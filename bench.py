@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Benchmark: ResNet-50 ImageNet-shape training throughput (img/s) on the
+available TPU chip(s), via the fused data-parallel train step.
+
+Baseline: the reference's published 109 img/s ResNet-50 train on 1x K80
+(BASELINE.md, example/image-classification/README.md:147-156).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import mxtpu  # noqa: F401
+    from mxtpu.models import resnet
+    from mxtpu.parallel import make_mesh
+    from mxtpu.parallel.dp import DataParallelTrainer
+
+    batch = int(float(__import__("os").environ.get("BENCH_BATCH", 256)))
+    n_dev = len(jax.devices())
+    mesh = make_mesh(shape=(n_dev,))
+    sym = resnet.get_symbol(num_classes=1000, num_layers=50,
+                            image_shape=(3, 224, 224))
+    trainer = DataParallelTrainer(
+        sym, mesh=mesh, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                          "rescale_grad": 1.0 / batch},
+        dtype="bfloat16")
+    trainer.init({"data": (batch, 3, 224, 224), "softmax_label": (batch,)})
+
+    rng = np.random.RandomState(0)
+    data = rng.rand(batch, 3, 224, 224).astype("float32")
+    import jax.numpy as jnp
+    data = jnp.asarray(data, dtype=jnp.bfloat16)
+    label = jnp.asarray(rng.randint(0, 1000, size=(batch,)).astype("float32"))
+    feed = {"data": data, "softmax_label": label}
+
+    # warmup (compile)
+    for _ in range(2):
+        outs = trainer.step(feed)
+    jax.block_until_ready(outs)
+
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        outs = trainer.step(feed)
+    jax.block_until_ready(outs)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * iters / dt
+    per_chip = img_per_sec / n_dev
+    baseline = 109.0  # K80 img/s, BASELINE.md
+    print(json.dumps({
+        "metric": "resnet50_train_throughput_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(per_chip / baseline, 3)}))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # never die silently: report a zero measurement
+        print(json.dumps({"metric": "resnet50_train_throughput_per_chip",
+                          "value": 0.0, "unit": "img/s/chip",
+                          "vs_baseline": 0.0, "error": str(e)[:400]}))
+        sys.exit(1)

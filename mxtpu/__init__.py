@@ -1,0 +1,56 @@
+"""mxtpu: a TPU-native deep-learning framework with the MXNet v0.11 capability
+surface (NDArray / Symbol / Module / Gluon / KVStore / DataIter) built on
+JAX/XLA/Pallas. See SURVEY.md for the reference layer map this mirrors.
+
+Usage parity with the reference Python package:
+
+    import mxtpu as mx
+    x = mx.nd.zeros((2, 3))
+    net = mx.sym.FullyConnected(mx.sym.Variable('data'), num_hidden=10)
+    mod = mx.mod.Module(mx.sym.SoftmaxOutput(net, name='softmax'))
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError, MXTPUError
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus
+from . import ndarray
+from . import ndarray as nd
+from . import symbol
+from . import symbol as sym
+from . import random
+from . import random as rnd
+from . import autograd
+from .executor import Executor
+
+# subsystems imported lazily-but-eagerly; order matters (no cycles)
+from . import initializer
+from .initializer import init  # noqa: F401  (registry namespace)
+from . import optimizer
+from . import lr_scheduler
+from . import metric
+from . import io
+from . import recordio
+from . import kvstore
+from . import kvstore as kv
+from . import callback
+from . import monitor
+from . import model
+from . import module
+from . import module as mod
+from . import gluon
+from . import models
+from . import visualization
+from . import visualization as viz
+from . import profiler
+from . import test_utils
+from . import parallel
+
+from .model import FeedForward
+from .kvstore import create as _kv_create
+
+
+def kvstore_create(name="local"):
+    return _kv_create(name)

@@ -1,0 +1,288 @@
+"""Define-by-run autograd: a tape over imperative op invokes, differentiated by
+jax.vjp at ``backward`` time.
+
+Parity: src/ndarray/autograd.{h,cc} (AutogradRuntime, AGNode tape, SURVEY.md §2.1)
+and python/mxnet/autograd.py (record/pause scopes :121-145, mark_variables :196,
+backward :227). TPU-native twist: instead of re-symbolizing the tape into an NNVM
+graph and binding an executor (autograd.cc:244-353), ``backward`` replays the tape
+as one pure JAX function of the marked variables and takes jax.vjp -- the whole
+backward becomes a single XLA program.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+_tls = threading.local()
+
+
+def _st():
+    if not hasattr(_tls, "recording"):
+        _tls.recording = False
+        _tls.training = False
+        _tls.tape = []
+    return _tls
+
+
+class _RecordingScope:
+    def __init__(self, recording, training):
+        self._rec = recording
+        self._train = training
+
+    def __enter__(self):
+        st = _st()
+        self._old = (st.recording, st.training)
+        if self._rec is not None:
+            st.recording = self._rec
+        if self._train is not None:
+            st.training = self._train
+        return self
+
+    def __exit__(self, *a):
+        st = _st()
+        st.recording, st.training = self._old
+
+
+def record(train_mode=True):
+    """Scope: record imperative ops onto the tape (parity autograd.py:121)."""
+    return _RecordingScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingScope(None, True)
+
+
+def predict_mode():
+    return _RecordingScope(None, False)
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(flag):
+    st = _st()
+    old = st.recording
+    st.recording = bool(flag)
+    return old
+
+
+def set_training(flag):
+    st = _st()
+    old = st.training
+    st.training = bool(flag)
+    return old
+
+
+class TapeEntry:
+    __slots__ = ("op", "attrs", "in_ids", "in_vals", "out_ids", "rng")
+
+    def __init__(self, op, attrs, in_ids, in_vals, out_ids, rng):
+        self.op = op
+        self.attrs = attrs
+        self.in_ids = in_ids
+        self.in_vals = in_vals  # raw jax arrays captured by value at record time
+        self.out_ids = out_ids
+        self.rng = rng
+
+
+def record_op(op, attrs, in_arrays, out_arrays, rng=None):
+    """Called by the imperative invoker for every op while recording."""
+    st = _st()
+    entry = TapeEntry(op, attrs,
+                      [x._uid for x in in_arrays],
+                      [x._data for x in in_arrays],
+                      [y._uid for y in out_arrays], rng)
+    st.tape.append(entry)
+    for y in out_arrays:
+        y._tape_entry = entry
+
+
+import weakref
+
+_marked = {}  # uid -> (weakref to NDArray, grad_req); dead refs pruned lazily
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers (parity autograd.py:196 / MXAutogradMarkVariables)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v.grad = g
+        v._grad_req = req
+        _marked[v._uid] = (weakref.ref(v), req)
+    if len(_marked) > 4096:
+        for uid in [u for u, (r, _) in _marked.items() if r() is None]:
+            del _marked[uid]
+
+
+def _get_marked(uid):
+    entry = _marked.get(uid)
+    if entry is None:
+        return None
+    v = entry[0]()
+    if v is None:
+        del _marked[uid]
+        return None
+    return (v, entry[1])
+
+
+def _collect(outputs):
+    """Backward slice of the tape reaching ``outputs``: entries in replay order."""
+    st = _st()
+    by_out = {}
+    for e in st.tape:
+        for oid in e.out_ids:
+            by_out[oid] = e
+    needed = []
+    seen = set()
+
+    def visit(e):
+        if id(e) in seen:
+            return
+        seen.add(id(e))
+        for iid in e.in_ids:
+            if iid in by_out:
+                visit(by_out[iid])
+        needed.append(e)
+
+    for o in outputs:
+        e = by_out.get(o._uid)
+        if e is not None:
+            visit(e)
+    return needed
+
+
+def backward(outputs, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of ``outputs`` w.r.t. all marked variables reached.
+
+    Replays the recorded slice as a pure function and runs one jax.vjp.
+    """
+    from .ndarray import NDArray
+
+    if isinstance(outputs, NDArray):
+        outputs = [outputs]
+    if head_grads is None:
+        head_grads = [None] * len(outputs)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    entries = _collect(outputs)
+    if not entries:
+        raise MXNetError("backward: outputs were not computed under record()")
+
+    produced = set()
+    for e in entries:
+        produced.update(e.out_ids)
+    # variables: marked arrays that feed the slice and were not produced inside it
+    var_ids, var_arrays = [], []
+    const_env = {}
+    for e in entries:
+        for iid, ival in zip(e.in_ids, e.in_vals):
+            if iid in produced or iid in const_env or iid in var_ids:
+                continue
+            marked = _get_marked(iid)
+            if marked is not None and marked[1] != "null":
+                var_ids.append(iid)
+                var_arrays.append(ival)
+            else:
+                const_env[iid] = ival
+
+    out_ids = [o._uid for o in outputs]
+
+    def replay(var_vals):
+        env = dict(const_env)
+        env.update(zip(var_ids, var_vals))
+        for e in entries:
+            ins = [env.get(iid, ival) for iid, ival in zip(e.in_ids, e.in_vals)]
+            outs = e.op.trace(e.attrs, ins, rng=e.rng)
+            for oid, oval in zip(e.out_ids, outs):
+                env[oid] = oval
+        return [env[oid] for oid in out_ids]
+
+    out_vals, vjp_fn = jax.vjp(replay, list(var_arrays))
+    cts = [jnp.ones_like(v) if g is None else g._data
+           for v, g in zip(out_vals, head_grads)]
+    (grads,) = vjp_fn(cts)
+
+    for uid, g in zip(var_ids, grads):
+        v, req = _get_marked(uid)
+        if req == "add" and v.grad is not None:
+            v.grad._data = v.grad._data + g
+        elif v.grad is not None:
+            v.grad._data = g.astype(v.grad._data.dtype)
+    if not retain_graph:
+        _st().tape.clear()
+
+
+def get_symbol(x):
+    """Trace the tape slice producing x into a Symbol (parity MXAutogradGetSymbol)."""
+    raise MXNetError("get_symbol: not supported yet")
+
+
+class Function:
+    """Custom differentiable function (parity autograd.py:292).
+
+    Subclass and override forward/backward; operates on NDArrays imperatively.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray
+        from .ops.registry import OpDef, AttrDict
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            fn = self
+
+            def impl(attrs, *raw):
+                @jax.custom_vjp
+                def core(*raw_in):
+                    return tuple(o._data for o in outs)
+
+                def fwd(*raw_in):
+                    return core(*raw_in), raw_in
+
+                def bwd(res, cts):
+                    with pause():
+                        gin = fn.backward(*[NDArray(c) for c in cts])
+                    gin = [gin] if not isinstance(gin, (list, tuple)) else gin
+                    return tuple(g._data for g in gin)
+
+                core.defvjp(fwd, bwd)
+                return core(*raw)
+
+            op = OpDef("_custom_function", impl, arg_names=["data"] * len(inputs),
+                       num_outputs=len(outs))
+            record_op(op, AttrDict(), list(inputs), outs)
+        return outputs if single else outs

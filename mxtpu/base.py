@@ -1,0 +1,124 @@
+"""Base utilities: error type, registry, attribute parsing, env config.
+
+TPU-native replacement for the reference's dmlc-core layer (SURVEY.md L0):
+``dmlc::Registry`` -> :class:`Registry`, ``dmlc::Parameter`` -> op attr specs in
+``mxtpu.ops.registry``, ``dmlc::GetEnv`` -> :func:`getenv`, logging/MXNetError ABI
+-> plain Python exceptions (reference: include/mxnet/base.h, python/mxnet/base.py:56).
+"""
+from __future__ import annotations
+
+import ast
+import logging
+import os
+
+__all__ = ["MXNetError", "MXTPUError", "Registry", "getenv", "string_types", "numeric_types"]
+
+string_types = (str,)
+numeric_types = (float, int)
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (parity with python/mxnet/base.py:56)."""
+
+
+# native name for the new framework; MXNetError kept as a compat alias
+MXTPUError = MXNetError
+
+
+def getenv(name, default):
+    """Typed env lookup (parity with dmlc::GetEnv). Type taken from ``default``."""
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    if isinstance(default, bool):
+        return val not in ("0", "false", "False", "")
+    if isinstance(default, int):
+        return int(val)
+    if isinstance(default, float):
+        return float(val)
+    return val
+
+
+class Registry:
+    """Generic name -> object registry (parity with dmlc::Registry).
+
+    Used for optimizers, metrics, initializers, data iterators and ops.
+    """
+
+    def __init__(self, kind):
+        self.kind = kind
+        self._map = {}
+
+    def register(self, obj, name=None, aliases=()):
+        key = (name or getattr(obj, "__name__", None) or str(obj)).lower()
+        self._map[key] = obj
+        for a in aliases:
+            self._map[a.lower()] = obj
+        return obj
+
+    def get(self, name):
+        key = name.lower()
+        if key not in self._map:
+            raise MXNetError(
+                "Cannot find %s '%s'. Registered: %s"
+                % (self.kind, name, sorted(self._map))
+            )
+        return self._map[key]
+
+    def find(self, name):
+        return self._map.get(name.lower())
+
+    def keys(self):
+        return list(self._map)
+
+    def create(self, name, *args, **kwargs):
+        return self.get(name)(*args, **kwargs)
+
+
+def parse_attr(value, proto):
+    """Parse a (possibly string) attribute value to the type of ``proto``.
+
+    Symbol JSON stores all attrs as strings (reference nnvm attr dicts);
+    this is the counterpart of dmlc::Parameter string parsing.
+    """
+    if proto is None:
+        return value
+    if isinstance(proto, type):
+        ty = proto
+    else:
+        ty = type(proto)
+    if value is None:
+        return value
+    if ty is bool:
+        if isinstance(value, str):
+            return value.strip().lower() in ("1", "true", "yes")
+        return bool(value)
+    if ty in (tuple, list):
+        if isinstance(value, str):
+            v = ast.literal_eval(value) if value.strip() else ()
+            return tuple(v) if not isinstance(v, (tuple, list)) else tuple(v)
+        if isinstance(value, (tuple, list)):
+            return tuple(value)
+        return (value,)
+    if ty is int:
+        if isinstance(value, str) and value.lower() == "none":
+            return None
+        return int(float(value)) if isinstance(value, str) else int(value)
+    if ty is float:
+        return float(value)
+    if ty is str:
+        return str(value)
+    return value
+
+
+def attr_repr(value):
+    """Serialize an attribute for symbol JSON (everything becomes a string)."""
+    if isinstance(value, (tuple, list)):
+        return "(" + ", ".join(str(v) for v in value) + ")"
+    return str(value)
+
+
+def get_logger(name="mxtpu"):
+    # deliberately no basicConfig() here: the library must not hijack the
+    # application's logging setup
+    return logging.getLogger(name)

@@ -1,0 +1,328 @@
+"""Executor: binds a Symbol and runs it as ONE jit-compiled XLA program.
+
+Parity: src/executor/graph_executor.{h,cc} (Bind/SimpleBind :1560-1597,
+Forward :80 / Backward :93) and python/mxnet/executor.py. TPU-native design
+(SURVEY.md §7 stage 4): the reference's init pipeline -- gradient pass, device
+placement, shape/type inference, PlanMemory, AttachOpExecs, bulk segments --
+collapses into a single traced JAX function per (mode, input shapes):
+  * forward graph      -> jit(trace)                       [eval path]
+  * forward + backward -> jit(value + vjp in one program)  [train path]
+XLA does memory planning, fusion, scheduling and rematerialization; gradients
+come from jax.vjp instead of registered _backward_* ops; loss heads use their
+custom_vjp (see ops/nn.py) so ``backward()`` with implicit ones-cotangents
+reproduces MXNet's head-gradient semantics. grad_req write/add/null matches
+include/mxnet/op_attr_types.h:44-59 (kWriteTo/kAddTo/kNullOp).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .base import MXNetError
+from .context import Context, current_context
+from .ndarray import NDArray, zeros
+from . import random as _rnd
+
+__all__ = ["Executor"]
+
+
+def _trace_graph(symbol, is_train):
+    """Return fn(arg_vals, aux_vals, rng) -> (outputs, aux_updates_dict)."""
+    topo = symbol._topo()
+    node_index = {id(n): i for i, n in enumerate(topo)}
+    aux_nodes = symbol._aux_node_set()
+    out_entries = [(id(n), i) for n, i in symbol._outputs]
+
+    def run(arg_vals, aux_vals, rng):
+        env = {}
+        aux_updates = {}
+        for node in topo:
+            if node.is_variable:
+                if id(node) in aux_nodes:
+                    env[(id(node), 0)] = aux_vals[node.name]
+                else:
+                    env[(id(node), 0)] = arg_vals[node.name]
+                continue
+            attrs = node.parsed_attrs()
+            if "__is_train__" in node.op.attrs_spec:
+                attrs = type(attrs)(attrs)
+                attrs["__is_train__"] = is_train
+            ins = [env[(id(n), i)] for n, i in node.inputs]
+            key = jax.random.fold_in(rng, node_index[id(node)]) \
+                if node.op.needs_rng else None
+            outs = node.op.trace(attrs, ins, rng=key)
+            n_vis = node.op.n_out(attrs)
+            for i in range(n_vis):
+                env[(id(node), i)] = outs[i]
+            # aux updates propagate back to the feeding aux variable
+            if node.op.aux_names and len(outs) > n_vis:
+                names = node.op.input_names(attrs, n=len(node.inputs))
+                for j, an in enumerate(node.op.aux_names):
+                    idx = names.index(an)
+                    src = node.inputs[idx][0]
+                    if src.is_variable:
+                        aux_updates[src.name] = outs[n_vis + j]
+        return [env[e] for e in out_entries], aux_updates
+
+    return run
+
+
+class Executor:
+    """Bound computation (one device context per executor, like the reference)."""
+
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None, group2ctx=None):
+        self._symbol = symbol
+        self._ctx = ctx if isinstance(ctx, Context) else (ctx or current_context())
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.output_names = symbol.list_outputs()
+
+        self.arg_dict = self._as_dict(args, self.arg_names, "args")
+        self.aux_dict = self._as_dict(aux_states or {}, self.aux_names, "aux_states")
+        if isinstance(grad_req, str):
+            self.grad_req = {n: grad_req for n in self.arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self.grad_req = dict(zip(self.arg_names, grad_req))
+        else:
+            self.grad_req = {n: grad_req.get(n, "null") for n in self.arg_names}
+        if args_grad is None:
+            self.grad_dict = {}
+        else:
+            self.grad_dict = self._as_dict(args_grad, self.arg_names, "args_grad",
+                                           allow_missing=True)
+        self.outputs = []
+        self._pending_grads = None
+        self._fns = {}
+        self._monitor_callback = None
+
+    def _as_dict(self, vals, names, what, allow_missing=False):
+        if isinstance(vals, dict):
+            out = dict(vals)
+        else:
+            out = dict(zip(names, vals))
+        if not allow_missing:
+            for n in names:
+                if n not in out:
+                    raise MXNetError("%s: missing array for '%s'" % (what, n))
+        return out
+
+    # -------------------------------------------------- compiled programs
+    def _grad_arg_names(self):
+        return [n for n in self.arg_names
+                if self.grad_req.get(n, "null") != "null" and n in self.grad_dict]
+
+    def _get_fn(self, kind):
+        fn = self._fns.get(kind)
+        if fn is not None:
+            return fn
+        if kind == "fwd_eval":
+            run = _trace_graph(self._symbol, is_train=False)
+            fn = jax.jit(lambda a, x, r: run(a, x, r))
+        elif kind == "fwd_train":
+            run = _trace_graph(self._symbol, is_train=True)
+            fn = jax.jit(lambda a, x, r: run(a, x, r))
+        elif kind == "fwd_bwd":
+            run = _trace_graph(self._symbol, is_train=True)
+            gnames = tuple(self._grad_arg_names())
+
+            def fb(arg_vals, aux_vals, rng):
+                gvals = {n: arg_vals[n] for n in gnames}
+                other = {n: v for n, v in arg_vals.items() if n not in gnames}
+
+                def f(gv):
+                    av = dict(other)
+                    av.update(gv)
+                    outs, auxu = run(av, aux_vals, rng)
+                    return outs, auxu
+
+                (outs, auxu), vjp = jax.vjp(f, gvals)
+                cts = [jnp.ones_like(o) for o in outs]
+                (grads,) = vjp((cts, {k: jnp.zeros_like(v)
+                                      for k, v in auxu.items()}))
+                return outs, auxu, grads
+
+            fn = jax.jit(fb)
+        elif kind == "fwd_bwd_heads":
+            run = _trace_graph(self._symbol, is_train=True)
+            gnames = tuple(self._grad_arg_names())
+
+            def fbh(arg_vals, aux_vals, rng, head_grads):
+                gvals = {n: arg_vals[n] for n in gnames}
+                other = {n: v for n, v in arg_vals.items() if n not in gnames}
+
+                def f(gv):
+                    av = dict(other)
+                    av.update(gv)
+                    outs, auxu = run(av, aux_vals, rng)
+                    return outs, auxu
+
+                (outs, auxu), vjp = jax.vjp(f, gvals)
+                (grads,) = vjp((list(head_grads),
+                                {k: jnp.zeros_like(v) for k, v in auxu.items()}))
+                return outs, auxu, grads
+
+            fn = jax.jit(fbh)
+        else:
+            raise MXNetError("unknown program kind %s" % kind)
+        self._fns[kind] = fn
+        return fn
+
+    def _raw_args(self):
+        return {n: self.arg_dict[n]._data for n in self.arg_names}
+
+    def _raw_aux(self):
+        return {n: self.aux_dict[n]._data for n in self.aux_names}
+
+    def _apply_aux(self, aux_updates):
+        for n, v in aux_updates.items():
+            self.aux_dict[n]._data = v
+
+    def _wrap_outputs(self, outs):
+        self.outputs = [NDArray(o, self._ctx) for o in outs]
+        return self.outputs
+
+    # -------------------------------------------------- public API
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._data = v._data if isinstance(v, NDArray) \
+                    else jnp.asarray(v)
+        rng = _rnd.next_key()
+        want_grad = bool(self._grad_arg_names())
+        if is_train and want_grad:
+            outs, auxu, grads = self._get_fn("fwd_bwd")(
+                self._raw_args(), self._raw_aux(), rng)
+            self._pending_grads = grads
+        else:
+            kind = "fwd_train" if is_train else "fwd_eval"
+            outs, auxu = self._get_fn(kind)(self._raw_args(), self._raw_aux(), rng)
+            self._pending_grads = None
+        if is_train:
+            self._apply_aux(auxu)
+        outputs = self._wrap_outputs(outs)
+        if self._monitor_callback is not None:
+            for name, arr in zip(self.output_names, outputs):
+                self._monitor_callback(name, arr)
+        return outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        if not self._grad_arg_names():
+            return
+        if out_grads is None:
+            grads = self._pending_grads
+            if grads is None:
+                raise MXNetError("backward: call forward(is_train=True) first")
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            rng = _rnd.next_key()
+            outs, auxu, grads = self._get_fn("fwd_bwd_heads")(
+                self._raw_args(), self._raw_aux(), rng,
+                [g._data for g in out_grads])
+            self._wrap_outputs(outs)
+        for n, g in grads.items():
+            req = self.grad_req.get(n, "null")
+            dst = self.grad_dict.get(n)
+            if dst is None or req == "null":
+                continue
+            if req == "add":
+                dst._data = dst._data + g
+            else:
+                dst._data = g.astype(dst._data.dtype)
+        self._pending_grads = None
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self.arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self.arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self.aux_names]
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._data = jax.device_put(
+                    arr._data, self._ctx.jax_device)
+            elif not allow_extra_params:
+                raise MXNetError("Found name \"%s\" not in arguments" % name)
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._data = jax.device_put(
+                        arr._data, self._ctx.jax_device)
+                elif not allow_extra_params:
+                    raise MXNetError("Found name \"%s\" not in aux states" % name)
+
+    def set_monitor_callback(self, callback):
+        self._monitor_callback = callback
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Rebind with new input shapes (cheap: jit retraces per shape)."""
+        new_args = {}
+        for n in self.arg_names:
+            if n in kwargs:
+                new_args[n] = zeros(kwargs[n], ctx=self._ctx,
+                                    dtype=self.arg_dict[n].dtype)
+            else:
+                new_args[n] = self.arg_dict[n]
+        new_grads = {n: zeros(new_args[n].shape, ctx=self._ctx,
+                              dtype=new_args[n].dtype)
+                     for n in self.grad_dict}
+        return Executor(self._symbol, self._ctx, new_args, args_grad=new_grads,
+                        grad_req=self.grad_req, aux_states=self.aux_dict)
+
+    # -------------------------------------------------- simple_bind
+    @staticmethod
+    def simple_bind(symbol, ctx=None, grad_req="write", type_dict=None,
+                    shared_exec=None, shared_data_arrays=None, **kwargs):
+        """Allocate args/grads/aux from inferred shapes (parity SimpleBind
+        graph_executor.cc:1560; memory pooling is XLA's concern here)."""
+        ctx = ctx or current_context()
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**kwargs)
+        if arg_shapes is None:
+            raise MXNetError("simple_bind: cannot infer shapes")
+        type_dict = type_dict or {}
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        arg_types, _, aux_types = symbol.infer_type(**{
+            k: v for k, v in type_dict.items() if k in arg_names})
+        args = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            dt = type_dict.get(name, "float32")
+            if shared_exec is not None and name in shared_exec.arg_dict and \
+                    shared_exec.arg_dict[name].shape == tuple(shape):
+                args[name] = shared_exec.arg_dict[name]
+            else:
+                args[name] = zeros(shape, ctx=ctx, dtype=dt)
+        if isinstance(grad_req, str):
+            req_of = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            req_of = dict(zip(arg_names, grad_req))
+        else:
+            req_of = {n: grad_req.get(n, "null") for n in arg_names}
+        args_grad = {}
+        for name in arg_names:
+            if req_of.get(name, "null") != "null":
+                if shared_exec is not None and name in shared_exec.grad_dict and \
+                        shared_exec.grad_dict[name].shape == args[name].shape:
+                    args_grad[name] = shared_exec.grad_dict[name]
+                else:
+                    args_grad[name] = zeros(args[name].shape, ctx=ctx,
+                                            dtype=args[name].dtype)
+        aux = {}
+        for name, shape in zip(aux_names, aux_shapes):
+            if shared_exec is not None and name in shared_exec.aux_dict and \
+                    shared_exec.aux_dict[name].shape == tuple(shape):
+                aux[name] = shared_exec.aux_dict[name]
+            else:
+                aux[name] = zeros(shape, ctx=ctx)
+        return Executor(symbol, ctx, args, args_grad=args_grad, grad_req=req_of,
+                        aux_states=aux)

@@ -1,0 +1,11 @@
+"""Gluon: the imperative / hybridizable frontend (parity: python/mxnet/gluon/).
+"""
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import data
+from . import model_zoo
+from . import utils
+from .utils import split_and_load, split_data

@@ -1,0 +1,382 @@
+"""Gluon Block / HybridBlock / SymbolBlock.
+
+Parity: python/mxnet/gluon/block.py (Block :119, HybridBlock :302, hybridize
+:273, _build_cache -> CachedOp :380-382, SymbolBlock :452). TPU-native CachedOp:
+the hybridized subgraph becomes ONE jit-compiled XLA program registered as a
+single op, so it both runs fused *and* records as a single tape entry for
+autograd (the reference's CachedOp replay, c_api_ndarray.cc:731)."""
+from __future__ import annotations
+
+import threading
+
+from .. import autograd
+from .. import ndarray as nd
+from .. import symbol as sym_mod
+from ..base import MXNetError
+from ..executor import _trace_graph
+from ..ndarray import NDArray
+from ..ops.registry import OpDef, AttrDict
+from ..symbol import Symbol
+from .parameter import DeferredInitializationError, Parameter, ParameterDict
+
+
+class _BlockScope:
+    """Name scoping for blocks (parity block.py _BlockScope)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+        self._name_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                prefix = _name_counter(hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = "%s%d_" % (hint, count)
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        _BlockScope._current.value = self._old_scope
+
+
+_global_counter = {}
+
+
+def _name_counter(hint):
+    count = _global_counter.get(hint, 0)
+    _global_counter[hint] = count + 1
+    return "%s%d" % (hint, count)
+
+
+def _flatten(args):
+    if isinstance(args, NDArray) or isinstance(args, Symbol):
+        return [args], int(0)
+    assert isinstance(args, (list, tuple)), \
+        "HybridBlock input must be (nested) list of Symbol or NDArray, " \
+        "got %s of type %s" % (str(args), str(type(args)))
+    flat = []
+    fmts = []
+    for i in args:
+        arg, fmt = _flatten(i)
+        flat.extend(arg)
+        fmts.append(fmt)
+    return flat, fmts
+
+
+def _regroup(args, fmt):
+    if isinstance(fmt, int):
+        if fmt == 0:
+            return args[0], args[1:]
+        return args[:fmt], args[fmt:]
+    ret = []
+    for i in fmt:
+        res, args = _regroup(args, i)
+        ret.append(res)
+    return ret, args
+
+
+class Block:
+    """Base class for all layers and models (parity block.py:119)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = []
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join("  ({key}): {block}".format(
+            key=key, block=repr(block).replace("\n", "\n  "))
+            for key, block in self.__dict__.items()
+            if isinstance(block, Block))
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            self.register_child(value)
+        super().__setattr__(name, value)
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self):
+        ret = ParameterDict(self._params.prefix)
+        ret.update(self.params)
+        for cld in self._children:
+            ret.update(cld.collect_params())
+        return ret
+
+    def save_params(self, filename):
+        self.collect_params().save(filename, strip_prefix=self.prefix)
+
+    def load_params(self, filename, ctx, allow_missing=False,
+                    ignore_extra=False):
+        self.collect_params().load(filename, ctx, allow_missing, ignore_extra,
+                                   self.prefix)
+
+    def register_child(self, block):
+        self._children.append(block)
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose,
+                                         force_reinit=force_reinit)
+
+    def hybridize(self, active=True):
+        for cld in self._children:
+            cld.hybridize(active)
+
+    def cast(self, dtype):
+        for child in self._children:
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def __call__(self, *args):
+        return self.forward(*args)
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+
+class HybridBlock(Block):
+    """Block that can be traced to a Symbol and run as one XLA program."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._reg_params = {}
+        self._cached_graph = ()
+        self._cached_op = None
+        self._active = False
+        self._flags = {}
+
+    def __setattr__(self, name, value):
+        super().__setattr__(name, value)
+        if isinstance(value, Parameter):
+            self._reg_params[name] = value
+
+    def register_child(self, block):
+        if not isinstance(block, HybridBlock):
+            raise ValueError(
+                "Children of HybridBlock must also be HybridBlock, but %s has "
+                "type %s." % (str(block), str(type(block))))
+        super().register_child(block)
+        self._cached_op = None
+        self._cached_graph = ()
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._flags = kwargs
+        self._cached_op = None
+        super().hybridize(active)
+
+    def cast(self, dtype):
+        self._cached_op = None
+        super().cast(dtype)
+
+    # ---------------------------------------- cached-graph machinery
+    def _get_graph(self, *args):
+        if not self._cached_graph:
+            flat_args, self._in_format = _flatten(args)
+            inputs = [sym_mod.var("data%d" % i) if len(flat_args) > 1
+                      else sym_mod.var("data") for i in range(len(flat_args))]
+            grouped, _ = _regroup(inputs, self._in_format)
+            params = {i: j.var() for i, j in self._reg_params.items()}
+            with self.name_scope():
+                out = self.hybrid_forward(sym_mod, grouped, **params) \
+                    if not isinstance(grouped, list) else \
+                    self.hybrid_forward(sym_mod, *grouped, **params)
+            out_flat, self._out_format = _flatten(out)
+            self._cached_graph = inputs, sym_mod.Group(out_flat)
+        return self._cached_graph
+
+    def infer_shape(self, *args):
+        """Infer (deferred) parameter shapes from input shapes."""
+        inputs, out = self._get_graph(*args)
+        flat_args, _ = _flatten(args)
+        shape_hints = {i.name: j.shape for i, j in zip(inputs, flat_args)}
+        arg_shapes, _, aux_shapes = out.infer_shape(**shape_hints)
+        sdict = dict(zip(out.list_arguments(), arg_shapes))
+        sdict.update(zip(out.list_auxiliary_states(), aux_shapes))
+        for _, param in self.collect_params().items():
+            if param.name in sdict:
+                param.shape = sdict[param.name]
+
+    def _build_cached_op(self, args):
+        """TPU CachedOp: wrap the traced Symbol into a single registered op."""
+        inputs, out = self._get_graph(*args)
+        input_names = [i.name for i in inputs]
+        arg_names = out.list_arguments()
+        aux_names = out.list_auxiliary_states()
+        params = {p.name: p for _, p in self.collect_params().items()}
+        # op input order: graph arg order (+ aux at the end)
+        self._cop_args = []
+        for name in arg_names + aux_names:
+            if name in input_names:
+                self._cop_args.append(("input", input_names.index(name)))
+            else:
+                self._cop_args.append(("param", params[name]))
+        run = _trace_graph(out, is_train=False)
+        run_train = _trace_graph(out, is_train=True)
+        all_names = arg_names + aux_names
+        aux_set = set(aux_names)
+        n_out = len(out.list_outputs())
+
+        def impl(attrs, rng, *vals):
+            env = {}
+            aux = {}
+            for name, v in zip(all_names, vals):
+                (aux if name in aux_set else env)[name] = v
+            r = run_train if attrs.get("__is_train__") else run
+            outs, auxu = r(env, aux, rng)
+            return tuple(outs) + tuple(auxu.get(n, aux[n]) for n in aux_names)
+
+        self._cached_op = OpDef(
+            "_cached_" + self.name, impl, arg_names=list(all_names),
+            attrs={"__is_train__": False}, num_outputs=n_out,
+            aux_names=list(aux_names), needs_rng=True)
+
+    def _call_cached_op(self, *args):
+        if self._cached_op is None:
+            self._build_cached_op(args)
+        flat_args, _ = _flatten(args)
+        cargs = []
+        for kind, v in self._cop_args:
+            if kind == "input":
+                cargs.append(flat_args[v])
+            else:
+                cargs.append(v.data())
+        from ..ndarray.ndarray import invoke_op as _invoke
+        from ..ops import registry as _reg
+        if self._cached_op.name not in _reg._OPS:
+            _reg.register_op(self._cached_op)
+        outs = _invoke(self._cached_op.name, cargs, {})
+        ret, _ = _regroup(outs, self._out_format)
+        return ret
+
+    def __call__(self, *args):
+        return self.forward(*args)
+
+    def forward(self, x, *args):
+        """Dispatch: NDArray -> imperative/cached; Symbol -> compose."""
+        if isinstance(x, NDArray):
+            if self._active:
+                try:
+                    return self._call_cached_op(x, *args)
+                except DeferredInitializationError:
+                    self.infer_shape(x, *args)
+                    for _, p in self.collect_params().items():
+                        p._finish_deferred_init()
+                    return self._call_cached_op(x, *args)
+            try:
+                params = {i: j.data(x.context)
+                          for i, j in self._reg_params.items()}
+            except DeferredInitializationError:
+                self.infer_shape(x, *args)
+                for _, p in self.collect_params().items():
+                    p._finish_deferred_init()
+                params = {i: j.data(x.context)
+                          for i, j in self._reg_params.items()}
+            return self.hybrid_forward(nd, x, *args, **params)
+        assert isinstance(x, Symbol), \
+            "HybridBlock requires the first argument to forward be either " \
+            "Symbol or NDArray, but got %s" % type(x)
+        params = {i: j.var() for i, j in self._reg_params.items()}
+        with self.name_scope():
+            return self.hybrid_forward(sym_mod, x, *args, **params)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol + inputs as a callable block (parity block.py:452)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix=None, params=params)
+        self._prefix = ""
+        self._params = ParameterDict("", params)
+        if isinstance(inputs, (Symbol,)) and len(inputs.list_outputs()) == 1:
+            inputs = [inputs]
+        if isinstance(outputs, (list, tuple)) and len(outputs) == 1 and \
+                isinstance(outputs[0], list):
+            outputs = outputs[0]
+        syms = inputs
+        if isinstance(outputs, (list, tuple)):
+            out = sym_mod.Group(outputs)
+        else:
+            out = outputs
+        input_names = set()
+        for i in syms:
+            assert len(i.list_outputs()) == 1, \
+                "Input symbols must be variable, but %s is an output of " \
+                "operators" % str(i)
+            input_names.add(i.list_outputs()[0] if i.name is None else i.name)
+        for i in out.list_arguments():
+            if i not in input_names:
+                self.params.get(i, allow_deferred_init=True)
+        for i in out.list_auxiliary_states():
+            if i not in input_names:
+                self.params.get(i, allow_deferred_init=True, grad_req="null")
+        self._cached_graph = syms, out
+        self._in_format = [0] * len(syms) if len(syms) > 1 else 0
+        self._out_format = [0] * len(out.list_outputs()) \
+            if len(out.list_outputs()) > 1 else 0
+        self._reg_params = {}
+
+    def forward(self, x, *args):
+        if isinstance(x, NDArray):
+            try:
+                return self._call_cached_op(x, *args)
+            except DeferredInitializationError:
+                self.infer_shape(x, *args)
+                for _, p in self.collect_params().items():
+                    p._finish_deferred_init()
+                return self._call_cached_op(x, *args)
+        assert isinstance(x, Symbol)
+        raise MXNetError("SymbolBlock symbolic forward not supported")
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError

@@ -1,0 +1,98 @@
+"""DataLoader (parity: python/mxnet/gluon/data/dataloader.py:40).
+
+Batches are assembled host-side (numpy) then wrapped as NDArrays; a background
+prefetch thread overlaps host assembly with device compute when num_workers>0
+(thread-based: the decode work releases the GIL in numpy/PIL, and device
+transfer is async anyway)."""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as _np
+
+from ... import ndarray as nd
+from ...ndarray import NDArray
+from . import sampler as _sampler
+
+
+def default_batchify_fn(data):
+    if isinstance(data[0], NDArray):
+        return nd.stack(*data)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = _np.asarray(data)
+    return nd.array(data, dtype=data.dtype)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = _sampler.RandomSampler(len(dataset))
+                else:
+                    sampler = _sampler.SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler is "
+                                 "specified")
+            batch_sampler = _sampler.BatchSampler(
+                sampler, batch_size, last_batch if last_batch else "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError("batch_size, shuffle, sampler and last_batch must "
+                             "not be specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = num_workers
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[idx] for idx in batch])
+            return
+        # threaded prefetch: one producer assembling batches ahead. A stop
+        # flag + timeout puts let the producer exit when the consumer
+        # abandons iteration early (no leaked thread / pinned batches).
+        q = queue.Queue(maxsize=max(2, self._num_workers * 2))
+        sentinel = object()
+        stopped = threading.Event()
+
+        def producer():
+            for batch in self._batch_sampler:
+                item = self._batchify_fn([self._dataset[idx] for idx in batch])
+                while not stopped.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stopped.is_set():
+                    return
+            while not stopped.is_set():
+                try:
+                    q.put(sentinel, timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                yield item
+        finally:
+            stopped.set()
+
+    def __len__(self):
+        return len(self._batch_sampler)

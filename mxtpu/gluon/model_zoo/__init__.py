@@ -1,0 +1,3 @@
+"""Model zoo (parity: python/mxnet/gluon/model_zoo/)."""
+from . import vision
+from . import model_store

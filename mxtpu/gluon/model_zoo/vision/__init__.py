@@ -1,0 +1,23 @@
+"""Vision model zoo (parity: python/mxnet/gluon/model_zoo/vision/__init__.py).
+
+Families: resnet v1/v2 now; alexnet/vgg/squeezenet/densenet/mobilenet/inception
+land with the model-breadth milestone (tracked against SURVEY.md §2.6)."""
+from .resnet import (BasicBlockV1, BasicBlockV2, BottleneckV1, BottleneckV2,
+                     ResNetV1, ResNetV2, get_resnet, resnet18_v1, resnet18_v2,
+                     resnet34_v1, resnet34_v2, resnet50_v1, resnet50_v2,
+                     resnet101_v1, resnet101_v2, resnet152_v1, resnet152_v2)
+
+_models = {"resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,
+           "resnet50_v1": resnet50_v1, "resnet101_v1": resnet101_v1,
+           "resnet152_v1": resnet152_v1, "resnet18_v2": resnet18_v2,
+           "resnet34_v2": resnet34_v2, "resnet50_v2": resnet50_v2,
+           "resnet101_v2": resnet101_v2, "resnet152_v2": resnet152_v2}
+
+
+def get_model(name, **kwargs):
+    name = name.lower()
+    if name not in _models:
+        raise ValueError(
+            "Model %s is not supported. Available options are:\n\t%s" % (
+                name, "\n\t".join(sorted(_models.keys()))))
+    return _models[name](**kwargs)

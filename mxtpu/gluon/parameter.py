@@ -1,0 +1,319 @@
+"""Gluon Parameter / ParameterDict (parity: python/mxnet/gluon/parameter.py:41,
+394 — deferred init, per-ctx replicas list_data, grads, var())."""
+from __future__ import annotations
+
+import re
+
+from .. import autograd
+from .. import context as ctx_mod
+from .. import ndarray as nd
+from .. import symbol as sym_mod
+from ..base import MXNetError
+from ..initializer import InitDesc
+
+
+class DeferredInitializationError(MXNetError):
+    pass
+
+
+class Parameter:
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True):
+        self.name = name
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.grad_req = grad_req if differentiable else "null"
+        self._allow_deferred_init = allow_deferred_init
+        self._var = None
+        self._data = None  # dict ctx -> NDArray
+        self._grad = None
+        self._deferred_init = ()
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (self.name, self.shape,
+                                                      self.dtype)
+
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        from ..initializer import Uniform
+        default_init = default_init or Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [ctx_mod.current_context()]
+        if isinstance(ctx, ctx_mod.Context):
+            ctx = [ctx]
+        if self.shape is None or any(s == 0 for s in self.shape):
+            if self._allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise MXNetError("Cannot initialize Parameter %s because it has "
+                             "invalid shape: %s." % (self.name, str(self.shape)))
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        data = nd.zeros(self.shape, dtype=self.dtype, ctx=ctx[0])
+        initializer = init or self.init or default_init
+        initializer(InitDesc(self.name), data)
+        self._init_impl(data, ctx)
+
+    def _init_impl(self, data, ctx_list):
+        self._data = {}
+        for c in ctx_list:
+            self._data[c] = data.as_in_context(c) if c != data.context else data
+        if self.grad_req == "null":
+            self._grad = None
+            return
+        self._grad = {c: nd.zeros(self.shape, dtype=self.dtype, ctx=c)
+                      for c in ctx_list}
+        for c in ctx_list:
+            autograd.mark_variables([self._data[c]], [self._grad[c]],
+                                    self.grad_req)
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, default_init = self._deferred_init
+        self._deferred_init = ()
+        if self.shape is None or any(s == 0 for s in self.shape):
+            raise DeferredInitializationError(
+                "Parameter %s has unknown shape" % self.name)
+        self._finish_init(init, ctx, default_init)
+
+    def _load_init(self, data, ctx):
+        if self.shape and any(s != 0 for s in self.shape):
+            assert tuple(data.shape) == tuple(self.shape), \
+                "Failed loading Parameter %s: shape %s vs saved %s" % (
+                    self.name, self.shape, data.shape)
+        else:
+            self.shape = data.shape
+        if self._data is None:
+            if isinstance(ctx, ctx_mod.Context):
+                ctx = [ctx]
+            self._deferred_init = ()
+            self._init_impl(data.astype(self.dtype), ctx)
+        else:
+            self.set_data(data)
+
+    def set_shape_from(self, data_shape_fill):
+        """Fill zero dims from an observed input (deferred shape inference)."""
+        if self.shape is None:
+            self.shape = tuple(data_shape_fill)
+            return
+        new = tuple(d if d != 0 else o
+                    for d, o in zip(self.shape, data_shape_fill))
+        self.shape = new
+
+    def set_data(self, data):
+        assert self._data is not None, \
+            "Parameter %s has not been initialized" % self.name
+        for c, arr in self._data.items():
+            arr._data = data.as_in_context(c)._data
+
+    def data(self, ctx=None):
+        if self._data is None:
+            if self._deferred_init:
+                raise DeferredInitializationError(
+                    "Parameter %s was not initialized on context %s." %
+                    (self.name, str(ctx)))
+            raise MXNetError("Parameter %s has not been initialized. "
+                             "call .initialize() first" % self.name)
+        if ctx is None:
+            if len(self._data) == 1:
+                return list(self._data.values())[0]
+            ctx = ctx_mod.current_context()
+        if ctx not in self._data:
+            raise MXNetError("Parameter %s was not initialized on context %s."
+                             % (self.name, str(ctx)))
+        return self._data[ctx]
+
+    def list_data(self):
+        if self._data is None:
+            raise MXNetError("Parameter %s has not been initialized" % self.name)
+        return list(self._data.values())
+
+    def grad(self, ctx=None):
+        if self._grad is None:
+            raise MXNetError(
+                "Cannot get gradient array for Parameter %s because grad_req="
+                "'null'" % self.name)
+        if ctx is None:
+            if len(self._grad) == 1:
+                return list(self._grad.values())[0]
+            ctx = ctx_mod.current_context()
+        return self._grad[ctx]
+
+    def list_grad(self):
+        if self._grad is None:
+            raise MXNetError("no gradients for %s" % self.name)
+        return list(self._grad.values())
+
+    def list_ctx(self):
+        if self._data is None:
+            if self._deferred_init:
+                return self._deferred_init[1]
+            raise MXNetError("Parameter %s has not been initialized" % self.name)
+        return list(self._data.keys())
+
+    def zero_grad(self):
+        if self._grad is None:
+            return
+        for g in self._grad.values():
+            g[:] = 0
+
+    def var(self):
+        if self._var is None:
+            shape = self.shape
+            if shape is not None and any(s == 0 for s in shape):
+                shape = None  # unknown dims: let graph inference fill them
+            self._var = sym_mod.var(self.name, shape=shape,
+                                    dtype=self.dtype, lr_mult=self.lr_mult,
+                                    wd_mult=self.wd_mult)
+        return self._var
+
+    def reset_ctx(self, ctx):
+        if isinstance(ctx, ctx_mod.Context):
+            ctx = [ctx]
+        if self._data is not None:
+            data = list(self._data.values())[0]
+            self._init_impl(data, ctx)
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is None:
+            return
+        with autograd.pause():
+            self._data = {c: v.astype(dtype) for c, v in self._data.items()}
+            if self._grad is not None:
+                self._grad = {c: v.astype(dtype)
+                              for c, v in self._grad.items()}
+                for c in self._data:
+                    autograd.mark_variables([self._data[c]], [self._grad[c]],
+                                            self.grad_req)
+
+
+class ParameterDict:
+    """Prefix-scoped dict of Parameters (parity parameter.py:394)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = {}
+        self._shared = shared
+
+    def __repr__(self):
+        s = "{name}(\n{content}\n)"
+        name = self._prefix + " " if self._prefix else ""
+        return s.format(name=name, content="\n".join(
+            "  " + repr(v) for v in self.values()))
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and v is not None and existing is not None:
+                        v = tuple(v)
+                        if len(v) == len(existing):
+                            merged = tuple(a if a != 0 else b
+                                           for a, b in zip(existing, v))
+                            param.shape = merged
+                        continue
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params:
+                assert self._params[k] is v, \
+                    "Cannot update self with other because they have different " \
+                    "Parameters with the same name %s" % k
+            else:
+                self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        from ..initializer import Uniform
+        for _, v in self.items():
+            v.initialize(None, ctx, init or Uniform(), force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        arg_dict = {}
+        for param in self.values():
+            weight = param.data().copy()
+            if not param.name.startswith(strip_prefix):
+                raise ValueError("Prefix %s is to be striped before saving, "
+                                 "but Parameter %s does not start with %s"
+                                 % (strip_prefix, param.name, strip_prefix))
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        if restore_prefix:
+            for name in self.keys():
+                assert name.startswith(restore_prefix), \
+                    "restore_prefix is %s but Parameter name %s does not start " \
+                    "with it" % (restore_prefix, name)
+        lprefix = len(restore_prefix)
+        arg_dict = {restore_prefix + k: v for k, v in nd.load(filename).items()}
+        if not allow_missing:
+            for name in self.keys():
+                assert name in arg_dict, \
+                    "Parameter %s is missing in file %s" % (name[lprefix:],
+                                                            filename)
+        for name in arg_dict:
+            if name not in self._params:
+                assert ignore_extra, \
+                    "Parameter %s loaded from file %s is not present in " \
+                    "ParameterDict" % (name[lprefix:], filename)
+                continue
+            self[name]._load_init(arg_dict[name], ctx)

@@ -1,0 +1,124 @@
+"""Gluon Trainer (parity: python/mxnet/gluon/trainer.py:26 — kvstore init :101,
+step :147 = rescale + allreduce(kvstore push/pull) or local update)."""
+from __future__ import annotations
+
+from .. import optimizer as opt
+from ..base import MXNetError
+from ..model import _create_kvstore
+from .parameter import Parameter, ParameterDict
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device"):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                "got %s." % (type(params)))
+        self._params = []
+        for param in params:
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    "got list of %s." % (type(param)))
+            if param.grad_req != "null":
+                self._params.append(param)
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0)) \
+            if optimizer_params else 1.0
+        optimizer_params = optimizer_params or {}
+        self._contexts = self._check_contexts()
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kv_initialized = False
+        self._kvstore = kvstore
+
+    def _check_contexts(self):
+        contexts = None
+        for param in self._params:
+            ctx = param.list_ctx()
+            assert contexts is None or contexts == ctx, \
+                "All Parameters must be initialized on the same set of contexts"
+            contexts = ctx
+        return contexts or []
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an Optimizer " \
+                "instance"
+            self._optimizer = optimizer
+        else:
+            self._optimizer = opt.create(optimizer,
+                                         param_idx2name={
+                                             i: p.name for i, p in
+                                             param_dict.items()},
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)
+                          for _ in self._contexts]
+
+    def _init_kvstore(self):
+        arg_arrays = {param.name: param.data(self._contexts[0])
+                      for param in self._params}
+        kvstore, update_on_kvstore = _create_kvstore(
+            self._kvstore, len(self._contexts), arg_arrays)
+        self._kvstore_obj = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        if kvstore:
+            for i, param in enumerate(self._params):
+                param_arrays = param.list_data()
+                kvstore.init(i, param_arrays[0])
+                if update_on_kvstore:
+                    kvstore.pull(i, param_arrays, priority=-i)
+            if update_on_kvstore:
+                kvstore.set_optimizer(self._optimizer)
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.lr
+
+    def set_learning_rate(self, lr):
+        self._optimizer.lr = lr
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Apply one optimization step using recorded gradients."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if self._kvstore_obj:
+                self._kvstore_obj.push(i, param.list_grad(), priority=-i)
+                if self._update_on_kvstore:
+                    self._kvstore_obj.pull(i, param.list_data(), priority=-i)
+                    continue
+                self._kvstore_obj.pull(i, param.list_grad(), priority=-i)
+            for upd, arr, grad in zip(self._updaters, param.list_data(),
+                                      param.list_grad()):
+                upd(i, grad, arr)
+
+    def save_states(self, fname):
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore_obj.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updaters[0].get_states())
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore_obj.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                states = f.read()
+            for updater in self._updaters:
+                updater.set_states(states)
+                updater.optimizer = self._optimizer

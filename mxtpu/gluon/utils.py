@@ -1,0 +1,56 @@
+"""Gluon utilities (parity: python/mxnet/gluon/utils.py — split_data,
+split_and_load, clip_global_norm)."""
+from __future__ import annotations
+
+import math
+
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    size = data.shape[batch_axis]
+    if size < num_slice:
+        raise ValueError(
+            "Too many slices for data with shape %s. Arguments are "
+            "num_slice=%d and batch_axis=%d." % (str(data.shape), num_slice,
+                                                 batch_axis))
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices along "
+            "axis %d. Use a batch size that's multiple of %d or set "
+            "even_split=False to allow uneven partitioning of data." % (
+                str(data.shape), num_slice, batch_axis, num_slice))
+    step = size // num_slice
+    if batch_axis == 0:
+        slices = [data[i * step:(i + 1) * step] if i < num_slice - 1
+                  else data[i * step:size] for i in range(num_slice)]
+    else:
+        slices = [nd.slice_axis(data, axis=batch_axis, begin=i * step,
+                                end=(i + 1) * step if i < num_slice - 1
+                                else size)
+                  for i in range(num_slice)]
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    if not isinstance(data, NDArray):
+        data = nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm):
+    """Rescale so the sum of their 2-norms is at most max_norm."""
+    assert len(arrays) > 0
+    total_norm = 0.0
+    for arr in arrays:
+        total_norm += float((arr * arr).sum().asscalar())
+    total_norm = math.sqrt(total_norm)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr *= scale
+    return total_norm

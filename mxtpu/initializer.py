@@ -1,0 +1,289 @@
+"""Weight initializers (parity: python/mxnet/initializer.py — Uniform/Normal/
+Xavier/MSRAPrelu/Bilinear/One/Zero/Constant/Orthogonal/LSTMBias/Mixed + the
+name-pattern dispatch by suffix _weight/_bias/_gamma/_beta/...)."""
+from __future__ import annotations
+
+import json
+import re
+
+import numpy as _np
+
+from .base import MXNetError, Registry
+from . import ndarray as nd
+
+_REG = Registry("initializer")
+
+
+class InitDesc(str):
+    """Name + attrs descriptor handed to an initializer."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Initializer:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, str):
+            raise TypeError("desc must be str/InitDesc")
+        name = str(desc)
+        init_attr = getattr(desc, "attrs", {}).get("__init__", "")
+        if init_attr:
+            klass, kwargs = json.loads(init_attr)
+            _REG.get(klass)(**kwargs)._init_weight(name, arr)
+            return
+        if name.endswith("upsampling"):
+            self._init_bilinear(name, arr)
+        elif name.endswith("bias"):
+            self._init_bias(name, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(name, arr)
+        elif name.endswith("beta"):
+            self._init_beta(name, arr)
+        elif name.endswith("weight"):
+            self._init_weight(name, arr)
+        elif name.endswith("moving_mean") or name.endswith("running_mean"):
+            self._init_zero(name, arr)
+        elif name.endswith("moving_var") or name.endswith("running_var"):
+            self._init_one(name, arr)
+        elif name.endswith("moving_inv_var"):
+            self._init_zero(name, arr)
+        elif name.endswith("moving_avg"):
+            self._init_zero(name, arr)
+        else:
+            self._init_default(name, arr)
+
+    def _init_bilinear(self, name, arr):
+        shape = arr.shape
+        weight = _np.zeros(_np.prod(shape), dtype="float32")
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(_np.prod(shape)):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = nd.array(weight.reshape(shape))
+
+    def _init_zero(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, name, arr):
+        arr[:] = 1.0
+
+    def _init_bias(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_gamma(self, name, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def _init_default(self, name, arr):
+        arr[:] = 0.0
+
+
+def register(klass):
+    _REG.register(klass)
+    return klass
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        arr[:] = 0.0
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        arr[:] = 1.0
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        arr[:] = self.value
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        arr[:] = nd.uniform(low=-self.scale, high=self.scale, shape=arr.shape)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        arr[:] = nd.normal(loc=0, scale=self.sigma, shape=arr.shape)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(_np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = _np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = _np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = _np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[:] = nd.array(self.scale * q.reshape(arr.shape))
+
+
+@register
+class Xavier(Initializer):
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise MXNetError("Xavier requires >=2d weight %s" % name)
+        if len(shape) > 2:
+            hw_scale = _np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in,
+                  "out": fan_out}[self.factor_type]
+        scale = _np.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr[:] = nd.uniform(low=-scale, high=scale, shape=shape)
+        else:
+            arr[:] = nd.normal(loc=0, scale=scale, shape=shape)
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        Initializer._init_bilinear(self, name, arr)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias init (parity initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        a = _np.zeros(arr.shape, dtype="float32")
+        num_hidden = arr.shape[0] // 4
+        a[num_hidden:2 * num_hidden] = self.forget_bias
+        arr[:] = nd.array(a)
+
+    _init_bias = _init_weight
+
+
+@register
+class FusedRNN(Initializer):
+    def __init__(self, init=None, num_hidden=0, num_layers=0, mode="lstm",
+                 bidirectional=False, forget_bias=1.0):
+        super().__init__()
+        if isinstance(init, str):
+            klass, kwargs = json.loads(init)
+            init = _REG.get(klass)(**kwargs)
+        self._init = init or Uniform(0.07)
+
+    def _init_weight(self, name, arr):
+        self._init._init_weight(name, arr)
+
+
+class Mixed:
+    """Pattern -> initializer dispatch (parity initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for pat, init in self.map:
+            if pat.match(str(name)):
+                init(name, arr)
+                return
+        raise MXNetError("Parameter %s did not match any pattern" % name)
+
+
+class Load:
+    """Init from saved dict, fall back to default_init (parity initializer.py)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = dict(param)
+        self.default_init = default_init
+
+    def __call__(self, name, arr):
+        key = str(name)
+        for cand in (key, "arg:" + key, "aux:" + key):
+            if cand in self.param:
+                arr[:] = self.param[cand]
+                return
+        if self.default_init is None:
+            raise MXNetError("no init for %s" % name)
+        self.default_init(name, arr)
+
+
+def create(name, **kwargs):
+    return _REG.create(name, **kwargs)
+
+
+class _InitNS:
+    """mx.init namespace alias."""
+    Initializer = Initializer
+    Zero = Zero
+    One = One
+    Constant = Constant
+    Uniform = Uniform
+    Normal = Normal
+    Orthogonal = Orthogonal
+    Xavier = Xavier
+    MSRAPrelu = MSRAPrelu
+    Bilinear = Bilinear
+    LSTMBias = LSTMBias
+    FusedRNN = FusedRNN
+    Mixed = Mixed
+    Load = Load
+    InitDesc = InitDesc
+
+
+init = _InitNS()

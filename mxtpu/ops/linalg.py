@@ -1,0 +1,68 @@
+"""Linear-algebra ops (parity: src/operator/tensor/la_op.cc _linalg_* family,
+backed by LAPACK via c_lapack_api.h in the reference; here by jnp.linalg/lax
+which XLA lowers to MXU-friendly kernels)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _t(x, flag):
+    return jnp.swapaxes(x, -1, -2) if flag else x
+
+
+register("_linalg_gemm",
+         lambda a, A, B, C: a.alpha * jnp.matmul(_t(A, a.transpose_a), _t(B, a.transpose_b)) + a.beta * C,
+         arg_names=["A", "B", "C"],
+         attrs={"transpose_a": False, "transpose_b": False, "alpha": 1.0, "beta": 1.0},
+         aliases=("linalg_gemm",))
+register("_linalg_gemm2",
+         lambda a, A, B: a.alpha * jnp.matmul(_t(A, a.transpose_a), _t(B, a.transpose_b)),
+         arg_names=["A", "B"],
+         attrs={"transpose_a": False, "transpose_b": False, "alpha": 1.0},
+         aliases=("linalg_gemm2",))
+register("_linalg_potrf", lambda a, A: jnp.linalg.cholesky(A),
+         arg_names=["A"], attrs={}, aliases=("linalg_potrf",))
+
+
+def _potri(a, A):
+    L = A
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    Linv = lax.linalg.triangular_solve(L, eye, left_side=True, lower=True)
+    return jnp.matmul(jnp.swapaxes(Linv, -1, -2), Linv)
+
+
+register("_linalg_potri", _potri, arg_names=["A"], attrs={},
+         aliases=("linalg_potri",))
+register("_linalg_trmm",
+         lambda a, A, B: a.alpha * (jnp.matmul(_t(A, a.transpose), B) if not a.rightside
+                                    else jnp.matmul(B, _t(A, a.transpose))),
+         arg_names=["A", "B"],
+         attrs={"transpose": False, "rightside": False, "alpha": 1.0},
+         aliases=("linalg_trmm",))
+register("_linalg_trsm",
+         lambda a, A, B: a.alpha * lax.linalg.triangular_solve(
+             A, B, left_side=not a.rightside, lower=True,
+             transpose_a=bool(a.transpose)),
+         arg_names=["A", "B"],
+         attrs={"transpose": False, "rightside": False, "alpha": 1.0},
+         aliases=("linalg_trsm",))
+register("_linalg_sumlogdiag",
+         lambda a, A: jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1),
+         arg_names=["A"], attrs={}, aliases=("linalg_sumlogdiag",))
+register("_linalg_syrk",
+         lambda a, A: a.alpha * (jnp.matmul(A, jnp.swapaxes(A, -1, -2)) if not a.transpose
+                                 else jnp.matmul(jnp.swapaxes(A, -1, -2), A)),
+         arg_names=["A"], attrs={"transpose": False, "alpha": 1.0},
+         aliases=("linalg_syrk",))
+
+
+def _gelqf(a, A):
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    return jnp.swapaxes(q, -1, -2), jnp.swapaxes(r, -1, -2)
+
+
+register("_linalg_gelqf", _gelqf, arg_names=["A"], attrs={}, num_outputs=2,
+         aliases=("linalg_gelqf",))

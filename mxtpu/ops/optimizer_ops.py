@@ -1,0 +1,129 @@
+"""Fused optimizer update ops.
+
+Parity: src/operator/optimizer_op.cc:37-278 (sgd_update, sgd_mom_update,
+mp_sgd_update, mp_sgd_mom_update, adam_update, rmsprop_update, rmspropalex_update,
+ftrl_update). Each is a single fused XLA computation; the Python Optimizer
+dispatches here exactly like the reference's python/mxnet/optimizer.py does to its
+fused kernels. Called with out= aliasing the weight so the wrapper mutates in
+place (kWriteInplace semantics via functional update)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import Required, register
+
+_COMMON = {"lr": Required(float), "wd": 0.0, "rescale_grad": 1.0,
+           "clip_gradient": -1.0}
+
+
+def _prep(a, grad, weight):
+    g = grad * a.rescale_grad
+    if a.clip_gradient and a.clip_gradient > 0:
+        g = jnp.clip(g, -a.clip_gradient, a.clip_gradient)
+    return g + a.wd * weight
+
+
+def _sgd_update(a, weight, grad):
+    return weight - a.lr * _prep(a, grad, weight)
+
+
+register("sgd_update", _sgd_update, arg_names=["weight", "grad"],
+         attrs=dict(_COMMON))
+
+
+def _sgd_mom_update(a, weight, grad, mom):
+    g = _prep(a, grad, weight)
+    new_mom = a.momentum * mom - a.lr * g
+    return weight + new_mom, new_mom
+
+
+register("sgd_mom_update", _sgd_mom_update, arg_names=["weight", "grad", "mom"],
+         attrs=dict(_COMMON, momentum=0.0), num_outputs=2)
+
+
+def _mp_sgd_update(a, weight, grad, weight32):
+    g32 = _prep(a, grad.astype(jnp.float32), weight32)
+    new_w32 = weight32 - a.lr * g32
+    return new_w32.astype(weight.dtype), new_w32
+
+
+register("mp_sgd_update", _mp_sgd_update, arg_names=["weight", "grad", "weight32"],
+         attrs=dict(_COMMON), num_outputs=2)
+
+
+def _mp_sgd_mom_update(a, weight, grad, mom, weight32):
+    g32 = _prep(a, grad.astype(jnp.float32), weight32)
+    new_mom = a.momentum * mom - a.lr * g32
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+register("mp_sgd_mom_update", _mp_sgd_mom_update,
+         arg_names=["weight", "grad", "mom", "weight32"],
+         attrs=dict(_COMMON, momentum=0.0), num_outputs=3)
+
+
+def _adam_update(a, weight, grad, mean, var):
+    g = grad * a.rescale_grad
+    if a.clip_gradient and a.clip_gradient > 0:
+        g = jnp.clip(g, -a.clip_gradient, a.clip_gradient)
+    g = g + a.wd * weight
+    new_mean = a.beta1 * mean + (1 - a.beta1) * g
+    new_var = a.beta2 * var + (1 - a.beta2) * jnp.square(g)
+    new_w = weight - a.lr * new_mean / (jnp.sqrt(new_var) + a.epsilon)
+    return new_w, new_mean, new_var
+
+
+register("adam_update", _adam_update, arg_names=["weight", "grad", "mean", "var"],
+         attrs=dict(_COMMON, beta1=0.9, beta2=0.999, epsilon=1e-8), num_outputs=3)
+
+
+def _rmsprop_update(a, weight, grad, n):
+    g = _prep(a, grad, weight)
+    new_n = (1 - a.gamma1) * jnp.square(g) + a.gamma1 * n
+    new_w = weight - a.lr * g / jnp.sqrt(new_n + a.epsilon)
+    if a.clip_weights and a.clip_weights > 0:
+        new_w = jnp.clip(new_w, -a.clip_weights, a.clip_weights)
+    return new_w, new_n
+
+
+register("rmsprop_update", _rmsprop_update, arg_names=["weight", "grad", "n"],
+         attrs=dict(_COMMON, gamma1=0.95, epsilon=1e-8, clip_weights=-1.0),
+         num_outputs=2)
+
+
+def _rmspropalex_update(a, weight, grad, n, g_avg, delta):
+    g = _prep(a, grad, weight)
+    new_n = (1 - a.gamma1) * jnp.square(g) + a.gamma1 * n
+    new_g = (1 - a.gamma1) * g + a.gamma1 * g_avg
+    new_delta = a.gamma2 * delta - a.lr * g / jnp.sqrt(new_n - jnp.square(new_g) + a.epsilon)
+    new_w = weight + new_delta
+    if a.clip_weights and a.clip_weights > 0:
+        new_w = jnp.clip(new_w, -a.clip_weights, a.clip_weights)
+    return new_w, new_n, new_g, new_delta
+
+
+register("rmspropalex_update", _rmspropalex_update,
+         arg_names=["weight", "grad", "n", "g", "delta"],
+         attrs=dict(_COMMON, gamma1=0.95, gamma2=0.9, epsilon=1e-8,
+                    clip_weights=-1.0),
+         num_outputs=4)
+
+
+def _ftrl_update(a, weight, grad, z, n):
+    g = grad * a.rescale_grad
+    if a.clip_gradient and a.clip_gradient > 0:
+        g = jnp.clip(g, -a.clip_gradient, a.clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / a.lr
+    new_z = z + g - sigma * weight
+    new_w = jnp.where(
+        jnp.abs(new_z) <= a.lamda1,
+        jnp.zeros_like(weight),
+        -(new_z - jnp.sign(new_z) * a.lamda1)
+        / ((a.beta + jnp.sqrt(new_n)) / a.lr + a.wd))
+    return new_w, new_z, new_n
+
+
+register("ftrl_update", _ftrl_update, arg_names=["weight", "grad", "z", "n"],
+         attrs=dict(_COMMON, lamda1=0.01, beta=1.0), num_outputs=3)

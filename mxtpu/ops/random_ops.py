@@ -1,0 +1,124 @@
+"""Random sampling ops on the TPU-native threefry PRNG.
+
+Parity: src/operator/random/{sample_op.cc:48-147, multisample_op.cc:380-389,
+sample_multinomial_op.cc}. The reference uses per-device PRNG resources
+(ResourceManager kRandom); here every sampler is a pure function of an explicit
+threefry key (SURVEY.md §2.3 'needs TPU PRNG design (threefry)'), threaded by the
+imperative invoker from mxtpu.random global state or by the executor per step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .registry import Required, register
+
+
+def _shape_dtype(a):
+    shape = tuple(a.shape) if a.shape else ()
+    dtype = _np.dtype(a.dtype if a.dtype and a.dtype != "None" else "float32")
+    return shape, dtype
+
+
+def _sampler(name, draw):
+    def impl(a, rng):
+        shape, dtype = _shape_dtype(a)
+        return draw(a, rng, shape, dtype)
+
+    register(name, impl, arg_names=[], needs_rng=True,
+             attrs={"shape": (), "dtype": "float32", "ctx": "",
+                    "low": 0.0, "high": 1.0, "loc": 0.0, "scale": 1.0,
+                    "lam": 1.0, "alpha": 1.0, "beta": 1.0, "k": 1, "p": 1.0,
+                    "mu": 1.0, "sigma": 1.0})
+
+
+_sampler("_random_uniform",
+         lambda a, r, s, d: jax.random.uniform(r, s, d, a.low, a.high))
+_sampler("_random_normal",
+         lambda a, r, s, d: a.loc + a.scale * jax.random.normal(r, s, d))
+_sampler("_random_gamma",
+         lambda a, r, s, d: (a.beta * jax.random.gamma(r, a.alpha, s)).astype(d))
+_sampler("_random_exponential",
+         lambda a, r, s, d: (jax.random.exponential(r, s) / a.lam).astype(d))
+_sampler("_random_poisson",
+         lambda a, r, s, d: jax.random.poisson(r, a.lam, s).astype(d))
+_sampler("_random_negative_binomial",
+         lambda a, r, s, d: _neg_binomial(r, float(a.k), float(a.p), s).astype(d))
+_sampler("_random_generalized_negative_binomial",
+         lambda a, r, s, d: _gen_neg_binomial(r, float(a.mu), float(a.alpha), s).astype(d))
+
+
+def _neg_binomial(rng, k, p, shape):
+    k1, k2 = jax.random.split(rng)
+    lam = jax.random.gamma(k1, k, shape) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, shape)
+
+
+def _gen_neg_binomial(rng, mu, alpha, shape):
+    if alpha == 0:
+        return jax.random.poisson(rng, mu, shape)
+    k1, k2 = jax.random.split(rng)
+    r = 1.0 / alpha
+    lam = jax.random.gamma(k1, r, shape) * (mu * alpha)
+    return jax.random.poisson(k2, lam, shape)
+
+
+# ---- per-row multisample ops: distribution params come from input tensors ----
+
+
+def _multisampler(name, draw, two_param=True):
+    def impl(a, rng, *params):
+        shape = tuple(a.shape) if a.shape else ()
+        out_shape = params[0].shape + shape
+        return draw(rng, params, out_shape).astype(
+            _np.dtype(a.dtype) if a.dtype and a.dtype != "None" else params[0].dtype)
+
+    register(name, impl,
+             arg_names=["lhs", "rhs"] if two_param else ["data"],
+             needs_rng=True, attrs={"shape": (), "dtype": "None"})
+
+
+def _rs(p, out_shape):
+    """Broadcast a per-row param tensor against trailing sample dims."""
+    return p.reshape(p.shape + (1,) * (len(out_shape) - p.ndim))
+
+
+_multisampler("sample_uniform",
+              lambda r, ps, s: jax.random.uniform(r, s) * (_rs(ps[1], s) - _rs(ps[0], s)) + _rs(ps[0], s))
+_multisampler("sample_normal",
+              lambda r, ps, s: _rs(ps[0], s) + _rs(ps[1], s) * jax.random.normal(r, s))
+_multisampler("sample_gamma",
+              lambda r, ps, s: jax.random.gamma(r, jnp.broadcast_to(_rs(ps[0], s), s)) * _rs(ps[1], s))
+_multisampler("sample_exponential",
+              lambda r, ps, s: jax.random.exponential(r, s) / _rs(ps[0], s), two_param=False)
+_multisampler("sample_poisson",
+              lambda r, ps, s: jax.random.poisson(r, jnp.broadcast_to(_rs(ps[0], s), s)).astype(jnp.float32),
+              two_param=False)
+
+
+def _sample_multinomial(a, rng, data):
+    n = int(a.shape[0]) if a.shape else 1
+    logits = jnp.log(jnp.clip(data, 1e-30, None))
+    if data.ndim == 1:
+        out = jax.random.categorical(rng, logits, shape=(n,))
+    else:
+        out = jax.random.categorical(rng, logits[:, None, :], axis=-1,
+                                     shape=(data.shape[0], n))
+    if not a.shape:
+        out = out.reshape(out.shape[:-1] + ()) if False else jnp.squeeze(out, -1)
+    out = out.astype(_np.dtype(a.dtype))
+    if a.get_prob:
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1) if data.ndim > 1 else
+            jax.nn.log_softmax(logits)[None],
+            out.reshape(data.shape[0] if data.ndim > 1 else 1, -1).astype(jnp.int32),
+            axis=-1)
+        return out, logp.reshape(out.shape).astype(jnp.float32)
+    return out
+
+
+register("sample_multinomial", _sample_multinomial, arg_names=["data"],
+         needs_rng=True,
+         attrs={"shape": (), "get_prob": False, "dtype": "int32"},
+         num_outputs=lambda a: 2 if a.get_prob else 1)

@@ -1,0 +1,516 @@
+"""Tensor op families: elemwise, broadcast, reduce, matrix/shape, indexing, init,
+ordering, control flow, dot.
+
+Parity with reference src/operator/tensor/* (SURVEY.md Appendix A census):
+elemwise_unary_op.cc:32-901, elemwise_binary_op_*.cc, elemwise_binary_scalar_op_*.cc,
+elemwise_binary_broadcast_op_*.cc, broadcast_reduce_op_{value,index}.cc, matrix_op.cc,
+indexing_op.cc, init_op.cc, ordering_op.cc, control_flow_op.cc, dot.cc.
+Each maps ~1:1 onto jnp/lax; gradients come from JAX autodiff instead of the
+reference's hand-registered _backward_* ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+from jax import lax
+
+from .registry import Required, register
+
+# ---------------------------------------------------------------- helpers
+
+
+def _axis_tuple(axis, ndim, exclude=False):
+    if axis is None or axis == () or axis == []:
+        ax = tuple(range(ndim))
+    elif isinstance(axis, int):
+        ax = (axis % ndim,)
+    else:
+        ax = tuple(a % ndim for a in axis)
+    if exclude:
+        ax = tuple(i for i in range(ndim) if i not in ax)
+    return ax
+
+
+def unary(name, f, **kw):
+    register(name, lambda a, x: f(x), arg_names=["data"], attrs={}, **kw)
+
+
+def binary(name, f, **kw):
+    register(name, lambda a, l, r: f(l, r), arg_names=["lhs", "rhs"], attrs={}, **kw)
+
+
+def binary_scalar(name, f, **kw):
+    register(name, lambda a, x: f(x, jnp.asarray(a.scalar, x.dtype)),
+             arg_names=["data"], attrs={"scalar": Required(float)}, **kw)
+
+
+def _logic(f):
+    return lambda l, r: f(l, r).astype(l.dtype if hasattr(l, "dtype") else jnp.float32)
+
+
+# ---------------------------------------------------------------- unary math
+unary("relu", lambda x: jnp.maximum(x, 0))
+unary("sigmoid", jax.nn.sigmoid)
+unary("softsign", lambda x: x / (1 + jnp.abs(x)))
+unary("_copy", lambda x: x)
+unary("identity", lambda x: x)
+unary("BlockGrad", lax.stop_gradient, aliases=("stop_gradient",))
+unary("make_loss", lambda x: x)
+unary("negative", lambda x: -x)
+unary("reciprocal", lambda x: 1 / x)
+unary("abs", jnp.abs)
+unary("sign", jnp.sign)
+unary("round", jnp.round)
+unary("rint", jnp.rint)
+unary("ceil", jnp.ceil)
+unary("floor", jnp.floor)
+unary("trunc", jnp.trunc)
+unary("fix", jnp.trunc)
+unary("square", jnp.square)
+unary("sqrt", jnp.sqrt)
+unary("rsqrt", lambda x: 1 / jnp.sqrt(x))
+unary("cbrt", jnp.cbrt)
+unary("rcbrt", lambda x: 1 / jnp.cbrt(x))
+unary("exp", jnp.exp)
+unary("log", jnp.log)
+unary("log10", jnp.log10)
+unary("log2", jnp.log2)
+unary("log1p", jnp.log1p)
+unary("expm1", jnp.expm1)
+unary("sin", jnp.sin)
+unary("cos", jnp.cos)
+unary("tan", jnp.tan)
+unary("arcsin", jnp.arcsin)
+unary("arccos", jnp.arccos)
+unary("arctan", jnp.arctan)
+unary("degrees", jnp.degrees)
+unary("radians", jnp.radians)
+unary("sinh", jnp.sinh)
+unary("cosh", jnp.cosh)
+unary("tanh", jnp.tanh)
+unary("arcsinh", jnp.arcsinh)
+unary("arccosh", jnp.arccosh)
+unary("arctanh", jnp.arctanh)
+unary("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)))
+unary("gammaln", jax.scipy.special.gammaln)
+unary("erf", jax.scipy.special.erf)
+unary("zeros_like", jnp.zeros_like)
+unary("ones_like", jnp.ones_like)
+
+register("Cast", lambda a, x: x.astype(_np.dtype(a.dtype)),
+         attrs={"dtype": Required(str)}, aliases=("cast",))
+register("_identity_with_attr_like_rhs", lambda a, l, r: l, arg_names=["lhs", "rhs"], attrs={})
+
+# ---------------------------------------------------------------- binary elemwise
+binary("elemwise_add", jnp.add, aliases=("_plus", "_add"))
+binary("_grad_add", jnp.add)
+binary("elemwise_sub", jnp.subtract, aliases=("_minus", "_sub"))
+binary("elemwise_mul", jnp.multiply, aliases=("_mul",))
+binary("elemwise_div", jnp.divide, aliases=("_div",))
+binary("_mod", jnp.mod)
+binary("_hypot", jnp.hypot)
+binary("_maximum", jnp.maximum)
+binary("_minimum", jnp.minimum)
+binary("_power", jnp.power)
+binary("_equal", _logic(jnp.equal))
+binary("_not_equal", _logic(jnp.not_equal))
+binary("_greater", _logic(jnp.greater))
+binary("_greater_equal", _logic(jnp.greater_equal))
+binary("_lesser", _logic(jnp.less))
+binary("_lesser_equal", _logic(jnp.less_equal))
+
+register("add_n", lambda a, *xs: sum(xs[1:], xs[0]), variadic="num_args",
+         attrs={"num_args": Required(int)}, aliases=("ElementWiseSum", "_sum"))
+
+# ---------------------------------------------------------------- scalar elemwise
+binary_scalar("_plus_scalar", jnp.add)
+binary_scalar("_minus_scalar", jnp.subtract)
+binary_scalar("_rminus_scalar", lambda x, s: s - x)
+binary_scalar("_mul_scalar", jnp.multiply)
+binary_scalar("_div_scalar", jnp.divide)
+binary_scalar("_rdiv_scalar", lambda x, s: s / x)
+binary_scalar("_mod_scalar", jnp.mod)
+binary_scalar("_rmod_scalar", lambda x, s: jnp.mod(s, x))
+binary_scalar("_maximum_scalar", jnp.maximum)
+binary_scalar("_minimum_scalar", jnp.minimum)
+binary_scalar("_power_scalar", jnp.power)
+binary_scalar("_rpower_scalar", lambda x, s: jnp.power(s, x))
+binary_scalar("_hypot_scalar", jnp.hypot)
+binary_scalar("_equal_scalar", _logic(jnp.equal))
+binary_scalar("_not_equal_scalar", _logic(jnp.not_equal))
+binary_scalar("_greater_scalar", _logic(jnp.greater))
+binary_scalar("_greater_equal_scalar", _logic(jnp.greater_equal))
+binary_scalar("_lesser_scalar", _logic(jnp.less))
+binary_scalar("_lesser_equal_scalar", _logic(jnp.less_equal))
+
+register("smooth_l1",
+         lambda a, x: jnp.where(jnp.abs(x) < 1.0 / (a.scalar ** 2),
+                                0.5 * (x * a.scalar) ** 2,
+                                jnp.abs(x) - 0.5 / (a.scalar ** 2)),
+         attrs={"scalar": 1.0})
+
+# ---------------------------------------------------------------- broadcast binary
+for _n, _f in [("add", jnp.add), ("plus", jnp.add), ("sub", jnp.subtract),
+               ("minus", jnp.subtract), ("mul", jnp.multiply), ("div", jnp.divide),
+               ("mod", jnp.mod), ("power", jnp.power), ("maximum", jnp.maximum),
+               ("minimum", jnp.minimum), ("hypot", jnp.hypot),
+               ("equal", _logic(jnp.equal)), ("not_equal", _logic(jnp.not_equal)),
+               ("greater", _logic(jnp.greater)), ("greater_equal", _logic(jnp.greater_equal)),
+               ("lesser", _logic(jnp.less)), ("lesser_equal", _logic(jnp.less_equal))]:
+    binary("broadcast_" + _n, _f)
+
+register("broadcast_axis",
+         lambda a, x: jnp.broadcast_to(
+             x, tuple(a.size[list(_axis_tuple(a.axis, x.ndim)).index(i)]
+                      if i in _axis_tuple(a.axis, x.ndim) else x.shape[i]
+                      for i in range(x.ndim))),
+         attrs={"axis": (), "size": ()}, aliases=("broadcast_axes",))
+register("broadcast_to",
+         lambda a, x: jnp.broadcast_to(
+             x, tuple(s if s != 0 else x.shape[i] for i, s in enumerate(a.shape))),
+         attrs={"shape": Required(tuple)})
+
+# ---------------------------------------------------------------- reductions
+
+
+def _reduce(name, f, default_all=True):
+    def impl(a, x):
+        ax = _axis_tuple(a.axis, x.ndim, a.exclude)
+        return f(x, axis=ax, keepdims=bool(a.keepdims))
+
+    register(name, impl, attrs={"axis": None, "keepdims": False, "exclude": False})
+
+
+_reduce("sum", jnp.sum)
+_reduce("mean", jnp.mean)
+_reduce("prod", jnp.prod)
+_reduce("nansum", jnp.nansum)
+_reduce("nanprod", jnp.nanprod)
+_reduce("max", jnp.max)
+_reduce("min", jnp.min)
+register("sum_axis", lambda a, x: jnp.sum(x, axis=_axis_tuple(a.axis, x.ndim, a.exclude),
+                                          keepdims=bool(a.keepdims)),
+         attrs={"axis": None, "keepdims": False, "exclude": False})
+
+register("norm", lambda a, x: jnp.sqrt(jnp.sum(jnp.square(x))), attrs={})
+
+
+def _arg_reduce(name, f):
+    def impl(a, x):
+        if a.axis is None:
+            r = f(jnp.ravel(x), axis=0)
+            return r.astype(x.dtype) if not a.keepdims else jnp.reshape(
+                r, (1,) * x.ndim).astype(x.dtype)
+        r = f(x, axis=int(a.axis))
+        if a.keepdims:
+            r = jnp.expand_dims(r, int(a.axis))
+        return r.astype(x.dtype)
+
+    register(name, impl, attrs={"axis": None, "keepdims": False})
+
+
+_arg_reduce("argmax", jnp.argmax)
+_arg_reduce("argmin", jnp.argmin)
+register("argmax_channel", lambda a, x: jnp.argmax(x, axis=1).astype(x.dtype), attrs={})
+
+
+def _pick(a, x, index):
+    axis = int(a.axis) if a.axis is not None else -1
+    idx = index.astype(jnp.int32)
+    picked = jnp.take_along_axis(x, jnp.expand_dims(idx, axis % x.ndim), axis=axis)
+    if not a.keepdims:
+        picked = jnp.squeeze(picked, axis=axis % x.ndim)
+    return picked
+
+
+register("pick", _pick, arg_names=["data", "index"],
+         attrs={"axis": -1, "keepdims": False})
+
+# ---------------------------------------------------------------- matrix / shape
+
+
+def _infer_reshape(shape_spec, in_shape):
+    """MXNet reshape mini-language: 0 copy, -1 infer, -2 rest, -3 merge, -4 split."""
+    out = []
+    src = list(in_shape)
+    i = 0  # index into src
+    j = 0
+    spec = list(shape_spec)
+    while j < len(spec):
+        s = spec[j]
+        if s == 0:
+            out.append(src[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:
+            a1, a2 = spec[j + 1], spec[j + 2]
+            if a1 == -1:
+                a1 = src[i] // a2
+            if a2 == -1:
+                a2 = src[i] // a1
+            out.extend([a1, a2]); i += 1; j += 2
+        else:
+            out.append(int(s))
+            if i < len(src):
+                i += 1
+        j += 1
+    if -1 in out:
+        known = 1
+        for v in out:
+            if v != -1:
+                known *= v
+        total = 1
+        for v in in_shape:
+            total *= v
+        out[out.index(-1)] = total // max(known, 1)
+    return tuple(out)
+
+
+def _reshape(a, x):
+    if a.reverse:
+        rev = _infer_reshape(tuple(reversed(a.shape)), tuple(reversed(x.shape)))
+        return jnp.reshape(x, tuple(reversed(rev)))
+    return jnp.reshape(x, _infer_reshape(a.shape, x.shape))
+
+
+register("Reshape", _reshape, attrs={"shape": Required(tuple), "reverse": False},
+         aliases=("reshape",))
+register("Flatten", lambda a, x: jnp.reshape(x, (x.shape[0], -1)), attrs={},
+         aliases=("flatten",))
+register("reshape_like", lambda a, l, r: jnp.reshape(l, r.shape),
+         arg_names=["lhs", "rhs"], attrs={})
+register("transpose", lambda a, x: jnp.transpose(x, a.axes if a.axes else None),
+         attrs={"axes": ()})
+register("expand_dims", lambda a, x: jnp.expand_dims(x, int(a.axis)),
+         attrs={"axis": Required(int)})
+register("SwapAxis", lambda a, x: jnp.swapaxes(x, int(a.dim1), int(a.dim2)),
+         attrs={"dim1": 0, "dim2": 0}, aliases=("swapaxes",))
+
+
+def _slice(a, x):
+    begin = list(a.begin)
+    end = list(a.end)
+    idx = []
+    for d in range(x.ndim):
+        b = begin[d] if d < len(begin) and begin[d] is not None else 0
+        e = end[d] if d < len(end) and end[d] is not None else x.shape[d]
+        if b < 0:
+            b += x.shape[d]
+        if e < 0:
+            e += x.shape[d]
+        idx.append(slice(b, e))
+    return x[tuple(idx)]
+
+
+register("slice", _slice, attrs={"begin": Required(tuple), "end": Required(tuple)},
+         aliases=("crop",))
+
+
+def _slice_axis(a, x):
+    ax = int(a.axis) % x.ndim
+    b = a.begin or 0
+    e = a.end if a.end is not None else x.shape[ax]
+    if b < 0:
+        b += x.shape[ax]
+    if e < 0:
+        e += x.shape[ax]
+    idx = [slice(None)] * x.ndim
+    idx[ax] = slice(b, e)
+    return x[tuple(idx)]
+
+
+register("slice_axis", _slice_axis,
+         attrs={"axis": Required(int), "begin": 0, "end": None})
+
+register("clip", lambda a, x: jnp.clip(x, a.a_min, a.a_max),
+         attrs={"a_min": Required(float), "a_max": Required(float)})
+register("repeat",
+         lambda a, x: jnp.repeat(x, int(a.repeats),
+                                 axis=None if a.axis is None else int(a.axis)),
+         attrs={"repeats": Required(int), "axis": None})
+register("tile", lambda a, x: jnp.tile(x, a.reps), attrs={"reps": Required(tuple)})
+register("reverse", lambda a, x: jnp.flip(x, axis=tuple(int(i) for i in a.axis)),
+         attrs={"axis": Required(tuple)}, aliases=("flip",))
+register("stack", lambda a, *xs: jnp.stack(xs, axis=int(a.axis)),
+         variadic="num_args", attrs={"num_args": Required(int), "axis": 0})
+register("space_to_depth", lambda a, x: lax.reshape(
+    jnp.transpose(jnp.reshape(x, (x.shape[0], x.shape[1], x.shape[2] // a.block_size,
+                                  a.block_size, x.shape[3] // a.block_size, a.block_size)),
+                  (0, 3, 5, 1, 2, 4)),
+    (x.shape[0], x.shape[1] * a.block_size ** 2,
+     x.shape[2] // a.block_size, x.shape[3] // a.block_size)),
+    attrs={"block_size": Required(int)})
+
+# ---------------------------------------------------------------- dot
+
+
+def _dot(a, lhs, rhs):
+    l = jnp.swapaxes(lhs, 0, 1) if a.transpose_a and lhs.ndim == 2 else lhs
+    r = jnp.swapaxes(rhs, 0, 1) if a.transpose_b and rhs.ndim == 2 else rhs
+    if a.transpose_a and lhs.ndim > 2:
+        l = jnp.transpose(lhs, tuple(range(1, lhs.ndim)) + (0,))
+    if a.transpose_b and rhs.ndim > 2:
+        r = jnp.transpose(rhs, (rhs.ndim - 1,) + tuple(range(rhs.ndim - 1)))
+    return jnp.dot(l, r)
+
+
+register("dot", _dot, arg_names=["lhs", "rhs"],
+         attrs={"transpose_a": False, "transpose_b": False})
+
+
+def _batch_dot(a, lhs, rhs):
+    l = jnp.swapaxes(lhs, -1, -2) if a.transpose_a else lhs
+    r = jnp.swapaxes(rhs, -1, -2) if a.transpose_b else rhs
+    return jnp.matmul(l, r)
+
+
+register("batch_dot", _batch_dot, arg_names=["lhs", "rhs"],
+         attrs={"transpose_a": False, "transpose_b": False})
+
+# ---------------------------------------------------------------- indexing
+
+
+def _embedding(a, data, weight):
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0)
+
+
+register("Embedding", _embedding, arg_names=["data", "weight"],
+         attrs={"input_dim": Required(int), "output_dim": Required(int),
+                "dtype": "float32"})
+
+
+def _take(a, data, indices):
+    mode = {"clip": "clip", "wrap": "wrap"}.get(a.mode, "clip")
+    return jnp.take(data, indices.astype(jnp.int32), axis=int(a.axis), mode=mode)
+
+
+register("take", _take, arg_names=["a", "indices"], attrs={"axis": 0, "mode": "clip"})
+
+register("batch_take",
+         lambda a, x, idx: jnp.take_along_axis(
+             x, idx.astype(jnp.int32)[:, None], axis=1)[:, 0],
+         arg_names=["a", "indices"], attrs={})
+
+
+def _one_hot(a, idx):
+    out = jax.nn.one_hot(idx.astype(jnp.int32), int(a.depth),
+                         dtype=_np.dtype(a.dtype))
+    return out * (a.on_value - a.off_value) + a.off_value
+
+
+register("one_hot", _one_hot,
+         attrs={"depth": Required(int), "on_value": 1.0, "off_value": 0.0,
+                "dtype": "float32"})
+
+
+def _gather_nd(a, data, indices):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+register("gather_nd", _gather_nd, arg_names=["data", "indices"], attrs={})
+
+
+def _scatter_nd(a, data, indices):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(tuple(a.shape), dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].add(data)
+
+
+register("scatter_nd", _scatter_nd, arg_names=["data", "indices"],
+         attrs={"shape": Required(tuple)})
+
+# ---------------------------------------------------------------- init ops
+
+
+def _full(a, value):
+    dtype = _np.dtype(a.dtype if a.dtype else "float32")
+    return jnp.full(tuple(a.shape), value, dtype=dtype)
+
+
+register("_zeros", lambda a: _full(a, 0), arg_names=[],
+         attrs={"shape": Required(tuple), "dtype": "float32", "ctx": ""})
+register("_ones", lambda a: _full(a, 1), arg_names=[],
+         attrs={"shape": Required(tuple), "dtype": "float32", "ctx": ""})
+register("_full", lambda a: _full(a, a.value), arg_names=[],
+         attrs={"shape": Required(tuple), "dtype": "float32", "ctx": "",
+                "value": Required(float)})
+def _arange(a):
+    start, stop = a.start, a.stop
+    if stop is None:
+        start, stop = 0.0, start
+    base = jnp.arange(start, stop, a.step, dtype=_np.dtype(a.dtype))
+    return jnp.repeat(base, int(a.repeat)) if int(a.repeat) > 1 else base
+
+
+register("_arange", _arange, arg_names=[],
+         attrs={"start": 0.0, "stop": None, "step": 1.0, "repeat": 1,
+                "dtype": "float32", "ctx": ""})
+
+# ---------------------------------------------------------------- ordering
+
+
+def _topk(a, x):
+    axis = x.ndim - 1 if a.axis is None else int(a.axis) % x.ndim
+    k = int(a.k) if int(a.k) > 0 else x.shape[axis]
+    xm = jnp.moveaxis(x, axis, -1)
+    vals = -jnp.sort(-xm, axis=-1) if not a.is_ascend else jnp.sort(xm, axis=-1)
+    idxs = jnp.argsort(-xm if not a.is_ascend else xm, axis=-1, stable=True)
+    vals, idxs = vals[..., :k], idxs[..., :k]
+    vals = jnp.moveaxis(vals, -1, axis)
+    idxs = jnp.moveaxis(idxs, -1, axis)
+    rt = a.ret_typ
+    if rt == "value":
+        return vals
+    if rt == "indices":
+        return idxs.astype(x.dtype)
+    if rt == "mask":
+        m = jnp.zeros(xm.shape, dtype=x.dtype)
+        m = m.at[..., :1].set(0)  # placeholder to keep shape
+        onehot = jax.nn.one_hot(idxs.reshape(idxs.shape), xm.shape[-1], dtype=x.dtype)
+        mask = jnp.moveaxis(jnp.sum(onehot, axis=-2), -1, axis)
+        return mask
+    return vals, idxs.astype(x.dtype)
+
+
+register("topk", _topk,
+         attrs={"axis": -1, "k": 1, "ret_typ": "indices", "is_ascend": False},
+         num_outputs=lambda a: 2 if a.ret_typ == "both" else 1)
+
+
+def _sort(a, x):
+    axis = x.ndim - 1 if a.axis is None else int(a.axis) % x.ndim
+    s = jnp.sort(x, axis=axis)
+    return s if a.is_ascend else jnp.flip(s, axis=axis)
+
+
+register("sort", _sort, attrs={"axis": -1, "is_ascend": True})
+
+
+def _argsort(a, x):
+    axis = x.ndim - 1 if a.axis is None else int(a.axis) % x.ndim
+    idx = jnp.argsort(x if a.is_ascend else -x, axis=axis, stable=True)
+    return idx.astype(x.dtype)
+
+
+register("argsort", _argsort, attrs={"axis": -1, "is_ascend": True})
+
+# ---------------------------------------------------------------- control flow
+register("where", lambda a, c, l, r: jnp.where(c.astype(bool), l, r),
+         arg_names=["condition", "x", "y"], attrs={})
+
+# ---------------------------------------------------------------- sparse-compat
+register("cast_storage", lambda a, x: x, attrs={"stype": Required(str)})
+register("_square_sum",
+         lambda a, x: jnp.sum(jnp.square(x),
+                              axis=_axis_tuple(a.axis, x.ndim),
+                              keepdims=bool(a.keepdims)),
+         attrs={"axis": None, "keepdims": False})

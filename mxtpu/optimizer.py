@@ -1,0 +1,464 @@
+"""Optimizers + Updater (parity: python/mxnet/optimizer.py:33-1085).
+
+Each optimizer dispatches to a fused XLA update op from ops/optimizer_ops.py
+(the reference's sgd_update/adam_update/... kernels) via out= in-place semantics.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+
+import numpy as _np
+
+from .base import MXNetError, Registry
+from . import ndarray as nd
+from .ndarray import NDArray, zeros
+
+_REG = Registry("optimizer")
+
+
+class Optimizer:
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, param_dict=None, **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        if param_idx2name is None:
+            param_idx2name = {}
+        self.idx2name = dict(param_idx2name)
+        self.sym = sym
+        if sym is not None:
+            attrs = sym.attr_dict()
+            for name in sym.list_arguments():
+                if name in attrs:
+                    if "__lr_mult__" in attrs[name]:
+                        self.lr_mult[name] = float(attrs[name]["__lr_mult__"])
+                    if "__wd_mult__" in attrs[name]:
+                        self.wd_mult[name] = float(attrs[name]["__wd_mult__"])
+
+    @staticmethod
+    def register(klass):
+        _REG.register(klass)
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        return _REG.create(name, **kwargs)
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+
+register = Optimizer.register
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and optional fp16 master weights
+    (parity optimizer.py:368; fused ops sgd_update/sgd_mom_update/mp_sgd_*)."""
+
+    def __init__(self, momentum=0.0, multi_precision=False, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.multi_precision = multi_precision
+
+    def create_state(self, index, weight):
+        momentum = None
+        weight_master = None
+        if self.multi_precision and weight.dtype == _np.float16:
+            weight_master = weight.astype("float32")
+            if self.momentum != 0.0:
+                momentum = zeros(weight.shape, ctx=weight.context, dtype="float32")
+            return (momentum, weight_master)
+        if self.momentum != 0.0:
+            momentum = zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return momentum
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kw = {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad}
+        if self.clip_gradient:
+            kw["clip_gradient"] = self.clip_gradient
+        if isinstance(state, tuple):
+            mom, w32 = state
+            if mom is not None:
+                nd.mp_sgd_mom_update(weight, grad, mom, w32, momentum=self.momentum,
+                                     out=[weight, mom, w32], **kw)
+            else:
+                nd.mp_sgd_update(weight, grad, w32, out=[weight, w32], **kw)
+        elif state is not None:
+            nd.sgd_mom_update(weight, grad, state, momentum=self.momentum,
+                              out=[weight, state], **kw)
+        else:
+            nd.sgd_update(weight, grad, out=weight, **kw)
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        if state is not None:
+            state._data = self.momentum * state._data + grad._data + wd * weight._data
+            weight._data = weight._data - lr * (grad._data + self.momentum * state._data)
+        else:
+            weight._data = weight._data - lr * (grad._data + wd * weight._data)
+
+
+@register
+class SGLD(Optimizer):
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        noise = nd.normal(loc=0, scale=math.sqrt(lr), shape=weight.shape)
+        weight._data = weight._data - (lr / 2) * (grad._data + wd * weight._data) \
+            + noise._data
+
+
+@register
+class DCASGD(Optimizer):
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, ctx=weight.context), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        mom, prev = state
+        comp = grad._data + wd * weight._data + self.lamda * grad._data * \
+            grad._data * (weight._data - prev._data)
+        if mom is not None:
+            mom._data = self.momentum * mom._data - lr * comp
+            weight._data = weight._data + mom._data
+        else:
+            weight._data = weight._data - lr * comp
+        prev._data = weight._data
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        kw = {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
+              "beta1": self.beta1, "beta2": self.beta2, "epsilon": self.epsilon}
+        if self.clip_gradient:
+            kw["clip_gradient"] = self.clip_gradient
+        nd.adam_update(weight, grad, mean, var, out=[weight, mean, var], **kw)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient:
+            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        state._data = state._data + grad._data * grad._data
+        import jax.numpy as jnp
+        weight._data = weight._data - lr * (
+            grad._data / jnp.sqrt(state._data + self.float_stable_eps)
+            + wd * weight._data)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, ctx=weight.context),
+                    zeros(weight.shape, ctx=weight.context),
+                    zeros(weight.shape, ctx=weight.context))
+        return (zeros(weight.shape, ctx=weight.context),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
+              "gamma1": self.gamma1, "epsilon": self.epsilon}
+        if self.clip_gradient:
+            kw["clip_gradient"] = self.clip_gradient
+        if self.clip_weights:
+            kw["clip_weights"] = self.clip_weights
+        if not self.centered:
+            (n,) = state
+            nd.rmsprop_update(weight, grad, n, out=[weight, n], **kw)
+        else:
+            n, g, delta = state
+            kw["gamma2"] = self.gamma2
+            nd.rmspropalex_update(weight, grad, n, g, delta,
+                                  out=[weight, n, g, delta], **kw)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context),
+                zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient:
+            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        import jax.numpy as jnp
+        acc_g, acc_delta = state
+        acc_g._data = self.rho * acc_g._data + (1 - self.rho) * grad._data ** 2
+        delta = jnp.sqrt(acc_delta._data + self.epsilon) / \
+            jnp.sqrt(acc_g._data + self.epsilon) * grad._data
+        acc_delta._data = self.rho * acc_delta._data + (1 - self.rho) * delta ** 2
+        weight._data = weight._data - delta - wd * weight._data
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context),
+                zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        z, n = state
+        kw = {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
+              "lamda1": self.lamda1, "beta": self.beta}
+        if self.clip_gradient:
+            kw["clip_gradient"] = self.clip_gradient
+        nd.ftrl_update(weight, grad, z, n, out=[weight, z, n], **kw)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context),
+                zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr /= (1.0 - self.beta1 ** t)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient:
+            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        import jax.numpy as jnp
+        g = grad._data + wd * weight._data
+        m_t, u_t = state
+        m_t._data = self.beta1 * m_t._data + (1.0 - self.beta1) * g
+        u_t._data = jnp.maximum(self.beta2 * u_t._data, jnp.abs(g))
+        weight._data = weight._data - lr * m_t._data / (u_t._data + 1e-8)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context),
+                zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        grad = grad * self.rescale_grad
+        if self.clip_gradient:
+            grad = nd.clip(grad, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        import jax.numpy as jnp
+        g = grad._data + wd * weight._data
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m_t, v_t = state
+        m_t._data = self.beta1 * m_t._data + (1.0 - self.beta1) * g
+        v_t._data = self.beta2 * v_t._data + (1.0 - self.beta2) * g * g
+        g_prime = g / (1.0 - self.m_schedule)
+        m_t_prime = m_t._data / (1.0 - m_schedule_next)
+        v_t_prime = v_t._data / (1.0 - self.beta2 ** t)
+        m_t_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_t_prime
+        weight._data = weight._data - lr * m_t_bar / (
+            jnp.sqrt(v_t_prime) + self.epsilon)
+
+
+@register
+class Test(Optimizer):
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight._data = weight._data + grad._data * self.rescale_grad
+        state._data = weight._data
+
+
+# ccSGD = deprecated alias of SGD in the reference
+_REG.register(SGD, name="ccsgd")
+create = Optimizer.create_optimizer
+
+
+class Updater:
+    """Applies an optimizer per key (parity optimizer.py:1019 get_updater)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def set_states(self, states):
+        raw = pickle.loads(states) if isinstance(states, bytes) else states
+
+        def conv(s):
+            if isinstance(s, _np.ndarray):
+                return nd.array(s)
+            if isinstance(s, tuple):
+                return tuple(conv(x) for x in s)
+            return s
+
+        self.states = {k: conv(v) for k, v in raw.items()}
+
+    def get_states(self):
+        def conv(s):
+            if isinstance(s, NDArray):
+                return s.asnumpy()
+            if isinstance(s, tuple):
+                return tuple(conv(x) for x in s)
+            return s
+        return pickle.dumps({k: conv(v) for k, v in self.states.items()})
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,14 @@
+"""Distributed execution over device meshes: the TPU-native replacement for the
+reference's two-tier comm stack (CommDevice intra-node + ps-lite inter-node,
+SURVEY.md §2.4/§5). All gradient sync is XLA collectives (psum / reduce-scatter
+/ all-gather) over ICI within a slice and DCN across slices; process identity
+comes from jax.distributed instead of DMLC_ROLE env plumbing.
+"""
+from .mesh import (current_mesh, host_barrier, make_mesh, process_count,
+                   process_index)
+from .dp import DataParallelTrainer, shard_params_spec
+from .ring_attention import ring_attention, blockwise_attention
+
+__all__ = ["make_mesh", "current_mesh", "host_barrier", "process_index",
+           "process_count", "DataParallelTrainer", "shard_params_spec",
+           "ring_attention", "blockwise_attention"]

@@ -1,0 +1,201 @@
+"""Fused data-parallel (optionally tensor-sharded) training over a Mesh.
+
+This is the TPU-native form of the reference's data-parallel path
+(DataParallelExecutorGroup + KVStore push/pull, SURVEY.md §3.1): ONE jit-compiled
+train step over the mesh — forward, backward, gradient all-reduce, and optimizer
+update fused into a single XLA program. Gradient synchronization is implicit:
+with params replicated and the batch sharded over the 'data' axis, GSPMD inserts
+the all-reduce over ICI (the KVStore Push+Pull ≡ allreduce equivalence of
+SURVEY.md §5). With shard_params=True, large weights are additionally sharded
+over the 'model' axis (tensor parallelism the reference never had)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import random as _rnd
+from ..base import MXNetError
+from ..executor import _trace_graph
+from .mesh import current_mesh
+
+
+def shard_params_spec(shapes, mesh, axis="model", min_size=2 ** 16):
+    """Partition specs for parameter dicts: shard dim0 over the model axis when
+    large and divisible; replicate otherwise."""
+    specs = {}
+    msize = mesh.shape.get(axis, 1) if hasattr(mesh.shape, "get") else \
+        dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
+    for name, shape in shapes.items():
+        size = int(_np.prod(shape))
+        if axis in mesh.axis_names and msize > 1 and size >= min_size and \
+                len(shape) >= 1 and shape[0] % msize == 0:
+            specs[name] = P(axis, *([None] * (len(shape) - 1)))
+        else:
+            specs[name] = P()
+    return specs
+
+
+def _sgd_mom(p, g, m, lr, momentum, wd, rescale):
+    g = g * rescale + wd * p
+    m_new = momentum * m - lr * g
+    return p + m_new, m_new
+
+
+def _adam(p, g, m, v, lr, b1, b2, eps, wd, rescale, t):
+    g = g * rescale + wd * p
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * jnp.square(g)
+    mhat = m_new / (1 - b1 ** t)
+    vhat = v_new / (1 - b2 ** t)
+    return p - lr * mhat / (jnp.sqrt(vhat) + eps), m_new, v_new
+
+
+class DataParallelTrainer:
+    """Whole-step-fused trainer for a Symbol over a device mesh."""
+
+    def __init__(self, symbol, mesh=None, optimizer="sgd", optimizer_params=None,
+                 data_names=("data",), label_names=("softmax_label",),
+                 shard_params=False, dtype="float32"):
+        self.symbol = symbol
+        self.mesh = mesh or current_mesh()
+        self.data_names = list(data_names)
+        self.label_names = list(label_names)
+        self.optimizer = optimizer
+        op = dict(optimizer_params or {})
+        self.lr = op.get("learning_rate", 0.01)
+        self.momentum = op.get("momentum", 0.0)
+        self.wd = op.get("wd", 0.0)
+        self.rescale = op.get("rescale_grad", 1.0)
+        self.shard_params = shard_params
+        self.dtype = dtype
+        arg_names = symbol.list_arguments()
+        inputs = set(self.data_names + self.label_names)
+        self.param_names = [n for n in arg_names if n not in inputs]
+        self.aux_names = symbol.list_auxiliary_states()
+        self._run = _trace_graph(symbol, is_train=True)
+        self._step_fn = None
+        self.step_count = 0
+
+    # ------------------------------------------------ init
+    def init(self, input_shapes, initializer=None):
+        """Infer shapes, initialize params/aux/opt state with shardings."""
+        from ..initializer import Xavier
+        from .. import ndarray as nd
+        initializer = initializer or Xavier(magnitude=2.0)
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**input_shapes)
+        arg_names = self.symbol.list_arguments()
+        shapes = dict(zip(arg_names, arg_shapes))
+        aux_shape = dict(zip(self.aux_names, aux_shapes))
+        params = {}
+        from ..initializer import InitDesc
+        for name in self.param_names:
+            arr = nd.zeros(shapes[name], dtype=self.dtype)
+            initializer(InitDesc(name), arr)
+            params[name] = arr._data
+        aux = {}
+        for name in self.aux_names:
+            arr = nd.zeros(aux_shape[name])
+            init_v = 1.0 if name.endswith("var") else 0.0
+            arr[:] = init_v
+            aux[name] = arr._data
+
+        pspecs = shard_params_spec({n: shapes[n] for n in self.param_names},
+                                   self.mesh) if self.shard_params else \
+            {n: P() for n in self.param_names}
+        self._pspecs = pspecs
+        self._params = {
+            n: jax.device_put(v, NamedSharding(self.mesh, pspecs[n]))
+            for n, v in params.items()}
+        self._aux = {n: jax.device_put(v, NamedSharding(self.mesh, P()))
+                     for n, v in aux.items()}
+        if self.optimizer in ("sgd", "nag") and self.momentum:
+            self._opt_state = {n: jnp.zeros_like(v)
+                               for n, v in self._params.items()}
+        elif self.optimizer == "adam":
+            self._opt_state = {n: (jnp.zeros_like(v), jnp.zeros_like(v))
+                               for n, v in self._params.items()}
+        else:
+            self._opt_state = {}
+        return self
+
+    # ------------------------------------------------ the fused step
+    def _build_step(self):
+        run = self._run
+        lr, momentum, wd, rescale = self.lr, self.momentum, self.wd, self.rescale
+        optimizer = self.optimizer
+
+        def step(params, aux, opt_state, batch, rng, t):
+            def f(p):
+                env = dict(p)
+                env.update(batch)
+                outs, auxu = run(env, aux, rng)
+                return outs, auxu
+
+            (outs, auxu), vjp = jax.vjp(f, params)
+            cts = ([jnp.ones_like(o) for o in outs],
+                   {k: jnp.zeros_like(v) for k, v in auxu.items()})
+            (grads,) = vjp(cts)
+            new_params = {}
+            new_opt = {}
+            for n, p in params.items():
+                g = grads[n]
+                if optimizer == "adam":
+                    m, v = opt_state[n]
+                    np_, m2, v2 = _adam(p, g, m, v, lr, 0.9, 0.999, 1e-8, wd,
+                                        rescale, t)
+                    new_params[n] = np_
+                    new_opt[n] = (m2, v2)
+                elif momentum:
+                    np_, m2 = _sgd_mom(p, g, opt_state[n], lr, momentum, wd,
+                                       rescale)
+                    new_params[n] = np_
+                    new_opt[n] = m2
+                else:
+                    new_params[n] = p - lr * (g * rescale + wd * p)
+            new_aux = dict(aux)
+            new_aux.update(auxu)
+            return new_params, new_aux, new_opt, outs
+
+        data_specs = {}
+        batch_spec = {n: NamedSharding(self.mesh, P("data"))
+                      for n in self.data_names + self.label_names}
+        pshard = {n: NamedSharding(self.mesh, self._pspecs[n])
+                  for n in self.param_names}
+        repl = NamedSharding(self.mesh, P())
+        if self.optimizer == "adam":
+            oshard = {n: (pshard[n], pshard[n]) for n in self._opt_state}
+        else:
+            oshard = {n: pshard[n] for n in self._opt_state}
+        self._step_fn = jax.jit(
+            step,
+            in_shardings=(pshard, {n: repl for n in self.aux_names}, oshard,
+                          batch_spec, repl, None),
+            donate_argnums=(0, 1, 2))
+        return self._step_fn
+
+    def step(self, batch):
+        """batch: dict name -> numpy/jax array (global batch)."""
+        if self._step_fn is None:
+            self._build_step()
+        self.step_count += 1
+        b = {}
+        for n in self.data_names + self.label_names:
+            v = batch[n]
+            arr = getattr(v, "_data", v)
+            b[n] = jax.device_put(
+                jnp.asarray(arr), NamedSharding(self.mesh, P("data")))
+        rng = _rnd.next_key()
+        self._params, self._aux, self._opt_state, outs = self._step_fn(
+            self._params, self._aux, self._opt_state, b, rng,
+            self.step_count)
+        return outs
+
+    @property
+    def params(self):
+        return self._params
+
+    @property
+    def aux(self):
+        return self._aux

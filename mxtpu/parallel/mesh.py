@@ -1,0 +1,64 @@
+"""Device-mesh utilities (role of ps-lite's Postoffice + dmlc tracker env:
+rank/num_workers/barrier — include/mxnet/kvstore.h:244-301 — re-expressed as
+jax.distributed + Mesh)."""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+from jax.sharding import Mesh
+
+_current = None
+
+
+def make_mesh(shape=None, axis_names=None, devices=None):
+    """Create a Mesh. Default: 1-D ('data',) over all devices.
+
+    shape: tuple like (dp, tp); axis_names defaults to ('data','model') for 2-D.
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if shape is None:
+        shape = (n,)
+    if axis_names is None:
+        axis_names = {1: ("data",), 2: ("data", "model"),
+                      3: ("data", "model", "pipeline"),
+                      4: ("data", "seq", "model", "pipeline")}[len(shape)]
+    arr = _np.asarray(devices[: int(_np.prod(shape))]).reshape(shape)
+    global _current
+    _current = Mesh(arr, axis_names)
+    return _current
+
+
+def current_mesh():
+    global _current
+    if _current is None:
+        make_mesh()
+    return _current
+
+
+def process_index():
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def process_count():
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def host_barrier():
+    """All-host sync: a global tiny psum (role of ps-lite Barrier)."""
+    import jax.numpy as jnp
+
+    x = jnp.ones(())
+    try:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("mxtpu_barrier")
+    except Exception:
+        jax.block_until_ready(x)

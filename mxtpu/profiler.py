@@ -1,0 +1,77 @@
+"""Profiler (parity: python/mxnet/profiler.py + src/engine/profiler.{h,cc}).
+
+TPU-native: wraps the JAX/XLA profiler (xplane) and also keeps a lightweight
+host-side span recorder dumped as chrome://tracing JSON, matching the
+reference's DumpProfile output format (profiler.cc:152 EmitPid/EmitEvent)."""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import jax
+
+_state = {"mode": "symbolic", "filename": "profile.json", "running": False,
+          "jax_trace": False}
+_events = []
+_lock = threading.Lock()
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """Parity MXSetProfilerConfig."""
+    _state["mode"] = mode
+    _state["filename"] = filename
+
+
+def profiler_set_state(state="stop"):
+    """Parity MXSetProfilerState: 'run' | 'stop'."""
+    if state == "run":
+        _state["running"] = True
+        try:
+            jax.profiler.start_trace("/tmp/mxtpu_xplane")
+            _state["jax_trace"] = True
+        except Exception:
+            _state["jax_trace"] = False
+    else:
+        if _state.get("jax_trace"):
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            _state["jax_trace"] = False
+        _state["running"] = False
+
+
+def record_span(name, begin_us, end_us, category="operator", tid=0):
+    """Record one op-level span (called by instrumented paths)."""
+    if not _state["running"]:
+        return
+    with _lock:
+        _events.append({"name": name, "cat": category, "ph": "B",
+                        "ts": begin_us, "pid": 0, "tid": tid})
+        _events.append({"name": name, "cat": category, "ph": "E",
+                        "ts": end_us, "pid": 0, "tid": tid})
+
+
+class scope:
+    """Context manager: time a region into the trace."""
+
+    def __init__(self, name, category="operator"):
+        self.name = name
+        self.category = category
+
+    def __enter__(self):
+        self.t0 = time.time() * 1e6
+        return self
+
+    def __exit__(self, *a):
+        record_span(self.name, self.t0, time.time() * 1e6, self.category)
+
+
+def dump_profile():
+    """Parity MXDumpProfile: write chrome://tracing JSON."""
+    with _lock:
+        payload = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+    with open(_state["filename"], "w") as f:
+        json.dump(payload, f)
+    return _state["filename"]

@@ -1,0 +1,36 @@
+"""Global PRNG state: a threefry key chain.
+
+Parity: mx.random.seed (python/mxnet/random.py) + the per-device kRandom resource
+(include/mxnet/resource.h:36-174). TPU-native: one splittable threefry key; every
+imperative sampler consumes a fresh split so results are reproducible under
+``seed`` regardless of async completion order.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+def _key():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(0)
+    return _state.key
+
+
+def seed(seed_state):
+    """Seed the global generator (parity mx.random.seed)."""
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Take a fresh subkey from the chain."""
+    k = _key()
+    _state.key, sub = jax.random.split(k)
+    return sub
+
+
+# imperative sampling front-ends (mx.random.uniform etc.) are generated onto
+# mxtpu.ndarray and re-exported from mxtpu/__init__.py

@@ -1,0 +1,184 @@
+"""RecordIO pack format (parity: python/mxnet/recordio.py + dmlc recordio —
+MXRecordIO, MXIndexedRecordIO, IRHeader pack/unpack, pack_img/unpack_img).
+
+Same on-disk framing as the reference (magic-delimited records, 4-byte aligned)
+so .rec files are interchangeable in structure. A C++ accelerated reader lives in
+mxtpu/native (used by the image pipeline when built)."""
+from __future__ import annotations
+
+import struct
+from collections import namedtuple
+
+import numpy as _np
+
+from .base import MXNetError
+
+_MAGIC = 0xCED7230A
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+class MXRecordIO:
+    """Sequential record file reader/writer (parity recordio.py MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.handle.close()
+            self.is_open = False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.handle.tell()
+
+    def seek(self, pos):
+        self.handle.seek(pos)
+
+    def write(self, buf):
+        assert self.writable
+        length = len(buf)
+        self.handle.write(struct.pack("<II", _MAGIC, length))
+        self.handle.write(buf)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        header = self.handle.read(8)
+        if len(header) < 8:
+            return None
+        magic, length = struct.unpack("<II", header)
+        if magic != _MAGIC:
+            raise MXNetError("Invalid record magic in %s" % self.uri)
+        buf = self.handle.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.read(pad)
+        return buf
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Keyed random access via an .idx sidecar (parity MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable:
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 2:
+                        continue
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+        else:
+            self.fidx = open(self.idx_path, "w")
+
+    def close(self):
+        if self.is_open and self.writable:
+            self.fidx.close()
+        super().close()
+
+    def read_idx(self, idx):
+        self.seek(self.idx[idx])
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write("%s\t%d\n" % (str(key), pos))
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+def pack(header, s):
+    """Pack a string with IRHeader (parity recordio.py pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        hdr = struct.pack(_IR_FORMAT, 0, float(header.label), header.id,
+                          header.id2)
+        return hdr + s
+    label = _np.asarray(header.label, dtype=_np.float32)
+    hdr = struct.pack(_IR_FORMAT, label.size, 0.0, header.id, header.id2)
+    return hdr + label.tobytes() + s
+
+
+def unpack(s):
+    flag, label, idx, idx2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    s = s[_IR_SIZE:]
+    if flag > 0:
+        label = _np.frombuffer(s[:flag * 4], dtype=_np.float32)
+        s = s[flag * 4:]
+    return IRHeader(flag, label, idx, idx2), s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Encode an image array and pack it (uses PIL if available, else raw)."""
+    try:
+        import io as _io
+
+        from PIL import Image
+
+        buf = _io.BytesIO()
+        fmt = "JPEG" if img_fmt in (".jpg", ".jpeg") else "PNG"
+        Image.fromarray(_np.asarray(img, dtype=_np.uint8)).save(
+            buf, format=fmt, quality=quality)
+        return pack(header, buf.getvalue())
+    except ImportError:
+        # raw fallback: shape header + bytes (decoded by unpack_img fallback)
+        arr = _np.asarray(img, dtype=_np.uint8)
+        meta = struct.pack("<III", *(arr.shape + (1,) * (3 - arr.ndim))[:3])
+        return pack(header, b"RAW0" + meta + arr.tobytes())
+
+
+def unpack_img(s, iscolor=-1):
+    header, s = unpack(s)
+    if s[:4] == b"RAW0":
+        h, w, c = struct.unpack("<III", s[4:16])
+        img = _np.frombuffer(s[16:], dtype=_np.uint8).reshape(
+            (h, w, c) if c > 1 else (h, w))
+        return header, img
+    import io as _io
+
+    from PIL import Image
+
+    img = _np.asarray(Image.open(_io.BytesIO(s)))
+    return header, img

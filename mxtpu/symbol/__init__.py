@@ -1,0 +1,52 @@
+"""mx.sym namespace: Symbol + auto-generated op composers.
+
+Parity: python/mxnet/symbol/op.py codegen over the op registry.
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..ops.registry import get_op, list_ops
+from .symbol import (Group, NameManager, Symbol, Variable, create, load,
+                     load_json, var)
+
+
+def _make_sym_fn(opname, op):
+    def fn(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        kwargs.pop("attr", None)
+        pos = [a for a in args if isinstance(a, Symbol)]
+        if op.variadic and len(pos) == 1 and isinstance(args[0], (list, tuple)):
+            pos = list(args[0])
+        # non-Symbol positionals map onto attrs in registration order
+        if op.variadic:
+            extra_pos = [a for a in args
+                         if not isinstance(a, (Symbol, list, tuple))]
+        else:
+            extra_pos = [a for a in args if not isinstance(a, Symbol)]
+        if extra_pos:
+            for attr_name in op.attrs_spec:
+                if not extra_pos:
+                    break
+                if attr_name.startswith("__") or attr_name in kwargs:
+                    continue
+                kwargs[attr_name] = extra_pos.pop(0)
+        sym_kw = {k: v for k, v in list(kwargs.items()) if isinstance(v, Symbol)}
+        for k in sym_kw:
+            kwargs.pop(k)
+        return create(opname, pos, kwargs, name=name, kwarg_syms=sym_kw)
+
+    fn.__name__ = opname
+    fn.__doc__ = op.doc or ("%s symbol composer (jax-backed)" % opname)
+    return fn
+
+
+_mod = _sys.modules[__name__]
+for _name in list_ops():
+    _op = get_op(_name)
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _make_sym_fn(_name, _op))
+
+for _pub, _priv in [("uniform", "_random_uniform"), ("normal", "_random_normal"),
+                    ("zeros", "_zeros"), ("ones", "_ones")]:
+    setattr(_mod, _pub, _make_sym_fn(_priv, get_op(_priv)))

@@ -1,0 +1,526 @@
+"""Symbol: the declarative graph IR.
+
+Parity: nnvm Symbol/Graph (SURVEY.md §2.2) + python/mxnet/symbol/symbol.py
+(compose, infer_shape, save/load JSON :1250). TPU-native: the graph is a pure
+dataflow DAG whose execution is a single traced JAX function (see
+mxtpu/executor.py); there are no memory-planning / op-fusion passes because XLA
+owns those. JSON schema follows the reference's graph format so checkpoints
+(prefix-symbol.json) stay interoperable in shape.
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as _np
+
+from ..base import MXNetError, attr_repr
+from ..ops.registry import get_op, op_exists
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "NameManager"]
+
+
+class NameManager:
+    """Auto-names composed ops: conv0, conv1, ... (parity python/mxnet/name.py)."""
+
+    _tls = threading.local()
+
+    @classmethod
+    def get(cls, name, hint):
+        if name:
+            return name
+        if not hasattr(cls._tls, "counter"):
+            cls._tls.counter = {}
+        c = cls._tls.counter
+        idx = c.get(hint, 0)
+        c[hint] = idx + 1
+        return "%s%d" % (hint, idx)
+
+    @classmethod
+    def reset(cls):
+        cls._tls.counter = {}
+
+
+class _Node:
+    __slots__ = ("op", "name", "attrs", "inputs", "_extra_attrs")
+
+    def __init__(self, op, name, attrs, inputs):
+        self.op = op  # OpDef or None for variables
+        self.name = name
+        self.attrs = dict(attrs)  # raw attr values (pre-parse)
+        self.inputs = list(inputs)  # list of (node, out_index)
+        self._extra_attrs = {}  # user __attrs__ like __ctx_group__, __shape__
+
+    @property
+    def is_variable(self):
+        return self.op is None
+
+    def parsed_attrs(self):
+        return self.op.parse_attrs(self.attrs)
+
+    def num_outputs(self):
+        if self.op is None:
+            return 1
+        n = self.op.n_out(self.parsed_attrs())
+        return n + len(self.op.aux_names)
+
+
+class Symbol:
+    """A (possibly multi-output) symbolic expression: list of node entries."""
+
+    def __init__(self, outputs):
+        self._outputs = list(outputs)  # list of (node, out_index)
+
+    # ------------------------------------------------ graph walk
+    def _topo(self):
+        order = []
+        seen = set()
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for n, _ in node.inputs:
+                visit(n)
+            order.append(node)
+
+        for n, _ in self._outputs:
+            visit(n)
+        return order
+
+    def _aux_node_set(self):
+        """Variable nodes wired into aux slots of any op."""
+        aux = set()
+        for node in self._topo():
+            if node.op is None or not node.op.aux_names:
+                continue
+            names = node.op.input_names(node.parsed_attrs(), n=len(node.inputs))
+            for i, (inode, _) in enumerate(node.inputs):
+                if i < len(names) and names[i] in node.op.aux_names and inode.is_variable:
+                    aux.add(id(inode))
+        return aux
+
+    def list_arguments(self):
+        aux = self._aux_node_set()
+        return [n.name for n in self._topo() if n.is_variable and id(n) not in aux]
+
+    def list_auxiliary_states(self):
+        aux = self._aux_node_set()
+        return [n.name for n in self._topo() if n.is_variable and id(n) in aux]
+
+    def list_outputs(self):
+        out = []
+        for node, idx in self._outputs:
+            if node.is_variable:
+                out.append(node.name)
+            else:
+                a = node.parsed_attrs()
+                n_vis = node.op.n_out(a)
+                names = _output_names(node, n_vis)
+                out.append(names[idx] if idx < len(names) else
+                           "%s_output%d" % (node.name, idx))
+        return out
+
+    def list_inputs(self):
+        return [n.name for n in self._topo() if n.is_variable]
+
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    # ------------------------------------------------ compose / access
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise MXNetError("output %s not found" % index)
+            index = names.index(index)
+        if isinstance(index, int):
+            return Symbol([self._outputs[index]])
+        raise TypeError(index)
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __iter__(self):
+        for i in range(len(self._outputs)):
+            yield self[i]
+
+    def get_internals(self):
+        entries = []
+        for node in self._topo():
+            for i in range(node.num_outputs() if node.op else 1):
+                # hide aux-update outputs
+                if node.op is not None:
+                    n_vis = node.op.n_out(node.parsed_attrs())
+                    if i >= n_vis:
+                        continue
+                entries.append((node, i))
+        return Symbol(entries)
+
+    def get_children(self):
+        node = self._outputs[0][0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    def attr(self, key):
+        node = self._outputs[0][0]
+        return node._extra_attrs.get(key) or node.attrs.get(key)
+
+    def attr_dict(self):
+        out = {}
+        for node in self._topo():
+            d = {k: attr_repr(v) for k, v in node.attrs.items()
+                 if not k.startswith("__")}
+            d.update(node._extra_attrs)
+            if d:
+                out[node.name] = d
+        return out
+
+    def _set_attr(self, **kwargs):
+        for node, _ in self._outputs:
+            node._extra_attrs.update({k: str(v) for k, v in kwargs.items()})
+
+    # ------------------------------------------------ arithmetic sugar
+    def _binop(self, other, op, scalar_op, rop=None):
+        from . import create  # late import of generated creators
+        if isinstance(other, Symbol):
+            return _compose(get_op(op), None, [self, other], {})
+        return _compose(get_op(scalar_op), None, [self], {"scalar": float(other)})
+
+    def __add__(self, o):
+        return self._binop(o, "_plus", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "_minus", "_minus_scalar")
+
+    def __rsub__(self, o):
+        return _compose(get_op("_rminus_scalar"), None, [self],
+                        {"scalar": float(o)})
+
+    def __mul__(self, o):
+        return self._binop(o, "_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, "_div", "_div_scalar")
+
+    __div__ = __truediv__
+
+    def __rtruediv__(self, o):
+        return _compose(get_op("_rdiv_scalar"), None, [self], {"scalar": float(o)})
+
+    __rdiv__ = __rtruediv__
+
+    def __pow__(self, o):
+        return self._binop(o, "_power", "_power_scalar")
+
+    def __neg__(self):
+        return _compose(get_op("negative"), None, [self], {})
+
+    def __repr__(self):
+        return "<Symbol %s>" % (self.name or ",".join(self.list_outputs()))
+
+    # ------------------------------------------------ inference
+    def infer_shape(self, *args, **kwargs):
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError:
+            raise
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known = {}
+        if args:
+            for n, s in zip(arg_names, args):
+                if s is not None:
+                    known[n] = tuple(s)
+        known.update({k: tuple(v) for k, v in kwargs.items() if v is not None})
+        shapes, dtypes = _infer_graph(self, known, {}, partial=partial)
+        if shapes is None:
+            return None, None, None
+        arg_shapes = [shapes.get(n) for n in arg_names]
+        if not partial:
+            for n, s in zip(arg_names, arg_shapes):
+                if s is None:
+                    raise MXNetError(
+                        "infer_shape: cannot determine shape of argument '%s'" % n)
+        out_shapes = [shapes.get(_entry_key(e)) for e in self._outputs]
+        aux_shapes = [shapes.get(n) for n in self.list_auxiliary_states()]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known = {}
+        if args:
+            for n, t in zip(arg_names, args):
+                if t is not None:
+                    known[n] = _np.dtype(t)
+        known.update({k: _np.dtype(v) for k, v in kwargs.items()})
+        shapes, dtypes = _infer_graph(self, {}, known, types_only=True)
+        if dtypes is None:
+            return None, None, None
+        arg_types = [dtypes.get(n) for n in arg_names]
+        out_types = [dtypes[_entry_key(e)] for e in self._outputs]
+        aux_types = [dtypes.get(n) for n in self.list_auxiliary_states()]
+        return arg_types, out_types, aux_types
+
+    # ------------------------------------------------ bind / eval
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    shared_exec=None, shared_data_arrays=None, **kwargs):
+        from ..executor import Executor
+        return Executor.simple_bind(self, ctx, grad_req=grad_req,
+                                    type_dict=type_dict,
+                                    shared_exec=shared_exec,
+                                    shared_data_arrays=shared_data_arrays,
+                                    **kwargs)
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
+             group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+        return Executor(self, ctx, args, args_grad=args_grad, grad_req=grad_req,
+                        aux_states=aux_states, group2ctx=group2ctx)
+
+    def eval(self, ctx=None, **kwargs):
+        ex = self.bind(ctx, kwargs)
+        return ex.forward()
+
+    def grad(self, wrt):
+        raise MXNetError("Symbol.grad: use bind + backward")
+
+    # ------------------------------------------------ serialization
+    def tojson(self):
+        nodes = []
+        node_id = {}
+        arg_nodes = []
+        for node in self._topo():
+            nid = len(nodes)
+            node_id[id(node)] = nid
+            attrs = {k: attr_repr(v) for k, v in node.attrs.items()
+                     if not k.startswith("__") and v is not None}
+            attrs.update(node._extra_attrs)
+            entry = {"op": "null" if node.is_variable else node.op.name,
+                     "name": node.name,
+                     "inputs": [[node_id[id(n)], idx, 0] for n, idx in node.inputs]}
+            if attrs and not node.is_variable:
+                entry["attrs"] = attrs
+            elif attrs:
+                entry["attrs"] = attrs
+            nodes.append(entry)
+            if node.is_variable:
+                arg_nodes.append(nid)
+        heads = [[node_id[id(n)], idx, 0] for n, idx in self._outputs]
+        return json.dumps({"nodes": nodes, "arg_nodes": arg_nodes,
+                           "node_row_ptr": list(range(len(nodes) + 1)),
+                           "heads": heads,
+                           "attrs": {"mxnet_version": ["int", 1100],
+                                     "framework": ["str", "mxtpu"]}},
+                          indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def debug_str(self):
+        lines = []
+        for node in self._topo():
+            kind = "Variable" if node.is_variable else node.op.name
+            ins = ", ".join(n.name for n, _ in node.inputs)
+            lines.append("%s %s(%s)" % (kind, node.name, ins))
+        return "\n".join(lines)
+
+
+def _output_names(node, n_vis):
+    if n_vis == 1:
+        return ["%s_output" % node.name]
+    return ["%s_output%d" % (node.name, i) for i in range(n_vis)]
+
+
+def _entry_key(entry):
+    node, idx = entry
+    return (id(node), idx)
+
+
+def _infer_graph(sym, shape_hints, type_hints, partial=False, types_only=False):
+    """Forward shape/dtype propagation using op.infer (jax.eval_shape)."""
+    shapes = {}
+    dtypes = {}
+    for node in sym._topo():
+        if node.is_variable:
+            shp = shape_hints.get(node.name)
+            if shp is None:
+                shp = node._extra_attrs.get("__shape__")
+                if shp is not None:
+                    shp = tuple(json.loads(str(list(shp)))) if not isinstance(shp, tuple) else shp
+            dt = type_hints.get(node.name, _np.dtype("float32"))
+            # unknown shapes stay None; a consumer's infer_args may fill them
+            shapes[node.name] = tuple(shp) if shp is not None else None
+            shapes[(id(node), 0)] = shapes[node.name]
+            dtypes[node.name] = dt
+            dtypes[(id(node), 0)] = dt
+            continue
+        if types_only:
+            # dtype-only propagation: first input's dtype (or the op's dtype attr)
+            dt = None
+            if "dtype" in node.attrs:
+                dt = _np.dtype(node.attrs["dtype"])
+            elif node.inputs:
+                dt = dtypes.get((id(node.inputs[0][0]), node.inputs[0][1]))
+            dt = dt or _np.dtype("float32")
+            for i in range(node.num_outputs()):
+                dtypes[(id(node), i)] = dt
+            continue
+        attrs = node.parsed_attrs()
+        in_shapes = []
+        for inode, idx in node.inputs:
+            key = (id(inode), idx)
+            in_shapes.append(shapes.get(key))
+        if any(s is None for s in in_shapes) and node.op.infer_args is not None:
+            try:
+                full = node.op.infer_args(attrs, in_shapes)
+            except Exception:
+                full = in_shapes
+            for (inode, idx), old, new in zip(node.inputs, in_shapes, full):
+                if old is None and new is not None and inode.is_variable:
+                    shapes[inode.name] = tuple(new)
+                    shapes[(id(inode), 0)] = tuple(new)
+                    dtypes.setdefault(inode.name, _np.dtype("float32"))
+                    dtypes.setdefault((id(inode), 0), _np.dtype("float32"))
+        in_avals = []
+        ok = True
+        for inode, idx in node.inputs:
+            key = (id(inode), idx)
+            if key not in shapes or shapes[key] is None:
+                ok = False
+                break
+            in_avals.append((shapes[key], dtypes.get(key, _np.dtype("float32"))))
+        if not ok:
+            if partial:
+                continue
+            raise MXNetError("infer_shape: insufficient information at node '%s'"
+                             % node.name)
+        out_avals = node.op.infer(attrs, in_avals)
+        for i, (s, d) in enumerate(out_avals):
+            shapes[(id(node), i)] = s
+            dtypes[(id(node), i)] = _np.dtype(d)
+    return shapes, dtypes
+
+
+# ---------------------------------------------------------------- constructors
+
+
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+             init=None, stype=None, **kwargs):
+    if not isinstance(name, str):
+        raise TypeError("Expect a string for variable name")
+    node = _Node(None, name, {}, [])
+    if shape is not None:
+        node._extra_attrs["__shape__"] = tuple(shape)
+    if lr_mult is not None:
+        node._extra_attrs["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        node._extra_attrs["__wd_mult__"] = str(wd_mult)
+    if dtype is not None:
+        node._extra_attrs["__dtype__"] = str(_np.dtype(dtype))
+    if init is not None:
+        node._extra_attrs["__init__"] = init.dumps() if hasattr(init, "dumps") else str(init)
+    if attr:
+        node._extra_attrs.update({k: str(v) for k, v in attr.items()})
+    node._extra_attrs.update({k: str(v) for k, v in kwargs.items()})
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    entries = []
+    for s in symbols:
+        entries.extend(s._outputs)
+    return Symbol(entries)
+
+
+def _compose(op, name, sym_inputs, attrs, kwarg_syms=None):
+    """Create an op node; auto-create Variables for missing tensor inputs
+    (parity: nnvm symbol composition auto-vars, e.g. fc weight/bias)."""
+    hint = op.name.lower().lstrip("_")
+    name = NameManager.get(name, hint)
+    parsed = op.parse_attrs(attrs)
+    if op.variadic:
+        in_syms = list(sym_inputs)
+        attrs = dict(attrs)
+        attrs[op.variadic] = len(in_syms)
+    else:
+        wanted = op.input_names(parsed)
+        by_name = dict(kwarg_syms or {})
+        in_syms = []
+        pos = list(sym_inputs)
+        for argn in wanted:
+            if argn in by_name:
+                in_syms.append(by_name[argn])
+            elif pos:
+                in_syms.append(pos.pop(0))
+            else:
+                in_syms.append(Variable("%s_%s" % (name, argn)))
+    entries = []
+    for s in in_syms:
+        if not isinstance(s, Symbol):
+            raise MXNetError("op %s: inputs must be Symbols, got %s"
+                             % (op.name, type(s)))
+        if len(s._outputs) != 1:
+            raise MXNetError("op %s: cannot compose multi-output symbol directly"
+                             % op.name)
+        entries.append(s._outputs[0])
+    node = _Node(op, name, attrs, entries)
+    n_vis = op.n_out(parsed)
+    return Symbol([(node, i) for i in range(n_vis)]) if n_vis > 1 else \
+        Symbol([(node, 0)])
+
+
+def create(op_name, inputs, attrs, name=None, kwarg_syms=None):
+    return _compose(get_op(op_name), name, inputs, attrs, kwarg_syms=kwarg_syms)
+
+
+# ---------------------------------------------------------------- load
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    nodes_meta = data["nodes"]
+    built = []
+    for meta in nodes_meta:
+        attrs = meta.get("attrs") or meta.get("attr") or meta.get("param") or {}
+        if meta["op"] == "null":
+            node = _Node(None, meta["name"], {}, [])
+            node._extra_attrs = {k: v for k, v in attrs.items()
+                                 if k.startswith("__")}
+        else:
+            if not op_exists(meta["op"]):
+                raise MXNetError("load: unknown op '%s'" % meta["op"])
+            op = get_op(meta["op"])
+            inputs = [(built[i], idx) for i, idx, *_ in meta["inputs"]]
+            user_attrs = {k: v for k, v in attrs.items() if k.startswith("__")}
+            op_attrs = {k: v for k, v in attrs.items()
+                        if not k.startswith("__") and k in op.attrs_spec}
+            if op.variadic and op.variadic in attrs:
+                op_attrs[op.variadic] = attrs[op.variadic]
+            node = _Node(op, meta["name"], op_attrs, inputs)
+            node._extra_attrs = user_attrs
+        built.append(node)
+    heads = [(built[i], idx) for i, idx, *_ in data["heads"]]
+    return Symbol(heads)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())

@@ -1,0 +1,272 @@
+"""Test harness (parity: python/mxnet/test_utils.py — assert_almost_equal :443,
+check_numeric_gradient :758 finite differences, check_symbolic_forward/backward
+:890, check_consistency, default_context :49, random data helpers).
+
+The trust chain mirrors the reference (SURVEY.md §4): numpy/finite-difference
+oracles per op, interpreter-vs-compiled consistency, tiny-model convergence."""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import context as ctx_mod
+from . import ndarray as nd
+from . import symbol as sym_mod
+from .base import MXNetError
+
+_rng = _np.random.RandomState(1234)
+
+
+def default_context():
+    return ctx_mod.current_context()
+
+
+def set_default_context(ctx):
+    ctx_mod.Context._default_ctx.stack = [ctx]
+
+
+def default_dtype():
+    return _np.float32
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (_rng.randint(1, dim0 + 1), _rng.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (_rng.randint(1, dim0 + 1), _rng.randint(1, dim1 + 1),
+            _rng.randint(1, dim2 + 1))
+
+
+def rand_ndarray(shape, stype="default", density=None):
+    arr = nd.array(_rng.uniform(-1, 1, size=shape))
+    return arr
+
+
+def random_arrays(*shapes):
+    arrays = [_np.array(_rng.standard_normal(s), dtype=default_dtype())
+              if s else _np.array(_rng.standard_normal(), dtype=default_dtype())
+              for s in shapes]
+    if len(arrays) == 1:
+        return arrays[0]
+    return arrays
+
+
+def same(a, b):
+    return _np.array_equal(a, b)
+
+
+def find_max_violation(a, b, rtol=None, atol=None):
+    rtol = rtol or 1e-5
+    atol = atol or 1e-20
+    diff = _np.abs(a - b)
+    tol = atol + rtol * _np.abs(b)
+    violation = diff / (tol + 1e-20)
+    loc = _np.unravel_index(_np.argmax(violation), violation.shape)
+    return violation[loc], loc
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b")):
+    """Parity test_utils.py:443."""
+    a = a.asnumpy() if isinstance(a, nd.NDArray) else _np.asarray(a)
+    b = b.asnumpy() if isinstance(b, nd.NDArray) else _np.asarray(b)
+    if _np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=True):
+        return
+    index, rel = find_max_violation(a, b, rtol, atol)
+    raise AssertionError(
+        "Error %f exceeds tolerance rtol=%f, atol=%f. Location of maximum "
+        "error: %s, %s=%s, %s=%s"
+        % (index, rtol, atol, str(rel), names[0],
+           a.flat[0] if a.size else a, names[1], b.flat[0] if b.size else b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20):
+    return _np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=True)
+
+
+def _parse_location(sym, location, ctx):
+    if isinstance(location, dict):
+        wrong = set(location.keys()) - set(sym.list_arguments())
+        if wrong:
+            raise ValueError("Location does not match arguments: %s" % wrong)
+        location = {k: nd.array(v, ctx=ctx) if not isinstance(v, nd.NDArray)
+                    else v for k, v in location.items()}
+    else:
+        location = {k: nd.array(v, ctx=ctx) if not isinstance(v, nd.NDArray)
+                    else v for k, v in zip(sym.list_arguments(), location)}
+    return location
+
+
+def _parse_aux_states(sym, aux_states, ctx):
+    if aux_states is None:
+        return {}
+    if isinstance(aux_states, dict):
+        return {k: nd.array(v, ctx=ctx) if not isinstance(v, nd.NDArray) else v
+                for k, v in aux_states.items()}
+    return {k: nd.array(v, ctx=ctx) for k, v in
+            zip(sym.list_auxiliary_states(), aux_states)}
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True):
+    """Central finite differences over executor forward (oracle)."""
+    grads = {}
+    for name in location:
+        arr = location[name].asnumpy()
+        grad = _np.zeros_like(arr)
+        flat = arr.ravel()
+        gflat = grad.ravel()
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            executor.forward(is_train=use_forward_train,
+                             **{name: nd.array(arr)})
+            f_plus = sum(float(o.asnumpy().sum()) for o in executor.outputs)
+            flat[i] = orig - eps
+            executor.forward(is_train=use_forward_train,
+                             **{name: nd.array(arr)})
+            f_minus = sum(float(o.asnumpy().sum()) for o in executor.outputs)
+            flat[i] = orig
+            gflat[i] = (f_plus - f_minus) / (2 * eps)
+        executor.forward(is_train=use_forward_train, **{name: nd.array(arr)})
+        grads[name] = grad
+    return grads
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None,
+                           use_forward_train=True, ctx=None, dtype=_np.float32):
+    """Finite differences vs autodiff backward (parity test_utils.py:758)."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx)
+    aux = _parse_aux_states(sym, aux_states, ctx)
+    if grad_nodes is None:
+        grad_nodes = list(location.keys())
+    input_shapes = {k: v.shape for k, v in location.items()}
+    arg_shapes, _, aux_shapes = sym.infer_shape(**input_shapes)
+    arg_names = sym.list_arguments()
+    args = {n: location.get(n, nd.zeros(s, ctx=ctx))
+            for n, s in zip(arg_names, arg_shapes)}
+    grad_req = {n: ("write" if n in grad_nodes else "null") for n in arg_names}
+    args_grad = {n: nd.zeros(args[n].shape, ctx=ctx) for n in grad_nodes}
+    executor = sym.bind(ctx, args, args_grad=args_grad, grad_req=grad_req,
+                        aux_states=aux)
+    executor.forward(is_train=use_forward_train)
+    executor.backward()
+    symbolic_grads = {k: executor.grad_dict[k].asnumpy() for k in grad_nodes}
+
+    # numeric: perturb each grad_node input
+    num_grads = {}
+    for name in grad_nodes:
+        arr = args[name].asnumpy().astype("float64")
+        grad = _np.zeros_like(arr)
+        flat = arr.ravel()
+        gflat = grad.ravel()
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + numeric_eps
+            executor.arg_dict[name][:] = nd.array(arr.astype(dtype))
+            executor.forward(is_train=use_forward_train)
+            f_plus = sum(float(o.asnumpy().astype("float64").sum())
+                         for o in executor.outputs)
+            flat[i] = orig - numeric_eps
+            executor.arg_dict[name][:] = nd.array(arr.astype(dtype))
+            executor.forward(is_train=use_forward_train)
+            f_minus = sum(float(o.asnumpy().astype("float64").sum())
+                          for o in executor.outputs)
+            flat[i] = orig
+            gflat[i] = (f_plus - f_minus) / (2 * numeric_eps)
+        executor.arg_dict[name][:] = nd.array(arr.astype(dtype))
+        num_grads[name] = grad
+    for name in grad_nodes:
+        assert_almost_equal(num_grads[name], symbolic_grads[name],
+                            rtol=rtol, atol=atol or 1e-4,
+                            names=("NUMERICAL_%s" % name, "BACKWARD_%s" % name))
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-5, atol=None,
+                           aux_states=None, ctx=None):
+    """Parity test_utils.py:890."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx)
+    aux = _parse_aux_states(sym, aux_states, ctx)
+    executor = sym.bind(ctx, location, aux_states=aux, grad_req="null")
+    outputs = executor.forward(is_train=False)
+    if isinstance(expected, dict):
+        expected = [expected[k] for k in sym.list_outputs()]
+    for output_name, expect, output in zip(sym.list_outputs(), expected,
+                                           outputs):
+        assert_almost_equal(expect, output.asnumpy(), rtol, atol or 1e-20,
+                            ("EXPECTED_%s" % output_name,
+                             "FORWARD_%s" % output_name))
+    return outputs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
+                            atol=None, aux_states=None, grad_req="write",
+                            ctx=None):
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx)
+    aux = _parse_aux_states(sym, aux_states, ctx)
+    if isinstance(expected, (list, tuple)):
+        expected = {k: v for k, v in zip(sym.list_arguments(), expected)}
+    args_grad = {k: nd.zeros(v.shape, ctx=ctx)
+                 for k, v in location.items() if k in expected}
+    if isinstance(grad_req, str):
+        grad_req = {k: grad_req if k in expected else "null"
+                    for k in sym.list_arguments()}
+    executor = sym.bind(ctx, location, args_grad=args_grad, grad_req=grad_req,
+                        aux_states=aux)
+    executor.forward(is_train=True)
+    if isinstance(out_grads, (tuple, list)):
+        out_grads = [nd.array(v, ctx=ctx) if not isinstance(v, nd.NDArray)
+                     else v for v in out_grads]
+    executor.backward(out_grads)
+    grads = {k: v.asnumpy() for k, v in args_grad.items()}
+    for name in expected:
+        assert_almost_equal(expected[name], grads[name], rtol, atol or 1e-20,
+                            ("EXPECTED_%s" % name, "BACKWARD_%s" % name))
+    return args_grad
+
+
+def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
+                      arg_params=None, rtol=1e-3, atol=1e-4):
+    """Cross-context consistency (parity check_consistency): run the same
+    symbol on each ctx and compare outputs/gradients."""
+    results = []
+    for spec in ctx_list:
+        ctx = spec["ctx"]
+        shapes = {k: v for k, v in spec.items() if k != "ctx" and k != "type_dict"}
+        exe = sym.simple_bind(ctx=ctx, grad_req=grad_req,
+                              type_dict=spec.get("type_dict"), **shapes)
+        if arg_params:
+            for k, v in arg_params.items():
+                if k in exe.arg_dict:
+                    exe.arg_dict[k][:] = nd.array(v)
+        else:
+            _np.random.seed(0)
+            for k, v in exe.arg_dict.items():
+                v[:] = nd.array(_np.random.normal(0, scale, size=v.shape)
+                                .astype(str(v.dtype)))
+        exe.forward(is_train=(grad_req != "null"))
+        if grad_req != "null":
+            exe.backward()
+        results.append(exe)
+    ref = results[0]
+    for exe in results[1:]:
+        for o_ref, o in zip(ref.outputs, exe.outputs):
+            assert_almost_equal(o_ref.asnumpy(), o.asnumpy(), rtol, atol)
+        if grad_req != "null":
+            for name in ref.grad_dict:
+                assert_almost_equal(ref.grad_dict[name].asnumpy(),
+                                    exe.grad_dict[name].asnumpy(), rtol, atol)
+    return results
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    ctx = ctx or default_context()
+    inputs = {k: nd.array(v) for k, v in inputs.items()}
+    exe = sym.bind(ctx, inputs)
+    outputs = [o.asnumpy() for o in exe.forward(is_train=is_train)]
+    if len(outputs) == 1:
+        outputs = outputs[0]
+    return outputs

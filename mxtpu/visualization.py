@@ -1,0 +1,138 @@
+"""Network visualization (parity: python/mxnet/visualization.py —
+print_summary, plot_network via graphviz if present)."""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74, 1.)):
+    """Print layer-by-layer summary (parity visualization.py print_summary)."""
+    show_shape = False
+    shape_dict = {}
+    if shape is not None:
+        show_shape = True
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape_partial(**shape)
+        if out_shapes is None:
+            raise ValueError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    if positions[-1] <= 1:
+        positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(fields, positions):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+
+    total_params = [0]
+
+    def print_layer_summary(node, out_shape):
+        op = node["op"]
+        pre_node = []
+        if op != "null":
+            inputs = node["inputs"]
+            for item in inputs:
+                input_node = nodes[item[0]]
+                input_name = input_node["name"]
+                if input_node["op"] != "null" or item[0] in heads:
+                    pre_node.append(input_name)
+        cur_param = 0
+        if op != "null":
+            for item in node["inputs"]:
+                input_node = nodes[item[0]]
+                if input_node["op"] == "null" and \
+                        (input_node["name"].endswith("weight") or
+                         input_node["name"].endswith("bias") or
+                         input_node["name"].endswith("gamma") or
+                         input_node["name"].endswith("beta")):
+                    key = input_node["name"]
+                    if show_shape:
+                        for k, v in shape_dict.items():
+                            if k == key + "_output" or k == key:
+                                pass
+        first_connection = pre_node[0] if pre_node else ""
+        fields = [node["name"] + " (" + op + ")",
+                  str(out_shape), cur_param, first_connection]
+        print_row(fields, positions)
+        for i in range(1, len(pre_node)):
+            fields = ["", "", "", pre_node[i]]
+            print_row(fields, positions)
+        total_params[0] += cur_param
+
+    heads = set(conf["arg_nodes"])
+    for node in nodes:
+        out_shape = []
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            continue
+        key = name + "_output"
+        if show_shape and key in shape_dict:
+            out_shape = shape_dict[key][1:]
+        print_layer_summary(node, out_shape)
+        print("_" * line_length)
+    print("Total params: %s" % total_params[0])
+    print("_" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz plot (parity visualization.py plot_network). Requires graphviz."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("Draw network requires graphviz library")
+    node_attrs = node_attrs or {}
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    node_attr.update(node_attrs)
+    dot = Digraph(name=title, format=save_format)
+    hidden_nodes = set()
+    for node in nodes:
+        op = node["op"]
+        name = node["name"]
+        attrs = node.get("attrs", {})
+        if op == "null":
+            if name.endswith("weight") or name.endswith("bias") or \
+                    name.endswith("gamma") or name.endswith("beta") or \
+                    name.endswith("moving_mean") or name.endswith("moving_var"):
+                if hide_weights:
+                    hidden_nodes.add(name)
+                continue
+            dot.node(name=name, label=name,
+                     **dict(node_attr, fillcolor="#8dd3c7"))
+        else:
+            label = op
+            if op == "Convolution":
+                label = "Convolution\n%s/%s, %s" % (
+                    attrs.get("kernel", "?"), attrs.get("stride", "(1,)"),
+                    attrs.get("num_filter", "?"))
+            elif op == "FullyConnected":
+                label = "FullyConnected\n%s" % attrs.get("num_hidden", "?")
+            elif op == "Activation" or op == "LeakyReLU":
+                label = "%s\n%s" % (op, attrs.get("act_type", ""))
+            elif op == "Pooling":
+                label = "Pooling\n%s, %s/%s" % (
+                    attrs.get("pool_type", "?"), attrs.get("kernel", "?"),
+                    attrs.get("stride", "(1,)"))
+            dot.node(name=name, label=label,
+                     **dict(node_attr, fillcolor="#fb8072"))
+        for item in node.get("inputs", []):
+            input_name = nodes[item[0]]["name"]
+            if input_name not in hidden_nodes:
+                dot.edge(tail_name=input_name, head_name=name)
+    return dot

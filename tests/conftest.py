@@ -1,0 +1,11 @@
+"""Test env: force an 8-device virtual CPU mesh BEFORE jax initializes.
+
+Mirrors the reference's trick of testing multi-device paths with multiple CPU
+contexts (SURVEY.md §4, tests/python/unittest/test_model_parallel.py)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

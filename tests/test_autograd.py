@@ -1,0 +1,125 @@
+"""Autograd tests (model: reference tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, nd
+
+
+def test_simple_grad():
+    x = nd.array(np.array([[1.0, 2], [3, 4]]))
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain_grad():
+    x = nd.array(np.array([1.0, 2, 3]))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x)
+        z = (y * y).sum()
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * np.exp(2 * x.asnumpy()), rtol=1e-4)
+
+
+def test_head_grads():
+    x = nd.array(np.array([1.0, 2, 3]))
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+    y.backward(nd.array(np.array([1.0, 10, 100])))
+    assert np.allclose(x.grad.asnumpy(), [2, 20, 200])
+
+
+def test_training_modes():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+    with autograd.record(train_mode=False):
+        assert autograd.is_recording()
+        assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+
+
+def test_pause():
+    x = nd.ones((2,))
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            z = y * 3  # not recorded
+        w = (y * y).sum()
+    w.backward()
+    assert np.allclose(x.grad.asnumpy(), 8)
+
+
+def test_grad_add_req():
+    x = nd.array(np.array([1.0, 2]))
+    grad = nd.zeros((2,))
+    autograd.mark_variables([x], [grad], "add")
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward(retain_graph=False)
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert np.allclose(grad.asnumpy(), 2 * 2 * x.asnumpy())
+
+
+def test_detach():
+    x = nd.array(np.array([2.0]))
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+        z = y.detach() * x
+    z.backward()
+    # z = const(6) * x -> dz/dx = 6
+    assert np.allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_multi_variable():
+    a = nd.array(np.array([1.0, 2]))
+    b = nd.array(np.array([3.0, 4]))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = (a * b).sum()
+    c.backward()
+    assert np.allclose(a.grad.asnumpy(), b.asnumpy())
+    assert np.allclose(b.grad.asnumpy(), a.asnumpy())
+
+
+def test_dropout_modes():
+    x = nd.ones((100, 100))
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5)
+    frac_zero = (y.asnumpy() == 0).mean()
+    assert 0.3 < frac_zero < 0.7
+    with autograd.record(train_mode=False):
+        y2 = nd.Dropout(x, p=0.5)
+    assert np.allclose(y2.asnumpy(), 1)
+
+
+def test_function_custom_grad():
+    class Double(autograd.Function):
+        def forward(self, x):
+            return x * 2
+
+        def backward(self, dy):
+            return dy * 7  # deliberately wrong constant to verify custom path
+
+    x = nd.array(np.array([1.0, 2]))
+    x.attach_grad()
+    f = Double()
+    with autograd.record():
+        y = f(x)
+        z = y.sum()
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [7, 7])

@@ -1,0 +1,188 @@
+"""Gluon tests (model: reference tests/python/unittest/test_gluon.py,
+test_nn.py convergence tests)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, gluon, nd
+from mxtpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(4, 3))
+    p.initialize(init=mx.initializer.One(), ctx=mx.cpu())
+    assert np.allclose(p.data().asnumpy(), 1)
+    assert p.list_ctx() == [mx.cpu()]
+    p.zero_grad()
+    assert np.allclose(p.grad().asnumpy(), 0)
+
+
+def test_parameter_dict():
+    params = gluon.ParameterDict("net_")
+    w = params.get("w", shape=(2, 2))
+    assert w.name == "net_w"
+    assert params.get("w") is w
+    params.initialize(ctx=mx.cpu())
+
+
+def test_dense_forward():
+    layer = nn.Dense(8, in_units=4)
+    layer.initialize(ctx=mx.cpu())
+    x = nd.ones((2, 4))
+    out = layer(x)
+    assert out.shape == (2, 8)
+
+
+def test_dense_deferred_init():
+    layer = nn.Dense(8)
+    layer.initialize(ctx=mx.cpu())
+    out = layer(nd.ones((2, 5)))
+    assert out.shape == (2, 8)
+    assert layer.weight.shape == (8, 5)
+
+
+def test_sequential_and_training():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"))
+    net.add(nn.Dense(4))
+    net.initialize(init=mx.initializer.Xavier(), ctx=mx.cpu())
+
+    rng = np.random.RandomState(0)
+    centers = rng.randn(4, 16) * 3
+    y = rng.randint(0, 4, 512)
+    X = (centers[y] + rng.randn(512, 16)).astype("float32")
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for epoch in range(10):
+        for i in range(0, 512, 64):
+            data = nd.array(X[i:i + 64])
+            label = nd.array(y[i:i + 64].astype("float32"))
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(64)
+    preds = net(nd.array(X)).asnumpy().argmax(axis=1)
+    acc = (preds == y).mean()
+    assert acc > 0.9, "gluon training accuracy %f" % acc
+
+
+def test_hybridize_consistency():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"))
+    net.add(nn.Dense(4))
+    net.initialize(ctx=mx.cpu())
+    x = nd.array(np.random.randn(3, 8).astype("f4"))
+    out_imperative = net(x).asnumpy()
+    net.hybridize()
+    out_hybrid = net(x).asnumpy()
+    assert np.allclose(out_imperative, out_hybrid, atol=1e-5)
+
+
+def test_hybridize_training():
+    """Gradients must flow through the cached (fused) op."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"))
+    net.add(nn.Dense(2))
+    net.initialize(ctx=mx.cpu())
+    net.hybridize()
+    x = nd.array(np.random.randn(4, 6).astype("f4"))
+    with autograd.record():
+        out = net(x)
+        loss = (out * out).sum()
+    loss.backward()
+    w = net[0].weight
+    assert float(np.abs(w.grad().asnumpy()).sum()) > 0
+
+
+def test_conv_block():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, kernel_size=3, padding=1, activation="relu"))
+    net.add(nn.MaxPool2D(2, 2))
+    net.add(nn.BatchNorm())
+    net.add(nn.Flatten())
+    net.add(nn.Dense(3))
+    net.initialize(ctx=mx.cpu())
+    out = net(nd.ones((2, 3, 8, 8)))
+    assert out.shape == (2, 3)
+
+
+def test_batchnorm_running_stats():
+    layer = nn.BatchNorm(in_channels=3)
+    layer.initialize(ctx=mx.cpu())
+    x = nd.array(np.random.randn(8, 3, 4, 4).astype("f4") * 3 + 1)
+    rm0 = layer.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        layer(x)
+    rm1 = layer.running_mean.data().asnumpy()
+    assert not np.allclose(rm0, rm1)
+    # eval mode: no update
+    layer(x)
+    rm2 = layer.running_mean.data().asnumpy()
+    assert np.allclose(rm1, rm2)
+
+
+def test_embedding_block():
+    emb = nn.Embedding(10, 4)
+    emb.initialize(ctx=mx.cpu())
+    out = emb(nd.array(np.array([1, 2], dtype="f4")))
+    assert out.shape == (2, 4)
+
+
+def test_losses():
+    pred = nd.array(np.random.randn(4, 5).astype("f4"))
+    label = nd.array(np.array([0, 1, 2, 3], dtype="f4"))
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    assert l.shape == (4,)
+    l2 = gluon.loss.L2Loss()(pred, nd.zeros((4, 5)))
+    assert np.allclose(l2.asnumpy(),
+                       (pred.asnumpy() ** 2).mean(axis=1) / 2, atol=1e-5)
+    l1 = gluon.loss.L1Loss()(pred, nd.zeros((4, 5)))
+    assert np.allclose(l1.asnumpy(), np.abs(pred.asnumpy()).mean(axis=1),
+                       atol=1e-6)
+    hu = gluon.loss.HuberLoss()(pred, nd.zeros((4, 5)))
+    assert hu.shape == (4,)
+
+
+def test_save_load_params(tmp_path):
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3))
+    net.initialize(ctx=mx.cpu())
+    fname = str(tmp_path / "net.params")
+    net.save_params(fname)
+    net2 = nn.HybridSequential(prefix="model_")
+    with net2.name_scope():
+        net2.add(nn.Dense(4, in_units=3))
+    net2.load_params(fname, ctx=mx.cpu())
+    x = nd.ones((1, 3))
+    assert np.allclose(net(x).asnumpy(), net2(x).asnumpy())
+
+
+def test_split_and_load():
+    data = nd.array(np.arange(16).reshape(8, 2).astype("f4"))
+    parts = gluon.utils.split_and_load(data, [mx.cpu(0), mx.cpu(1)])
+    assert len(parts) == 2
+    assert parts[0].shape == (4, 2)
+
+
+def test_model_zoo_resnet_tiny():
+    net = gluon.model_zoo.vision.resnet18_v1(classes=10)
+    net.initialize(init=mx.initializer.Xavier(), ctx=mx.cpu())
+    out = net(nd.ones((1, 3, 32, 32)))
+    assert out.shape == (1, 10)
+
+
+def test_dataset_dataloader():
+    X = np.random.randn(32, 3).astype("f4")
+    y = np.arange(32).astype("f4")
+    ds = gluon.data.ArrayDataset(X, y)
+    assert len(ds) == 32
+    loader = gluon.data.DataLoader(ds, batch_size=8, shuffle=True)
+    seen = 0
+    for data, label in loader:
+        assert data.shape == (8, 3)
+        seen += data.shape[0]
+    assert seen == 32

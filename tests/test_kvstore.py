@@ -1,0 +1,97 @@
+"""KVStore exact-value invariants (model: reference
+tests/python/unittest/test_kvstore.py + tests/nightly/dist_sync_kvstore.py
+:28-60 — after push from n sources, pulled value equals n * expected)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import kv, nd
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def test_single_kv_pair():
+    store = kv.create("local")
+    store.init(3, nd.ones(SHAPE))
+    out = nd.zeros(SHAPE)
+    store.pull(3, out=out)
+    assert np.allclose(out.asnumpy(), 1)
+    store.push(3, nd.ones(SHAPE) * 4)
+    store.pull(3, out=out)
+    assert np.allclose(out.asnumpy(), 4)
+
+
+def test_aggregation():
+    """Push a list (one per 'device') -> values are summed."""
+    store = kv.create("local")
+    store.init(3, nd.ones(SHAPE))
+    num_devs = 4
+    devs = [mx.cpu(i % 2) for i in range(num_devs)]
+    vals = [nd.ones(SHAPE, ctx=d) for d in devs]
+    store.push(3, vals)
+    out = nd.zeros(SHAPE)
+    store.pull(3, out=out)
+    assert np.allclose(out.asnumpy(), num_devs)
+
+
+def test_list_kv_pairs():
+    store = kv.create("local")
+    store.init(KEYS, [nd.ones(SHAPE)] * len(KEYS))
+    store.push(KEYS, [nd.ones(SHAPE) * 2] * len(KEYS))
+    outs = [nd.zeros(SHAPE) for _ in KEYS]
+    store.pull(KEYS, out=outs)
+    for o in outs:
+        assert np.allclose(o.asnumpy(), 2)
+
+
+def test_updater():
+    store = kv.create("local")
+    store.init(3, nd.ones(SHAPE))
+
+    def updater(key, recv, stored):
+        stored += recv * 2
+
+    store.set_updater(updater)
+    store.push(3, nd.ones(SHAPE))
+    out = nd.zeros(SHAPE)
+    store.pull(3, out=out)
+    assert np.allclose(out.asnumpy(), 3)  # 1 + 2*1
+    # aggregated push then updater
+    store.push(3, [nd.ones(SHAPE)] * 4)
+    store.pull(3, out=out)
+    assert np.allclose(out.asnumpy(), 11)  # 3 + 2*4
+
+
+def test_optimizer_on_kvstore():
+    """update_on_kvstore semantics: push grad, pull updated weight."""
+    store = kv.create("local")
+    opt = mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0)
+    store.set_optimizer(opt)
+    w = nd.ones(SHAPE)
+    store.init(0, w)
+    g = nd.ones(SHAPE)
+    store.push(0, g)
+    out = nd.zeros(SHAPE)
+    store.pull(0, out=out)
+    assert np.allclose(out.asnumpy(), 1 - 0.1)
+
+
+def test_kvstore_types_and_rank():
+    for name in ("local", "device", "dist_sync", "dist_async"):
+        store = kv.create(name)
+        assert store.type == name
+    store = kv.create("local")
+    assert store.rank == 0
+    assert store.num_workers == 1
+    with pytest.raises(mx.MXNetError):
+        kv.create("unknown_type")
+
+
+def test_row_sparse_pull():
+    store = kv.create("local")
+    store.init("emb", nd.array(np.arange(12).reshape(4, 3).astype("f4")))
+    out = nd.zeros((4, 3))
+    store.row_sparse_pull("emb", out=out,
+                          row_ids=nd.array(np.array([0., 2.])))
+    assert out.shape == (4, 3)

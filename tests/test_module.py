@@ -1,0 +1,160 @@
+"""Module API tests incl. tiny-model convergence (model: reference
+tests/python/unittest/test_module.py + tests/python/train/test_mlp.py)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd, sym
+
+
+def _toy_data(n=512, dim=16, classes=4, seed=0):
+    """Separable Gaussian blobs (converges fast -> tests optimization, not
+    task difficulty)."""
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, dim) * 3
+    y = rng.randint(0, classes, n)
+    X = (centers[y] + rng.randn(n, dim)).astype("float32")
+    return X, y.astype("float32")
+
+
+def _mlp(classes=4):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_module_bind_forward():
+    net = _mlp()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 16))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(initializer=mx.initializer.Xavier())
+    batch = mx.io.DataBatch(data=[nd.ones((8, 16))],
+                            label=[nd.zeros((8,))])
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (8, 4)
+
+
+def test_module_fit_convergence():
+    """MLP on separable data must reach >0.9 accuracy (parity
+    tests/python/train/test_mlp.py threshold idea)."""
+    mx.random.seed(7)
+    X, y = _toy_data()
+    train = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=12,
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            initializer=mx.initializer.Xavier())
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=32), "acc")
+    assert score[0][1] > 0.9, "accuracy %f too low" % score[0][1]
+
+
+def test_module_predict_and_params():
+    X, y = _toy_data(n=64)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    preds = mod.predict(it)
+    assert preds.shape == (64, 4)
+    arg_params, aux_params = mod.get_params()
+    assert "fc1_weight" in arg_params
+
+
+def test_module_checkpoint(tmp_path):
+    X, y = _toy_data(n=64)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer()
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+    mod2 = mx.mod.Module.load(prefix, 1)
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    a1, _ = mod.get_params()
+    a2, _ = mod2.get_params()
+    for k in a1:
+        assert np.allclose(a1[k].asnumpy(), a2[k].asnumpy()), k
+
+
+def test_module_multi_device():
+    """Multi-'device' DP on CPU contexts (the reference's own trick:
+    test_multi_device_exec.py uses cpu(0), cpu(1))."""
+    mx.random.seed(7)
+    X, y = _toy_data()
+    train = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu(0), mx.cpu(1)])
+    mod.fit(train, num_epoch=10, kvstore="local",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            initializer=mx.initializer.Xavier())
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=32), "acc")
+    assert score[0][1] > 0.85, "multi-device accuracy %f" % score[0][1]
+
+
+def test_module_input_grads():
+    net = _mlp()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 16))],
+             label_shapes=[("softmax_label", (4,))],
+             inputs_need_grad=True)
+    mod.init_params()
+    batch = mx.io.DataBatch(data=[nd.ones((4, 16))], label=[nd.zeros((4,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    ig = mod.get_input_grads()[0]
+    assert ig.shape == (4, 16)
+
+
+def test_bucketing_module():
+    """Variable-length buckets share parameters (parity
+    tests/python/train/test_bucketing.py shape)."""
+    buckets = [4, 8]
+
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        net = sym.FullyConnected(data, num_hidden=8, name="fc_shared")
+        net = sym.SoftmaxOutput(net, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer()
+    for key, feat in [(8, 8), (4, 4)]:
+        batch = mx.io.DataBatch(
+            data=[nd.ones((4, feat))], label=[nd.zeros((4,))],
+            bucket_key=key,
+            provide_data=[mx.io.DataDesc("data", (4, feat))],
+            provide_label=[mx.io.DataDesc("softmax_label", (4,))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    # same parameter object across buckets
+    m4 = mod._buckets[4]._exec_group.execs[0].arg_dict["fc_shared_weight"]
+    m8 = mod._buckets[8]._exec_group.execs[0].arg_dict["fc_shared_weight"]
+    assert m4 is not None and m8 is not None
+
+
+def test_sequential_module():
+    net1 = sym.FullyConnected(sym.Variable("data"), num_hidden=8, name="fc1")
+    net2 = sym.SoftmaxOutput(sym.FullyConnected(
+        sym.Variable("fc1_output"), num_hidden=4, name="fc2"), name="softmax")
+    mod = mx.mod.SequentialModule()
+    mod.add(mx.mod.Module(net1, label_names=None, context=mx.cpu()))
+    mod.add(mx.mod.Module(net2, data_names=("fc1_output",),
+                          context=mx.cpu()),
+            take_labels=True, auto_wiring=True)
+    mod.bind(data_shapes=[("data", (4, 16))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer()
+    batch = mx.io.DataBatch(data=[nd.ones((4, 16))], label=[nd.zeros((4,))])
+    mod.forward(batch, is_train=True)
+    out = mod.get_outputs()[0]
+    assert out.shape == (4, 4)

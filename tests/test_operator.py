@@ -1,0 +1,204 @@
+"""Operator tests vs numpy oracles + finite-difference gradient checks
+(model: reference tests/python/unittest/test_operator.py + test_utils.py
+check_numeric_gradient/check_symbolic_forward)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd, sym
+from mxtpu.test_utils import (assert_almost_equal, check_numeric_gradient,
+                              check_symbolic_forward)
+
+
+def test_unary_vs_numpy():
+    x = np.random.uniform(0.1, 2.0, (3, 4)).astype("float32")
+    cases = [("sqrt", np.sqrt), ("exp", np.exp), ("log", np.log),
+             ("tanh", np.tanh), ("abs", np.abs), ("square", np.square),
+             ("sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+             ("relu", lambda v: np.maximum(v, 0)), ("cos", np.cos)]
+    for name, ref in cases:
+        out = getattr(nd, name)(nd.array(x)).asnumpy()
+        assert np.allclose(out, ref(x), atol=1e-5), name
+
+
+def test_fully_connected_forward():
+    x = np.random.randn(4, 5).astype("float32")
+    w = np.random.randn(3, 5).astype("float32")
+    b = np.random.randn(3).astype("float32")
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b),
+                            num_hidden=3)
+    assert np.allclose(out.asnumpy(), x @ w.T + b, atol=1e-5)
+
+
+def test_convolution_forward():
+    # compare against explicit loop conv
+    x = np.random.randn(1, 2, 5, 5).astype("float32")
+    w = np.random.randn(3, 2, 3, 3).astype("float32")
+    out = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         num_filter=3, no_bias=True).asnumpy()
+    ref = np.zeros((1, 3, 3, 3), dtype="float32")
+    for f in range(3):
+        for i in range(3):
+            for j in range(3):
+                ref[0, f, i, j] = np.sum(x[0, :, i:i + 3, j:j + 3] * w[f])
+    assert np.allclose(out, ref, atol=1e-4)
+
+
+def test_pooling_forward():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    mp = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                    pool_type="max").asnumpy()
+    assert np.allclose(mp, [[[[5, 7], [13, 15]]]])
+    ap = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                    pool_type="avg").asnumpy()
+    assert np.allclose(ap, [[[[2.5, 4.5], [10.5, 12.5]]]])
+    gp = nd.Pooling(nd.array(x), global_pool=True, pool_type="max").asnumpy()
+    assert np.allclose(gp, [[[[15]]]])
+
+
+def test_softmax_forward():
+    x = np.random.randn(3, 5).astype("float32")
+    out = nd.softmax(nd.array(x)).asnumpy()
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    assert np.allclose(out, e / e.sum(axis=1, keepdims=True), atol=1e-5)
+
+
+def test_batchnorm_train_eval():
+    x = np.random.randn(8, 3, 4, 4).astype("float32")
+    g = np.ones(3, dtype="float32")
+    b = np.zeros(3, dtype="float32")
+    mm = np.zeros(3, dtype="float32")
+    mv = np.ones(3, dtype="float32")
+    with mx.autograd.train_mode():
+        out = nd.BatchNorm(nd.array(x), nd.array(g), nd.array(b),
+                           nd.array(mm), nd.array(mv), fix_gamma=False)
+    o = out.asnumpy()
+    # normalized per channel
+    assert np.allclose(o.mean(axis=(0, 2, 3)), 0, atol=1e-4)
+    assert np.allclose(o.std(axis=(0, 2, 3)), 1, atol=1e-2)
+
+
+def test_gradient_fc():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=4, name="fc")
+    out = sym.sum(fc)
+    check_numeric_gradient(out, {"data": np.random.randn(3, 5).astype("f4")},
+                           numeric_eps=1e-2, rtol=1e-2, atol=1e-2)
+
+
+def test_gradient_elemwise():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    out = sym.sum(a * b + sym.tanh(a))
+    loc = {"a": np.random.randn(3, 3).astype("f4"),
+           "b": np.random.randn(3, 3).astype("f4")}
+    check_numeric_gradient(out, loc, numeric_eps=1e-2, rtol=1e-2, atol=1e-2)
+
+
+def test_gradient_conv_pool():
+    data = sym.Variable("data")
+    net = sym.Convolution(data, kernel=(3, 3), num_filter=2, pad=(1, 1),
+                          name="c")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    out = sym.sum(net)
+    check_numeric_gradient(out, {"data": np.random.randn(1, 1, 4, 4)
+                                 .astype("f4")},
+                           numeric_eps=1e-2, rtol=5e-2, atol=5e-2)
+
+
+def test_symbolic_forward_check():
+    x = np.random.randn(2, 3).astype("f4")
+    data = sym.Variable("x")
+    out = sym.exp(data)
+    check_symbolic_forward(out, {"x": x}, [np.exp(x)], rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_and_sequence_ops():
+    w = np.random.randn(10, 4).astype("f4")
+    idx = np.array([1, 3, 5], dtype="f4")
+    out = nd.Embedding(nd.array(idx), nd.array(w), input_dim=10, output_dim=4)
+    assert np.allclose(out.asnumpy(), w[[1, 3, 5]], atol=1e-6)
+    # sequence ops on (T, N, C)
+    x = np.random.randn(4, 2, 3).astype("f4")
+    lens = np.array([2, 4], dtype="f4")
+    masked = nd.SequenceMask(nd.array(x), nd.array(lens),
+                             use_sequence_length=True, value=0.0).asnumpy()
+    assert np.allclose(masked[2:, 0], 0)
+    assert np.allclose(masked[:, 1], x[:, 1], atol=1e-6)
+    last = nd.SequenceLast(nd.array(x), nd.array(lens),
+                           use_sequence_length=True).asnumpy()
+    assert np.allclose(last[0], x[1, 0], atol=1e-6)
+    assert np.allclose(last[1], x[3, 1], atol=1e-6)
+    rev = nd.SequenceReverse(nd.array(x), nd.array(lens),
+                             use_sequence_length=True).asnumpy()
+    assert np.allclose(rev[0, 0], x[1, 0], atol=1e-6)
+    assert np.allclose(rev[0, 1], x[3, 1], atol=1e-6)
+
+
+def test_slice_and_crop():
+    x = np.arange(24, dtype="f4").reshape(2, 3, 4)
+    out = nd.slice(nd.array(x), begin=(0, 1, 1), end=(2, 3, 3)).asnumpy()
+    assert np.allclose(out, x[:, 1:3, 1:3])
+    out2 = nd.slice_axis(nd.array(x), axis=2, begin=1, end=3).asnumpy()
+    assert np.allclose(out2, x[:, :, 1:3])
+
+
+def test_optimizer_ops():
+    w = nd.ones((4,))
+    g = nd.ones((4,)) * 2
+    nd.sgd_update(w, g, lr=0.1, out=w)
+    assert np.allclose(w.asnumpy(), 1 - 0.1 * 2)
+    w2 = nd.ones((4,))
+    mom = nd.zeros((4,))
+    nd.sgd_mom_update(w2, g, mom, lr=0.1, momentum=0.9, out=[w2, mom])
+    assert np.allclose(w2.asnumpy(), 0.8)
+    assert np.allclose(mom.asnumpy(), -0.2)
+    wa = nd.ones((4,))
+    me, va = nd.zeros((4,)), nd.zeros((4,))
+    nd.adam_update(wa, g, me, va, lr=0.01, out=[wa, me, va])
+    assert wa.asnumpy().mean() < 1.0
+
+
+def test_random_ops_seeded():
+    mx.random.seed(42)
+    a = nd.uniform(low=0, high=1, shape=(100,)).asnumpy()
+    mx.random.seed(42)
+    b = nd.uniform(low=0, high=1, shape=(100,)).asnumpy()
+    assert np.allclose(a, b)
+    assert 0 <= a.min() and a.max() <= 1
+    n = nd.normal(loc=5, scale=0.1, shape=(2000,)).asnumpy()
+    assert abs(n.mean() - 5) < 0.1
+
+
+def test_where_clip_etc():
+    c = nd.array(np.array([1.0, 0, 1]))
+    a = nd.array(np.array([1.0, 2, 3]))
+    b = nd.array(np.array([4.0, 5, 6]))
+    out = nd.where(c, a, b).asnumpy()
+    assert np.allclose(out, [1, 5, 3])
+    assert np.allclose(nd.clip(a, a_min=1.5, a_max=2.5).asnumpy(),
+                       [1.5, 2, 2.5])
+
+
+def test_linalg_ops():
+    a = np.random.randn(3, 3).astype("f4")
+    spd = a @ a.T + 3 * np.eye(3, dtype="f4")
+    l = nd.linalg_potrf(nd.array(spd)).asnumpy()
+    assert np.allclose(l @ l.T, spd, atol=1e-3)
+    g = nd.linalg_gemm2(nd.array(a), nd.array(a), transpose_b=True).asnumpy()
+    assert np.allclose(g, a @ a.T, atol=1e-4)
+
+
+def test_loss_ops_grad_semantics():
+    # LinearRegressionOutput: grad = pred - label
+    d = sym.Variable("d")
+    l = sym.Variable("l")
+    out = sym.LinearRegressionOutput(d, l, name="lro")
+    pred = np.random.randn(4, 3).astype("f4")
+    lab = np.random.randn(4, 3).astype("f4")
+    ex = out.bind(mx.cpu(), {"d": nd.array(pred), "l": nd.array(lab)},
+                  args_grad={"d": nd.zeros((4, 3))},
+                  grad_req={"d": "write", "l": "null"})
+    ex.forward(is_train=True)
+    ex.backward()
+    assert np.allclose(ex.grad_dict["d"].asnumpy(), pred - lab, atol=1e-5)

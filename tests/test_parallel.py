@@ -1,0 +1,129 @@
+"""Mesh / data-parallel / ring-attention tests on the virtual 8-device CPU
+mesh (model: reference dist tests run as multi-process on one host,
+SURVEY.md §4; here multi-device XLA collectives replace processes)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxtpu as mx
+from mxtpu import nd, sym
+from mxtpu.parallel import (DataParallelTrainer, blockwise_attention,
+                            make_mesh, ring_attention)
+
+
+def test_mesh_creation():
+    mesh = make_mesh()
+    assert len(mesh.devices.reshape(-1)) == 8
+    mesh2 = make_mesh(shape=(4, 2))
+    assert mesh2.axis_names == ("data", "model")
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=64, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_dp_trainer_step_and_convergence():
+    mesh = make_mesh(shape=(8,))
+    trainer = DataParallelTrainer(
+        _mlp(), mesh=mesh, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.5, "momentum": 0.9,
+                          "rescale_grad": 1.0 / 64})
+    trainer.init({"data": (64, 16), "softmax_label": (64,)})
+    rng = np.random.RandomState(0)
+    centers = rng.randn(4, 16) * 3
+    cls = rng.randint(0, 4, 512)
+    X = (centers[cls] + rng.randn(512, 16)).astype("float32")
+    y = cls.astype("float32")
+    for epoch in range(15):
+        for i in range(0, 512, 64):
+            trainer.step({"data": X[i:i + 64],
+                          "softmax_label": y[i:i + 64]})
+    outs = trainer.step({"data": X[:64], "softmax_label": y[:64]})
+    acc = (np.asarray(outs[0]).argmax(axis=1) == y[:64]).mean()
+    assert acc > 0.9, "dp trainer accuracy %f" % acc
+
+
+def test_dp_trainer_tensor_sharding():
+    """2-D mesh: data axis 4, model axis 2 with sharded params."""
+    mesh = make_mesh(shape=(4, 2))
+    trainer = DataParallelTrainer(
+        _mlp(), mesh=mesh, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1},
+        shard_params=True)
+    trainer.init({"data": (16, 16), "softmax_label": (16,)})
+    X = np.random.randn(16, 16).astype("f4")
+    y = np.zeros(16, dtype="f4")
+    outs = trainer.step({"data": X, "softmax_label": y})
+    assert np.asarray(outs[0]).shape == (16, 4)
+
+
+def test_dp_matches_single_device():
+    """Grad math identical to single-executor path after one step."""
+    mesh = make_mesh(shape=(8,))
+    net = _mlp()
+    tr = DataParallelTrainer(net, mesh=mesh, optimizer="sgd",
+                             optimizer_params={"learning_rate": 0.1,
+                                               "rescale_grad": 1.0 / 16})
+    tr.init({"data": (16, 16), "softmax_label": (16,)})
+    # copy initial params into an executor
+    ex = net.simple_bind(ctx=mx.cpu(), data=(16, 16))
+    for name, v in tr.params.items():
+        ex.arg_dict[name]._data = jnp.asarray(np.asarray(v))
+    X = np.random.RandomState(1).randn(16, 16).astype("f4")
+    y = np.zeros(16, dtype="f4")
+    ex.arg_dict["data"][:] = nd.array(X)
+    ex.arg_dict["softmax_label"][:] = nd.array(y)
+    ex.forward(is_train=True)
+    ex.backward()
+    tr.step({"data": X, "softmax_label": y})
+    for name in ("fc1_weight", "fc2_weight"):
+        manual = ex.arg_dict[name].asnumpy() - \
+            0.1 * (1.0 / 16) * ex.grad_dict[name].asnumpy()
+        assert np.allclose(np.asarray(tr.params[name]), manual, atol=1e-4), name
+
+
+def test_blockwise_attention_matches_exact():
+    B, T, H, D = 2, 64, 2, 8
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, T, H, D).astype("f4"))
+    k = jnp.asarray(rng.randn(B, T, H, D).astype("f4"))
+    v = jnp.asarray(rng.randn(B, T, H, D).astype("f4"))
+
+    def exact(q, k, v, causal=False):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+        if causal:
+            mask = np.tril(np.ones((T, T), bool))
+            s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    out = blockwise_attention(q, k, v, block_size=16)
+    assert np.allclose(np.asarray(out), np.asarray(exact(q, k, v)), atol=1e-4)
+    out_c = blockwise_attention(q, k, v, block_size=16, causal=True)
+    assert np.allclose(np.asarray(out_c),
+                       np.asarray(exact(q, k, v, causal=True)), atol=1e-4)
+
+
+def test_ring_attention_matches_exact():
+    mesh = make_mesh(shape=(1, 8), axis_names=("data", "seq"))
+    B, T, H, D = 2, 64, 2, 8
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, T, H, D).astype("f4"))
+    k = jnp.asarray(rng.randn(B, T, H, D).astype("f4"))
+    v = jnp.asarray(rng.randn(B, T, H, D).astype("f4"))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    exact = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), v)
+    out = ring_attention(q, k, v, mesh=mesh, axis_name="seq")
+    assert np.allclose(np.asarray(out), np.asarray(exact), atol=1e-4)
+    # causal
+    mask = np.tril(np.ones((T, T), bool))
+    sc = jnp.where(mask[None, None], s, -1e30)
+    exact_c = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(sc, -1), v)
+    out_c = ring_attention(q, k, v, mesh=mesh, axis_name="seq", causal=True)
+    assert np.allclose(np.asarray(out_c), np.asarray(exact_c), atol=1e-4)

@@ -1,0 +1,153 @@
+"""Symbol composition / inference / executor tests (model: reference
+tests/python/unittest/{test_symbol.py,test_executor.py,test_infer_shape.py})."""
+import json
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd, sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_compose_and_listing():
+    net = _mlp()
+    assert net.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                    "fc2_weight", "fc2_bias", "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(8, 10))
+    shapes = dict(zip(net.list_arguments(), arg_shapes))
+    assert shapes["fc1_weight"] == (16, 10)
+    assert shapes["fc1_bias"] == (16,)
+    assert shapes["fc2_weight"] == (4, 16)
+    assert out_shapes == [(8, 4)]
+
+
+def test_infer_shape_partial():
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data, num_hidden=4, name="fc")
+    arg_shapes, out_shapes, _ = out.infer_shape_partial()
+    assert out_shapes is not None or arg_shapes is not None
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    parsed = json.loads(js)
+    assert "nodes" in parsed and "heads" in parsed
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    # still executable after roundtrip
+    ex = net2.simple_bind(ctx=mx.cpu(), data=(2, 5))
+    ex.forward()
+
+
+def test_get_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    outs = internals.list_outputs()
+    assert "fc1_output" in outs
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_symbol_arithmetic():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a + b) * 2 - a / b
+    ex = c.bind(mx.cpu(), {"a": nd.array(np.array([2.0, 4])),
+                           "b": nd.array(np.array([1.0, 2]))})
+    out = ex.forward()[0].asnumpy()
+    assert np.allclose(out, [(2 + 1) * 2 - 2, (4 + 2) * 2 - 2])
+
+
+def test_bind_forward_backward():
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(4, 6))
+    for name in ("fc1_weight", "fc2_weight"):
+        ex.arg_dict[name][:] = nd.array(
+            np.random.randn(*ex.arg_dict[name].shape).astype("f4") * 0.1)
+    ex.arg_dict["data"][:] = nd.array(np.random.randn(4, 6).astype("f4"))
+    ex.arg_dict["softmax_label"][:] = nd.array(np.array([0., 1, 2, 3]))
+    out = ex.forward(is_train=True)[0]
+    assert out.shape == (4, 4)
+    assert np.allclose(out.asnumpy().sum(axis=1), 1, atol=1e-5)
+    ex.backward()
+    assert float(np.abs(ex.grad_dict["fc1_weight"].asnumpy()).sum()) > 0
+
+
+def test_grad_req_add_and_null():
+    a = sym.Variable("a")
+    out = sym.sum(a * a)
+    ga = nd.zeros((3,))
+    ex = out.bind(mx.cpu(), {"a": nd.array(np.array([1.0, 2, 3]))},
+                  args_grad={"a": ga}, grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward()
+    ex.forward(is_train=True)
+    ex.backward()
+    assert np.allclose(ga.asnumpy(), 2 * np.array([1.0, 2, 3]) * 2)
+
+
+def test_executor_reshape():
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(4, 6))
+    ex2 = ex.reshape(data=(8, 6), softmax_label=(8,))
+    ex2.forward()
+    assert ex2.outputs[0].shape == (8, 4)
+    # params shared
+    assert ex2.arg_dict["fc1_weight"] is ex.arg_dict["fc1_weight"]
+
+
+def test_shared_exec_memory():
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(4, 6))
+    ex2 = net.simple_bind(ctx=mx.cpu(), data=(2, 6), shared_exec=ex)
+    assert ex2.arg_dict["fc1_weight"] is ex.arg_dict["fc1_weight"]
+    assert ex2.arg_dict["data"] is not ex.arg_dict["data"]
+
+
+def test_multi_output_symbol():
+    a = sym.Variable("a")
+    s = sym.SliceChannel(a, num_outputs=3, axis=1, name="sc")
+    assert len(s.list_outputs()) == 3
+    ex = s.bind(mx.cpu(), {"a": nd.array(np.arange(6).reshape(2, 3)
+                                         .astype("f4"))})
+    outs = ex.forward()
+    assert len(outs) == 3
+    assert outs[0].shape == (2, 1)
+    g = sym.Group([s[0], s[2]])
+    assert len(g.list_outputs()) == 2
+
+
+def test_eval_api():
+    a = sym.Variable("a")
+    out = (a * 2).eval(ctx=mx.cpu(), a=nd.ones((2, 2)))
+    assert np.allclose(out[0].asnumpy(), 2)
+
+
+def test_save_load_file(tmp_path):
+    net = _mlp()
+    path = str(tmp_path / "net.json")
+    net.save(path)
+    net2 = sym.load(path)
+    assert net2.list_arguments() == net.list_arguments()
+
+
+def test_attr_and_name():
+    a = sym.Variable("a", lr_mult=2.0)
+    assert a.attr("__lr_mult__") == "2.0"
+    fc = sym.FullyConnected(a, num_hidden=3, name="myfc")
+    assert fc.name == "myfc"
