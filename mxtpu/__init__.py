@@ -40,6 +40,7 @@ from . import monitor
 from . import model
 from . import module
 from . import module as mod
+from . import rnn
 from . import gluon
 from . import models
 from . import visualization

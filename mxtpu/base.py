@@ -122,3 +122,24 @@ def get_logger(name="mxtpu"):
     # deliberately no basicConfig() here: the library must not hijack the
     # application's logging setup
     return logging.getLogger(name)
+
+
+class PrefixOpNamespace:
+    """Sub-namespace over a module exposing prefix-registered ops, e.g.
+    nd.contrib.MultiBoxPrior -> module attr '_contrib_MultiBoxPrior'
+    (parity: the reference's _init_op_module sub-namespaces, base.py:_init_op_module)."""
+
+    def __init__(self, module, prefix):
+        self._module = module
+        self._prefix = prefix
+
+    def __getattr__(self, name):
+        full = self._prefix + name
+        if hasattr(self._module, full):
+            return getattr(self._module, full)
+        raise AttributeError("%s%s" % (self._prefix, name))
+
+    def __dir__(self):
+        n = len(self._prefix)
+        return [k[n:] for k in dir(self._module)
+                if k.startswith(self._prefix)]

@@ -190,14 +190,18 @@ class Executor:
                 self.arg_dict[k]._data = v._data if isinstance(v, NDArray) \
                     else jnp.asarray(v)
         rng = _rnd.next_key()
+        raw_args, raw_aux = self._raw_args(), self._raw_aux()
+        # remember the forward's exact inputs + rng so a later
+        # backward(out_grads) replays the SAME computation (same dropout
+        # masks, pre-update aux) instead of a fresh stochastic forward
+        self._fwd_snapshot = (raw_args, raw_aux, rng)
         want_grad = bool(self._grad_arg_names())
         if is_train and want_grad:
-            outs, auxu, grads = self._get_fn("fwd_bwd")(
-                self._raw_args(), self._raw_aux(), rng)
+            outs, auxu, grads = self._get_fn("fwd_bwd")(raw_args, raw_aux, rng)
             self._pending_grads = grads
         else:
             kind = "fwd_train" if is_train else "fwd_eval"
-            outs, auxu = self._get_fn(kind)(self._raw_args(), self._raw_aux(), rng)
+            outs, auxu = self._get_fn(kind)(raw_args, raw_aux, rng)
             self._pending_grads = None
         if is_train:
             self._apply_aux(auxu)
@@ -217,10 +221,16 @@ class Executor:
         else:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
-            rng = _rnd.next_key()
-            outs, auxu, grads = self._get_fn("fwd_bwd_heads")(
-                self._raw_args(), self._raw_aux(), rng,
-                [g._data for g in out_grads])
+            snap = getattr(self, "_fwd_snapshot", None)
+            if snap is not None:
+                raw_args, raw_aux, rng = snap
+            else:
+                raw_args, raw_aux, rng = (self._raw_args(), self._raw_aux(),
+                                          _rnd.next_key())
+            outs, _auxu, grads = self._get_fn("fwd_bwd_heads")(
+                raw_args, raw_aux, rng, [g._data for g in out_grads])
+            # aux updates were already applied by the matching forward;
+            # replaying here must not double-apply them
             self._wrap_outputs(outs)
         for n, g in grads.items():
             req = self.grad_req.get(n, "null")

@@ -85,9 +85,11 @@ class Dense(HybridBlock):
     def hybrid_forward(self, F, x, weight, bias=None):
         if bias is None:
             act = F.FullyConnected(x, weight, no_bias=True,
-                                   num_hidden=self._units)
+                                   num_hidden=self._units,
+                                   flatten=self._flatten)
         else:
-            act = F.FullyConnected(x, weight, bias, num_hidden=self._units)
+            act = F.FullyConnected(x, weight, bias, num_hidden=self._units,
+                                   flatten=self._flatten)
         if self.act is not None:
             act = self.act(act)
         return act

@@ -86,9 +86,12 @@ class Parameter:
 
     def _load_init(self, data, ctx):
         if self.shape and any(s != 0 for s in self.shape):
-            assert tuple(data.shape) == tuple(self.shape), \
+            # 0 dims are deferred-init wildcards: only compare known dims
+            assert len(data.shape) == len(self.shape) and all(
+                s in (0, d) for s, d in zip(self.shape, data.shape)), \
                 "Failed loading Parameter %s: shape %s vs saved %s" % (
                     self.name, self.shape, data.shape)
+            self.shape = tuple(data.shape)
         else:
             self.shape = data.shape
         if self._data is None:

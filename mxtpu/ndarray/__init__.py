@@ -38,8 +38,8 @@ def _make_nd_fn(opname, op):
         for k in nd_kw:
             kwargs.pop(k)
         if op.variadic:
-            if len(pos) == 1 and isinstance(args[0], (list, tuple)):
-                pos = list(args[0])
+            if len(args) >= 1 and isinstance(args[0], (list, tuple)):
+                pos = list(args[0]) + pos
             kwargs.setdefault(op.variadic, len(pos))
             inputs = pos
         else:
@@ -89,3 +89,9 @@ class _InternalNS:
 
 
 _internal = _InternalNS()
+
+
+from ..base import PrefixOpNamespace as _PrefixNS  # noqa: E402
+
+contrib = _PrefixNS(_mod, "_contrib_")
+linalg = _PrefixNS(_mod, "_linalg_")

@@ -182,7 +182,13 @@ class NDArray:
             yield self[i]
 
     def __bool__(self):
-        return bool(self.size > 0)
+        # Scalar arrays truth-test by value; multi-element arrays are
+        # ambiguous (parity with the reference / numpy, which raise).
+        if self.size == 1:
+            return bool(self.asnumpy().reshape(())[()])
+        raise ValueError(
+            "The truth value of an NDArray with %d elements is ambiguous; "
+            "use asnumpy() with .any()/.all()" % self.size)
 
     # ------------------------------------------------ arithmetic
     def _binop(self, other, op, scalar_op, reverse=False):

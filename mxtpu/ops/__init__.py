@@ -7,4 +7,6 @@ from . import nn  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import linalg  # noqa: F401
+from . import rnn  # noqa: F401
+from . import contrib  # noqa: F401
 from .registry import OpDef, get_op, list_ops, op_exists, register  # noqa: F401

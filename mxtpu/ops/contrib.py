@@ -1,0 +1,368 @@
+"""Contrib operators: SSD multibox trio + NMS, quantization, fft, count_sketch.
+
+TPU-native equivalents of src/operator/contrib/ (multibox_prior.cc,
+multibox_target.cc, multibox_detection.cc, quantize.cc, dequantize.cc,
+fft.cc, count_sketch.cc). The reference implements these as hand-written
+CPU/CUDA kernels; here each is expressed over jax arrays with static shapes —
+anchor generation is pure broadcasting, target matching is an IOU matrix +
+argmax/sort, and NMS is a sequential suppression scan (lax.scan) over
+score-sorted candidates, all of which XLA fuses into a few kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+from jax import lax
+
+from .registry import Required, register
+
+# ------------------------------------------------------------------ box utils
+
+
+def _box_iou_corner(a, b):
+    """IOU between two corner-format box sets: a (A,4), b (B,4) -> (A,B)."""
+    ax1, ay1, ax2, ay2 = jnp.split(a, 4, axis=-1)  # (A,1)
+    bx1, by1, bx2, by2 = [v[:, 0] for v in jnp.split(b, 4, axis=-1)]  # (B,)
+    ix1 = jnp.maximum(ax1, bx1)
+    iy1 = jnp.maximum(ay1, by1)
+    ix2 = jnp.minimum(ax2, bx2)
+    iy2 = jnp.minimum(ay2, by2)
+    iw = jnp.maximum(ix2 - ix1, 0)
+    ih = jnp.maximum(iy2 - iy1, 0)
+    inter = iw * ih
+    area_a = jnp.maximum(ax2 - ax1, 0) * jnp.maximum(ay2 - ay1, 0)
+    area_b = jnp.maximum(bx2 - bx1, 0) * jnp.maximum(by2 - by1, 0)
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+# ------------------------------------------------------------ MultiBoxPrior
+
+
+def _multibox_prior(a, data):
+    """Generate SSD anchor boxes for one feature map.
+
+    data: (N, C, H, W). Output (1, H*W*num_anchors, 4) corner boxes in
+    [0,1] coords; num_anchors = len(sizes) + len(ratios) - 1
+    (reference src/operator/contrib/multibox_prior-inl.h).
+    """
+    _, _, H, W = data.shape
+    sizes = [float(s) for s in a.sizes]
+    ratios = [float(r) for r in a.ratios]
+    steps = a.steps
+    offsets = a.offsets
+    step_y = float(steps[0]) if steps and float(steps[0]) > 0 else 1.0 / H
+    step_x = float(steps[1]) if steps and float(steps[1]) > 0 else 1.0 / W
+    off_y, off_x = float(offsets[0]), float(offsets[1])
+
+    cy = (jnp.arange(H, dtype=jnp.float32) + off_y) * step_y  # (H,)
+    cx = (jnp.arange(W, dtype=jnp.float32) + off_x) * step_x  # (W,)
+    cxg, cyg = jnp.meshgrid(cx, cy)  # (H,W)
+
+    wh = []
+    for s in sizes:  # (size_i, ratios[0])
+        r = ratios[0]
+        wh.append((s * _np.sqrt(r) / 2, s / _np.sqrt(r) / 2))
+    for r in ratios[1:]:  # (sizes[0], ratio_j)
+        wh.append((sizes[0] * _np.sqrt(r) / 2, sizes[0] / _np.sqrt(r) / 2))
+    wh = jnp.asarray(wh, dtype=jnp.float32)  # (K, 2) half-extents
+
+    cxg = cxg[..., None]  # (H,W,1)
+    cyg = cyg[..., None]
+    hw_, hh_ = wh[:, 0], wh[:, 1]  # (K,)
+    boxes = jnp.stack([cxg - hw_, cyg - hh_, cxg + hw_, cyg + hh_],
+                      axis=-1)  # (H,W,K,4)
+    boxes = boxes.reshape(1, -1, 4)
+    if a.clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+register("_contrib_MultiBoxPrior", _multibox_prior,
+         attrs={"sizes": (1.0,), "ratios": (1.0,), "clip": False,
+                "steps": (-1.0, -1.0), "offsets": (0.5, 0.5)},
+         aliases=("MultiBoxPrior",))
+
+
+# ------------------------------------------------------------ MultiBoxTarget
+
+
+def _encode_loc(anchors, gt, variances):
+    """Corner anchors (A,4) + matched GT corners (A,4) -> loc targets (A,4)."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    gw = jnp.maximum(gt[:, 2] - gt[:, 0], 1e-8)
+    gh = jnp.maximum(gt[:, 3] - gt[:, 1], 1e-8)
+    gcx = (gt[:, 0] + gt[:, 2]) / 2
+    gcy = (gt[:, 1] + gt[:, 3]) / 2
+    v0, v1, v2, v3 = [float(v) for v in variances]
+    tx = (gcx - acx) / jnp.maximum(aw, 1e-8) / v0
+    ty = (gcy - acy) / jnp.maximum(ah, 1e-8) / v1
+    tw = jnp.log(gw / jnp.maximum(aw, 1e-8)) / v2
+    th = jnp.log(gh / jnp.maximum(ah, 1e-8)) / v3
+    return jnp.stack([tx, ty, tw, th], axis=-1)
+
+
+def _multibox_target_one(anchors, label, cls_pred, a):
+    """One sample. anchors (A,4), label (G,5) [cls,x1,y1,x2,y2] (cls=-1 pad),
+    cls_pred (num_cls+1, A). Returns loc_target (A*4,), loc_mask (A*4,),
+    cls_target (A,)."""
+    A = anchors.shape[0]
+    valid_gt = label[:, 0] >= 0  # (G,)
+    iou = _box_iou_corner(anchors, label[:, 1:5])  # (A,G)
+    iou = jnp.where(valid_gt[None, :], iou, -1.0)
+
+    # step 1: each valid GT claims its best anchor (bipartite-greedy in the
+    # reference; here one-shot argmax per GT — ties/conflicts resolved by
+    # later GT winning, which matches the reference for disjoint objects)
+    best_anchor_per_gt = jnp.argmax(iou, axis=0)  # (G,)
+    # step 2: each anchor takes its best GT if IOU > threshold
+    best_gt_per_anchor = jnp.argmax(iou, axis=1)  # (A,)
+    best_iou_per_anchor = jnp.max(iou, axis=1)  # (A,)
+    matched = best_iou_per_anchor > float(a.overlap_threshold)  # (A,)
+    match_gt = best_gt_per_anchor
+
+    # force-match the per-GT best anchors
+    G = label.shape[0]
+    forced = jnp.zeros((A,), dtype=bool)
+    forced_gt = jnp.zeros((A,), dtype=jnp.int32)
+
+    def body(g, carry):
+        forced, forced_gt = carry
+        anc = best_anchor_per_gt[g]
+        use = valid_gt[g]
+        forced = forced.at[anc].set(jnp.where(use, True, forced[anc]))
+        forced_gt = forced_gt.at[anc].set(
+            jnp.where(use, g, forced_gt[anc]).astype(jnp.int32))
+        return forced, forced_gt
+
+    forced, forced_gt = lax.fori_loop(0, G, body, (forced, forced_gt))
+    matched = matched | forced
+    match_gt = jnp.where(forced, forced_gt, match_gt)
+
+    gt_cls = label[:, 0].astype(jnp.int32)  # (G,)
+    cls_target = jnp.where(matched, gt_cls[match_gt] + 1, 0)  # 0 = background
+
+    # negative mining: keep top (ratio * num_pos) negatives by max non-bg
+    # score, mark the rest ignore_label
+    ratio = float(a.negative_mining_ratio)
+    if ratio > 0:
+        num_pos = jnp.sum(matched)
+        max_neg = jnp.maximum(ratio * num_pos,
+                              int(a.minimum_negative_samples))
+        # hardness score = max non-background prediction (multibox_target.cc);
+        # anchors overlapping a GT above negative_mining_thresh are excluded
+        # from the negative pool even though they fell short of
+        # overlap_threshold (multibox_target.cc:215)
+        neg_score = jnp.max(cls_pred[1:, :], axis=0)  # (A,)
+        ineligible = matched | (best_iou_per_anchor >=
+                                float(a.negative_mining_thresh))
+        neg_score = jnp.where(ineligible, -jnp.inf, neg_score)
+        order = jnp.argsort(-neg_score)  # hardest first
+        rank = jnp.zeros((A,), jnp.int32).at[order].set(jnp.arange(A,
+                                                        dtype=jnp.int32))
+        keep_neg = (~matched) & (rank < max_neg)
+        ignore = (~matched) & (~keep_neg)
+        cls_target = jnp.where(ignore, int(a.ignore_label), cls_target)
+
+    gt_boxes = label[:, 1:5][match_gt]  # (A,4)
+    loc_t = _encode_loc(anchors, gt_boxes, a.variances)  # (A,4)
+    loc_t = jnp.where(matched[:, None], loc_t, 0.0)
+    loc_mask = jnp.where(matched[:, None],
+                         jnp.ones_like(loc_t), jnp.zeros_like(loc_t))
+    return (loc_t.reshape(-1), loc_mask.reshape(-1),
+            cls_target.astype(anchors.dtype))
+
+
+def _multibox_target(a, anchor, label, cls_pred):
+    anchors = anchor[0]  # (A,4)
+    loc_t, loc_m, cls_t = jax.vmap(
+        lambda lb, cp: _multibox_target_one(anchors, lb, cp, a))(label,
+                                                                 cls_pred)
+    return loc_t, loc_m, cls_t
+
+
+register("_contrib_MultiBoxTarget", _multibox_target,
+         arg_names=["anchor", "label", "cls_pred"],
+         attrs={"overlap_threshold": 0.5, "ignore_label": -1.0,
+                "negative_mining_ratio": -1.0,
+                "negative_mining_thresh": 0.5,
+                "minimum_negative_samples": 0,
+                "variances": (0.1, 0.1, 0.2, 0.2)},
+         num_outputs=3, aliases=("MultiBoxTarget",))
+
+
+# --------------------------------------------------------- MultiBoxDetection
+
+
+def _decode_loc(anchors, loc, variances):
+    v0, v1, v2, v3 = [float(v) for v in variances]
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    cx = loc[:, 0] * v0 * aw + acx
+    cy = loc[:, 1] * v1 * ah + acy
+    w = jnp.exp(loc[:, 2] * v2) * aw / 2
+    h = jnp.exp(loc[:, 3] * v3) * ah / 2
+    return jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=-1)
+
+
+def _nms_scan(boxes, scores, cls_id, thresh, force_suppress):
+    """Sequential NMS over score-sorted candidates via lax.scan.
+
+    Returns keep mask aligned with the (sorted) input order.
+    """
+    K = boxes.shape[0]
+    iou = _box_iou_corner(boxes, boxes)  # (K,K)
+    same_cls = (cls_id[:, None] == cls_id[None, :]) | force_suppress
+    suppress_pair = (iou > thresh) & same_cls  # j suppressed by i
+
+    def step(alive, i):
+        # candidate i survives iff still alive; if it survives it kills
+        # its overlapping lower-scored neighbours
+        keep_i = alive[i]
+        alive = alive & ~(suppress_pair[i] & keep_i &
+                          (jnp.arange(K) > i))
+        return alive, keep_i
+
+    alive0 = scores > -jnp.inf
+    _, keep = lax.scan(step, alive0, jnp.arange(K))
+    return keep
+
+
+def _multibox_detection_one(cls_prob, loc_pred, anchors, a):
+    """cls_prob (num_cls+1, A), loc_pred (A*4,), anchors (A,4) ->
+    (A, 6) rows [cls_id, score, x1, y1, x2, y2], invalid rows cls_id=-1."""
+    A = anchors.shape[0]
+    boxes = _decode_loc(anchors, loc_pred.reshape(A, 4), a.variances)
+    if a.clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    # per-anchor best foreground class
+    fg = cls_prob[1:, :]  # (C, A)
+    cls_id = jnp.argmax(fg, axis=0).astype(jnp.float32)  # (A,)
+    score = jnp.max(fg, axis=0)
+    valid = score > float(a.threshold)
+    score = jnp.where(valid, score, -jnp.inf)
+
+    order = jnp.argsort(-score)
+    nms_topk = int(a.nms_topk)
+    if nms_topk > 0 and nms_topk < A:
+        order = order[:nms_topk]
+    sb = boxes[order]
+    ss = score[order]
+    sc = cls_id[order]
+    keep = _nms_scan(sb, ss, sc, float(a.nms_threshold),
+                     bool(a.force_suppress))
+    out_cls = jnp.where(keep & (ss > -jnp.inf), sc, -1.0)
+    out_score = jnp.where(keep, ss, 0.0)
+    out_score = jnp.where(jnp.isfinite(out_score), out_score, 0.0)
+    out = jnp.concatenate([out_cls[:, None], out_score[:, None], sb],
+                          axis=-1)
+    if out.shape[0] < A:  # pad back to A rows
+        pad = jnp.full((A - out.shape[0], 6), -1.0, out.dtype)
+        pad = pad.at[:, 1:].set(0.0)
+        out = jnp.concatenate([out, pad], axis=0)
+    return out
+
+
+def _multibox_detection(a, cls_prob, loc_pred, anchor):
+    anchors = anchor[0]
+    return jax.vmap(
+        lambda cp, lp: _multibox_detection_one(cp, lp, anchors, a))(
+            cls_prob, loc_pred)
+
+
+register("_contrib_MultiBoxDetection", _multibox_detection,
+         arg_names=["cls_prob", "loc_pred", "anchor"],
+         attrs={"clip": True, "threshold": 0.01, "background_id": 0,
+                "nms_threshold": 0.5, "force_suppress": False,
+                "variances": (0.1, 0.1, 0.2, 0.2), "nms_topk": -1},
+         aliases=("MultiBoxDetection",))
+
+
+def _mbt_infer(a, shapes):
+    return shapes
+
+
+def _mbd_infer(a, shapes):
+    return shapes
+
+
+# ------------------------------------------------------------- quantization
+
+
+def _quantize(a, data, min_range, max_range):
+    """float -> uint8 affine quantization (contrib/quantize.cc)."""
+    mn = min_range.reshape(())
+    mx = max_range.reshape(())
+    scale = 255.0 / jnp.maximum(mx - mn, 1e-8)
+    q = jnp.clip(jnp.round((data - mn) * scale), 0, 255).astype(jnp.uint8)
+    return q, mn.reshape(1), mx.reshape(1)
+
+
+register("_contrib_quantize", _quantize,
+         arg_names=["data", "min_range", "max_range"],
+         attrs={"out_type": "uint8"}, num_outputs=3)
+
+
+def _dequantize(a, data, min_range, max_range):
+    mn = min_range.reshape(())
+    mx = max_range.reshape(())
+    scale = jnp.maximum(mx - mn, 1e-8) / 255.0
+    return data.astype(jnp.float32) * scale + mn
+
+
+register("_contrib_dequantize", _dequantize,
+         arg_names=["data", "min_range", "max_range"],
+         attrs={"out_type": "float32"})
+
+
+# ---------------------------------------------------------------------- fft
+
+
+def _fft(a, data):
+    """Real->complex FFT packed as interleaved re/im on the last axis
+    (contrib/fft.cc semantics: output last dim = 2*input last dim)."""
+    f = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    out = jnp.stack([f.real, f.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)) \
+        .astype(jnp.float32)
+
+
+register("_contrib_fft", _fft, attrs={"compute_size": 128})
+
+
+def _ifft(a, data):
+    """Interleaved re/im -> real inverse FFT (contrib/ifft.cc)."""
+    n = data.shape[-1] // 2
+    c = data.reshape(data.shape[:-1] + (n, 2))
+    comp = c[..., 0] + 1j * c[..., 1]
+    out = jnp.fft.ifft(comp, axis=-1)
+    # reference returns unnormalized ifft * n; jnp.fft.ifft normalizes by n
+    return (out.real * n).astype(jnp.float32)
+
+
+register("_contrib_ifft", _ifft, attrs={"compute_size": 128})
+
+
+# -------------------------------------------------------------- count_sketch
+
+
+def _count_sketch(a, data, h, s):
+    """Count-sketch projection to out_dim (contrib/count_sketch.cc):
+    out[n, h[i]] += s[i] * data[n, i]."""
+    out_dim = int(a.out_dim)
+    idx = h.reshape(-1).astype(jnp.int32)
+    sign = s.reshape(-1)
+    contrib = data * sign[None, :]
+    out = jnp.zeros(data.shape[:-1] + (out_dim,), data.dtype)
+    return out.at[..., idx].add(contrib)
+
+
+register("_contrib_count_sketch", _count_sketch,
+         arg_names=["data", "h", "s"],
+         attrs={"out_dim": Required(int), "processing_batch_size": 32})

@@ -24,7 +24,10 @@ from .registry import Required, register
 
 
 def _fully_connected(a, data, weight, bias=None):
-    x = data.reshape(data.shape[0], -1)
+    if a.get("flatten", True):
+        x = data.reshape(data.shape[0], -1)
+    else:
+        x = data  # apply along the last axis (Gluon Dense flatten=False)
     out = jnp.dot(x, weight.T)
     if bias is not None:
         out = out + bias
@@ -34,7 +37,8 @@ def _fully_connected(a, data, weight, bias=None):
 register("FullyConnected", _fully_connected,
          arg_names=lambda a: ["data", "weight"] if a.get("no_bias") else
          ["data", "weight", "bias"],
-         attrs={"num_hidden": Required(int), "no_bias": False})
+         attrs={"num_hidden": Required(int), "no_bias": False,
+                "flatten": True})
 
 # ---------------------------------------------------------------- Convolution
 
@@ -592,7 +596,7 @@ def _prod(xs):
 
 def _fc_infer(a, shapes):
     data = shapes[0]
-    d = _prod(data[1:])
+    d = data[-1] if not a.get("flatten", True) else _prod(data[1:])
     out = [data, (int(a.num_hidden), d)]
     if not a.no_bias:
         out.append((int(a.num_hidden),))

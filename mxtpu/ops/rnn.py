@@ -1,0 +1,275 @@
+"""Fused RNN operator lowered to an XLA while-loop (lax.scan).
+
+TPU-native replacement for the reference's RNN op (src/operator/rnn-inl.h:124),
+which on GPU wraps cuDNN (src/operator/cudnn_rnn-inl.h) and on CPU is
+unimplemented (src/operator/rnn.cc:32 LOG(FATAL)). Here there is ONE
+implementation for all backends: per-timestep cell math expressed over jax
+arrays, scanned over the sequence axis with ``lax.scan`` so XLA compiles it
+into a single fused while loop whose body is MXU matmuls. Layers (and the two
+directions of a bidirectional net) are unrolled in Python — ``num_layers`` is
+a static attribute — so each layer's weights stay as separate large matmuls
+that tile well onto the MXU.
+
+Weight layout (our own, documented — the reference inherits cuDNN's opaque
+filter blob): the ``parameters`` input is a flat vector packed as, for each
+layer ``l`` in 0..num_layers-1, for each direction ``d`` (forward, then
+backward when bidirectional):
+
+    Wx[l,d]  shape (G*H, I_l)   input->hidden weight
+    Wh[l,d]  shape (G*H, H)     hidden->hidden weight
+    bx[l,d]  shape (G*H,)       input bias
+    bh[l,d]  shape (G*H,)       hidden bias
+
+concatenated flat in that order, where ``H = state_size``, ``I_0`` is the
+input feature size, ``I_l = H * num_directions`` for l > 0, and G is the gate
+count (1 for rnn_relu/rnn_tanh, 4 for lstm in gate order i,f,g,o, 3 for gru
+in gate order r,z,n). ``rnn_pack_weights`` / ``rnn_unpack_weights`` convert
+between this blob and per-gate dicts (parity with FusedRNNCell.unpack_weights,
+python/mxnet/rnn/rnn_cell.py:620).
+
+Data layout matches the reference: data is (seq_len, batch, feature) ("TNC"),
+states are (num_layers*num_directions, batch, state_size).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+from jax import lax
+
+from ..base import MXNetError
+from .registry import Required, register
+
+__all__ = ["rnn_param_size", "rnn_pack_weights", "rnn_unpack_weights",
+           "GATE_COUNT", "GATE_NAMES"]
+
+GATE_COUNT = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+GATE_NAMES = {"rnn_relu": [""], "rnn_tanh": [""],
+              "lstm": ["i", "f", "c", "o"], "gru": ["r", "z", "o"]}
+
+
+def _layer_input_size(layer, input_size, state_size, num_directions):
+    return input_size if layer == 0 else state_size * num_directions
+
+
+def _layer_sizes(mode, layer, input_size, state_size, num_directions):
+    """(Wx, Wh, bx, bh) element counts for one (layer, direction)."""
+    gates = GATE_COUNT[mode]
+    i = _layer_input_size(layer, input_size, state_size, num_directions)
+    h = state_size
+    return gates * h * i, gates * h * h, gates * h, gates * h
+
+
+def rnn_param_size(num_layers, input_size, state_size, mode,
+                   bidirectional=False):
+    """Total element count of the flat ``parameters`` vector."""
+    d = 2 if bidirectional else 1
+    total = 0
+    for l in range(num_layers):
+        total += d * sum(_layer_sizes(mode, l, input_size, state_size, d))
+    return total
+
+
+def _unpack(params, num_layers, input_size, state_size, mode, num_directions):
+    """flat vector -> nested [layer][direction] dict of (Wx, Wh, bx, bh)."""
+    gates = GATE_COUNT[mode]
+    h = state_size
+    out = []
+    off = 0
+    for l in range(num_layers):
+        i = _layer_input_size(l, input_size, state_size, num_directions)
+        per_dir = []
+        for _d in range(num_directions):
+            nwx, nwh, nbx, nbh = _layer_sizes(mode, l, input_size, h,
+                                              num_directions)
+            wx = params[off:off + nwx].reshape(gates * h, i); off += nwx
+            wh = params[off:off + nwh].reshape(gates * h, h); off += nwh
+            bx = params[off:off + nbx]; off += nbx
+            bh = params[off:off + nbh]; off += nbh
+            per_dir.append((wx, wh, bx, bh))
+        out.append(per_dir)
+    return out
+
+
+def rnn_unpack_weights(params, num_layers, input_size, state_size, mode,
+                       bidirectional=False):
+    """Flat blob -> {name: array} with FusedRNNCell-style names like
+    'l0_i2h_i_weight' / 'r0_h2h_f_bias' (l=forward, r=backward direction)."""
+    d = 2 if bidirectional else 1
+    layers = _unpack(_np.asarray(params), num_layers, input_size, state_size,
+                     mode, d)
+    gates, h = GATE_COUNT[mode], state_size
+    names = GATE_NAMES[mode]
+    out = {}
+    for l, per_dir in enumerate(layers):
+        for di, (wx, wh, bx, bh) in enumerate(per_dir):
+            p = ("l%d" if di == 0 else "r%d") % l
+            for g in range(gates):
+                suf = ("_%s" % names[g]) if names[g] else ""
+                out["%s_i2h%s_weight" % (p, suf)] = wx[g * h:(g + 1) * h]
+                out["%s_h2h%s_weight" % (p, suf)] = wh[g * h:(g + 1) * h]
+                out["%s_i2h%s_bias" % (p, suf)] = bx[g * h:(g + 1) * h]
+                out["%s_h2h%s_bias" % (p, suf)] = bh[g * h:(g + 1) * h]
+    return out
+
+
+def rnn_pack_weights(weights, num_layers, input_size, state_size, mode,
+                     bidirectional=False, dtype="float32"):
+    """Inverse of rnn_unpack_weights: {name: array} -> flat blob."""
+    d = 2 if bidirectional else 1
+    gates, h = GATE_COUNT[mode], state_size
+    names = GATE_NAMES[mode]
+    parts = []
+    for l in range(num_layers):
+        for di in range(d):
+            p = ("l%d" if di == 0 else "r%d") % l
+            for kind in ("i2h_weight", "h2h_weight", "i2h_bias", "h2h_bias"):
+                rows = []
+                for g in range(gates):
+                    suf = ("_%s" % names[g]) if names[g] else ""
+                    key = "%s_%s%s_%s" % (p, kind.split("_")[0], suf,
+                                          kind.split("_")[1])
+                    rows.append(_np.asarray(weights[key], dtype=dtype))
+                parts.append(_np.concatenate([r.reshape(-1) for r in rows]))
+    return _np.concatenate(parts)
+
+
+def _cell_step(mode, wx, wh, bx, bh, h_size, clip=None):
+    """Return f(x_t, state) -> (new_state, output) for one direction/layer."""
+    if mode == "lstm":
+        def step(carry, x):
+            h, c = carry
+            gates = x @ wx.T + bx + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c2 = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            if clip is not None:
+                c2 = jnp.clip(c2, clip[0], clip[1])
+            h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+            return (h2, c2), h2
+    elif mode == "gru":
+        def step(carry, x):
+            h = carry
+            xg = x @ wx.T + bx
+            hg = h @ wh.T + bh
+            xr, xz, xn = jnp.split(xg, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h2 = (1 - z) * n + z * h
+            return h2, h2
+    else:
+        act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+
+        def step(carry, x):
+            h = carry
+            h2 = act(x @ wx.T + bx + h @ wh.T + bh)
+            return h2, h2
+    return step
+
+
+def _run_direction(mode, x, h0, c0, wx, wh, bx, bh, reverse, clip=None):
+    """Scan one direction over time. x: (T,N,I). Returns (out (T,N,H), hT, cT)."""
+    step = _cell_step(mode, wx, wh, bx, bh, h0.shape[-1], clip=clip)
+    carry0 = (h0, c0) if mode == "lstm" else h0
+    # reverse=True scans t=T-1..0 but stacks outputs aligned with input
+    # order (out[t] is the state after consuming x[T-1..t]).
+    carry, out = lax.scan(step, carry0, x, reverse=reverse)
+    if mode == "lstm":
+        hT, cT = carry
+    else:
+        hT, cT = carry, None
+    return out, hT, cT
+
+
+def _rnn(a, rng, data, parameters, state, state_cell=None):
+    mode = a.mode
+    if mode not in GATE_COUNT:
+        raise MXNetError("RNN: unknown mode '%s'" % mode)
+    num_layers = int(a.num_layers)
+    h_size = int(a.state_size)
+    d = 2 if a.bidirectional else 1
+    T, N, input_size = data.shape
+    dt = data.dtype
+    layers = _unpack(parameters.astype(dt), num_layers, input_size, h_size,
+                     mode, d)
+    p = float(a.p)
+    training = bool(a.get("__is_train__", False))
+
+    # batch-1 initial states broadcast up front: lax.scan carries must keep
+    # a fixed shape, so the broadcast cannot happen inside the loop body
+    if state.shape[1] != N:
+        state = jnp.broadcast_to(state, (state.shape[0], N, h_size))
+    if state_cell is not None and state_cell.shape[1] != N:
+        state_cell = jnp.broadcast_to(state_cell,
+                                      (state_cell.shape[0], N, h_size))
+    x = data
+    h_outs, c_outs = [], []
+    for l in range(num_layers):
+        if l > 0 and p > 0 and training:
+            rng, sub = jax.random.split(rng)
+            keep = jax.random.bernoulli(sub, 1.0 - p, x.shape)
+            x = jnp.where(keep, x / (1.0 - p), 0).astype(dt)
+        dir_outs = []
+        for di in range(d):
+            wx, wh, bx, bh = [w.astype(dt) for w in layers[l][di]]
+            h0 = state[l * d + di]
+            c0 = state_cell[l * d + di] if mode == "lstm" else None
+            clip = None
+            if (mode == "lstm" and a.get("lstm_state_clip_min") is not None
+                    and a.get("lstm_state_clip_max") is not None):
+                clip = (float(a.lstm_state_clip_min),
+                        float(a.lstm_state_clip_max))
+            out, hT, cT = _run_direction(mode, x, h0, c0, wx, wh, bx, bh,
+                                         reverse=(di == 1), clip=clip)
+            dir_outs.append(out)
+            h_outs.append(hT)
+            if mode == "lstm":
+                c_outs.append(cT)
+        x = dir_outs[0] if d == 1 else jnp.concatenate(dir_outs, axis=-1)
+
+    outputs = [x]
+    if a.state_outputs:
+        outputs.append(jnp.stack(h_outs, axis=0))
+        if mode == "lstm":
+            outputs.append(jnp.stack(c_outs, axis=0))
+    return tuple(outputs)
+
+
+def _rnn_args(a):
+    base = ["data", "parameters", "state"]
+    if a.get("mode") == "lstm":
+        base.append("state_cell")
+    return base
+
+
+def _rnn_nout(a):
+    if not a.get("state_outputs"):
+        return 1
+    return 3 if a.get("mode") == "lstm" else 2
+
+
+def _rnn_infer(a, shapes):
+    """Fill parameters/state shapes from the data shape (the reference's
+    bidirectional InferShape; rnn-inl.h ListArguments)."""
+    data = shapes[0]
+    if data is None:
+        return shapes
+    T, N, input_size = data
+    h = int(a.state_size)
+    d = 2 if a.bidirectional else 1
+    L = int(a.num_layers)
+    psize = rnn_param_size(L, input_size, h, a.mode, a.bidirectional)
+    out = [data, (psize,), (L * d, N, h)]
+    if a.mode == "lstm":
+        out.append((L * d, N, h))
+    return out
+
+
+register("RNN", _rnn, arg_names=_rnn_args,
+         attrs={"state_size": Required(int), "num_layers": Required(int),
+                "bidirectional": False, "mode": Required(str), "p": 0.0,
+                "state_outputs": False, "lstm_state_clip_min": None,
+                "lstm_state_clip_max": None, "__is_train__": False},
+         num_outputs=_rnn_nout, needs_rng=True, infer_args=_rnn_infer,
+         doc=_rnn.__doc__ or "Fused recurrent layer (lax.scan; TNC layout).")

@@ -159,8 +159,9 @@ class NAG(Optimizer):
             grad = nd.clip(grad, a_min=-self.clip_gradient,
                            a_max=self.clip_gradient)
         if state is not None:
-            state._data = self.momentum * state._data + grad._data + wd * weight._data
-            weight._data = weight._data - lr * (grad._data + self.momentum * state._data)
+            g = grad._data + wd * weight._data
+            state._data = self.momentum * state._data + g
+            weight._data = weight._data - lr * (g + self.momentum * state._data)
         else:
             weight._data = weight._data - lr * (grad._data + wd * weight._data)
 

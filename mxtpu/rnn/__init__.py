@@ -1,0 +1,10 @@
+"""mx.rnn: symbolic recurrent cells, bucketed iterators, RNN checkpoints.
+
+Parity: python/mxnet/rnn/ (rnn_cell.py, io.py, rnn.py)."""
+from .rnn_cell import (BaseRNNCell, BidirectionalCell, DropoutCell,
+                       FusedRNNCell, GRUCell, LSTMCell, ModifierCell,
+                       ResidualCell, RNNCell, RNNParams, SequentialRNNCell,
+                       ZoneoutCell)
+from .io import BucketSentenceIter, encode_sentences
+from .rnn import (do_rnn_checkpoint, load_rnn_checkpoint,
+                  save_rnn_checkpoint)

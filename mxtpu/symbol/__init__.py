@@ -16,8 +16,9 @@ def _make_sym_fn(opname, op):
         name = kwargs.pop("name", None)
         kwargs.pop("attr", None)
         pos = [a for a in args if isinstance(a, Symbol)]
-        if op.variadic and len(pos) == 1 and isinstance(args[0], (list, tuple)):
-            pos = list(args[0])
+        if op.variadic and len(args) >= 1 and isinstance(args[0],
+                                                         (list, tuple)):
+            pos = list(args[0]) + pos
         # non-Symbol positionals map onto attrs in registration order
         if op.variadic:
             extra_pos = [a for a in args
@@ -34,6 +35,8 @@ def _make_sym_fn(opname, op):
         sym_kw = {k: v for k, v in list(kwargs.items()) if isinstance(v, Symbol)}
         for k in sym_kw:
             kwargs.pop(k)
+        if op.variadic and op.variadic not in kwargs:
+            kwargs[op.variadic] = len(pos)  # MXNet fills num_args implicitly
         return create(opname, pos, kwargs, name=name, kwarg_syms=sym_kw)
 
     fn.__name__ = opname
@@ -50,3 +53,9 @@ for _name in list_ops():
 for _pub, _priv in [("uniform", "_random_uniform"), ("normal", "_random_normal"),
                     ("zeros", "_zeros"), ("ones", "_ones")]:
     setattr(_mod, _pub, _make_sym_fn(_priv, get_op(_priv)))
+
+
+from ..base import PrefixOpNamespace as _PrefixNS  # noqa: E402
+
+contrib = _PrefixNS(_mod, "_contrib_")
+linalg = _PrefixNS(_mod, "_linalg_")

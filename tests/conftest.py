@@ -9,3 +9,11 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Some environments eagerly register an accelerator PJRT plugin at
+# interpreter startup (sitecustomize), which overrides JAX_PLATFORMS set
+# here. jax.config.update still wins as long as no backend has been
+# initialized yet, so force it explicitly too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,126 @@
+"""Gluon RNN tests (mirrors tests/python/unittest/test_gluon_rnn.py)."""
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd, gluon
+
+
+def _init(block):
+    block.collect_params().initialize(mx.init.Xavier(), ctx=mx.cpu())
+    return block
+
+
+def test_lstm_layer_shapes():
+    lstm = _init(gluon.rnn.LSTM(10, num_layers=2, bidirectional=True))
+    x = mx.nd.array(np.random.randn(7, 4, 5).astype("float32"))
+    out = lstm(x)
+    assert out.shape == (7, 4, 20)
+    h0 = mx.nd.zeros((4, 4, 10))
+    c0 = mx.nd.zeros((4, 4, 10))
+    out, states = lstm(x, [h0, c0])
+    assert out.shape == (7, 4, 20)
+    assert [s.shape for s in states] == [(4, 4, 10), (4, 4, 10)]
+
+
+def test_gru_rnn_layer_ntc():
+    gru = _init(gluon.rnn.GRU(6, num_layers=1, layout="NTC"))
+    x = mx.nd.array(np.random.randn(3, 5, 4).astype("float32"))
+    out = gru(x)
+    assert out.shape == (3, 5, 6)
+    rnn = _init(gluon.rnn.RNN(6, activation="tanh", layout="NTC"))
+    assert rnn(x).shape == (3, 5, 6)
+
+
+def test_rnn_layer_backward():
+    lstm = _init(gluon.rnn.LSTM(8))
+    x = mx.nd.array(np.random.randn(5, 2, 3).astype("float32"))
+    with autograd.record():
+        out = lstm(x)
+        loss = mx.nd.sum(out * out)
+    loss.backward()
+    g = lstm.collect_params()["%sl0_i2h_weight" % lstm.prefix].grad()
+    assert g.shape == (32, 3)
+    assert float(mx.nd.sum(mx.nd.abs(g)).asnumpy()) > 0
+
+
+def test_layer_matches_cell_unroll():
+    """Fused gluon LSTM layer == LSTMCell.unroll with shared packed weights."""
+    T, N, I, H = 4, 2, 3, 5
+    layer = _init(gluon.rnn.LSTM(H, input_size=I))
+    x = np.random.randn(T, N, I).astype("float32")
+    out_layer = layer(mx.nd.array(x)).asnumpy()
+
+    cell = gluon.rnn.LSTMCell(H, input_size=I)
+    cell.collect_params().initialize(ctx=mx.cpu())
+    p = layer.collect_params()
+    cp = cell.collect_params()
+    cp["%si2h_weight" % cell.prefix].set_data(
+        p["%sl0_i2h_weight" % layer.prefix].data())
+    cp["%sh2h_weight" % cell.prefix].set_data(
+        p["%sl0_h2h_weight" % layer.prefix].data())
+    cp["%si2h_bias" % cell.prefix].set_data(
+        p["%sl0_i2h_bias" % layer.prefix].data())
+    cp["%sh2h_bias" % cell.prefix].set_data(
+        p["%sl0_h2h_bias" % layer.prefix].data())
+    out_cell, _ = cell.unroll(T, mx.nd.array(x), layout="TNC",
+                              merge_outputs=True)
+    assert np.allclose(out_layer, out_cell.asnumpy(), atol=1e-5)
+
+
+def test_gluon_cell_stack_and_modifiers():
+    cell = gluon.rnn.SequentialRNNCell()
+    cell.add(gluon.rnn.LSTMCell(8))
+    cell.add(gluon.rnn.ResidualCell(gluon.rnn.GRUCell(8)))
+    cell.add(gluon.rnn.DropoutCell(0.2))
+    _init(cell)
+    x = mx.nd.array(np.random.randn(4, 3, 6).astype("float32"))
+    outs, states = cell.unroll(3, x, merge_outputs=True)
+    assert outs.shape == (4, 3, 8)
+    assert len(states) == 3
+
+
+def test_gluon_bidirectional_cell():
+    cell = gluon.rnn.BidirectionalCell(gluon.rnn.LSTMCell(4, prefix="l_"),
+                                       gluon.rnn.LSTMCell(4, prefix="r_"))
+    _init(cell)
+    x = mx.nd.array(np.random.randn(2, 3, 5).astype("float32"))
+    outs, states = cell.unroll(3, x, merge_outputs=True)
+    assert outs.shape == (2, 3, 8)
+    assert len(states) == 4
+
+
+def test_gluon_zoneout_cell():
+    cell = gluon.rnn.ZoneoutCell(gluon.rnn.RNNCell(6), 0.3, 0.2)
+    _init(cell)
+    x = mx.nd.array(np.random.randn(2, 4, 3).astype("float32"))
+    outs, _ = cell.unroll(4, x, merge_outputs=True)
+    assert outs.shape == (2, 4, 6)
+
+
+def test_rnn_cell_trains_in_net():
+    """Tiny seq classifier with a gluon LSTM trains under Trainer."""
+    rng = np.random.RandomState(0)
+    net = gluon.nn.HybridSequential()
+    lstm = gluon.rnn.LSTM(16, layout="NTC")
+    dense = gluon.nn.Dense(2)
+    # sequence: class = whether the mean of features is positive
+    X = rng.randn(64, 6, 4).astype("float32")
+    Y = (X.mean(axis=(1, 2)) > 0).astype("float32")
+    params = gluon.ParameterDict()
+    lstm.collect_params().initialize(mx.init.Xavier(), ctx=mx.cpu())
+    dense.collect_params().initialize(mx.init.Xavier(), ctx=mx.cpu())
+    allp = gluon.ParameterDict()
+    allp.update(lstm.collect_params())
+    allp.update(dense.collect_params())
+    trainer = gluon.Trainer(allp, "adam", {"learning_rate": 0.02})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for _ in range(30):
+        with autograd.record():
+            h = lstm(mx.nd.array(X))
+            out = dense(h[:, -1, :])
+            loss = loss_fn(out, mx.nd.array(Y))
+        loss.backward()
+        trainer.step(64)
+        losses.append(float(mx.nd.mean(loss).asnumpy()))
+    assert losses[-1] < losses[0] * 0.85, losses[::10]

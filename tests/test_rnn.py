@@ -1,0 +1,208 @@
+"""RNN stack tests: fused RNN op vs unfused cells, cells API, bucketing
+training (mirrors tests/python/unittest/test_rnn.py + test_bucketing.py)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu.ops.rnn import (rnn_pack_weights, rnn_param_size,
+                           rnn_unpack_weights)
+
+
+def test_rnn_param_size():
+    # lstm: G=4; layer0: 4*H*(I+H) + 8H; layer1 input = H
+    assert rnn_param_size(1, 10, 6, "lstm") == 4 * 6 * (10 + 6) + 8 * 6
+    s1 = rnn_param_size(2, 10, 6, "gru", bidirectional=True)
+    # layer0: 2 dirs * (3*6*(10+6) + 36); layer1 input = 12
+    assert s1 == 2 * (3 * 6 * 16 + 36) + 2 * (3 * 6 * (12 + 6) + 36)
+
+
+def test_rnn_pack_unpack_roundtrip():
+    rng = np.random.RandomState(3)
+    for mode in ("rnn_tanh", "lstm", "gru"):
+        for bi in (False, True):
+            n = rnn_param_size(2, 5, 4, mode, bi)
+            flat = rng.randn(n).astype("float32")
+            w = rnn_unpack_weights(flat, 2, 5, 4, mode, bi)
+            flat2 = rnn_pack_weights(w, 2, 5, 4, mode, bi)
+            assert np.allclose(flat, flat2)
+
+
+def _np_lstm_ref(x, w, h0, c0, H):
+    """Single-layer unidirectional LSTM in numpy (finite oracle)."""
+    T, N, _ = x.shape
+    wx, wh = w["l0_stack_wx"], w["l0_stack_wh"]
+    bx, bh = w["l0_stack_bx"], w["l0_stack_bh"]
+    h, c = h0.copy(), c0.copy()
+    outs = []
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    for t in range(T):
+        g = x[t] @ wx.T + bx + h @ wh.T + bh
+        i, f, gg, o = np.split(g, 4, axis=-1)
+        c = sig(f) * c + sig(i) * np.tanh(gg)
+        h = sig(o) * np.tanh(c)
+        outs.append(h)
+    return np.stack(outs), h, c
+
+
+def test_fused_lstm_matches_numpy():
+    rng = np.random.RandomState(0)
+    T, N, I, H = 4, 3, 5, 6
+    ps = rnn_param_size(1, I, H, "lstm")
+    flat = (rng.randn(ps) * 0.2).astype("float32")
+    x = rng.randn(T, N, I).astype("float32")
+    h0 = rng.randn(1, N, H).astype("float32")
+    c0 = rng.randn(1, N, H).astype("float32")
+
+    out = mx.nd.RNN(mx.nd.array(x), mx.nd.array(flat), mx.nd.array(h0),
+                    mx.nd.array(c0), state_size=H, num_layers=1, mode="lstm",
+                    state_outputs=True)
+    # rebuild stacked weights from the unpacked per-gate dict
+    w = rnn_unpack_weights(flat, 1, I, H, "lstm")
+    wx = np.concatenate([w["l0_i2h_%s_weight" % g] for g in "ifco"])
+    wh = np.concatenate([w["l0_h2h_%s_weight" % g] for g in "ifco"])
+    bx = np.concatenate([w["l0_i2h_%s_bias" % g] for g in "ifco"])
+    bh = np.concatenate([w["l0_h2h_%s_bias" % g] for g in "ifco"])
+    ref_out, ref_h, ref_c = _np_lstm_ref(
+        x, {"l0_stack_wx": wx, "l0_stack_wh": wh, "l0_stack_bx": bx,
+            "l0_stack_bh": bh}, h0[0], c0[0], H)
+    assert np.allclose(out[0].asnumpy(), ref_out, atol=1e-5)
+    assert np.allclose(out[1].asnumpy()[0], ref_h, atol=1e-5)
+    assert np.allclose(out[2].asnumpy()[0], ref_c, atol=1e-5)
+
+
+@pytest.mark.parametrize("mode", ["rnn_relu", "rnn_tanh", "lstm", "gru"])
+def test_fused_matches_unfused(mode):
+    """FusedRNNCell.unroll == its unfuse()d SequentialRNNCell unroll."""
+    rng = np.random.RandomState(1)
+    T, N, I, H, L = 3, 2, 4, 5, 2
+    fused = mx.rnn.FusedRNNCell(H, num_layers=L, mode=mode, prefix="f_")
+    fo, _ = fused.unroll(T, mx.sym.Variable("data"), layout="NTC",
+                         merge_outputs=True)
+    stack = fused.unfuse()
+    uo, _ = stack.unroll(T, mx.sym.Variable("data"), layout="NTC",
+                         merge_outputs=True)
+
+    ps = rnn_param_size(L, I, H, mode)
+    flat = (rng.randn(ps) * 0.3).astype("float32")
+    data = rng.randn(N, T, I).astype("float32")
+
+    fex = fo.simple_bind(mx.cpu(), data=(N, T, I))
+    fex.copy_params_from({"f_parameters": mx.nd.array(flat),
+                          "data": mx.nd.array(data)})
+    fex.forward(data=mx.nd.array(data))
+    fused_out = fex.outputs[0].asnumpy()
+
+    args = {"f_" + k: mx.nd.array(v) for k, v in rnn_unpack_weights(
+        flat, L, I, H, mode).items()}
+    args = stack.pack_weights(args)  # per-gate -> gate-stacked cell params
+    uex = uo.simple_bind(mx.cpu(), data=(N, T, I))
+    uex.copy_params_from(args, allow_extra_params=True)
+    uex.forward(data=mx.nd.array(data))
+    unfused_out = uex.outputs[0].asnumpy()
+    assert np.allclose(fused_out, unfused_out, atol=1e-4), \
+        "%s mismatch %g" % (mode, np.abs(fused_out - unfused_out).max())
+
+
+def test_bidirectional_cell():
+    T, N, I, H = 3, 2, 4, 5
+    cell = mx.rnn.BidirectionalCell(
+        mx.rnn.LSTMCell(H, prefix="l_"), mx.rnn.LSTMCell(H, prefix="r_"))
+    outputs, states = cell.unroll(T, mx.sym.Variable("data"),
+                                  merge_outputs=True)
+    ex = outputs.simple_bind(mx.cpu(), data=(N, T, I))
+    ex.forward(is_train=False)
+    assert ex.outputs[0].shape == (N, T, 2 * H)
+    assert len(states) == 4
+
+
+def test_residual_zoneout_dropout_cells():
+    T, N, I, H = 3, 2, 5, 5
+    cell = mx.rnn.SequentialRNNCell()
+    cell.add(mx.rnn.ResidualCell(mx.rnn.GRUCell(H, prefix="g0_")))
+    cell.add(mx.rnn.DropoutCell(0.3))
+    cell.add(mx.rnn.ZoneoutCell(mx.rnn.RNNCell(H, prefix="r0_"), 0.2, 0.1))
+    outputs, _ = cell.unroll(T, mx.sym.Variable("data"), merge_outputs=True)
+    ex = outputs.simple_bind(mx.cpu(), data=(N, T, I))
+    ex.forward(is_train=True)
+    assert ex.outputs[0].shape == (N, T, H)
+
+
+def test_rnn_grad_flows():
+    T, N, I, H = 4, 2, 3, 5
+    sym = mx.sym.RNN(mx.sym.Variable("data"), mx.sym.Variable("p"),
+                     mx.sym.Variable("s"), state_size=H, num_layers=1,
+                     mode="gru")
+    ex = sym.simple_bind(mx.cpu(), data=(T, N, I))
+    rng = np.random.RandomState(0)
+    for k, v in ex.arg_dict.items():
+        v[:] = mx.nd.array(rng.randn(*v.shape).astype("float32") * 0.2)
+    ex.forward(is_train=True)
+    ex.backward(out_grads=mx.nd.ones(ex.outputs[0].shape))
+    for name in ("data", "p", "s"):
+        g = ex.grad_dict[name].asnumpy()
+        assert np.isfinite(g).all()
+        assert np.abs(g).sum() > 0, "no grad flow to %s" % name
+
+
+def _make_lm_iter(batch_size=16):
+    # learnable structure: ascending token runs (next = prev + 1 mod vocab),
+    # so a trained LM beats the uniform-perplexity floor decisively
+    rng = np.random.RandomState(0)
+    sentences = []
+    for _ in range(300):
+        start = rng.randint(1, 40)
+        ln = rng.randint(3, 15)
+        sentences.append([(start + i - 1) % 39 + 1 for i in range(ln)])
+    return mx.rnn.BucketSentenceIter(sentences, batch_size,
+                                     buckets=[8, 16], invalid_label=0)
+
+
+def _lm_sym_gen(vocab=40, E=16, H=24):
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab, output_dim=E,
+                                 name="embed")
+        stack = mx.rnn.SequentialRNNCell()
+        stack.add(mx.rnn.LSTMCell(num_hidden=H, prefix="lstm_l0_"))
+        outputs, _ = stack.unroll(seq_len, inputs=embed, merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, H))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(pred, label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+    return sym_gen
+
+
+def test_bucketing_lm_trains():
+    """Tiny LSTM LM perplexity drops under training (test_bucketing.py)."""
+    train = _make_lm_iter()
+    mod = mx.mod.BucketingModule(_lm_sym_gen(),
+                                 default_bucket_key=train.default_bucket_key)
+    mod.fit(train, num_epoch=5, eval_metric=mx.metric.Perplexity(0),
+            optimizer="sgd", optimizer_params={"learning_rate": 0.2},
+            initializer=mx.init.Xavier())
+    train.reset()
+    score = mod.score(train, mx.metric.Perplexity(0))
+    ppl = dict(score)["perplexity"]
+    assert np.isfinite(ppl)
+    assert ppl < 15, "perplexity should beat uniform(~39): %g" % ppl
+
+
+def test_bucket_sentence_iter_shapes():
+    it = _make_lm_iter(batch_size=8)
+    seen = set()
+    for batch in it:
+        assert batch.data[0].shape[0] == 8
+        seen.add(batch.bucket_key)
+        assert batch.data[0].shape[1] == batch.bucket_key
+    assert seen == {8, 16}
+    # labels are next-token shifted
+    it.reset()
+    b = next(it)
+    d = b.data[0].asnumpy()
+    lbl = b.label[0].asnumpy()
+    assert np.allclose(d[:, 1:], lbl[:, :-1])
