@@ -48,6 +48,7 @@ from . import visualization as viz
 from . import profiler
 from . import test_utils
 from . import parallel
+from . import operator
 
 from .model import FeedForward
 from .kvstore import create as _kv_create

@@ -441,10 +441,15 @@ def ImageDetRecordIter(**kwargs):
 
 
 @register_iter
-def LibSVMIter(data_libsvm, data_shape, batch_size=128, **kwargs):
-    """LibSVM text reader (parity src/io/iter_libsvm.cc); densifies rows."""
+def LibSVMIter(data_libsvm, data_shape, batch_size=128, dense=False,
+               **kwargs):
+    """LibSVM text reader (parity src/io/iter_libsvm.cc).
+
+    Yields CSR-storage batches like the reference (its output stype is csr,
+    feeding sparse FC / dot); pass ``dense=True`` for densified batches.
+    """
     feat_dim = int(_np.prod(data_shape))
-    rows = []
+    data_vals, data_idx, data_ptr = [], [], [0]
     labels = []
     with open(data_libsvm) as f:
         for line in f:
@@ -452,11 +457,60 @@ def LibSVMIter(data_libsvm, data_shape, batch_size=128, **kwargs):
             if not parts:
                 continue
             labels.append(float(parts[0]))
-            row = _np.zeros(feat_dim, dtype="float32")
             for tok in parts[1:]:
                 k, v = tok.split(":")
-                row[int(k)] = float(v)
-            rows.append(row)
-    data = _np.stack(rows).reshape((-1,) + tuple(data_shape))
-    return NDArrayIter(data, _np.asarray(labels, dtype="float32"),
-                       batch_size=batch_size, last_batch_handle="pad")
+                data_idx.append(int(k))
+                data_vals.append(float(v))
+            data_ptr.append(len(data_idx))
+    n = len(labels)
+    labels = _np.asarray(labels, dtype="float32")
+    if dense:
+        dense_arr = _np.zeros((n, feat_dim), dtype="float32")
+        for r in range(n):
+            lo, hi = data_ptr[r], data_ptr[r + 1]
+            dense_arr[r, data_idx[lo:hi]] = data_vals[lo:hi]
+        return NDArrayIter(dense_arr.reshape((-1,) + tuple(data_shape)),
+                           labels, batch_size=batch_size,
+                           last_batch_handle="pad")
+
+    from .ndarray.sparse import CSRNDArray
+
+    csr = CSRNDArray(_np.asarray(data_vals, dtype="float32"),
+                     _np.asarray(data_idx, dtype=_np.int64),
+                     _np.asarray(data_ptr, dtype=_np.int64), (n, feat_dim))
+
+    class _LibSVMIter(DataIter):
+        def __init__(self):
+            super().__init__(batch_size)
+            self._cursor = 0
+            self.provide_data = [DataDesc("data", (batch_size, feat_dim),
+                                          "float32")]
+            self.provide_label = [DataDesc("label", (batch_size,),
+                                           "float32")]
+
+        def reset(self):
+            self._cursor = 0
+
+        def next(self):
+            if self._cursor >= n:
+                raise StopIteration
+            lo = self._cursor
+            hi = min(lo + batch_size, n)
+            pad = batch_size - (hi - lo)
+            sl = csr[lo:hi]
+            if pad:  # pad by wrapping like the reference's pad batches
+                # wrap indices modulo n so pad > n (tiny datasets) works
+                wrap_rows = _np.arange(pad) % n
+                from .ndarray.sparse import _dense_to_csr
+                full = csr.asnumpy()
+                sl = _dense_to_csr(
+                    _np.concatenate([sl.asnumpy(), full[wrap_rows]]))
+            lab = labels[lo:hi]
+            if pad:
+                lab = _np.concatenate([lab, labels[_np.arange(pad) % n]])
+            self._cursor = hi
+            from .ndarray import array as nd_array
+            return DataBatch(data=[sl], label=[nd_array(lab)], pad=pad,
+                             index=None)
+
+    return _LibSVMIter()

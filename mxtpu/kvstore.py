@@ -109,7 +109,12 @@ class KVStore:
                 dst._data = jax.device_put(src._data, dst.context.jax_device)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Sparse pull: gathers the requested rows (dense-backed on TPU)."""
+        """Pull only the requested rows (parity KVStore::PullRowSparse,
+        kvstore_local.h PullRowSparseImpl). If ``out`` is row_sparse the
+        result keeps sparse storage; dense outs get the full weight."""
+        import numpy as _np
+        from .ndarray.sparse import RowSparseNDArray
+
         if out is None or row_ids is None:
             raise MXNetError("row_sparse_pull requires out and row_ids")
         keys, outs = self._normalize(key, out)
@@ -119,7 +124,16 @@ class KVStore:
             olist = o if isinstance(o, list) else [o]
             rlist = rids if len(rids) == len(olist) else rids * len(olist)
             for dst, rid in zip(olist, rlist):
-                dst._data = jax.device_put(src._data, dst.context.jax_device)
+                if isinstance(dst, RowSparseNDArray):
+                    rows = _np.unique(
+                        rid.asnumpy().astype(_np.int64).reshape(-1))
+                    gathered = src._data[rows]
+                    dst._sp_data = gathered
+                    dst._sp_indices = jax.numpy.asarray(rows)
+                    dst._dense_cache = None
+                else:
+                    dst._data = jax.device_put(src._data,
+                                               dst.context.jax_device)
 
     # ------------------------------------------------ updater / optimizer
     def set_updater(self, updater):

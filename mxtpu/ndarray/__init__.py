@@ -95,3 +95,42 @@ from ..base import PrefixOpNamespace as _PrefixNS  # noqa: E402
 
 contrib = _PrefixNS(_mod, "_contrib_")
 linalg = _PrefixNS(_mod, "_linalg_")
+
+# ----------------------------------------------------------- sparse dispatch
+from . import sparse  # noqa: E402
+from .sparse import (BaseSparseNDArray, CSRNDArray,  # noqa: E402,F401
+                     RowSparseNDArray)
+
+_dense_dot = dot  # registry-generated
+_dense_cast_storage = cast_storage
+_dense_elemwise_add = elemwise_add
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, **kw):
+    """Sparse-aware dot (parity nd.dot over all storage types)."""
+    if isinstance(lhs, BaseSparseNDArray) or isinstance(rhs,
+                                                        BaseSparseNDArray):
+        return sparse.dot(lhs, rhs, transpose_a=transpose_a,
+                          transpose_b=transpose_b)
+    return _dense_dot(lhs, rhs, transpose_a=transpose_a,
+                      transpose_b=transpose_b, **kw)
+
+
+def cast_storage(data, stype="default", **kw):
+    if isinstance(data, BaseSparseNDArray) or stype != "default":
+        return sparse.cast_storage(data, stype)
+    return _dense_cast_storage(data, stype=stype, **kw)
+
+
+def sparse_retain(data, indices, **kw):
+    return sparse.sparse_retain(data, indices)
+
+
+_sparse_retain = sparse_retain
+
+
+def elemwise_add(lhs, rhs, **kw):
+    if isinstance(lhs, BaseSparseNDArray) and isinstance(rhs,
+                                                         BaseSparseNDArray):
+        return sparse.add(lhs, rhs)
+    return _dense_elemwise_add(lhs, rhs, **kw)

@@ -1,0 +1,368 @@
+"""Sparse NDArray storage types: CSR and RowSparse.
+
+Parity: python/mxnet/ndarray/sparse.py (CSRNDArray, RowSparseNDArray) and the
+native storage types in include/mxnet/ndarray.h:82-87 (kCSRStorage,
+kRowSparseStorage) + cast_storage / sparse dot kernels
+(src/operator/tensor/cast_storage-inl.h, dot-inl.h).
+
+TPU-native design: components (data/indices/indptr) live as JAX arrays;
+device-side sparse compute uses ``jax.experimental.sparse.BCOO`` (csr·dense
+dot rides the MXU via dot_general on gathered rows), while any op without a
+sparse implementation transparently densifies — the same storage-fallback
+contract as the reference executor (attach_op_execs_pass.cc:79-94), except
+XLA fuses the densification into the consumer where possible.
+
+Note on dynamic nnz vs XLA static shapes: conversions dense→sparse run
+eagerly on host (numpy), mirroring the reference running cast_storage on
+CPU; once built, component arrays have fixed shapes and all device math is
+jit-compatible.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..context import current_context
+from .ndarray import NDArray, array as _dense_array
+
+__all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix",
+           "row_sparse_array", "zeros", "array", "empty"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base; ``_data`` materializes the dense view lazily so every
+    dense op works via storage fallback."""
+
+    __slots__ = ("_sp_shape", "_sp_dtype", "_dense_cache")
+
+    def __init__(self, shape, dtype, ctx=None):
+        # mirror NDArray.__init__ without a dense buffer
+        self._ctx = ctx or current_context()
+        from .ndarray import _uid_counter
+        self._uid = next(_uid_counter)
+        self.grad = None
+        self._grad_req = "null"
+        self._tape_entry = None
+        self._sp_shape = tuple(int(s) for s in shape)
+        self._sp_dtype = _np.dtype(dtype)
+        self._dense_cache = None
+
+    # _data becomes a lazy dense materialization (storage fallback)
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            self._dense_cache = self._to_dense_jax()
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, v):  # e.g. autograd writing grads
+        self._dense_cache = v
+
+    @property
+    def shape(self):
+        return self._sp_shape
+
+    @property
+    def dtype(self):
+        return self._sp_dtype
+
+    @property
+    def ndim(self):
+        return len(self._sp_shape)
+
+    @property
+    def size(self):
+        n = 1
+        for s in self._sp_shape:
+            n *= s
+        return n
+
+    def asnumpy(self):
+        return _np.asarray(self._data)
+
+    def todense(self):
+        return NDArray(self._data, self._ctx)
+
+    def tostype(self, stype):
+        if stype == self.stype:
+            return self
+        if stype == "default":
+            return self.todense()
+        return cast_storage(self.todense(), stype)
+
+    def _to_dense_jax(self):
+        raise NotImplementedError
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """2D compressed-sparse-row array (parity sparse.py CSRNDArray)."""
+
+    __slots__ = ("_sp_data", "_sp_indices", "_sp_indptr")
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        dt = _np.asarray(data).dtype
+        super().__init__(shape, dt, ctx)
+        self.stype = "csr"
+        self._sp_data = jnp.asarray(data)
+        self._sp_indices = jnp.asarray(indices, dtype=jnp.int32)
+        self._sp_indptr = jnp.asarray(indptr, dtype=jnp.int32)
+
+    @property
+    def data(self):
+        return NDArray(self._sp_data, self._ctx)
+
+    @property
+    def indices(self):
+        return NDArray(self._sp_indices, self._ctx)
+
+    @property
+    def indptr(self):
+        return NDArray(self._sp_indptr, self._ctx)
+
+    @property
+    def nnz(self):
+        return int(self._sp_data.shape[0])
+
+    def _to_dense_jax(self):
+        n, m = self._sp_shape
+        data = _np.asarray(self._sp_data)
+        indices = _np.asarray(self._sp_indices)
+        indptr = _np.asarray(self._sp_indptr)
+        out = _np.zeros((n, m), dtype=self._sp_dtype)
+        for r in range(n):
+            lo, hi = indptr[r], indptr[r + 1]
+            out[r, indices[lo:hi]] = data[lo:hi]
+        return jnp.asarray(out)
+
+    def _to_bcoo(self):
+        """Device-side BCOO view for jit-compatible sparse math."""
+        from jax.experimental import sparse as jsparse
+        n = self._sp_shape[0]
+        row_counts = self._sp_indptr[1:] - self._sp_indptr[:-1]
+        rows = jnp.repeat(jnp.arange(n, dtype=self._sp_indices.dtype),
+                          row_counts, total_repeat_length=self.nnz)
+        idx = jnp.stack([rows, self._sp_indices], axis=1)
+        return jsparse.BCOO((self._sp_data, idx), shape=self._sp_shape)
+
+    def copy(self):
+        return CSRNDArray(self._sp_data, self._sp_indices, self._sp_indptr,
+                          self._sp_shape, self._ctx)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            start = key.start or 0
+            stop = key.stop if key.stop is not None else self._sp_shape[0]
+            data = _np.asarray(self._sp_data)
+            indices = _np.asarray(self._sp_indices)
+            indptr = _np.asarray(self._sp_indptr)
+            lo, hi = indptr[start], indptr[stop]
+            return CSRNDArray(data[lo:hi], indices[lo:hi],
+                              indptr[start:stop + 1] - lo,
+                              (stop - start, self._sp_shape[1]), self._ctx)
+        return super().__getitem__(key)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """First-dim-sparse array: (indices, data) where data[i] is the full
+    slice for row indices[i] (parity sparse.py RowSparseNDArray — the
+    storage type of embedding/sparse gradients)."""
+
+    __slots__ = ("_sp_data", "_sp_indices")
+
+    def __init__(self, data, indices, shape, ctx=None):
+        dt = _np.asarray(data).dtype
+        super().__init__(shape, dt, ctx)
+        self.stype = "row_sparse"
+        self._sp_data = jnp.asarray(data)
+        self._sp_indices = jnp.asarray(indices, dtype=jnp.int32)
+
+    @property
+    def data(self):
+        return NDArray(self._sp_data, self._ctx)
+
+    @property
+    def indices(self):
+        return NDArray(self._sp_indices, self._ctx)
+
+    def _to_dense_jax(self):
+        out = jnp.zeros(self._sp_shape, dtype=self._sp_dtype)
+        if self._sp_data.shape[0] == 0:
+            return out
+        return out.at[self._sp_indices].set(self._sp_data)
+
+    def copy(self):
+        return RowSparseNDArray(self._sp_data, self._sp_indices,
+                                self._sp_shape, self._ctx)
+
+    def retain(self, indices):
+        return sparse_retain(self, indices)
+
+
+# ------------------------------------------------------------ constructors
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray from (data, indices, indptr), a dense source, or
+    a scipy.sparse matrix (parity sparse.py csr_matrix)."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = _np.asarray(getattr(data, "_data", data),
+                           dtype=dtype or _np.float32)
+        return CSRNDArray(data, _np.asarray(indices), _np.asarray(indptr),
+                          shape, ctx)
+    if hasattr(arg1, "tocsr"):  # scipy sparse
+        m = arg1.tocsr()
+        return CSRNDArray(m.data.astype(dtype or m.dtype), m.indices,
+                          m.indptr, m.shape, ctx)
+    dense = _np.asarray(getattr(arg1, "_data", arg1))
+    if dtype is not None:
+        dense = dense.astype(dtype)
+    return _dense_to_csr(dense, ctx)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = _np.asarray(getattr(data, "_data", data),
+                           dtype=dtype or _np.float32)
+        indices = _np.asarray(getattr(indices, "_data", indices))
+        if shape is None:
+            raise MXNetError("row_sparse_array((data, indices)) needs shape")
+        return RowSparseNDArray(data, indices, shape, ctx)
+    dense = _np.asarray(getattr(arg1, "_data", arg1))
+    if dtype is not None:
+        dense = dense.astype(dtype)
+    return _dense_to_rsp(dense, ctx)
+
+
+def _dense_to_csr(dense, ctx=None):
+    if dense.ndim != 2:
+        raise MXNetError("csr storage requires 2D")
+    n, m = dense.shape
+    indptr = [0]
+    indices = []
+    data = []
+    for r in range(n):
+        nz = _np.nonzero(dense[r])[0]
+        indices.extend(nz.tolist())
+        data.extend(dense[r, nz].tolist())
+        indptr.append(len(indices))
+    return CSRNDArray(_np.asarray(data, dtype=dense.dtype),
+                      _np.asarray(indices, dtype=_np.int64),
+                      _np.asarray(indptr, dtype=_np.int64), (n, m), ctx)
+
+
+def _dense_to_rsp(dense, ctx=None):
+    nz_rows = _np.nonzero(_np.any(dense.reshape(dense.shape[0], -1) != 0,
+                                  axis=1))[0]
+    data = dense[nz_rows]
+    return RowSparseNDArray(data, nz_rows, dense.shape, ctx)
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    dtype = _np.dtype(dtype)
+    if stype == "csr":
+        return CSRNDArray(_np.zeros((0,), dtype), _np.zeros((0,), _np.int64),
+                          _np.zeros((shape[0] + 1,), _np.int64), shape, ctx)
+    if stype == "row_sparse":
+        return RowSparseNDArray(_np.zeros((0,) + tuple(shape[1:]), dtype),
+                                _np.zeros((0,), _np.int64), shape, ctx)
+    if stype == "default":
+        from . import zeros as dzeros
+        return dzeros(shape, ctx, str(dtype))
+    raise MXNetError("unknown stype %s" % stype)
+
+
+def empty(stype, shape, ctx=None, dtype="float32"):
+    return zeros(stype, shape, ctx, dtype)
+
+
+def array(source, ctx=None, dtype=None):
+    """Create a sparse array from a sparse source (parity sparse.array)."""
+    if isinstance(source, BaseSparseNDArray):
+        return source.copy()
+    if hasattr(source, "tocsr"):
+        return csr_matrix(source, ctx=ctx, dtype=dtype)
+    raise MXNetError("sparse.array expects a sparse source; use nd.array")
+
+
+# ------------------------------------------------------------ sparse ops
+
+
+def cast_storage(arr, stype):
+    """Convert between storage types (parity op cast_storage)."""
+    if stype == arr.stype:
+        return arr
+    if stype == "default":
+        return arr.todense() if isinstance(arr, BaseSparseNDArray) else arr
+    dense = arr.asnumpy()
+    if stype == "csr":
+        return _dense_to_csr(dense, arr.context)
+    if stype == "row_sparse":
+        return _dense_to_rsp(dense, arr.context)
+    raise MXNetError("unknown stype %s" % stype)
+
+
+def sparse_retain(arr, indices):
+    """Retain only the requested rows of a row_sparse array (parity
+    _sparse_retain, src/operator/tensor/sparse_retain-inl.h)."""
+    if not isinstance(arr, RowSparseNDArray):
+        raise MXNetError("sparse_retain expects row_sparse storage")
+    want = _np.asarray(getattr(indices, "_data", indices)).astype(_np.int64)
+    have = _np.asarray(arr._sp_indices)
+    mask = _np.isin(have, want)
+    data = _np.asarray(arr._sp_data)[mask]
+    return RowSparseNDArray(data, have[mask], arr.shape, arr.context)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot. csr·dense runs device-side via BCOO dot_general
+    (lowers to gather + MXU dot); dense·dense falls through to the dense op.
+    dot(csr.T, dense) produces row_sparse output like the reference
+    (dot-inl.h) — that is the embedding-gradient path."""
+    from . import dot as dense_dot
+
+    if isinstance(lhs, CSRNDArray) and not isinstance(rhs, BaseSparseNDArray):
+        rhs_mat = rhs._data.T if transpose_b else rhs._data
+        if transpose_a:
+            # out rows touched = csr column indices -> row_sparse output
+            out = lhs._to_bcoo().T @ rhs_mat
+            rows = _np.unique(_np.asarray(lhs._sp_indices))
+            dense = _np.asarray(out)
+            return RowSparseNDArray(dense[rows], rows, dense.shape,
+                                    lhs.context)
+        out = lhs._to_bcoo() @ rhs_mat
+        return NDArray(out, lhs.context)
+    if isinstance(lhs, BaseSparseNDArray) or isinstance(rhs,
+                                                        BaseSparseNDArray):
+        lhs = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+        rhs = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return dense_dot(lhs, rhs, transpose_a=transpose_a,
+                     transpose_b=transpose_b)
+
+
+def add(lhs, rhs):
+    """Sparse elemwise add; rsp+rsp stays row_sparse."""
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs,
+                                                        RowSparseNDArray):
+        idx = _np.union1d(_np.asarray(lhs._sp_indices),
+                          _np.asarray(rhs._sp_indices))
+        shape = (len(idx),) + lhs.shape[1:]
+        data = _np.zeros(shape, lhs.dtype)
+        li = {int(v): i for i, v in enumerate(_np.asarray(lhs._sp_indices))}
+        ri = {int(v): i for i, v in enumerate(_np.asarray(rhs._sp_indices))}
+        ld = _np.asarray(lhs._sp_data)
+        rd = _np.asarray(rhs._sp_data)
+        for i, v in enumerate(idx):
+            if int(v) in li:
+                data[i] += ld[li[int(v)]]
+            if int(v) in ri:
+                data[i] += rd[ri[int(v)]]
+        return RowSparseNDArray(data, idx, lhs.shape, lhs.context)
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
+        # csr + csr stays csr (reference elemwise_binary_op csr kernels);
+        # merged on host via the dense bridge — row-merge kernel TODO
+        return _dense_to_csr(lhs.asnumpy() + rhs.asnumpy(), lhs.context)
+    return NDArray(lhs._data + rhs._data, lhs._ctx)

@@ -1,0 +1,144 @@
+"""User-defined operators in Python (``mx.operator`` parity).
+
+Mirrors the reference's custom-op surface (python/mxnet/operator.py:
+``CustomOp``, ``CustomOpProp``, ``register``; native side
+src/operator/custom/custom.cc:45-253 with its MXCallbackList trampoline).
+
+TPU-native design: instead of the reference's C callback lists crossing the
+C API, a registered custom op becomes a ``jax.pure_callback`` host call for
+forward and a ``jax.custom_vjp`` whose backward is a second host call into
+the user's ``backward``. Inside a jit-compiled graph this lowers to an XLA
+host callback, which is exactly the TPU analogue of the reference's
+"engine thread calls back into Python" path.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop_cls"]
+
+_REGISTRY = {}  # op_type -> CustomOpProp subclass
+
+
+class CustomOp:
+    """Base class for user ops (parity operator.py CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honouring the write request."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst[:] + src
+        else:
+            raise MXNetError("unknown req '%s'" % req)
+
+
+class CustomOpProp:
+    """Describes a custom op: arguments, outputs, shapes, types.
+
+    Parity operator.py CustomOpProp; kwargs arrive as strings, like the
+    reference's param dict.
+    """
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = bool(need_top_grad)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Decorator: register a CustomOpProp subclass under ``op_type``."""
+
+    def _do(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return _do
+
+
+def get_prop_cls(op_type):
+    if op_type not in _REGISTRY:
+        raise MXNetError("custom op type '%s' is not registered "
+                         "(use mx.operator.register)" % op_type)
+    return _REGISTRY[op_type]
+
+
+def make_prop(op_type, kwargs):
+    """Instantiate the prop with string kwargs (reference passes str params)."""
+    cls = get_prop_cls(op_type)
+    str_kwargs = {k: str(v) for k, v in kwargs.items()}
+    try:
+        return cls(**str_kwargs)
+    except TypeError:
+        return cls()
+
+
+class _HostArray:
+    """Mutable host-side array handed to CustomOp.forward/backward.
+
+    Behaves like the reference's NDArray for the common custom-op idioms:
+    ``.asnumpy()``, ``.shape``, ``x[:] = value``, arithmetic via numpy.
+    """
+
+    def __init__(self, arr):
+        self._arr = _np.asarray(arr)
+
+    def asnumpy(self):
+        return self._arr
+
+    @property
+    def shape(self):
+        return self._arr.shape
+
+    @property
+    def dtype(self):
+        return self._arr.dtype
+
+    def __getitem__(self, k):
+        return self._arr[k]
+
+    def __setitem__(self, k, v):
+        self._arr[k] = _np.asarray(getattr(v, "_arr", v))
+
+    def __array__(self, dtype=None):
+        return self._arr if dtype is None else self._arr.astype(dtype)

@@ -9,4 +9,6 @@ from . import optimizer_ops  # noqa: F401
 from . import linalg  # noqa: F401
 from . import rnn  # noqa: F401
 from . import contrib  # noqa: F401
+from . import spatial  # noqa: F401
+from . import custom  # noqa: F401
 from .registry import OpDef, get_op, list_ops, op_exists, register  # noqa: F401

@@ -349,6 +349,118 @@ def _ifft(a, data):
 register("_contrib_ifft", _ifft, attrs={"compute_size": 128})
 
 
+# ------------------------------------------------------------------ CTCLoss
+
+
+def _ctc_loss_one(logits, label, a, data_len=None, label_len=None):
+    """CTC negative log-likelihood for one sequence.
+
+    logits (T, C); label (L,) int labels. MXNet conventions
+    (contrib/ctc_loss-inl.h): with blank_label='first' the blank is class 0
+    and label value 0 means padding; with 'last' the blank is class C-1 and
+    negative labels are padding. Log-domain alpha recursion over the
+    expanded label [blank, l1, blank, l2, ..., blank] via lax.scan; when
+    data_len is given, steps t >= data_len freeze the recursion.
+    """
+    T, C = logits.shape
+    L = label.shape[0]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    lab = label.astype(jnp.int32)
+    blank_first = str(a.blank_label) != "last"
+    blank = 0 if blank_first else C - 1
+    if label_len is not None:
+        valid = jnp.arange(L) < label_len.astype(jnp.int32)
+    elif blank_first:
+        valid = lab > 0
+    else:
+        valid = lab >= 0
+    n_lab = jnp.sum(valid.astype(jnp.int32))
+    # compact the labels to the front (padding may be interleaved in theory)
+    order = jnp.argsort(~valid, stable=True)
+    lab = lab[order]
+    S = 2 * L + 1
+    ext = jnp.full((S,), blank, jnp.int32)
+    ext = ext.at[1::2].set(jnp.clip(lab, 0, C - 1))  # labels at odd slots
+    NEG = jnp.asarray(-1e30, logp.dtype)
+    s_idx = jnp.arange(S)
+    s_valid = s_idx < 2 * n_lab + 1
+    # allow the skip transition a[s-2] when ext[s] != blank and != ext[s-2]
+    ext_m2 = jnp.concatenate([jnp.full((2,), blank, jnp.int32), ext[:-2]])
+    can_skip = (ext != blank) & (ext != ext_m2) & (s_idx >= 2)
+
+    alpha0 = jnp.full((S,), NEG)
+    alpha0 = alpha0.at[0].set(logp[0, blank])
+    alpha0 = alpha0.at[1].set(jnp.where(n_lab > 0, logp[0, ext[1]], NEG))
+
+    def step(alpha, xs):
+        t, lp = xs
+        a_prev = alpha
+        a_m1 = jnp.concatenate([jnp.array([NEG]), alpha[:-1]])
+        a_m2 = jnp.concatenate([jnp.full((2,), NEG), alpha[:-2]])
+        a_m2 = jnp.where(can_skip, a_m2, NEG)
+        m = jnp.maximum(jnp.maximum(a_prev, a_m1), a_m2)
+        tot = m + jnp.log(jnp.exp(a_prev - m) + jnp.exp(a_m1 - m) +
+                          jnp.exp(jnp.where(can_skip, a_m2, NEG) - m))
+        tot = jnp.where(jnp.isfinite(m), tot, NEG)
+        new = jnp.where(s_valid, tot + lp[ext], NEG)
+        if data_len is not None:  # freeze past the true sequence end
+            new = jnp.where(t < data_len.astype(jnp.int32), new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0,
+                        (jnp.arange(1, T), logp[1:]))
+    end1 = alpha[2 * n_lab]  # final blank
+    end2 = jnp.where(n_lab > 0, alpha[2 * n_lab - 1], NEG)
+    m = jnp.maximum(end1, end2)
+    ll = m + jnp.log(jnp.exp(end1 - m) + jnp.exp(end2 - m))
+    return -ll
+
+
+def _ctc_loss(a, *inputs):
+    """data (T, N, C) activations, label (N, L) -> loss (N,) (grad flows
+    through data via jax.grad of this expression, replacing the reference's
+    hand-written warp-ctc backward). Optional inputs follow arg order:
+    data_lengths if use_data_lengths, then label_lengths if
+    use_label_lengths."""
+    data, label = inputs[0], inputs[1]
+    i = 2
+    data_lengths = label_lengths = None
+    if a.use_data_lengths:
+        data_lengths = inputs[i]
+        i += 1
+    if a.use_label_lengths:
+        label_lengths = inputs[i]
+    if a.use_data_lengths and a.use_label_lengths:
+        return jax.vmap(lambda lg, lb, dl, ll: _ctc_loss_one(
+            lg, lb, a, dl, ll), in_axes=(1, 0, 0, 0))(
+                data, label, data_lengths, label_lengths)
+    if a.use_data_lengths:
+        return jax.vmap(lambda lg, lb, dl: _ctc_loss_one(lg, lb, a, dl),
+                        in_axes=(1, 0, 0))(data, label, data_lengths)
+    if a.use_label_lengths:
+        return jax.vmap(lambda lg, lb, ll: _ctc_loss_one(
+            lg, lb, a, None, ll), in_axes=(1, 0, 0))(data, label,
+                                                     label_lengths)
+    return jax.vmap(lambda lg, lb: _ctc_loss_one(lg, lb, a),
+                    in_axes=(1, 0))(data, label)
+
+
+def _ctc_args(a):
+    names = ["data", "label"]
+    if a.get("use_data_lengths"):
+        names.append("data_lengths")
+    if a.get("use_label_lengths"):
+        names.append("label_lengths")
+    return names
+
+
+register("_contrib_CTCLoss", _ctc_loss, arg_names=_ctc_args,
+         attrs={"use_data_lengths": False, "use_label_lengths": False,
+                "blank_label": "first"},
+         aliases=("CTCLoss", "ctc_loss", "_contrib_ctc_loss"),
+         loss_like=True)
+
+
 # -------------------------------------------------------------- count_sketch
 
 

@@ -1,0 +1,131 @@
+"""The ``Custom`` operator: user Python code inside compiled graphs.
+
+Reference: src/operator/custom/custom.cc (op registration :45-253, backward
+:393) + python/mxnet/operator.py. Here the custom body runs as a
+``jax.pure_callback`` (XLA host callback on TPU) and its gradient is wired
+with ``jax.custom_vjp`` so it composes with both the autograd tape and
+whole-graph executor tracing.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .. import operator as _operator
+from ..base import MXNetError
+from .registry import AttrDict, OpDef, Required, register_op, register
+
+
+class _CustomOpDef(OpDef):
+    """OpDef that keeps ALL kwargs (custom ops take arbitrary str params)."""
+
+    def parse_attrs(self, kwargs):
+        if "op_type" not in kwargs:
+            raise MXNetError("Custom op requires op_type=")
+        out = AttrDict()
+        for k, v in kwargs.items():
+            if k in ("name", "out", "ctx", "dtype_hint"):
+                continue
+            out[k] = v if not isinstance(v, (list, dict)) else str(v)
+        return out
+
+
+def _prop_of(attrs):
+    kwargs = {k: v for k, v in attrs.items() if k != "op_type"}
+    return _operator.make_prop(attrs["op_type"], kwargs)
+
+
+def _custom_fn(attrs, *inputs):
+    prop = _prop_of(attrs)
+    n_args = len(prop.list_arguments())
+    n_out = len(prop.list_outputs())
+    n_aux = len(prop.list_auxiliary_states())
+    in_shapes = [tuple(x.shape) for x in inputs]
+    arg_shapes, out_shapes, aux_shapes = prop.infer_shape(
+        [list(s) for s in in_shapes[:n_args]])
+    in_dt = [x.dtype for x in inputs]
+    _, out_dtypes, _ = prop.infer_type(list(in_dt[:n_args]))
+    out_structs = tuple(jax.ShapeDtypeStruct(tuple(s), d)
+                        for s, d in zip(out_shapes, out_dtypes))
+    from .. import autograd as _ag
+    is_train = bool(_ag.is_training())
+
+    def host_forward(*ins):
+        op = prop.create_operator(None, [list(s) for s in in_shapes], in_dt)
+        in_data = [_operator._HostArray(_np.asarray(x)) for x in ins]
+        out_data = [_operator._HostArray(_np.zeros(s.shape, s.dtype))
+                    for s in out_structs]
+        aux = in_data[n_args:n_args + n_aux]
+        op.forward(is_train, ["write"] * n_out, in_data[:n_args],
+                   out_data, aux)
+        return tuple(o.asnumpy().astype(s.dtype)
+                     for o, s in zip(out_data, out_structs))
+
+    def host_backward(ins, outs, cts):
+        op = prop.create_operator(None, [list(s) for s in in_shapes], in_dt)
+        in_data = [_operator._HostArray(_np.asarray(x)) for x in ins]
+        out_data = [_operator._HostArray(_np.asarray(y)) for y in outs]
+        out_grad = [_operator._HostArray(_np.asarray(c)) for c in cts]
+        in_grad = [_operator._HostArray(_np.zeros_like(_np.asarray(x)))
+                   for x in ins]
+        aux = in_data[n_args:n_args + n_aux]
+        op.backward(["write"] * len(ins), out_grad, in_data[:n_args],
+                    out_data, in_grad, aux)
+        return tuple(g.asnumpy().astype(d)
+                     for g, d in zip(in_grad, in_dt))
+
+    @jax.custom_vjp
+    def run(*ins):
+        return jax.pure_callback(host_forward, out_structs, *ins,
+                                 vmap_method="sequential")
+
+    def run_fwd(*ins):
+        outs = jax.pure_callback(host_forward, out_structs, *ins,
+                                 vmap_method="sequential")
+        return outs, (ins, outs)
+
+    def run_bwd(res, cts):
+        ins, outs = res
+        in_structs = tuple(jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+                           for x in ins)
+        grads = jax.pure_callback(
+            lambda *flat: host_backward(flat[:len(ins)],
+                                        flat[len(ins):len(ins) + len(outs)],
+                                        flat[len(ins) + len(outs):]),
+            in_structs, *(tuple(ins) + tuple(outs) + tuple(cts)),
+            vmap_method="sequential")
+        return tuple(grads)
+
+    run.defvjp(run_fwd, run_bwd)
+    outs = run(*inputs)
+    return outs if len(outs) > 1 else outs[0]
+
+
+def _custom_arg_names(attrs):
+    prop = _prop_of(attrs)
+    return list(prop.list_arguments()) + list(prop.list_auxiliary_states())
+
+
+def _custom_n_out(attrs):
+    return len(_prop_of(attrs).list_outputs())
+
+
+register_op(_CustomOpDef(
+    "Custom", _custom_fn, arg_names=_custom_arg_names,
+    attrs={"op_type": Required(str)}, num_outputs=_custom_n_out,
+    aliases=("_Custom",)))
+
+
+# ----------------------------------------------------------- _NoGradient
+
+
+def _no_gradient(a):
+    """Placeholder node meaning 'no gradient flows here' (reference
+    elemwise_unary_op.cc _NoGradient): a constant zero scalar."""
+    return jnp.zeros((1,), jnp.float32)
+
+
+register("_NoGradient", _no_gradient, arg_names=[])

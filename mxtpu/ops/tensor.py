@@ -311,6 +311,44 @@ register("slice", _slice, attrs={"begin": Required(tuple), "end": Required(tuple
          aliases=("crop",))
 
 
+def _slice_idx(a, shape):
+    begin = list(a.begin)
+    end = list(a.end)
+    idx = []
+    for d in range(len(shape)):
+        b = begin[d] if d < len(begin) and begin[d] is not None else 0
+        e = end[d] if d < len(end) and end[d] is not None else shape[d]
+        if b < 0:
+            b += shape[d]
+        if e < 0:
+            e += shape[d]
+        idx.append(slice(b, e))
+    return tuple(idx)
+
+
+def _slice_assign(a, lhs, rhs):
+    """Functional slice assignment (reference matrix_op.cc _slice_assign /
+    _crop_assign): returns lhs with lhs[begin:end] = rhs."""
+    return lhs.at[_slice_idx(a, lhs.shape)].set(rhs.astype(lhs.dtype))
+
+
+register("_slice_assign", _slice_assign, arg_names=["lhs", "rhs"],
+         attrs={"begin": Required(tuple), "end": Required(tuple)},
+         aliases=("_crop_assign",))
+
+
+def _slice_assign_scalar(a, data):
+    """lhs[begin:end] = scalar (reference _crop_assign_scalar)."""
+    return data.at[_slice_idx(a, data.shape)].set(
+        jnp.asarray(a.scalar, data.dtype))
+
+
+register("_slice_assign_scalar", _slice_assign_scalar,
+         attrs={"begin": Required(tuple), "end": Required(tuple),
+                "scalar": 0.0},
+         aliases=("_crop_assign_scalar",))
+
+
 def _slice_axis(a, x):
     ax = int(a.axis) % x.ndim
     b = a.begin or 0

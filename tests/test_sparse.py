@@ -1,0 +1,192 @@
+"""Sparse NDArray tests (model: reference tests/python/unittest/
+test_sparse_ndarray.py + test_sparse_operator.py)."""
+import os
+import tempfile
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import nd
+
+
+def _rand_csr(shape, density=0.3):
+    dense = (np.random.uniform(0, 1, shape) < density) * \
+        np.random.randn(*shape)
+    return dense.astype("float32")
+
+
+def test_csr_roundtrip():
+    dense = _rand_csr((5, 8))
+    csr = nd.sparse.csr_matrix(dense)
+    assert csr.stype == "csr"
+    assert csr.shape == (5, 8)
+    assert np.allclose(csr.asnumpy(), dense)
+    back = csr.tostype("default")
+    assert back.stype == "default"
+    assert np.allclose(back.asnumpy(), dense)
+
+
+def test_csr_components():
+    data = np.array([1, 2, 3], dtype="float32")
+    indices = np.array([0, 2, 1])
+    indptr = np.array([0, 2, 2, 3])
+    csr = nd.sparse.csr_matrix((data, indices, indptr), shape=(3, 3))
+    ref = np.array([[1, 0, 2], [0, 0, 0], [0, 3, 0]], dtype="float32")
+    assert np.allclose(csr.asnumpy(), ref)
+    assert np.allclose(csr.data.asnumpy(), data)
+    assert np.allclose(csr.indices.asnumpy(), indices)
+    assert np.allclose(csr.indptr.asnumpy(), indptr)
+    assert csr.nnz == 3
+
+
+def test_row_sparse_roundtrip():
+    dense = np.zeros((6, 3), dtype="float32")
+    dense[1] = 1.0
+    dense[4] = 2.0
+    rsp = nd.sparse.row_sparse_array(dense)
+    assert rsp.stype == "row_sparse"
+    assert np.allclose(rsp.indices.asnumpy(), [1, 4])
+    assert np.allclose(rsp.asnumpy(), dense)
+
+
+def test_cast_storage():
+    dense = _rand_csr((4, 5))
+    x = nd.array(dense)
+    csr = nd.cast_storage(x, "csr")
+    assert csr.stype == "csr"
+    rsp = nd.cast_storage(x, "row_sparse")
+    assert rsp.stype == "row_sparse"
+    assert np.allclose(csr.asnumpy(), dense)
+    assert np.allclose(rsp.asnumpy(), dense)
+    d2 = nd.cast_storage(csr, "default")
+    assert np.allclose(d2.asnumpy(), dense)
+
+
+def test_sparse_dot():
+    np.random.seed(0)
+    a = _rand_csr((4, 6))
+    b = np.random.randn(6, 3).astype("float32")
+    csr = nd.sparse.csr_matrix(a)
+    out = nd.dot(csr, nd.array(b))
+    assert out.stype == "default"
+    assert np.allclose(out.asnumpy(), a @ b, atol=1e-5)
+    # transpose_a -> row_sparse output (embedding-grad path)
+    outT = nd.dot(csr, nd.array(np.random.randn(4, 3).astype("float32")),
+                  transpose_a=True)
+    assert outT.stype == "row_sparse"
+
+
+def test_sparse_retain():
+    dense = np.zeros((6, 2), dtype="float32")
+    dense[1] = 1
+    dense[3] = 3
+    dense[5] = 5
+    rsp = nd.sparse.row_sparse_array(dense)
+    kept = nd.sparse_retain(rsp, nd.array(np.array([1, 5])))
+    ref = dense.copy()
+    ref[3] = 0
+    assert np.allclose(kept.asnumpy(), ref)
+
+
+def test_sparse_add():
+    d1 = np.zeros((5, 2), dtype="float32")
+    d1[0] = 1
+    d2 = np.zeros((5, 2), dtype="float32")
+    d2[0] = 2
+    d2[3] = 3
+    r = nd.elemwise_add(nd.sparse.row_sparse_array(d1),
+                        nd.sparse.row_sparse_array(d2))
+    assert r.stype == "row_sparse"
+    assert np.allclose(r.asnumpy(), d1 + d2)
+
+
+def test_sparse_zeros():
+    z = nd.sparse.zeros("csr", (3, 4))
+    assert z.stype == "csr" and z.asnumpy().sum() == 0
+    z2 = nd.sparse.zeros("row_sparse", (3, 4))
+    assert z2.stype == "row_sparse" and z2.asnumpy().sum() == 0
+
+
+def test_storage_fallback_dense_op():
+    # any dense op on sparse input densifies transparently (reference
+    # executor storage fallback)
+    dense = _rand_csr((3, 4))
+    csr = nd.sparse.csr_matrix(dense)
+    out = nd.relu(csr)
+    assert np.allclose(out.asnumpy(), np.maximum(dense, 0), atol=1e-6)
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    w = np.random.randn(8, 4).astype("float32")
+    kv.init("emb", nd.array(w))
+    out = nd.sparse.zeros("row_sparse", (8, 4))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array(np.array([2, 5])))
+    assert sorted(out.indices.asnumpy().tolist()) == [2, 5]
+    assert np.allclose(out.asnumpy()[2], w[2], atol=1e-6)
+    assert np.allclose(out.asnumpy()[0], 0)
+
+
+def test_libsvm_iter_csr():
+    content = "1 0:1.5 3:2.0\n0 1:1.0\n1 2:0.5 3:1.0\n"
+    with tempfile.NamedTemporaryFile("w", suffix=".libsvm",
+                                     delete=False) as f:
+        f.write(content)
+        path = f.name
+    try:
+        it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(4,),
+                              batch_size=2)
+        batches = list(it)
+        assert len(batches) == 2
+        b0 = batches[0]
+        assert b0.data[0].stype == "csr"
+        ref0 = np.array([[1.5, 0, 0, 2.0], [0, 1.0, 0, 0]], dtype="float32")
+        assert np.allclose(b0.data[0].asnumpy(), ref0)
+        assert batches[1].pad == 1
+    finally:
+        os.unlink(path)
+
+
+def test_sparse_dot_transpose_b():
+    np.random.seed(1)
+    a = _rand_csr((4, 6))
+    b = np.random.randn(3, 6).astype("float32")
+    out = nd.dot(nd.sparse.csr_matrix(a), nd.array(b), transpose_b=True)
+    assert np.allclose(out.asnumpy(), a @ b.T, atol=1e-5)
+
+
+def test_sparse_add_csr_keeps_csr():
+    a = _rand_csr((4, 5))
+    b = _rand_csr((4, 5))
+    out = nd.elemwise_add(nd.sparse.csr_matrix(a), nd.sparse.csr_matrix(b))
+    assert out.stype == "csr"
+    assert np.allclose(out.asnumpy(), a + b, atol=1e-6)
+
+
+def test_libsvm_iter_tiny_dataset_pad():
+    import tempfile
+    content = "1 0:1.0\n0 1:2.0\n1 2:3.0\n"
+    with tempfile.NamedTemporaryFile("w", suffix=".libsvm",
+                                     delete=False) as f:
+        f.write(content)
+        path = f.name
+    try:
+        it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(4,),
+                              batch_size=8)
+        batches = list(it)
+        assert len(batches) == 1
+        assert batches[0].pad == 5
+        assert batches[0].data[0].shape == (8, 4)
+    finally:
+        os.unlink(path)
+
+
+def test_sparse_save_load_dense_interop():
+    # sparse arrays serialize through their dense view for checkpoint parity
+    dense = _rand_csr((3, 3))
+    csr = nd.sparse.csr_matrix(dense)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "x.params")
+        nd.save(path, {"w": csr.todense()})
+        back = nd.load(path)["w"]
+        assert np.allclose(back.asnumpy(), dense)

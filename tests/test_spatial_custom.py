@@ -1,0 +1,278 @@
+"""Tests for spatial/warping/region ops + the Custom python-op path.
+
+Oracles: numpy recomputation (reference model: tests/python/unittest/
+test_operator.py spatial-transformer / roi / correlation / custom tests).
+"""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+
+
+def test_bilinear_sampler_identity():
+    x = np.random.randn(2, 3, 5, 7).astype("float32")
+    # identity grid: x,y in [-1,1]
+    ys = np.linspace(-1, 1, 5)
+    xs = np.linspace(-1, 1, 7)
+    gx, gy = np.meshgrid(xs, ys)
+    grid = np.stack([gx, gy], axis=0)[None].repeat(2, 0).astype("float32")
+    out = nd.BilinearSampler(nd.array(x), nd.array(grid)).asnumpy()
+    assert np.allclose(out, x, atol=1e-5)
+
+
+def test_grid_generator_affine_identity():
+    # identity affine [1,0,0, 0,1,0]
+    aff = np.array([[1, 0, 0, 0, 1, 0]], dtype="float32")
+    g = nd.GridGenerator(nd.array(aff), transform_type="affine",
+                         target_shape=(4, 6)).asnumpy()
+    assert g.shape == (1, 2, 4, 6)
+    assert np.allclose(g[0, 0, 0], np.linspace(-1, 1, 6), atol=1e-6)
+    assert np.allclose(g[0, 1, :, 0], np.linspace(-1, 1, 4), atol=1e-6)
+
+
+def test_spatial_transformer_identity():
+    x = np.random.randn(2, 1, 6, 6).astype("float32")
+    loc = np.tile(np.array([1, 0, 0, 0, 1, 0], dtype="float32"), (2, 1))
+    out = nd.SpatialTransformer(nd.array(x), nd.array(loc),
+                                target_shape=(6, 6)).asnumpy()
+    assert np.allclose(out, x, atol=1e-5)
+
+
+def test_roi_pooling_matches_naive():
+    np.random.seed(0)
+    x = np.random.randn(1, 2, 8, 8).astype("float32")
+    rois = np.array([[0, 0, 0, 7, 7], [0, 2, 2, 6, 6]], dtype="float32")
+    out = nd.ROIPooling(nd.array(x), nd.array(rois), pooled_size=(2, 2),
+                        spatial_scale=1.0).asnumpy()
+    assert out.shape == (2, 2, 2, 2)
+    # whole-image ROI, 2x2 pooling = max over quadrants
+    ref00 = x[0, :, 0:4, 0:4].max(axis=(1, 2))
+    assert np.allclose(out[0, :, 0, 0], ref00, atol=1e-5)
+    ref11 = x[0, :, 4:8, 4:8].max(axis=(1, 2))
+    assert np.allclose(out[0, :, 1, 1], ref11, atol=1e-5)
+
+
+def test_correlation_exact_values():
+    np.random.seed(1)
+    x = np.random.randn(1, 4, 6, 6).astype("float32")
+    y = np.random.randn(1, 4, 6, 6).astype("float32")
+    out = nd.Correlation(nd.array(x), nd.array(y), kernel_size=1,
+                         max_displacement=1, stride1=1, stride2=1,
+                         pad_size=1).asnumpy()
+    assert out.shape[1] == 9
+    # zero-displacement channel = per-pixel channel inner product / C
+    ref0 = (x[0] * y[0]).sum(axis=0) / 4.0
+    assert np.allclose(out[0, 4], ref0, atol=1e-4)
+    # displacement (dy=+1, dx=0) channel index 7: x(p) . y(p + dy)
+    ref_dy = np.zeros((6, 6), dtype="float32")
+    ref_dy[:5] = (x[0, :, :5, :] * y[0, :, 1:, :]).sum(axis=0) / 4.0
+    assert np.allclose(out[0, 7], ref_dy, atol=1e-4)
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    np.random.seed(2)
+    x = np.random.randn(1, 2, 6, 6).astype("float32")
+    w = np.random.randn(3, 2, 3, 3).astype("float32")
+    off = np.zeros((1, 18, 4, 4), dtype="float32")
+    out = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), kernel=(3, 3),
+        num_filter=3, no_bias=True).asnumpy()
+    ref = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         num_filter=3, no_bias=True).asnumpy()
+    assert np.allclose(out, ref, atol=1e-4)
+
+
+def test_psroi_pooling_shape():
+    x = np.random.randn(1, 2 * 9, 8, 8).astype("float32")
+    rois = np.array([[0, 0, 0, 7, 7]], dtype="float32")
+    out = nd.contrib.PSROIPooling(nd.array(x), nd.array(rois),
+                                  spatial_scale=1.0, output_dim=2,
+                                  pooled_size=3, group_size=3).asnumpy()
+    assert out.shape == (1, 2, 3, 3)
+
+
+def test_ctc_loss_blank_last():
+    np.random.seed(5)
+    T, N, C = 6, 1, 5
+    x = np.random.randn(T, N, C).astype("float32")
+    # same label sequence expressed in both conventions must give the
+    # same loss when the logits are permuted to match blank position
+    lab_first = np.array([[1, 2, 0, 0]], dtype="float32")  # blank=0
+    lab_last = np.array([[0, 1, -1, -1]], dtype="float32")  # blank=C-1
+    x_last = np.concatenate([x[:, :, 1:], x[:, :, :1]], axis=2)
+    out_first = nd.contrib.CTCLoss(nd.array(x), nd.array(lab_first)).asnumpy()
+    out_last = nd.contrib.CTCLoss(nd.array(x_last), nd.array(lab_last),
+                                  blank_label="last").asnumpy()
+    assert abs(out_first[0] - out_last[0]) < 1e-4
+
+
+def test_ctc_loss_lengths():
+    np.random.seed(6)
+    T, C = 8, 5
+    x = np.random.randn(T, 2, C).astype("float32")
+    labels = np.array([[1, 2, 3, 3], [2, 1, 0, 0]], dtype="float32")
+    dl = np.array([5.0, 8.0], dtype="float32")
+    ll = np.array([2.0, 2.0], dtype="float32")
+    out = nd.contrib.CTCLoss(nd.array(x), nd.array(labels), nd.array(dl),
+                             nd.array(ll), use_data_lengths=True,
+                             use_label_lengths=True).asnumpy()
+    # sample 0 truncated to 5 steps and 2 labels == plain CTC on the slice
+    ref = _np_ctc_loss(x[:5, 0], [1, 2])
+    assert abs(out[0] - ref) < 1e-3
+
+
+def test_psroi_pooling_default_group_size():
+    x = np.random.randn(1, 2 * 9, 8, 8).astype("float32")
+    rois = np.array([[0, 0, 0, 7, 7]], dtype="float32")
+    out = nd.contrib.PSROIPooling(nd.array(x), nd.array(rois),
+                                  spatial_scale=1.0, output_dim=2,
+                                  pooled_size=3).asnumpy()
+    assert out.shape == (1, 2, 3, 3)
+
+
+def test_deformable_psroi_no_trans():
+    x = np.random.randn(1, 2 * 9, 8, 8).astype("float32")
+    rois = np.array([[0, 0, 0, 7, 7]], dtype="float32")
+    out = nd.contrib.DeformablePSROIPooling(
+        nd.array(x), nd.array(rois), spatial_scale=1.0, output_dim=2,
+        group_size=3, pooled_size=3, no_trans=True).asnumpy()
+    assert out.shape == (1, 2, 3, 3)
+
+
+def test_proposal_shapes_and_validity():
+    np.random.seed(3)
+    A = 3 * 4  # ratios x scales
+    H = W = 4
+    score = np.random.uniform(0, 1, (1, 2 * A, H, W)).astype("float32")
+    bbox = (np.random.randn(1, 4 * A, H, W) * 0.1).astype("float32")
+    im_info = np.array([[64, 64, 1.0]], dtype="float32")
+    rois = nd.contrib.Proposal(nd.array(score), nd.array(bbox),
+                               nd.array(im_info),
+                               rpn_pre_nms_top_n=50,
+                               rpn_post_nms_top_n=10,
+                               feature_stride=16).asnumpy()
+    assert rois.shape == (10, 5)
+    assert (rois[:, 1] <= rois[:, 3] + 1e-3).all()
+    assert (rois[:, 2] <= rois[:, 4] + 1e-3).all()
+    assert (rois[:, 1:] >= -1e-3).all()
+
+
+def _np_ctc_loss(logits, labels):
+    """Plain-python CTC NLL oracle, blank=0."""
+    T, C = logits.shape
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    lab = [int(l) for l in labels if l > 0]
+    ext = [0]
+    for l in lab:
+        ext += [l, 0]
+    S = len(ext)
+    alpha = np.zeros((T, S))
+    alpha[0, 0] = p[0, 0]
+    if S > 1:
+        alpha[0, 1] = p[0, ext[1]]
+    for t in range(1, T):
+        for s in range(S):
+            a = alpha[t - 1, s]
+            if s >= 1:
+                a += alpha[t - 1, s - 1]
+            if s >= 2 and ext[s] != 0 and ext[s] != ext[s - 2]:
+                a += alpha[t - 1, s - 2]
+            alpha[t, s] = a * p[t, ext[s]]
+    ll = alpha[T - 1, S - 1] + (alpha[T - 1, S - 2] if S > 1 else 0)
+    return -np.log(max(ll, 1e-30))
+
+
+def test_ctc_loss_vs_oracle():
+    np.random.seed(4)
+    T, N, C = 6, 2, 5
+    x = np.random.randn(T, N, C).astype("float32")
+    labels = np.array([[1, 2, 0, 0], [3, 3, 1, 0]], dtype="float32")
+    out = nd.contrib.CTCLoss(nd.array(x), nd.array(labels)).asnumpy()
+    for i in range(N):
+        ref = _np_ctc_loss(x[:, i], labels[i])
+        assert abs(out[i] - ref) < 1e-3, (i, out[i], ref)
+
+
+def test_khatri_rao():
+    a = np.random.randn(3, 2).astype("float32")
+    b = np.random.randn(3, 4).astype("float32")
+    out = nd.khatri_rao(nd.array(a), nd.array(b)).asnumpy()
+    ref = np.stack([np.kron(a[i], b[i]) for i in range(3)])
+    assert np.allclose(out, ref, atol=1e-5)
+
+
+def test_slice_assign_ops():
+    x = np.zeros((4, 4), dtype="float32")
+    v = np.ones((2, 2), dtype="float32")
+    out = nd._slice_assign(nd.array(x), nd.array(v), begin=(1, 1),
+                           end=(3, 3)).asnumpy()
+    assert out[1:3, 1:3].sum() == 4 and out.sum() == 4
+    out2 = nd._slice_assign_scalar(nd.array(x), begin=(0, 0), end=(2, 4),
+                                   scalar=2.5).asnumpy()
+    assert np.allclose(out2[:2], 2.5) and np.allclose(out2[2:], 0)
+
+
+# ------------------------------------------------------------- Custom op
+
+
+@mx.operator.register("sigmoid_custom")
+class SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        class SigmoidOp(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                x = in_data[0].asnumpy()
+                self.assign(out_data[0], req[0], 1 / (1 + np.exp(-x)))
+
+            def backward(self, req, out_grad, in_data, out_data,
+                         in_grad, aux):
+                y = out_data[0].asnumpy()
+                g = out_grad[0].asnumpy()
+                self.assign(in_grad[0], req[0], g * y * (1 - y))
+        return SigmoidOp()
+
+
+def test_custom_op_forward():
+    x = np.random.randn(3, 4).astype("float32")
+    out = nd.Custom(nd.array(x), op_type="sigmoid_custom").asnumpy()
+    assert np.allclose(out, 1 / (1 + np.exp(-x)), atol=1e-6)
+
+
+def test_custom_op_backward_autograd():
+    from mxtpu import autograd
+    x = nd.array(np.random.randn(3, 4).astype("float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="sigmoid_custom")
+        loss = nd.sum(y)
+    loss.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    assert np.allclose(x.grad.asnumpy(), s * (1 - s), atol=1e-5)
+
+
+def test_custom_op_in_symbol_executor():
+    import mxtpu as mx
+    data = mx.sym.Variable("data")
+    y = mx.sym.Custom(data, op_type="sigmoid_custom", name="sig")
+    exe = y.simple_bind(mx.cpu(), data=(2, 3))
+    x = np.random.randn(2, 3).astype("float32")
+    out = exe.forward(is_train=False, data=mx.nd.array(x))[0].asnumpy()
+    assert np.allclose(out, 1 / (1 + np.exp(-x)), atol=1e-6)
+
+
+def test_no_gradient_op():
+    out = nd._NoGradient()
+    assert out.asnumpy().shape == (1,)
