@@ -1,0 +1,155 @@
+"""Host-side asynchronous dependency engine.
+
+Parity: include/mxnet/engine.h:93-268 (``Engine::Get`` singleton with
+``NewVariable``/``PushAsync``/``WaitForVar``/``WaitForAll``) and the
+``MXNET_ENGINE_TYPE`` selection mechanism (src/engine/engine.cc:31-57).
+
+TPU-native scope: the reference engine schedules *every tensor op*; on TPU
+that role belongs to XLA/PJRT async dispatch, so this engine sequences the
+host-side task graph instead — prefetch/decode, checkpoint IO, custom-op
+callbacks, host staging — with the same read/write-variable protocol.
+Two engines, mirroring the reference:
+
+- ``ThreadedEngine`` (default): backed by the native C++ scheduler
+  (src/core/engine.cc) via ctypes.
+- ``NaiveEngine``: runs every push synchronously on the calling thread
+  (debugging aid, exactly like ``MXNET_ENGINE_TYPE=NaiveEngine``).
+
+Select with ``MXTPU_ENGINE_TYPE`` (``MXNET_ENGINE_TYPE`` also honored).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+from . import _native
+from ._native import check_call
+
+
+class Var:
+    """Engine variable handle (parity: engine.h VarHandle)."""
+
+    __slots__ = ("handle", "_engine")
+
+    def __init__(self, handle, engine):
+        self.handle = handle
+        self._engine = engine
+
+
+class NaiveEngine:
+    """Fully synchronous engine (parity: src/engine/naive_engine.cc:34)."""
+
+    def new_variable(self):
+        return Var(None, self)
+
+    def delete_variable(self, var):
+        pass
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        fn()
+
+    def wait_for_var(self, var):
+        pass
+
+    def wait_for_all(self):
+        pass
+
+    @property
+    def num_workers(self):
+        return 0
+
+    @property
+    def ops_completed(self):
+        return 0
+
+
+class ThreadedEngine:
+    """Native C++ dependency engine (src/core/engine.{h,cc})."""
+
+    def __init__(self):
+        self._lib = _native.get_lib()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        # One persistent dispatcher CFUNCTYPE for every push: per-op Python
+        # closures are kept in a table keyed by the ctx token, so no ctypes
+        # thunk is ever freed while a native thread may still be inside it.
+        self._pending = {}
+        self._pending_lock = threading.Lock()
+        self._next_token = 0
+        self._dispatch_cb = _native.ASYNC_FN(self._dispatch)
+        # Drain before interpreter teardown: the native worker threads call
+        # back into Python, which must still be alive when they do.
+        import atexit
+
+        atexit.register(self.wait_for_all)
+
+    def _dispatch(self, ctx):
+        token = int(ctx) if ctx is not None else 0
+        with self._pending_lock:
+            fn = self._pending.pop(token, None)
+        if fn is not None:
+            fn()
+
+    def new_variable(self):
+        h = ctypes.c_void_p()
+        check_call(self._lib.MXTPUEngineNewVar(ctypes.byref(h)))
+        return Var(h, self)
+
+    def delete_variable(self, var):
+        if var.handle is not None:
+            check_call(self._lib.MXTPUEngineDeleteVar(var.handle))
+            var.handle = None
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        with self._pending_lock:
+            self._next_token += 1
+            token = self._next_token  # nonzero: ctx NULL maps to token 0
+            self._pending[token] = fn
+        n_c, n_m = len(const_vars), len(mutable_vars)
+        cv = (ctypes.c_void_p * max(n_c, 1))(
+            *[v.handle for v in const_vars]) if n_c else None
+        mv = (ctypes.c_void_p * max(n_m, 1))(
+            *[v.handle for v in mutable_vars]) if n_m else None
+        check_call(self._lib.MXTPUEnginePushAsync(
+            self._dispatch_cb, ctypes.c_void_p(token), cv, n_c, mv, n_m,
+            priority))
+
+    def wait_for_var(self, var):
+        if var.handle is not None:
+            check_call(self._lib.MXTPUEngineWaitForVar(var.handle))
+
+    def wait_for_all(self):
+        check_call(self._lib.MXTPUEngineWaitForAll())
+
+    @property
+    def num_workers(self):
+        out = ctypes.c_int()
+        check_call(self._lib.MXTPUEngineNumWorkers(ctypes.byref(out)))
+        return out.value
+
+    @property
+    def ops_completed(self):
+        out = ctypes.c_uint64()
+        check_call(self._lib.MXTPUEngineOpsCompleted(ctypes.byref(out)))
+        return out.value
+
+
+_ENGINE = None
+_ENGINE_LOCK = threading.Lock()
+
+
+def get():
+    """Engine singleton (parity: Engine::Get, selection engine.cc:31-57)."""
+    global _ENGINE
+    if _ENGINE is None:
+        with _ENGINE_LOCK:
+            if _ENGINE is None:
+                kind = os.environ.get(
+                    "MXTPU_ENGINE_TYPE",
+                    os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEngine"))
+                if kind == "NaiveEngine" or not _native.native_available():
+                    _ENGINE = NaiveEngine()
+                else:
+                    _ENGINE = ThreadedEngine()
+    return _ENGINE

@@ -21,28 +21,60 @@ _IR_SIZE = struct.calcsize(_IR_FORMAT)
 
 
 class MXRecordIO:
-    """Sequential record file reader/writer (parity recordio.py MXRecordIO)."""
+    """Sequential record file reader/writer (parity recordio.py MXRecordIO).
+
+    Backed by the native reader/writer (src/core/recordio.cc) when
+    libmxtpu.so is available; transparently falls back to pure Python.
+    """
 
     def __init__(self, uri, flag):
         self.uri = uri
         self.flag = flag
         self.handle = None
+        self._nh = None  # native handle
+        self._lib = None
         self.open()
 
     def open(self):
+        from . import _native
+
+        lib = _native.get_lib()
         if self.flag == "w":
-            self.handle = open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.handle = open(self.uri, "rb")
             self.writable = False
         else:
             raise ValueError("Invalid flag %s" % self.flag)
+        if lib is not None:
+            import ctypes
+
+            self._lib = lib
+            h = ctypes.c_void_p()
+            uri = self.uri.encode("utf-8")
+            if self.writable:
+                _native.check_call(lib.MXTPURecordWriterCreate(
+                    uri, ctypes.byref(h)))
+            else:
+                _native.check_call(lib.MXTPURecordReaderCreate(
+                    uri, ctypes.byref(h)))
+            self._nh = h
+        else:
+            self.handle = open(self.uri, "wb" if self.writable else "rb")
         self.is_open = True
 
     def close(self):
         if self.is_open:
-            self.handle.close()
+            if self._nh is not None:
+                from . import _native
+
+                if self.writable:
+                    _native.check_call(self._lib.MXTPURecordWriterFree(self._nh))
+                else:
+                    _native.check_call(self._lib.MXTPURecordReaderFree(self._nh))
+                self._nh = None
+            if self.handle is not None:
+                self.handle.close()
+                self.handle = None
             self.is_open = False
 
     def __del__(self):
@@ -56,13 +88,35 @@ class MXRecordIO:
         self.open()
 
     def tell(self):
+        if self._nh is not None:
+            import ctypes
+
+            from . import _native
+
+            pos = ctypes.c_uint64()
+            fn = (self._lib.MXTPURecordWriterTell if self.writable
+                  else self._lib.MXTPURecordReaderTell)
+            _native.check_call(fn(self._nh, ctypes.byref(pos)))
+            return pos.value
         return self.handle.tell()
 
     def seek(self, pos):
-        self.handle.seek(pos)
+        assert not self.writable
+        if self._nh is not None:
+            from . import _native
+
+            _native.check_call(self._lib.MXTPURecordReaderSeek(self._nh, pos))
+        else:
+            self.handle.seek(pos)
 
     def write(self, buf):
         assert self.writable
+        if self._nh is not None:
+            from . import _native
+
+            _native.check_call(self._lib.MXTPURecordWriterWrite(
+                self._nh, bytes(buf), len(buf)))
+            return
         length = len(buf)
         self.handle.write(struct.pack("<II", _MAGIC, length))
         self.handle.write(buf)
@@ -72,6 +126,18 @@ class MXRecordIO:
 
     def read(self):
         assert not self.writable
+        if self._nh is not None:
+            import ctypes
+
+            from . import _native
+
+            data = ctypes.c_void_p()
+            size = ctypes.c_uint64()
+            _native.check_call(self._lib.MXTPURecordReaderNext(
+                self._nh, ctypes.byref(data), ctypes.byref(size)))
+            if not data.value:
+                return None
+            return ctypes.string_at(data.value, size.value)
         header = self.handle.read(8)
         if len(header) < 8:
             return None

@@ -1,0 +1,33 @@
+// mxtpu native runtime — base utilities.
+//
+// Parity: the reference's dmlc-core base layer (SURVEY.md L0; logging/error
+// surfaced through C API return codes like src/c_api via MXGetLastError).
+// TPU-native design: the native runtime only owns *host-side* concerns —
+// IO, staging memory, and host task scheduling. Device compute/memory is
+// XLA/PJRT's job, so there is no device abstraction here at all.
+#ifndef MXTPU_CORE_BASE_H_
+#define MXTPU_CORE_BASE_H_
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+
+namespace mxtpu {
+
+// Error type thrown by runtime internals; the C API boundary catches these
+// and stashes the message in a thread-local (c_api.cc) for
+// MXTPUGetLastError, mirroring the reference's MXNetError/MXGetLastError
+// contract (python/mxnet/base.py check_call).
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string& msg) : std::runtime_error(msg) {}
+};
+
+#define MXTPU_CHECK(cond, msg)                          \
+  do {                                                  \
+    if (!(cond)) throw ::mxtpu::Error(msg);             \
+  } while (0)
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_CORE_BASE_H_

@@ -1,0 +1,202 @@
+// C ABI for the mxtpu native runtime, loaded from Python via ctypes
+// (mxtpu/_native.py).
+//
+// Parity: the reference's C API boundary pattern (include/mxnet/c_api.h —
+// every function returns 0/-1 with the message retrievable via
+// MXGetLastError; src/c_api/c_api_error.h). Surface covered here is the
+// native-runtime subset: storage pool, recordio, dependency engine,
+// threaded prefetch. Graph/ops/executor live in the JAX/XLA layer where
+// they belong on TPU.
+#include <cstring>
+#include <string>
+
+#include "base.h"
+#include "engine.h"
+#include "recordio.h"
+#include "storage.h"
+#include "threaded_iter.h"
+
+namespace {
+thread_local std::string last_error;
+}  // namespace
+
+#define API_BEGIN() try {
+#define API_END()                          \
+  }                                        \
+  catch (const std::exception& e) {       \
+    last_error = e.what();                 \
+    return -1;                             \
+  }                                        \
+  return 0;
+
+extern "C" {
+
+const char* MXTPUGetLastError() { return last_error.c_str(); }
+
+// ---------------------------------------------------------------- storage
+int MXTPUStorageAlloc(uint64_t size, void** out) {
+  API_BEGIN();
+  *out = mxtpu::PooledStorage::Get()->Alloc(size);
+  API_END();
+}
+
+int MXTPUStorageFree(void* ptr) {
+  API_BEGIN();
+  mxtpu::PooledStorage::Get()->Free(ptr);
+  API_END();
+}
+
+int MXTPUStorageDirectFree(void* ptr) {
+  API_BEGIN();
+  mxtpu::PooledStorage::Get()->DirectFree(ptr);
+  API_END();
+}
+
+int MXTPUStorageReleaseAll() {
+  API_BEGIN();
+  mxtpu::PooledStorage::Get()->ReleaseAll();
+  API_END();
+}
+
+int MXTPUStorageStats(uint64_t* allocated, uint64_t* pooled) {
+  API_BEGIN();
+  *allocated = mxtpu::PooledStorage::Get()->bytes_allocated();
+  *pooled = mxtpu::PooledStorage::Get()->bytes_pooled();
+  API_END();
+}
+
+// --------------------------------------------------------------- recordio
+int MXTPURecordWriterCreate(const char* path, void** out) {
+  API_BEGIN();
+  *out = new mxtpu::RecordWriter(path);
+  API_END();
+}
+
+int MXTPURecordWriterWrite(void* handle, const void* data, uint64_t size) {
+  API_BEGIN();
+  static_cast<mxtpu::RecordWriter*>(handle)->Write(data, size);
+  API_END();
+}
+
+int MXTPURecordWriterTell(void* handle, uint64_t* pos) {
+  API_BEGIN();
+  *pos = static_cast<mxtpu::RecordWriter*>(handle)->Tell();
+  API_END();
+}
+
+int MXTPURecordWriterFree(void* handle) {
+  API_BEGIN();
+  delete static_cast<mxtpu::RecordWriter*>(handle);
+  API_END();
+}
+
+int MXTPURecordReaderCreate(const char* path, void** out) {
+  API_BEGIN();
+  *out = new mxtpu::RecordReader(path);
+  API_END();
+}
+
+// *out_data == nullptr and *size == 0 at end-of-file (rc still 0).
+int MXTPURecordReaderNext(void* handle, const char** out_data,
+                          uint64_t* size) {
+  API_BEGIN();
+  if (!static_cast<mxtpu::RecordReader*>(handle)->Next(out_data, size)) {
+    *out_data = nullptr;
+    *size = 0;
+  }
+  API_END();
+}
+
+int MXTPURecordReaderSeek(void* handle, uint64_t pos) {
+  API_BEGIN();
+  static_cast<mxtpu::RecordReader*>(handle)->Seek(pos);
+  API_END();
+}
+
+int MXTPURecordReaderTell(void* handle, uint64_t* pos) {
+  API_BEGIN();
+  *pos = static_cast<mxtpu::RecordReader*>(handle)->Tell();
+  API_END();
+}
+
+int MXTPURecordReaderFree(void* handle) {
+  API_BEGIN();
+  delete static_cast<mxtpu::RecordReader*>(handle);
+  API_END();
+}
+
+// ----------------------------------------------------------------- engine
+typedef void (*MXTPUAsyncFn)(void* ctx);
+
+int MXTPUEngineNewVar(void** out) {
+  API_BEGIN();
+  *out = mxtpu::Engine::Get()->NewVariable();
+  API_END();
+}
+
+int MXTPUEngineDeleteVar(void* var) {
+  API_BEGIN();
+  mxtpu::Engine::Get()->DeleteVariable(static_cast<mxtpu::Var*>(var));
+  API_END();
+}
+
+int MXTPUEnginePushAsync(MXTPUAsyncFn fn, void* ctx, void** const_vars,
+                         int n_const, void** mut_vars, int n_mut,
+                         int priority) {
+  API_BEGIN();
+  std::vector<mxtpu::Var*> cv(n_const), mv(n_mut);
+  for (int i = 0; i < n_const; ++i) cv[i] = static_cast<mxtpu::Var*>(const_vars[i]);
+  for (int i = 0; i < n_mut; ++i) mv[i] = static_cast<mxtpu::Var*>(mut_vars[i]);
+  mxtpu::Engine::Get()->PushAsync([fn, ctx] { fn(ctx); }, std::move(cv),
+                                  std::move(mv), priority);
+  API_END();
+}
+
+int MXTPUEngineWaitForVar(void* var) {
+  API_BEGIN();
+  mxtpu::Engine::Get()->WaitForVar(static_cast<mxtpu::Var*>(var));
+  API_END();
+}
+
+int MXTPUEngineWaitForAll() {
+  API_BEGIN();
+  mxtpu::Engine::Get()->WaitForAll();
+  API_END();
+}
+
+int MXTPUEngineNumWorkers(int* out) {
+  API_BEGIN();
+  *out = mxtpu::Engine::Get()->num_workers();
+  API_END();
+}
+
+int MXTPUEngineOpsCompleted(uint64_t* out) {
+  API_BEGIN();
+  *out = mxtpu::Engine::Get()->ops_completed();
+  API_END();
+}
+
+// ---------------------------------------------------------- threaded iter
+int MXTPUThreadedIterCreate(mxtpu::ThreadedIter::ProduceFn fn, void* ctx,
+                            int max_prefetch, void** out) {
+  API_BEGIN();
+  *out = new mxtpu::ThreadedIter(fn, ctx, max_prefetch);
+  API_END();
+}
+
+// *out_item == nullptr at end-of-stream (rc still 0).
+int MXTPUThreadedIterNext(void* handle, void** out_item) {
+  API_BEGIN();
+  if (!static_cast<mxtpu::ThreadedIter*>(handle)->Next(out_item)) {
+    *out_item = nullptr;
+  }
+  API_END();
+}
+
+int MXTPUThreadedIterFree(void* handle) {
+  API_BEGIN();
+  delete static_cast<mxtpu::ThreadedIter*>(handle);
+  API_END();
+}
+
+}  // extern "C"

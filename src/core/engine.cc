@@ -1,0 +1,179 @@
+#include "engine.h"
+
+#include <cstdlib>
+
+#include "base.h"
+
+namespace mxtpu {
+
+Engine* Engine::Get() {
+  static Engine inst(0);
+  return &inst;
+}
+
+Engine::Engine(int num_workers) {
+  if (num_workers <= 0) {
+    const char* env = getenv("MXTPU_ENGINE_NTHREADS");
+    if (env != nullptr) num_workers = atoi(env);
+    if (num_workers <= 0) {
+      const unsigned hc = std::thread::hardware_concurrency();
+      num_workers = hc > 8 ? 8 : (hc < 2 ? 2 : static_cast<int>(hc));
+    }
+  }
+  workers_.reserve(num_workers);
+  for (int i = 0; i < num_workers; ++i) {
+    workers_.emplace_back([this] { WorkerLoop(); });
+  }
+}
+
+Engine::~Engine() {
+  {
+    std::unique_lock<std::mutex> lock(state_mu_);
+    idle_cv_.wait(lock, [this] { return pending_ == 0; });
+    shutdown_ = true;
+  }
+  ready_cv_.notify_all();
+  for (auto& t : workers_) t.join();
+}
+
+Var* Engine::NewVariable() { return new Var(); }
+
+void Engine::DeleteVariable(Var* var) {
+  // Serialize deletion behind all outstanding ops on the var by pushing it
+  // as a write; CompleteOpr reclaims the Var when the token retires.
+  auto* opr = new Opr();
+  opr->fn = [] {};
+  opr->mut_vars = {var};
+  opr->delete_var = var;
+  opr->priority = 1 << 20;  // retire promptly once unblocked
+  std::lock_guard<std::mutex> lock(state_mu_);
+  opr->seq = next_seq_++;
+  opr->wait = 1;
+  ++pending_;
+  var->queue.push_back(VarToken{opr, /*is_write=*/true});
+  Advance(var);
+}
+
+void Engine::PushAsync(std::function<void()> fn, std::vector<Var*> const_vars,
+                       std::vector<Var*> mut_vars, int priority) {
+  auto* opr = new Opr();
+  opr->fn = std::move(fn);
+  opr->const_vars = std::move(const_vars);
+  opr->mut_vars = std::move(mut_vars);
+  opr->priority = priority;
+  std::lock_guard<std::mutex> lock(state_mu_);
+  opr->seq = next_seq_++;
+  opr->wait =
+      static_cast<int>(opr->const_vars.size() + opr->mut_vars.size());
+  ++pending_;
+  if (opr->wait == 0) {
+    ready_.push(opr);
+    ready_cv_.notify_one();
+    return;
+  }
+  for (Var* v : opr->const_vars) {
+    v->queue.push_back(VarToken{opr, /*is_write=*/false});
+  }
+  for (Var* v : opr->mut_vars) {
+    v->queue.push_back(VarToken{opr, /*is_write=*/true});
+  }
+  for (Var* v : opr->const_vars) Advance(v);
+  for (Var* v : opr->mut_vars) Advance(v);
+}
+
+void Engine::WaitForVar(Var* var) {
+  struct Signal {
+    std::mutex mu;
+    std::condition_variable cv;
+    bool done = false;
+  };
+  auto sig = std::make_shared<Signal>();
+  PushAsync(
+      [sig] {
+        std::lock_guard<std::mutex> lock(sig->mu);
+        sig->done = true;
+        sig->cv.notify_all();
+      },
+      {var}, {}, /*priority=*/1 << 20);
+  std::unique_lock<std::mutex> lock(sig->mu);
+  sig->cv.wait(lock, [&] { return sig->done; });
+}
+
+void Engine::WaitForAll() {
+  std::unique_lock<std::mutex> lock(state_mu_);
+  idle_cv_.wait(lock, [this] { return pending_ == 0; });
+}
+
+void Engine::Advance(Var* var) {
+  auto& q = var->queue;
+  while (!q.empty() && q.front().done) q.pop_front();
+  for (auto it = q.begin(); it != q.end(); ++it) {
+    if (it->is_write) {
+      if (it == q.begin() && !it->granted) {
+        it->granted = true;
+        if (--it->opr->wait == 0) {
+          ready_.push(it->opr);
+          ready_cv_.notify_one();
+        }
+      }
+      break;  // nothing behind a pending/running write may start
+    }
+    if (!it->granted) {
+      it->granted = true;
+      if (--it->opr->wait == 0) {
+        ready_.push(it->opr);
+        ready_cv_.notify_one();
+      }
+    }
+  }
+}
+
+void Engine::CompleteOpr(Opr* opr) {
+  std::lock_guard<std::mutex> lock(state_mu_);
+  for (Var* v : opr->const_vars) {
+    for (auto& tok : v->queue) {
+      if (tok.opr == opr) {
+        tok.done = true;
+        break;
+      }
+    }
+    Advance(v);
+  }
+  Var* to_delete = opr->delete_var;
+  for (Var* v : opr->mut_vars) {
+    for (auto& tok : v->queue) {
+      if (tok.opr == opr) {
+        tok.done = true;
+        break;
+      }
+    }
+    ++v->version;
+    if (v != to_delete) Advance(v);
+  }
+  if (to_delete != nullptr) {
+    auto& q = to_delete->queue;
+    while (!q.empty() && q.front().done) q.pop_front();
+    MXTPU_CHECK(q.empty(), "DeleteVariable: ops pushed after deletion");
+    delete to_delete;
+  }
+  delete opr;
+  ops_completed_.fetch_add(1);
+  if (--pending_ == 0) idle_cv_.notify_all();
+}
+
+void Engine::WorkerLoop() {
+  for (;;) {
+    Opr* opr = nullptr;
+    {
+      std::unique_lock<std::mutex> lock(state_mu_);
+      ready_cv_.wait(lock, [this] { return shutdown_ || !ready_.empty(); });
+      if (shutdown_ && ready_.empty()) return;
+      opr = ready_.top();
+      ready_.pop();
+    }
+    opr->fn();
+    CompleteOpr(opr);
+  }
+}
+
+}  // namespace mxtpu

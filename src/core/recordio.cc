@@ -1,0 +1,77 @@
+#include "recordio.h"
+
+#include <cstring>
+
+#include "base.h"
+
+namespace mxtpu {
+
+RecordWriter::RecordWriter(const std::string& path) {
+  fp_ = fopen(path.c_str(), "wb");
+  MXTPU_CHECK(fp_ != nullptr, "RecordWriter: cannot open " + path);
+}
+
+RecordWriter::~RecordWriter() {
+  if (fp_) fclose(fp_);
+}
+
+void RecordWriter::Write(const void* data, uint64_t size) {
+  const uint32_t header[2] = {kRecordMagic, static_cast<uint32_t>(size)};
+  MXTPU_CHECK(size <= 0xffffffffu, "RecordWriter: record too large");
+  MXTPU_CHECK(fwrite(header, sizeof(header), 1, fp_) == 1,
+              "RecordWriter: write failed");
+  if (size > 0) {
+    MXTPU_CHECK(fwrite(data, 1, size, fp_) == size,
+                "RecordWriter: write failed");
+  }
+  static const char zeros[4] = {0, 0, 0, 0};
+  const uint64_t pad = (4 - size % 4) % 4;
+  if (pad) {
+    MXTPU_CHECK(fwrite(zeros, 1, pad, fp_) == pad,
+                "RecordWriter: write failed");
+  }
+}
+
+uint64_t RecordWriter::Tell() { return static_cast<uint64_t>(ftell(fp_)); }
+
+void RecordWriter::Flush() { fflush(fp_); }
+
+RecordReader::RecordReader(const std::string& path) {
+  fp_ = fopen(path.c_str(), "rb");
+  MXTPU_CHECK(fp_ != nullptr, "RecordReader: cannot open " + path);
+}
+
+RecordReader::~RecordReader() {
+  if (fp_) fclose(fp_);
+}
+
+bool RecordReader::Next(const char** out, uint64_t* size) {
+  uint32_t header[2];
+  if (fread(header, sizeof(header), 1, fp_) != 1) {
+    *out = nullptr;
+    *size = 0;
+    return false;  // EOF
+  }
+  MXTPU_CHECK(header[0] == kRecordMagic, "RecordReader: bad magic (corrupt file?)");
+  const uint64_t len = header[1];
+  const uint64_t padded = len + (4 - len % 4) % 4;
+  // Keep data() non-null even for empty records: null signals EOF at the
+  // C API boundary.
+  if (buf_.size() < padded || buf_.empty()) buf_.resize(padded ? padded : 4);
+  if (padded > 0) {
+    MXTPU_CHECK(fread(buf_.data(), 1, padded, fp_) == padded,
+                "RecordReader: truncated record");
+  }
+  *out = buf_.data();
+  *size = len;
+  return true;
+}
+
+void RecordReader::Seek(uint64_t pos) {
+  MXTPU_CHECK(fseek(fp_, static_cast<long>(pos), SEEK_SET) == 0,
+              "RecordReader: seek failed");
+}
+
+uint64_t RecordReader::Tell() { return static_cast<uint64_t>(ftell(fp_)); }
+
+}  // namespace mxtpu

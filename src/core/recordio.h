@@ -1,0 +1,49 @@
+// RecordIO file framing: sequential magic-delimited records, 4-byte aligned.
+//
+// Parity: the reference's recordio layer (dmlc recordio as used by
+// src/io/iter_image_recordio_2.cc and python/mxnet/recordio.py). The on-disk
+// format matches mxtpu/recordio.py exactly — [u32 magic][u32 length]
+// [payload][pad to 4] — so files written from Python read back here and
+// vice versa.
+#ifndef MXTPU_CORE_RECORDIO_H_
+#define MXTPU_CORE_RECORDIO_H_
+
+#include <cstdint>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+namespace mxtpu {
+
+constexpr uint32_t kRecordMagic = 0xced7230a;
+
+class RecordWriter {
+ public:
+  explicit RecordWriter(const std::string& path);
+  ~RecordWriter();
+  void Write(const void* data, uint64_t size);
+  uint64_t Tell();
+  void Flush();
+
+ private:
+  FILE* fp_;
+};
+
+class RecordReader {
+ public:
+  explicit RecordReader(const std::string& path);
+  ~RecordReader();
+  // Read the next record into an internal buffer. Returns false at EOF.
+  // The pointer stays valid until the next call.
+  bool Next(const char** out, uint64_t* size);
+  void Seek(uint64_t pos);
+  uint64_t Tell();
+
+ private:
+  FILE* fp_;
+  std::vector<char> buf_;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_CORE_RECORDIO_H_

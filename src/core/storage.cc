@@ -1,0 +1,78 @@
+#include "storage.h"
+
+#include <cstdlib>
+
+#include "base.h"
+
+namespace mxtpu {
+
+PooledStorage* PooledStorage::Get() {
+  static PooledStorage inst;
+  return &inst;
+}
+
+size_t PooledStorage::Bucket(size_t size) {
+  size_t b = 64;
+  while (b < size) b <<= 1;
+  return b;
+}
+
+void* PooledStorage::Alloc(size_t size) {
+  const size_t bucket = Bucket(size);
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = pool_.find(bucket);
+  void* ptr = nullptr;
+  if (it != pool_.end() && !it->second.empty()) {
+    ptr = it->second.back();
+    it->second.pop_back();
+    bytes_pooled_ -= bucket;
+  } else {
+    if (posix_memalign(&ptr, 64, bucket) != 0 || ptr == nullptr) {
+      throw Error("PooledStorage: out of host memory allocating " +
+                  std::to_string(bucket) + " bytes");
+    }
+  }
+  live_[ptr] = bucket;
+  bytes_allocated_ += bucket;
+  return ptr;
+}
+
+void PooledStorage::Free(void* ptr) {
+  if (ptr == nullptr) return;
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = live_.find(ptr);
+  MXTPU_CHECK(it != live_.end(), "PooledStorage::Free on unknown pointer");
+  const size_t bucket = it->second;
+  live_.erase(it);
+  bytes_allocated_ -= bucket;
+  pool_[bucket].push_back(ptr);
+  bytes_pooled_ += bucket;
+}
+
+void PooledStorage::DirectFree(void* ptr) {
+  if (ptr == nullptr) return;
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = live_.find(ptr);
+  MXTPU_CHECK(it != live_.end(), "PooledStorage::DirectFree on unknown pointer");
+  bytes_allocated_ -= it->second;
+  live_.erase(it);
+  free(ptr);
+}
+
+void PooledStorage::ReleaseAll() {
+  std::lock_guard<std::mutex> lock(mu_);
+  for (auto& kv : pool_) {
+    for (void* p : kv.second) free(p);
+  }
+  pool_.clear();
+  bytes_pooled_ = 0;
+}
+
+PooledStorage::~PooledStorage() {
+  for (auto& kv : pool_) {
+    for (void* p : kv.second) free(p);
+  }
+  // live_ blocks intentionally leak at process exit (owners may still run).
+}
+
+}  // namespace mxtpu

@@ -1,0 +1,52 @@
+// Pooled host-staging allocator.
+//
+// Parity: the reference's Storage singleton with a size-bucketed pooled
+// manager (include/mxnet/storage.h:35-93, src/storage/pooled_storage_manager.h:46).
+// TPU-native twist: the pool manages *host staging buffers* only (batch
+// assembly, recordio chunks, checkpoint spill). Device HBM is owned by
+// XLA/PJRT — pooling it here would fight the compiler's arena planner.
+#ifndef MXTPU_CORE_STORAGE_H_
+#define MXTPU_CORE_STORAGE_H_
+
+#include <cstddef>
+#include <cstdint>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace mxtpu {
+
+class PooledStorage {
+ public:
+  static PooledStorage* Get();
+
+  // Allocate >=size bytes, 64-byte aligned. Buckets to the next power of
+  // two so frees can be recycled across nearby sizes.
+  void* Alloc(size_t size);
+  // Return to the pool (fast path, no munmap/free).
+  void Free(void* ptr);
+  // Bypass the pool and release to the OS.
+  void DirectFree(void* ptr);
+  // Drop every pooled (unused) block back to the OS.
+  void ReleaseAll();
+
+  uint64_t bytes_allocated() const { return bytes_allocated_; }
+  uint64_t bytes_pooled() const { return bytes_pooled_; }
+
+ private:
+  PooledStorage() = default;
+  ~PooledStorage();
+  static size_t Bucket(size_t size);
+
+  std::mutex mu_;
+  // bucket size -> LIFO free list (LIFO keeps caches warm).
+  std::unordered_map<size_t, std::vector<void*>> pool_;
+  // live ptr -> bucket size it was allocated under.
+  std::unordered_map<void*, size_t> live_;
+  uint64_t bytes_allocated_ = 0;  // handed out and not yet freed
+  uint64_t bytes_pooled_ = 0;     // cached in the pool
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_CORE_STORAGE_H_

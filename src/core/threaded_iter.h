@@ -1,0 +1,91 @@
+// Bounded producer/consumer prefetch pipeline.
+//
+// Parity: dmlc::ThreadedIter as used by the reference's PrefetcherIter
+// (src/io/iter_prefetcher.h:46,141) — a background thread runs the
+// producer while the consumer double-buffers. Items are opaque pointers
+// owned by the producer (for the Python data pipeline they are handles
+// into the frontend's batch table; decode work inside the callback
+// releases the GIL in numpy/cv2, so the overlap is real).
+#ifndef MXTPU_CORE_THREADED_ITER_H_
+#define MXTPU_CORE_THREADED_ITER_H_
+
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <thread>
+
+namespace mxtpu {
+
+class ThreadedIter {
+ public:
+  // Returns 0 and sets *out_item on success, 1 at end-of-stream, <0 on
+  // error (stream terminates).
+  typedef int (*ProduceFn)(void* ctx, void** out_item);
+
+  ThreadedIter(ProduceFn fn, void* ctx, int max_prefetch)
+      : fn_(fn), ctx_(ctx), capacity_(max_prefetch < 1 ? 1 : max_prefetch) {
+    producer_ = std::thread([this] { ProducerLoop(); });
+  }
+
+  ~ThreadedIter() {
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      stop_ = true;
+    }
+    space_cv_.notify_all();
+    producer_.join();
+  }
+
+  // Blocks for the next item; returns false at end-of-stream.
+  bool Next(void** out_item) {
+    std::unique_lock<std::mutex> lock(mu_);
+    item_cv_.wait(lock, [this] { return !queue_.empty() || finished_; });
+    if (queue_.empty()) return false;
+    *out_item = queue_.front();
+    queue_.pop_front();
+    space_cv_.notify_one();
+    return true;
+  }
+
+ private:
+  void ProducerLoop() {
+    for (;;) {
+      void* item = nullptr;
+      const int rc = fn_(ctx_, &item);  // may block / take the GIL
+      std::unique_lock<std::mutex> lock(mu_);
+      // rc!=0 is EOF/error; a null item on rc==0 is also treated as
+      // termination — it is the consumer-side end-of-stream sentinel, and
+      // it is what a Python producer that raised looks like (ctypes
+      // returns 0 from a callback that threw).
+      if (rc != 0 || item == nullptr || stop_) {
+        finished_ = true;
+        item_cv_.notify_all();
+        return;
+      }
+      space_cv_.wait(lock, [this] {
+        return static_cast<int>(queue_.size()) < capacity_ || stop_;
+      });
+      if (stop_) {
+        finished_ = true;
+        item_cv_.notify_all();
+        return;
+      }
+      queue_.push_back(item);
+      item_cv_.notify_one();
+    }
+  }
+
+  ProduceFn fn_;
+  void* ctx_;
+  const int capacity_;
+  std::mutex mu_;
+  std::condition_variable item_cv_, space_cv_;
+  std::deque<void*> queue_;
+  std::thread producer_;
+  bool stop_ = false;
+  bool finished_ = false;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_CORE_THREADED_ITER_H_
