@@ -466,9 +466,9 @@ def LibSVMIter(data_libsvm, data_shape, batch_size=128, dense=False,
     labels = _np.asarray(labels, dtype="float32")
     if dense:
         dense_arr = _np.zeros((n, feat_dim), dtype="float32")
-        for r in range(n):
-            lo, hi = data_ptr[r], data_ptr[r + 1]
-            dense_arr[r, data_idx[lo:hi]] = data_vals[lo:hi]
+        ptr = _np.asarray(data_ptr)
+        rows = _np.repeat(_np.arange(n), _np.diff(ptr))
+        dense_arr[rows, _np.asarray(data_idx)] = data_vals
         return NDArrayIter(dense_arr.reshape((-1,) + tuple(data_shape)),
                            labels, batch_size=batch_size,
                            last_batch_handle="pad")
@@ -499,12 +499,27 @@ def LibSVMIter(data_libsvm, data_shape, batch_size=128, dense=False,
             pad = batch_size - (hi - lo)
             sl = csr[lo:hi]
             if pad:  # pad by wrapping like the reference's pad batches
-                # wrap indices modulo n so pad > n (tiny datasets) works
+                # wrap indices modulo n so pad > n (tiny datasets) works;
+                # gather pad rows straight from the CSR components — never
+                # densify the dataset.
                 wrap_rows = _np.arange(pad) % n
-                from .ndarray.sparse import _dense_to_csr
-                full = csr.asnumpy()
-                sl = _dense_to_csr(
-                    _np.concatenate([sl.asnumpy(), full[wrap_rows]]))
+                d = _np.asarray(csr._sp_data)
+                ix = _np.asarray(csr._sp_indices)
+                ptr = _np.asarray(csr._sp_indptr)
+                sel = _np.concatenate(
+                    [_np.arange(ptr[r], ptr[r + 1]) for r in wrap_rows]
+                    or [_np.zeros((0,), _np.int64)]).astype(_np.int64)
+                pad_counts = ptr[wrap_rows + 1] - ptr[wrap_rows]
+                sd = _np.asarray(sl._sp_data)
+                six = _np.asarray(sl._sp_indices)
+                sptr = _np.asarray(sl._sp_indptr)
+                from .ndarray.sparse import CSRNDArray
+                sl = CSRNDArray(
+                    _np.concatenate([sd, d[sel]]),
+                    _np.concatenate([six, ix[sel]]),
+                    _np.concatenate([sptr,
+                                     sptr[-1] + _np.cumsum(pad_counts)]),
+                    (batch_size, feat_dim))
             lab = labels[lo:hi]
             if pad:
                 lab = _np.concatenate([lab, labels[_np.arange(pad) % n]])

@@ -57,8 +57,14 @@ class BaseSparseNDArray(NDArray):
         return self._dense_cache
 
     @_data.setter
-    def _data(self, v):  # e.g. autograd writing grads
+    def _data(self, v):  # e.g. autograd grads, kvstore pull into this array
         self._dense_cache = v
+        # Dense writes must not desynchronize the sparse components: rebuild
+        # them eagerly so sparse readers (retain/dot/push) see the new value.
+        self._refresh_from_dense(_np.asarray(v))
+
+    def _refresh_from_dense(self, dense):
+        raise NotImplementedError
 
     @property
     def shape(self):
@@ -129,12 +135,19 @@ class CSRNDArray(BaseSparseNDArray):
         n, m = self._sp_shape
         data = _np.asarray(self._sp_data)
         indices = _np.asarray(self._sp_indices)
-        indptr = _np.asarray(self._sp_indptr)
+        indptr = _np.asarray(self._sp_indptr).astype(_np.int64)
         out = _np.zeros((n, m), dtype=self._sp_dtype)
-        for r in range(n):
-            lo, hi = indptr[r], indptr[r + 1]
-            out[r, indices[lo:hi]] = data[lo:hi]
+        rows = _np.repeat(_np.arange(n), _np.diff(indptr))
+        out[rows, indices] = data
         return jnp.asarray(out)
+
+    def _refresh_from_dense(self, dense):
+        rows, cols = _np.nonzero(dense)
+        self._sp_data = jnp.asarray(dense[rows, cols])
+        self._sp_indices = jnp.asarray(cols.astype(_np.int32))
+        counts = _np.bincount(rows, minlength=dense.shape[0])
+        self._sp_indptr = jnp.asarray(
+            _np.concatenate([[0], _np.cumsum(counts)]).astype(_np.int32))
 
     def _to_bcoo(self):
         """Device-side BCOO view for jit-compatible sparse math."""
@@ -152,8 +165,13 @@ class CSRNDArray(BaseSparseNDArray):
 
     def __getitem__(self, key):
         if isinstance(key, slice):
-            start = key.start or 0
-            stop = key.stop if key.stop is not None else self._sp_shape[0]
+            if key.step not in (None, 1):
+                raise MXNetError(
+                    "CSRNDArray slicing supports step=1 only (got step=%s)"
+                    % key.step)
+            start, stop, _ = key.indices(self._sp_shape[0])
+            if stop < start:
+                stop = start
             data = _np.asarray(self._sp_data)
             indices = _np.asarray(self._sp_indices)
             indptr = _np.asarray(self._sp_indptr)
@@ -191,6 +209,12 @@ class RowSparseNDArray(BaseSparseNDArray):
         if self._sp_data.shape[0] == 0:
             return out
         return out.at[self._sp_indices].set(self._sp_data)
+
+    def _refresh_from_dense(self, dense):
+        nz_rows = _np.nonzero(
+            _np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
+        self._sp_data = jnp.asarray(dense[nz_rows])
+        self._sp_indices = jnp.asarray(nz_rows.astype(_np.int32))
 
     def copy(self):
         return RowSparseNDArray(self._sp_data, self._sp_indices,
@@ -241,17 +265,12 @@ def _dense_to_csr(dense, ctx=None):
     if dense.ndim != 2:
         raise MXNetError("csr storage requires 2D")
     n, m = dense.shape
-    indptr = [0]
-    indices = []
-    data = []
-    for r in range(n):
-        nz = _np.nonzero(dense[r])[0]
-        indices.extend(nz.tolist())
-        data.extend(dense[r, nz].tolist())
-        indptr.append(len(indices))
-    return CSRNDArray(_np.asarray(data, dtype=dense.dtype),
-                      _np.asarray(indices, dtype=_np.int64),
-                      _np.asarray(indptr, dtype=_np.int64), (n, m), ctx)
+    rows, cols = _np.nonzero(dense)
+    counts = _np.bincount(rows, minlength=n)
+    indptr = _np.concatenate([[0], _np.cumsum(counts)])
+    return CSRNDArray(dense[rows, cols],
+                      cols.astype(_np.int64),
+                      indptr.astype(_np.int64), (n, m), ctx)
 
 
 def _dense_to_rsp(dense, ctx=None):
@@ -347,19 +366,13 @@ def add(lhs, rhs):
     """Sparse elemwise add; rsp+rsp stays row_sparse."""
     if isinstance(lhs, RowSparseNDArray) and isinstance(rhs,
                                                         RowSparseNDArray):
-        idx = _np.union1d(_np.asarray(lhs._sp_indices),
-                          _np.asarray(rhs._sp_indices))
+        lidx = _np.asarray(lhs._sp_indices)
+        ridx = _np.asarray(rhs._sp_indices)
+        idx = _np.union1d(lidx, ridx)
         shape = (len(idx),) + lhs.shape[1:]
         data = _np.zeros(shape, lhs.dtype)
-        li = {int(v): i for i, v in enumerate(_np.asarray(lhs._sp_indices))}
-        ri = {int(v): i for i, v in enumerate(_np.asarray(rhs._sp_indices))}
-        ld = _np.asarray(lhs._sp_data)
-        rd = _np.asarray(rhs._sp_data)
-        for i, v in enumerate(idx):
-            if int(v) in li:
-                data[i] += ld[li[int(v)]]
-            if int(v) in ri:
-                data[i] += rd[ri[int(v)]]
+        _np.add.at(data, _np.searchsorted(idx, lidx), _np.asarray(lhs._sp_data))
+        _np.add.at(data, _np.searchsorted(idx, ridx), _np.asarray(rhs._sp_data))
         return RowSparseNDArray(data, idx, lhs.shape, lhs.context)
     if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
         # csr + csr stays csr (reference elemwise_binary_op csr kernels);

@@ -303,6 +303,8 @@ def _deformable_conv(a, data, offset, weight, bias=None):
     dh, dw = (int(x) for x in (tuple(a.dilate) or (1, 1)))
     N, C, H, W = data.shape
     F = int(a.num_filter)
+    G = int(a.num_group)
+    DG = int(a.num_deformable_group)
     out_h = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
     out_w = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
 
@@ -312,18 +314,29 @@ def _deformable_conv(a, data, offset, weight, bias=None):
     kx = (jnp.arange(kw) * dw)[None, None, None, :]  # (1,1,1,kw)
 
     def one(d, off):
-        # off (2*kh*kw, oh, ow) -> dy/dx (oh, ow, kh, kw)
-        off = off.reshape(kh * kw, 2, out_h, out_w)
-        dy = jnp.transpose(off[:, 0], (1, 2, 0)).reshape(out_h, out_w, kh, kw)
-        dx = jnp.transpose(off[:, 1], (1, 2, 0)).reshape(out_h, out_w, kh, kw)
-        gy = base_y[..., None] + ky[0] + dy  # (oh,ow,kh,kw)
-        gx = base_x[..., None] + kx[0] + dx
-        cols = _bilinear_gather(d, gx, gy)  # (C, oh, ow, kh, kw)
-        return cols
+        # off (2*DG*kh*kw, oh, ow): one offset field per deformable group,
+        # each applied to its C/DG slice of input channels.
+        off = off.reshape(DG, kh * kw, 2, out_h, out_w)
+
+        def per_dg(o_dg, d_dg):
+            dy = jnp.transpose(o_dg[:, 0], (1, 2, 0)).reshape(
+                out_h, out_w, kh, kw)
+            dx = jnp.transpose(o_dg[:, 1], (1, 2, 0)).reshape(
+                out_h, out_w, kh, kw)
+            gy = base_y[..., None] + ky[0] + dy  # (oh,ow,kh,kw)
+            gx = base_x[..., None] + kx[0] + dx
+            return _bilinear_gather(d_dg, gx, gy)  # (C/DG,oh,ow,kh,kw)
+
+        cols = jax.vmap(per_dg)(off, d.reshape(DG, C // DG, H, W))
+        return cols.reshape(C, out_h, out_w, kh, kw)
 
     cols = jax.vmap(one)(data, offset)  # (N,C,oh,ow,kh,kw)
-    out = jnp.einsum("nchwyx,fcyx->nfhw",
-                     cols, weight.reshape(F, C, kh, kw))
+    # grouped conv: weight is (F, C/G, kh, kw); each group of F/G filters
+    # sees its own C/G slice of input channels.
+    cols_g = cols.reshape(N, G, C // G, out_h, out_w, kh, kw)
+    w_g = weight.reshape(G, F // G, C // G, kh, kw)
+    out = jnp.einsum("ngchwyx,gfcyx->ngfhw", cols_g, w_g).reshape(
+        N, F, out_h, out_w)
     if bias is not None:
         out = out + bias.reshape(1, -1, 1, 1)
     return out
@@ -362,7 +375,7 @@ def _deformable_psroi_pooling(a, data, rois, trans=None):
         rh = jnp.maximum(y2 - y1, 0.1)
         bin_w = rw / pooled
         bin_h = rh / pooled
-        sub = 4  # sampling taps per bin edge (ref sample_per_part)
+        sub = int(a.sample_per_part)  # sampling taps per bin edge
         gi = jnp.arange(pooled)
         f = feat.reshape(odim, group, group, H, W)
 
@@ -483,6 +496,12 @@ def _proposal_one(score, bbox_deltas, im_info, a):
     keep = _nms_scan(b, s, jnp.zeros_like(s), float(a.threshold), True)
     s = jnp.where(keep, s, -jnp.inf)
     order2 = jnp.argsort(-s)[:post]
+    # When NMS keeps fewer than post proposals, cycle through the kept ones
+    # instead of emitting suppressed boxes (reference proposal.cc pads from
+    # the kept set).
+    num_kept = jnp.maximum(jnp.sum(jnp.isfinite(s[order2])), 1)
+    slot = jnp.arange(post)
+    order2 = order2[jnp.where(slot < num_kept, slot, slot % num_kept)]
     out_boxes = b[order2]
     out_scores = jnp.where(jnp.isfinite(s[order2]), s[order2], 0.0)
     rois = jnp.concatenate([jnp.zeros((post, 1), b.dtype), out_boxes],

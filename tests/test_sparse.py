@@ -190,3 +190,40 @@ def test_sparse_save_load_dense_interop():
         nd.save(path, {"w": csr.todense()})
         back = nd.load(path)["w"]
         assert np.allclose(back.asnumpy(), dense)
+
+
+def test_csr_negative_and_step_slices():
+    import numpy as np
+    import pytest
+    from mxtpu.ndarray import sparse as sp
+    from mxtpu.base import MXNetError
+
+    dense = np.zeros((6, 4), np.float32)
+    dense[0, 1] = 1; dense[2, 3] = 2; dense[5, 0] = 3
+    csr = sp.csr_matrix(dense)
+    np.testing.assert_allclose(csr[:-1].asnumpy(), dense[:-1])
+    np.testing.assert_allclose(csr[-3:].asnumpy(), dense[-3:])
+    np.testing.assert_allclose(csr[2:2].asnumpy(), dense[2:2])
+    with pytest.raises(MXNetError):
+        csr[0:6:2]
+
+
+def test_sparse_dense_write_resyncs_components():
+    import numpy as np
+    import jax.numpy as jnp
+    from mxtpu.ndarray import sparse as sp
+
+    dense = np.zeros((4, 3), np.float32)
+    dense[1, 2] = 5.0
+    csr = sp.csr_matrix(dense)
+    new = np.zeros((4, 3), np.float32)
+    new[0, 0] = 7.0
+    csr._data = jnp.asarray(new)  # dense write (kvstore pull path)
+    assert csr.nnz == 1
+    np.testing.assert_allclose(np.asarray(csr.data.asnumpy()), [7.0])
+    np.testing.assert_allclose(csr.asnumpy(), new)
+
+    rsp = sp.row_sparse_array(dense)
+    rsp._data = jnp.asarray(new)
+    np.testing.assert_allclose(np.asarray(rsp.indices.asnumpy()), [0])
+    np.testing.assert_allclose(rsp.asnumpy(), new)

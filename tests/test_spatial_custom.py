@@ -276,3 +276,54 @@ def test_custom_op_in_symbol_executor():
 def test_no_gradient_op():
     out = nd._NoGradient()
     assert out.asnumpy().shape == (1,)
+
+
+def test_deformable_conv_groups():
+    import numpy as np
+    from mxtpu import nd
+
+    rng = np.random.RandomState(0)
+    N, C, H, W, F = 2, 4, 6, 6, 4
+    x = rng.rand(N, C, H, W).astype('float32')
+    # num_group=2: weight carries C/2 input channels per filter
+    w = rng.rand(F, C // 2, 3, 3).astype('float32')
+    off = np.zeros((N, 2 * 2 * 9, H, W), 'float32')  # num_deformable_group=2
+    out = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), kernel=(3, 3), pad=(1, 1),
+        num_filter=F, num_group=2, num_deformable_group=2)
+    assert out.shape == (N, F, H, W)
+    # zero offsets must equal a plain grouped convolution
+    ref = np.zeros((N, F, H, W), 'float32')
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    for g in range(2):
+        for f in range(2):
+            fi = g * 2 + f
+            for i in range(H):
+                for j in range(W):
+                    patch = xp[:, g * 2:(g + 1) * 2, i:i + 3, j:j + 3]
+                    ref[:, fi, i, j] = (patch * w[fi]).sum(axis=(1, 2, 3))
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_proposal_pads_with_kept_boxes():
+    import numpy as np
+    from mxtpu import nd
+
+    # One dominant box; aggressive NMS keeps very few. Output must cycle
+    # kept proposals, never emit suppressed ones.
+    H = W = 4
+    A = 1
+    rng = np.random.RandomState(0)
+    score = rng.rand(1, 2 * A, H, W).astype('float32')
+    bbox = np.zeros((1, 4 * A, H, W), 'float32')
+    im_info = np.array([[64.0, 64.0, 1.0]], 'float32')
+    rois = nd.contrib.Proposal(
+        nd.array(score), nd.array(bbox), nd.array(im_info),
+        feature_stride=16, scales=(8,), ratios=(1.0,),
+        rpn_pre_nms_top_n=16, rpn_post_nms_top_n=12, threshold=0.01,
+        rpn_min_size=1).asnumpy()
+    assert rois.shape == (12, 5)
+    # with threshold 0.01 nearly everything overlapping is suppressed;
+    # padded slots must duplicate kept boxes, so unique rows are few
+    uniq = np.unique(np.round(rois, 3), axis=0)
+    assert len(uniq) < 12
