@@ -33,6 +33,9 @@ from . import lr_scheduler
 from . import metric
 from . import io
 from . import recordio
+from . import image
+from . import image as img
+from . import engine
 from . import kvstore
 from . import kvstore as kv
 from . import callback

@@ -1,0 +1,10 @@
+"""mx.image — host-side image decode + augmentation pipeline.
+
+Parity: python/mxnet/image/ (image.py:975 ImageIter and the augmenter
+chain; detection.py ImageDetIter). Decode/augment stay on host CPU exactly
+like the reference (OpenCV there, cv2/PIL here); the TPU sees only
+assembled batches.
+"""
+from .image import *  # noqa: F401,F403
+from . import detection  # noqa: F401
+from .detection import ImageDetIter, CreateDetAugmenter  # noqa: F401

@@ -1,0 +1,522 @@
+"""Image decode, transforms, augmenters, and ImageIter.
+
+Parity: python/mxnet/image/image.py (imdecode, resize_short, fixed_crop,
+random_crop, center_crop, color_normalize, the *Aug classes,
+CreateAugmenter :719, ImageIter :975). Implemented over cv2 (same backend
+as the reference's OpenCV path) with numpy; outputs are mxtpu NDArrays in
+HWC until the final NCHW batch assembly, matching the reference layout
+contract.
+"""
+from __future__ import annotations
+
+import os
+import random as _pyrandom
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import io as _io
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+try:
+    import cv2 as _cv2
+except ImportError:  # pragma: no cover - cv2 is baked in normally
+    _cv2 = None
+
+__all__ = [
+    "imdecode", "imread", "imresize", "copyMakeBorder", "scale_down",
+    "resize_short", "fixed_crop", "random_crop", "center_crop",
+    "color_normalize", "random_size_crop", "Augmenter", "ResizeAug",
+    "ForceResizeAug", "RandomCropAug", "RandomSizedCropAug",
+    "CenterCropAug", "RandomOrderAug", "BrightnessJitterAug",
+    "ContrastJitterAug", "SaturationJitterAug", "ColorJitterAug",
+    "LightingAug", "ColorNormalizeAug", "HorizontalFlipAug", "CastAug",
+    "CreateAugmenter", "ImageIter",
+]
+
+
+def _as_np(img):
+    if isinstance(img, NDArray):
+        return img.asnumpy()
+    return _np.asarray(img)
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an encoded image buffer to HWC uint8 (parity op _cvimdecode /
+    image.py imdecode). flag: 1 color, 0 grayscale."""
+    if _cv2 is None:
+        raise MXNetError("imdecode requires cv2")
+    raw = _np.frombuffer(bytes(buf), dtype=_np.uint8)
+    img = _cv2.imdecode(raw, 1 if flag else 0)
+    if img is None:
+        raise MXNetError("imdecode: cannot decode buffer")
+    if flag and to_rgb:
+        img = _cv2.cvtColor(img, _cv2.COLOR_BGR2RGB)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    arr = nd.array(img.astype(_np.uint8), dtype="uint8")
+    if out is not None:
+        out._data = arr._data
+        return out
+    return arr
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """Read+decode an image file (parity op _cvimread)."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    """Resize to exactly (w, h) (parity op _cvimresize)."""
+    if _cv2 is None:
+        raise MXNetError("imresize requires cv2")
+    img = _as_np(src)
+    out = _cv2.resize(img, (int(w), int(h)), interpolation=int(interp))
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return nd.array(out, dtype=str(img.dtype))
+
+
+def copyMakeBorder(src, top, bot, left, right, border_type=0, value=0.0):
+    """Pad an image (parity op _cvcopyMakeBorder)."""
+    if _cv2 is None:
+        raise MXNetError("copyMakeBorder requires cv2")
+    img = _as_np(src)
+    out = _cv2.copyMakeBorder(img, top, bot, left, right, border_type,
+                              value=value)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return nd.array(out, dtype=str(img.dtype))
+
+
+def scale_down(src_size, size):
+    """Scale (w, h) down to fit src_size keeping aspect (parity image.py)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the shorter edge equals size (parity image.py:290)."""
+    img = _as_np(src)
+    h, w = img.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(img, new_w, new_h, interp=interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    img = _as_np(src)
+    out = img[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(out, size[0], size[1], interp=interp)
+    return nd.array(out, dtype=str(img.dtype))
+
+
+def random_crop(src, size, interp=2):
+    img = _as_np(src)
+    h, w = img.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = _pyrandom.randint(0, w - new_w)
+    y0 = _pyrandom.randint(0, h - new_h)
+    out = fixed_crop(img, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    img = _as_np(src)
+    h, w = img.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(img, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    img = _as_np(src).astype(_np.float32)
+    mean = _as_np(mean) if mean is not None else None
+    if mean is not None:
+        img = img - mean
+    if std is not None:
+        img = img / _as_np(std)
+    return nd.array(img)
+
+
+def random_size_crop(src, size, min_area, ratio, interp=2):
+    """Random area+aspect crop (parity image.py random_size_crop)."""
+    img = _as_np(src)
+    h, w = img.shape[:2]
+    area = h * w
+    for _ in range(10):
+        target_area = _pyrandom.uniform(min_area, 1.0) * area
+        log_ratio = (_np.log(ratio[0]), _np.log(ratio[1]))
+        aspect = _np.exp(_pyrandom.uniform(*log_ratio))
+        new_w = int(round((target_area * aspect) ** 0.5))
+        new_h = int(round((target_area / aspect) ** 0.5))
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            out = fixed_crop(img, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(img, size, interp)
+
+
+class Augmenter:
+    """Base augmenter (parity image.py Augmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__, self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return [resize_short(src, self.size, self.interp)]
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return [imresize(src, self.size[0], self.size[1], self.interp)]
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return [random_crop(src, self.size, self.interp)[0]]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, min_area, ratio, interp=2):
+        super().__init__(size=size, min_area=min_area, ratio=ratio,
+                         interp=interp)
+        self.size = size
+        self.min_area = min_area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return [random_size_crop(src, self.size, self.min_area, self.ratio,
+                                 self.interp)[0]]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return [center_crop(src, self.size, self.interp)[0]]
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        srcs = [src]
+        ts = list(self.ts)
+        _pyrandom.shuffle(ts)
+        for t in ts:
+            srcs = [out for s in srcs for out in t(s)]
+        return srcs
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.brightness, self.brightness)
+        return [nd.array(_as_np(src).astype(_np.float32) * alpha)]
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = _np.array([[[0.299, 0.587, 0.114]]], _np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        img = _as_np(src).astype(_np.float32)
+        alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
+        gray = (img * self._coef).sum() * (3.0 / img.size)
+        return [nd.array(img * alpha + gray * (1.0 - alpha))]
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = _np.array([[[0.299, 0.587, 0.114]]], _np.float32)
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        img = _as_np(src).astype(_np.float32)
+        alpha = 1.0 + _pyrandom.uniform(-self.saturation, self.saturation)
+        gray = (img * self._coef).sum(axis=2, keepdims=True)
+        return [nd.array(img * alpha + gray * (1.0 - alpha))]
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA lighting noise (parity image.py LightingAug)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = _np.asarray(eigval, _np.float32)
+        self.eigvec = _np.asarray(eigvec, _np.float32)
+
+    def __call__(self, src):
+        alpha = _np.random.normal(0, self.alphastd, size=(3,))
+        rgb = _np.dot(self.eigvec * alpha, self.eigval)
+        return [nd.array(_as_np(src).astype(_np.float32) + rgb)]
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = None if mean is None else _np.asarray(mean, _np.float32)
+        self.std = None if std is None else _np.asarray(std, _np.float32)
+
+    def __call__(self, src):
+        return [color_normalize(src, self.mean, self.std)]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return [nd.array(_as_np(src)[:, ::-1].copy())]
+        return [nd.array(_as_np(src))]
+
+
+class CastAug(Augmenter):
+    def __call__(self, src):
+        return [nd.array(_as_np(src).astype(_np.float32))]
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+    """Standard augmenter chain (parity image.py CreateAugmenter:719)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, 0.3, (3.0 / 4.0,
+                                                           4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    elif mean is not None:
+        mean = _np.asarray(mean)
+        assert mean.shape[0] in [1, 3]
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    elif std is not None:
+        std = _np.asarray(std)
+        assert std.shape[0] in [1, 3]
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(_io.DataIter):
+    """Pure-Python image iterator over .rec files or image lists
+    (parity image.py ImageIter:975).
+
+    Supports path_imgrec (recordio) or path_imglist/imglist + path_root
+    (loose image files), shuffle, part reading for distributed loaders,
+    and an augmenter chain. Batches come out NCHW RGB.
+    """
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        assert len(data_shape) == 3 and data_shape[0] in (1, 3)
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.seq = None
+        self.imgrec = None
+        self.imglist = None
+
+        if path_imgrec is not None:
+            from .. import recordio as rio
+            if path_imgidx is None and os.path.exists(
+                    os.path.splitext(path_imgrec)[0] + ".idx"):
+                path_imgidx = os.path.splitext(path_imgrec)[0] + ".idx"
+            if path_imgidx is not None:
+                self.imgrec = rio.MXIndexedRecordIO(path_imgidx, path_imgrec,
+                                                    "r")
+                self.seq = list(self.imgrec.keys)
+            else:
+                self.imgrec = rio.MXRecordIO(path_imgrec, "r")
+        elif path_imglist is not None:
+            imglist = {}
+            seq = []
+            with open(path_imglist) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    label = _np.array([float(x) for x in parts[1:-1]],
+                                      dtype=_np.float32)
+                    key = int(parts[0])
+                    imglist[key] = (label, parts[-1])
+                    seq.append(key)
+            self.imglist = imglist
+            self.seq = seq
+        elif imglist is not None:
+            result = {}
+            seq = []
+            for i, (label, fname) in enumerate(imglist):
+                label = _np.array(label, dtype=_np.float32).reshape(-1)
+                result[i] = (label, fname)
+                seq.append(i)
+            self.imglist = result
+            self.seq = seq
+        else:
+            raise MXNetError(
+                "ImageIter needs path_imgrec, path_imglist, or imglist")
+        self.path_root = path_root
+        if self.seq is not None and num_parts > 1:
+            part = len(self.seq) // num_parts
+            self.seq = self.seq[part * part_index:part * (part_index + 1)]
+        self.shuffle = shuffle
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        self.provide_data = [_io.DataDesc(data_name,
+                                          (batch_size,) + self.data_shape)]
+        if label_width > 1:
+            self.provide_label = [_io.DataDesc(label_name,
+                                               (batch_size, label_width))]
+        else:
+            self.provide_label = [_io.DataDesc(label_name, (batch_size,))]
+        self.cur = 0
+        self.reset()
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            _pyrandom.shuffle(self.seq)
+        if self.imgrec is not None and self.seq is None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        """(label, decoded HWC image) for the next sample."""
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                from .. import recordio as rio
+                header, img = rio.unpack(s)
+                return header.label, imdecode(img)
+            label, fname = self.imglist[idx]
+            return label, imread(os.path.join(self.path_root or "", fname))
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        from .. import recordio as rio
+        header, img = rio.unpack(s)
+        return header.label, imdecode(img)
+
+    def next(self):
+        batch_size = self.batch_size
+        c, h, w = self.data_shape
+        batch_data = _np.zeros((batch_size, h, w, c), dtype=_np.float32)
+        batch_label = _np.zeros((batch_size, self.label_width),
+                                dtype=_np.float32)
+        i = 0
+        try:
+            while i < batch_size:
+                label, img = self.next_sample()
+                arr = _as_np(img)
+                for aug in self.auglist:
+                    arr = _as_np(aug(arr)[0])
+                if arr.shape[:2] != (h, w):
+                    raise MXNetError(
+                        "ImageIter: augmented image %s != data_shape %s; add "
+                        "a resize/crop augmenter" % (arr.shape, (h, w)))
+                batch_data[i] = arr.reshape(h, w, c)
+                batch_label[i] = _np.asarray(label, _np.float32).reshape(-1)[
+                    :self.label_width]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        pad = batch_size - i
+        data = nd.array(batch_data.transpose(0, 3, 1, 2))
+        label = nd.array(batch_label[:, 0] if self.label_width == 1
+                         else batch_label)
+        return _io.DataBatch(data=[data], label=[label], pad=pad, index=None)

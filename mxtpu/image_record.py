@@ -1,0 +1,324 @@
+"""ImageRecordIter / ImageDetRecordIter: the .rec training pipeline.
+
+Parity: the reference's native record iterators (src/io/
+iter_image_recordio_2.cc:503 ImageRecordIter2 and iter_image_det_recordio.cc)
+with the same parameter surface the C iterators register (path_imgrec,
+path_imgidx, data_shape, batch_size, shuffle, preprocess_threads,
+prefetch_buffer, rand_crop, rand_mirror, mean_r/g/b, std_r/g/b, scale,
+label_width, num_parts/part_index, round_batch, seed).
+
+TPU-native pipeline shape (mirrors SURVEY.md §3.5): recordio chunk read →
+a decode/augment *thread pool* (cv2/numpy release the GIL, so threads
+scale) → batch assembly → a bounded prefetch queue. The prefetch queue is
+the native C++ ThreadedIter (src/core/threaded_iter.h) when libmxtpu.so is
+available, else a Python thread. Batches surface as NCHW float32 NDArrays;
+device transfer happens lazily on first use so H2D overlaps the next
+batch's decode.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as _np
+
+from . import _native
+from . import io as _io
+from . import ndarray as nd
+from . import recordio as rio
+from .base import MXNetError
+from .image import image as _img
+
+
+class _NativePrefetcher:
+    """Bounded prefetch over the native ThreadedIter; items are integer
+    tickets into a Python-side store."""
+
+    def __init__(self, produce, buffer_size):
+        self._produce = produce  # () -> object or None at EOF
+        self._store = {}
+        self._lock = threading.Lock()
+        self._ticket = 0
+        self._error = None
+        lib = _native.get_lib()
+
+        def c_produce(_ctx, out_item):
+            try:
+                item = self._produce()
+            except StopIteration:
+                return 1
+            except BaseException as e:  # surface in consumer
+                self._error = e
+                return -1
+            if item is None:
+                return 1
+            with self._lock:
+                self._ticket += 1
+                t = self._ticket
+                self._store[t] = item
+            out_item[0] = t
+            return 0
+
+        self._cb = _native.PRODUCE_FN(c_produce)
+        h = ctypes.c_void_p()
+        _native.check_call(lib.MXTPUThreadedIterCreate(
+            self._cb, None, int(buffer_size), ctypes.byref(h)))
+        self._h = h
+        self._lib = lib
+
+    def next(self):
+        item = ctypes.c_void_p()
+        _native.check_call(self._lib.MXTPUThreadedIterNext(
+            self._h, ctypes.byref(item)))
+        if not item.value:
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        with self._lock:
+            return self._store.pop(item.value)
+
+    def close(self):
+        if self._h is not None:
+            _native.check_call(self._lib.MXTPUThreadedIterFree(self._h))
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class _PyPrefetcher:
+    """Fallback single-thread prefetcher with a bounded queue."""
+
+    def __init__(self, produce, buffer_size):
+        import queue
+
+        self._q = queue.Queue(maxsize=buffer_size)
+        self._stop = False
+
+        def loop():
+            while not self._stop:
+                try:
+                    item = produce()
+                except StopIteration:
+                    item = None
+                except BaseException as e:
+                    self._q.put(e)
+                    return
+                self._q.put(item)
+                if item is None:
+                    return
+
+        self._t = threading.Thread(target=loop, daemon=True)
+        self._t.start()
+
+    def next(self):
+        item = self._q.get()
+        if item is None:
+            raise StopIteration
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    def close(self):
+        self._stop = True
+
+
+class ImageRecordIter(_io.DataIter):
+    """Decode+augment pipeline over a .rec file (see module docstring)."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 path_imgidx=None, label_width=1, shuffle=False,
+                 rand_crop=False, rand_mirror=False, resize=0,
+                 mean_img=None, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=0.0, std_g=0.0, std_b=0.0, scale=1.0,
+                 preprocess_threads=4, prefetch_buffer=4, seed=0,
+                 num_parts=1, part_index=0, round_batch=True,
+                 data_name="data", label_name="softmax_label",
+                 aug_list=None, **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(int(x) for x in data_shape)
+        self.label_width = int(label_width)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.round_batch = round_batch
+        self._epoch = 0
+        if path_imgidx is None:
+            guess = os.path.splitext(path_imgrec)[0] + ".idx"
+            if os.path.exists(guess):
+                path_imgidx = guess
+        if path_imgidx is not None:
+            self._rec = rio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+            keys = list(self._rec.keys)
+            if num_parts > 1:
+                part = len(keys) // num_parts
+                keys = keys[part * part_index:part * (part_index + 1)]
+            self._keys = keys
+        else:
+            if shuffle or num_parts > 1:
+                raise MXNetError(
+                    "shuffle/num_parts need path_imgidx (an .idx file)")
+            self._rec = rio.MXRecordIO(path_imgrec, "r")
+            self._keys = None
+        # mean/std: per-channel scalars like the C iterator's normalize
+        mean = None
+        if any((mean_r, mean_g, mean_b)):
+            mean = _np.array([mean_r, mean_g, mean_b][:self.data_shape[0]],
+                             dtype=_np.float32)
+        if mean_img is not None and os.path.exists(mean_img):
+            loaded = nd.load(mean_img)
+            arr = (loaded["mean_img"] if isinstance(loaded, dict)
+                   else loaded[0])
+            self._mean_arr = arr.asnumpy().transpose(1, 2, 0)
+        else:
+            self._mean_arr = None
+        std = None
+        if any((std_r, std_g, std_b)):
+            std = _np.array([std_r, std_g, std_b][:self.data_shape[0]],
+                            dtype=_np.float32)
+        if aug_list is None:
+            self._augs = _img.CreateAugmenter(
+                self.data_shape, resize=resize, rand_crop=rand_crop,
+                rand_mirror=rand_mirror, mean=mean, std=std)
+        else:
+            self._augs = aug_list
+        self._scale = float(scale)
+        self._pool = ThreadPoolExecutor(max_workers=int(preprocess_threads))
+        self._prefetch_n = int(prefetch_buffer)
+        self.provide_data = [_io.DataDesc(data_name,
+                                          (batch_size,) + self.data_shape)]
+        if self.label_width > 1:
+            self.provide_label = [_io.DataDesc(
+                label_name, (batch_size, self.label_width))]
+        else:
+            self.provide_label = [_io.DataDesc(label_name, (batch_size,))]
+        self._prefetcher = None
+        self.reset()
+
+    # ------------------------------------------------------------ epoch
+    def reset(self):
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+        if self._keys is not None:
+            order = list(self._keys)
+            if self.shuffle:
+                rng = _np.random.RandomState(self.seed + self._epoch)
+                rng.shuffle(order)
+            self._order = order
+        else:
+            self._rec.reset()
+            self._order = None
+        self._cursor = 0
+        self._epoch += 1
+        produce = self._produce_batch
+        if _native.native_available():
+            self._prefetcher = _NativePrefetcher(produce, self._prefetch_n)
+        else:
+            self._prefetcher = _PyPrefetcher(produce, self._prefetch_n)
+
+    def _read_raw(self):
+        """Next raw record bytes, or None at end of epoch."""
+        if self._order is not None:
+            if self._cursor >= len(self._order):
+                return None
+            key = self._order[self._cursor]
+            self._cursor += 1
+            return self._rec.read_idx(key)
+        return self._rec.read()
+
+    def _decode_one(self, raw):
+        header, img = rio.unpack(raw)
+        arr = _img._as_np(_img.imdecode(img))
+        for aug in self._augs:
+            arr = _img._as_np(aug(arr)[0])
+        if self._mean_arr is not None:
+            arr = arr.astype(_np.float32) - self._mean_arr
+        if self._scale != 1.0:
+            arr = arr.astype(_np.float32) * self._scale
+        label = _np.asarray(header.label, _np.float32).reshape(-1)
+        return arr, label
+
+    def _produce_batch(self):
+        c, h, w = self.data_shape
+        raws = []
+        while len(raws) < self.batch_size:
+            raw = self._read_raw()
+            if raw is None:
+                break
+            raws.append(raw)
+        if not raws:
+            return None
+        pad = self.batch_size - len(raws)
+        if pad and not self.round_batch:
+            return None
+        decoded = list(self._pool.map(self._decode_one, raws))
+        data = _np.zeros((self.batch_size, h, w, c), _np.float32)
+        label = _np.zeros((self.batch_size, self.label_width), _np.float32)
+        for i, (arr, lab) in enumerate(decoded):
+            data[i] = arr.reshape(h, w, c)
+            label[i] = lab[:self.label_width]
+        for j in range(pad):  # wrap-pad the tail batch
+            src = decoded[j % len(decoded)]
+            data[len(decoded) + j] = src[0].reshape(h, w, c)
+            label[len(decoded) + j] = src[1][:self.label_width]
+        return _io.DataBatch(
+            data=[nd.array(data.transpose(0, 3, 1, 2))],
+            label=[nd.array(label[:, 0] if self.label_width == 1
+                            else label)],
+            pad=pad, index=None)
+
+    def next(self):
+        batch = self._prefetcher.next()
+        if batch is None:
+            raise StopIteration
+        return batch
+
+
+class ImageDetRecordIter(_io.DataIter):
+    """Detection variant (parity ImageDetRecordIter,
+    src/io/iter_image_det_recordio.cc): delegates decode to ImageDetIter's
+    label-aware augmenter chain over the same .rec format."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 path_imgidx=None, shuffle=False, mean_pixels=None,
+                 rand_mirror_prob=0.0, rand_crop_prob=0.0,
+                 rand_pad_prob=0.0, max_pad_scale=3.0, label_pad_width=0,
+                 min_object_covered=0.1, preprocess_threads=4,
+                 num_parts=1, part_index=0, data_name="data",
+                 label_name="label", **kwargs):
+        super().__init__(batch_size)
+        from .image.detection import CreateDetAugmenter, ImageDetIter
+
+        mean = None
+        if mean_pixels is not None:
+            mean = _np.asarray(mean_pixels, _np.float32)
+        aug = CreateDetAugmenter(
+            data_shape, rand_crop=rand_crop_prob, rand_pad=rand_pad_prob,
+            rand_mirror=rand_mirror_prob > 0, mean=mean,
+            min_object_covered=min_object_covered,
+            area_range=(0.05, max_pad_scale))
+        self._it = ImageDetIter(
+            batch_size=batch_size, data_shape=data_shape,
+            path_imgrec=path_imgrec, path_imgidx=path_imgidx,
+            shuffle=shuffle, num_parts=num_parts, part_index=part_index,
+            aug_list=aug, data_name=data_name, label_name=label_name)
+        if label_pad_width:
+            self._it.reshape(label_shape=(
+                batch_size, int(label_pad_width) // self._it.object_width,
+                self._it.object_width))
+        self.provide_data = self._it.provide_data
+        self.provide_label = self._it.provide_label
+
+    def reset(self):
+        self._it.reset()
+
+    def next(self):
+        return self._it.next()
+
+    @property
+    def object_width(self):
+        return self._it.object_width

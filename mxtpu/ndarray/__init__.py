@@ -134,3 +134,32 @@ def elemwise_add(lhs, rhs, **kw):
                                                          BaseSparseNDArray):
         return sparse.add(lhs, rhs)
     return _dense_elemwise_add(lhs, rhs, **kw)
+
+
+# host-side image codec ops (parity: src/io/image_io.cc _cvimread /
+# _cvimdecode / _cvimresize / _cvcopyMakeBorder — CPU/OpenCV ops in the
+# reference too, so they live outside the jit op registry)
+def _cvimread(filename, flag=1, to_rgb=True, **kw):
+    from ..image import imread
+    return imread(filename, flag=flag, to_rgb=to_rgb)
+
+
+def _cvimdecode(buf, flag=1, to_rgb=True, **kw):
+    from ..image import imdecode
+    return imdecode(buf, flag=flag, to_rgb=to_rgb)
+
+
+def _cvimresize(src, w, h, interp=1, **kw):
+    from ..image import imresize
+    return imresize(src, w, h, interp=interp)
+
+
+def _cvcopyMakeBorder(src, top, bot, left, right, type=0, value=0.0, **kw):
+    from ..image import copyMakeBorder
+    return copyMakeBorder(src, top, bot, left, right, border_type=type,
+                          value=value)
+
+
+imread = _cvimread
+imdecode = _cvimdecode
+imresize = _cvimresize

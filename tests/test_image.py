@@ -1,0 +1,186 @@
+"""Image pipeline tests (parity tier: tests/python/unittest/test_image.py +
+test_io.py ImageRecordIter coverage in the reference)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import image as img
+from mxtpu import recordio
+
+
+def _make_rec(tmp_path, n=12, size=40, label_width=1, det=False):
+    """Write a small .rec/.idx of random JPEGs; returns (rec, idx) paths."""
+    import cv2
+
+    rec_path = str(tmp_path / "data.rec")
+    idx_path = str(tmp_path / "data.idx")
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        arr = (rng.rand(size, size, 3) * 255).astype("uint8")
+        ok, buf = cv2.imencode(".jpg", arr)
+        assert ok
+        if det:
+            # [header_width=2, object_width=5, id,xmin,ymin,xmax,ymax]
+            label = [2, 5, float(i % 3), 0.1, 0.2, 0.6, 0.7]
+            header = recordio.IRHeader(0, label, i, 0)
+        elif label_width > 1:
+            header = recordio.IRHeader(0, [float(i), float(i + 1)], i, 0)
+        else:
+            header = recordio.IRHeader(0, float(i % 3), i, 0)
+        rec.write_idx(i, recordio.pack(header, buf.tobytes()))
+    rec.close()
+    return rec_path, idx_path
+
+
+def test_imdecode_imresize(tmp_path):
+    import cv2
+
+    rng = np.random.RandomState(0)
+    arr = (rng.rand(30, 20, 3) * 255).astype("uint8")
+    ok, buf = cv2.imencode(".png", arr)
+    out = img.imdecode(buf.tobytes())
+    assert out.shape == (30, 20, 3)
+    # png is lossless; BGR->RGB flip must match
+    np.testing.assert_array_equal(out.asnumpy(), arr[:, :, ::-1])
+    small = img.imresize(out, 10, 15)
+    assert small.shape == (15, 10, 3)
+    padded = img.copyMakeBorder(out, 1, 2, 3, 4)
+    assert padded.shape == (33, 27, 3)
+
+
+def test_resize_short_and_crops():
+    rng = np.random.RandomState(0)
+    arr = (rng.rand(48, 64, 3) * 255).astype("uint8")
+    r = img.resize_short(arr, 32)
+    assert min(r.shape[:2]) == 32 and r.shape[0] == 32
+    c, rect = img.center_crop(arr, (32, 32))
+    assert c.shape == (32, 32, 3) and rect[2:] == (32, 32)
+    rc, _ = img.random_crop(arr, (20, 24))
+    assert rc.shape == (24, 20, 3)
+
+
+def test_augmenter_chain():
+    augs = img.CreateAugmenter((3, 24, 24), resize=28, rand_crop=True,
+                               rand_mirror=True, mean=True, std=True,
+                               brightness=0.1, contrast=0.1, saturation=0.1,
+                               pca_noise=0.05)
+    rng = np.random.RandomState(0)
+    arr = (rng.rand(40, 36, 3) * 255).astype("uint8")
+    out = arr
+    for a in augs:
+        out = a(out)[0].asnumpy()
+    assert out.shape == (24, 24, 3)
+    assert out.dtype == np.float32
+
+
+def test_image_iter_rec(tmp_path):
+    rec_path, idx_path = _make_rec(tmp_path)
+    it = img.ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                       path_imgrec=rec_path, path_imgidx=idx_path,
+                       shuffle=True)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 3, 32, 32)
+    assert batches[0].label[0].shape == (4,)
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_image_record_iter(tmp_path):
+    rec_path, idx_path = _make_rec(tmp_path, n=10)
+    it = mx.io.ImageRecordIter(
+        path_imgrec=rec_path, path_imgidx=idx_path, data_shape=(3, 32, 32),
+        batch_size=4, shuffle=True, rand_mirror=True,
+        mean_r=123.0, mean_g=117.0, mean_b=104.0, preprocess_threads=2)
+    epoch = list(it)
+    assert len(epoch) == 3  # 10 -> 3 batches with wrap-pad
+    assert epoch[-1].pad == 2
+    assert epoch[0].data[0].shape == (4, 3, 32, 32)
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_image_record_iter_parts(tmp_path):
+    rec_path, idx_path = _make_rec(tmp_path, n=12)
+    a = mx.io.ImageRecordIter(path_imgrec=rec_path, path_imgidx=idx_path,
+                              data_shape=(3, 32, 32), batch_size=3,
+                              num_parts=2, part_index=0)
+    b = mx.io.ImageRecordIter(path_imgrec=rec_path, path_imgidx=idx_path,
+                              data_shape=(3, 32, 32), batch_size=3,
+                              num_parts=2, part_index=1)
+    la = np.concatenate([x.label[0].asnumpy() for x in a])
+    lb = np.concatenate([x.label[0].asnumpy() for x in b])
+    assert len(la) == len(lb) == 6
+    # disjoint shards covering the dataset
+    ka = set(zip(la.tolist(), range(0)))  # labels repeat; compare counts
+    assert len(la) + len(lb) == 12
+
+
+def test_image_det_record_iter(tmp_path):
+    rec_path, idx_path = _make_rec(tmp_path, n=8, det=True)
+    it = mx.io.ImageDetRecordIter(
+        path_imgrec=rec_path, path_imgidx=idx_path, data_shape=(3, 32, 32),
+        batch_size=4, rand_mirror_prob=0.5, rand_crop_prob=0.0)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 32, 32)
+    lab = batch.label[0].asnumpy()
+    assert lab.ndim == 3 and lab.shape[2] == 5
+    # each image has exactly one valid object row
+    valid = (lab[:, :, 0] >= 0).sum(axis=1)
+    np.testing.assert_array_equal(valid, np.ones(4))
+    # box coords stay normalized
+    rows = lab[lab[:, :, 0] >= 0]
+    assert (rows[:, 1:] >= 0).all() and (rows[:, 1:] <= 1).all()
+
+
+def test_det_flip_updates_boxes():
+    rng = np.random.RandomState(0)
+    arr = (rng.rand(20, 20, 3) * 255).astype("uint8")
+    label = np.full((4, 5), -1.0, np.float32)
+    label[0] = [1, 0.1, 0.2, 0.4, 0.6]
+    aug = img.detection.DetHorizontalFlipAug(1.0)
+    out, new_label = aug(arr, label)
+    np.testing.assert_allclose(new_label[0],
+                               [1, 0.6, 0.2, 0.9, 0.6], rtol=1e-6)
+    np.testing.assert_array_equal(out, arr[:, ::-1])
+
+
+def test_im2rec_tool(tmp_path):
+    import cv2
+
+    root = tmp_path / "imgs" / "cat"
+    root.mkdir(parents=True)
+    rng = np.random.RandomState(0)
+    for i in range(4):
+        cv2.imwrite(str(root / ("%d.jpg" % i)),
+                    (rng.rand(16, 16, 3) * 255).astype("uint8"))
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "im2rec.py")
+    prefix = str(tmp_path / "out")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    subprocess.run([sys.executable, tool, prefix, str(tmp_path / "imgs"),
+                    "--list", "--recursive"], check=True, env=env)
+    subprocess.run([sys.executable, tool, prefix, str(tmp_path / "imgs")],
+                   check=True, env=env)
+    it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                               path_imgidx=prefix + ".idx",
+                               data_shape=(3, 16, 16), batch_size=2)
+    assert len(list(it)) == 2
+
+
+def test_nd_cv_ops(tmp_path):
+    import cv2
+
+    rng = np.random.RandomState(0)
+    arr = (rng.rand(8, 8, 3) * 255).astype("uint8")
+    path = str(tmp_path / "x.png")
+    cv2.imwrite(path, arr)
+    out = mx.nd.imread(path)
+    assert out.shape == (8, 8, 3)
+    small = mx.nd.imresize(out, 4, 4)
+    assert small.shape == (4, 4, 3)

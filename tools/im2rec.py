@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""im2rec: convert an image directory / .lst file into recordio packs.
+
+Parity: tools/im2rec.py (and the C++ tools/im2rec.cc) from the reference —
+same .lst format (index\tlabel...\trelpath) and .rec/.idx output, so
+datasets packed here feed ImageRecordIter/ImageDetRecordIter directly.
+
+Usage:
+  python tools/im2rec.py prefix image_root --list          # make prefix.lst
+  python tools/im2rec.py prefix image_root                 # pack prefix.rec
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxtpu import recordio  # noqa: E402
+
+_EXTS = {".jpg", ".jpeg", ".png", ".bmp"}
+
+
+def list_images(root, recursive=False):
+    i = 0
+    if recursive:
+        cat = {}
+        for path, _dirs, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                if os.path.splitext(fname)[1].lower() not in _EXTS:
+                    continue
+                if path not in cat:
+                    cat[path] = len(cat)
+                yield (i, os.path.relpath(os.path.join(path, fname), root),
+                       cat[path])
+                i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            if os.path.splitext(fname)[1].lower() in _EXTS:
+                yield (i, fname, 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as f:
+        for idx, relpath, label in image_list:
+            f.write("%d\t%f\t%s\n" % (idx, float(label), relpath))
+
+
+def read_list(path_in):
+    with open(path_in) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield (int(parts[0]),
+                   [float(x) for x in parts[1:-1]], parts[-1])
+
+
+def make_rec(prefix, root, lst_iter, quality=95, resize=0, color=1,
+             encoding=".jpg"):
+    try:
+        import cv2
+    except ImportError:
+        raise SystemExit("im2rec packing requires cv2")
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    count = 0
+    for idx, label, relpath in lst_iter:
+        fname = os.path.join(root, relpath)
+        img = cv2.imread(fname, color)
+        if img is None:
+            print("imread failed, skipping %s" % fname, file=sys.stderr)
+            continue
+        if resize:
+            h, w = img.shape[:2]
+            if h > w:
+                img = cv2.resize(img, (resize, resize * h // w))
+            else:
+                img = cv2.resize(img, (resize * w // h, resize))
+        ok, buf = cv2.imencode(encoding, img,
+                               [cv2.IMWRITE_JPEG_QUALITY, quality])
+        if not ok:
+            print("imencode failed, skipping %s" % fname, file=sys.stderr)
+            continue
+        if len(label) == 1:
+            header = recordio.IRHeader(0, label[0], idx, 0)
+            packed = recordio.pack(header, buf.tobytes())
+        else:
+            header = recordio.IRHeader(0, label, idx, 0)
+            packed = recordio.pack(header, buf.tobytes())
+        rec.write_idx(idx, packed)
+        count += 1
+        if count % 1000 == 0:
+            print("packed %d images" % count)
+    rec.close()
+    print("wrote %d records to %s.rec" % (count, prefix))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix", help="output prefix (or .lst path when packing)")
+    ap.add_argument("root", help="image root directory")
+    ap.add_argument("--list", action="store_true",
+                    help="create a .lst instead of packing")
+    ap.add_argument("--recursive", action="store_true",
+                    help="one label per subdirectory")
+    ap.add_argument("--shuffle", action="store_true", default=True)
+    ap.add_argument("--no-shuffle", dest="shuffle", action="store_false")
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize shorter edge before packing")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--color", type=int, default=1, choices=[0, 1])
+    ap.add_argument("--encoding", default=".jpg")
+    args = ap.parse_args()
+
+    if args.list:
+        images = list(list_images(args.root, args.recursive))
+        if args.shuffle:
+            random.seed(100)
+            random.shuffle(images)
+        write_list(args.prefix + ".lst", images)
+        print("wrote %d entries to %s.lst" % (len(images), args.prefix))
+        return
+    lst_path = args.prefix if args.prefix.endswith(".lst") \
+        else args.prefix + ".lst"
+    if not os.path.exists(lst_path):
+        raise SystemExit("list file %s not found; run --list first" % lst_path)
+    prefix = lst_path[:-4]
+    make_rec(prefix, args.root, read_list(lst_path), quality=args.quality,
+             resize=args.resize, color=args.color, encoding=args.encoding)
+
+
+if __name__ == "__main__":
+    main()
