@@ -26,3 +26,9 @@ def purge(root=os.path.join("~", ".mxnet", "models")):
         for f in os.listdir(root):
             if f.endswith(".params"):
                 os.remove(os.path.join(root, f))
+
+
+def load_pretrained(net, name, ctx=None,
+                    root=os.path.join("~", ".mxnet", "models")):
+    """Load locally-stored pretrained params into net (offline store)."""
+    net.load_params(get_model_file(name, root), ctx=ctx)

@@ -4,6 +4,12 @@ Module-API baseline configs train)."""
 from . import resnet
 from . import mlp
 from . import lenet
+from . import alexnet
+from . import vgg
+from . import inception_bn
 from .mlp import get_symbol as get_mlp
 from .lenet import get_symbol as get_lenet
 from .resnet import get_symbol as get_resnet
+from .alexnet import get_symbol as get_alexnet
+from .vgg import get_symbol as get_vgg
+from .inception_bn import get_symbol as get_inception_bn

@@ -1,0 +1,81 @@
+"""Model zoo forward-shape tests (parity tier: reference
+tests/python/unittest/test_gluon_model_zoo.py which instantiates every
+model and checks the forward pass)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu.gluon.model_zoo import vision
+
+
+def _check(net, size=32, classes=10, batch=2):
+    net.collect_params().initialize(ctx=mx.cpu())
+    x = mx.nd.random.uniform(shape=(batch, 3, size, size))
+    out = net(x)
+    assert out.shape == (batch, classes)
+    return out
+
+
+@pytest.mark.parametrize("name,size", [
+    ("resnet18_v1", 32), ("resnet18_v2", 32),
+    ("mobilenet0.25", 32),
+    ("squeezenet1.0", 64), ("squeezenet1.1", 64),
+    ("densenet121", 32),
+    ("alexnet", 224),
+    ("vgg11", 32), ("vgg11_bn", 32),
+])
+def test_models_forward(name, size):
+    net = vision.get_model(name, classes=10)
+    _check(net, size=size)
+
+
+def test_inception_v3_forward():
+    net = vision.get_model("inceptionv3", classes=10)
+    _check(net, size=299)
+
+
+def test_get_model_unknown():
+    with pytest.raises(ValueError):
+        vision.get_model("not_a_model")
+
+
+def test_model_zoo_hybridize():
+    net = vision.get_model("mobilenet0.25", classes=10)
+    net.collect_params().initialize(ctx=mx.cpu())
+    x = mx.nd.random.uniform(shape=(2, 3, 32, 32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    cached = net(x).asnumpy()
+    np.testing.assert_allclose(eager, cached, rtol=1e-4, atol=1e-5)
+
+
+def test_model_zoo_trains():
+    from mxtpu import gluon, autograd
+
+    net = vision.get_model("squeezenet1.1", classes=4)
+    net.collect_params().initialize(ctx=mx.cpu())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.nd.random.uniform(shape=(4, 3, 64, 64))
+    y = mx.nd.array(np.array([0, 1, 2, 3], "float32"))
+    losses = []
+    for _ in range(3):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(4)
+        losses.append(float(loss.mean().asnumpy()))
+    assert np.isfinite(losses).all()
+
+
+def test_symbol_model_factories():
+    from mxtpu import models
+
+    for get, shape in [(models.get_alexnet, (2, 3, 224, 224)),
+                       (models.get_vgg, (2, 3, 32, 32)),
+                       (models.get_inception_bn, (2, 3, 224, 224))]:
+        s = get(num_classes=10)
+        arg_shapes, out_shapes, _ = s.infer_shape(
+            data=shape, softmax_label=(shape[0],))
+        assert out_shapes[0] == (shape[0], 10), (get, out_shapes)
