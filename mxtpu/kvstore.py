@@ -44,6 +44,22 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._barrier_count = 0
+        self._client = None
+        self._env = None
+        if kind.startswith("dist"):
+            from . import kvstore_server as kvs
+
+            env = kvs.cluster_env()
+            if env is not None and env["role"] == "worker":
+                # ps-style transport (tools/launch.py cluster). On real
+                # multi-host TPU (jax.process_count() > 1) the psum path
+                # below is used instead and this client only carries
+                # control traffic.
+                self._env = env
+                self._client = kvs.KVClient(env["uri"], env["port"])
+                if "async" in kind:
+                    self._client.send_command("sync_mode", False)
+                self._client.barrier()
 
     # ------------------------------------------------ identity
     @property
@@ -52,6 +68,8 @@ class KVStore:
 
     @property
     def rank(self):
+        if self._env is not None:
+            return self._env["worker_id"]
         if self._kind.startswith("dist"):
             try:
                 return jax.process_index()
@@ -61,6 +79,8 @@ class KVStore:
 
     @property
     def num_workers(self):
+        if self._env is not None:
+            return self._env["num_workers"]
         if self._kind.startswith("dist"):
             try:
                 return jax.process_count()
@@ -73,23 +93,42 @@ class KVStore:
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             arr = v[0] if isinstance(v, list) else v
+            if self._client is not None:
+                # first writer wins server-side = rank0 init semantics
+                # (KVStoreDist::Init + Barrier, kvstore_dist.h)
+                self._client.init(k, arr.asnumpy())
+                self._client.barrier()
             self._store[k] = arr.copy()
+
+    def _local_merge(self, vlist):
+        """Reduce a per-device value list onto the first device (the
+        CommCPU/CommDevice tree-reduce role, comm.h:90/:462)."""
+        merged = vlist[0]
+        if len(vlist) > 1:
+            dev = vlist[0].context.jax_device
+            acc = vlist[0]._data
+            for x in vlist[1:]:
+                acc = acc + jax.device_put(x._data, dev)
+            merged = NDArray(acc, vlist[0].context)
+        return merged
 
     def push(self, key, value, priority=0):
         """Aggregate pushed values per key; run updater if set, else assign-sum
-        (parity KVStoreLocal::PushImpl kvstore_local.h:149)."""
+        (parity KVStoreLocal::PushImpl kvstore_local.h:149; dist path
+        KVStoreDist::Push_ kvstore_dist.h:256)."""
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             vlist = v if isinstance(v, list) else [v]
-            merged = vlist[0]
-            if len(vlist) > 1:
-                # cross-device reduce: gather onto the first device then add-N
-                # (XLA fuses the chain; replaces CommDevice tree-reduce)
-                dev = vlist[0].context.jax_device
-                acc = vlist[0]._data
-                for x in vlist[1:]:
-                    acc = acc + jax.device_put(x._data, dev)
-                merged = NDArray(acc, vlist[0].context)
+            merged = self._local_merge(vlist)
+            if self._client is not None:
+                self._client.push(k, merged.asnumpy())
+                continue
+            if self._kind.startswith("dist") and _is_dist():
+                # real multi-host path: all-reduce over DCN/ICI replaces the
+                # worker->server hop entirely
+                from jax.experimental import multihost_utils as mhu
+                gathered = mhu.process_allgather(merged._data)
+                merged = NDArray(gathered.sum(axis=0), merged.context)
             if k not in self._store:
                 self._store[k] = merged.copy()
                 continue
@@ -103,6 +142,14 @@ class KVStore:
             raise MXNetError("pull: out is required")
         keys, outs = self._normalize(key, out)
         for k, o in zip(keys, outs):
+            if self._client is not None:
+                import jax.numpy as jnp
+                src_np = self._client.pull(k)
+                olist = o if isinstance(o, list) else [o]
+                for dst in olist:
+                    dst._data = jax.device_put(jnp.asarray(src_np),
+                                               dst.context.jax_device)
+                continue
             src = self._store[k]
             olist = o if isinstance(o, list) else [o]
             for dst in olist:
@@ -140,22 +187,47 @@ class KVStore:
         self._updater = updater
 
     def set_optimizer(self, optimizer):
-        """Parity kvstore.py:349: in dist mode the reference pickles the
-        optimizer to servers; here the optimizer runs worker-side after
-        aggregation, which is the same sync semantics without a server role."""
+        """Parity kvstore.py:349: in ps-transport dist mode the optimizer is
+        pickled to the server (the reference's exact mechanism); otherwise it
+        runs worker-side after aggregation — the same sync semantics."""
         self._optimizer = optimizer
+        if self._client is not None:
+            # every worker sends (idempotent server-side); the socket's FIFO
+            # order guarantees this precedes the worker's own pushes, and a
+            # sync merge completes only after ALL workers pushed, so the
+            # updater is installed before the first ApplyUpdates.
+            self._client.send_command("set_optimizer",
+                                      pickle.dumps(optimizer))
+            return
         self._updater = opt.get_updater(optimizer)
 
     # ------------------------------------------------ cluster control
     def barrier(self):
         self._barrier_count += 1
+        if self._client is not None:
+            self._client.barrier()
+            return
         if self._kind.startswith("dist") and _is_dist():
             # all-host sync point via a tiny global psum
             from .parallel import host_barrier
             host_barrier()
 
     def send_command_to_servers(self, head, body):
-        pass  # no server role in the collective design
+        if self._client is not None:
+            self._client.send_command(head, body)
+
+    def close(self):
+        """Stop the worker's server connection (sends STOP; the server
+        exits after all workers stop — barrier_before_exit role)."""
+        if self._client is not None:
+            self._client.stop()
+            self._client = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:

@@ -1,0 +1,313 @@
+"""Distributed KVStore transport: server process + worker client.
+
+Parity: the ps-lite + KVStoreDist + KVStoreDistServer stack
+(src/kvstore/kvstore_dist.h:52, kvstore_dist_server.h:109, and the empty
+ps-lite submodule's ZPush/ZPull/Barrier surface). The reference runs a
+ZeroMQ parameter server; this is the same design over a plain TCP socket
+protocol with length-prefixed pickle frames:
+
+  * sync mode: pushes for a key are merged until every worker has
+    contributed, then the server applies its updater once
+    (ApplyUpdates semantics, kvstore_dist_server.h:175); pulls block until
+    the round's version is visible.
+  * async mode: every push updates immediately; pulls never block.
+  * ``set_optimizer`` pickles the Python optimizer to the server —
+    byte-for-byte the reference's kvstore.py:349 behavior.
+  * Barrier across workers (ps::Postoffice barrier role).
+
+On a real multi-host TPU pod this transport is only the *control plane*;
+gradient aggregation rides XLA psum over ICI/DCN instead (see
+mxtpu/kvstore.py dist path). On CPU test clusters (the reference's own
+"launch N processes on one host" trick, tools/launch.py) this transport
+carries the values too, giving exact-arithmetic invariants for tests.
+
+Cluster env (parity with DMLC_ROLE/DMLC_PS_ROOT_*):
+  MXTPU_ROLE            worker | server | scheduler(unused alias: server)
+  MXTPU_ROOT_URI/PORT   server address
+  MXTPU_NUM_WORKERS     world size
+  MXTPU_WORKER_ID       rank
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as _np
+
+from .base import MXNetError
+
+_HDR = struct.Struct("<Q")
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            raise ConnectionError("kvstore peer closed")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def _recv_msg(sock):
+    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class KVServer:
+    """The server role (parity KVStoreDistServer, kvstore_dist_server.h:109)."""
+
+    def __init__(self, port, num_workers, host="127.0.0.1"):
+        self.num_workers = int(num_workers)
+        self.sync_mode = True
+        self.store = {}          # key -> np array (weights)
+        self.versions = {}       # key -> completed update rounds
+        self.merge = {}          # key -> [accumulated, n_contributions]
+        self.updater = None      # None => merged value is assigned/summed
+        self.cv = threading.Condition()
+        self.barrier_counts = {}
+        self._stop = False
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((host, int(port)))
+        self.port = self.sock.getsockname()[1]
+        self.sock.listen(self.num_workers + 4)
+        self._threads = []
+
+    # ---------------------------------------------------------- lifecycle
+    def run(self):
+        """Serve until every worker sent STOP (blocking; parity RunServer)."""
+        stops = 0
+        accept_thread_done = threading.Event()
+
+        def acceptor():
+            while not self._stop:
+                try:
+                    conn, _ = self.sock.accept()
+                except OSError:
+                    break
+                t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
+            accept_thread_done.set()
+
+        at = threading.Thread(target=acceptor, daemon=True)
+        at.start()
+        with self.cv:
+            while self.stops_seen < self.num_workers:
+                self.cv.wait(timeout=0.5)
+        self._stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    stops_seen = 0
+
+    def run_in_thread(self):
+        t = threading.Thread(target=self.run, daemon=True)
+        t.start()
+        return t
+
+    # ---------------------------------------------------------- handlers
+    def _serve_conn(self, conn):
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                op = msg[0]
+                if op == "PUSH":
+                    _send_msg(conn, self._handle_push(*msg[1:]))
+                elif op == "PULL":
+                    _send_msg(conn, self._handle_pull(*msg[1:]))
+                elif op == "INIT":
+                    _send_msg(conn, self._handle_init(*msg[1:]))
+                elif op == "BARRIER":
+                    _send_msg(conn, self._handle_barrier(*msg[1:]))
+                elif op == "COMMAND":
+                    _send_msg(conn, self._handle_command(*msg[1:]))
+                elif op == "STOP":
+                    with self.cv:
+                        self.stops_seen += 1
+                        self.cv.notify_all()
+                    _send_msg(conn, ("OK",))
+                    return
+                else:
+                    _send_msg(conn, ("ERR", "unknown op %s" % op))
+        except (ConnectionError, EOFError):
+            return
+
+    def _apply(self, key, merged):
+        """ApplyUpdates: run updater or assign (kvstore_dist_server.h:175)."""
+        if key not in self.store:
+            self.store[key] = merged.copy()
+        elif self.updater is not None:
+            # updaters speak NDArray (python/mxnet/optimizer.py Updater)
+            from .ndarray import array as nd_array
+
+            weight = nd_array(self.store[key])
+            self.updater(key, nd_array(merged), weight)
+            self.store[key] = weight.asnumpy()
+        else:
+            self.store[key] = merged.copy()
+        self.versions[key] = self.versions.get(key, 0) + 1
+
+    def _handle_init(self, key, value):
+        with self.cv:
+            if key not in self.store:  # first writer (rank 0) wins
+                self.store[key] = _np.asarray(value).copy()
+                self.versions.setdefault(key, 0)
+            self.cv.notify_all()
+        return ("OK",)
+
+    def _handle_push(self, key, value):
+        value = _np.asarray(value)
+        with self.cv:
+            if not self.sync_mode:
+                self._apply(key, value)
+                self.cv.notify_all()
+                return ("OK", self.versions[key])
+            acc = self.merge.get(key)
+            if acc is None:
+                self.merge[key] = [value.astype(_np.float64, copy=True)
+                                   if value.dtype.kind == "f" else
+                                   value.copy(), 1]
+            else:
+                acc[0] = acc[0] + value
+                acc[1] += 1
+            if self.merge[key][1] >= self.num_workers:
+                merged, _n = self.merge.pop(key)
+                self._apply(key, merged.astype(value.dtype, copy=False))
+                self.cv.notify_all()
+            return ("OK", self.versions.get(key, 0))
+
+    def _handle_pull(self, key, min_version):
+        with self.cv:
+            while (key not in self.store
+                   or (self.sync_mode
+                       and self.versions.get(key, 0) < min_version)):
+                if not self.cv.wait(timeout=60):
+                    return ("ERR", "pull timeout on key %r" % (key,))
+            return ("OK", self.store[key], self.versions.get(key, 0))
+
+    def _handle_barrier(self, bid):
+        with self.cv:
+            self.barrier_counts[bid] = self.barrier_counts.get(bid, 0) + 1
+            self.cv.notify_all()
+            while self.barrier_counts[bid] % self.num_workers != 0:
+                if not self.cv.wait(timeout=60):
+                    return ("ERR", "barrier timeout")
+            return ("OK",)
+
+    def _handle_command(self, head, body):
+        """Controller channel (kStopServer/kSyncMode/kSetOptimizer parity)."""
+        with self.cv:
+            if head == "sync_mode":
+                self.sync_mode = bool(body)
+            elif head == "set_optimizer":
+                from . import optimizer as opt
+                optimizer = pickle.loads(body)
+                self.updater = opt.get_updater(optimizer)
+            else:
+                return ("ERR", "unknown command %s" % head)
+            self.cv.notify_all()
+        return ("OK",)
+
+
+class KVClient:
+    """Worker-side connection (parity ps::KVWorker ZPush/ZPull)."""
+
+    def __init__(self, uri, port, connect_timeout=90):
+        # the server process may still be importing (jax init takes tens of
+        # seconds); retry until it binds
+        import time
+
+        deadline = time.monotonic() + connect_timeout
+        while True:
+            try:
+                self._sock = socket.create_connection((uri, int(port)),
+                                                      timeout=120)
+                break
+            except (ConnectionRefusedError, socket.timeout, OSError):
+                if time.monotonic() >= deadline:
+                    raise MXNetError(
+                        "cannot reach kvstore server at %s:%s" % (uri, port))
+                time.sleep(0.3)
+        self._lock = threading.Lock()
+        self._barrier_id = 0
+        self._push_counts = {}
+
+    def _rpc(self, *msg):
+        with self._lock:
+            _send_msg(self._sock, msg)
+            resp = _recv_msg(self._sock)
+        if resp[0] != "OK":
+            raise MXNetError("kvstore rpc failed: %r" % (resp,))
+        return resp
+
+    def init(self, key, value):
+        self._rpc("INIT", key, _np.asarray(value))
+
+    def push(self, key, value):
+        self._push_counts[key] = self._push_counts.get(key, 0) + 1
+        self._rpc("PUSH", key, _np.asarray(value))
+
+    def pull(self, key):
+        # sync semantics: see every push round this worker contributed to
+        resp = self._rpc("PULL", key, self._push_counts.get(key, 0))
+        return resp[1]
+
+    def barrier(self):
+        self._barrier_id += 1
+        self._rpc("BARRIER", self._barrier_id)
+
+    def send_command(self, head, body):
+        self._rpc("COMMAND", head, body)
+
+    def stop(self):
+        try:
+            self._rpc("STOP")
+        except (MXNetError, ConnectionError):
+            pass
+        self._sock.close()
+
+
+# ------------------------------------------------------------ env plumbing
+
+
+def cluster_env():
+    """Read the MXTPU_* cluster env (DMLC_* also honored)."""
+    env = os.environ
+    role = env.get("MXTPU_ROLE", env.get("DMLC_ROLE"))
+    if role is None:
+        return None
+    return {
+        "role": role,
+        "uri": env.get("MXTPU_ROOT_URI", env.get("DMLC_PS_ROOT_URI",
+                                                 "127.0.0.1")),
+        "port": int(env.get("MXTPU_ROOT_PORT",
+                            env.get("DMLC_PS_ROOT_PORT", "9091"))),
+        "num_workers": int(env.get("MXTPU_NUM_WORKERS",
+                                   env.get("DMLC_NUM_WORKER", "1"))),
+        "worker_id": int(env.get("MXTPU_WORKER_ID", "0")),
+    }
+
+
+def _init_kvstore_server_module():
+    """Entry for server processes (parity python/mxnet/kvstore_server.py:11):
+    a process whose role is 'server' serves until workers stop it."""
+    env = cluster_env()
+    if env is None or env["role"] not in ("server", "scheduler"):
+        return False
+    server = KVServer(env["port"], env["num_workers"])
+    server.run()
+    return True

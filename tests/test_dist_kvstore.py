@@ -1,0 +1,135 @@
+"""Distributed KVStore tests (parity: tests/nightly/dist_sync_kvstore.py —
+exact-value invariants with N workers as separate processes on one host,
+launched the way tools/launch.py does)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER_SYNC = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, %r)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import mxtpu as mx
+
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    shape = (3, 4)
+    kv.init(3, mx.nd.ones(shape))
+    # each worker pushes rank+1; with no server optimizer the merged sum is
+    # assigned per round (CopyFromTo semantics): always nw*(nw+1)/2
+    for rnd in range(1, 4):
+        kv.push(3, mx.nd.ones(shape) * (rank + 1))
+        out = mx.nd.zeros(shape)
+        kv.pull(3, out=out)
+        expect = nw * (nw + 1) / 2.0
+        assert np.allclose(out.asnumpy(), expect), (rnd, out.asnumpy()[0, 0],
+                                                    expect)
+    kv.barrier()
+    kv.close()
+    print("WORKER_OK", rank)
+""")
+
+WORKER_OPT = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, %r)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import mxtpu as mx
+
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    shape = (2, 2)
+    kv.init(7, mx.nd.zeros(shape))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, rescale_grad=1.0))
+    kv.barrier()
+    # server-side sgd: w -= 0.5 * sum_grads ; grads sum to nw each round
+    kv.push(7, mx.nd.ones(shape))
+    out = mx.nd.zeros(shape)
+    kv.pull(7, out=out)
+    assert np.allclose(out.asnumpy(), -0.5 * nw), out.asnumpy()
+    kv.barrier()
+    kv.close()
+    print("WORKER_OK", rank)
+""")
+
+
+def _run_cluster(worker_src, n=3, timeout=120):
+    from mxtpu.kvstore_server import KVServer
+
+    server = KVServer(0, n)
+    server.run_in_thread()
+    # PYTHONPATH=REPO (not the baked TPU-plugin site dir): concurrent
+    # worker processes must not race for the single TPU tunnel.
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               MXTPU_ROOT_URI="127.0.0.1",
+               MXTPU_ROOT_PORT=str(server.port),
+               MXTPU_NUM_WORKERS=str(n),
+               MXTPU_ROLE="worker")
+    procs = []
+    for rank in range(n):
+        e = dict(env, MXTPU_WORKER_ID=str(rank))
+        procs.append(subprocess.Popen([sys.executable, "-c", worker_src],
+                                      env=e, stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=timeout)
+        outs.append(out.decode())
+        assert p.returncode == 0, out.decode()
+    return outs
+
+
+def test_dist_sync_exact_values():
+    outs = _run_cluster(WORKER_SYNC % REPO, n=3)
+    assert all("WORKER_OK" in o for o in outs)
+
+
+def test_dist_sync_server_optimizer():
+    outs = _run_cluster(WORKER_OPT % REPO, n=2)
+    assert all("WORKER_OK" in o for o in outs)
+
+
+def test_dist_async_push_pull():
+    src = textwrap.dedent("""
+        import os, sys
+        import numpy as np
+        sys.path.insert(0, %r)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import mxtpu as mx
+
+        kv = mx.kv.create("dist_async")
+        kv.init(1, mx.nd.zeros((2,)))
+        kv.push(1, mx.nd.ones((2,)))
+        out = mx.nd.zeros((2,))
+        kv.pull(1, out=out)  # must not block on other workers
+        assert out.asnumpy().sum() >= 2.0  # own push applied at minimum
+        kv.barrier()
+        kv.close()
+        print("WORKER_OK")
+    """) % REPO
+    outs = _run_cluster(src, n=2)
+    assert all("WORKER_OK" in o for o in outs)
+
+
+def test_launch_tool():
+    script = ("import os, sys; sys.path.insert(0, %r); "
+              "os.environ.setdefault('JAX_PLATFORMS','cpu'); "
+              "import mxtpu as mx; kv = mx.kv.create('dist_sync'); "
+              "kv.init(0, mx.nd.ones((2,))); "
+              "kv.push(0, mx.nd.ones((2,)) * (kv.rank + 1)); "
+              "out = mx.nd.zeros((2,)); kv.pull(0, out=out); "
+              "assert out.asnumpy()[0] == 3.0, out.asnumpy(); kv.close(); "
+              "print('LAUNCH_OK')" % REPO)
+    launch = os.path.join(REPO, "tools", "launch.py")
+    res = subprocess.run(
+        [sys.executable, launch, "-n", "2", sys.executable, "-c", script],
+        capture_output=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO))
+    assert res.returncode == 0, res.stdout.decode() + res.stderr.decode()
