@@ -52,6 +52,11 @@ from . import profiler
 from . import test_utils
 from . import parallel
 from . import operator
+from . import predict
+from . import rtc
+from . import contrib
+from . import torch_bridge
+from . import torch_bridge as th
 
 from .model import FeedForward
 from .kvstore import create as _kv_create

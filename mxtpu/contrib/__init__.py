@@ -1,0 +1,3 @@
+"""mx.contrib — experimental namespaces (parity python/mxnet/contrib/)."""
+from . import autograd  # noqa: F401
+from . import tensorboard  # noqa: F401

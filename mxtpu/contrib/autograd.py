@@ -1,0 +1,73 @@
+"""Old-style autograd API (parity: python/mxnet/contrib/autograd.py — the
+pre-gluon interface kept for back-compat; delegates to mxtpu.autograd)."""
+from __future__ import annotations
+
+import functools
+
+from .. import autograd as _ag
+
+__all__ = ["set_is_training", "set_recording", "train_section",
+           "test_section", "mark_variables", "backward", "compute_gradient",
+           "grad_and_loss", "grad"]
+
+
+def set_is_training(is_train):
+    prev = _ag.set_training(is_train)
+    _ag.set_recording(is_train)
+    return prev
+
+
+def set_recording(is_recording):
+    return _ag.set_recording(is_recording)
+
+
+train_section = _ag.record
+test_section = _ag.pause
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    _ag.mark_variables(variables, gradients, grad_reqs)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    _ag.backward(outputs, head_grads=out_grads, retain_graph=retain_graph)
+
+
+def compute_gradient(outputs, out_grads=None, retain_graph=False):
+    """Old name for backward over explicit outputs."""
+    backward(outputs, out_grads, retain_graph)
+
+
+def grad_and_loss(func, argnum=None):
+    """Return a function computing both gradient of func and its output
+    (parity contrib/autograd.py grad_and_loss)."""
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        from ..ndarray import NDArray, zeros_like
+
+        variables = args
+        if argnum is not None:
+            argnums = [argnum] if isinstance(argnum, int) else argnum
+            variables = [args[i] for i in argnums]
+        for v in variables:
+            assert isinstance(v, NDArray), "type of autograd input must be "\
+                "NDArray, not %s" % type(v)
+        grads = [zeros_like(v) for v in variables]
+        mark_variables(variables, grads)
+        with train_section():
+            outputs = func(*args)
+        backward([outputs] if isinstance(outputs, NDArray) else outputs)
+        return grads, outputs
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    grad_with_loss_func = grad_and_loss(func, argnum)
+
+    @functools.wraps(grad_with_loss_func)
+    def wrapped(*args):
+        return grad_with_loss_func(*args)[0]
+
+    return wrapped

@@ -1,0 +1,45 @@
+"""TensorBoard logging hook (parity: python/mxnet/contrib/tensorboard.py —
+an eval-metric callback that writes scalar summaries)."""
+from __future__ import annotations
+
+
+class LogMetricsCallback:
+    """Log metrics to a TensorBoard event file each time it is invoked as a
+    batch/epoch callback. Uses torch.utils.tensorboard when available
+    (baked torch provides it); otherwise falls back to a plain JSONL file
+    so training never breaks on a missing dependency."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self._jsonl = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            self.summary_writer = SummaryWriter(logging_dir)
+        except Exception:
+            import os
+
+            os.makedirs(logging_dir, exist_ok=True)
+            self._jsonl = open(
+                __import__("os").path.join(logging_dir, "metrics.jsonl"),
+                "a")
+            self.summary_writer = None
+        self._step = 0
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self._step += 1
+        name_value = param.eval_metric.get_name_value()
+        for name, value in name_value:
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            if self.summary_writer is not None:
+                self.summary_writer.add_scalar(name, value, self._step)
+            else:
+                import json
+
+                self._jsonl.write(json.dumps(
+                    {"step": self._step, "name": name,
+                     "value": float(value)}) + "\n")
+                self._jsonl.flush()

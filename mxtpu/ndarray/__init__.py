@@ -95,6 +95,7 @@ from ..base import PrefixOpNamespace as _PrefixNS  # noqa: E402
 
 contrib = _PrefixNS(_mod, "_contrib_")
 linalg = _PrefixNS(_mod, "_linalg_")
+random = _PrefixNS(_mod, "_random_")
 
 # ----------------------------------------------------------- sparse dispatch
 from . import sparse  # noqa: E402

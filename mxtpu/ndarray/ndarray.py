@@ -469,10 +469,14 @@ def save(fname, data):
 
 
 def load(fname):
-    """Load NDArrays saved by ``save`` (returns list or dict like mx.nd.load)."""
+    """Load NDArrays saved by ``save`` (returns list or dict like
+    mx.nd.load). Accepts a path or a binary file-like object."""
     import json
+    from contextlib import nullcontext
 
-    with open(fname, "rb") as f:
+    ctx_mgr = (nullcontext(fname) if hasattr(fname, "read")
+               else open(fname, "rb"))
+    with ctx_mgr as f:
         magic = f.read(8)
         if magic != _MAGIC:
             raise MXNetError("invalid NDArray file %s" % fname)

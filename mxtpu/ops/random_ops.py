@@ -97,6 +97,29 @@ _multisampler("sample_poisson",
               two_param=False)
 
 
+def _row_neg_binomial(r, ps, s):
+    k1, k2 = jax.random.split(r)
+    k = jnp.broadcast_to(_rs(ps[0], s), s)
+    p = jnp.broadcast_to(_rs(ps[1], s), s)
+    lam = jax.random.gamma(k1, k) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam).astype(jnp.float32)
+
+
+def _row_gen_neg_binomial(r, ps, s):
+    k1, k2 = jax.random.split(r)
+    mu = jnp.broadcast_to(_rs(ps[0], s), s)
+    alpha = jnp.broadcast_to(_rs(ps[1], s), s)
+    # alpha -> 0 degenerates to poisson(mu); clamp for the gamma draw
+    safe_alpha = jnp.maximum(alpha, 1e-8)
+    lam = jax.random.gamma(k1, 1.0 / safe_alpha) * (mu * safe_alpha)
+    lam = jnp.where(alpha <= 1e-8, mu, lam)
+    return jax.random.poisson(k2, lam).astype(jnp.float32)
+
+
+_multisampler("sample_negative_binomial", _row_neg_binomial)
+_multisampler("sample_generalized_negative_binomial", _row_gen_neg_binomial)
+
+
 def _sample_multinomial(a, rng, data):
     n = int(a.shape[0]) if a.shape else 1
     logits = jnp.log(jnp.clip(data, 1e-30, None))

@@ -463,3 +463,9 @@ class Updater:
 
 def get_updater(optimizer):
     return Updater(optimizer)
+
+
+@register
+class ccSGD(SGD):
+    """Deprecated alias of SGD (parity optimizer.py ccSGD — kept so configs
+    naming 'ccsgd' keep working)."""

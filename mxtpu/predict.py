@@ -1,0 +1,131 @@
+"""Standalone inference API.
+
+Parity: include/mxnet/c_predict_api.h:77-152 + src/c_api/c_predict_api.cc
+(``MXPredCreate`` from symbol JSON + param bytes, ``SetInput`` /
+``Forward`` / ``GetOutput`` / ``Reshape``) — the surface the reference's
+amalgamation build exposes for deployment.
+
+TPU-native design: the whole forward graph compiles to ONE jitted XLA
+program at creation (per input-shape set, cached on Reshape), replacing
+the reference's NaiveEngine + static memory planning; inference dispatch
+is a single device call.
+"""
+from __future__ import annotations
+
+import io as _io
+
+import numpy as _np
+
+from .base import MXNetError
+from . import ndarray as nd
+from . import symbol as sym_mod
+from .context import cpu
+
+
+class Predictor:
+    """One bound inference graph (parity: the PredictorHandle object)."""
+
+    def __init__(self, symbol_json_str, param_bytes_or_dict, ctx=None,
+                 input_shapes=None, dev_type=None, dev_id=0,
+                 output_index=None):
+        if input_shapes is None:
+            raise MXNetError("Predictor requires input_shapes")
+        self._ctx = ctx or cpu()
+        symbol = sym_mod.load_json(symbol_json_str) \
+            if isinstance(symbol_json_str, str) else symbol_json_str
+        if output_index is not None:
+            outs = symbol.get_internals().list_outputs()  # pragma: no cover
+        self._symbol = symbol
+        if isinstance(param_bytes_or_dict, (bytes, bytearray)):
+            loaded = nd.load(_io.BytesIO(bytes(param_bytes_or_dict)))
+        else:
+            loaded = param_bytes_or_dict
+        self._arg_params = {}
+        self._aux_params = {}
+        for k, v in loaded.items():
+            if k.startswith("arg:"):
+                self._arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                self._aux_params[k[4:]] = v
+            else:
+                self._arg_params[k] = v
+        self._input_shapes = dict(input_shapes)
+        self._inputs = {}
+        self._bind()
+
+    def _bind(self):
+        symbol = self._symbol
+        arg_names = symbol.list_arguments()
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(
+            **self._input_shapes)
+        aux_names = symbol.list_auxiliary_states()
+        args = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            if name in self._input_shapes:
+                args[name] = nd.zeros(shape, self._ctx)
+            elif name in self._arg_params:
+                args[name] = self._arg_params[name]
+            else:
+                # unfed non-param args (e.g. softmax_label) are dead in the
+                # inference graph; bind zeros (c_predict_api drops them the
+                # same way by planning only the forward outputs)
+                args[name] = nd.zeros(shape, self._ctx)
+        aux = {}
+        for name, shape in zip(aux_names, aux_shapes):
+            if name not in self._aux_params:
+                raise MXNetError("predictor: missing aux state %s" % name)
+            aux[name] = self._aux_params[name]
+        self._executor = symbol.bind(self._ctx, args, aux_states=aux,
+                                     grad_req="null")
+        self._arg_arrays = args
+        self._out_shapes = out_shapes
+
+    # ----------------------------------------------------------- C-API ops
+    def set_input(self, name, value):
+        """MXPredSetInput."""
+        if name not in self._input_shapes:
+            raise MXNetError("unknown input %s" % name)
+        value = _np.asarray(value, dtype=_np.float32)
+        if tuple(value.shape) != tuple(self._input_shapes[name]):
+            raise MXNetError(
+                "input %s shape %s != bound shape %s" % (
+                    name, value.shape, self._input_shapes[name]))
+        self._arg_arrays[name][:] = value
+
+    def forward(self, **inputs):
+        """MXPredForward (optionally setting inputs in one call)."""
+        for k, v in inputs.items():
+            self.set_input(k, v)
+        self._executor.forward(is_train=False)
+
+    def get_output(self, index=0):
+        """MXPredGetOutput -> numpy."""
+        return self._executor.outputs[index].asnumpy()
+
+    def get_output_shape(self, index=0):
+        return tuple(self._out_shapes[index])
+
+    @property
+    def num_outputs(self):
+        return len(self._out_shapes)
+
+    def reshape(self, new_input_shapes):
+        """MXPredReshape: rebind with new shapes (new XLA executable;
+        weights are reused)."""
+        self._input_shapes.update(new_input_shapes)
+        self._bind()
+
+
+def create(symbol_file, param_file, input_shapes, ctx=None):
+    """Convenience: build a Predictor from checkpoint files (the
+    MXPredCreate file-path flow)."""
+    with open(symbol_file) as f:
+        sym_json = f.read()
+    params = nd.load(param_file)
+    return Predictor(sym_json, params, ctx=ctx, input_shapes=input_shapes)
+
+
+def load_checkpoint_predictor(prefix, epoch, input_shapes, ctx=None):
+    """Build a Predictor straight from a Module/model checkpoint pair."""
+    return create("%s-symbol.json" % prefix,
+                  "%s-%04d.params" % (prefix, epoch), input_shapes, ctx=ctx)

@@ -1,0 +1,65 @@
+"""mx.rtc — runtime-compiled custom kernels.
+
+Parity: include/mxnet/mxrtc.h + python/mxnet/rtc.py, where the reference
+JIT-compiles CUDA C source via NVRTC and launches it on NDArrays.
+
+TPU-native design: there is no "source string -> PTX" path on TPU; the
+honest equivalent is a Python kernel body compiled by the XLA/Pallas
+toolchain. ``CudaModule``-style source strings are not supported; instead
+``Rtc`` takes a Python callable over jax arrays — by default jit-compiled
+(XLA fuses it), or lowered as a Pallas TPU kernel when ``pallas=True`` and
+a ``pallas_call`` spec is supplied. The push-style launch API matches the
+reference's ``rtc.push(ins, outs, grid, block)`` shape minus the
+grid/block geometry, which has no meaning under XLA's tiling.
+"""
+from __future__ import annotations
+
+import jax
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["Rtc"]
+
+
+class Rtc:
+    """A runtime-compiled kernel over NDArrays.
+
+    Parameters
+    ----------
+    name : str
+        Kernel name (diagnostic only).
+    fn : callable(*jax_arrays) -> jax array or tuple
+        The kernel body. Traced and compiled on first push per shape set.
+    pallas : bool
+        If True, ``fn`` is expected to already be a pallas_call-wrapped
+        kernel (see /opt/skills/guides/pallas_guide.md); it is invoked
+        directly so its BlockSpecs control tiling.
+    """
+
+    def __init__(self, name, fn, pallas=False):
+        if isinstance(fn, str):
+            raise MXNetError(
+                "mx.rtc on TPU takes a Python kernel function, not CUDA "
+                "source (NVRTC has no TPU equivalent; write a jax/pallas "
+                "kernel body instead)")
+        self.name = name
+        self._fn = fn if pallas else jax.jit(fn)
+
+    def push(self, ins, outs=None, *_grid_block):
+        """Run the kernel. ``ins`` are NDArrays; results are returned and,
+        when ``outs`` is given, also written into those NDArrays (the
+        reference's output-argument convention)."""
+        args = [x._data if isinstance(x, NDArray) else x for x in ins]
+        res = self._fn(*args)
+        if not isinstance(res, (tuple, list)):
+            res = (res,)
+        if outs is not None:
+            if len(outs) != len(res):
+                raise MXNetError("rtc %s: %d outputs for %d results"
+                                 % (self.name, len(outs), len(res)))
+            for dst, val in zip(outs, res):
+                dst._data = val
+        return [NDArray(v, ins[0].context if ins else None) for v in res]
+
+    __call__ = push

@@ -1,0 +1,62 @@
+"""mx.th — torch interop bridge.
+
+Parity: the reference's torch plugin (python/mxnet/torch.py + plugin/torch)
+which exposes torch tensor math and torch nn modules over NDArrays. The
+baked CPU torch provides the same capability here via zero-ceremony
+array conversion: NDArray <-> torch.Tensor through numpy, plus a generic
+``function`` dispatcher that applies any torch function to NDArrays.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .context import cpu
+from .ndarray import NDArray, array
+
+__all__ = ["to_torch", "from_torch", "function"]
+
+
+def _torch():
+    try:
+        import torch
+    except ImportError as e:  # pragma: no cover - torch is baked in
+        raise MXNetError("torch bridge requires torch: %s" % e)
+    return torch
+
+
+def to_torch(arr):
+    """NDArray -> torch.Tensor (host copy; the reference's bridge is also
+    a host-side plugin)."""
+    import numpy as _np
+
+    torch = _torch()
+    return torch.from_numpy(_np.array(arr.asnumpy(), copy=True))
+
+
+def from_torch(tensor, ctx=None):
+    """torch.Tensor -> NDArray."""
+    return array(tensor.detach().cpu().numpy(), ctx=ctx or cpu())
+
+
+def function(name):
+    """Wrap a torch function by name to operate on NDArrays, e.g.
+    ``mx.th.function('sort')(x)`` (the reference code-gens these from the
+    TH function registry)."""
+    torch = _torch()
+    fn = getattr(torch, name, None)
+    if fn is None:
+        raise MXNetError("torch has no function %r" % name)
+
+    def wrapped(*args, **kwargs):
+        targs = [to_torch(a) if isinstance(a, NDArray) else a for a in args]
+        out = fn(*targs, **kwargs)
+        if isinstance(out, tuple):
+            return tuple(from_torch(o) if hasattr(o, "numpy") else o
+                         for o in out)
+        return from_torch(out) if hasattr(out, "numpy") else out
+
+    return wrapped
+
+
+def __getattr__(name):
+    # attribute-style access: mx.th.sigmoid(x)
+    return function(name)

@@ -1,0 +1,123 @@
+"""Predict API, rtc, contrib.autograd, torch bridge, ccSGD, and the
+per-row negative-binomial samplers (parity tier: tests/python/predict/,
+test_rtc.py, contrib autograd tests)."""
+import numpy as np
+import pytest
+
+import mxtpu as mx
+
+
+def _train_tiny(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.rand(32, 6).astype("float32")
+    Y = (X.sum(1) > 3).astype("float32")
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    it = mx.io.NDArrayIter(X, Y, batch_size=8, label_name="softmax_label")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    prefix = str(tmp_path / "tiny")
+    mod.save_checkpoint(prefix, 1)
+    return prefix, X, mod
+
+
+def test_predictor_matches_module(tmp_path):
+    prefix, X, mod = _train_tiny(tmp_path)
+    pred = mx.predict.load_checkpoint_predictor(
+        prefix, 1, {"data": (8, 6)})
+    pred.forward(data=X[:8])
+    out = pred.get_output(0)
+    assert out.shape == (8, 2)
+    it = mx.io.NDArrayIter(X[:8], None, batch_size=8)
+    ref = mod.predict(it).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    # reshape -> new batch geometry, same weights
+    pred.reshape({"data": (4, 6)})
+    pred.forward(data=X[:4])
+    np.testing.assert_allclose(pred.get_output(0), ref[:4], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_predictor_errors(tmp_path):
+    prefix, X, _ = _train_tiny(tmp_path)
+    pred = mx.predict.load_checkpoint_predictor(prefix, 1,
+                                                {"data": (8, 6)})
+    with pytest.raises(mx.MXNetError):
+        pred.set_input("nope", X[:8])
+    with pytest.raises(mx.MXNetError):
+        pred.set_input("data", X[:4])  # wrong shape
+
+
+def test_rtc_jit_kernel():
+    import jax.numpy as jnp
+
+    k = mx.rtc.Rtc("saxpy", lambda a, x, y: a * x + y)
+    x = mx.nd.array(np.arange(6, dtype="float32"))
+    y = mx.nd.ones((6,))
+    out = mx.nd.zeros((6,))
+    k.push([mx.nd.array(np.array([2.0], "float32")), x, y], [out])
+    np.testing.assert_allclose(out.asnumpy(),
+                               2.0 * np.arange(6) + 1.0)
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.Rtc("cuda", "__global__ void k() {}")
+
+
+def test_contrib_autograd_grad_and_loss():
+    from mxtpu.contrib import autograd as cag
+
+    def f(x):
+        return (x * x).sum()
+
+    x = mx.nd.array(np.array([1.0, 2.0, 3.0], "float32"))
+    grads, loss = cag.grad_and_loss(f)(x)
+    np.testing.assert_allclose(grads[0].asnumpy(), [2.0, 4.0, 6.0],
+                               rtol=1e-5)
+
+
+def test_torch_bridge():
+    x = mx.nd.array(np.array([[3.0, 1.0], [2.0, 4.0]], "float32"))
+    t = mx.th.to_torch(x)
+    assert tuple(t.shape) == (2, 2)
+    back = mx.th.from_torch(t * 2)
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy() * 2)
+    sig = mx.th.function("sigmoid")(x)
+    np.testing.assert_allclose(sig.asnumpy(),
+                               1 / (1 + np.exp(-x.asnumpy())), rtol=1e-5)
+
+
+def test_ccsgd_registered():
+    o = mx.optimizer.create("ccsgd", learning_rate=0.1)
+    assert isinstance(o, mx.optimizer.SGD)
+
+
+def test_sample_negative_binomial_rowwise():
+    k = mx.nd.array(np.array([1.0, 20.0], "float32"))
+    p = mx.nd.array(np.array([0.5, 0.5], "float32"))
+    out = mx.nd.sample_negative_binomial(k, p, shape=(400,))
+    assert out.shape == (2, 400)
+    m = out.asnumpy().mean(axis=1)
+    # mean = k(1-p)/p = [1, 20]
+    assert abs(m[0] - 1.0) < 0.5 and abs(m[1] - 20.0) < 3.0
+    mu = mx.nd.array(np.array([2.0, 10.0], "float32"))
+    alpha = mx.nd.array(np.array([0.0, 0.1], "float32"))
+    out2 = mx.nd.sample_generalized_negative_binomial(mu, alpha,
+                                                      shape=(400,))
+    m2 = out2.asnumpy().mean(axis=1)
+    assert abs(m2[0] - 2.0) < 0.5 and abs(m2[1] - 10.0) < 2.5
+
+
+def test_tensorboard_callback(tmp_path):
+    from mxtpu.contrib.tensorboard import LogMetricsCallback
+    from collections import namedtuple
+
+    cb = LogMetricsCallback(str(tmp_path / "tb"))
+    metric = mx.metric.Accuracy()
+    metric.update([mx.nd.array(np.array([0.0, 1.0], "float32"))],
+                  [mx.nd.array(np.array([[0.9, 0.1], [0.2, 0.8]],
+                                        "float32"))])
+    Param = namedtuple("Param", ["eval_metric"])
+    cb(Param(eval_metric=metric))
