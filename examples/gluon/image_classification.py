@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Gluon imperative training (parity: example/gluon/image_classification.py
+— baseline config 3: model_zoo net + autograd.record + Trainer.step,
+optionally hybridized)."""
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxtpu as mx  # noqa: E402
+from mxtpu import autograd, gluon  # noqa: E402
+from mxtpu.gluon.model_zoo import vision  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet18_v1")
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--classes", type=int, default=10)
+    ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--hybridize", action="store_true")
+    ap.add_argument("--data-rec", default=None,
+                    help=".rec pack; synthetic data when omitted")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = vision.get_model(args.model, classes=args.classes)
+    net.collect_params().initialize(ctx=mx.test_utils.default_context())
+    if args.hybridize:
+        net.hybridize()
+
+    if args.data_rec:
+        train_iter = mx.io.ImageRecordIter(
+            path_imgrec=args.data_rec, batch_size=args.batch_size,
+            data_shape=(3, args.image_size, args.image_size), shuffle=True,
+            rand_mirror=True, scale=1.0 / 255)
+        batches = list(train_iter)
+    else:
+        rng = np.random.RandomState(0)
+        batches = []
+        for _ in range(16):
+            x = mx.nd.array(rng.rand(args.batch_size, 3, args.image_size,
+                                     args.image_size).astype("float32"))
+            y = mx.nd.array(rng.randint(
+                0, args.classes, args.batch_size).astype("float32"))
+            batches.append(mx.io.DataBatch(data=[x], label=[y], pad=0,
+                                           index=None))
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9,
+                             "wd": 1e-4})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        tic = time.time()
+        for batch in batches:
+            data, label = batch.data[0], batch.label[0]
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(args.batch_size)
+            metric.update([label], [out])
+        name, acc = metric.get()
+        logging.info("epoch %d: %s=%f (%.1f samples/s)", epoch, name, acc,
+                     len(batches) * args.batch_size / (time.time() - tic))
+
+
+if __name__ == "__main__":
+    main()

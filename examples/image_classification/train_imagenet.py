@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""ImageNet-style training over recordio (parity:
+example/image-classification/train_imagenet.py + common/fit.py — baseline
+config 2: ResNet-50 data-parallel over ImageRecordIter).
+
+Point --data-train at an ImageNet .rec (build with tools/im2rec.py); the
+script runs the same pipeline on any .rec pack.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxtpu as mx  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-train", required=True, help=".rec file")
+    ap.add_argument("--data-val", default=None)
+    ap.add_argument("--network", default="resnet")
+    ap.add_argument("--num-layers", type=int, default=50)
+    ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument("--image-shape", default="3,224,224")
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--num-epochs", type=int, default=1)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--lr-step-epochs", default="30,60")
+    ap.add_argument("--kv-store", default="device")
+    ap.add_argument("--model-prefix", default=None)
+    ap.add_argument("--epoch-size", type=int, default=0,
+                    help="batches per epoch (0 = full pass)")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    if args.network == "resnet":
+        net = mx.models.get_resnet(num_classes=args.num_classes,
+                                   num_layers=args.num_layers,
+                                   image_shape=shape)
+    elif args.network == "alexnet":
+        net = mx.models.get_alexnet(num_classes=args.num_classes)
+    elif args.network == "vgg":
+        net = mx.models.get_vgg(num_classes=args.num_classes)
+    elif args.network == "inception-bn":
+        net = mx.models.get_inception_bn(num_classes=args.num_classes)
+    else:
+        raise SystemExit("unknown network %s" % args.network)
+
+    kv = mx.kv.create(args.kv_store)
+    train = mx.io.ImageRecordIter(
+        path_imgrec=args.data_train, data_shape=shape,
+        batch_size=args.batch_size, shuffle=True, rand_mirror=True,
+        rand_crop=True, mean_r=123.68, mean_g=116.779, mean_b=103.939,
+        num_parts=kv.num_workers, part_index=kv.rank)
+    if args.epoch_size:
+        train = mx.io.ResizeIter(train, args.epoch_size)
+    val = None
+    if args.data_val:
+        val = mx.io.ImageRecordIter(
+            path_imgrec=args.data_val, data_shape=shape,
+            batch_size=args.batch_size,
+            mean_r=123.68, mean_g=116.779, mean_b=103.939)
+
+    steps = [int(e) for e in args.lr_step_epochs.split(",") if e]
+    lr_sched = mx.lr_scheduler.MultiFactorScheduler(
+        step=[s * 5000 for s in steps], factor=0.1) if steps else None
+
+    mod = mx.mod.Module(net, context=mx.test_utils.default_context())
+    checkpoint = (mx.callback.do_checkpoint(args.model_prefix)
+                  if args.model_prefix and kv.rank == 0 else None)
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs, kvstore=kv,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-4, "lr_scheduler": lr_sched},
+            eval_metric=[mx.metric.Accuracy(),
+                         mx.metric.TopKAccuracy(top_k=5)],
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20),
+            epoch_end_callback=checkpoint)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Train MLP/LeNet on MNIST via Module.fit (parity:
+example/image-classification/train_mnist.py — baseline config 1).
+
+Uses the real MNIST ubyte files when present (set --data-dir), otherwise
+a synthetic stand-in so the script runs end-to-end offline.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxtpu as mx  # noqa: E402
+
+
+def get_mnist_iter(args):
+    img = os.path.join(args.data_dir, "train-images-idx3-ubyte")
+    lbl = os.path.join(args.data_dir, "train-labels-idx1-ubyte")
+    if os.path.exists(img) or os.path.exists(img + ".gz"):
+        train = mx.io.MNISTIter(image=img, label=lbl,
+                                batch_size=args.batch_size,
+                                flat=(args.network == "mlp"))
+        vimg = os.path.join(args.data_dir, "t10k-images-idx3-ubyte")
+        vlbl = os.path.join(args.data_dir, "t10k-labels-idx1-ubyte")
+        val = mx.io.MNISTIter(image=vimg, label=vlbl,
+                              batch_size=args.batch_size, shuffle=False,
+                              flat=(args.network == "mlp"))
+        return train, val
+    logging.warning("MNIST not found under %s; using synthetic digits",
+                    args.data_dir)
+    rng = np.random.RandomState(7)
+    n = 2048
+    y = rng.randint(0, 10, size=n).astype("float32")
+    x = rng.rand(n, 1, 28, 28).astype("float32") * 0.1
+    for i in range(n):  # one bright row per class: linearly separable
+        x[i, 0, int(y[i]) * 2 + 2, :] += 1.0
+    if args.network == "mlp":
+        x = x.reshape(n, 784)
+    cut = (n * 7 // 8 // args.batch_size) * args.batch_size
+    train = mx.io.NDArrayIter(x[:cut], y[:cut], args.batch_size,
+                              shuffle=True, label_name="softmax_label")
+    val = mx.io.NDArrayIter(x[cut:], y[cut:], args.batch_size,
+                            label_name="softmax_label")
+    return train, val
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="mlp", choices=["mlp", "lenet"])
+    ap.add_argument("--data-dir", default="data/mnist")
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=10)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--kv-store", default="local")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = (mx.models.get_mlp(num_classes=10) if args.network == "mlp"
+           else mx.models.get_lenet(num_classes=10))
+    train, val = get_mnist_iter(args)
+    kv = mx.kv.create(args.kv_store)
+    mod = mx.mod.Module(net, context=mx.test_utils.default_context())
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs, kvstore=kv,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50),
+            eval_metric="acc")
+    score = mod.score(val, mx.metric.Accuracy())
+    logging.info("final validation %s", score)
+
+
+if __name__ == "__main__":
+    main()

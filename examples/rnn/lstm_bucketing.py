@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""LSTM bucketing language model (parity: example/rnn/lstm_bucketing.py —
+baseline config 4: BucketingModule + BucketSentenceIter + stacked
+LSTMCell.unroll + Perplexity)."""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxtpu as mx  # noqa: E402
+
+
+def load_corpus(path, vocab=None):
+    """PTB-style text -> sentences of word ids (parity rnn/io.py
+    encode_sentences flow)."""
+    from mxtpu.rnn.io import encode_sentences
+
+    with open(path) as f:
+        sentences = [line.strip().split() for line in f if line.strip()]
+    return encode_sentences(sentences, vocab=vocab, start_label=2,
+                            invalid_label=0)
+
+
+def synthetic_corpus(n=400, vocab_size=60, seed=0):
+    rng = np.random.RandomState(seed)
+    sents = []
+    for _ in range(n):
+        ln = rng.randint(4, 33)
+        # a learnable pattern: next id = id + 1 mod vocab
+        start = rng.randint(2, vocab_size - 1)
+        sents.append([(start + i) % (vocab_size - 2) + 2
+                      for i in range(ln)])
+    return sents, {i: i for i in range(vocab_size)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--train-data", default=None, help="text corpus (PTB)")
+    ap.add_argument("--num-hidden", type=int, default=128)
+    ap.add_argument("--num-embed", type=int, default=64)
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--num-epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=0.1)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    buckets = [10, 20, 30, 40]
+    if args.train_data:
+        sentences, vocab = load_corpus(args.train_data)
+    else:
+        logging.warning("no --train-data; using synthetic corpus")
+        sentences, vocab = synthetic_corpus()
+    vocab_size = max(max(v for v in vocab.values()), 2) + 1
+
+    train = mx.rnn.BucketSentenceIter(sentences, args.batch_size,
+                                      buckets=buckets)
+
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(args.num_layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden,
+                                  prefix="lstm_l%d_" % i))
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data=data, input_dim=vocab_size,
+                                 output_dim=args.num_embed, name="embed")
+        stack.reset()
+        outputs, _ = stack.unroll(seq_len, inputs=embed, merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(data=pred, num_hidden=vocab_size,
+                                     name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(data=pred, label=label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    model = mx.mod.BucketingModule(
+        sym_gen=sym_gen, default_bucket_key=train.default_bucket_key,
+        context=mx.test_utils.default_context())
+    model.fit(train, num_epoch=args.num_epochs,
+              eval_metric=mx.metric.Perplexity(ignore_label=0),
+              optimizer="sgd",
+              optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                                "wd": 1e-5},
+              initializer=mx.initializer.Xavier(factor_type="in",
+                                                magnitude=2.34),
+              batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                         20))
+
+
+if __name__ == "__main__":
+    main()

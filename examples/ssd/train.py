@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""SSD detector training (parity: example/ssd/train.py → train/train_net.py
+— baseline config 5: VGG16-reduced SSD over ImageDetRecordIter with the
+MultiBox target/detection ops and a mAP-style metric)."""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxtpu as mx  # noqa: E402
+from mxtpu.models import ssd as ssd_model  # noqa: E402
+
+
+class MultiBoxMetric(mx.metric.EvalMetric):
+    """Train-time metric pair (parity example/ssd/train/metric.py):
+    cross-entropy over matched anchors + smooth-l1 loc loss."""
+
+    def __init__(self):
+        super().__init__("MultiBox")
+        self.num = 2
+        self.name = ["CrossEntropy", "SmoothL1"]
+        self.reset()
+
+    def reset(self):
+        self.num_inst = [0, 0]
+        self.sum_metric = [0.0, 0.0]
+
+    def update(self, labels, preds):
+        cls_prob = preds[0].asnumpy()
+        loc_loss = preds[1].asnumpy()
+        cls_label = preds[2].asnumpy()
+        valid = cls_label >= 0
+        label = cls_label[valid].astype(int)
+        flat = np.moveaxis(cls_prob, 1, -1).reshape(-1, cls_prob.shape[1])
+        prob = flat[valid.reshape(-1)][np.arange(label.size), label]
+        self.sum_metric[0] += (-np.log(np.maximum(prob, 1e-12))).sum()
+        self.num_inst[0] += label.size
+        self.sum_metric[1] += np.abs(loc_loss).sum()
+        self.num_inst[1] += max((cls_label > 0).sum(), 1)
+
+    def get(self):
+        return (self.name,
+                [s / max(n, 1) for s, n in zip(self.sum_metric,
+                                               self.num_inst)])
+
+    def get_name_value(self):
+        names, values = self.get()
+        return list(zip(names, values))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--train-rec", default=None,
+                    help="detection .rec (tools/im2rec.py packed .lst with "
+                         "[2,5,id,xmin,ymin,xmax,ymax] labels)")
+    ap.add_argument("--num-classes", type=int, default=20)
+    ap.add_argument("--data-shape", type=int, default=300)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--num-epochs", type=int, default=1)
+    ap.add_argument("--num-scales", type=int, default=6)
+    ap.add_argument("--lr", type=float, default=0.001)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = ssd_model.get_symbol_train(num_classes=args.num_classes,
+                                     num_scales=args.num_scales)
+    shape = (3, args.data_shape, args.data_shape)
+    if args.train_rec:
+        train = mx.io.ImageDetRecordIter(
+            path_imgrec=args.train_rec, data_shape=shape,
+            batch_size=args.batch_size, shuffle=True,
+            mean_pixels=(123, 117, 104), rand_mirror_prob=0.5)
+        batches = None
+    else:
+        logging.warning("no --train-rec; using synthetic boxes")
+        rng = np.random.RandomState(0)
+
+        def synth_batch():
+            x = mx.nd.array(rng.rand(args.batch_size, *shape)
+                            .astype("float32"))
+            lab = np.full((args.batch_size, 8, 5), -1.0, "float32")
+            for b in range(args.batch_size):
+                cx, cy = rng.uniform(0.3, 0.7, 2)
+                w, h = rng.uniform(0.1, 0.25, 2)
+                lab[b, 0] = [rng.randint(0, args.num_classes),
+                             cx - w, cy - h, cx + w, cy + h]
+            return mx.io.DataBatch(data=[x], label=[mx.nd.array(lab)],
+                                   pad=0, index=None,
+                                   provide_data=[mx.io.DataDesc(
+                                       "data",
+                                       (args.batch_size,) + shape)],
+                                   provide_label=[mx.io.DataDesc(
+                                       "label", lab.shape)])
+
+        class _SynthIter(mx.io.DataIter):
+            def __init__(self):
+                super().__init__(args.batch_size)
+                self._n = 0
+                self.provide_data = [mx.io.DataDesc(
+                    "data", (args.batch_size,) + shape)]
+                self.provide_label = [mx.io.DataDesc(
+                    "label", (args.batch_size, 8, 5))]
+
+            def reset(self):
+                self._n = 0
+
+            def next(self):
+                if self._n >= 4:
+                    raise StopIteration
+                self._n += 1
+                return synth_batch()
+
+        train = _SynthIter()
+
+    mod = mx.mod.Module(net, label_names=("label",),
+                        context=mx.test_utils.default_context())
+    mod.fit(train, num_epoch=args.num_epochs,
+            eval_metric=MultiBoxMetric(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr,
+                              "momentum": 0.9, "wd": 5e-4},
+            batch_end_callback=mx.callback.Speedometer(
+                args.batch_size, 10))
+
+
+if __name__ == "__main__":
+    main()
