@@ -1,0 +1,251 @@
+// C predict ABI implementation over the embedded Python runtime.
+// See c_predict_api.h; parity with src/c_api/c_predict_api.cc.
+#include "c_predict_api.h"
+
+#include <Python.h>
+
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+std::once_flag g_py_once;
+bool g_we_initialized = false;
+
+struct Predictor {
+  PyObject *obj;                       // mxtpu.predict.Predictor
+  std::vector<std::vector<mx_uint>> out_shapes;  // cached for GetOutputShape
+};
+
+void EnsurePython() {
+  std::call_once(g_py_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      g_we_initialized = true;
+    }
+  });
+}
+
+// Store the current Python exception into g_last_error.
+void CapturePyError(const char *where) {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = std::string(where) + ": ";
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      msg += PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  } else {
+    msg += "unknown python error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  g_last_error = msg;
+}
+
+class GilGuard {
+ public:
+  GilGuard() { state_ = PyGILState_Ensure(); }
+  ~GilGuard() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+}  // namespace
+
+extern "C" {
+
+const char *MXGetLastError(void) { return g_last_error.c_str(); }
+
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out) {
+  (void)dev_id;
+  EnsurePython();
+  GilGuard gil;
+  PyObject *mod = PyImport_ImportModule("mxtpu.predict");
+  if (mod == nullptr) {
+    CapturePyError("import mxtpu.predict");
+    return -1;
+  }
+  PyObject *cls = PyObject_GetAttrString(mod, "Predictor");
+  Py_DECREF(mod);
+  if (cls == nullptr) {
+    CapturePyError("Predictor class");
+    return -1;
+  }
+  PyObject *shapes = PyDict_New();
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    const mx_uint lo = input_shape_indptr[i];
+    const mx_uint hi = input_shape_indptr[i + 1];
+    PyObject *shape = PyTuple_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j) {
+      PyTuple_SET_ITEM(shape, j - lo,
+                       PyLong_FromUnsignedLong(input_shape_data[j]));
+    }
+    PyDict_SetItemString(shapes, input_keys[i], shape);
+    Py_DECREF(shape);
+  }
+  PyObject *params =
+      PyBytes_FromStringAndSize(static_cast<const char *>(param_bytes),
+                                param_size);
+  PyObject *json = PyUnicode_FromString(symbol_json_str);
+  PyObject *kwargs = PyDict_New();
+  PyDict_SetItemString(kwargs, "input_shapes", shapes);
+  // dev_type 1=cpu keeps default ctx; anything else also uses the default
+  // context (tpu when available) — device selection is XLA's job.
+  (void)dev_type;
+  PyObject *args = PyTuple_Pack(2, json, params);
+  PyObject *pred = PyObject_Call(cls, args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  Py_DECREF(json);
+  Py_DECREF(params);
+  Py_DECREF(shapes);
+  Py_DECREF(cls);
+  if (pred == nullptr) {
+    CapturePyError("Predictor()");
+    return -1;
+  }
+  auto *handle = new Predictor();
+  handle->obj = pred;
+  // cache output shapes
+  PyObject *n_out = PyObject_GetAttrString(pred, "num_outputs");
+  const long n = n_out ? PyLong_AsLong(n_out) : 0;
+  Py_XDECREF(n_out);
+  for (long i = 0; i < n; ++i) {
+    PyObject *shp =
+        PyObject_CallMethod(pred, "get_output_shape", "l", i);
+    std::vector<mx_uint> dims;
+    if (shp != nullptr) {
+      const Py_ssize_t ndim = PySequence_Size(shp);
+      for (Py_ssize_t d = 0; d < ndim; ++d) {
+        PyObject *item = PySequence_GetItem(shp, d);
+        dims.push_back(static_cast<mx_uint>(PyLong_AsLong(item)));
+        Py_DECREF(item);
+      }
+      Py_DECREF(shp);
+    }
+    handle->out_shapes.push_back(std::move(dims));
+  }
+  *out = handle;
+  return 0;
+}
+
+int MXPredGetOutputShape(PredictorHandle h, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim) {
+  auto *p = static_cast<Predictor *>(h);
+  if (index >= p->out_shapes.size()) {
+    g_last_error = "output index out of range";
+    return -1;
+  }
+  *shape_data = p->out_shapes[index].data();
+  *shape_ndim = static_cast<mx_uint>(p->out_shapes[index].size());
+  return 0;
+}
+
+int MXPredSetInput(PredictorHandle h, const char *key, const mx_float *data,
+                   mx_uint size) {
+  auto *p = static_cast<Predictor *>(h);
+  GilGuard gil;
+  PyObject *list = PyList_New(size);
+  for (mx_uint i = 0; i < size; ++i) {
+    PyList_SET_ITEM(list, i, PyFloat_FromDouble(data[i]));
+  }
+  // reshape host-side in python: set_input handles shape via numpy reshape
+  PyObject *np = PyImport_ImportModule("numpy");
+  PyObject *arr = PyObject_CallMethod(np, "asarray", "O", list);
+  Py_DECREF(np);
+  Py_DECREF(list);
+  if (arr == nullptr) {
+    CapturePyError("numpy.asarray");
+    return -1;
+  }
+  PyObject *shapes = PyObject_GetAttrString(p->obj, "_input_shapes");
+  PyObject *shape = shapes ? PyDict_GetItemString(shapes, key) : nullptr;
+  PyObject *reshaped =
+      shape ? PyObject_CallMethod(arr, "reshape", "O", shape) : nullptr;
+  Py_XDECREF(shapes);
+  Py_DECREF(arr);
+  if (reshaped == nullptr) {
+    CapturePyError("reshape input (unknown key?)");
+    return -1;
+  }
+  PyObject *r =
+      PyObject_CallMethod(p->obj, "set_input", "sO", key, reshaped);
+  Py_DECREF(reshaped);
+  if (r == nullptr) {
+    CapturePyError("set_input");
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredForward(PredictorHandle h) {
+  auto *p = static_cast<Predictor *>(h);
+  GilGuard gil;
+  PyObject *r = PyObject_CallMethod(p->obj, "forward", nullptr);
+  if (r == nullptr) {
+    CapturePyError("forward");
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle h, mx_uint index, mx_float *data,
+                    mx_uint size) {
+  auto *p = static_cast<Predictor *>(h);
+  GilGuard gil;
+  PyObject *out = PyObject_CallMethod(p->obj, "get_output", "I", index);
+  if (out == nullptr) {
+    CapturePyError("get_output");
+    return -1;
+  }
+  PyObject *flat = PyObject_CallMethod(out, "reshape", "i", -1);
+  Py_DECREF(out);
+  if (flat == nullptr) {
+    CapturePyError("flatten output");
+    return -1;
+  }
+  PyObject *lst = PyObject_CallMethod(flat, "tolist", nullptr);
+  Py_DECREF(flat);
+  if (lst == nullptr) {
+    CapturePyError("tolist");
+    return -1;
+  }
+  const Py_ssize_t n = PySequence_Size(lst);
+  if (static_cast<mx_uint>(n) != size) {
+    Py_DECREF(lst);
+    g_last_error = "MXPredGetOutput: size mismatch";
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *item = PySequence_GetItem(lst, i);
+    data[i] = static_cast<mx_float>(PyFloat_AsDouble(item));
+    Py_DECREF(item);
+  }
+  Py_DECREF(lst);
+  return 0;
+}
+
+int MXPredFree(PredictorHandle h) {
+  auto *p = static_cast<Predictor *>(h);
+  {
+    GilGuard gil;
+    Py_XDECREF(p->obj);
+  }
+  delete p;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,58 @@
+/*
+ * C inference ABI (parity: include/mxnet/c_predict_api.h:77-152).
+ *
+ * Same function surface as the reference so C/C++ deployments port
+ * directly: create a predictor from symbol JSON + param bytes, set
+ * inputs, forward, read outputs. Backed by the mxtpu Python runtime via
+ * an embedded interpreter — the heavy lifting (graph -> one XLA
+ * executable) happens in XLA, so this shim stays thin.
+ */
+#ifndef MXTPU_C_PREDICT_API_H_
+#define MXTPU_C_PREDICT_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+
+/* Returns the last error message (thread-local). */
+const char *MXGetLastError(void);
+
+/*
+ * Create a predictor.
+ *  symbol_json_str : symbol graph JSON
+ *  param_bytes     : nd.save()-format parameter blob
+ *  param_size      : blob size in bytes
+ *  dev_type        : 1 cpu, 2 gpu (mapped to tpu when available)
+ *  dev_id          : device ordinal
+ *  num_input_nodes : number of fed inputs
+ *  input_keys      : input names
+ *  input_shape_indptr / input_shape_data : CSR-style shape encoding
+ */
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out);
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim);
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size);
+
+int MXPredForward(PredictorHandle handle);
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size);
+
+int MXPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXTPU_C_PREDICT_API_H_ */

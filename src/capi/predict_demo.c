@@ -1,0 +1,84 @@
+/* Minimal C consumer of the predict ABI (parity role: the amalgamation /
+ * cpp-package inference examples). Usage:
+ *   predict_demo <symbol.json> <params file> <batch> <feature_dim>
+ * Prints the first output row. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "c_predict_api.h"
+
+static char *read_file(const char *path, long *size) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char *buf = (char *)malloc(*size + 1);
+  if (fread(buf, 1, *size, f) != (size_t)*size) {
+    fclose(f);
+    free(buf);
+    return NULL;
+  }
+  buf[*size] = 0;
+  fclose(f);
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 5) {
+    fprintf(stderr, "usage: %s symbol.json params batch dim\n", argv[0]);
+    return 2;
+  }
+  long json_size = 0, param_size = 0;
+  char *json = read_file(argv[1], &json_size);
+  char *params = read_file(argv[2], &param_size);
+  if (!json || !params) {
+    fprintf(stderr, "cannot read inputs\n");
+    return 2;
+  }
+  mx_uint batch = (mx_uint)atoi(argv[3]);
+  mx_uint dim = (mx_uint)atoi(argv[4]);
+
+  const char *keys[] = {"data"};
+  mx_uint indptr[] = {0, 2};
+  mx_uint shape[] = {batch, dim};
+  PredictorHandle pred = NULL;
+  if (MXPredCreate(json, params, (int)param_size, 1, 0, 1, keys, indptr,
+                   shape, &pred) != 0) {
+    fprintf(stderr, "MXPredCreate failed: %s\n", MXGetLastError());
+    return 1;
+  }
+  mx_uint n = batch * dim;
+  mx_float *in = (mx_float *)malloc(n * sizeof(mx_float));
+  for (mx_uint i = 0; i < n; ++i) in[i] = (mx_float)(i % 7) * 0.1f;
+  if (MXPredSetInput(pred, "data", in, n) != 0 ||
+      MXPredForward(pred) != 0) {
+    fprintf(stderr, "forward failed: %s\n", MXGetLastError());
+    return 1;
+  }
+  mx_uint *oshape = NULL, ondim = 0;
+  if (MXPredGetOutputShape(pred, 0, &oshape, &ondim) != 0) {
+    fprintf(stderr, "shape failed: %s\n", MXGetLastError());
+    return 1;
+  }
+  mx_uint osize = 1;
+  for (mx_uint i = 0; i < ondim; ++i) osize *= oshape[i];
+  mx_float *out = (mx_float *)malloc(osize * sizeof(mx_float));
+  if (MXPredGetOutput(pred, 0, out, osize) != 0) {
+    fprintf(stderr, "get output failed: %s\n", MXGetLastError());
+    return 1;
+  }
+  printf("output_shape:");
+  for (mx_uint i = 0; i < ondim; ++i) printf(" %u", oshape[i]);
+  printf("\nrow0:");
+  for (mx_uint i = 0; i < (osize < 8 ? osize : 8); ++i)
+    printf(" %.4f", out[i]);
+  printf("\nPREDICT_DEMO_OK\n");
+  MXPredFree(pred);
+  free(in);
+  free(out);
+  free(json);
+  free(params);
+  return 0;
+}

@@ -1,6 +1,7 @@
 """Predict API, rtc, contrib.autograd, torch bridge, ccSGD, and the
 per-row negative-binomial samplers (parity tier: tests/python/predict/,
 test_rtc.py, contrib autograd tests)."""
+import os
 import numpy as np
 import pytest
 
@@ -121,3 +122,28 @@ def test_tensorboard_callback(tmp_path):
                                         "float32"))])
     Param = namedtuple("Param", ["eval_metric"])
     cb(Param(eval_metric=metric))
+
+
+def test_c_predict_abi(tmp_path):
+    """Compile and run the C predict demo against a real checkpoint
+    (parity tier: tests/python/predict + amalgamation smoke)."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    lib = os.path.join(repo, "mxtpu", "native", "libmxtpu_predict.so")
+    if not os.path.exists(lib):
+        pytest.skip("libmxtpu_predict.so not built")
+    prefix, X, _ = _train_tiny(tmp_path)
+    exe = str(tmp_path / "predict_demo")
+    src = os.path.join(repo, "src", "capi", "predict_demo.c")
+    subprocess.run(["gcc", src, "-I", os.path.join(repo, "src", "capi"),
+                    lib, "-o", exe, "-Wl,-rpath," + os.path.dirname(lib)],
+                   check=True)
+    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [exe, prefix + "-symbol.json", prefix + "-0001.params", "8", "6"],
+        capture_output=True, timeout=300, env=env)
+    out = res.stdout.decode()
+    assert res.returncode == 0, out + res.stderr.decode()
+    assert "PREDICT_DEMO_OK" in out
+    assert "output_shape: 8 2" in out
