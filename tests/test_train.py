@@ -1,0 +1,121 @@
+"""Convergence tier (parity: tests/python/train/{test_mlp,test_conv}.py —
+small end-to-end runs asserting accuracy thresholds)."""
+import numpy as np
+
+import mxtpu as mx
+
+
+def _separable(n=512, dim=20, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, dim).astype("float32")
+    w = rng.randn(dim).astype("float32")
+    Y = (X @ w > np.median(X @ w)).astype("float32")
+    return X, Y
+
+
+def test_mlp_converges():
+    X, Y = _separable()
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    it = mx.io.NDArrayIter(X, Y, batch_size=32, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=25, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    acc = mod.score(it, mx.metric.Accuracy())[0][1]
+    assert acc > 0.95, acc
+
+
+def test_conv_converges():
+    # class = which quadrant carries a bright blob
+    rng = np.random.RandomState(0)
+    n = 256
+    Y = rng.randint(0, 4, n).astype("float32")
+    X = rng.rand(n, 1, 12, 12).astype("float32") * 0.1
+    for i in range(n):
+        q = int(Y[i])
+        r, c = (q // 2) * 6, (q % 2) * 6
+        X[i, 0, r:r + 6, c:c + 6] += 1.0
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, name="c1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    it = mx.io.NDArrayIter(X, Y, batch_size=32, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=12, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9})
+    acc = mod.score(it, mx.metric.Accuracy())[0][1]
+    assert acc > 0.9, acc
+
+
+def test_gluon_converges_and_resumes(tmp_path):
+    from mxtpu import autograd, gluon
+
+    X, Y = _separable(n=256, dim=10)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(32, activation="relu"))
+        net.add(gluon.nn.Dense(2))
+    net.collect_params().initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    xs = mx.nd.array(X)
+    ys = mx.nd.array(Y)
+    for _ in range(60):
+        with autograd.record():
+            loss = loss_fn(net(xs), ys)
+        loss.backward()
+        trainer.step(X.shape[0])
+    pred = net(xs).asnumpy().argmax(1)
+    acc = (pred == Y).mean()
+    assert acc > 0.95, acc
+    # checkpoint + reload keeps accuracy
+    p = str(tmp_path / "net.params")
+    net.save_params(p)
+    net2 = gluon.nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(gluon.nn.Dense(32, activation="relu"))
+        net2.add(gluon.nn.Dense(2))
+    net2.load_params(p, ctx=mx.cpu())
+    pred2 = net2(xs).asnumpy().argmax(1)
+    assert (pred2 == pred).all()
+
+
+def test_bf16_training_converges():
+    """fp16-tier parity (test_dtype.py role): train in bfloat16 via the
+    fused trainer; loss must fall."""
+    from mxtpu.parallel import make_mesh
+    from mxtpu.parallel.dp import DataParallelTrainer
+
+    X, Y = _separable(n=128, dim=16)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mesh = make_mesh(shape=(1,))
+    tr = DataParallelTrainer(net, mesh=mesh, optimizer="sgd",
+                             optimizer_params={"learning_rate": 0.5,
+                                               "momentum": 0.9,
+                                               "rescale_grad": 1.0 / 128},
+                             dtype="bfloat16")
+    tr.init({"data": (128, 16), "softmax_label": (128,)})
+    import jax.numpy as jnp
+
+    feed = {"data": jnp.asarray(X, jnp.bfloat16),
+            "softmax_label": jnp.asarray(Y)}
+    first = None
+    for i in range(40):
+        outs = tr.step(feed)
+    probs = np.asarray(outs[0], dtype=np.float32)
+    acc = (probs.argmax(1) == Y).mean()
+    assert acc > 0.9, acc
