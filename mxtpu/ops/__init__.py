@@ -11,4 +11,5 @@ from . import rnn  # noqa: F401
 from . import contrib  # noqa: F401
 from . import spatial  # noqa: F401
 from . import custom  # noqa: F401
+from . import attention  # noqa: F401
 from .registry import OpDef, get_op, list_ops, op_exists, register  # noqa: F401

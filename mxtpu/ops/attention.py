@@ -1,0 +1,184 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Beyond reference parity (the reference has no attention operator —
+SURVEY.md §5 'Long-context'), but the hot op of any long-context model, so
+it gets the full TPU treatment per /opt/skills/guides/pallas_guide.md:
+
+- grid (batch*heads, q_blocks, kv_blocks), iterated sequentially on-core
+  so VMEM scratch (running max / normalizer / accumulator) carries the
+  online-softmax state across the kv dimension;
+- q@k^T and p@v on the MXU with f32 accumulation (preferred_element_type);
+- causal masking per block via broadcasted iotas;
+- output written once, on the last kv block, normalized by the running sum.
+
+Backward runs through a jax.custom_vjp whose residual-free bwd recomputes
+with the pure-jnp reference (identical math) — the standard
+recompute-in-bwd tradeoff flash attention makes anyway.
+
+On non-TPU backends the kernel runs in interpret mode for small shapes
+(tests) and falls back to the jnp reference otherwise.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+try:  # pallas import kept soft so CPU-only installs still import this module
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PALLAS = True
+except ImportError:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+NEG_INF = -1e30
+
+
+def _reference(q, k, v, scale, causal):
+    """Pure-jnp oracle; also the bwd recompute path. (BH, T, D) layout."""
+    s = jnp.einsum("btd,bsd->bts", q, k).astype(jnp.float32) * scale
+    if causal:
+        t = s.shape[1]
+        srng = s.shape[2]
+        mask = jnp.arange(srng)[None, :] <= jnp.arange(t)[:, None]
+        s = jnp.where(mask[None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bts,bsd->btd", p.astype(v.dtype), v)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                scale, causal, block_q, block_k):
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    i = pl.program_id(1)
+    # causal: a kv block strictly above the diagonal contributes nothing —
+    # skip its matmuls entirely (halves the causal FLOPs)
+    live = (j * block_k <= (i + 1) * block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0]  # (block_q, D)
+        k = k_ref[0]  # (block_k, D)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols <= rows, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]                      # (block_q, 1)
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                     # (block_q, block_k) f32
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[...] /
+                    jnp.where(l == 0, 1.0, l)).astype(o_ref.dtype)
+
+
+def _flash_call(q, k, v, scale, causal, block_q, block_k, interpret):
+    bh, t, d = q.shape
+    s_len = k.shape[1]
+    block_q = min(block_q, t)
+    block_k = min(block_k, s_len)
+    grid = (bh, pl.cdiv(t, block_q), pl.cdiv(s_len, block_k))
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash3(q, k, v, scale, causal, block_q, block_k):
+    if not _HAVE_PALLAS:
+        return _reference(q, k, v, scale, causal)
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu:
+        # interpret mode exercises the kernel logic on CPU for small
+        # problems; big CPU shapes take the reference path
+        if q.shape[0] * q.shape[1] * k.shape[1] <= 1 << 22:
+            return _flash_call(q, k, v, scale, causal, block_q, block_k,
+                               interpret=True)
+        return _reference(q, k, v, scale, causal)
+    return _flash_call(q, k, v, scale, causal, block_q, block_k,
+                       interpret=False)
+
+
+def _flash3_fwd(q, k, v, scale, causal, block_q, block_k):
+    return _flash3(q, k, v, scale, causal, block_q, block_k), (q, k, v)
+
+
+def _flash3_bwd(scale, causal, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: _reference(a, b, c, scale, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash3.defvjp(_flash3_fwd, _flash3_bwd)
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=512,
+                    block_k=1024):
+    """Multi-head attention, (B, H, T, D) layout (B/H merged internally)."""
+    b, h, t, d = q.shape
+    s_len = k.shape[2]
+    scale = float(sm_scale) if sm_scale is not None else 1.0 / (d ** 0.5)
+    qf = q.reshape(b * h, t, d)
+    kf = k.reshape(b * h, s_len, d)
+    vf = v.reshape(b * h, s_len, d)
+    out = _flash3(qf, kf, vf, scale, bool(causal), int(block_q),
+                  int(block_k))
+    return out.reshape(b, h, t, d)
+
+
+def _flash_op(a, q, k, v):
+    return flash_attention(q, k, v, causal=a.causal,
+                           sm_scale=(a.sm_scale if a.sm_scale != 0.0
+                                     else None),
+                           block_q=a.block_q, block_k=a.block_k)
+
+
+register("_contrib_FlashAttention", _flash_op,
+         arg_names=["query", "key", "value"],
+         attrs={"causal": False, "sm_scale": 0.0, "block_q": 512,
+                "block_k": 1024},
+         aliases=("flash_attention",))
