@@ -7,8 +7,9 @@ comes from jax.distributed instead of DMLC_ROLE env plumbing.
 from .mesh import (current_mesh, host_barrier, make_mesh, process_count,
                    process_index)
 from .dp import DataParallelTrainer, shard_params_spec
-from .ring_attention import ring_attention, blockwise_attention
+from .ring_attention import (ring_attention, blockwise_attention,
+                             ulysses_attention)
 
 __all__ = ["make_mesh", "current_mesh", "host_barrier", "process_index",
            "process_count", "DataParallelTrainer", "shard_params_spec",
-           "ring_attention", "blockwise_attention"]
+           "ring_attention", "blockwise_attention", "ulysses_attention"]

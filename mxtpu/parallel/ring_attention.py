@@ -43,7 +43,8 @@ def _block_attn(q, k, v, m_prev, l_prev, o_prev, mask=None, scale=1.0):
     return m_new, l_new, o_new
 
 
-def blockwise_attention(q, k, v, block_size=512, causal=False):
+def blockwise_attention(q, k, v, block_size=512, causal=False,
+                        axis_name=None):
     """Memory-efficient attention on one device: scan over K/V blocks.
 
     Shapes: q (B, Tq, H, D), k/v (B, Tk, H, D). Returns (B, Tq, H, D).
@@ -76,6 +77,9 @@ def blockwise_attention(q, k, v, block_size=512, causal=False):
     m0 = jnp.full((B, H, Tq), NEG_INF, q.dtype)
     l0 = jnp.zeros((B, H, Tq), q.dtype)
     o0 = jnp.zeros_like(q)
+    if axis_name is not None:  # inside shard_map: carries must be varying
+        m0 = lax.pvary(m0, axis_name)
+        l0 = lax.pvary(l0, axis_name)
     (m, l, o), _ = lax.scan(body, (m0, l0, o0),
                             (kb, vb, jnp.arange(nblk)))
     return o / jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
@@ -119,6 +123,60 @@ def ring_attention(q, k, v, mesh=None, axis_name="seq", causal=False):
         o0 = jnp.zeros_like(ql)
         m, l, o, _, _ = lax.fori_loop(0, axis_size, body, (m0, l0, o0, kl, vl))
         return o / jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
+
+    return shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)
+
+
+def ulysses_attention(q, k, v, mesh=None, axis_name="seq", causal=False):
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
+
+    Alternative context-parallel strategy to ring_attention: q/k/v arrive
+    sharded on the sequence axis (B, T/p, H, D); an all-to-all re-shards
+    them to (B, T, H/p, D) so every device runs FULL-sequence attention
+    over its head slice, then a second all-to-all restores sequence
+    sharding. Two collectives total instead of p ppermute steps — better
+    when heads >= devices and the interconnect favors fewer, larger
+    transfers.
+    """
+    if mesh is None:
+        from .mesh import current_mesh
+        mesh = current_mesh()
+    axis_size = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
+    spec = P(None, axis_name, None, None)
+
+    def local_fn(ql, kl, vl):
+        B, Tl, H, D = ql.shape
+        assert H % axis_size == 0, \
+            "ulysses needs heads (%d) divisible by axis size (%d)" % (
+                H, axis_size)
+        scale = 1.0 / jnp.sqrt(D).astype(ql.dtype)
+
+        def to_heads(x):
+            # (B, Tl, H, D) -> (B, p*Tl, H/p, D): split heads across the
+            # axis, gather the full sequence
+            x = x.reshape(B, Tl, axis_size, H // axis_size, D)
+            x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                               tiled=False)
+            return x.reshape(B, axis_size * Tl, H // axis_size, D)
+
+        def to_seq(x):
+            # inverse: (B, T, H/p, D) -> (B, Tl, H, D)
+            T = x.shape[1]
+            x = x.reshape(B, axis_size, T // axis_size, H // axis_size, D)
+            x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=3,
+                               tiled=False)
+            # received axis (pos 3) is the head-group owner: head index is
+            # (group, within-group), so put the group axis first
+            x = x.transpose(0, 1, 3, 2, 4)
+            return x.reshape(B, T // axis_size, H, D)
+
+        qh, kh, vh = to_heads(ql), to_heads(kl), to_heads(vl)
+        # full-sequence attention on the local head slice (flash-style
+        # streaming so long context stays O(T) memory)
+        out = blockwise_attention(qh, kh, vh, block_size=512,
+                                  causal=causal, axis_name=axis_name)
+        return to_seq(out)
 
     return shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec)(q, k, v)
