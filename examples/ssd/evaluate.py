@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""SSD evaluation: VOC-style mean average precision (parity:
+example/ssd/evaluate.py + train/metric.py MApMetric)."""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxtpu as mx  # noqa: E402
+from mxtpu.models import ssd as ssd_model  # noqa: E402
+
+
+class MApMetric(mx.metric.EvalMetric):
+    """VOC mean average precision (parity example/ssd/train/metric.py).
+
+    update() takes detection outputs (N, num_det, 6) rows
+    [cls, score, x1, y1, x2, y2] (invalid cls < 0) and labels
+    (N, num_obj, >=5) rows [cls, x1, y1, x2, y2] (invalid cls < 0).
+    """
+
+    def __init__(self, ovp_thresh=0.5, use_difficult=False, class_names=None,
+                 pred_idx=0):
+        self.ovp_thresh = ovp_thresh
+        self.use_difficult = use_difficult
+        self.class_names = class_names
+        self.pred_idx = int(pred_idx)
+        super().__init__("mAP")
+        self.reset()
+
+    def reset(self):
+        # per-class list of (score, tp) plus gt counts
+        self.records = {}
+        self.gt_counts = {}
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    @staticmethod
+    def _iou(box, boxes):
+        ix1 = np.maximum(box[0], boxes[:, 0])
+        iy1 = np.maximum(box[1], boxes[:, 1])
+        ix2 = np.minimum(box[2], boxes[:, 2])
+        iy2 = np.minimum(box[3], boxes[:, 3])
+        iw = np.maximum(ix2 - ix1, 0)
+        ih = np.maximum(iy2 - iy1, 0)
+        inter = iw * ih
+        a1 = (box[2] - box[0]) * (box[3] - box[1])
+        a2 = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        union = a1 + a2 - inter
+        return inter / np.maximum(union, 1e-12)
+
+    def update(self, labels, preds):
+        det = preds[self.pred_idx].asnumpy()
+        lab = labels[0].asnumpy()
+        for i in range(det.shape[0]):
+            d = det[i]
+            d = d[d[:, 0] >= 0]
+            g = lab[i]
+            g = g[g[:, 0] >= 0]
+            for cls in np.unique(np.concatenate([d[:, 0], g[:, 0]])):
+                cls = int(cls)
+                dc = d[d[:, 0] == cls]
+                gc = g[g[:, 0] == cls][:, 1:5]
+                self.gt_counts[cls] = self.gt_counts.get(cls, 0) + len(gc)
+                taken = np.zeros(len(gc), bool)
+                order = np.argsort(-dc[:, 1])
+                for j in order:
+                    box = dc[j, 2:6]
+                    if len(gc):
+                        ious = self._iou(box, gc)
+                        best = int(np.argmax(ious))
+                        if ious[best] >= self.ovp_thresh and not taken[best]:
+                            taken[best] = True
+                            self.records.setdefault(cls, []).append(
+                                (dc[j, 1], 1))
+                            continue
+                    self.records.setdefault(cls, []).append((dc[j, 1], 0))
+
+    def get(self):
+        aps = []
+        for cls, count in self.gt_counts.items():
+            if count == 0:
+                continue
+            recs = sorted(self.records.get(cls, []), reverse=True)
+            if not recs:
+                aps.append(0.0)
+                continue
+            tps = np.cumsum([r[1] for r in recs])
+            fps = np.cumsum([1 - r[1] for r in recs])
+            recall = tps / count
+            precision = tps / np.maximum(tps + fps, 1e-12)
+            # VOC-style interpolated AP (all-points)
+            ap = 0.0
+            prev_r = 0.0
+            for r, p in zip(recall, precision):
+                ap += (r - prev_r) * np.max(
+                    precision[recall >= r]) if r > prev_r else 0.0
+                prev_r = r
+            aps.append(ap)
+        return "mAP", float(np.mean(aps)) if aps else 0.0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--val-rec", required=True)
+    ap.add_argument("--num-classes", type=int, default=20)
+    ap.add_argument("--data-shape", type=int, default=300)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--prefix", default=None, help="checkpoint prefix")
+    ap.add_argument("--epoch", type=int, default=0)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = ssd_model.get_symbol(num_classes=args.num_classes)
+    shape = (3, args.data_shape, args.data_shape)
+    it = mx.io.ImageDetRecordIter(
+        path_imgrec=args.val_rec, data_shape=shape,
+        batch_size=args.batch_size, mean_pixels=(123, 117, 104))
+    mod = mx.mod.Module(net, label_names=("label",),
+                        context=mx.test_utils.default_context())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=False)
+    if args.prefix:
+        _, arg_params, aux_params = mx.model.load_checkpoint(args.prefix,
+                                                             args.epoch)
+        mod.set_params(arg_params, aux_params, allow_missing=True)
+    else:
+        mod.init_params()
+    metric = MApMetric()
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        metric.update(batch.label, mod.get_outputs())
+    logging.info("%s: %.4f", *metric.get())
+
+
+if __name__ == "__main__":
+    main()

@@ -191,3 +191,38 @@ def test_kvstore_save_load_optimizer_states(tmp_path):
     fname = str(tmp_path / "states.bin")
     store.save_optimizer_states(fname)
     store.load_optimizer_states(fname)
+
+
+def test_map_metric():
+    import importlib.util
+    import os
+    import numpy as np
+    import mxtpu as mx
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "examples", "ssd", "evaluate.py")
+    spec = importlib.util.spec_from_file_location("ssd_evaluate", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    m = mod.MApMetric(ovp_thresh=0.5)
+    # one image, one gt box of class 0; detections: one perfect hit and
+    # one false positive of class 1
+    label = np.full((1, 4, 5), -1.0, "float32")
+    label[0, 0] = [0, 0.1, 0.1, 0.5, 0.5]
+    det = np.full((1, 4, 6), -1.0, "float32")
+    det[0, 0] = [0, 0.9, 0.1, 0.1, 0.5, 0.5]   # matches gt -> tp
+    det[0, 1] = [1, 0.8, 0.6, 0.6, 0.9, 0.9]   # class with no gt
+    m.update([mx.nd.array(label)], [mx.nd.array(det)])
+    name, val = m.get()
+    assert name == "mAP"
+    assert abs(val - 1.0) < 1e-6  # class 0 AP=1; class 1 has no gt -> skip
+
+    # a missed gt halves recall
+    m2 = mod.MApMetric()
+    label2 = np.full((1, 4, 5), -1.0, "float32")
+    label2[0, 0] = [0, 0.1, 0.1, 0.5, 0.5]
+    label2[0, 1] = [0, 0.6, 0.6, 0.9, 0.9]
+    m2.update([mx.nd.array(label2)], [mx.nd.array(det)])
+    _, val2 = m2.get()
+    assert abs(val2 - 0.5) < 1e-6
