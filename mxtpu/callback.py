@@ -19,13 +19,16 @@ def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
 
 
 def do_checkpoint(prefix, period=1):
-    """Epoch-end checkpoint callback (parity callback.py:55)."""
+    """Epoch-end checkpoint callback (parity callback.py:55). Writes go
+    through the native engine asynchronously so the next epoch starts
+    while the file lands; load_checkpoint/nd.waitall() drain them."""
     from .model import save_checkpoint
     period = int(max(1, period))
 
     def _callback(iter_no, sym, arg, aux):
         if (iter_no + 1) % period == 0:
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+            save_checkpoint(prefix, iter_no + 1, sym, arg, aux,
+                            async_write=True)
     return _callback
 
 

@@ -77,18 +77,62 @@ def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None,
             updater(index * num_device + k, g, w)
 
 
-def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
-    """prefix-symbol.json + prefix-%04d.params (parity model.py:340)."""
+_ckpt_vars = {}  # prefix -> engine Var serializing writes to that prefix
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    async_write=False):
+    """prefix-symbol.json + prefix-%04d.params (parity model.py:340).
+
+    With ``async_write`` the params write is pushed onto the native engine
+    as a host task — training continues while the file lands (the
+    reference gets the same overlap from engine-scheduled ops). Device
+    values are snapshotted to host numpy eagerly so later optimizer steps
+    cannot corrupt the checkpoint; writes to one prefix serialize on one
+    engine variable and ``load_checkpoint``/``nd.waitall()`` drain them.
+    """
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
-    logging.info('Saved checkpoint to "%s"', param_name)
+    if not async_write:
+        nd.save(param_name, save_dict)
+        logging.info('Saved checkpoint to "%s"', param_name)
+        return
+    import numpy as _np
+
+    from . import engine as _engine
+
+    snap = {k: _np.asarray(v.asnumpy()) for k, v in save_dict.items()}
+    eng = _engine.get()
+    var = _ckpt_vars.get(prefix)
+    if var is None:
+        var = _ckpt_vars[prefix] = eng.new_variable()
+
+    def _write(snap=snap, param_name=param_name):
+        nd.save(param_name, snap)
+        logging.info('Saved checkpoint to "%s"', param_name)
+
+    eng.push(_write, mutable_vars=[var])
+
+
+def wait_checkpoints(prefix=None):
+    """Block until pending async checkpoint writes are durable."""
+    from . import engine as _engine
+
+    eng = _engine.get()
+    if prefix is not None:
+        var = _ckpt_vars.get(prefix)
+        if var is not None:
+            eng.wait_for_var(var)
+        return
+    for var in _ckpt_vars.values():
+        eng.wait_for_var(var)
 
 
 def load_checkpoint(prefix, epoch):
+    wait_checkpoints(prefix)  # drain any in-flight async write first
     symbol = sym.load("%s-symbol.json" % prefix)
     save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
     arg_params = {}

@@ -364,12 +364,17 @@ imperative_invoke = invoke_op
 
 
 def waitall():
-    """Block until all launched work completes (parity Engine::WaitForAll)."""
+    """Block until all launched work completes (parity Engine::WaitForAll):
+    device work (XLA dispatch queue) AND host tasks scheduled on the native
+    engine (async checkpoint writes, prefetch side effects)."""
     (jnp.zeros(()) + 0).block_until_ready()
     try:
         jax.effects_barrier()
     except Exception:
         pass
+    from .. import engine as _engine
+
+    _engine.get().wait_for_all()
 
 
 # ---------------------------------------------------------------- creation
@@ -455,7 +460,8 @@ def save(fname, data):
         f.write(_MAGIC)
         f.write(struct.pack("<q", len(items)))
         for name, arr in items:
-            np_arr = arr.asnumpy()
+            np_arr = (arr.asnumpy() if hasattr(arr, "asnumpy")
+                      else _np.asarray(arr))
             hdr = json.dumps({"shape": list(np_arr.shape),
                               "dtype": str(np_arr.dtype)}).encode()
             nb = name.encode()

@@ -215,3 +215,27 @@ def test_threaded_iter_prefetch():
         got.append(item.value - 1)
     assert got == list(range(50))
     _native.check_call(lib.MXTPUThreadedIterFree(h))
+
+
+def test_async_checkpoint_roundtrip(tmp_path):
+    """do_checkpoint-style async writes land durably and load_checkpoint
+    drains in-flight writes before reading."""
+    import numpy as np
+    import mxtpu as mx
+
+    prefix = str(tmp_path / "ck")
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    arg = {"fc_weight": mx.nd.array(np.arange(12, dtype="float32")
+                                    .reshape(4, 3)),
+           "fc_bias": mx.nd.zeros((4,))}
+    for epoch in range(1, 4):
+        mx.model.save_checkpoint(prefix, epoch, net, arg, {},
+                                 async_write=True)
+    sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 3)
+    np.testing.assert_allclose(arg2["fc_weight"].asnumpy(),
+                               arg["fc_weight"].asnumpy())
+    mx.nd.waitall()
+    assert os.path.exists(prefix + "-0001.params")
+    assert os.path.exists(prefix + "-0002.params")
