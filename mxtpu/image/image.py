@@ -472,6 +472,7 @@ class ImageIter(_io.DataIter):
 
     def next_sample(self):
         """(label, decoded HWC image) for the next sample."""
+        flag = 1 if self.data_shape[0] == 3 else 0  # grayscale decode for C=1
         if self.seq is not None:
             if self.cur >= len(self.seq):
                 raise StopIteration
@@ -481,15 +482,16 @@ class ImageIter(_io.DataIter):
                 s = self.imgrec.read_idx(idx)
                 from .. import recordio as rio
                 header, img = rio.unpack(s)
-                return header.label, imdecode(img)
+                return header.label, imdecode(img, flag=flag)
             label, fname = self.imglist[idx]
-            return label, imread(os.path.join(self.path_root or "", fname))
+            return label, imread(os.path.join(self.path_root or "", fname),
+                                 flag=flag)
         s = self.imgrec.read()
         if s is None:
             raise StopIteration
         from .. import recordio as rio
         header, img = rio.unpack(s)
-        return header.label, imdecode(img)
+        return header.label, imdecode(img, flag=flag)
 
     def next(self):
         batch_size = self.batch_size

@@ -100,6 +100,16 @@ class _PyPrefetcher:
         self._q = queue.Queue(maxsize=buffer_size)
         self._stop = False
 
+        def _put(item):
+            # bounded put that aborts when the consumer went away
+            while not self._stop:
+                try:
+                    self._q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
         def loop():
             while not self._stop:
                 try:
@@ -107,10 +117,9 @@ class _PyPrefetcher:
                 except StopIteration:
                     item = None
                 except BaseException as e:
-                    self._q.put(e)
+                    _put(e)
                     return
-                self._q.put(item)
-                if item is None:
+                if not _put(item) or item is None:
                     return
 
         self._t = threading.Thread(target=loop, daemon=True)
@@ -125,7 +134,16 @@ class _PyPrefetcher:
         return item
 
     def close(self):
+        # Stop the producer BEFORE the caller rewinds shared state
+        # (reset() reuses the same record reader): unblock a full-queue
+        # put and join so no stale thread keeps reading.
         self._stop = True
+        while True:
+            try:
+                self._q.get_nowait()
+            except Exception:
+                break
+        self._t.join(timeout=5)
 
 
 class ImageRecordIter(_io.DataIter):

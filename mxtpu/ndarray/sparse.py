@@ -35,7 +35,7 @@ class BaseSparseNDArray(NDArray):
     """Common base; ``_data`` materializes the dense view lazily so every
     dense op works via storage fallback."""
 
-    __slots__ = ("_sp_shape", "_sp_dtype", "_dense_cache")
+    __slots__ = ("_sp_shape", "_sp_dtype", "_dense_cache", "_sp_stale")
 
     def __init__(self, shape, dtype, ctx=None):
         # mirror NDArray.__init__ without a dense buffer
@@ -48,6 +48,7 @@ class BaseSparseNDArray(NDArray):
         self._sp_shape = tuple(int(s) for s in shape)
         self._sp_dtype = _np.dtype(dtype)
         self._dense_cache = None
+        self._sp_stale = False
 
     # _data becomes a lazy dense materialization (storage fallback)
     @property
@@ -58,10 +59,17 @@ class BaseSparseNDArray(NDArray):
 
     @_data.setter
     def _data(self, v):  # e.g. autograd grads, kvstore pull into this array
+        # Dense writes must not desynchronize the sparse components, but
+        # hot paths (per-step kvstore pulls, grad writes) should not pay a
+        # D2H + nonzero rescan either: mark stale and rebuild lazily on the
+        # first sparse-component read (the _sp_* properties call _sync).
         self._dense_cache = v
-        # Dense writes must not desynchronize the sparse components: rebuild
-        # them eagerly so sparse readers (retain/dot/push) see the new value.
-        self._refresh_from_dense(_np.asarray(v))
+        self._sp_stale = True
+
+    def _sync(self):
+        if self._sp_stale:
+            self._sp_stale = False
+            self._refresh_from_dense(_np.asarray(self._dense_cache))
 
     def _refresh_from_dense(self, dense):
         raise NotImplementedError
@@ -105,15 +113,47 @@ class BaseSparseNDArray(NDArray):
 class CSRNDArray(BaseSparseNDArray):
     """2D compressed-sparse-row array (parity sparse.py CSRNDArray)."""
 
-    __slots__ = ("_sp_data", "_sp_indices", "_sp_indptr")
+    __slots__ = ("_spd", "_spi", "_spp")
 
     def __init__(self, data, indices, indptr, shape, ctx=None):
         dt = _np.asarray(data).dtype
         super().__init__(shape, dt, ctx)
         self.stype = "csr"
-        self._sp_data = jnp.asarray(data)
-        self._sp_indices = jnp.asarray(indices, dtype=jnp.int32)
-        self._sp_indptr = jnp.asarray(indptr, dtype=jnp.int32)
+        self._spd = jnp.asarray(data)
+        self._spi = jnp.asarray(indices, dtype=jnp.int32)
+        self._spp = jnp.asarray(indptr, dtype=jnp.int32)
+
+    # component accessors sync with any pending dense write; assigning a
+    # component directly (kvstore row_sparse paths) makes it the truth
+    @property
+    def _sp_data(self):
+        self._sync()
+        return self._spd
+
+    @_sp_data.setter
+    def _sp_data(self, v):
+        self._spd = v
+        self._sp_stale = False
+
+    @property
+    def _sp_indices(self):
+        self._sync()
+        return self._spi
+
+    @_sp_indices.setter
+    def _sp_indices(self, v):
+        self._spi = v
+        self._sp_stale = False
+
+    @property
+    def _sp_indptr(self):
+        self._sync()
+        return self._spp
+
+    @_sp_indptr.setter
+    def _sp_indptr(self, v):
+        self._spp = v
+        self._sp_stale = False
 
     @property
     def data(self):
@@ -143,10 +183,10 @@ class CSRNDArray(BaseSparseNDArray):
 
     def _refresh_from_dense(self, dense):
         rows, cols = _np.nonzero(dense)
-        self._sp_data = jnp.asarray(dense[rows, cols])
-        self._sp_indices = jnp.asarray(cols.astype(_np.int32))
+        self._spd = jnp.asarray(dense[rows, cols])
+        self._spi = jnp.asarray(cols.astype(_np.int32))
         counts = _np.bincount(rows, minlength=dense.shape[0])
-        self._sp_indptr = jnp.asarray(
+        self._spp = jnp.asarray(
             _np.concatenate([[0], _np.cumsum(counts)]).astype(_np.int32))
 
     def _to_bcoo(self):
@@ -187,14 +227,34 @@ class RowSparseNDArray(BaseSparseNDArray):
     slice for row indices[i] (parity sparse.py RowSparseNDArray — the
     storage type of embedding/sparse gradients)."""
 
-    __slots__ = ("_sp_data", "_sp_indices")
+    __slots__ = ("_spd", "_spi")
 
     def __init__(self, data, indices, shape, ctx=None):
         dt = _np.asarray(data).dtype
         super().__init__(shape, dt, ctx)
         self.stype = "row_sparse"
-        self._sp_data = jnp.asarray(data)
-        self._sp_indices = jnp.asarray(indices, dtype=jnp.int32)
+        self._spd = jnp.asarray(data)
+        self._spi = jnp.asarray(indices, dtype=jnp.int32)
+
+    @property
+    def _sp_data(self):
+        self._sync()
+        return self._spd
+
+    @_sp_data.setter
+    def _sp_data(self, v):
+        self._spd = v
+        self._sp_stale = False
+
+    @property
+    def _sp_indices(self):
+        self._sync()
+        return self._spi
+
+    @_sp_indices.setter
+    def _sp_indices(self, v):
+        self._spi = v
+        self._sp_stale = False
 
     @property
     def data(self):
@@ -213,8 +273,8 @@ class RowSparseNDArray(BaseSparseNDArray):
     def _refresh_from_dense(self, dense):
         nz_rows = _np.nonzero(
             _np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
-        self._sp_data = jnp.asarray(dense[nz_rows])
-        self._sp_indices = jnp.asarray(nz_rows.astype(_np.int32))
+        self._spd = jnp.asarray(dense[nz_rows])
+        self._spi = jnp.asarray(nz_rows.astype(_np.int32))
 
     def copy(self):
         return RowSparseNDArray(self._sp_data, self._sp_indices,

@@ -50,7 +50,7 @@ def _reference(q, k, v, scale, causal):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                scale, causal, block_q, block_k):
+                scale, causal, block_q, block_k, kv_len):
     j = pl.program_id(2)
     nj = pl.num_programs(2)
 
@@ -72,12 +72,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
 
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
         if causal:
             rows = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(cols <= rows, s, NEG_INF)
+        v_blk = v_ref[0]
+        if kv_len % block_k != 0:
+            # tail block: padded KV columns must not enter the softmax,
+            # and padded V rows may be garbage/NaN — 0 * NaN = NaN, so
+            # zero them instead of relying on p == 0
+            s = jnp.where(cols < kv_len, s, NEG_INF)
+            vrows = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, 1), 0)
+            v_blk = jnp.where(vrows < kv_len, v_blk, 0)
 
         m_prev = m_ref[:, :1]                      # (block_q, 1)
         l_prev = l_ref[:, :1]
@@ -87,7 +96,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         p = jnp.exp(s - m_new)                     # (block_q, block_k) f32
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -106,7 +115,8 @@ def _flash_call(q, k, v, scale, causal, block_q, block_k, interpret):
     block_k = min(block_k, s_len)
     grid = (bh, pl.cdiv(t, block_q), pl.cdiv(s_len, block_k))
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k)
+                               block_q=block_q, block_k=block_k,
+                               kv_len=s_len)
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),

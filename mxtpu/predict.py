@@ -34,7 +34,8 @@ class Predictor:
         symbol = sym_mod.load_json(symbol_json_str) \
             if isinstance(symbol_json_str, str) else symbol_json_str
         if output_index is not None:
-            outs = symbol.get_internals().list_outputs()  # pragma: no cover
+            # MXPredCreatePartialOut contract: predict an internal output
+            symbol = symbol.get_internals()[int(output_index)]
         self._symbol = symbol
         if isinstance(param_bytes_or_dict, (bytes, bytearray)):
             loaded = nd.load(_io.BytesIO(bytes(param_bytes_or_dict)))

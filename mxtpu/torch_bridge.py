@@ -58,5 +58,11 @@ def function(name):
 
 
 def __getattr__(name):
-    # attribute-style access: mx.th.sigmoid(x)
+    # attribute-style access: mx.th.sigmoid(x). Missing names must raise
+    # AttributeError (not MXNetError) so hasattr()/introspection work.
+    if name.startswith("__"):
+        raise AttributeError(name)
+    torch = _torch()
+    if not hasattr(torch, name):
+        raise AttributeError("torch has no function %r" % name)
     return function(name)

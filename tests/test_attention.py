@@ -87,3 +87,15 @@ def test_blockwise_agrees_with_flash():
     np.testing.assert_allclose(np.asarray(blockwise),
                                np.asarray(flash.transpose(0, 2, 1, 3)),
                                rtol=2e-3, atol=2e-3)
+
+
+def test_flash_ragged_kv_tail():
+    # kv length not a multiple of block_k: padded columns must not leak
+    b, h, t, s, d = 1, 1, 64, 96, 32
+    q = _rand((b, h, t, d), 0)
+    k = _rand((b, h, s, d), 1)
+    v = _rand((b, h, s, d), 2)
+    out = att.flash_attention(q, k, v, block_q=64, block_k=64)
+    ref = att._reference(q[0], k[0], v[0], 1.0 / d ** 0.5, False)[None]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
