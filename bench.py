@@ -47,7 +47,7 @@ def main():
     # flush the remote execution queue on tunneled runtimes)
     np.asarray(outs[0][:1])
 
-    iters = 10
+    iters = 30
     t0 = time.perf_counter()
     for _ in range(iters):
         outs = trainer.step(feed)
