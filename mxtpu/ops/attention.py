@@ -38,7 +38,8 @@ NEG_INF = -1e30
 
 
 def _reference(q, k, v, scale, causal):
-    """Pure-jnp oracle; also the bwd recompute path. (BH, T, D) layout."""
+    """Pure-jnp oracle. (BH, T, D) layout. Materializes the T^2 score
+    matrix — tests and small shapes only."""
     s = jnp.einsum("btd,bsd->bts", q, k).astype(jnp.float32) * scale
     if causal:
         t = s.shape[1]
@@ -47,6 +48,45 @@ def _reference(q, k, v, scale, causal):
         s = jnp.where(mask[None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bts,bsd->btd", p.astype(v.dtype), v)
+
+
+def _streaming(q, k, v, scale, causal, block=512):
+    """lax.scan flash-style attention, (BH, T, D) layout: O(T) residuals,
+    so its VJP is the memory-efficient backward recompute path."""
+    bh, t, d = q.shape
+    s_len = k.shape[1]
+    nblk = -(-s_len // block)
+    pad = nblk * block - s_len
+    kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0))) if pad else k
+    vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0))) if pad else v
+    kb = kp.reshape(bh, nblk, block, d).transpose(1, 0, 2, 3)
+    vb = vp.reshape(bh, nblk, block, d).transpose(1, 0, 2, 3)
+    q_idx = jnp.arange(t)
+
+    def body(carry, blk):
+        m_prev, l_prev, o_prev = carry
+        kc, vc, bi = blk
+        s = jnp.einsum("btd,bsd->bts", q, kc).astype(jnp.float32) * scale
+        k_idx = bi * block + jnp.arange(block)
+        valid = k_idx[None, :] < s_len
+        if causal:
+            valid = valid & (k_idx[None, :] <= q_idx[:, None])
+        s = jnp.where(valid[None], s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1)
+        o_new = o_prev * alpha[..., None] + jnp.einsum(
+            "bts,bsd->btd", p, vc.astype(jnp.float32))
+        return (m_new, l_new, o_new), None
+
+    m0 = jnp.full((bh, t), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bh, t), jnp.float32)
+    o0 = jnp.zeros((bh, t, d), jnp.float32)
+    (m, l, o), _ = jax.lax.scan(body, (m0, l0, o0),
+                                (kb, vb, jnp.arange(nblk)))
+    return (o / jnp.maximum(l, 1e-20)[..., None]).astype(q.dtype)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
@@ -158,7 +198,10 @@ def _flash3_fwd(q, k, v, scale, causal, block_q, block_k):
 
 def _flash3_bwd(scale, causal, block_q, block_k, res, g):
     q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: _reference(a, b, c, scale, causal),
+    # recompute through the streaming implementation: its scan keeps O(T)
+    # residuals, so long-context training never materializes T^2 scores
+    _, vjp = jax.vjp(lambda a, b, c: _streaming(a, b, c, scale, causal,
+                                                block=block_k),
                      q, k, v)
     return vjp(g)
 

@@ -99,3 +99,19 @@ def test_flash_ragged_kv_tail():
     ref = att._reference(q[0], k[0], v[0], 1.0 / d ** 0.5, False)[None]
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-3, atol=2e-3)
+
+
+def test_streaming_matches_reference():
+    bh, t, s, d = 2, 96, 160, 32
+    q = _rand((bh, t, d), 0)
+    k = _rand((bh, s, d), 1)
+    v = _rand((bh, s, d), 2)
+    for causal in (False, True):
+        if causal and t != s:
+            ref = att._reference(q, k, v, 0.2, False)
+            stream = att._streaming(q, k, v, 0.2, False, block=64)
+        else:
+            ref = att._reference(q, k, v, 0.2, causal)
+            stream = att._streaming(q, k, v, 0.2, causal, block=64)
+        np.testing.assert_allclose(np.asarray(stream), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)

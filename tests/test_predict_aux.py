@@ -147,3 +147,23 @@ def test_c_predict_abi(tmp_path):
     assert res.returncode == 0, out + res.stderr.decode()
     assert "PREDICT_DEMO_OK" in out
     assert "output_shape: 8 2" in out
+
+
+def test_profiler_chrome_trace(tmp_path):
+    import json
+
+    from mxtpu import profiler
+
+    profiler.profiler_set_config(filename=str(tmp_path / "trace.json"))
+    profiler.profiler_set_state("run")
+    with profiler.scope("stage_a"):
+        mx.nd.ones((4, 4)).asnumpy()
+    with profiler.scope("stage_b"):
+        pass
+    profiler.profiler_set_state("stop")
+    out = profiler.dump_profile()
+    with open(out or str(tmp_path / "trace.json")) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"] if isinstance(trace, dict) else trace
+    names = {e.get("name") for e in events}
+    assert "stage_a" in names and "stage_b" in names
