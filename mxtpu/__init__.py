@@ -15,6 +15,8 @@ __version__ = "0.1.0"
 
 from . import base
 from .base import MXNetError, MXTPUError
+from . import attribute
+from .attribute import AttrScope
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus
 from . import ndarray
 from . import ndarray as nd

@@ -424,6 +424,8 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None
     if not isinstance(name, str):
         raise TypeError("Expect a string for variable name")
     node = _Node(None, name, {}, [])
+    from ..attribute import AttrScope
+    node._extra_attrs.update(AttrScope.current())
     if shape is not None:
         node._extra_attrs["__shape__"] = tuple(shape)
     if lr_mult is not None:
@@ -482,6 +484,12 @@ def _compose(op, name, sym_inputs, attrs, kwarg_syms=None):
                              % op.name)
         entries.append(s._outputs[0])
     node = _Node(op, name, attrs, entries)
+    # scoped user attrs (with AttrScope(ctx_group=...)): dunder keys attach
+    # as extra attrs, the reference's __ctx_group__ mechanism
+    from ..attribute import AttrScope
+    scoped = AttrScope.current()
+    if scoped:
+        node._extra_attrs.update(scoped)
     n_vis = op.n_out(parsed)
     return Symbol([(node, i) for i in range(n_vis)]) if n_vis > 1 else \
         Symbol([(node, 0)])

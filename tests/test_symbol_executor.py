@@ -151,3 +151,25 @@ def test_attr_and_name():
     assert a.attr("__lr_mult__") == "2.0"
     fc = sym.FullyConnected(a, num_hidden=3, name="myfc")
     assert fc.name == "myfc"
+
+
+def test_attr_scope():
+    import mxtpu as mx
+
+    with mx.AttrScope(ctx_group="dev1", lr_mult="0.1"):
+        a = mx.sym.Variable("a")
+        fc = mx.sym.FullyConnected(a, num_hidden=4, name="fc_scoped")
+    plain = mx.sym.FullyConnected(mx.sym.Variable("b"), num_hidden=4,
+                                  name="fc_plain")
+    assert fc.attr("__ctx_group__") == "dev1"
+    assert fc.attr("__lr_mult__") == "0.1"
+    assert plain.attr("__ctx_group__") is None
+    # nesting: inner scope overrides, exits cleanly
+    with mx.AttrScope(ctx_group="g0"):
+        with mx.AttrScope(ctx_group="g1"):
+            inner = mx.sym.FullyConnected(mx.sym.Variable("c"),
+                                          num_hidden=2, name="fc_inner")
+        outer = mx.sym.FullyConnected(mx.sym.Variable("d"),
+                                      num_hidden=2, name="fc_outer")
+    assert inner.attr("__ctx_group__") == "g1"
+    assert outer.attr("__ctx_group__") == "g0"
