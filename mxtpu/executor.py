@@ -27,8 +27,14 @@ from . import random as _rnd
 __all__ = ["Executor"]
 
 
-def _trace_graph(symbol, is_train):
-    """Return fn(arg_vals, aux_vals, rng) -> (outputs, aux_updates_dict)."""
+def _trace_graph(symbol, is_train, placements=None):
+    """Return fn(arg_vals, aux_vals, rng) -> (outputs, aux_updates_dict).
+
+    ``placements`` maps a ctx-group name to a jax Device or Sharding:
+    nodes tagged ``__ctx_group__`` (AttrScope / group2ctx, the reference's
+    model-parallel mechanism — graph_executor.cc AssignContext) get their
+    outputs placed there; XLA inserts the cross-device transfers that the
+    reference realized as _CrossDeviceCopy nodes."""
     topo = symbol._topo()
     node_index = {id(n): i for i, n in enumerate(topo)}
     aux_nodes = symbol._aux_node_set()
@@ -52,6 +58,11 @@ def _trace_graph(symbol, is_train):
             key = jax.random.fold_in(rng, node_index[id(node)]) \
                 if node.op.needs_rng else None
             outs = node.op.trace(attrs, ins, rng=key)
+            if placements:
+                grp = node._extra_attrs.get("__ctx_group__")
+                if grp is not None and grp in placements:
+                    outs = tuple(jax.device_put(o, placements[grp])
+                                 for o in outs)
             n_vis = node.op.n_out(attrs)
             for i in range(n_vis):
                 env[(id(node), i)] = outs[i]
@@ -75,6 +86,13 @@ class Executor:
                  aux_states=None, group2ctx=None):
         self._symbol = symbol
         self._ctx = ctx if isinstance(ctx, Context) else (ctx or current_context())
+        # group2ctx model parallelism: group name -> Context; tagged nodes'
+        # outputs are placed on that context's device inside the program
+        self._placements = None
+        if group2ctx:
+            self._placements = {g: (c.jax_device if isinstance(c, Context)
+                                    else c)
+                                for g, c in group2ctx.items()}
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
         self.output_names = symbol.list_outputs()
@@ -118,13 +136,16 @@ class Executor:
         if fn is not None:
             return fn
         if kind == "fwd_eval":
-            run = _trace_graph(self._symbol, is_train=False)
+            run = _trace_graph(self._symbol, is_train=False,
+                               placements=self._placements)
             fn = jax.jit(lambda a, x, r: run(a, x, r))
         elif kind == "fwd_train":
-            run = _trace_graph(self._symbol, is_train=True)
+            run = _trace_graph(self._symbol, is_train=True,
+                               placements=self._placements)
             fn = jax.jit(lambda a, x, r: run(a, x, r))
         elif kind == "fwd_bwd":
-            run = _trace_graph(self._symbol, is_train=True)
+            run = _trace_graph(self._symbol, is_train=True,
+                               placements=self._placements)
             gnames = tuple(self._grad_arg_names())
 
             def fb(arg_vals, aux_vals, rng):
@@ -145,7 +166,8 @@ class Executor:
 
             fn = jax.jit(fb)
         elif kind == "fwd_bwd_heads":
-            run = _trace_graph(self._symbol, is_train=True)
+            run = _trace_graph(self._symbol, is_train=True,
+                               placements=self._placements)
             gnames = tuple(self._grad_arg_names())
 
             def fbh(arg_vals, aux_vals, rng, head_grads):

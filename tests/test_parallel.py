@@ -147,3 +147,33 @@ def test_ulysses_attention_matches_exact():
         out = ulysses_attention(qd, kd, vd, mesh=mesh, causal=causal)
         ref = blockwise_attention(q, k, v, block_size=32, causal=causal)
         assert float(jnp.max(jnp.abs(out - ref))) < 1e-4
+
+
+def test_group2ctx_model_parallel():
+    """Model parallelism across two CPU devices via AttrScope ctx_group +
+    group2ctx bind (parity: tests/python/unittest/test_model_parallel.py,
+    which also uses two CPU contexts)."""
+    import numpy as np
+    import mxtpu as mx
+
+    with mx.AttrScope(ctx_group="dev1"):
+        data = mx.sym.Variable("data")
+        fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+        act1 = mx.sym.Activation(fc1, act_type="relu")
+    with mx.AttrScope(ctx_group="dev2"):
+        fc2 = mx.sym.FullyConnected(act1, num_hidden=4, name="fc2")
+        net = mx.sym.Activation(fc2, act_type="tanh")
+
+    rng = np.random.RandomState(0)
+    shapes, _, _ = net.infer_shape(data=(2, 6))
+    args = {n: mx.nd.array(rng.rand(*s).astype("float32") * 0.1)
+            for n, s in zip(net.list_arguments(), shapes)}
+    exe = net.bind(mx.cpu(), args,
+                   group2ctx={"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+    exe.forward(is_train=False)
+    split_out = exe.outputs[0].asnumpy()
+
+    exe_single = net.bind(mx.cpu(), args)
+    exe_single.forward(is_train=False)
+    np.testing.assert_allclose(split_out, exe_single.outputs[0].asnumpy(),
+                               rtol=1e-5, atol=1e-6)
