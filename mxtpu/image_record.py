@@ -20,6 +20,7 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as _np
@@ -135,15 +136,26 @@ class _PyPrefetcher:
 
     def close(self):
         # Stop the producer BEFORE the caller rewinds shared state
-        # (reset() reuses the same record reader): unblock a full-queue
-        # put and join so no stale thread keeps reading.
+        # (reset() reuses the same record reader): the join must be
+        # unconditional — returning while the thread is still inside
+        # produce() would let two threads read one file handle. Drain the
+        # queue in a loop so a blocked put always observes _stop.
         self._stop = True
-        while True:
+        deadline = time.monotonic() + 60
+        while self._t.is_alive():
             try:
-                self._q.get_nowait()
+                while True:
+                    self._q.get_nowait()
             except Exception:
-                break
-        self._t.join(timeout=5)
+                pass
+            self._t.join(timeout=0.2)
+            if time.monotonic() > deadline:
+                # produce() itself is stuck (hung filesystem?). Better to
+                # fail loudly than to silently let two threads share the
+                # record reader after reset().
+                raise RuntimeError(
+                    "prefetch producer stuck in produce() for 60s; "
+                    "cannot safely rewind the shared record reader")
 
 
 class ImageRecordIter(_io.DataIter):

@@ -94,9 +94,9 @@ class KVStore:
         for k, v in zip(keys, values):
             arr = v[0] if isinstance(v, list) else v
             if self._client is not None:
-                # first writer wins server-side = rank0 init semantics
+                # lowest rank wins server-side = rank0 init semantics
                 # (KVStoreDist::Init + Barrier, kvstore_dist.h)
-                self._client.init(k, arr.asnumpy())
+                self._client.init(k, arr.asnumpy(), rank=self.rank)
                 self._client.barrier()
             self._store[k] = arr.copy()
 

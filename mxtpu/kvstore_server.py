@@ -75,6 +75,8 @@ class KVServer:
         self.updater = None      # None => merged value is assigned/summed
         self.cv = threading.Condition()
         self.barrier_counts = {}
+        self.init_ranks = {}     # key -> lowest rank that initialized it
+        self.stops_seen = 0
         self._stop = False
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -111,8 +113,6 @@ class KVServer:
             self.sock.close()
         except OSError:
             pass
-
-    stops_seen = 0
 
     def run_in_thread(self):
         t = threading.Thread(target=self.run, daemon=True)
@@ -161,11 +161,18 @@ class KVServer:
             self.store[key] = merged.copy()
         self.versions[key] = self.versions.get(key, 0) + 1
 
-    def _handle_init(self, key, value):
+    def _handle_init(self, key, value, rank=0):
+        # Deterministic rank-0-wins: concurrent INITs from different workers
+        # may arrive in any order, so the LOWEST rank seen (not the first
+        # writer) provides the initial value — but never after a push round
+        # has already updated the key.
         with self.cv:
-            if key not in self.store:  # first writer (rank 0) wins
+            prev_rank = self.init_ranks.get(key)
+            if (self.versions.get(key, 0) == 0
+                    and (prev_rank is None or rank < prev_rank)):
                 self.store[key] = _np.asarray(value).copy()
                 self.versions.setdefault(key, 0)
+                self.init_ranks[key] = rank
             self.cv.notify_all()
         return ("OK",)
 
@@ -254,8 +261,8 @@ class KVClient:
             raise MXNetError("kvstore rpc failed: %r" % (resp,))
         return resp
 
-    def init(self, key, value):
-        self._rpc("INIT", key, _np.asarray(value))
+    def init(self, key, value, rank=0):
+        self._rpc("INIT", key, _np.asarray(value), rank)
 
     def push(self, key, value):
         self._push_counts[key] = self._push_counts.get(key, 0) + 1

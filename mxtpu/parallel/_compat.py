@@ -1,0 +1,11 @@
+"""JAX version compatibility shims shared by the parallel modules."""
+from __future__ import annotations
+
+from jax import lax
+
+if hasattr(lax, "pcast"):
+    def _to_varying(x, axis_name):
+        return lax.pcast(x, axis_name, to="varying")
+else:  # older JAX without pcast
+    def _to_varying(x, axis_name):
+        return lax.pvary(x, axis_name)

@@ -84,6 +84,13 @@ def moe_apply(expert_fn, expert_params, gate_logits, x, mesh=None,
         return routed
 
     pspec = jax.tree.map(lambda _: P(axis_name), expert_params)
+    # The routed output is computed identically on every device (routing is
+    # a pure function of the replicated gates, and all_gather hands every
+    # device the full expert-output table), but JAX's varying-axes checker
+    # cannot prove replication through all_to_all/all_gather — so the VMA
+    # check is disabled for this map; test_moe_expert_parallel asserts the
+    # exact values instead.
     return shard_map(local_fn, mesh=mesh,
                      in_specs=(pspec, P(), P()),
-                     out_specs=P())(expert_params, gate_logits, x)
+                     out_specs=P(), check_vma=False)(expert_params,
+                                                     gate_logits, x)

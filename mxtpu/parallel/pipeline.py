@@ -21,6 +21,8 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 from jax import shard_map
 
+from ._compat import _to_varying
+
 __all__ = ["pipeline_apply", "stack_stage_params"]
 
 
@@ -87,8 +89,8 @@ def pipeline_apply(stage_fn, stacked_params, x, mesh=None,
                                              xl.dtype))
         acts0 = jnp.zeros((mb,) + xl.shape[1:], xl.dtype)
         outputs0 = jnp.zeros((n_micro,) + out_shape.shape, out_shape.dtype)
-        acts0 = lax.pvary(acts0, axis_name)
-        outputs0 = lax.pvary(outputs0, axis_name)
+        acts0 = _to_varying(acts0, axis_name)
+        outputs0 = _to_varying(outputs0, axis_name)
         (acts, outputs), _ = lax.scan(tick, (acts0, outputs0),
                                       jnp.arange(n_ticks))
         # only the last stage holds real outputs; share them with everyone

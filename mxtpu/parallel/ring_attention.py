@@ -21,6 +21,8 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
+from ._compat import _to_varying
+
 NEG_INF = -1e30
 
 
@@ -78,8 +80,8 @@ def blockwise_attention(q, k, v, block_size=512, causal=False,
     l0 = jnp.zeros((B, H, Tq), q.dtype)
     o0 = jnp.zeros_like(q)
     if axis_name is not None:  # inside shard_map: carries must be varying
-        m0 = lax.pvary(m0, axis_name)
-        l0 = lax.pvary(l0, axis_name)
+        m0 = _to_varying(m0, axis_name)
+        l0 = _to_varying(l0, axis_name)
     (m, l, o), _ = lax.scan(body, (m0, l0, o0),
                             (kb, vb, jnp.arange(nblk)))
     return o / jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
@@ -118,8 +120,8 @@ def ring_attention(q, k, v, mesh=None, axis_name="seq", causal=False):
             vc = lax.ppermute(vc, axis_name, perm)
             return (m, l, o, kc, vc)
 
-        m0 = lax.pvary(jnp.full((B, H, Tl), NEG_INF, ql.dtype), axis_name)
-        l0 = lax.pvary(jnp.zeros((B, H, Tl), ql.dtype), axis_name)
+        m0 = _to_varying(jnp.full((B, H, Tl), NEG_INF, ql.dtype), axis_name)
+        l0 = _to_varying(jnp.zeros((B, H, Tl), ql.dtype), axis_name)
         o0 = jnp.zeros_like(ql)
         m, l, o, _, _ = lax.fori_loop(0, axis_size, body, (m0, l0, o0, kl, vl))
         return o / jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]

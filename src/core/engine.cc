@@ -1,6 +1,7 @@
 #include "engine.h"
 
 #include <cstdlib>
+#include <stdexcept>
 
 #include "base.h"
 
@@ -95,13 +96,19 @@ void Engine::WaitForVar(Var* var) {
         sig->cv.notify_all();
       },
       {var}, {}, /*priority=*/1 << 20);
-  std::unique_lock<std::mutex> lock(sig->mu);
-  sig->cv.wait(lock, [&] { return sig->done; });
+  {
+    std::unique_lock<std::mutex> lock(sig->mu);
+    sig->cv.wait(lock, [&] { return sig->done; });
+  }
+  RethrowAsyncError();
 }
 
 void Engine::WaitForAll() {
-  std::unique_lock<std::mutex> lock(state_mu_);
-  idle_cv_.wait(lock, [this] { return pending_ == 0; });
+  {
+    std::unique_lock<std::mutex> lock(state_mu_);
+    idle_cv_.wait(lock, [this] { return pending_ == 0; });
+  }
+  RethrowAsyncError();
 }
 
 void Engine::Advance(Var* var) {
@@ -153,8 +160,17 @@ void Engine::CompleteOpr(Opr* opr) {
   if (to_delete != nullptr) {
     auto& q = to_delete->queue;
     while (!q.empty() && q.front().done) q.pop_front();
-    MXTPU_CHECK(q.empty(), "DeleteVariable: ops pushed after deletion");
-    delete to_delete;
+    if (q.empty()) {
+      delete to_delete;
+    } else {
+      // Programming error (ops pushed after deletion). Throwing here would
+      // skip the pending_ decrement below and deadlock waiters, so record
+      // it for the next wait and leak the var instead of corrupting state —
+      // but still grant its queued ops so they retire and pending_ drains.
+      if (async_error_.empty())
+        async_error_ = "DeleteVariable: ops pushed after deletion";
+      Advance(to_delete);
+    }
   }
   delete opr;
   ops_completed_.fetch_add(1);
@@ -171,9 +187,30 @@ void Engine::WorkerLoop() {
       opr = ready_.top();
       ready_.pop();
     }
-    opr->fn();
+    // A throwing task must not take down the pool: record the first error
+    // (rethrown by the next WaitForVar/WaitForAll) and keep scheduling, so
+    // dependent ops still retire and waiters don't deadlock.
+    try {
+      opr->fn();
+    } catch (const std::exception& e) {
+      std::lock_guard<std::mutex> lock(state_mu_);
+      if (async_error_.empty()) async_error_ = e.what();
+    } catch (...) {
+      std::lock_guard<std::mutex> lock(state_mu_);
+      if (async_error_.empty()) async_error_ = "unknown error in engine task";
+    }
     CompleteOpr(opr);
   }
+}
+
+void Engine::RethrowAsyncError() {
+  std::string err;
+  {
+    std::lock_guard<std::mutex> lock(state_mu_);
+    if (async_error_.empty()) return;
+    err.swap(async_error_);
+  }
+  throw std::runtime_error(err);
 }
 
 }  // namespace mxtpu

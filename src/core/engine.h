@@ -26,6 +26,7 @@
 #include <functional>
 #include <mutex>
 #include <queue>
+#include <string>
 #include <thread>
 #include <vector>
 
@@ -71,8 +72,9 @@ class Engine {
   void PushAsync(std::function<void()> fn, std::vector<Var*> const_vars,
                  std::vector<Var*> mut_vars, int priority = 0);
   // Block until every op that writes `var` pushed before this call is done.
+  // Rethrows the first error raised by an async task since the last wait.
   void WaitForVar(Var* var);
-  // Block until all pushed ops are done.
+  // Block until all pushed ops are done. Rethrows like WaitForVar.
   void WaitForAll();
 
   int num_workers() const { return static_cast<int>(workers_.size()); }
@@ -100,6 +102,12 @@ class Engine {
   std::condition_variable idle_cv_;
   std::priority_queue<Opr*, std::vector<Opr*>, ReadyCmp> ready_;
   std::vector<std::thread> workers_;
+  // First error thrown by an async task since the last wait; guarded by
+  // state_mu_. Rethrown (and cleared) by WaitForVar/WaitForAll so the
+  // worker pool survives a throwing task.
+  void RethrowAsyncError();
+  std::string async_error_;
+
   uint64_t next_seq_ = 0;
   int pending_ = 0;  // pushed but not completed
   bool shutdown_ = false;
