@@ -1,0 +1,304 @@
+"""Bind-time fused train step for Module: fwd+bwd+optimizer in ONE program.
+
+The reference splits a training step into forward, backward, kvstore
+push/pull, and a per-parameter updater loop (python/mxnet/module/module.py
+:615 update -> model.py _update_params; graph_executor.cc:1322 runs the
+graph in bulk segments). On TPU that split costs one device program per
+parameter per step. Here the whole step — forward, vjp backward, gradient
+averaging across devices, and the optimizer update for every parameter —
+is a single jitted XLA program with donated buffers: zero per-parameter
+dispatch, buffers reused in place, and (with several devices) GSPMD
+inserting the gradient all-reduce over the mesh.
+
+Arithmetic parity: the update rules call the SAME kernel functions the
+NDArray optimizer path dispatches to (ops/optimizer_ops.py — the analogue
+of src/operator/optimizer_op.cc:37-278), and per-parameter lr/wd
+(schedulers, lr_mult/wd_mult) are computed each step by the Optimizer's
+own _get_lr/_get_wd, so a fused step is bit-compatible with the unfused
+one up to reduction order.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import random as _rnd
+from ..executor import _trace_graph
+from ..ops import optimizer_ops as _ops
+
+
+class _Hyper(dict):
+    """Attribute-style view used to call the registered update kernels."""
+
+    def __getattr__(self, k):
+        return self.get(k)
+
+
+def _rule_sgd(opt):
+    mom = float(getattr(opt, "momentum", 0.0) or 0.0)
+    base = {"rescale_grad": opt.rescale_grad,
+            "clip_gradient": opt.clip_gradient or -1.0, "momentum": mom}
+
+    def init(w):
+        return jnp.zeros_like(w) if mom else None
+
+    def apply(p, g, s, lr, wd):
+        a = _Hyper(base, lr=lr, wd=wd)
+        if mom:
+            return _ops._sgd_mom_update(a, p, g, s)
+        return _ops._sgd_update(a, p, g), None
+
+    return init, apply, None
+
+
+def _rule_nag(opt):
+    mom = float(getattr(opt, "momentum", 0.0) or 0.0)
+    rescale, clip = opt.rescale_grad, opt.clip_gradient
+
+    def init(w):
+        return jnp.zeros_like(w) if mom else None
+
+    def apply(p, g, s, lr, wd):
+        g = g * rescale
+        if clip:
+            g = jnp.clip(g, -clip, clip)
+        if mom:
+            gw = g + wd * p
+            s2 = mom * s + gw
+            return p - lr * (gw + mom * s2), s2
+        return p - lr * (g + wd * p), None
+
+    return init, apply, None
+
+
+def _rule_adam(opt):
+    base = {"rescale_grad": opt.rescale_grad,
+            "clip_gradient": opt.clip_gradient or -1.0,
+            "beta1": opt.beta1, "beta2": opt.beta2, "epsilon": opt.epsilon}
+
+    def init(w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def apply(p, g, s, lr, wd):
+        a = _Hyper(base, lr=lr, wd=wd)
+        w2, m2, v2 = _ops._adam_update(a, p, g, s[0], s[1])
+        return w2, (m2, v2)
+
+    # the Python path folds bias correction into lr (optimizer.py Adam.update)
+    def lr_scale(t):
+        return math.sqrt(1.0 - opt.beta2 ** t) / (1.0 - opt.beta1 ** t)
+
+    return init, apply, lr_scale
+
+
+def _rule_rmsprop(opt):
+    base = {"rescale_grad": opt.rescale_grad,
+            "clip_gradient": opt.clip_gradient or -1.0,
+            "gamma1": opt.gamma1, "gamma2": getattr(opt, "gamma2", 0.9),
+            "epsilon": opt.epsilon,
+            "clip_weights": getattr(opt, "clip_weights", None) or -1.0}
+    centered = bool(getattr(opt, "centered", False))
+
+    def init(w):
+        if centered:
+            return (jnp.zeros_like(w), jnp.zeros_like(w), jnp.zeros_like(w))
+        return (jnp.zeros_like(w),)
+
+    def apply(p, g, s, lr, wd):
+        a = _Hyper(base, lr=lr, wd=wd)
+        if centered:
+            w2, n2, g2, d2 = _ops._rmspropalex_update(a, p, g, *s)
+            return w2, (n2, g2, d2)
+        w2, n2 = _ops._rmsprop_update(a, p, g, s[0])
+        return w2, (n2,)
+
+    return init, apply, None
+
+
+def _rule_adagrad(opt):
+    rescale, clip, eps = opt.rescale_grad, opt.clip_gradient, opt.float_stable_eps
+
+    def init(w):
+        return jnp.zeros_like(w)
+
+    def apply(p, g, s, lr, wd):
+        g = g * rescale
+        if clip:
+            g = jnp.clip(g, -clip, clip)
+        g = g + wd * p
+        s2 = s + jnp.square(g)
+        return p - lr * g / jnp.sqrt(s2 + eps), s2
+
+    return init, apply, None
+
+
+_RULES = {"SGD": _rule_sgd, "NAG": _rule_nag, "Adam": _rule_adam,
+          "RMSProp": _rule_rmsprop, "AdaGrad": _rule_adagrad}
+
+
+def supports(optimizer):
+    """Whether a fused-step update rule exists for this optimizer."""
+    name = type(optimizer).__name__
+    if name not in _RULES:
+        return False
+    if name == "SGD" and getattr(optimizer, "multi_precision", False):
+        return False  # fp16 master-weight path stays on the NDArray kernels
+    return True
+
+
+class FusedTrainStep:
+    """One-program train step bound to a Symbol and a set of devices.
+
+    ``devices`` with more than one entry builds a ('data',) mesh: the batch
+    shards over it, params/aux replicate, and the gradient mean implied by
+    vjp-under-GSPMD reproduces the kvstore sum + rescale_grad semantics.
+    """
+
+    def __init__(self, symbol, devices, param_names, data_names, label_names,
+                 optimizer, fixed_param_names=(), logger=None):
+        self.symbol = symbol
+        self.devices = list(devices)
+        self.param_names = list(param_names)
+        self.fixed = set(fixed_param_names or ())
+        self.trainable = [n for n in self.param_names if n not in self.fixed]
+        self.data_names = list(data_names)
+        self.label_names = list(label_names)
+        self.aux_names = symbol.list_auxiliary_states()
+        self.optimizer = optimizer
+        init, apply, lr_scale = _RULES[type(optimizer).__name__](optimizer)
+        self._state_init = init
+        self._apply = apply
+        self._lr_scale = lr_scale
+        # lr_mult/wd_mult lookups go through optimizer.idx2name; make sure
+        # the fused indices resolve to the right names
+        optimizer.idx2name = dict(getattr(optimizer, "idx2name", {}) or {})
+        optimizer.idx2name.update(enumerate(self.trainable))
+        self._run = _trace_graph(symbol, is_train=True)
+        self._mesh = None
+        if len(self.devices) > 1:
+            self._mesh = Mesh(_np.array(self.devices), ("data",))
+        self._step_fn = None
+        self.params = None      # name -> device array (all params incl fixed)
+        self.aux = None
+        self.opt_state = None   # name -> pytree for trainable params
+        self.outputs = None     # last step's outputs (device arrays)
+
+    # ------------------------------------------------ state staging
+    def _put(self, v, spec=P()):
+        if self._mesh is not None:
+            return jax.device_put(v, NamedSharding(self._mesh, spec))
+        return jax.device_put(v, self.devices[0])
+
+    def load(self, arg_params, aux_params):
+        """Stage host params onto the device(s), (re)creating opt state."""
+        self.params = {n: self._put(getattr(v, "_data", v))
+                       for n, v in arg_params.items()
+                       if n in set(self.param_names)}
+        self.aux = {n: self._put(getattr(v, "_data", v))
+                    for n, v in (aux_params or {}).items()}
+        self.opt_state = {n: jax.tree.map(self._put, self._state_init(
+            self.params[n])) for n in self.trainable}
+
+    # ------------------------------------------------ the program
+    def _build(self):
+        run = self._run
+        trainable = tuple(self.trainable)
+        apply_update = self._apply
+
+        def step(params, aux, opt_state, batch, lrs, wds, rng):
+            fixed = {n: v for n, v in params.items() if n not in trainable}
+
+            def f(train_p):
+                env = dict(fixed)
+                env.update(train_p)
+                env.update(batch)
+                outs, auxu = run(env, aux, rng)
+                return outs, auxu
+
+            train_p = {n: params[n] for n in trainable}
+            (outs, auxu), vjp = jax.vjp(f, train_p)
+            cts = ([jnp.ones_like(o) for o in outs],
+                   {k: jnp.zeros_like(v) for k, v in auxu.items()})
+            (grads,) = vjp(cts)
+            new_params = dict(fixed)
+            new_opt = {}
+            for i, n in enumerate(trainable):
+                p2, s2 = apply_update(params[n], grads[n], opt_state[n],
+                                      lrs[i], wds[i])
+                new_params[n] = p2.astype(params[n].dtype)
+                new_opt[n] = s2
+            new_aux = dict(aux)
+            new_aux.update(auxu)
+            return new_params, new_aux, new_opt, outs
+
+        if self._mesh is not None:
+            repl = NamedSharding(self._mesh, P())
+            bshard = NamedSharding(self._mesh, P("data"))
+            p_sh = {n: repl for n in self.params}
+            a_sh = {n: repl for n in self.aux}
+            o_sh = jax.tree.map(lambda _: repl, self.opt_state)
+            b_sh = {n: bshard for n in self.data_names + self.label_names}
+            self._step_fn = jax.jit(
+                step, in_shardings=(p_sh, a_sh, o_sh, b_sh, repl, repl, repl),
+                donate_argnums=(0, 1, 2))
+        else:
+            self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
+        return self._step_fn
+
+    # ------------------------------------------------ per-step driver
+    def step(self, data_arrays, label_arrays):
+        """Run one fused step; returns the outputs (device arrays)."""
+        opt = self.optimizer
+        lrs = _np.empty(len(self.trainable), _np.float32)
+        wds = _np.empty(len(self.trainable), _np.float32)
+        for i, n in enumerate(self.trainable):
+            opt._update_count(i)
+            lr = opt._get_lr(i)
+            if self._lr_scale is not None:
+                lr *= self._lr_scale(opt._index_update_count[i])
+            lrs[i] = lr
+            wds[i] = opt._get_wd(i)
+        batch = {}
+        spec = P("data") if self._mesh is not None else P()
+        for names, arrs in ((self.data_names, data_arrays),
+                            (self.label_names, label_arrays)):
+            for n, v in zip(names, arrs):
+                batch[n] = self._put(getattr(v, "_data", v), spec)
+        if self._step_fn is None:
+            self._build()
+        self.params, self.aux, self.opt_state, outs = self._step_fn(
+            self.params, self.aux, self.opt_state, batch,
+            self._put(lrs), self._put(wds), _rnd.next_key())
+        self.outputs = outs
+        return outs
+
+    # ------------------------------------------------ sync back
+    def export_params(self):
+        """Return (arg_params, aux_params) as host NDArray dicts."""
+        from .. import ndarray as nd
+        args = {n: nd.array(_np.asarray(v), dtype=v.dtype)
+                for n, v in self.params.items()}
+        aux = {n: nd.array(_np.asarray(v), dtype=v.dtype)
+               for n, v in self.aux.items()}
+        return args, aux
+
+    def export_opt_state(self):
+        """Optimizer state as {index: numpy pytree} in trainable order,
+        interoperable with Updater.get_states (optimizer.py)."""
+        out = {}
+        for i, n in enumerate(self.trainable):
+            out[i] = jax.tree.map(lambda v: _np.asarray(v), self.opt_state[n])
+        return out
+
+    def import_opt_state(self, states):
+        for i, n in enumerate(self.trainable):
+            if i in states and states[i] is not None:
+                tmpl = self.opt_state[n]
+                new = states[i]
+                self.opt_state[n] = jax.tree.map(
+                    lambda t, s: self._put(jnp.asarray(
+                        getattr(s, "_data", s), t.dtype)), tmpl, new)
