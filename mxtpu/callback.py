@@ -45,52 +45,55 @@ def log_train_metric(period, auto_reset=False):
 
 
 class Speedometer:
-    """Samples/sec logger (parity callback.py:120)."""
+    """Samples/sec logger (role parity with the reference's batch-end
+    speed callback, python/mxnet/callback.py:120; re-implemented around a
+    rolling window timer rather than the reference's init/tic state
+    machine)."""
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
-        self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
+        self.frequent = max(1, int(frequent))
         self.auto_reset = auto_reset
+        self._window_start = None  # wall time at the start of the window
+        self._prev_nbatch = -1
+
+    def _emit(self, param, speed):
+        metric = getattr(param, "eval_metric", None)
+        pairs = metric.get_name_value() if metric is not None else []
+        extra = "".join("\t%s=%g" % (k, v) for k, v in pairs)
+        logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
+                     param.epoch, param.nbatch, speed, extra)
+        if pairs and self.auto_reset:
+            metric.reset()
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    if self.auto_reset:
-                        param.eval_metric.reset()
-                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
-                    msg += "\t%s=%f" * len(name_value)
-                    logging.info(msg, param.epoch, count, speed,
-                                 *sum(name_value, ()))
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
-                self.tic = time.time()
-        else:
-            self.init = True
-            self.tic = time.time()
+        n = param.nbatch
+        if n < self._prev_nbatch:          # new epoch: restart the window
+            self._window_start = None
+        self._prev_nbatch = n
+        if self._window_start is None:
+            self._window_start = time.time()
+            return
+        if n % self.frequent:
+            return
+        elapsed = time.time() - self._window_start
+        if elapsed > 0:
+            self._emit(param, self.frequent * self.batch_size / elapsed)
+        self._window_start = time.time()
 
 
 class ProgressBar:
+    """Textual progress bar over the epoch's batches."""
+
     def __init__(self, total, length=80):
-        self.bar_len = length
-        self.total = total
+        self.bar_len = int(length)
+        self.total = max(1, int(total))
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = math.ceil(100.0 * count / float(self.total))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        sys.stdout.write("[%s] %s%s\r" % (prog_bar, percents, "%"))
+        frac = min(1.0, param.nbatch / float(self.total))
+        done = int(round(self.bar_len * frac))
+        bar = "=" * done + "-" * (self.bar_len - done)
+        sys.stdout.write("[%s] %s%%\r" % (bar, math.ceil(100.0 * frac)))
 
 
 class LogValidationMetricsCallback:

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Analyze the compiled HLO of the fused ResNet-50 train step: per-opcode
+materialized bytes (fusion bodies excluded) and the largest single
+materializations. Compile-only (abstract inputs), so it never allocates on
+the device and can run alongside a benchmark.
+
+Usage: python tools/hlo_analyze.py [batch] [--fwd-only]
+"""
+import collections
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def shape_bytes(s, _DT={'bf16': 2, 'f32': 4, 's32': 4, 'u32': 4, 'f16': 2,
+                        'pred': 1, 's8': 1, 'u8': 1, 's64': 8, 'f64': 8}):
+    tot = 0
+    for m in re.finditer(r'(\w+)\[([\d,]*)\]', s):
+        dt, dims = m.group(1), m.group(2)
+        if dt not in _DT:
+            continue
+        n = 1
+        for d in dims.split(','):
+            if d:
+                n *= int(d)
+        tot += n * _DT[dt]
+    return tot
+
+
+def analyze(txt, top=25):
+    """Tally output bytes of materializing ops (outside fusion bodies)."""
+    stats = collections.Counter()
+    counts = collections.Counter()
+    biggest = []
+    cur = None
+    for line in txt.splitlines():
+        ls = line.strip()
+        # computation header: `%name (args) -> type {` or `ENTRY ...`
+        if ls.endswith('{') and ('(' in ls) and ('=' not in ls.split('(')[0]):
+            m = re.match(r'(?:ENTRY\s+)?%?([\w.$-]+)', ls)
+            cur = m.group(1) if m else None
+            continue
+        if cur and ('fused' in cur or 'region' in cur):
+            continue
+        m = re.match(r'%?[\w.$-]+ = (\S+?) ([\w-]+)\(', ls)
+        if not m:
+            continue
+        outshape, opk = m.group(1), m.group(2)
+        if opk in ('parameter', 'constant', 'get-tuple-element', 'tuple',
+                   'bitcast'):
+            continue
+        b = shape_bytes(outshape)
+        stats[opk] += b
+        counts[opk] += 1
+        if b > 50e6:
+            biggest.append((b, opk, cur, ls[:140]))
+    print('total materialized output bytes: %.1f GB' %
+          (sum(stats.values()) / 1e9))
+    for k, v in stats.most_common(20):
+        print('%-22s %8.2f GB  x%d' % (k, v / 1e9, counts[k]))
+    biggest.sort(reverse=True)
+    print('--- largest materializations ---')
+    for b, opk, comp, l in biggest[:top]:
+        print('%9.0f MB %-12s [%s] %s' % (b / 1e6, opk, comp, l[:120]))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import mxtpu  # noqa: F401
+    from mxtpu.models import resnet
+    from mxtpu.parallel import make_mesh
+    from mxtpu.parallel.dp import DataParallelTrainer
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 and sys.argv[1].isdigit() \
+        else 256
+    sym = resnet.get_symbol(num_classes=1000, num_layers=50,
+                            image_shape=(3, 224, 224))
+    mesh = make_mesh(shape=(len(jax.devices()),))
+    trainer = DataParallelTrainer(
+        sym, mesh=mesh, optimizer='sgd',
+        optimizer_params={'learning_rate': 0.1, 'momentum': 0.9,
+                          'rescale_grad': 1.0 / batch}, dtype='bfloat16')
+
+    # abstract init: shapes only, no device arrays
+    arg_shapes, _, aux_shapes = sym.infer_shape(
+        data=(batch, 3, 224, 224), softmax_label=(batch,))
+    shapes = dict(zip(sym.list_arguments(), arg_shapes))
+    ashapes = dict(zip(sym.list_auxiliary_states(), aux_shapes))
+    sds = jax.ShapeDtypeStruct
+    params = {n: sds(shapes[n], jnp.bfloat16) for n in trainer.param_names}
+    aux = {n: sds(ashapes[n], jnp.bfloat16) for n in trainer.aux_names}
+    opt = {n: sds(shapes[n], jnp.bfloat16) for n in trainer.param_names}
+    batch_in = {'data': sds((batch, 3, 224, 224), jnp.bfloat16),
+                'softmax_label': sds((batch,), jnp.float32)}
+    rng = sds((2,), jnp.uint32)
+    trainer._pspecs = {n: jax.sharding.PartitionSpec()
+                       for n in trainer.param_names}
+    trainer._opt_state = opt
+    fn = trainer._build_step()
+    print('lowering...', flush=True)
+    c = fn.lower(params, aux, opt, batch_in, rng, 1).compile()
+    ca = c.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    print('cost: %.2f TFLOP, %.1f GB accessed' %
+          (ca.get('flops', 0) / 1e12, ca.get('bytes accessed', 0) / 1e9))
+    analyze(c.as_text())
+
+
+if __name__ == '__main__':
+    main()
