@@ -126,12 +126,13 @@ def _rule_adagrad(opt):
         return jnp.zeros_like(w)
 
     def apply(p, g, s, lr, wd):
+        # history accumulates the raw (rescaled/clipped) gradient; weight
+        # decay applies OUTSIDE the preconditioner (optimizer.py AdaGrad.update)
         g = g * rescale
         if clip:
             g = jnp.clip(g, -clip, clip)
-        g = g + wd * p
         s2 = s + jnp.square(g)
-        return p - lr * g / jnp.sqrt(s2 + eps), s2
+        return p - lr * (g / jnp.sqrt(s2 + eps) + wd * p), s2
 
     return init, apply, None
 
@@ -173,10 +174,24 @@ class FusedTrainStep:
         self._state_init = init
         self._apply = apply
         self._lr_scale = lr_scale
-        # lr_mult/wd_mult lookups go through optimizer.idx2name; make sure
-        # the fused indices resolve to the right names
-        optimizer.idx2name = dict(getattr(optimizer, "idx2name", {}) or {})
-        optimizer.idx2name.update(enumerate(self.trainable))
+        # lr_mult/wd_mult/update-count lookups go through the optimizer's
+        # existing idx2name index scheme (i*num_device+k over all params,
+        # module.py init_optimizer). Reuse those indices rather than
+        # renumbering, so the fused and unfused paths share one scheme;
+        # only names the optimizer has never seen get fresh indices.
+        idx2name = dict(getattr(optimizer, "idx2name", {}) or {})
+        name2idx = {}
+        for idx in sorted(idx2name):
+            name2idx.setdefault(idx2name[idx], idx)
+        nxt = max(idx2name, default=-1) + 1
+        for n in self.trainable:
+            if n not in name2idx:
+                idx2name[nxt] = n
+                name2idx[n] = nxt
+                nxt += 1
+        optimizer.idx2name = idx2name
+        self._idx2name = idx2name
+        self._name_idx = [name2idx[n] for n in self.trainable]
         self._run = _trace_graph(symbol, is_train=True)
         self._mesh = None
         if len(self.devices) > 1:
@@ -195,9 +210,9 @@ class FusedTrainStep:
 
     def load(self, arg_params, aux_params):
         """Stage host params onto the device(s), (re)creating opt state."""
+        names = set(self.param_names)
         self.params = {n: self._put(getattr(v, "_data", v))
-                       for n, v in arg_params.items()
-                       if n in set(self.param_names)}
+                       for n, v in arg_params.items() if n in names}
         self.aux = {n: self._put(getattr(v, "_data", v))
                     for n, v in (aux_params or {}).items()}
         self.opt_state = {n: jax.tree.map(self._put, self._state_init(
@@ -255,13 +270,13 @@ class FusedTrainStep:
         opt = self.optimizer
         lrs = _np.empty(len(self.trainable), _np.float32)
         wds = _np.empty(len(self.trainable), _np.float32)
-        for i, n in enumerate(self.trainable):
-            opt._update_count(i)
-            lr = opt._get_lr(i)
+        for i, idx in enumerate(self._name_idx):
+            opt._update_count(idx)
+            lr = opt._get_lr(idx)
             if self._lr_scale is not None:
-                lr *= self._lr_scale(opt._index_update_count[i])
+                lr *= self._lr_scale(opt._index_update_count[idx])
             lrs[i] = lr
-            wds[i] = opt._get_wd(i)
+            wds[i] = opt._get_wd(idx)
         batch = {}
         spec = P("data") if self._mesh is not None else P()
         for names, arrs in ((self.data_names, data_arrays),
@@ -287,18 +302,30 @@ class FusedTrainStep:
         return args, aux
 
     def export_opt_state(self):
-        """Optimizer state as {index: numpy pytree} in trainable order,
-        interoperable with Updater.get_states (optimizer.py)."""
+        """Optimizer state as {index: numpy pytree} under the SAME index
+        scheme the Updater uses (optimizer.idx2name keys), so a state file
+        written by the fused path loads on the unfused path and vice versa.
+        Every index aliasing a name (one per device copy in the unfused
+        scheme) receives the same state."""
+        name_indices = {}
+        for idx, n in self._idx2name.items():
+            name_indices.setdefault(n, []).append(idx)
         out = {}
-        for i, n in enumerate(self.trainable):
-            out[i] = jax.tree.map(lambda v: _np.asarray(v), self.opt_state[n])
+        for n in self.trainable:
+            st = jax.tree.map(lambda v: _np.asarray(v), self.opt_state[n])
+            for idx in name_indices.get(n, []):
+                out[idx] = st
         return out
 
     def import_opt_state(self, states):
+        """Accept {index: state} keyed by the Updater's index scheme; for a
+        name with several device-copy indices the lowest present wins."""
         for i, n in enumerate(self.trainable):
-            if i in states and states[i] is not None:
-                tmpl = self.opt_state[n]
-                new = states[i]
-                self.opt_state[n] = jax.tree.map(
-                    lambda t, s: self._put(jnp.asarray(
-                        getattr(s, "_data", s), t.dtype)), tmpl, new)
+            cands = [states[j] for j in sorted(states)
+                     if self._idx2name.get(j) == n and states[j] is not None]
+            if not cands:
+                continue
+            self.opt_state[n] = jax.tree.map(
+                lambda t, s: self._put(jnp.asarray(
+                    getattr(s, "_data", s), t.dtype)),
+                self.opt_state[n], cands[0])
