@@ -411,7 +411,9 @@ def _lrn(a, x):
     pad = [(0, 0), (n // 2, n // 2), (0, 0), (0, 0)][: x.ndim]
     while len(pad) < x.ndim:
         pad.append((0, 0))
-    s = lax.reduce_window(sq, jnp.asarray(0, x.dtype), lax.add,
+    # literal init value: a traced init breaks reverse-mode autodiff of
+    # reduce_window (same constraint as Pooling above)
+    s = lax.reduce_window(sq, 0.0, lax.add,
                           (1, n) + (1,) * (x.ndim - 2), (1,) * x.ndim, pad)
     return x * jnp.power(a.knorm + (a.alpha / n) * s, -a.beta)
 
