@@ -30,25 +30,15 @@ def get_mnist_iter(args):
                               batch_size=args.batch_size, shuffle=False,
                               flat=(args.network == "mlp"))
         return train, val
-    logging.warning("MNIST not found under %s; using synthetic digits",
-                    args.data_dir)
-    rng = np.random.RandomState(7)
-    n = 2048
-    y = rng.randint(0, 10, size=n).astype("float32")
-    x = rng.rand(n, 1, 28, 28).astype("float32") * 0.1
-    for i in range(n):  # one bright row per class: linearly separable
-        x[i, 0, int(y[i]) * 2 + 2, :] += 1.0
-    if args.network == "mlp":
-        x = x.reshape(n, 784)
-    cut = (n * 7 // 8 // args.batch_size) * args.batch_size
-    train = mx.io.NDArrayIter(x[:cut], y[:cut], args.batch_size,
-                              shuffle=True, label_name="softmax_label")
-    val = mx.io.NDArrayIter(x[cut:], y[cut:], args.batch_size,
-                            label_name="softmax_label")
-    return train, val
+    logging.warning("MNIST not found under %s; generating deterministic "
+                    "glyph digits in idx format there", args.data_dir)
+    mx.test_utils.make_synthetic_mnist_idx(args.data_dir)
+    return get_mnist_iter(args)
 
 
-def main():
+def main(argv=None):
+    """Returns the final validation accuracy (the config-1 gate value:
+    reference tests/python/train/test_mlp.py:82 asserts >0.95)."""
     ap = argparse.ArgumentParser()
     ap.add_argument("--network", default="mlp", choices=["mlp", "lenet"])
     ap.add_argument("--data-dir", default="data/mnist")
@@ -56,7 +46,7 @@ def main():
     ap.add_argument("--num-epochs", type=int, default=10)
     ap.add_argument("--lr", type=float, default=0.1)
     ap.add_argument("--kv-store", default="local")
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
     net = (mx.models.get_mlp(num_classes=10) if args.network == "mlp"
@@ -67,10 +57,12 @@ def main():
     mod.fit(train, eval_data=val, num_epoch=args.num_epochs, kvstore=kv,
             optimizer="sgd",
             optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(),
             batch_end_callback=mx.callback.Speedometer(args.batch_size, 50),
             eval_metric="acc")
     score = mod.score(val, mx.metric.Accuracy())
     logging.info("final validation %s", score)
+    return score[0][1]
 
 
 if __name__ == "__main__":

@@ -37,7 +37,9 @@ def synthetic_corpus(n=400, vocab_size=60, seed=0):
     return sents, {i: i for i in range(vocab_size)}
 
 
-def main():
+def main(argv=None):
+    """Returns the list of per-epoch validation perplexities (the config-4
+    gate: perplexity must fall as training proceeds)."""
     ap = argparse.ArgumentParser()
     ap.add_argument("--train-data", default=None, help="text corpus (PTB)")
     ap.add_argument("--num-hidden", type=int, default=128)
@@ -45,8 +47,8 @@ def main():
     ap.add_argument("--num-layers", type=int, default=2)
     ap.add_argument("--num-epochs", type=int, default=3)
     ap.add_argument("--batch-size", type=int, default=32)
-    ap.add_argument("--lr", type=float, default=0.1)
-    args = ap.parse_args()
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
     buckets = [10, 20, 30, 40]
@@ -82,15 +84,27 @@ def main():
     model = mx.mod.BucketingModule(
         sym_gen=sym_gen, default_bucket_key=train.default_bucket_key,
         context=mx.test_utils.default_context())
-    model.fit(train, num_epoch=args.num_epochs,
+    val = mx.rnn.BucketSentenceIter(sentences, args.batch_size,
+                                    buckets=buckets)
+    per_epoch = []
+
+    def _collect(param):
+        for name, value in param.eval_metric.get_name_value():
+            if name == "perplexity":
+                per_epoch.append(value)
+
+    model.fit(train, eval_data=val, num_epoch=args.num_epochs,
               eval_metric=mx.metric.Perplexity(ignore_label=0),
               optimizer="sgd",
               optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
-                                "wd": 1e-5},
+                                "wd": 1e-5, "clip_gradient": 1.0},
               initializer=mx.initializer.Xavier(factor_type="in",
                                                 magnitude=2.34),
               batch_end_callback=mx.callback.Speedometer(args.batch_size,
-                                                         20))
+                                                         20),
+              eval_end_callback=_collect)
+    logging.info("per-epoch validation perplexity: %s", per_epoch)
+    return per_epoch
 
 
 if __name__ == "__main__":

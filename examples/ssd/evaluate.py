@@ -103,22 +103,38 @@ class MApMetric(mx.metric.EvalMetric):
         return "mAP", float(np.mean(aps)) if aps else 0.0
 
 
-def main():
+def main(argv=None):
+    """Returns the mAP value (the config-5 gate: training must raise it)."""
     ap = argparse.ArgumentParser()
-    ap.add_argument("--val-rec", required=True)
+    ap.add_argument("--val-rec", default=None,
+                    help="detection .rec; omitted, deterministic synthetic "
+                         "painted boxes are scored instead")
     ap.add_argument("--num-classes", type=int, default=20)
+    ap.add_argument("--num-scales", type=int, default=6)
+    ap.add_argument("--network", default="vgg16_reduced",
+                    choices=["vgg16_reduced", "tiny"])
     ap.add_argument("--data-shape", type=int, default=300)
     ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--num-batches", type=int, default=4,
+                    help="synthetic batches (no --val-rec)")
     ap.add_argument("--prefix", default=None, help="checkpoint prefix")
     ap.add_argument("--epoch", type=int, default=0)
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
-    net = ssd_model.get_symbol(num_classes=args.num_classes)
+    net = ssd_model.get_symbol(num_classes=args.num_classes,
+                               num_scales=args.num_scales,
+                               network=args.network)
     shape = (3, args.data_shape, args.data_shape)
-    it = mx.io.ImageDetRecordIter(
-        path_imgrec=args.val_rec, data_shape=shape,
-        batch_size=args.batch_size, mean_pixels=(123, 117, 104))
+    if args.val_rec:
+        it = mx.io.ImageDetRecordIter(
+            path_imgrec=args.val_rec, data_shape=shape,
+            batch_size=args.batch_size, mean_pixels=(123, 117, 104))
+    else:
+        logging.warning("no --val-rec; scoring synthetic painted boxes")
+        from _synth import SynthDetIter
+        it = SynthDetIter(args.batch_size, shape, args.num_classes,
+                          num_batches=args.num_batches, seed=77)
     mod = mx.mod.Module(net, label_names=("label",),
                         context=mx.test_utils.default_context())
     mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
@@ -133,7 +149,9 @@ def main():
     for batch in it:
         mod.forward(batch, is_train=False)
         metric.update(batch.label, mod.get_outputs())
-    logging.info("%s: %.4f", *metric.get())
+    name, value = metric.get()
+    logging.info("%s: %.4f", name, value)
+    return value
 
 
 if __name__ == "__main__":

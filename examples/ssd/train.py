@@ -52,7 +52,9 @@ class MultiBoxMetric(mx.metric.EvalMetric):
         return list(zip(names, values))
 
 
-def main():
+def main(argv=None):
+    """Returns (module, final MultiBox metric pairs); with --prefix set,
+    also writes a checkpoint evaluate.py can score (the config-5 gate)."""
     ap = argparse.ArgumentParser()
     ap.add_argument("--train-rec", default=None,
                     help="detection .rec (tools/im2rec.py packed .lst with "
@@ -62,12 +64,18 @@ def main():
     ap.add_argument("--batch-size", type=int, default=8)
     ap.add_argument("--num-epochs", type=int, default=1)
     ap.add_argument("--num-scales", type=int, default=6)
+    ap.add_argument("--network", default="vgg16_reduced",
+                    choices=["vgg16_reduced", "tiny"])
+    ap.add_argument("--num-batches", type=int, default=4,
+                    help="synthetic batches per epoch (no --train-rec)")
     ap.add_argument("--lr", type=float, default=0.001)
-    args = ap.parse_args()
+    ap.add_argument("--prefix", default=None, help="checkpoint prefix")
+    args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
     net = ssd_model.get_symbol_train(num_classes=args.num_classes,
-                                     num_scales=args.num_scales)
+                                     num_scales=args.num_scales,
+                                     network=args.network)
     shape = (3, args.data_shape, args.data_shape)
     if args.train_rec:
         train = mx.io.ImageDetRecordIter(
@@ -76,55 +84,27 @@ def main():
             mean_pixels=(123, 117, 104), rand_mirror_prob=0.5)
         batches = None
     else:
-        logging.warning("no --train-rec; using synthetic boxes")
-        rng = np.random.RandomState(0)
+        logging.warning("no --train-rec; using synthetic painted boxes")
+        from _synth import SynthDetIter
+        train = SynthDetIter(args.batch_size, shape, args.num_classes,
+                             num_batches=args.num_batches, seed=0)
 
-        def synth_batch():
-            x = mx.nd.array(rng.rand(args.batch_size, *shape)
-                            .astype("float32"))
-            lab = np.full((args.batch_size, 8, 5), -1.0, "float32")
-            for b in range(args.batch_size):
-                cx, cy = rng.uniform(0.3, 0.7, 2)
-                w, h = rng.uniform(0.1, 0.25, 2)
-                lab[b, 0] = [rng.randint(0, args.num_classes),
-                             cx - w, cy - h, cx + w, cy + h]
-            return mx.io.DataBatch(data=[x], label=[mx.nd.array(lab)],
-                                   pad=0, index=None,
-                                   provide_data=[mx.io.DataDesc(
-                                       "data",
-                                       (args.batch_size,) + shape)],
-                                   provide_label=[mx.io.DataDesc(
-                                       "label", lab.shape)])
-
-        class _SynthIter(mx.io.DataIter):
-            def __init__(self):
-                super().__init__(args.batch_size)
-                self._n = 0
-                self.provide_data = [mx.io.DataDesc(
-                    "data", (args.batch_size,) + shape)]
-                self.provide_label = [mx.io.DataDesc(
-                    "label", (args.batch_size, 8, 5))]
-
-            def reset(self):
-                self._n = 0
-
-            def next(self):
-                if self._n >= 4:
-                    raise StopIteration
-                self._n += 1
-                return synth_batch()
-
-        train = _SynthIter()
-
+    metric = MultiBoxMetric()
     mod = mx.mod.Module(net, label_names=("label",),
                         context=mx.test_utils.default_context())
     mod.fit(train, num_epoch=args.num_epochs,
-            eval_metric=MultiBoxMetric(),
+            eval_metric=metric,
             optimizer="sgd",
             optimizer_params={"learning_rate": args.lr,
                               "momentum": 0.9, "wd": 5e-4},
+            initializer=mx.initializer.Xavier(),
             batch_end_callback=mx.callback.Speedometer(
-                args.batch_size, 10))
+                args.batch_size, 10),
+            epoch_end_callback=(mx.callback.do_checkpoint(args.prefix)
+                                if args.prefix else None))
+    if args.prefix:
+        mx.nd.waitall()  # drain async checkpoint writes before scoring
+    return mod, metric.get_name_value()
 
 
 if __name__ == "__main__":

@@ -18,15 +18,17 @@ __all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_gpus", "num_d
 
 
 def _cpu_devices():
+    # local (addressable) devices only: under jax.distributed, jax.devices()
+    # is the GLOBAL list and other processes' devices can't back an NDArray
     try:
-        return jax.devices("cpu")
+        return jax.local_devices(backend="cpu")
     except RuntimeError:
-        return jax.devices()
+        return jax.local_devices()
 
 
 def _accel_devices():
-    """Non-CPU JAX devices, else CPU devices (covers the forced-CPU test mesh)."""
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    """Non-CPU local JAX devices, else CPU (covers the forced-CPU test mesh)."""
+    devs = [d for d in jax.local_devices() if d.platform != "cpu"]
     return devs if devs else _cpu_devices()
 
 

@@ -326,9 +326,13 @@ class Executor:
         aux_names = symbol.list_auxiliary_states()
         arg_types, _, aux_types = symbol.infer_type(**{
             k: v for k, v in type_dict.items() if k in arg_names})
+        inferred = dict(zip(arg_names, arg_types or []))
+        inferred_aux = dict(zip(aux_names, aux_types or []))
         args = {}
         for name, shape in zip(arg_names, arg_shapes):
-            dt = type_dict.get(name, "float32")
+            # explicit type_dict wins; else the type inferred from the data
+            # dtypes (bf16 data => bf16 weights, reference InferType flow)
+            dt = type_dict.get(name) or inferred.get(name) or "float32"
             if shared_exec is not None and name in shared_exec.arg_dict and \
                     shared_exec.arg_dict[name].shape == tuple(shape):
                 args[name] = shared_exec.arg_dict[name]
@@ -355,6 +359,7 @@ class Executor:
                     shared_exec.aux_dict[name].shape == tuple(shape):
                 aux[name] = shared_exec.aux_dict[name]
             else:
-                aux[name] = zeros(shape, ctx=ctx)
+                aux[name] = zeros(shape, ctx=ctx,
+                                  dtype=inferred_aux.get(name) or "float32")
         return Executor(symbol, ctx, args, args_grad=args_grad, grad_req=req_of,
                         aux_states=aux)

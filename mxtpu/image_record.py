@@ -214,9 +214,21 @@ class ImageRecordIter(_io.DataIter):
             self._augs = _img.CreateAugmenter(
                 self.data_shape, resize=resize, rand_crop=rand_crop,
                 rand_mirror=rand_mirror, mean=mean, std=std)
+            # all-numpy fast decode for the standard recipe: the augmenter
+            # objects round-trip every image through NDArray (a jax commit
+            # per image); crop/mirror/normalize are plain slicing, and the
+            # C++ iterator does exactly this inline
+            # (iter_image_recordio_2.cc ProcessImage)
+            self._fast = (resize == 0 or resize is None)
+            self._fast_crop = bool(rand_crop)
+            self._fast_mirror = bool(rand_mirror)
+            self._fast_mean = mean
+            self._fast_std = std
         else:
             self._augs = aug_list
+            self._fast = False
         self._scale = float(scale)
+        self._rng = _np.random.RandomState(seed + 12345)
         self._pool = ThreadPoolExecutor(max_workers=int(preprocess_threads))
         self._prefetch_n = int(prefetch_buffer)
         self.provide_data = [_io.DataDesc(data_name,
@@ -262,15 +274,43 @@ class ImageRecordIter(_io.DataIter):
 
     def _decode_one(self, raw):
         header, img = rio.unpack(raw)
-        arr = _img._as_np(_img.imdecode(img))
-        for aug in self._augs:
-            arr = _img._as_np(aug(arr)[0])
+        if self._fast:
+            arr = self._decode_fast(img)
+        else:
+            arr = _img._as_np(_img.imdecode(img))
+            for aug in self._augs:
+                arr = _img._as_np(aug(arr)[0])
         if self._mean_arr is not None:
             arr = arr.astype(_np.float32) - self._mean_arr
         if self._scale != 1.0:
             arr = arr.astype(_np.float32) * self._scale
         label = _np.asarray(header.label, _np.float32).reshape(-1)
         return arr, label
+
+    def _decode_fast(self, img):
+        """cv2+numpy decode/crop/mirror/normalize with no NDArray hops."""
+        arr = _img.imdecode_np(img)
+        c, h, w = self.data_shape
+        H, W = arr.shape[:2]
+        if H < h or W < w:  # upscale small sources to the target crop
+            arr = _img.imresize_np(arr, max(w, int(W * h / H)),
+                                   max(h, int(H * w / W)))
+            H, W = arr.shape[:2]
+        if self._fast_crop:
+            y0 = self._rng.randint(0, H - h + 1)
+            x0 = self._rng.randint(0, W - w + 1)
+        else:  # center crop, like the reference's default eval path
+            y0, x0 = (H - h) // 2, (W - w) // 2
+        arr = arr[y0:y0 + h, x0:x0 + w]
+        if self._fast_mirror and self._rng.rand() < 0.5:
+            arr = arr[:, ::-1]
+        if self._fast_mean is not None or self._fast_std is not None:
+            arr = arr.astype(_np.float32)
+            if self._fast_mean is not None:
+                arr = arr - self._fast_mean
+            if self._fast_std is not None:
+                arr = arr / self._fast_std
+        return arr
 
     def _produce_batch(self):
         c, h, w = self.data_shape

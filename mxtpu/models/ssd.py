@@ -98,19 +98,41 @@ def _multibox_layer(from_layers, num_classes, sizes, ratios, clip=False):
     return loc_preds, cls_preds_s, anchor_boxes
 
 
-def _build_features(data, num_scales):
-    relu4_3, fc7 = _vgg_reduced(data)
-    extras = _extra_layers(fc7, [512, 256, 256, 256][:max(0, num_scales - 2)])
-    return [relu4_3, fc7] + extras
+def _tiny_backbone(data):
+    """Small backbone for smoke tests / CPU gates (role of the reference's
+    lighter --network choices in example/ssd/symbol_factory.py)."""
+    x = data
+    for b, nf in enumerate((32, 64)):
+        x = _conv_act(x, "t%d" % b, nf)
+        x = sym.Pooling(x, pool_type="max", kernel=(2, 2), stride=(2, 2),
+                        name="tpool%d" % b)
+    x = _conv_act(x, "t2", 128)
+    first = x
+    x = sym.Pooling(x, pool_type="max", kernel=(2, 2), stride=(2, 2),
+                    name="tpool2")
+    x = _conv_act(x, "t3", 128)
+    return first, x
+
+
+def _build_features(data, num_scales, network="vgg16_reduced"):
+    if network == "tiny":
+        first, second = _tiny_backbone(data)
+        extra_filters = [128, 128, 128, 128]
+    else:
+        first, second = _vgg_reduced(data)
+        extra_filters = [512, 256, 256, 256]
+    extras = _extra_layers(second, extra_filters[:max(0, num_scales - 2)])
+    return [first, second] + extras
 
 
 def get_symbol_train(num_classes=20, num_scales=6, nms_thresh=0.5,
-                     force_suppress=False, nms_topk=400, clip=False):
+                     force_suppress=False, nms_topk=400, clip=False,
+                     network="vgg16_reduced"):
     """Training symbol: outputs [cls_prob, loc_loss, cls_label, det]
     (parity example/ssd/symbol/symbol_builder.py get_symbol_train)."""
     data = sym.Variable("data")
     label = sym.Variable("label")
-    layers = _build_features(data, num_scales)
+    layers = _build_features(data, num_scales, network=network)
     sizes, ratios = default_spec(num_scales)
     loc_preds, cls_preds, anchor_boxes = _multibox_layer(
         layers, num_classes, sizes, ratios, clip=clip)
@@ -141,10 +163,11 @@ def get_symbol_train(num_classes=20, num_scales=6, nms_thresh=0.5,
 
 
 def get_symbol(num_classes=20, num_scales=6, nms_thresh=0.5,
-               force_suppress=False, nms_topk=400, clip=False):
+               force_suppress=False, nms_topk=400, clip=False,
+               network="vgg16_reduced"):
     """Inference symbol: detections (N, A, 6) [cls, score, x1,y1,x2,y2]."""
     data = sym.Variable("data")
-    layers = _build_features(data, num_scales)
+    layers = _build_features(data, num_scales, network=network)
     sizes, ratios = default_spec(num_scales)
     loc_preds, cls_preds, anchor_boxes = _multibox_layer(
         layers, num_classes, sizes, ratios, clip=clip)

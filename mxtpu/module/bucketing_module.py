@@ -176,10 +176,16 @@ class BucketingModule(BaseModule):
         assert self.binded and self.params_initialized
         self.switch_bucket(data_batch.bucket_key, data_batch.provide_data,
                            data_batch.provide_label)
-        weights = None
-        prev = self._buckets[self._default_bucket_key] \
-            if self._curr_bucket_key != self._default_bucket_key else None
         self._curr_module.forward(data_batch, is_train=is_train)
+
+    def forward_backward(self, data_batch):
+        """Route through the bucket module's own forward_backward so the
+        fused one-program step (Module._fused, shared FusedState across
+        buckets) is used when armed."""
+        assert self.binded and self.params_initialized
+        self.switch_bucket(data_batch.bucket_key, data_batch.provide_data,
+                           data_batch.provide_label)
+        self._curr_module.forward_backward(data_batch)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized

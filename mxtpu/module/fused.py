@@ -151,16 +151,34 @@ def supports(optimizer):
     return True
 
 
+class FusedState:
+    """Mutable device-state store for fused training, shareable between
+    several FusedTrainStep instances (BucketingModule: one step per bucket
+    over ONE set of weights/optimizer moments, the analogue of the
+    reference's shared-executor parameter arrays in
+    python/mxnet/module/bucketing_module.py switch_bucket)."""
+
+    def __init__(self):
+        self.params = None     # name -> device array (all params incl fixed)
+        self.aux = None
+        self.opt_state = None  # name -> pytree for trainable params
+        self.host_stale = False   # device params newer than host _arg_params
+        self.exec_stale = False   # device params newer than executor arrays
+
+
 class FusedTrainStep:
     """One-program train step bound to a Symbol and a set of devices.
 
     ``devices`` with more than one entry builds a ('data',) mesh: the batch
     shards over it, params/aux replicate, and the gradient mean implied by
     vjp-under-GSPMD reproduces the kvstore sum + rescale_grad semantics.
+
+    ``state``: pass an existing FusedState to share weights/opt-state with
+    other steps (bucketing); omitted, a private store is created.
     """
 
     def __init__(self, symbol, devices, param_names, data_names, label_names,
-                 optimizer, fixed_param_names=(), logger=None):
+                 optimizer, fixed_param_names=(), logger=None, state=None):
         self.symbol = symbol
         self.devices = list(devices)
         self.param_names = list(param_names)
@@ -197,10 +215,33 @@ class FusedTrainStep:
         if len(self.devices) > 1:
             self._mesh = Mesh(_np.array(self.devices), ("data",))
         self._step_fn = None
-        self.params = None      # name -> device array (all params incl fixed)
-        self.aux = None
-        self.opt_state = None   # name -> pytree for trainable params
+        self.state = state if state is not None else FusedState()
         self.outputs = None     # last step's outputs (device arrays)
+
+    # shared-state views ------------------------------------------------
+    @property
+    def params(self):
+        return self.state.params
+
+    @params.setter
+    def params(self, v):
+        self.state.params = v
+
+    @property
+    def aux(self):
+        return self.state.aux
+
+    @aux.setter
+    def aux(self, v):
+        self.state.aux = v
+
+    @property
+    def opt_state(self):
+        return self.state.opt_state
+
+    @opt_state.setter
+    def opt_state(self, v):
+        self.state.opt_state = v
 
     # ------------------------------------------------ state staging
     def _put(self, v, spec=P()):
@@ -217,6 +258,17 @@ class FusedTrainStep:
                     for n, v in (aux_params or {}).items()}
         self.opt_state = {n: jax.tree.map(self._put, self._state_init(
             self.params[n])) for n in self.trainable}
+
+    def adopt_state(self):
+        """Joining an already-populated shared FusedState (a new bucket):
+        keep the live weights/opt-state, only init entries this symbol
+        introduces (normally none -- buckets share all parameters)."""
+        st = self.state
+        assert st.params is not None, "adopt_state needs a populated state"
+        for n in self.trainable:
+            if n not in st.opt_state:
+                st.opt_state[n] = jax.tree.map(
+                    self._put, self._state_init(st.params[n]))
 
     # ------------------------------------------------ the program
     def _build(self):

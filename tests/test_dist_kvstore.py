@@ -133,3 +133,71 @@ def test_launch_tool():
         capture_output=True, timeout=120,
         env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO))
     assert res.returncode == 0, res.stdout.decode() + res.stderr.decode()
+
+
+# ---------------------------------------------------------------------------
+# Collectives-backed values (VERDICT r1 weak #9): 2 REAL processes joined via
+# jax.distributed; the dist KVStore must move values over XLA collectives
+# (process_allgather sum), with the TCP PS as control plane only.
+# Model: tests/nightly/dist_sync_kvstore.py:28-60 exact-value invariants.
+
+WORKER_COLLECTIVE = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, %r)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.distributed.initialize(coordinator_address="localhost:%%d",
+                               num_processes=2,
+                               process_id=int(sys.argv[1]))
+    import mxtpu as mx
+
+    kv = mx.kv.create("dist_sync")
+    assert kv._client is None, "PS transport must be idle in collective mode"
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == 2 and rank == jax.process_index()
+
+    shape = (3, 4)
+    kv.init(3, mx.nd.ones(shape))
+    # no updater: each round assigns the allgather-sum -> nw*(nw+1)/2
+    for rnd in range(3):
+        kv.push(3, mx.nd.ones(shape) * (rank + 1))
+        out = mx.nd.zeros(shape)
+        kv.pull(3, out=out)
+        assert np.allclose(out.asnumpy(), nw * (nw + 1) / 2.0), out.asnumpy()
+
+    # optimizer semantics: every replica applies the SAME update to the
+    # allgather-summed gradient -> exact agreement without a server
+    kv.init(9, mx.nd.zeros((2, 2)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, rescale_grad=1.0))
+    for rnd in range(1, 3):
+        kv.push(9, mx.nd.ones((2, 2)))
+        out = mx.nd.zeros((2, 2))
+        kv.pull(9, out=out)
+        assert np.allclose(out.asnumpy(), -0.5 * nw * rnd), out.asnumpy()
+    kv.barrier()
+    print("WORKER_OK", rank)
+""")
+
+
+def test_dist_kvstore_collective_values():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    src = (WORKER_COLLECTIVE % REPO) % port
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    for v in ("MXTPU_ROOT_URI", "MXTPU_ROOT_PORT", "MXTPU_NUM_WORKERS",
+              "MXTPU_ROLE", "MXTPU_WORKER_ID", "DMLC_PS_ROOT_URI",
+              "DMLC_ROLE", "XLA_FLAGS"):  # 1 device per process for gloo
+        env.pop(v, None)
+    procs = [subprocess.Popen([sys.executable, "-c", src, str(r)], env=env,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT)
+             for r in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=180)
+        outs.append(out.decode())
+        assert p.returncode == 0, out.decode()
+    assert all("WORKER_OK" in o for o in outs)

@@ -316,6 +316,12 @@ def _canonical_ops():
     return seen
 
 
+# snapshot at import (collection) time: ops user tests register later via
+# mx.operator.register (e.g. test_spatial_custom's sigmoid_custom) are not
+# part of the framework census
+_CENSUS_AT_IMPORT = frozenset(_canonical_ops())
+
+
 def _primary_symbol(opname, spec):
     op = _OPS[opname]
     nvar = spec.get("nvar")
@@ -479,7 +485,7 @@ def test_svm_output_backward_closed_form():
 # census: every canonical op is classified exactly once
 
 def test_every_op_classified():
-    ops = _canonical_ops()
+    ops = _CENSUS_AT_IMPORT
     checked = set(UNARY) | set(BINARY) | set(SCALAR) | \
         {SPECS[k].get("op", k) for k in SPECS}
     classified = checked | set(SKIP)
