@@ -57,7 +57,12 @@ def _trace_graph(symbol, is_train, placements=None):
             ins = [env[(id(n), i)] for n, i in node.inputs]
             key = jax.random.fold_in(rng, node_index[id(node)]) \
                 if node.op.needs_rng else None
-            outs = node.op.trace(attrs, ins, rng=key)
+            # named_scope stamps the layer name into HLO op metadata, so
+            # XLA/xprof traces attribute device time per layer — the
+            # TPU-native form of the engine's per-op OprExecStat stamps
+            # (src/engine/threaded_engine.h:314-325)
+            with jax.named_scope(node.name or node.op.name):
+                outs = node.op.trace(attrs, ins, rng=key)
             if placements:
                 grp = node._extra_attrs.get("__ctx_group__")
                 if grp is not None and grp in placements:
@@ -205,6 +210,50 @@ class Executor:
         self.outputs = [NDArray(o, self._ctx) for o in outs]
         return self.outputs
 
+    def _forward_profiled(self, is_train, raw_args, raw_aux, rng):
+        """Node-at-a-time eager execution with a device sync + trace span
+        per node: true per-layer timings for mx.profiler (the role of the
+        reference's per-op engine stats, src/engine/profiler.cc:152).
+        Slower than the fused program by design; only used while the
+        profiler is running in operator mode."""
+        from . import profiler as _prof
+        topo = self._symbol._topo()
+        node_index = {id(n): i for i, n in enumerate(topo)}
+        aux_nodes = self._symbol._aux_node_set()
+        env = {}
+        aux_updates = {}
+        import time as _time
+        for node in topo:
+            if node.is_variable:
+                src = raw_aux if id(node) in aux_nodes else raw_args
+                env[(id(node), 0)] = src[node.name]
+                continue
+            attrs = node.parsed_attrs()
+            if "__is_train__" in node.op.attrs_spec:
+                attrs = type(attrs)(attrs)
+                attrs["__is_train__"] = is_train
+            ins = [env[(id(n), i)] for n, i in node.inputs]
+            key = jax.random.fold_in(rng, node_index[id(node)]) \
+                if node.op.needs_rng else None
+            t0 = _time.perf_counter() * 1e6
+            outs = node.op.trace(attrs, ins, rng=key)
+            jax.block_until_ready(outs)
+            _prof.record_span(node.name or node.op.name,
+                              t0, _time.perf_counter() * 1e6,
+                              category=node.op.name)
+            n_vis = node.op.n_out(attrs)
+            for i in range(n_vis):
+                env[(id(node), i)] = outs[i]
+            if node.op.aux_names and len(outs) > n_vis:
+                names = node.op.input_names(attrs, n=len(node.inputs))
+                for j, an in enumerate(node.op.aux_names):
+                    idx = names.index(an)
+                    src = node.inputs[idx][0]
+                    if src.is_variable:
+                        aux_updates[src.name] = outs[n_vis + j]
+        outs = [env[(id(n), i)] for n, i in self._symbol._outputs]
+        return outs, aux_updates
+
     # -------------------------------------------------- public API
     def forward(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
@@ -213,6 +262,16 @@ class Executor:
                     else jnp.asarray(v)
         rng = _rnd.next_key()
         raw_args, raw_aux = self._raw_args(), self._raw_aux()
+        from . import profiler as _prof
+        if _prof.ops_enabled():
+            self._fwd_snapshot = (raw_args, raw_aux, rng)
+            outs, auxu = self._forward_profiled(is_train, raw_args, raw_aux,
+                                                rng)
+            self._pending_grads = None
+            self._profiled_pending = is_train and bool(self._grad_arg_names())
+            if is_train:
+                self._apply_aux(auxu)
+            return self._wrap_outputs(outs)
         # remember the forward's exact inputs + rng so a later
         # backward(out_grads) replays the SAME computation (same dropout
         # masks, pre-update aux) instead of a fresh stochastic forward
@@ -238,6 +297,16 @@ class Executor:
             return
         if out_grads is None:
             grads = self._pending_grads
+            if grads is None and getattr(self, "_profiled_pending", False):
+                # profiled forward ran node-by-node; grads come from the
+                # fused program, timed as one 'backward' span
+                from . import profiler as _prof
+                raw_args, raw_aux, rng = self._fwd_snapshot
+                with _prof.scope("backward", category="backward"):
+                    outs, _auxu, grads = self._get_fn("fwd_bwd")(
+                        raw_args, raw_aux, rng)
+                    jax.block_until_ready(grads)
+                self._profiled_pending = False
             if grads is None:
                 raise MXNetError("backward: call forward(is_train=True) first")
         else:

@@ -168,9 +168,20 @@ def _batch_norm(a, data, gamma, beta, moving_mean, moving_var):
         mean, var = moving_mean, moving_var
         new_mm, new_mv = moving_mean, moving_var
     else:
-        x32 = data.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=red).astype(data.dtype)
-        var = jnp.var(x32, axis=red).astype(data.dtype)
+        # single-pass stats: sum and sum-of-squares fuse into ONE
+        # multi-output reduction that reads the (bf16) activation once with
+        # the f32 convert inlined. The two-pass jnp.var form needs the f32
+        # activation twice, which makes XLA materialize a full f32 copy of
+        # every conv output — ~2x the training step's HBM traffic.
+        n = 1.0
+        for i in red:
+            n *= data.shape[i]
+        s1 = jnp.sum(data, axis=red, dtype=jnp.float32)
+        s2 = jnp.sum(jnp.square(data.astype(jnp.float32)), axis=red)
+        mean32 = s1 / n
+        var32 = jnp.maximum(s2 / n - jnp.square(mean32), 0.0)
+        mean = mean32.astype(data.dtype)
+        var = var32.astype(data.dtype)
         m = a.momentum
         new_mm = m * moving_mean + (1 - m) * lax.stop_gradient(mean)
         new_mv = m * moving_var + (1 - m) * lax.stop_gradient(var)

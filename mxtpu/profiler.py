@@ -1,8 +1,17 @@
 """Profiler (parity: python/mxnet/profiler.py + src/engine/profiler.{h,cc}).
 
-TPU-native: wraps the JAX/XLA profiler (xplane) and also keeps a lightweight
-host-side span recorder dumped as chrome://tracing JSON, matching the
-reference's DumpProfile output format (profiler.cc:152 EmitPid/EmitEvent)."""
+TPU-native three-tier design:
+  1. every graph node is traced under ``jax.named_scope(layer_name)``
+     (executor.py), so XLA/xprof device traces attribute time per layer —
+     the fused-program analogue of the engine's per-op OprExecStat stamps
+     (src/engine/threaded_engine.h:314-325);
+  2. with the profiler running in an operator mode, the Executor switches to
+     node-at-a-time execution with a device sync per node, recording true
+     per-layer wall times as chrome://tracing spans (DumpProfile parity,
+     profiler.cc:152 EmitPid/EmitEvent);
+  3. ``profiler_set_state('run')`` also starts a jax xplane trace for
+     TensorBoard's profile plugin when available.
+"""
 from __future__ import annotations
 
 import json
@@ -12,15 +21,20 @@ import time
 import jax
 
 _state = {"mode": "symbolic", "filename": "profile.json", "running": False,
-          "jax_trace": False}
+          "jax_trace": False, "aggregate_stats": False}
 _events = []
 _lock = threading.Lock()
 
+_OP_MODES = ("symbolic", "imperative", "operator", "all")
 
-def profiler_set_config(mode="symbolic", filename="profile.json"):
-    """Parity MXSetProfilerConfig."""
+
+def profiler_set_config(mode="symbolic", filename="profile.json",
+                        aggregate_stats=False, **kwargs):
+    """Parity MXSetProfilerConfig(kwargs): mode 'symbolic'|'imperative'|
+    'operator'|'api'|'all', output filename, optional aggregate stats."""
     _state["mode"] = mode
     _state["filename"] = filename
+    _state["aggregate_stats"] = bool(aggregate_stats)
 
 
 def profiler_set_state(state="stop"):
@@ -40,6 +54,20 @@ def profiler_set_state(state="stop"):
                 pass
             _state["jax_trace"] = False
         _state["running"] = False
+
+
+# aliases matching python/mxnet/profiler.py's public names
+set_config = profiler_set_config
+set_state = profiler_set_state
+
+
+def is_running():
+    return _state["running"]
+
+
+def ops_enabled():
+    """True when executors should run node-at-a-time with per-op spans."""
+    return _state["running"] and _state["mode"] in _OP_MODES
 
 
 def record_span(name, begin_us, end_us, category="operator", tid=0):
@@ -68,10 +96,47 @@ class scope:
         record_span(self.name, self.t0, time.time() * 1e6, self.category)
 
 
-def dump_profile():
+def dump_profile(finished=True):
     """Parity MXDumpProfile: write chrome://tracing JSON."""
     with _lock:
         payload = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
     with open(_state["filename"], "w") as f:
         json.dump(payload, f)
     return _state["filename"]
+
+
+dump = dump_profile
+
+
+def dumps(reset=False):
+    """Aggregate per-op statistics table as text (parity MXAggregateProfile
+    StatsToString: name, count, total/avg/min/max ms)."""
+    stats = {}
+    with _lock:
+        spans = {}
+        for ev in _events:
+            key = (ev["name"], ev["tid"])
+            if ev["ph"] == "B":
+                spans[key] = ev["ts"]
+            elif ev["ph"] == "E" and key in spans:
+                dur = (ev["ts"] - spans.pop(key)) / 1e3  # ms
+                s = stats.setdefault(ev["name"],
+                                     [0, 0.0, float("inf"), 0.0])
+                s[0] += 1
+                s[1] += dur
+                s[2] = min(s[2], dur)
+                s[3] = max(s[3], dur)
+        if reset:
+            _events.clear()
+    lines = ["%-40s %8s %12s %12s %12s %12s" %
+             ("Name", "Count", "Total(ms)", "Avg(ms)", "Min(ms)", "Max(ms)")]
+    for name in sorted(stats, key=lambda n: -stats[n][1]):
+        c, tot, lo, hi = stats[name]
+        lines.append("%-40s %8d %12.3f %12.3f %12.3f %12.3f" %
+                     (name[:40], c, tot, tot / c, lo, hi))
+    return "\n".join(lines)
+
+
+def clear():
+    with _lock:
+        _events.clear()
