@@ -1,0 +1,199 @@
+"""Python side of the full C ABI (src/capi/c_api_full.cc).
+
+The C layer (reference surface: include/mxnet/c_api.h — NDArray / Symbol /
+Executor / KVStore groups) keeps only integer handles; every operation
+resolves here through a process-wide registry. This is the porting seam the
+reference gives every language binding (SURVEY.md L10): a non-Python client
+trains through these entry points while the TPU execution path stays the
+jit-compiled executor.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as _np
+
+_lock = threading.Lock()
+_handles = {}
+_next = [1]
+
+
+def _register(obj):
+    with _lock:
+        h = _next[0]
+        _next[0] += 1
+        _handles[h] = obj
+    return h
+
+
+def _get(h):
+    return _handles[int(h)]
+
+
+def free(h):
+    with _lock:
+        _handles.pop(int(h), None)
+    return 0
+
+
+def _ctx(dev_type, dev_id):
+    from . import context as ctx
+    return {1: ctx.cpu, 2: ctx.gpu, 4: ctx.tpu}.get(int(dev_type), ctx.cpu)(
+        int(dev_id))
+
+
+# ------------------------------------------------------------- NDArray
+def ndarray_create(shape, dtype, dev_type, dev_id):
+    from . import ndarray as nd
+    arr = nd.zeros(tuple(int(s) for s in shape), dtype=str(dtype),
+                   ctx=_ctx(dev_type, dev_id))
+    return _register(arr)
+
+
+def ndarray_shape(h):
+    return tuple(int(s) for s in _get(h).shape)
+
+
+def ndarray_dtype(h):
+    return str(_get(h).dtype)
+
+
+def ndarray_copy_from(h, buf):
+    """buf: bytes of the array's dtype in C order."""
+    arr = _get(h)
+    src = _np.frombuffer(buf, dtype=_np.dtype(str(arr.dtype)))
+    arr[:] = src.reshape(arr.shape)
+    return 0
+
+
+def ndarray_copy_to(h):
+    return _np.ascontiguousarray(_get(h).asnumpy()).tobytes()
+
+
+def ndarray_wait_all():
+    from . import ndarray as nd
+    nd.waitall()
+    return 0
+
+
+def ndarray_save(path, handles, names):
+    from . import ndarray as nd
+    arrs = [_get(h) for h in handles]
+    if names:
+        nd.save(str(path), dict(zip([str(n) for n in names], arrs)))
+    else:
+        nd.save(str(path), arrs)
+    return 0
+
+
+def ndarray_load(path):
+    from . import ndarray as nd
+    loaded = nd.load(str(path))
+    if isinstance(loaded, dict):
+        names = list(loaded.keys())
+        return names, [_register(loaded[n]) for n in names]
+    return [], [_register(a) for a in loaded]
+
+
+# ------------------------------------------------------------- Symbol
+def symbol_from_json(js):
+    from . import symbol as sym
+    return _register(sym.load_json(str(js)))
+
+
+def symbol_to_json(h):
+    return _get(h).tojson()
+
+
+def symbol_list_arguments(h):
+    return list(_get(h).list_arguments())
+
+
+def symbol_list_outputs(h):
+    return list(_get(h).list_outputs())
+
+
+def symbol_list_aux(h):
+    return list(_get(h).list_auxiliary_states())
+
+
+# ------------------------------------------------------------- Executor
+def executor_simple_bind(sym_h, dev_type, dev_id, grad_req, names, shapes):
+    """names/shapes: flat input-shape spec (data/label names)."""
+    sym = _get(sym_h)
+    kw = {str(n): tuple(int(x) for x in s) for n, s in zip(names, shapes)}
+    exe = sym.simple_bind(ctx=_ctx(dev_type, dev_id),
+                          grad_req=str(grad_req), **kw)
+    return _register(exe)
+
+
+def executor_forward(h, is_train):
+    _get(h).forward(is_train=bool(is_train))
+    return 0
+
+
+def executor_backward(h):
+    _get(h).backward()
+    return 0
+
+
+def executor_num_outputs(h):
+    return len(_get(h).outputs)
+
+
+def executor_output(h, i):
+    return _register(_get(h).outputs[int(i)])
+
+
+def executor_arg(h, name):
+    return _register(_get(h).arg_dict[str(name)])
+
+
+def executor_grad(h, name):
+    g = _get(h).grad_dict.get(str(name))
+    if g is None:
+        raise KeyError("no gradient for %s" % name)
+    return _register(g)
+
+
+def executor_arg_names(h):
+    return list(_get(h).arg_names)
+
+
+# ------------------------------------------------------------- KVStore
+def kvstore_create(kind):
+    from . import kvstore as kv
+    return _register(kv.create(str(kind)))
+
+
+def kvstore_init(h, key, nd_h):
+    _get(h).init(str(key), _get(nd_h))
+    return 0
+
+
+def kvstore_push(h, key, nd_h):
+    _get(h).push(str(key), _get(nd_h))
+    return 0
+
+
+def kvstore_pull(h, key, nd_h):
+    _get(h).pull(str(key), out=_get(nd_h))
+    return 0
+
+
+def kvstore_set_optimizer(h, name, lr, wd, momentum, rescale):
+    from . import optimizer as opt
+    kwargs = {"learning_rate": float(lr), "wd": float(wd),
+              "rescale_grad": float(rescale)}
+    if float(momentum):
+        kwargs["momentum"] = float(momentum)
+    _get(h).set_optimizer(opt.create(str(name), **kwargs))
+    return 0
+
+
+def kvstore_rank(h):
+    return int(_get(h).rank)
+
+
+def kvstore_num_workers(h):
+    return int(_get(h).num_workers)

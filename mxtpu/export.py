@@ -1,0 +1,103 @@
+"""Ahead-of-time model export: Python-free deployment artifacts.
+
+The reference ships ``amalgamation`` — a single-file libmxnet_predict a C
+client links to run inference without the framework
+(amalgamation/README.md). The TPU-native equivalent is XLA's portable
+serialization: the bound inference graph (weights baked in as constants)
+exports to a StableHLO artifact via ``jax.export`` that ANY jax-bearing
+process — or a PJRT C++ host loading the embedded StableHLO module — can
+run without the mxtpu package. ``load_serving`` needs only ``jax``.
+
+Format (.mxa): 8-byte magic ``MXTPUAOT`` + u32 version + u32 header length
++ JSON header {input names/shapes/dtypes, output names} + the jax.export
+payload bytes.
+"""
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as _np
+
+_MAGIC = b"MXTPUAOT"
+_VERSION = 1
+
+
+def export_serving(symbol, arg_params, aux_params, data_shapes, path,
+                   platforms=None):
+    """Serialize an inference-ready program to `path`.
+
+    symbol: inference Symbol; arg_params/aux_params: trained NDArray (or
+    array) dicts — baked into the program as constants; data_shapes:
+    {input_name: shape} for the data inputs that remain runtime arguments.
+    platforms: e.g. ("cpu", "tpu") for a cross-platform artifact (defaults
+    to the current backend).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .executor import _trace_graph
+
+    run = _trace_graph(symbol, is_train=False)
+    inputs = dict(data_shapes)
+    consts = {}
+    for n, v in arg_params.items():
+        if n not in inputs:
+            consts[n] = jnp.asarray(getattr(v, "_data", v))
+    # loss-head label args don't influence inference outputs; bind zeros
+    arg_shapes, _, _ = symbol.infer_shape(**inputs)
+    for n, s in zip(symbol.list_arguments(), arg_shapes):
+        if n not in inputs and n not in consts:
+            consts[n] = jnp.zeros(tuple(s), jnp.float32)
+    aux = {n: jnp.asarray(getattr(v, "_data", v))
+           for n, v in (aux_params or {}).items()}
+    rng = jnp.zeros((2,), jnp.uint32)
+
+    def serve(*data_vals):
+        env = dict(consts)
+        env.update(dict(zip(inputs.keys(), data_vals)))
+        outs, _aux = run(env, aux, rng)
+        return tuple(outs)
+
+    example = [jax.ShapeDtypeStruct(tuple(s), jnp.float32)
+               for s in inputs.values()]
+    kwargs = {}
+    if platforms:
+        kwargs["platforms"] = tuple(platforms)
+    exported = jax.export.export(jax.jit(serve), **kwargs)(*example)
+    payload = exported.serialize()
+    header = json.dumps({
+        "inputs": [{"name": n, "shape": list(s), "dtype": "float32"}
+                   for n, s in inputs.items()],
+        "outputs": list(symbol.list_outputs()),
+    }).encode("utf-8")
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<II", _VERSION, len(header)))
+        f.write(header)
+        f.write(payload)
+    return path
+
+
+def load_serving(path):
+    """Load a .mxa artifact: returns (fn, meta). Pure jax — no mxtpu
+    needed (deployable in a bare jax container or via PJRT in C++)."""
+    import jax
+
+    with open(path, "rb") as f:
+        magic = f.read(8)
+        if magic != _MAGIC:
+            raise ValueError("not an mxtpu AOT artifact: %r" % magic)
+        version, hlen = struct.unpack("<II", f.read(8))
+        if version != _VERSION:
+            raise ValueError("unsupported artifact version %d" % version)
+        meta = json.loads(f.read(hlen).decode("utf-8"))
+        payload = f.read()
+    exported = jax.export.deserialize(payload)
+
+    def fn(*data_vals):
+        import jax.numpy as jnp
+        vals = [jnp.asarray(_np.asarray(v), jnp.float32) for v in data_vals]
+        return exported.call(*vals)
+
+    return fn, meta

@@ -1,0 +1,89 @@
+/*
+ * Full C training ABI (reference surface: include/mxnet/c_api.h — the
+ * NDArray / Symbol / Executor / KVStore groups every language binding sits
+ * on, SURVEY.md L10). Handles are opaque; every function returns 0 on
+ * success, -1 on failure with the message via MXGetLastError().
+ *
+ * Build: part of libmxtpu_capi.so (src/Makefile). The execution path behind
+ * the seam is the jit-compiled TPU executor; the runtime is hosted in an
+ * embedded CPython, so this ABI is the porting boundary, not a new engine.
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned int mx_uint;
+typedef void *NDArrayHandle;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+typedef void *KVStoreHandle;
+
+const char *MXGetLastError(void);
+
+/* ---------------- NDArray ---------------- */
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, int dtype,
+                    NDArrayHandle *out);
+int MXNDArrayFree(NDArrayHandle handle);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             uint64_t size_bytes);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,
+                           uint64_t size_bytes);
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata);
+int MXNDArrayWaitAll(void);
+int MXNDArraySave(const char *fname, mx_uint num_args,
+                  NDArrayHandle *args, const char **keys);
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names);
+
+/* ---------------- Symbol ---------------- */
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json);
+int MXSymbolFree(SymbolHandle sym);
+int MXSymbolListArguments(SymbolHandle sym, mx_uint *out_size,
+                          const char ***out_array);
+int MXSymbolListOutputs(SymbolHandle sym, mx_uint *out_size,
+                        const char ***out_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint *out_size,
+                                const char ***out_array);
+
+/* ---------------- Executor ---------------- */
+/* simple-bind with explicit input shapes; every other argument is
+ * allocated and initialized to zeros (fill via MXExecutorArg +
+ * MXNDArraySyncCopyFromCPU). */
+int MXExecutorSimpleBind(SymbolHandle sym, int dev_type, int dev_id,
+                         const char *grad_req, mx_uint num_inputs,
+                         const char **input_names,
+                         const mx_uint *shape_indptr,
+                         const mx_uint *shape_data, ExecutorHandle *out);
+int MXExecutorForward(ExecutorHandle exec, int is_train);
+int MXExecutorBackward(ExecutorHandle exec);
+int MXExecutorOutputs(ExecutorHandle exec, mx_uint *out_size);
+int MXExecutorOutput(ExecutorHandle exec, mx_uint index, NDArrayHandle *out);
+int MXExecutorArg(ExecutorHandle exec, const char *name, NDArrayHandle *out);
+int MXExecutorGrad(ExecutorHandle exec, const char *name, NDArrayHandle *out);
+int MXExecutorFree(ExecutorHandle exec);
+
+/* ---------------- KVStore ---------------- */
+int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+int MXKVStoreFree(KVStoreHandle kv);
+int MXKVStoreInit(KVStoreHandle kv, const char *key, NDArrayHandle val);
+int MXKVStorePush(KVStoreHandle kv, const char *key, NDArrayHandle val);
+int MXKVStorePull(KVStoreHandle kv, const char *key, NDArrayHandle out);
+int MXKVStoreSetOptimizer(KVStoreHandle kv, const char *name, float lr,
+                          float wd, float momentum, float rescale_grad);
+int MXKVStoreGetRank(KVStoreHandle kv, int *out);
+int MXKVStoreGetGroupSize(KVStoreHandle kv, int *out);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXTPU_C_API_H_ */

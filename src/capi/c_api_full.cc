@@ -1,0 +1,449 @@
+// Full C training ABI over the embedded runtime. See c_api.h.
+// Every entry point marshals into mxtpu.capi_bridge (a handle registry);
+// the execution path stays the jit-compiled executor. Reference surface:
+// include/mxnet/c_api.h NDArray/Symbol/Executor/KVStore groups.
+#include "c_api.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+std::once_flag g_py_once;
+
+void EnsurePython() {
+  std::call_once(g_py_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+    }
+  });
+}
+
+void CapturePyError(const char *where) {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = std::string(where) + ": ";
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      msg += PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  } else {
+    msg += "unknown python error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  g_last_error = msg;
+}
+
+class GilGuard {
+ public:
+  GilGuard() { state_ = PyGILState_Ensure(); }
+  ~GilGuard() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+// Call mxtpu.capi_bridge.<fn>(*args); steals the args tuple ref.
+PyObject *CallBridge(const char *fn, PyObject *args) {
+  PyObject *mod = PyImport_ImportModule("mxtpu.capi_bridge");
+  if (mod == nullptr) {
+    Py_XDECREF(args);
+    CapturePyError("import mxtpu.capi_bridge");
+    return nullptr;
+  }
+  PyObject *f = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
+  if (f == nullptr) {
+    Py_XDECREF(args);
+    CapturePyError(fn);
+    return nullptr;
+  }
+  PyObject *res = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (res == nullptr) CapturePyError(fn);
+  return res;
+}
+
+// Handle = bridge registry id stored directly in the pointer value.
+void *IdToHandle(PyObject *res) {
+  long id = PyLong_AsLong(res);
+  return reinterpret_cast<void *>(static_cast<intptr_t>(id));
+}
+
+long HandleToId(void *h) {
+  return static_cast<long>(reinterpret_cast<intptr_t>(h));
+}
+
+// Per-thread string/shape arenas backing the const char**/mx_uint* returns
+// (valid until the next call on the same thread, like the reference's
+// per-thread return buffers in src/c_api/c_api.cc).
+thread_local std::vector<std::string> g_str_arena;
+thread_local std::vector<const char *> g_ptr_arena;
+thread_local std::vector<mx_uint> g_shape_arena;
+thread_local std::string g_json_arena;
+thread_local std::vector<void *> g_handle_arena;
+
+int StringListOut(PyObject *list, mx_uint *out_size,
+                  const char ***out_array) {
+  g_str_arena.clear();
+  g_ptr_arena.clear();
+  Py_ssize_t n = PyList_Size(list);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    g_str_arena.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(list, i)));
+  }
+  for (auto &s : g_str_arena) g_ptr_arena.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(n);
+  *out_array = g_ptr_arena.data();
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXGetLastError(void) { return g_last_error.c_str(); }
+
+/* ---------------- NDArray ---------------- */
+
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, int dtype,
+                    NDArrayHandle *out) {
+  (void)delay_alloc;
+  EnsurePython();
+  GilGuard gil;
+  PyObject *shp = PyTuple_New(ndim);
+  for (mx_uint i = 0; i < ndim; ++i) {
+    PyTuple_SetItem(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  }
+  // dtype codes follow the reference's mshadow enum: 0=f32 1=f64 2=f16
+  // 3=u8 4=i32 5=i8 6=i64; extension 7=bf16
+  static const char *kDtype[] = {"float32", "float64", "float16", "uint8",
+                                 "int32", "int8", "int64", "bfloat16"};
+  const char *dt = (dtype >= 0 && dtype < 8) ? kDtype[dtype] : "float32";
+  PyObject *res = CallBridge(
+      "ndarray_create", Py_BuildValue("(OsII)", shp, dt, dev_type, dev_id));
+  Py_DECREF(shp);
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  GilGuard gil;
+  PyObject *res = CallBridge("free", Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             uint64_t size_bytes) {
+  GilGuard gil;
+  PyObject *buf = PyBytes_FromStringAndSize(
+      static_cast<const char *>(data), static_cast<Py_ssize_t>(size_bytes));
+  PyObject *res = CallBridge(
+      "ndarray_copy_from", Py_BuildValue("(lN)", HandleToId(handle), buf));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,
+                           uint64_t size_bytes) {
+  GilGuard gil;
+  PyObject *res = CallBridge("ndarray_copy_to",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  char *src = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(res, &src, &n) != 0) {
+    Py_DECREF(res);
+    CapturePyError("ndarray_copy_to");
+    return -1;
+  }
+  if (static_cast<uint64_t>(n) < size_bytes) size_bytes = n;
+  std::memcpy(data, src, size_bytes);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata) {
+  GilGuard gil;
+  PyObject *res = CallBridge("ndarray_shape",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  g_shape_arena.clear();
+  Py_ssize_t n = PyTuple_Size(res);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    g_shape_arena.push_back(static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(res, i))));
+  }
+  Py_DECREF(res);
+  *out_dim = static_cast<mx_uint>(n);
+  *out_pdata = g_shape_arena.data();
+  return 0;
+}
+
+int MXNDArrayWaitAll(void) {
+  GilGuard gil;
+  PyObject *res = CallBridge("ndarray_wait_all", PyTuple_New(0));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArraySave(const char *fname, mx_uint num_args, NDArrayHandle *args,
+                  const char **keys) {
+  GilGuard gil;
+  PyObject *hs = PyList_New(num_args);
+  PyObject *ns = PyList_New(keys ? num_args : 0);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyList_SetItem(hs, i, PyLong_FromLong(HandleToId(args[i])));
+    if (keys) PyList_SetItem(ns, i, PyUnicode_FromString(keys[i]));
+  }
+  PyObject *res = CallBridge("ndarray_save",
+                             Py_BuildValue("(sNN)", fname, hs, ns));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names) {
+  GilGuard gil;
+  PyObject *res = CallBridge("ndarray_load", Py_BuildValue("(s)", fname));
+  if (res == nullptr) return -1;
+  PyObject *names = PyTuple_GetItem(res, 0);
+  PyObject *handles = PyTuple_GetItem(res, 1);
+  StringListOut(names, out_name_size, out_names);
+  g_handle_arena.clear();
+  Py_ssize_t n = PyList_Size(handles);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    g_handle_arena.push_back(reinterpret_cast<void *>(static_cast<intptr_t>(
+        PyLong_AsLong(PyList_GetItem(handles, i)))));
+  }
+  Py_DECREF(res);
+  *out_size = static_cast<mx_uint>(n);
+  *out_arr = reinterpret_cast<NDArrayHandle *>(g_handle_arena.data());
+  return 0;
+}
+
+/* ---------------- Symbol ---------------- */
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge("symbol_from_json", Py_BuildValue("(s)", json));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json) {
+  GilGuard gil;
+  PyObject *res = CallBridge("symbol_to_json",
+                             Py_BuildValue("(l)", HandleToId(sym)));
+  if (res == nullptr) return -1;
+  g_json_arena = PyUnicode_AsUTF8(res);
+  Py_DECREF(res);
+  *out_json = g_json_arena.c_str();
+  return 0;
+}
+
+int MXSymbolFree(SymbolHandle sym) { return MXNDArrayFree(sym); }
+
+#define MXTPU_SYM_LIST(NAME, FN)                                        \
+  int NAME(SymbolHandle sym, mx_uint *out_size, const char ***out) {    \
+    GilGuard gil;                                                       \
+    PyObject *res = CallBridge(FN, Py_BuildValue("(l)", HandleToId(sym))); \
+    if (res == nullptr) return -1;                                      \
+    StringListOut(res, out_size, out);                                  \
+    Py_DECREF(res);                                                     \
+    return 0;                                                           \
+  }
+
+MXTPU_SYM_LIST(MXSymbolListArguments, "symbol_list_arguments")
+MXTPU_SYM_LIST(MXSymbolListOutputs, "symbol_list_outputs")
+MXTPU_SYM_LIST(MXSymbolListAuxiliaryStates, "symbol_list_aux")
+#undef MXTPU_SYM_LIST
+
+/* ---------------- Executor ---------------- */
+
+int MXExecutorSimpleBind(SymbolHandle sym, int dev_type, int dev_id,
+                         const char *grad_req, mx_uint num_inputs,
+                         const char **input_names,
+                         const mx_uint *shape_indptr,
+                         const mx_uint *shape_data, ExecutorHandle *out) {
+  GilGuard gil;
+  PyObject *names = PyList_New(num_inputs);
+  PyObject *shapes = PyList_New(num_inputs);
+  for (mx_uint i = 0; i < num_inputs; ++i) {
+    PyList_SetItem(names, i, PyUnicode_FromString(input_names[i]));
+    mx_uint lo = shape_indptr[i], hi = shape_indptr[i + 1];
+    PyObject *shp = PyTuple_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j) {
+      PyTuple_SetItem(shp, j - lo, PyLong_FromUnsignedLong(shape_data[j]));
+    }
+    PyList_SetItem(shapes, i, shp);
+  }
+  PyObject *res = CallBridge(
+      "executor_simple_bind",
+      Py_BuildValue("(lIIsNN)", HandleToId(sym), dev_type, dev_id, grad_req,
+                    names, shapes));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorForward(ExecutorHandle exec, int is_train) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "executor_forward", Py_BuildValue("(li)", HandleToId(exec), is_train));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorBackward(ExecutorHandle exec) {
+  GilGuard gil;
+  PyObject *res = CallBridge("executor_backward",
+                             Py_BuildValue("(l)", HandleToId(exec)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorOutputs(ExecutorHandle exec, mx_uint *out_size) {
+  GilGuard gil;
+  PyObject *res = CallBridge("executor_num_outputs",
+                             Py_BuildValue("(l)", HandleToId(exec)));
+  if (res == nullptr) return -1;
+  *out_size = static_cast<mx_uint>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorOutput(ExecutorHandle exec, mx_uint index, NDArrayHandle *out) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "executor_output", Py_BuildValue("(lI)", HandleToId(exec), index));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorArg(ExecutorHandle exec, const char *name, NDArrayHandle *out) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "executor_arg", Py_BuildValue("(ls)", HandleToId(exec), name));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorGrad(ExecutorHandle exec, const char *name, NDArrayHandle *out) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "executor_grad", Py_BuildValue("(ls)", HandleToId(exec), name));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorFree(ExecutorHandle exec) { return MXNDArrayFree(exec); }
+
+/* ---------------- KVStore ---------------- */
+
+int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge("kvstore_create", Py_BuildValue("(s)", type));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreFree(KVStoreHandle kv) { return MXNDArrayFree(kv); }
+
+int MXKVStoreInit(KVStoreHandle kv, const char *key, NDArrayHandle val) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "kvstore_init",
+      Py_BuildValue("(lsl)", HandleToId(kv), key, HandleToId(val)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStorePush(KVStoreHandle kv, const char *key, NDArrayHandle val) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "kvstore_push",
+      Py_BuildValue("(lsl)", HandleToId(kv), key, HandleToId(val)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStorePull(KVStoreHandle kv, const char *key, NDArrayHandle out) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "kvstore_pull",
+      Py_BuildValue("(lsl)", HandleToId(kv), key, HandleToId(out)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreSetOptimizer(KVStoreHandle kv, const char *name, float lr,
+                          float wd, float momentum, float rescale_grad) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "kvstore_set_optimizer",
+      Py_BuildValue("(lsffff)", HandleToId(kv), name, lr, wd, momentum,
+                    rescale_grad));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreGetRank(KVStoreHandle kv, int *out) {
+  GilGuard gil;
+  PyObject *res = CallBridge("kvstore_rank",
+                             Py_BuildValue("(l)", HandleToId(kv)));
+  if (res == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle kv, int *out) {
+  GilGuard gil;
+  PyObject *res = CallBridge("kvstore_num_workers",
+                             Py_BuildValue("(l)", HandleToId(kv)));
+  if (res == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+}  /* extern "C" */

@@ -1,0 +1,158 @@
+/*
+ * C ABI training demo: train a small MLP end to end through the full
+ * C API (c_api.h) — symbol from JSON, simple-bind executor,
+ * forward/backward, optimizer-on-kvstore updates — no Python in the
+ * client. Mirrors the reference's cpp-package training flow
+ * (cpp-package/include/mxnet-cpp/MxNetCpp.h) on this ABI.
+ *
+ * Usage: train_demo <symbol.json> <data.bin> <labels.bin> <n> <dim> <classes>
+ * data.bin: n*dim float32, labels.bin: n float32. Prints final training
+ * accuracy as "ACCURACY <float>".
+ */
+#define _POSIX_C_SOURCE 200809L  /* strdup under -std=c99 */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "c_api.h"
+
+#define CHECK(call)                                                     \
+  do {                                                                  \
+    if ((call) != 0) {                                                  \
+      fprintf(stderr, "FAILED %s: %s\n", #call, MXGetLastError());      \
+      return 1;                                                         \
+    }                                                                   \
+  } while (0)
+
+static char *read_file(const char *path, long *size) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char *buf = (char *)malloc(*size + 1);
+  if (fread(buf, 1, *size, f) != (size_t)*size) {
+    fclose(f);
+    free(buf);
+    return NULL;
+  }
+  buf[*size] = 0;
+  fclose(f);
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 7) {
+    fprintf(stderr, "usage: %s sym.json data.bin labels.bin n dim classes\n",
+            argv[0]);
+    return 2;
+  }
+  long js_size, data_size, label_size;
+  char *json = read_file(argv[1], &js_size);
+  float *data = (float *)read_file(argv[2], &data_size);
+  float *labels = (float *)read_file(argv[3], &label_size);
+  int n = atoi(argv[4]), dim = atoi(argv[5]), classes = atoi(argv[6]);
+  if (!json || !data || !labels) {
+    fprintf(stderr, "cannot read inputs\n");
+    return 2;
+  }
+
+  SymbolHandle sym;
+  CHECK(MXSymbolCreateFromJSON(json, &sym));
+
+  mx_uint n_args;
+  const char **arg_names;
+  CHECK(MXSymbolListArguments(sym, &n_args, &arg_names));
+
+  /* bind with batch = n (full batch training keeps the demo simple) */
+  const char *input_names[2] = {"data", "softmax_label"};
+  mx_uint indptr[3] = {0, 2, 3};
+  mx_uint shapes[3] = {(mx_uint)n, (mx_uint)dim, (mx_uint)n};
+  ExecutorHandle exec;
+  CHECK(MXExecutorSimpleBind(sym, 1 /*cpu*/, 0, "write", 2, input_names,
+                             indptr, shapes, &exec));
+
+  /* feed data/labels */
+  NDArrayHandle a_data, a_label;
+  CHECK(MXExecutorArg(exec, "data", &a_data));
+  CHECK(MXExecutorArg(exec, "softmax_label", &a_label));
+  CHECK(MXNDArraySyncCopyFromCPU(a_data, data, (uint64_t)n * dim * 4));
+  CHECK(MXNDArraySyncCopyFromCPU(a_label, labels, (uint64_t)n * 4));
+
+  /* init params: deterministic pseudo-random uniform(-0.5, 0.5) */
+  KVStoreHandle kv;
+  CHECK(MXKVStoreCreate("local", &kv));
+  CHECK(MXKVStoreSetOptimizer(kv, "sgd", 0.5f, 0.0f, 0.9f, 1.0f / n));
+  unsigned seed = 12345;
+  /* copy of the param names list (arena is reused by later calls) */
+  char **params = (char **)malloc(n_args * sizeof(char *));
+  mx_uint n_params = 0;
+  for (mx_uint i = 0; i < n_args; ++i) {
+    if (strcmp(arg_names[i], "data") == 0 ||
+        strcmp(arg_names[i], "softmax_label") == 0) {
+      continue;
+    }
+    params[n_params] = strdup(arg_names[i]);
+    n_params++;
+  }
+  for (mx_uint i = 0; i < n_params; ++i) {
+    NDArrayHandle w;
+    CHECK(MXExecutorArg(exec, params[i], &w));
+    mx_uint ndim;
+    const mx_uint *shp;
+    CHECK(MXNDArrayGetShape(w, &ndim, &shp));
+    uint64_t total = 1;
+    for (mx_uint j = 0; j < ndim; ++j) total *= shp[j];
+    float *init = (float *)malloc(total * 4);
+    for (uint64_t j = 0; j < total; ++j) {
+      seed = seed * 1103515245u + 12345u;
+      init[j] = ((float)(seed >> 16 & 0x7fff) / 32768.0f - 0.5f) * 0.2f;
+    }
+    CHECK(MXNDArraySyncCopyFromCPU(w, init, total * 4));
+    free(init);
+    CHECK(MXKVStoreInit(kv, params[i], w));
+    CHECK(MXNDArrayFree(w));
+  }
+
+  /* training loop: fwd/bwd + push grad / pull weight per param */
+  int epochs = 60;
+  for (int e = 0; e < epochs; ++e) {
+    CHECK(MXExecutorForward(exec, 1));
+    CHECK(MXExecutorBackward(exec));
+    for (mx_uint i = 0; i < n_params; ++i) {
+      NDArrayHandle g, w;
+      CHECK(MXExecutorGrad(exec, params[i], &g));
+      CHECK(MXExecutorArg(exec, params[i], &w));
+      CHECK(MXKVStorePush(kv, params[i], g));
+      CHECK(MXKVStorePull(kv, params[i], w));
+      CHECK(MXNDArrayFree(g));
+      CHECK(MXNDArrayFree(w));
+    }
+  }
+  CHECK(MXNDArrayWaitAll());
+
+  /* accuracy on the training batch */
+  CHECK(MXExecutorForward(exec, 0));
+  NDArrayHandle out;
+  CHECK(MXExecutorOutput(exec, 0, &out));
+  float *probs = (float *)malloc((uint64_t)n * classes * 4);
+  CHECK(MXNDArraySyncCopyToCPU(out, probs, (uint64_t)n * classes * 4));
+  int correct = 0;
+  for (int i = 0; i < n; ++i) {
+    int best = 0;
+    for (int c = 1; c < classes; ++c) {
+      if (probs[i * classes + c] > probs[i * classes + best]) best = c;
+    }
+    if (best == (int)labels[i]) correct++;
+  }
+  printf("ACCURACY %.4f\n", (double)correct / n);
+
+  CHECK(MXExecutorFree(exec));
+  CHECK(MXSymbolFree(sym));
+  CHECK(MXKVStoreFree(kv));
+  free(probs);
+  free(json);
+  free(data);
+  free(labels);
+  return 0;
+}
