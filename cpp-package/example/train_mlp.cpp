@@ -1,0 +1,91 @@
+// C++ training example over the header-only API (role of
+// cpp-package/example/mlp.cpp in the reference): load a symbol JSON,
+// bind, train with optimizer-on-kvstore SGD, report accuracy.
+//
+// Usage: train_mlp <symbol.json> <data.bin> <labels.bin> <n> <dim> <classes>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <random>
+#include <sstream>
+#include <vector>
+
+#include "mxtpu-cpp/mxtpu_cpp.hpp"
+
+using mxtpu::cpp::Executor;
+using mxtpu::cpp::KVStore;
+using mxtpu::cpp::NDArray;
+using mxtpu::cpp::Symbol;
+
+static std::string ReadFile(const char *path) {
+  std::ifstream f(path, std::ios::binary);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+int main(int argc, char **argv) {
+  if (argc < 7) {
+    std::fprintf(stderr, "usage: %s sym.json data.bin labels.bin n dim c\n",
+                 argv[0]);
+    return 2;
+  }
+  const int n = std::atoi(argv[4]);
+  const int dim = std::atoi(argv[5]);
+  const int classes = std::atoi(argv[6]);
+  std::string json = ReadFile(argv[1]);
+  std::string data_raw = ReadFile(argv[2]);
+  std::string label_raw = ReadFile(argv[3]);
+  const float *data = reinterpret_cast<const float *>(data_raw.data());
+  const float *labels = reinterpret_cast<const float *>(label_raw.data());
+
+  Symbol sym = Symbol::FromJSON(json);
+  Executor exec(sym, /*cpu*/ 1, 0, "write",
+                {{"data", {static_cast<mx_uint>(n),
+                           static_cast<mx_uint>(dim)}},
+                 {"softmax_label", {static_cast<mx_uint>(n)}}});
+  exec.Arg("data").CopyFrom(data, static_cast<uint64_t>(n) * dim);
+  exec.Arg("softmax_label").CopyFrom(labels, n);
+
+  KVStore kv("local");
+  kv.SetOptimizer("sgd", 0.5f, 0.0f, 0.9f, 1.0f / n);
+  std::mt19937 rng(7);
+  std::uniform_real_distribution<float> uni(-0.1f, 0.1f);
+  std::vector<std::string> params;
+  for (const auto &name : sym.ListArguments()) {
+    if (name == "data" || name == "softmax_label") continue;
+    params.push_back(name);
+    NDArray w = exec.Arg(name);
+    std::vector<float> init(w.Size());
+    for (auto &v : init) v = uni(rng);
+    w.CopyFrom(init.data(), init.size());
+    kv.Init(name, w);
+  }
+
+  for (int e = 0; e < 60; ++e) {
+    exec.Forward(true);
+    exec.Backward();
+    for (const auto &name : params) {
+      NDArray g = exec.Grad(name);
+      NDArray w = exec.Arg(name);
+      kv.Push(name, g);
+      kv.Pull(name, &w);
+    }
+  }
+  mxtpu::cpp::WaitAll();
+
+  exec.Forward(false);
+  NDArray out = exec.Output(0);
+  std::vector<float> probs(static_cast<uint64_t>(n) * classes);
+  out.CopyTo(probs.data(), probs.size());
+  int correct = 0;
+  for (int i = 0; i < n; ++i) {
+    int best = 0;
+    for (int c = 1; c < classes; ++c) {
+      if (probs[i * classes + c] > probs[i * classes + best]) best = c;
+    }
+    if (best == static_cast<int>(labels[i])) ++correct;
+  }
+  std::printf("ACCURACY %.4f\n", static_cast<double>(correct) / n);
+  return 0;
+}

@@ -1,0 +1,206 @@
+/*
+ * Header-only C++ training API over the C ABI (src/capi/c_api.h) — the
+ * role of the reference's cpp-package
+ * (cpp-package/include/mxnet-cpp/MxNetCpp.h): idiomatic RAII wrappers so a
+ * C++ program builds symbols from JSON, binds executors, trains with the
+ * optimizer-on-kvstore flow, and reads results — no Python in the client.
+ */
+#ifndef MXTPU_CPP_MXTPU_CPP_HPP_
+#define MXTPU_CPP_MXTPU_CPP_HPP_
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "c_api.h"
+
+namespace mxtpu {
+namespace cpp {
+
+inline void Check(int rc, const char *what) {
+  if (rc != 0) {
+    throw std::runtime_error(std::string(what) + ": " + MXGetLastError());
+  }
+}
+
+class NDArray {
+ public:
+  NDArray() = default;
+  NDArray(const std::vector<mx_uint> &shape, int dev_type = 1,
+          int dev_id = 0, int dtype = 0) {
+    Check(MXNDArrayCreate(shape.data(),
+                          static_cast<mx_uint>(shape.size()), dev_type,
+                          dev_id, 0, dtype, &h_),
+          "NDArrayCreate");
+    owned_ = true;
+  }
+  explicit NDArray(NDArrayHandle h, bool owned = true)
+      : h_(h), owned_(owned) {}
+  NDArray(NDArray &&o) noexcept : h_(o.h_), owned_(o.owned_) {
+    o.h_ = nullptr;
+  }
+  NDArray &operator=(NDArray &&o) noexcept {
+    Reset();
+    h_ = o.h_;
+    owned_ = o.owned_;
+    o.h_ = nullptr;
+    return *this;
+  }
+  NDArray(const NDArray &) = delete;
+  NDArray &operator=(const NDArray &) = delete;
+  ~NDArray() { Reset(); }
+
+  void CopyFrom(const float *data, uint64_t count) {
+    Check(MXNDArraySyncCopyFromCPU(h_, data, count * sizeof(float)),
+          "SyncCopyFromCPU");
+  }
+  void CopyTo(float *data, uint64_t count) const {
+    Check(MXNDArraySyncCopyToCPU(h_, data, count * sizeof(float)),
+          "SyncCopyToCPU");
+  }
+  std::vector<mx_uint> Shape() const {
+    mx_uint ndim = 0;
+    const mx_uint *p = nullptr;
+    Check(MXNDArrayGetShape(h_, &ndim, &p), "GetShape");
+    return std::vector<mx_uint>(p, p + ndim);
+  }
+  uint64_t Size() const {
+    uint64_t n = 1;
+    for (auto d : Shape()) n *= d;
+    return n;
+  }
+  NDArrayHandle handle() const { return h_; }
+
+ private:
+  void Reset() {
+    if (h_ != nullptr && owned_) MXNDArrayFree(h_);
+    h_ = nullptr;
+  }
+  NDArrayHandle h_ = nullptr;
+  bool owned_ = false;
+};
+
+class Symbol {
+ public:
+  static Symbol FromJSON(const std::string &json) {
+    SymbolHandle h;
+    Check(MXSymbolCreateFromJSON(json.c_str(), &h), "SymbolCreateFromJSON");
+    return Symbol(h);
+  }
+  explicit Symbol(SymbolHandle h) : h_(h) {}
+  Symbol(Symbol &&o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  Symbol(const Symbol &) = delete;
+  ~Symbol() {
+    if (h_ != nullptr) MXSymbolFree(h_);
+  }
+
+  std::vector<std::string> ListArguments() const {
+    mx_uint n = 0;
+    const char **arr = nullptr;
+    Check(MXSymbolListArguments(h_, &n, &arr), "ListArguments");
+    return std::vector<std::string>(arr, arr + n);
+  }
+  std::vector<std::string> ListOutputs() const {
+    mx_uint n = 0;
+    const char **arr = nullptr;
+    Check(MXSymbolListOutputs(h_, &n, &arr), "ListOutputs");
+    return std::vector<std::string>(arr, arr + n);
+  }
+  std::string ToJSON() const {
+    const char *js = nullptr;
+    Check(MXSymbolSaveToJSON(h_, &js), "SaveToJSON");
+    return std::string(js);
+  }
+  SymbolHandle handle() const { return h_; }
+
+ private:
+  SymbolHandle h_ = nullptr;
+};
+
+class Executor {
+ public:
+  Executor(const Symbol &sym, int dev_type, int dev_id,
+           const std::string &grad_req,
+           const std::vector<std::pair<std::string,
+                                       std::vector<mx_uint>>> &inputs) {
+    std::vector<const char *> names;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> data;
+    for (const auto &kv : inputs) {
+      names.push_back(kv.first.c_str());
+      for (auto d : kv.second) data.push_back(d);
+      indptr.push_back(static_cast<mx_uint>(data.size()));
+    }
+    Check(MXExecutorSimpleBind(sym.handle(), dev_type, dev_id,
+                               grad_req.c_str(),
+                               static_cast<mx_uint>(names.size()),
+                               names.data(), indptr.data(), data.data(),
+                               &h_),
+          "SimpleBind");
+  }
+  Executor(Executor &&o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  Executor(const Executor &) = delete;
+  ~Executor() {
+    if (h_ != nullptr) MXExecutorFree(h_);
+  }
+
+  void Forward(bool is_train) {
+    Check(MXExecutorForward(h_, is_train ? 1 : 0), "Forward");
+  }
+  void Backward() { Check(MXExecutorBackward(h_), "Backward"); }
+  NDArray Arg(const std::string &name) {
+    NDArrayHandle a;
+    Check(MXExecutorArg(h_, name.c_str(), &a), "Arg");
+    return NDArray(a);
+  }
+  NDArray Grad(const std::string &name) {
+    NDArrayHandle g;
+    Check(MXExecutorGrad(h_, name.c_str(), &g), "Grad");
+    return NDArray(g);
+  }
+  NDArray Output(mx_uint i) {
+    NDArrayHandle o;
+    Check(MXExecutorOutput(h_, i, &o), "Output");
+    return NDArray(o);
+  }
+
+ private:
+  ExecutorHandle h_ = nullptr;
+};
+
+class KVStore {
+ public:
+  explicit KVStore(const std::string &type = "local") {
+    Check(MXKVStoreCreate(type.c_str(), &h_), "KVStoreCreate");
+  }
+  KVStore(const KVStore &) = delete;
+  ~KVStore() {
+    if (h_ != nullptr) MXKVStoreFree(h_);
+  }
+  void SetOptimizer(const std::string &name, float lr, float wd = 0.0f,
+                    float momentum = 0.0f, float rescale = 1.0f) {
+    Check(MXKVStoreSetOptimizer(h_, name.c_str(), lr, wd, momentum,
+                                rescale),
+          "SetOptimizer");
+  }
+  void Init(const std::string &key, const NDArray &v) {
+    Check(MXKVStoreInit(h_, key.c_str(), v.handle()), "KVStoreInit");
+  }
+  void Push(const std::string &key, const NDArray &v) {
+    Check(MXKVStorePush(h_, key.c_str(), v.handle()), "KVStorePush");
+  }
+  void Pull(const std::string &key, NDArray *out) {
+    Check(MXKVStorePull(h_, key.c_str(), out->handle()), "KVStorePull");
+  }
+
+ private:
+  KVStoreHandle h_ = nullptr;
+};
+
+inline void WaitAll() { Check(MXNDArrayWaitAll(), "WaitAll"); }
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_MXTPU_CPP_HPP_

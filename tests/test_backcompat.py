@@ -1,0 +1,48 @@
+"""Serialization back-compat gate: committed v1 golden artifacts must keep
+loading bit-for-bit (model: the reference's versioned fixtures —
+tests/python/unittest/legacy_ndarray.v0, save_000800.json loaded in
+test_module.py). Any format change must remain able to READ these."""
+import os
+
+import numpy as np
+
+import mxtpu as mx
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIX = os.path.join(HERE, "fixtures")
+
+
+def test_ndarray_v1_fixture_loads():
+    loaded = mx.nd.load(os.path.join(FIX, "ndarray_v1.params"))
+    want = np.load(os.path.join(FIX, "ndarray_v1_expected.npz"))
+    assert set(loaded) == set(want.files)
+    for k in want.files:
+        got = loaded[k].asnumpy()
+        np.testing.assert_array_equal(got, want[k])
+        assert str(loaded[k].dtype) == str(want[k].dtype)
+
+
+def test_module_v1_checkpoint_loads_and_predicts():
+    prefix = os.path.join(FIX, "module_v1")
+    sym, args, aux = mx.model.load_checkpoint(prefix, 1)
+    assert "fc_weight" in args
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 3))], for_training=False)
+    mod.set_params(args, aux, allow_missing=True)
+    x = np.load(os.path.join(FIX, "module_v1_input.npy"))
+    want = np.load(os.path.join(FIX, "module_v1_expected.npy"))
+    mod.forward(mx.io.DataBatch(data=[mx.nd.array(x)], label=None),
+                is_train=False)
+    np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(), want,
+                               rtol=1e-6)
+
+
+def test_module_load_api_on_v1_checkpoint():
+    mod = mx.mod.Module.load(os.path.join(FIX, "module_v1"), 1)
+    mod.bind(data_shapes=[("data", (2, 3))], for_training=False)
+    x = np.load(os.path.join(FIX, "module_v1_input.npy"))
+    mod.forward(mx.io.DataBatch(data=[mx.nd.array(x)], label=None),
+                is_train=False)
+    want = np.load(os.path.join(FIX, "module_v1_expected.npy"))
+    np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(), want,
+                               rtol=1e-6)

@@ -66,3 +66,47 @@ def test_c_client_trains_mlp(tmp_path):
     assert line, out.stdout
     acc = float(line[0].split()[1])
     assert acc > 0.9, "C-ABI training reached only %.3f" % acc
+
+
+def test_cpp_package_trains_mlp(tmp_path):
+    """Header-only C++ API (cpp-package/include/mxtpu-cpp) trains the same
+    MLP: the reference's cpp-package role on this ABI."""
+    ok, log = _build()
+    if not ok:
+        pytest.skip("libmxtpu_capi.so did not build: %s" % log[-400:])
+
+    import mxtpu as mx
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    sym_path = str(tmp_path / "mlp.json")
+    net.save(sym_path)
+    rng = np.random.RandomState(0)
+    n, dim, classes = 256, 16, 4
+    centers = rng.randn(classes, dim) * 3
+    y = rng.randint(0, classes, n)
+    X = (centers[y] + rng.randn(n, dim)).astype("float32")
+    (tmp_path / "data.bin").write_bytes(X.tobytes())
+    (tmp_path / "labels.bin").write_bytes(y.astype("float32").tobytes())
+
+    exe = str(tmp_path / "train_mlp")
+    src = os.path.join(REPO, "cpp-package", "example", "train_mlp.cpp")
+    r = subprocess.run(
+        ["g++", "-std=c++17",
+         "-I", os.path.join(REPO, "cpp-package", "include"),
+         "-I", os.path.join(REPO, "src", "capi"), src, "-o", exe,
+         "-L", os.path.dirname(CAPI_SO), "-lmxtpu_capi",
+         "-Wl,-rpath," + os.path.dirname(CAPI_SO)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    out = subprocess.run(
+        [exe, sym_path, str(tmp_path / "data.bin"),
+         str(tmp_path / "labels.bin"), str(n), str(dim), str(classes)],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    acc = float([ln for ln in out.stdout.splitlines()
+                 if "ACCURACY" in ln][0].split()[1])
+    assert acc > 0.9, "C++ training reached only %.3f" % acc
