@@ -167,3 +167,53 @@ def test_profiler_chrome_trace(tmp_path):
     events = trace["traceEvents"] if isinstance(trace, dict) else trace
     names = {e.get("name") for e in events}
     assert "stage_a" in names and "stage_b" in names
+
+
+def test_native_im2rec_roundtrip(tmp_path):
+    """The C++ packer's output reads back through MXIndexedRecordIO and
+    ImageRecordIter (tools/im2rec.cc, role of the reference's C++ tool)."""
+    import subprocess
+    import numpy as np
+    import mxtpu as mx
+    from mxtpu import recordio
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    exe = os.path.join(repo, "tools", "im2rec")
+    if not os.path.exists(exe):
+        r = subprocess.run(["make", "-C", os.path.join(repo, "tools"),
+                            "im2rec"], capture_output=True, text=True)
+        if not os.path.exists(exe):
+            import pytest
+            pytest.skip("im2rec did not build: %s" % r.stderr[-300:])
+
+    # source images + .lst
+    import cv2
+    rng = np.random.RandomState(0)
+    img_dir = tmp_path / "imgs"
+    img_dir.mkdir()
+    lst = []
+    for i in range(12):
+        img = rng.randint(0, 255, (40 + i, 52, 3), dtype=np.uint8)
+        cv2.imwrite(str(img_dir / ("im%d.png" % i)), img)
+        lst.append("%d\t%d\tim%d.png" % (i, i % 3, i))
+    (tmp_path / "all.lst").write_text("\n".join(lst) + "\n")
+
+    out_prefix = str(tmp_path / "packed")
+    r = subprocess.run([exe, str(tmp_path / "all.lst"), str(img_dir),
+                        out_prefix, "--resize", "32", "--num-thread", "2"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    rec = recordio.MXIndexedRecordIO(out_prefix + ".idx",
+                                     out_prefix + ".rec", "r")
+    assert len(rec.keys) == 12
+    hdr, img = recordio.unpack_img(rec.read_idx(5))
+    assert hdr.label == 5 % 3 and hdr.id == 5
+    assert min(img.shape[:2]) == 32  # shorter side resized
+
+    it = mx.io.ImageRecordIter(path_imgrec=out_prefix + ".rec",
+                               path_imgidx=out_prefix + ".idx",
+                               data_shape=(3, 24, 24), batch_size=4,
+                               shuffle=True, rand_crop=True)
+    batches = sum(1 for _ in it)
+    assert batches == 3

@@ -1,0 +1,210 @@
+// Native im2rec: pack an image list into .rec/.idx at full speed.
+//
+// Role parity: the reference's C++ packer (tools/im2rec.cc) — the
+// high-throughput path for preparing ImageNet-scale recordio datasets,
+// with multi-threaded decode/resize/encode via OpenCV and the native
+// recordio writer (src/core/recordio.cc, same on-disk format as
+// mxtpu/recordio.py).
+//
+// .lst line: <index>\t<label>\t<relative/path>
+// Usage: im2rec <list.lst> <image_root> <out_prefix>
+//          [--resize N] [--quality Q] [--pass-through]
+//          [--num-thread T] [--center-crop]
+// Build: see tools/Makefile (pkg-config opencv4).
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <map>
+#include <mutex>
+#include <queue>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <opencv2/imgcodecs.hpp>
+#include <opencv2/imgproc.hpp>
+
+#include "../src/core/recordio.h"
+
+namespace {
+
+#pragma pack(push, 1)
+struct IRHeader {          // matches mxtpu/recordio.py _IR_FORMAT "IfQQ"
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+};
+#pragma pack(pop)
+
+struct Task {
+  uint64_t seq;            // output-order key (keeps .rec deterministic)
+  uint64_t id;             // index from the .lst
+  float label;
+  std::string path;
+};
+
+struct Packed {
+  uint64_t id;
+  std::string payload;     // IRHeader + encoded image
+};
+
+struct Options {
+  int resize = 0;          // shorter side -> N (0: keep)
+  int quality = 95;
+  bool pass_through = false;
+  bool center_crop = false;
+  int num_thread = static_cast<int>(
+      std::max(1u, std::thread::hardware_concurrency()));
+};
+
+std::string EncodeOne(const Task &t, const Options &opt) {
+  std::string bytes;
+  {
+    std::ifstream f(t.path, std::ios::binary);
+    if (!f) throw std::runtime_error("cannot read " + t.path);
+    std::ostringstream ss;
+    ss << f.rdbuf();
+    bytes = ss.str();
+  }
+  if (!opt.pass_through) {
+    std::vector<uint8_t> raw(bytes.begin(), bytes.end());
+    cv::Mat img = cv::imdecode(raw, cv::IMREAD_COLOR);
+    if (img.empty()) throw std::runtime_error("cannot decode " + t.path);
+    if (opt.resize > 0) {
+      const int s = std::min(img.rows, img.cols);
+      const double f = static_cast<double>(opt.resize) / s;
+      cv::resize(img, img, cv::Size(), f, f,
+                 f < 1.0 ? cv::INTER_AREA : cv::INTER_LINEAR);
+    }
+    if (opt.center_crop && img.rows != img.cols) {
+      const int s = std::min(img.rows, img.cols);
+      const int y0 = (img.rows - s) / 2, x0 = (img.cols - s) / 2;
+      img = img(cv::Rect(x0, y0, s, s)).clone();
+    }
+    std::vector<uint8_t> enc;
+    cv::imencode(".jpg", img, enc,
+                 {cv::IMWRITE_JPEG_QUALITY, opt.quality});
+    bytes.assign(enc.begin(), enc.end());
+  }
+  IRHeader hdr{0, t.label, t.id, 0};
+  std::string payload(sizeof(hdr) + bytes.size(), '\0');
+  std::memcpy(&payload[0], &hdr, sizeof(hdr));
+  std::memcpy(&payload[sizeof(hdr)], bytes.data(), bytes.size());
+  return payload;
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    std::cerr << "usage: " << argv[0]
+              << " list.lst image_root out_prefix [--resize N]"
+                 " [--quality Q] [--pass-through] [--num-thread T]"
+                 " [--center-crop]\n";
+    return 2;
+  }
+  const std::string lst_path = argv[1];
+  std::string root = argv[2];
+  const std::string prefix = argv[3];
+  if (!root.empty() && root.back() != '/') root += '/';
+  Options opt;
+  for (int i = 4; i < argc; ++i) {
+    std::string a = argv[i];
+    if (a == "--resize" && i + 1 < argc) opt.resize = std::atoi(argv[++i]);
+    else if (a == "--quality" && i + 1 < argc)
+      opt.quality = std::atoi(argv[++i]);
+    else if (a == "--pass-through") opt.pass_through = true;
+    else if (a == "--center-crop") opt.center_crop = true;
+    else if (a == "--num-thread" && i + 1 < argc)
+      opt.num_thread = std::max(1, std::atoi(argv[++i]));
+  }
+
+  // read the list
+  std::vector<Task> tasks;
+  {
+    std::ifstream lst(lst_path);
+    if (!lst) {
+      std::cerr << "cannot open " << lst_path << "\n";
+      return 2;
+    }
+    std::string line;
+    uint64_t seq = 0;
+    while (std::getline(lst, line)) {
+      if (line.empty()) continue;
+      std::istringstream ss(line);
+      Task t;
+      std::string path;
+      ss >> t.id >> t.label >> path;
+      if (path.empty()) continue;
+      t.path = root + path;
+      t.seq = seq++;
+      tasks.push_back(std::move(t));
+    }
+  }
+
+  // parallel encode, ordered write (the reference packer's shape:
+  // worker pool + sequential committer keeps the .rec deterministic)
+  mxtpu::RecordWriter writer(prefix + ".rec");
+  std::ofstream fidx(prefix + ".idx");
+  std::mutex mu;
+  std::condition_variable cv_done;
+  std::map<uint64_t, Packed> ready;
+  std::atomic<uint64_t> next_task{0};
+  std::atomic<bool> failed{false};
+  uint64_t write_seq = 0;
+  std::string err;
+
+  auto worker = [&]() {
+    for (;;) {
+      uint64_t i = next_task.fetch_add(1);
+      if (i >= tasks.size() || failed.load()) return;
+      try {
+        Packed p{tasks[i].id, EncodeOne(tasks[i], opt)};
+        std::lock_guard<std::mutex> lk(mu);
+        ready.emplace(tasks[i].seq, std::move(p));
+        cv_done.notify_one();
+      } catch (const std::exception &e) {
+        std::lock_guard<std::mutex> lk(mu);
+        err = e.what();
+        failed.store(true);
+        cv_done.notify_one();
+        return;
+      }
+    }
+  };
+  std::vector<std::thread> pool;
+  for (int i = 0; i < opt.num_thread; ++i) pool.emplace_back(worker);
+
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    while (write_seq < tasks.size() && !failed.load()) {
+      cv_done.wait(lk, [&] {
+        return failed.load() || ready.count(write_seq) > 0;
+      });
+      if (failed.load()) break;
+      auto it = ready.find(write_seq);
+      Packed p = std::move(it->second);
+      ready.erase(it);
+      lk.unlock();
+      uint64_t pos = writer.Tell();
+      writer.Write(p.payload.data(), p.payload.size());
+      fidx << p.id << "\t" << pos << "\n";
+      lk.lock();
+      ++write_seq;
+    }
+  }
+  for (auto &t : pool) t.join();
+  if (failed.load()) {
+    std::cerr << "im2rec failed: " << err << "\n";
+    return 1;
+  }
+  writer.Flush();
+  std::cout << "packed " << tasks.size() << " records to " << prefix
+            << ".rec\n";
+  return 0;
+}
