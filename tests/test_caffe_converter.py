@@ -1,0 +1,154 @@
+"""Caffe converter (tools/caffe_converter.py): prototxt parsing, wire-format
+weight extraction, symbol building, and a numeric end-to-end check against
+a hand-computed conv+fc forward. Role parity: the reference's
+tools/caffe_converter test_converter.py flow, offline."""
+import os
+import struct
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import caffe_converter as cc  # noqa: E402
+
+PROTOTXT = """
+name: "TinyNet"
+input: "data"
+input_dim: 1
+input_dim: 2
+input_dim: 6
+input_dim: 6
+layer {
+  name: "conv1"
+  type: "Convolution"
+  bottom: "data"
+  top: "conv1"
+  convolution_param { num_output: 3 kernel_size: 3 stride: 1 pad: 1 }
+}
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer {
+  name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "fc1" type: "InnerProduct" bottom: "pool1" top: "fc1"
+  inner_product_param { num_output: 4 }
+}
+layer { name: "prob" type: "Softmax" bottom: "fc1" top: "prob" }
+"""
+
+
+# -- minimal protobuf wire ENCODER (test-side) ------------------------------
+def _varint(v):
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _field(num, wire, payload):
+    return _varint(num << 3 | wire) + payload
+
+
+def _ld(num, payload):
+    return _field(num, 2, _varint(len(payload)) + payload)
+
+
+def _blob(arr):
+    arr = np.asarray(arr, "<f4")
+    shape = b"".join(_varint(int(d)) for d in arr.shape)
+    return _ld(7, _ld(1, shape)) + _ld(5, arr.tobytes())
+
+
+def _layer(name, blobs):
+    body = _ld(1, name.encode())
+    for b in blobs:
+        body += _ld(7, _blob(b))
+    return _ld(100, body)
+
+
+def test_prototxt_parser():
+    net = cc.parse_prototxt(PROTOTXT)
+    assert net["name"] == "TinyNet"
+    assert net["input_dim"] == [1, 2, 6, 6]
+    layers = net["layer"]
+    assert [l["type"] for l in layers] == [
+        "Convolution", "ReLU", "Pooling", "InnerProduct", "Softmax"]
+    assert layers[0]["convolution_param"]["num_output"] == 3
+
+
+def test_convert_and_run(tmp_path):
+    import mxtpu as mx
+
+    rng = np.random.RandomState(0)
+    w_conv = rng.randn(3, 2, 3, 3).astype("float32") * 0.3
+    b_conv = rng.randn(3).astype("float32") * 0.1
+    w_fc = rng.randn(4, 3 * 3 * 3).astype("float32") * 0.2
+    b_fc = rng.randn(4).astype("float32") * 0.1
+
+    model = (_layer("conv1", [w_conv, b_conv]) +
+             _layer("fc1", [w_fc, b_fc]))
+    mpath = str(tmp_path / "net.caffemodel")
+    open(mpath, "wb").write(model)
+
+    sym, args, aux = cc.convert_model(PROTOTXT, mpath)
+    assert set(args) == {"conv1_weight", "conv1_bias", "fc1_weight",
+                        "fc1_bias"}
+    np.testing.assert_array_equal(args["conv1_weight"].asnumpy(), w_conv)
+
+    x = rng.randn(1, 2, 6, 6).astype("float32")
+    mod = mx.mod.Module(sym, context=mx.cpu(),
+                        label_names=[n for n in sym.list_arguments()
+                                     if n.endswith("label")] or None)
+    mod.bind(data_shapes=[("data", (1, 2, 6, 6))], for_training=False)
+    mod.set_params(args, aux, allow_missing=True)
+    mod.forward(mx.io.DataBatch(data=[mx.nd.array(x)], label=None),
+                is_train=False)
+    got = mod.get_outputs()[0].asnumpy()
+
+    # numpy oracle: conv(pad1) -> relu -> maxpool2 -> fc -> softmax
+    from numpy.lib.stride_tricks import sliding_window_view
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    win = sliding_window_view(xp[0], (3, 3), axis=(1, 2))  # (2, 6, 6, 3, 3)
+    conv = np.einsum("chwij,ocij->ohw", win, w_conv) + b_conv[:, None, None]
+    relu = np.maximum(conv, 0)
+    pool = relu.reshape(3, 3, 2, 3, 2).max(axis=(2, 4))
+    fc = w_fc @ pool.reshape(-1) + b_fc
+    e = np.exp(fc - fc.max())
+    want = (e / e.sum())[None]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_scale_folding(tmp_path):
+    proto = """
+    input: "data"
+    input_dim: 1
+    input_dim: 2
+    input_dim: 4
+    input_dim: 4
+    layer { name: "bn" type: "BatchNorm" bottom: "data" top: "bn" }
+    layer { name: "sc" type: "Scale" bottom: "bn" top: "bn"
+            scale_param { bias_term: true } }
+    layer { name: "relu" type: "ReLU" bottom: "bn" top: "out" }
+    """
+    mean = np.array([0.5, -0.5], "float32")
+    var = np.array([4.0, 1.0], "float32")
+    factor = np.array([2.0], "float32")  # caffe stores scaled stats
+    gamma = np.array([1.5, 0.5], "float32")
+    beta = np.array([0.1, -0.1], "float32")
+    model = (_layer("bn", [mean * 2, var * 2, factor]) +
+             _layer("sc", [gamma, beta]))
+    mpath = str(tmp_path / "bn.caffemodel")
+    open(mpath, "wb").write(model)
+
+    sym, args, aux = cc.convert_model(proto, mpath)
+    np.testing.assert_allclose(aux["bn_moving_mean"].asnumpy(), mean)
+    np.testing.assert_allclose(aux["bn_moving_var"].asnumpy(), var)
+    np.testing.assert_allclose(args["bn_gamma"].asnumpy(), gamma)
+    np.testing.assert_allclose(args["bn_beta"].asnumpy(), beta)

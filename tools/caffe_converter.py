@@ -1,0 +1,397 @@
+#!/usr/bin/env python
+"""Caffe model converter: prototxt + .caffemodel -> mxtpu symbol + params.
+
+Role parity: the reference's tools/caffe_converter (convert_symbol.py /
+convert_model.py) — migrate Caffe-zoo models into the framework. Fresh
+implementation: a recursive-descent parser for the prototxt text format and
+a minimal protobuf wire-format reader for the weight blobs (schema
+constants from caffe.proto: NetParameter.layer=100, LayerParameter
+name=1/type=2/bottom=3/top=4/blobs=7, BlobProto shape=7/data=5 packed,
+BlobShape.dim=1).
+
+Supported layers: Input/Data, Convolution, Deconvolution, Pooling,
+InnerProduct, ReLU, Sigmoid, TanH, LRN, Dropout, Softmax(WithLoss),
+Concat, Eltwise, Flatten, BatchNorm(+Scale folding).
+
+Usage:
+  python tools/caffe_converter.py deploy.prototxt [net.caffemodel] out_prefix
+Writes out_prefix-symbol.json (+ out_prefix-0000.params with weights).
+"""
+import json
+import os
+import struct
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# --------------------------------------------------------------- prototxt
+def parse_prototxt(text):
+    """Parse protobuf text format into a dict; repeated keys -> lists."""
+    pos = [0]
+    n = len(text)
+
+    def skip_ws():
+        while pos[0] < n:
+            c = text[pos[0]]
+            if c == "#":
+                while pos[0] < n and text[pos[0]] != "\n":
+                    pos[0] += 1
+            elif c.isspace():
+                pos[0] += 1
+            else:
+                break
+
+    def token():
+        skip_ws()
+        start = pos[0]
+        while pos[0] < n and (text[pos[0]].isalnum() or
+                              text[pos[0]] in "_.-+"):
+            pos[0] += 1
+        return text[start:pos[0]]
+
+    def value():
+        skip_ws()
+        c = text[pos[0]]
+        if c == '"' or c == "'":
+            q = c
+            pos[0] += 1
+            start = pos[0]
+            while pos[0] < n and text[pos[0]] != q:
+                pos[0] += 1
+            v = text[start:pos[0]]
+            pos[0] += 1
+            return v
+        tok = token()
+        if tok in ("true", "false"):
+            return tok == "true"
+        try:
+            return int(tok)
+        except ValueError:
+            try:
+                return float(tok)
+            except ValueError:
+                return tok
+
+    def message():
+        out = {}
+        while True:
+            skip_ws()
+            if pos[0] >= n or text[pos[0]] == "}":
+                if pos[0] < n:
+                    pos[0] += 1
+                return out
+            key = token()
+            if not key:
+                raise ValueError("parse error at %d: %r" %
+                                 (pos[0], text[pos[0]:pos[0] + 20]))
+            skip_ws()
+            if text[pos[0]] == ":":
+                pos[0] += 1
+                v = value()
+            elif text[pos[0]] == "{":
+                pos[0] += 1
+                v = message()
+            else:
+                raise ValueError("expected ':' or '{' after %s" % key)
+            if key in out:
+                if not isinstance(out[key], list):
+                    out[key] = [out[key]]
+                out[key].append(v)
+            else:
+                out[key] = v
+    return message()
+
+
+def _as_list(v):
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+# ------------------------------------------------- caffemodel wire format
+def _read_varint(buf, i):
+    val, shift = 0, 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def _iter_fields(buf):
+    """Yield (field_no, wire_type, value) over a protobuf message body."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        key, i = _read_varint(buf, i)
+        field, wt = key >> 3, key & 7
+        if wt == 0:            # varint
+            v, i = _read_varint(buf, i)
+        elif wt == 1:          # 64-bit
+            v = buf[i:i + 8]
+            i += 8
+        elif wt == 2:          # length-delimited
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:          # 32-bit
+            v = buf[i:i + 4]
+            i += 4
+        else:
+            raise ValueError("unsupported wire type %d" % wt)
+        yield field, wt, v
+
+
+def parse_caffemodel(path):
+    """-> {layer_name: [numpy blobs]} (new 'layer'=100 and V1 'layers'=2)."""
+    import numpy as np
+
+    with open(path, "rb") as f:
+        buf = f.read()
+    weights = {}
+    for field, wt, v in _iter_fields(buf):
+        if field not in (100, 2) or wt != 2:
+            continue
+        name, blobs = None, []
+        for lf, lwt, lv in _iter_fields(v):
+            if lf == 1 and lwt == 2:
+                name = lv.decode("utf-8", "replace")
+            elif lf in (7, 6) and lwt == 2:
+                # blobs: field 7 in LayerParameter, 6 in V1LayerParameter
+                shape, data = [], None
+                legacy = {}
+                for bf, bwt, bv in _iter_fields(lv):
+                    if bf == 7 and bwt == 2:        # BlobShape message
+                        for sf, swt, sv in _iter_fields(bv):
+                            if sf != 1:
+                                continue
+                            if swt == 2:            # packed dims
+                                j = 0
+                                while j < len(sv):
+                                    d, j = _read_varint(sv, j)
+                                    shape.append(d)
+                            elif swt == 0:          # unpacked dim
+                                shape.append(sv)
+                    elif bf == 5:                   # packed float data
+                        if bwt == 2:
+                            data = np.frombuffer(bv, dtype="<f4")
+                        else:
+                            data = np.frombuffer(bytes(bv), dtype="<f4")
+                    elif bf in (1, 2, 3, 4) and bwt == 0:
+                        legacy[bf] = bv
+                if data is None:
+                    continue
+                if not shape and legacy:
+                    shape = [legacy.get(k, 1) for k in (1, 2, 3, 4)]
+                blobs.append(data.reshape(shape) if shape else data)
+        if name and blobs:
+            weights[name] = blobs
+    return weights
+
+
+# ---------------------------------------------------------- symbol build
+def _conv_attrs(p):
+    k = p.get("kernel_size", p.get("kernel_h", 1))
+    kh = p.get("kernel_h", k)
+    kw = p.get("kernel_w", k)
+    s = p.get("stride", p.get("stride_h", 1))
+    sh, sw = p.get("stride_h", s), p.get("stride_w", s)
+    pd = p.get("pad", p.get("pad_h", 0))
+    ph, pw = p.get("pad_h", pd), p.get("pad_w", pd)
+    return {"kernel": (int(kh), int(kw)), "stride": (int(sh), int(sw)),
+            "pad": (int(ph), int(pw))}
+
+
+def convert_symbol(prototxt_text):
+    """-> (mxtpu Symbol, input_name, input_dim list)."""
+    import mxtpu as mx
+
+    net = parse_prototxt(prototxt_text)
+    layers = _as_list(net.get("layer") or net.get("layers"))
+    if "input_dim" in net:
+        input_dim = _as_list(net["input_dim"])
+        input_name = _as_list(net.get("input", ["data"]))[0]
+    elif "input_shape" in net:
+        input_dim = _as_list(net["input_shape"]["dim"])
+        input_name = _as_list(net.get("input", ["data"]))[0]
+    elif layers and layers[0].get("type") == "Input":
+        input_dim = _as_list(layers[0]["input_param"]["shape"]["dim"])
+        input_name = _as_list(layers[0]["top"])[0]
+        layers = layers[1:]
+    else:
+        raise ValueError("cannot determine network input")
+
+    blobs = {input_name: mx.sym.Variable(input_name)}
+
+    def top_of(layer, out):
+        for t in _as_list(layer.get("top", [])):
+            blobs[t] = out
+
+    for layer in layers:
+        ltype = str(layer.get("type"))
+        name = layer.get("name", ltype)
+        bottoms = [blobs[b] for b in _as_list(layer.get("bottom", []))
+                   if b in blobs]
+        if ltype in ("Data", "ImageData", "HDF5Data", "Accuracy", "Silence"):
+            continue
+        if ltype == "Convolution":
+            p = layer.get("convolution_param", {})
+            a = _conv_attrs(p)
+            out = mx.sym.Convolution(
+                bottoms[0], name=name, num_filter=int(p["num_output"]),
+                num_group=int(p.get("group", 1)),
+                no_bias=not p.get("bias_term", True), **a)
+        elif ltype == "Deconvolution":
+            p = layer.get("convolution_param", {})
+            a = _conv_attrs(p)
+            out = mx.sym.Deconvolution(
+                bottoms[0], name=name, num_filter=int(p["num_output"]),
+                no_bias=not p.get("bias_term", True),
+                kernel=a["kernel"], stride=a["stride"], pad=a["pad"])
+        elif ltype == "Pooling":
+            p = layer.get("pool_param", layer.get("pooling_param", {}))
+            pool = {0: "max", 1: "avg", "MAX": "max", "AVE": "avg"}.get(
+                p.get("pool", "MAX"), "max")
+            if p.get("global_pooling"):
+                out = mx.sym.Pooling(bottoms[0], name=name, global_pool=True,
+                                     pool_type=pool, kernel=(1, 1))
+            else:
+                a = _conv_attrs(p)
+                out = mx.sym.Pooling(bottoms[0], name=name, pool_type=pool,
+                                     pooling_convention="full", **a)
+        elif ltype == "InnerProduct":
+            p = layer.get("inner_product_param", {})
+            out = mx.sym.FullyConnected(
+                bottoms[0], name=name, num_hidden=int(p["num_output"]),
+                no_bias=not p.get("bias_term", True))
+        elif ltype == "ReLU":
+            out = mx.sym.Activation(bottoms[0], name=name, act_type="relu")
+        elif ltype == "Sigmoid":
+            out = mx.sym.Activation(bottoms[0], name=name,
+                                    act_type="sigmoid")
+        elif ltype == "TanH":
+            out = mx.sym.Activation(bottoms[0], name=name, act_type="tanh")
+        elif ltype == "LRN":
+            p = layer.get("lrn_param", {})
+            out = mx.sym.LRN(bottoms[0], name=name,
+                             alpha=float(p.get("alpha", 1e-4)),
+                             beta=float(p.get("beta", 0.75)),
+                             knorm=float(p.get("k", 2.0)),
+                             nsize=int(p.get("local_size", 5)))
+        elif ltype == "Dropout":
+            p = layer.get("dropout_param", {})
+            out = mx.sym.Dropout(bottoms[0], name=name,
+                                 p=float(p.get("dropout_ratio", 0.5)))
+        elif ltype in ("Softmax", "SoftmaxWithLoss"):
+            out = mx.sym.SoftmaxOutput(bottoms[0], name=name)
+        elif ltype == "Concat":
+            p = layer.get("concat_param", {})
+            out = mx.sym.Concat(*bottoms, name=name,
+                                dim=int(p.get("axis", 1)))
+        elif ltype == "Eltwise":
+            p = layer.get("eltwise_param", {})
+            op = {0: "prod", 1: "sum", 2: "max", "PROD": "prod",
+                  "SUM": "sum", "MAX": "max"}.get(
+                      p.get("operation", "SUM"), "sum")
+            out = bottoms[0]
+            for b in bottoms[1:]:
+                if op == "sum":
+                    out = mx.sym.elemwise_add(out, b)
+                elif op == "prod":
+                    out = mx.sym.elemwise_mul(out, b)
+                else:
+                    out = mx.sym._maximum(out, b)
+        elif ltype == "Flatten":
+            out = mx.sym.Flatten(bottoms[0], name=name)
+        elif ltype == "BatchNorm":
+            out = mx.sym.BatchNorm(bottoms[0], name=name, fix_gamma=True,
+                                   use_global_stats=True, eps=1e-5)
+        elif ltype == "Scale":
+            # Scale after BatchNorm folds into the BN's gamma/beta; the
+            # symbol stays the BN output and convert_model maps weights
+            out = bottoms[0]
+        else:
+            raise ValueError("unsupported caffe layer type %r" % ltype)
+        top_of(layer, out)
+
+    last = _as_list(layers[-1].get("top", []))[-1]
+    return blobs[last], input_name, [int(d) for d in input_dim]
+
+
+def convert_model(prototxt_text, caffemodel_path):
+    """-> (symbol, arg_params, aux_params)."""
+    import numpy as np
+
+    import mxtpu as mx
+
+    sym, input_name, input_dim = convert_symbol(prototxt_text)
+    weights = parse_caffemodel(caffemodel_path)
+    net = parse_prototxt(prototxt_text)
+    layers = _as_list(net.get("layer") or net.get("layers"))
+    arg_params, aux_params = {}, {}
+    bn_gamma_beta = {}  # bn layer name -> (gamma, beta) from Scale
+    bn_of_scale = {}
+    prev_bn = None
+    for layer in layers:
+        lt = str(layer.get("type"))
+        nm = layer.get("name", lt)
+        if lt == "BatchNorm":
+            prev_bn = nm
+        elif lt == "Scale" and prev_bn is not None:
+            bn_of_scale[nm] = prev_bn
+            prev_bn = None
+
+    for name, blobs in weights.items():
+        spec = next((l for l in layers if l.get("name") == name), {})
+        lt = str(spec.get("type", ""))
+        if lt in ("Convolution", "Deconvolution", "InnerProduct"):
+            arg_params["%s_weight" % name] = mx.nd.array(
+                np.asarray(blobs[0], "float32"))
+            if len(blobs) > 1:
+                arg_params["%s_bias" % name] = mx.nd.array(
+                    np.asarray(blobs[1], "float32").reshape(-1))
+        elif lt == "BatchNorm":
+            scale = float(blobs[2].reshape(-1)[0]) if len(blobs) > 2 else 1.0
+            scale = 1.0 / scale if scale else 0.0
+            aux_params["%s_moving_mean" % name] = mx.nd.array(
+                np.asarray(blobs[0], "float32").reshape(-1) * scale)
+            aux_params["%s_moving_var" % name] = mx.nd.array(
+                np.asarray(blobs[1], "float32").reshape(-1) * scale)
+        elif lt == "Scale":
+            bn = bn_of_scale.get(name)
+            if bn is not None:
+                bn_gamma_beta[bn] = (np.asarray(blobs[0], "float32"),
+                                     np.asarray(blobs[1], "float32")
+                                     if len(blobs) > 1 else None)
+    for bn, (gamma, beta) in bn_gamma_beta.items():
+        arg_params["%s_gamma" % bn] = mx.nd.array(gamma.reshape(-1))
+        if beta is not None:
+            arg_params["%s_beta" % bn] = mx.nd.array(beta.reshape(-1))
+    return sym, arg_params, aux_params
+
+
+def main():
+    if len(sys.argv) < 3:
+        print(__doc__)
+        return 2
+    prototxt = open(sys.argv[1]).read()
+    if len(sys.argv) >= 4:
+        model_path, prefix = sys.argv[2], sys.argv[3]
+        sym, args, aux = convert_model(prototxt, model_path)
+    else:
+        prefix = sys.argv[2]
+        sym, _, _ = convert_symbol(prototxt)
+        args, aux = {}, {}
+    sym.save(prefix + "-symbol.json")
+    if args or aux:
+        import mxtpu as mx
+        mx.model.save_checkpoint(prefix, 0, sym, args, aux)
+    print("converted ->", prefix)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
