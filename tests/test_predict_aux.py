@@ -217,3 +217,37 @@ def test_native_im2rec_roundtrip(tmp_path):
                                shuffle=True, rand_crop=True)
     batches = sum(1 for _ in it)
     assert batches == 3
+
+
+def test_torch_module_differentiable():
+    """TorchModule: torch.nn blocks run on NDArrays with torch-autograd
+    backward (plugin/torch torch_module role), numerically checked."""
+    import numpy as np
+    import torch
+    import mxtpu as mx
+
+    lin = torch.nn.Linear(3, 2)
+    with torch.no_grad():
+        lin.weight.copy_(torch.arange(6.).reshape(2, 3))
+        lin.bias.zero_()
+    mod = mx.th.TorchModule(lin)
+    x = mx.nd.array(np.ones((4, 3), "float32"))
+    out = mod(x)
+    want = np.ones((4, 3)) @ np.arange(6.).reshape(2, 3).T
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-6)
+    (gx,) = mod.backward()
+    # d(sum(Wx))/dx = column sums of W, broadcast over the batch
+    np.testing.assert_allclose(gx.asnumpy(),
+                               np.tile(np.arange(6.).reshape(2, 3)
+                                       .sum(0), (4, 1)), rtol=1e-6)
+
+
+def test_torch_dlpack_zero_copy():
+    import numpy as np
+    import mxtpu as mx
+
+    x = mx.nd.array(np.arange(4.0).astype("float32"))
+    t = mx.th.to_torch(x)
+    assert t.shape == (4,)
+    back = mx.th.from_torch(t + 1)
+    np.testing.assert_allclose(back.asnumpy(), [1, 2, 3, 4])
