@@ -202,3 +202,88 @@ def test_loss_ops_grad_semantics():
     ex.forward(is_train=True)
     ex.backward()
     assert np.allclose(ex.grad_dict["d"].asnumpy(), pred - lab, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# value oracles for ops the gradient sweep skip-lists as "value-tested":
+# linalg family, fft packing, count_sketch, CTC loss.
+
+def test_linalg_value_oracles():
+    rng = np.random.RandomState(0)
+    A = rng.randn(4, 4).astype("float32")
+    spd = A @ A.T + 4 * np.eye(4, dtype="float32")
+    L = nd.linalg_potrf(nd.array(spd)).asnumpy()
+
+    # potri: inverse of spd from its Cholesky factor
+    inv = nd.linalg_potri(nd.array(L)).asnumpy()
+    np.testing.assert_allclose(inv, np.linalg.inv(spd), rtol=1e-3,
+                               atol=1e-4)
+
+    # trsm: L X = B  =>  X = L^-1 B
+    B = rng.randn(4, 3).astype("float32")
+    X = nd.linalg_trsm(nd.array(L), nd.array(B)).asnumpy()
+    np.testing.assert_allclose(L @ X, B, rtol=1e-4, atol=1e-4)
+
+    # sumlogdiag(L) = 0.5 * logdet(spd)
+    sld = nd.linalg_sumlogdiag(nd.array(L)).asnumpy()
+    np.testing.assert_allclose(sld, 0.5 * np.linalg.slogdet(spd)[1],
+                               rtol=1e-4)
+
+    # gelqf: A = L Q with Q orthonormal rows
+    M = rng.randn(3, 5).astype("float32")
+    Q, Lq = nd.linalg_gelqf(nd.array(M))
+    Q, Lq = Q.asnumpy(), Lq.asnumpy()
+    np.testing.assert_allclose(Q @ Q.T, np.eye(3), atol=1e-4)
+    np.testing.assert_allclose(Lq @ Q, M, rtol=1e-3, atol=1e-4)
+
+    # trmm: alpha * op(A) @ B (lower-triangular A)
+    out = nd.linalg_trmm(nd.array(np.tril(A)), nd.array(B)).asnumpy()
+    np.testing.assert_allclose(out, np.tril(A) @ B, rtol=1e-4, atol=1e-4)
+
+    # syrk: A A^T
+    out = nd.linalg_syrk(nd.array(M)).asnumpy()
+    np.testing.assert_allclose(out, M @ M.T, rtol=1e-4, atol=1e-4)
+
+
+def test_fft_ifft_packing_oracle():
+    """contrib.fft packs complex as interleaved re/im on the last axis;
+    ifft returns the unnormalized inverse (reference contrib/fft.cc)."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 8).astype("float32")
+    out = nd.contrib.fft(nd.array(x)).asnumpy()
+    ref = np.fft.fft(x, axis=-1)
+    packed = np.stack([ref.real, ref.imag], axis=-1).reshape(2, 16)
+    np.testing.assert_allclose(out, packed, rtol=1e-4, atol=1e-4)
+
+    back = nd.contrib.ifft(nd.array(out)).asnumpy()
+    np.testing.assert_allclose(back, x * 8, rtol=1e-4, atol=1e-4)
+
+
+def test_count_sketch_oracle():
+    rng = np.random.RandomState(2)
+    n, d, k = 3, 6, 4
+    x = rng.randn(n, d).astype("float32")
+    h = rng.randint(0, k, d).astype("float32")
+    s = rng.choice([-1.0, 1.0], d).astype("float32")
+    out = nd.contrib.count_sketch(nd.array(x), nd.array(h), nd.array(s),
+                                  out_dim=k).asnumpy()
+    want = np.zeros((n, k), "float32")
+    for i in range(d):
+        want[:, int(h[i])] += s[i] * x[:, i]
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+
+def test_ctc_loss_oracle():
+    """CTC nll for a tiny case vs the direct path enumeration.
+    T=2, C=3 (blank=0), label='1': paths for '1' are
+    (1,1), (blank,1), (1,blank) -> p = p1(1)p2(1)+p1(0)p2(1)+p1(1)p2(0)."""
+    logits = np.array([[[0.6, 1.2, -0.4]], [[-0.2, 0.9, 0.1]]], "float32")
+    label = np.array([[1.0]], "float32")
+    out = nd.contrib.CTCLoss(nd.array(logits), nd.array(label)).asnumpy()
+
+    def softmax(v):
+        e = np.exp(v - v.max())
+        return e / e.sum()
+    p1, p2 = softmax(logits[0, 0]), softmax(logits[1, 0])
+    p = p1[1] * p2[1] + p1[0] * p2[1] + p1[1] * p2[0]
+    np.testing.assert_allclose(out[0], -np.log(p), rtol=1e-4)
