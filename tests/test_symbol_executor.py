@@ -173,3 +173,27 @@ def test_attr_scope():
                                       num_hidden=2, name="fc_outer")
     assert inner.attr("__ctx_group__") == "g1"
     assert outer.attr("__ctx_group__") == "g0"
+
+
+def test_visualization_print_summary(capsys):
+    """mx.viz.print_summary renders the layer table (parity test_viz.py)."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, name="c1")
+    net = mx.sym.Activation(net, act_type="relu", name="r1")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=10,
+                                name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mx.visualization.print_summary(net, shape={"data": (1, 3, 8, 8)})
+    out = capsys.readouterr().out
+    assert "c1" in out and "fc" in out
+    assert "Total params" in out
+
+
+def test_visualization_plot_network_graph():
+    """plot_network emits a graphviz dot source naming every layer."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc_viz")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    dot = mx.visualization.plot_network(net, shape={"data": (1, 8)})
+    src = getattr(dot, "source", None) or str(dot)
+    assert "fc_viz" in src
