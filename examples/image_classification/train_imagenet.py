@@ -16,7 +16,9 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 import mxtpu as mx  # noqa: E402
 
 
-def main():
+def main(argv=None):
+    """Returns the steady-state training throughput (img/s) measured by
+    the Speedometer over the final logging window."""
     ap = argparse.ArgumentParser()
     ap.add_argument("--data-train", required=True, help=".rec file")
     ap.add_argument("--data-val", default=None)
@@ -32,7 +34,8 @@ def main():
     ap.add_argument("--model-prefix", default=None)
     ap.add_argument("--epoch-size", type=int, default=0,
                     help="batches per epoch (0 = full pass)")
-    args = ap.parse_args()
+    ap.add_argument("--speedometer-period", type=int, default=20)
+    args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
     shape = tuple(int(x) for x in args.image_shape.split(","))
@@ -71,14 +74,25 @@ def main():
     mod = mx.mod.Module(net, context=mx.test_utils.default_context())
     checkpoint = (mx.callback.do_checkpoint(args.model_prefix)
                   if args.model_prefix and kv.rank == 0 else None)
+    speeds = []
+
+    class _MeterHook(mx.callback.Speedometer):
+        def _emit(self, param, speed):
+            speeds.append(speed)
+            super()._emit(param, speed)
+
     mod.fit(train, eval_data=val, num_epoch=args.num_epochs, kvstore=kv,
             optimizer="sgd",
             optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
                               "wd": 1e-4, "lr_scheduler": lr_sched},
             eval_metric=[mx.metric.Accuracy(),
                          mx.metric.TopKAccuracy(top_k=5)],
-            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20),
+            batch_end_callback=_MeterHook(args.batch_size,
+                                          args.speedometer_period),
             epoch_end_callback=checkpoint)
+    steady = speeds[-1] if speeds else 0.0
+    logging.info("steady-state throughput: %.1f img/s", steady)
+    return steady
 
 
 if __name__ == "__main__":

@@ -32,7 +32,8 @@ def make_rec(path, n, edge=256, seed=0):
     from mxtpu import recordio
 
     rng = np.random.RandomState(seed)
-    rec = recordio.MXIndexedRecordIO(path + ".idx", path, "w")
+    idx_path = os.path.splitext(path)[0] + ".idx"
+    rec = recordio.MXIndexedRecordIO(idx_path, path, "w")
     # structured images compress realistically (~20-60 KB like ImageNet)
     base = rng.randint(0, 255, size=(edge, edge, 3), dtype=np.uint8)
     for i in range(n):
@@ -50,7 +51,7 @@ def bench_standalone(rec_path, batch, shape, epochs=2):
     import mxtpu as mx
 
     it = mx.io.ImageRecordIter(
-        path_imgrec=rec_path, path_imgidx=rec_path + ".idx",
+        path_imgrec=rec_path,
         data_shape=shape, batch_size=batch,
         shuffle=True, rand_crop=True, rand_mirror=True,
         preprocess_threads=int(os.environ.get("BENCH_INPUT_DECODE_THREADS",
@@ -78,7 +79,7 @@ def bench_overlapped(rec_path, batch, shape):
     import mxtpu as mx
 
     it = mx.io.ImageRecordIter(
-        path_imgrec=rec_path, path_imgidx=rec_path + ".idx",
+        path_imgrec=rec_path,
         data_shape=shape, batch_size=batch,
         shuffle=True, rand_crop=True, rand_mirror=True,
         preprocess_threads=int(os.environ.get("BENCH_INPUT_DECODE_THREADS",
