@@ -83,11 +83,21 @@ class Trainer:
         self._optimizer.lr = lr
 
     def step(self, batch_size, ignore_stale_grad=False):
-        """Apply one optimization step using recorded gradients."""
+        """Apply one optimization step using recorded gradients.
+
+        Fast path: with a single context and no kvstore transport, the
+        WHOLE parameter sweep runs as ONE donated jit program (the same
+        design as Module's fused step) instead of one device program per
+        parameter — the per-op dispatch the reference amortized with its
+        async engine and we remove outright."""
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
 
+        if self._kvstore_obj is None and len(self._contexts) == 1 and \
+                self._fused_sweep_ok():
+            self._fused_sweep()
+            return
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
@@ -100,6 +110,66 @@ class Trainer:
             for upd, arr, grad in zip(self._updaters, param.list_data(),
                                       param.list_grad()):
                 upd(i, grad, arr)
+
+    # ------------------------------------------------ fused update sweep
+    def _fused_sweep_ok(self):
+        import os
+        if os.environ.get("MXTPU_FUSED_TRAINER", "1") == "0":
+            return False
+        from ..module import fused as _f
+        return _f.supports(self._optimizer)
+
+    def _fused_sweep(self):
+        import jax
+
+        from ..module.fused import _RULES
+
+        opt_ = self._optimizer
+        if not hasattr(self, "_fused_state"):
+            init, apply, lr_scale = _RULES[type(opt_).__name__](opt_)
+            self._fused_apply = apply
+            self._fused_lr_scale = lr_scale
+            self._fused_state = {}
+            for i, p in enumerate(self._params):
+                self._fused_state[i] = init(p.list_data()[0]._data)
+
+            def sweep(params, grads, states, lrs, wds):
+                new_p, new_s = [], []
+                for p, g, s, lr, wd in zip(params, grads, states, lrs, wds):
+                    p2, s2 = apply(p, g, s, lr, wd)
+                    new_p.append(p2.astype(p.dtype))
+                    new_s.append(s2)
+                return new_p, new_s
+
+            self._fused_fn = jax.jit(sweep, donate_argnums=(0, 2))
+
+        idxs, params, grads, states, lrs, wds = [], [], [], [], [], []
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            opt_._update_count(i)
+            lr = opt_._get_lr(i)
+            if self._fused_lr_scale is not None:
+                lr *= self._fused_lr_scale(opt_._index_update_count[i])
+            idxs.append(i)
+            params.append(param.list_data()[0]._data)
+            grads.append(param.list_grad()[0]._data)
+            states.append(self._fused_state[i])
+            lrs.append(lr)
+            wds.append(opt_._get_wd(i))
+        new_p, new_s = self._fused_fn(params, grads, states,
+                                      [float(v) for v in lrs],
+                                      [float(v) for v in wds])
+        for i, p2, s2 in zip(idxs, new_p, new_s):
+            self._params[i].list_data()[0]._data = p2
+            self._fused_state[i] = s2
+        # keep the classic updater's state view in sync so
+        # save_states/load_states stay format-compatible
+        from .. import ndarray as nd
+        ust = self._updaters[0].states
+        for i in idxs:
+            ust[i] = jax.tree.map(lambda v: nd.NDArray(v),
+                                  self._fused_state[i])
 
     def save_states(self, fname):
         assert self._optimizer is not None
@@ -122,3 +192,10 @@ class Trainer:
             for updater in self._updaters:
                 updater.set_states(states)
                 updater.optimizer = self._optimizer
+            if hasattr(self, "_fused_state"):
+                # restore the fused sweep's device state from the loaded
+                # updater view (same index scheme)
+                import jax
+                for i, st in self._updaters[0].states.items():
+                    self._fused_state[int(i)] = jax.tree.map(
+                        lambda v: getattr(v, "_data", v), st)

@@ -186,3 +186,47 @@ def test_dataset_dataloader():
         assert data.shape == (8, 3)
         seen += data.shape[0]
     assert seen == 32
+
+
+def test_trainer_fused_sweep_matches_classic(tmp_path):
+    """Trainer.step's one-program update sweep must match the per-param
+    updater path, and .states files must interoperate."""
+    import os
+
+    def run(fused, states_out=None, states_in=None):
+        mx.random.seed(9)
+        os.environ["MXTPU_FUSED_TRAINER"] = "1" if fused else "0"
+        try:
+            net = gluon.nn.Sequential()
+            with net.name_scope():
+                net.add(gluon.nn.Dense(16, activation="relu"))
+                net.add(gluon.nn.Dense(4))
+            net.initialize(mx.initializer.Xavier())
+            trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                    {"learning_rate": 0.1,
+                                     "momentum": 0.9})
+            rng = np.random.RandomState(0)
+            X = mx.nd.array(rng.randn(32, 8).astype("float32"))
+            y = mx.nd.array(rng.randint(0, 4, 32).astype("float32"))
+            loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+            for _ in range(5):
+                with autograd.record():
+                    loss = loss_fn(net(X), y)
+                loss.backward()
+                trainer.step(32)
+            if states_in:
+                trainer.load_states(states_in)
+            if states_out:
+                trainer.save_states(states_out)
+            # strip the run-dependent sequentialN_ prefix for comparison
+            return {k.split("_", 1)[1]: v.data().asnumpy()
+                    for k, v in net.collect_params().items()}
+        finally:
+            os.environ.pop("MXTPU_FUSED_TRAINER", None)
+
+    sf = str(tmp_path / "fused.states")
+    w_fused = run(True, states_out=sf)
+    w_plain = run(False, states_in=sf)  # classic path loads fused states
+    for k in w_plain:
+        np.testing.assert_allclose(w_fused[k], w_plain[k], rtol=2e-3,
+                                   atol=2e-4, err_msg=k)
