@@ -19,7 +19,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
-__all__ = ["moe_apply"]
+__all__ = ["moe_apply", "moe_apply_topk", "load_balancing_loss"]
 
 
 def moe_apply(expert_fn, expert_params, gate_logits, x, mesh=None,
@@ -94,3 +94,89 @@ def moe_apply(expert_fn, expert_params, gate_logits, x, mesh=None,
                      in_specs=(pspec, P(), P()),
                      out_specs=P(), check_vma=False)(expert_params,
                                                      gate_logits, x)
+
+
+def load_balancing_loss(gate_logits, choice_onehot):
+    """Switch/GShard auxiliary loss: n_experts * sum_e f_e * p_e, where
+    f_e = fraction of routing decisions sent to expert e and p_e = mean
+    gate probability of e. Minimized (=1) at a uniform assignment."""
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    n_experts = gate_logits.shape[-1]
+    f = jnp.mean(choice_onehot.astype(probs.dtype), axis=tuple(
+        range(choice_onehot.ndim - 1)))
+    p = jnp.mean(probs, axis=tuple(range(probs.ndim - 1)))
+    return n_experts * jnp.sum(f * p)
+
+
+def moe_apply_topk(expert_fn, expert_params, gate_logits, x, k=2, mesh=None,
+                   axis_name="expert", capacity_factor=2.0):
+    """Top-k MoE over expert-parallel devices.
+
+    Same exchange as ``moe_apply`` (all_to_all dispatch over the expert
+    axis) with k routing decisions per token, GShard slot priority (all
+    rank-0 choices claim capacity before rank-1, ...), gate weights
+    normalized over the selected experts, and the Switch auxiliary
+    load-balancing loss returned alongside the output.
+
+    Returns (out (tokens, d), aux_loss scalar).
+    """
+    if mesh is None:
+        from .mesh import current_mesh
+        mesh = current_mesh()
+    n_dev = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
+    tokens, d = x.shape
+    n_experts = gate_logits.shape[1]
+    assert n_experts % n_dev == 0
+    n_local = n_experts // n_dev
+    capacity = max(1, int(capacity_factor * tokens * k / n_experts))
+
+    def local_fn(params, gates, xl):
+        probs = jax.nn.softmax(gates, axis=-1)
+        topv, topi = lax.top_k(probs, k)                  # (tokens, k)
+        wsum = jnp.sum(topv, axis=-1, keepdims=True)
+        weights = topv / jnp.maximum(wsum, 1e-9)          # renormalized
+
+        # GShard priority: rank-0 decisions claim slots first. Build the
+        # flattened decision list in rank-major order and cumsum it.
+        flat_choice = topi.T.reshape(-1)                  # (k*tokens,)
+        onehot = jax.nn.one_hot(flat_choice, n_experts, dtype=jnp.int32)
+        slot_flat = (jnp.cumsum(onehot, axis=0) - 1)
+        slot_flat = jnp.take_along_axis(
+            slot_flat, flat_choice[:, None], axis=1)[:, 0]
+        slot = slot_flat.reshape(k, tokens).T             # (tokens, k)
+        choice = topi                                     # (tokens, k)
+        keep = slot < capacity
+
+        disp = jnp.zeros((n_experts, capacity, d), x.dtype)
+        for j in range(k):
+            disp = disp.at[choice[:, j],
+                           jnp.minimum(slot[:, j], capacity - 1)].add(
+                jnp.where(keep[:, j][:, None], xl, 0.0))
+
+        disp = disp.reshape(n_dev, n_local, capacity, d)
+        recv = lax.all_to_all(disp, axis_name, split_axis=0, concat_axis=0,
+                              tiled=False)
+        my_tokens = recv[0]                               # replicated routing
+        out = jax.vmap(expert_fn)(params, my_tokens)
+        all_out = lax.all_gather(out, axis_name).reshape(
+            n_experts, capacity, d)
+
+        combined = jnp.zeros_like(xl)
+        any_kept = jnp.zeros((tokens,), bool)
+        for j in range(k):
+            got = all_out[choice[:, j],
+                          jnp.minimum(slot[:, j], capacity - 1)]
+            combined = combined + jnp.where(
+                keep[:, j][:, None], got * weights[:, j][:, None], 0.0)
+            any_kept = any_kept | keep[:, j]
+        routed = jnp.where(any_kept[:, None], combined, xl)
+
+        aux = load_balancing_loss(
+            gates, jax.nn.one_hot(topi[:, 0], n_experts))
+        return routed, aux
+
+    pspec = jax.tree.map(lambda _: P(axis_name), expert_params)
+    return shard_map(local_fn, mesh=mesh,
+                     in_specs=(pspec, P(), P()),
+                     out_specs=(P(), P()), check_vma=False)(
+                         expert_params, gate_logits, x)
