@@ -154,23 +154,25 @@ def ulysses_attention(q, k, v, mesh=None, axis_name="seq", causal=False):
                 H, axis_size)
         scale = 1.0 / jnp.sqrt(D).astype(ql.dtype)
 
+        # both exchanges use split_axis == concat_axis (jax's all_to_all
+        # reverse-mode mis-books cotangent shapes when they differ), with
+        # explicit transposes putting the exchanged axis at position 1
         def to_heads(x):
-            # (B, Tl, H, D) -> (B, p*Tl, H/p, D): split heads across the
-            # axis, gather the full sequence
+            # (B, Tl, H, D) -> (B, p*Tl, H/p, D): split heads (group-major)
+            # across the axis, gather the full sequence
             x = x.reshape(B, Tl, axis_size, H // axis_size, D)
-            x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
-                               tiled=False)
+            x = x.transpose(0, 2, 1, 3, 4)      # (B, p=head-group, Tl, ...)
+            x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=1,
+                               tiled=False)     # axis1 -> seq-block owner
             return x.reshape(B, axis_size * Tl, H // axis_size, D)
 
         def to_seq(x):
             # inverse: (B, T, H/p, D) -> (B, Tl, H, D)
             T = x.shape[1]
             x = x.reshape(B, axis_size, T // axis_size, H // axis_size, D)
-            x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=3,
-                               tiled=False)
-            # received axis (pos 3) is the head-group owner: head index is
-            # (group, within-group), so put the group axis first
-            x = x.transpose(0, 1, 3, 2, 4)
+            x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=1,
+                               tiled=False)     # axis1 -> head-group owner
+            x = x.transpose(0, 2, 1, 3, 4)      # (B, Tl, p, H/p, D)
             return x.reshape(B, T // axis_size, H, D)
 
         qh, kh, vh = to_heads(ql), to_heads(kl), to_heads(vl)
