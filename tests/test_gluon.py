@@ -42,6 +42,7 @@ def test_dense_deferred_init():
 
 
 def test_sequential_and_training():
+    mx.random.seed(5)  # deterministic init regardless of suite order
     net = nn.HybridSequential()
     net.add(nn.Dense(32, activation="relu"))
     net.add(nn.Dense(4))
