@@ -78,7 +78,11 @@ def main():
 
     sym = resnet.get_symbol(num_classes=1000, num_layers=50,
                             image_shape=(3, 224, 224))
-    mod = mx.mod.Module(sym, context=mx.test_utils.default_context())
+    # bind explicitly on the accelerator when one exists (default_context()
+    # is cpu; relying on backend fallbacks would silently bench the host)
+    has_accel = any(d.platform != "cpu" for d in jax.local_devices())
+    ctx = mx.tpu(0) if has_accel else mx.cpu(0)
+    mod = mx.mod.Module(sym, context=ctx)
     pdata = [mx.io.DataDesc("data", (batch, 3, 224, 224), dtype="bfloat16")]
     plabel = [mx.io.DataDesc("softmax_label", (batch,), dtype="float32")]
     mod.bind(data_shapes=pdata, label_shapes=plabel)
