@@ -195,3 +195,54 @@ def test_fused_respects_lr_mult_via_shared_indices():
                                rtol=1e-6)
     for k in w_u:
         np.testing.assert_allclose(w_f[k], w_u[k], rtol=2e-3, atol=2e-4)
+
+
+def test_bucketing_buckets_share_fused_state():
+    """Every bucket module must train through ONE FusedState (weights +
+    optimizer moments), and a step on bucket A must be visible to bucket B
+    (regression: per-bucket fused copies diverged and training failed)."""
+    def sym_gen(T):
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        emb = sym.Embedding(data, input_dim=12, output_dim=6, name="emb")
+        pred = sym.Reshape(emb, shape=(-1, 6))
+        pred = sym.FullyConnected(pred, num_hidden=12, name="out")
+        label = sym.Reshape(label, shape=(-1,))
+        return (sym.SoftmaxOutput(pred, label, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    mod = mx.mod.BucketingModule(sym_gen=sym_gen, default_bucket_key=8,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4, 8))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5,
+                                         "momentum": 0.9})
+    rng = np.random.RandomState(0)
+
+    def batch(T):
+        x = rng.randint(0, 12, (4, T)).astype("float32")
+        return mx.io.DataBatch(
+            data=[nd.array(x)], label=[nd.array((x + 1) % 12)],
+            bucket_key=T,
+            provide_data=[mx.io.DataDesc("data", (4, T))],
+            provide_label=[mx.io.DataDesc("softmax_label", (4, T))])
+
+    mod.forward_backward(batch(8))
+    mod.update()
+    w_after_a = np.asarray(mod._buckets[8]._fused.params["out_weight"])
+
+    mod.forward_backward(batch(4))   # new bucket: must adopt shared state
+    mod.update()
+    assert 4 in mod._buckets
+    fa, fb = mod._buckets[8]._fused, mod._buckets[4]._fused
+    assert fa is not fb and fa.state is fb.state, \
+        "buckets must share one FusedState"
+    # bucket B's step advanced the SAME weights bucket A sees
+    w_after_b = np.asarray(fa.params["out_weight"])
+    assert not np.allclose(w_after_a, w_after_b), \
+        "bucket B's update did not reach the shared weights"
+    # momentum is shared too (non-zero after steps, same object)
+    assert fa.opt_state is fb.opt_state
+    assert np.abs(np.asarray(fa.opt_state["out_weight"])).max() > 0
