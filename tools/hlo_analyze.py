@@ -93,12 +93,14 @@ def main():
     sds = jax.ShapeDtypeStruct
     params = {n: sds(shapes[n], jnp.bfloat16) for n in trainer.param_names}
     aux = {n: sds(ashapes[n], jnp.bfloat16) for n in trainer.aux_names}
-    opt = {n: sds(shapes[n], jnp.bfloat16) for n in trainer.param_names}
+    # optimizer state is kept in f32 (master momentum, module/fused.py)
+    opt = {n: sds(shapes[n], jnp.float32) for n in trainer.param_names}
     batch_in = {'data': sds((batch, 3, 224, 224), jnp.bfloat16),
                 'softmax_label': sds((batch,), jnp.float32)}
     rng = sds((2,), jnp.uint32)
     trainer._pspecs = {n: jax.sharding.PartitionSpec()
                        for n in trainer.param_names}
+    trainer._ospecs = trainer._pspecs
     trainer._opt_state = opt
     fn = trainer._build_step()
     print('lowering...', flush=True)
