@@ -27,6 +27,25 @@ from . import random as _rnd
 __all__ = ["Executor"]
 
 
+def _with_matmul_precision(fn):
+    """Honor ``MXTPU_MATMUL_PRECISION`` (default/high/highest) around an
+    executor program. TPU MXU matmuls default to bf16 passes over f32
+    inputs; 'highest' requests full f32 accumulation (3-pass) — the knob a
+    user needs when exact f32 parity matters more than throughput. Read at
+    call time; the precision context participates in jax's trace cache, so
+    flipping the env retraces rather than returning stale programs."""
+    import os
+
+    def wrapped(*args, **kwargs):
+        prec = os.environ.get("MXTPU_MATMUL_PRECISION")
+        if not prec:
+            return fn(*args, **kwargs)
+        with jax.default_matmul_precision(prec):
+            return fn(*args, **kwargs)
+
+    return wrapped
+
+
 def _trace_graph(symbol, is_train, placements=None):
     """Return fn(arg_vals, aux_vals, rng) -> (outputs, aux_updates_dict).
 
@@ -193,6 +212,7 @@ class Executor:
             fn = jax.jit(fbh)
         else:
             raise MXNetError("unknown program kind %s" % kind)
+        fn = _with_matmul_precision(fn)
         self._fns[kind] = fn
         return fn
 

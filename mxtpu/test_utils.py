@@ -229,9 +229,18 @@ def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
 
 
 def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
-                      arg_params=None, rtol=1e-3, atol=1e-4):
+                      arg_params=None, rtol=1e-3, atol=1e-4,
+                      precision="highest"):
     """Cross-context consistency (parity check_consistency): run the same
-    symbol on each ctx and compare outputs/gradients."""
+    symbol on each ctx and compare outputs/gradients.
+
+    ``precision``: matmul precision requested while tracing each context's
+    program (jax.default_matmul_precision). The default 'highest' makes a
+    TPU context compute f32 matmuls with f32 accumulation so it is
+    comparable to the CPU reference; pass 'default' to test the bf16-MXU
+    fast path (with a correspondingly looser tolerance ladder)."""
+    import jax as _jax
+
     results = []
     for spec in ctx_list:
         ctx = spec["ctx"]
@@ -247,9 +256,10 @@ def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
             for k, v in exe.arg_dict.items():
                 v[:] = nd.array(_np.random.normal(0, scale, size=v.shape)
                                 .astype(str(v.dtype)))
-        exe.forward(is_train=(grad_req != "null"))
-        if grad_req != "null":
-            exe.backward()
+        with _jax.default_matmul_precision(precision or "default"):
+            exe.forward(is_train=(grad_req != "null"))
+            if grad_req != "null":
+                exe.backward()
         results.append(exe)
     ref = results[0]
     for exe in results[1:]:
