@@ -1,0 +1,380 @@
+/* XS glue between Perl and the mxtpu C training ABI (src/capi/c_api.h).
+ * Role parity: the reference's perl-package (AI::MXNet) sits on the same
+ * kind of seam — perl -> C ABI -> runtime (reference
+ * perl-package/AI-MXNet/lib/AI/MXNet.pm over include/mxnet/c_api.h).
+ * Handles cross the boundary as UV integers; the Perl layer (AI::MXTPU)
+ * wraps them in objects with destructors. */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include "c_api.h"
+
+static void *uv_handle(UV v) { return INT2PTR(void *, v); }
+
+static AV *strs_to_av(pTHX_ mx_uint n, const char **arr) {
+    AV *av = newAV();
+    for (mx_uint i = 0; i < n; ++i) {
+        av_push(av, newSVpv(arr[i], 0));
+    }
+    return av;
+}
+
+MODULE = AI::MXTPU    PACKAGE = AI::MXTPU    PREFIX = mxtpu_
+
+PROTOTYPES: DISABLE
+
+const char *
+mxtpu_last_error()
+  CODE:
+    RETVAL = MXGetLastError();
+  OUTPUT:
+    RETVAL
+
+UV
+mxtpu__ndarray_create(shape_ref, dev_type, dev_id, dtype)
+    SV *shape_ref
+    int dev_type
+    int dev_id
+    int dtype
+  CODE:
+    AV *av = (AV *)SvRV(shape_ref);
+    mx_uint ndim = (mx_uint)(av_len(av) + 1);
+    mx_uint shape[32];
+    if (ndim > 32) croak("ndim too large");
+    for (mx_uint i = 0; i < ndim; ++i) {
+        SV **e = av_fetch(av, i, 0);
+        shape[i] = e ? (mx_uint)SvUV(*e) : 0;
+    }
+    NDArrayHandle h;
+    if (MXNDArrayCreate(shape, ndim, dev_type, dev_id, 0, dtype, &h) != 0)
+        croak("MXNDArrayCreate: %s", MXGetLastError());
+    RETVAL = PTR2UV(h);
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu__ndarray_free(h)
+    UV h
+  CODE:
+    MXNDArrayFree(uv_handle(h));
+
+void
+mxtpu__ndarray_copy_from(h, bytes)
+    UV h
+    SV *bytes
+  CODE:
+    STRLEN len;
+    const char *p = SvPV(bytes, len);
+    if (MXNDArraySyncCopyFromCPU(uv_handle(h), p, (uint64_t)len) != 0)
+        croak("MXNDArraySyncCopyFromCPU: %s", MXGetLastError());
+
+SV *
+mxtpu__ndarray_copy_to(h, nbytes)
+    UV h
+    UV nbytes
+  CODE:
+    char *buf;
+    Newx(buf, nbytes, char);
+    if (MXNDArraySyncCopyToCPU(uv_handle(h), buf, (uint64_t)nbytes) != 0) {
+        Safefree(buf);
+        croak("MXNDArraySyncCopyToCPU: %s", MXGetLastError());
+    }
+    RETVAL = newSVpvn(buf, nbytes);
+    Safefree(buf);
+  OUTPUT:
+    RETVAL
+
+SV *
+mxtpu__ndarray_shape(h)
+    UV h
+  CODE:
+    mx_uint ndim;
+    const mx_uint *dims;
+    if (MXNDArrayGetShape(uv_handle(h), &ndim, &dims) != 0)
+        croak("MXNDArrayGetShape: %s", MXGetLastError());
+    AV *av = newAV();
+    for (mx_uint i = 0; i < ndim; ++i) av_push(av, newSVuv(dims[i]));
+    RETVAL = newRV_noinc((SV *)av);
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu__ndarray_wait_all()
+  CODE:
+    if (MXNDArrayWaitAll() != 0)
+        croak("MXNDArrayWaitAll: %s", MXGetLastError());
+
+void
+mxtpu__ndarray_save(fname, handles_ref, keys_ref)
+    const char *fname
+    SV *handles_ref
+    SV *keys_ref
+  CODE:
+    AV *hv = (AV *)SvRV(handles_ref);
+    AV *kv = (AV *)SvRV(keys_ref);
+    mx_uint n = (mx_uint)(av_len(hv) + 1);
+    NDArrayHandle *hs;
+    const char **ks;
+    Newx(hs, n, NDArrayHandle);
+    Newx(ks, n, const char *);
+    for (mx_uint i = 0; i < n; ++i) {
+        hs[i] = uv_handle(SvUV(*av_fetch(hv, i, 0)));
+        ks[i] = SvPV_nolen(*av_fetch(kv, i, 0));
+    }
+    int rc = MXNDArraySave(fname, n, hs, ks);
+    Safefree(hs);
+    Safefree(ks);
+    if (rc != 0) croak("MXNDArraySave: %s", MXGetLastError());
+
+void
+mxtpu__ndarray_load(fname)
+    const char *fname
+  PPCODE:
+    mx_uint n, nk;
+    NDArrayHandle *arrs;
+    const char **names;
+    if (MXNDArrayLoad(fname, &n, &arrs, &nk, &names) != 0)
+        croak("MXNDArrayLoad: %s", MXGetLastError());
+    AV *ha = newAV();
+    for (mx_uint i = 0; i < n; ++i) av_push(ha, newSVuv(PTR2UV(arrs[i])));
+    XPUSHs(sv_2mortal(newRV_noinc((SV *)ha)));
+    XPUSHs(sv_2mortal(newRV_noinc((SV *)strs_to_av(aTHX_ nk, names))));
+
+UV
+mxtpu__symbol_from_json(json)
+    const char *json
+  CODE:
+    SymbolHandle h;
+    if (MXSymbolCreateFromJSON(json, &h) != 0)
+        croak("MXSymbolCreateFromJSON: %s", MXGetLastError());
+    RETVAL = PTR2UV(h);
+  OUTPUT:
+    RETVAL
+
+const char *
+mxtpu__symbol_to_json(h)
+    UV h
+  CODE:
+    const char *out;
+    if (MXSymbolSaveToJSON(uv_handle(h), &out) != 0)
+        croak("MXSymbolSaveToJSON: %s", MXGetLastError());
+    RETVAL = out;
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu__symbol_free(h)
+    UV h
+  CODE:
+    MXSymbolFree(uv_handle(h));
+
+SV *
+mxtpu__symbol_list(h, what)
+    UV h
+    const char *what
+  CODE:
+    mx_uint n;
+    const char **arr;
+    int rc;
+    if (strcmp(what, "arguments") == 0)
+        rc = MXSymbolListArguments(uv_handle(h), &n, &arr);
+    else if (strcmp(what, "outputs") == 0)
+        rc = MXSymbolListOutputs(uv_handle(h), &n, &arr);
+    else
+        rc = MXSymbolListAuxiliaryStates(uv_handle(h), &n, &arr);
+    if (rc != 0) croak("MXSymbolList%s: %s", what, MXGetLastError());
+    RETVAL = newRV_noinc((SV *)strs_to_av(aTHX_ n, arr));
+  OUTPUT:
+    RETVAL
+
+UV
+mxtpu__executor_simple_bind(sym, dev_type, dev_id, grad_req, names_ref, shapes_ref)
+    UV sym
+    int dev_type
+    int dev_id
+    const char *grad_req
+    SV *names_ref
+    SV *shapes_ref
+  CODE:
+    AV *nav = (AV *)SvRV(names_ref);
+    AV *sav = (AV *)SvRV(shapes_ref);
+    mx_uint n = (mx_uint)(av_len(nav) + 1);
+    const char **names;
+    Newx(names, n, const char *);
+    mx_uint *indptr;
+    Newx(indptr, n + 1, mx_uint);
+    indptr[0] = 0;
+    mx_uint total = 0;
+    for (mx_uint i = 0; i < n; ++i) {
+        AV *shp = (AV *)SvRV(*av_fetch(sav, i, 0));
+        total += (mx_uint)(av_len(shp) + 1);
+        indptr[i + 1] = total;
+    }
+    mx_uint *data;
+    Newx(data, total, mx_uint);
+    mx_uint k = 0;
+    for (mx_uint i = 0; i < n; ++i) {
+        names[i] = SvPV_nolen(*av_fetch(nav, i, 0));
+        AV *shp = (AV *)SvRV(*av_fetch(sav, i, 0));
+        for (mx_uint j = 0; j <= (mx_uint)av_len(shp); ++j)
+            data[k++] = (mx_uint)SvUV(*av_fetch(shp, j, 0));
+    }
+    ExecutorHandle h;
+    int rc = MXExecutorSimpleBind(uv_handle(sym), dev_type, dev_id, grad_req,
+                                  n, names, indptr, data, &h);
+    Safefree(names);
+    Safefree(indptr);
+    Safefree(data);
+    if (rc != 0) croak("MXExecutorSimpleBind: %s", MXGetLastError());
+    RETVAL = PTR2UV(h);
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu__executor_forward(h, is_train)
+    UV h
+    int is_train
+  CODE:
+    if (MXExecutorForward(uv_handle(h), is_train) != 0)
+        croak("MXExecutorForward: %s", MXGetLastError());
+
+void
+mxtpu__executor_backward(h)
+    UV h
+  CODE:
+    if (MXExecutorBackward(uv_handle(h)) != 0)
+        croak("MXExecutorBackward: %s", MXGetLastError());
+
+UV
+mxtpu__executor_num_outputs(h)
+    UV h
+  CODE:
+    mx_uint n;
+    if (MXExecutorOutputs(uv_handle(h), &n) != 0)
+        croak("MXExecutorOutputs: %s", MXGetLastError());
+    RETVAL = n;
+  OUTPUT:
+    RETVAL
+
+UV
+mxtpu__executor_output(h, index)
+    UV h
+    UV index
+  CODE:
+    NDArrayHandle out;
+    if (MXExecutorOutput(uv_handle(h), (mx_uint)index, &out) != 0)
+        croak("MXExecutorOutput: %s", MXGetLastError());
+    RETVAL = PTR2UV(out);
+  OUTPUT:
+    RETVAL
+
+UV
+mxtpu__executor_arg(h, name)
+    UV h
+    const char *name
+  CODE:
+    NDArrayHandle out;
+    if (MXExecutorArg(uv_handle(h), name, &out) != 0)
+        croak("MXExecutorArg: %s", MXGetLastError());
+    RETVAL = PTR2UV(out);
+  OUTPUT:
+    RETVAL
+
+UV
+mxtpu__executor_grad(h, name)
+    UV h
+    const char *name
+  CODE:
+    NDArrayHandle out;
+    if (MXExecutorGrad(uv_handle(h), name, &out) != 0)
+        croak("MXExecutorGrad: %s", MXGetLastError());
+    RETVAL = PTR2UV(out);
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu__executor_free(h)
+    UV h
+  CODE:
+    MXExecutorFree(uv_handle(h));
+
+UV
+mxtpu__kvstore_create(type)
+    const char *type
+  CODE:
+    KVStoreHandle h;
+    if (MXKVStoreCreate(type, &h) != 0)
+        croak("MXKVStoreCreate: %s", MXGetLastError());
+    RETVAL = PTR2UV(h);
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu__kvstore_free(h)
+    UV h
+  CODE:
+    MXKVStoreFree(uv_handle(h));
+
+void
+mxtpu__kvstore_init(h, key, val)
+    UV h
+    const char *key
+    UV val
+  CODE:
+    if (MXKVStoreInit(uv_handle(h), key, uv_handle(val)) != 0)
+        croak("MXKVStoreInit: %s", MXGetLastError());
+
+void
+mxtpu__kvstore_push(h, key, val)
+    UV h
+    const char *key
+    UV val
+  CODE:
+    if (MXKVStorePush(uv_handle(h), key, uv_handle(val)) != 0)
+        croak("MXKVStorePush: %s", MXGetLastError());
+
+void
+mxtpu__kvstore_pull(h, key, out)
+    UV h
+    const char *key
+    UV out
+  CODE:
+    if (MXKVStorePull(uv_handle(h), key, uv_handle(out)) != 0)
+        croak("MXKVStorePull: %s", MXGetLastError());
+
+void
+mxtpu__kvstore_set_optimizer(h, name, lr, wd, momentum, rescale_grad)
+    UV h
+    const char *name
+    float lr
+    float wd
+    float momentum
+    float rescale_grad
+  CODE:
+    if (MXKVStoreSetOptimizer(uv_handle(h), name, lr, wd, momentum,
+                              rescale_grad) != 0)
+        croak("MXKVStoreSetOptimizer: %s", MXGetLastError());
+
+int
+mxtpu__kvstore_rank(h)
+    UV h
+  CODE:
+    int r;
+    if (MXKVStoreGetRank(uv_handle(h), &r) != 0)
+        croak("MXKVStoreGetRank: %s", MXGetLastError());
+    RETVAL = r;
+  OUTPUT:
+    RETVAL
+
+int
+mxtpu__kvstore_group_size(h)
+    UV h
+  CODE:
+    int r;
+    if (MXKVStoreGetGroupSize(uv_handle(h), &r) != 0)
+        croak("MXKVStoreGetGroupSize: %s", MXGetLastError());
+    RETVAL = r;
+  OUTPUT:
+    RETVAL

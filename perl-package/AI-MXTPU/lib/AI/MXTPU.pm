@@ -1,0 +1,214 @@
+package AI::MXTPU;
+# Perl binding for the mxtpu training ABI — role parity with the
+# reference's AI::MXNet (perl-package/AI-MXNet over include/mxnet/c_api.h):
+# NDArray / Symbol / Executor / KVStore objects over opaque C handles, with
+# enough surface to train and run a model from pure Perl.
+use strict;
+use warnings;
+
+our $VERSION = '0.01';
+
+require XSLoader;
+XSLoader::load('AI::MXTPU', $VERSION);
+
+# mshadow dtype enum (c_api_full.cc kDtype)
+our %DTYPE = (float32 => 0, float64 => 1, float16 => 2, uint8 => 3,
+              int32 => 4, int8 => 5, int64 => 6, bfloat16 => 7);
+
+# ------------------------------------------------------------------ NDArray
+package AI::MXTPU::NDArray;
+use strict;
+use warnings;
+
+sub _new_from_handle {
+    my ($class, $h, $owned) = @_;
+    return bless { h => $h, owned => ($owned // 1) }, $class;
+}
+
+sub zeros {
+    my ($class, $shape, %opt) = @_;
+    my $dtype = $AI::MXTPU::DTYPE{ $opt{dtype} // 'float32' } // 0;
+    my $h = AI::MXTPU::_ndarray_create($shape, $opt{dev_type} // 1,
+                                       $opt{dev_id} // 0, $dtype);
+    return $class->_new_from_handle($h);
+}
+
+sub from_list {
+    my ($class, $shape, $vals, %opt) = @_;
+    my $arr = $class->zeros($shape, %opt);
+    $arr->set_list($vals);
+    return $arr;
+}
+
+sub set_list {
+    my ($self, $vals) = @_;
+    AI::MXTPU::_ndarray_copy_from($self->{h}, pack('f*', @$vals));
+    return $self;
+}
+
+sub aslist {
+    my ($self) = @_;
+    my $n = 1;
+    $n *= $_ for @{ $self->shape };
+    my $bytes = AI::MXTPU::_ndarray_copy_to($self->{h}, $n * 4);
+    return [ unpack('f*', $bytes) ];
+}
+
+sub shape { return AI::MXTPU::_ndarray_shape($_[0]{h}) }
+sub handle { return $_[0]{h} }
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXTPU::_ndarray_free($self->{h}) if $self->{owned} && $self->{h};
+    $self->{h} = 0;
+}
+
+# ------------------------------------------------------------------- Symbol
+package AI::MXTPU::Symbol;
+use strict;
+use warnings;
+
+sub load_json {
+    my ($class, $json) = @_;
+    my $h = AI::MXTPU::_symbol_from_json($json);
+    return bless { h => $h }, $class;
+}
+
+sub load {
+    my ($class, $path) = @_;
+    open my $fh, '<', $path or die "open $path: $!";
+    local $/;
+    my $json = <$fh>;
+    close $fh;
+    return $class->load_json($json);
+}
+
+sub tojson { return AI::MXTPU::_symbol_to_json($_[0]{h}) }
+sub list_arguments { return AI::MXTPU::_symbol_list($_[0]{h}, 'arguments') }
+sub list_outputs { return AI::MXTPU::_symbol_list($_[0]{h}, 'outputs') }
+sub list_auxiliary_states {
+    return AI::MXTPU::_symbol_list($_[0]{h}, 'auxiliary');
+}
+sub handle { return $_[0]{h} }
+
+sub simple_bind {
+    my ($self, %opt) = @_;
+    my $shapes = $opt{shapes} or die 'simple_bind needs shapes => {name=>[...]}';
+    my @names = sort keys %$shapes;
+    my @dims = map { $shapes->{$_} } @names;
+    my $h = AI::MXTPU::_executor_simple_bind(
+        $self->{h}, $opt{dev_type} // 1, $opt{dev_id} // 0,
+        $opt{grad_req} // 'write', \@names, \@dims);
+    return AI::MXTPU::Executor->_new_from_handle($h);
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXTPU::_symbol_free($self->{h}) if $self->{h};
+    $self->{h} = 0;
+}
+
+# ----------------------------------------------------------------- Executor
+package AI::MXTPU::Executor;
+use strict;
+use warnings;
+
+sub _new_from_handle {
+    my ($class, $h) = @_;
+    return bless { h => $h }, $class;
+}
+
+sub forward {
+    my ($self, $is_train) = @_;
+    AI::MXTPU::_executor_forward($self->{h}, $is_train ? 1 : 0);
+    return $self;
+}
+
+sub backward {
+    my ($self) = @_;
+    AI::MXTPU::_executor_backward($self->{h});
+    return $self;
+}
+
+sub num_outputs { return AI::MXTPU::_executor_num_outputs($_[0]{h}) }
+
+sub output {
+    my ($self, $i) = @_;
+    my $h = AI::MXTPU::_executor_output($self->{h}, $i // 0);
+    # executor owns output buffers; the wrapper must not free them
+    return AI::MXTPU::NDArray->_new_from_handle($h, 0);
+}
+
+sub arg {
+    my ($self, $name) = @_;
+    return AI::MXTPU::NDArray->_new_from_handle(
+        AI::MXTPU::_executor_arg($self->{h}, $name), 0);
+}
+
+sub grad {
+    my ($self, $name) = @_;
+    return AI::MXTPU::NDArray->_new_from_handle(
+        AI::MXTPU::_executor_grad($self->{h}, $name), 0);
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXTPU::_executor_free($self->{h}) if $self->{h};
+    $self->{h} = 0;
+}
+
+# ------------------------------------------------------------------ KVStore
+package AI::MXTPU::KVStore;
+use strict;
+use warnings;
+
+sub create {
+    my ($class, $type) = @_;
+    return bless { h => AI::MXTPU::_kvstore_create($type // 'local') }, $class;
+}
+
+sub init { AI::MXTPU::_kvstore_init($_[0]{h}, $_[1], $_[2]->handle) }
+sub push_ { AI::MXTPU::_kvstore_push($_[0]{h}, $_[1], $_[2]->handle) }
+sub pull { AI::MXTPU::_kvstore_pull($_[0]{h}, $_[1], $_[2]->handle) }
+
+sub set_optimizer {
+    my ($self, %opt) = @_;
+    AI::MXTPU::_kvstore_set_optimizer(
+        $self->{h}, $opt{name} // 'sgd', $opt{lr} // 0.01, $opt{wd} // 0.0,
+        $opt{momentum} // 0.0, $opt{rescale_grad} // 1.0);
+}
+
+sub rank { return AI::MXTPU::_kvstore_rank($_[0]{h}) }
+sub group_size { return AI::MXTPU::_kvstore_group_size($_[0]{h}) }
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXTPU::_kvstore_free($self->{h}) if $self->{h};
+    $self->{h} = 0;
+}
+
+1;
+__END__
+
+=head1 NAME
+
+AI::MXTPU - Perl binding for the mxtpu TPU-native training framework
+
+=head1 SYNOPSIS
+
+  use AI::MXTPU;
+  my $sym  = AI::MXTPU::Symbol->load('mlp-symbol.json');
+  my $exec = $sym->simple_bind(shapes => { data => [32, 16],
+                                           softmax_label => [32] });
+  $exec->arg('data')->set_list(\@batch);
+  $exec->forward(1)->backward;
+  my $probs = $exec->output(0)->aslist;
+
+=head1 DESCRIPTION
+
+Sits on the C training ABI (src/capi/c_api.h) exactly as the reference's
+AI::MXNet sits on libmxnet's C API: NDArray, Symbol, Executor and KVStore
+handles with Perl object wrappers. The compute path behind the seam is the
+jit-compiled XLA executor.
+
+=cut

@@ -1,0 +1,98 @@
+#!/usr/bin/perl
+# Trains the MLP the pytest gate generated (symbol JSON + blob data) through
+# the full perl API: Symbol -> simple_bind -> forward/backward -> KVStore
+# optimizer push/pull. Mirrors src/capi/train_demo.c; role parity with the
+# reference's perl-package AI-MXNet/t/ training tests.
+use strict;
+use warnings;
+use Test::More;
+
+use FindBin;
+use lib "$FindBin::Bin/../lib";
+use AI::MXTPU;
+
+my $dir = $ENV{MXTPU_PERL_TEST_DIR};
+plan skip_all => 'MXTPU_PERL_TEST_DIR not set (run via tests/test_perl_binding.py)'
+    unless $dir && -d $dir;
+
+my ($n, $dim, $classes) = (256, 16, 4);
+
+my $sym = AI::MXTPU::Symbol->load("$dir/mlp.json");
+ok($sym, 'symbol loads from JSON');
+my $args = $sym->list_arguments;
+ok(scalar(@$args) >= 5, 'symbol has fc1/fc2 params + data + label');
+
+my $exec = $sym->simple_bind(
+    shapes => { data => [$n, $dim], softmax_label => [$n] });
+ok($exec, 'executor binds');
+
+# feed data + labels from the packed blobs
+open my $df, '<:raw', "$dir/data.bin" or die $!;
+read $df, my $dbytes, $n * $dim * 4;
+open my $lf, '<:raw', "$dir/labels.bin" or die $!;
+read $lf, my $lbytes, $n * 4;
+AI::MXTPU::_ndarray_copy_from($exec->arg('data')->handle, $dbytes);
+AI::MXTPU::_ndarray_copy_from($exec->arg('softmax_label')->handle, $lbytes);
+
+# init params (deterministic LCG uniform) + register with the kvstore
+my $kv = AI::MXTPU::KVStore->create('local');
+$kv->set_optimizer(name => 'sgd', lr => 0.5, momentum => 0.9,
+                   rescale_grad => 1.0 / $n);
+is($kv->rank, 0, 'local kvstore rank is 0');
+my @params = grep { $_ ne 'data' && $_ ne 'softmax_label' } @$args;
+my $seed = 12345;
+for my $p (@params) {
+    my $w = $exec->arg($p);
+    my $total = 1;
+    $total *= $_ for @{ $w->shape };
+    my @init;
+    for (1 .. $total) {
+        $seed = ($seed * 1103515245 + 12345) & 0xffffffff;
+        push @init, ((($seed >> 16) & 0x7fff) / 32768.0 - 0.5) * 0.2;
+    }
+    $w->set_list(\@init);
+    $kv->init($p, $w);
+}
+
+# training loop: forward/backward, push grads, pull updated weights
+for my $epoch (1 .. 60) {
+    $exec->forward(1);
+    $exec->backward;
+    for my $p (@params) {
+        $kv->push_($p, $exec->grad($p));
+        $kv->pull($p, $exec->arg($p));
+    }
+}
+AI::MXTPU::_ndarray_wait_all();
+
+# accuracy on the training blobs (they're well-separated clusters)
+$exec->forward(0);
+my $probs = $exec->output(0)->aslist;
+my @labels = unpack('f*', $lbytes);
+my $correct = 0;
+for my $i (0 .. $n - 1) {
+    my ($best, $bestv) = (0, -1);
+    for my $c (0 .. $classes - 1) {
+        my $v = $probs->[$i * $classes + $c];
+        ($best, $bestv) = ($c, $v) if $v > $bestv;
+    }
+    $correct++ if $best == $labels[$i];
+}
+my $acc = $correct / $n;
+cmp_ok($acc, '>', 0.9, "perl-driven training reaches >0.9 accuracy (got $acc)");
+
+# NDArray save/load roundtrip through the ABI
+my $w0 = $exec->arg($params[0]);
+AI::MXTPU::_ndarray_save("$dir/w.params", [$w0->handle], [$params[0]]);
+my ($hs, $names) = AI::MXTPU::_ndarray_load("$dir/w.params");
+is($names->[0], $params[0], 'save/load keeps the key');
+my $back = AI::MXTPU::NDArray->_new_from_handle($hs->[0]);
+my ($a, $b) = ($w0->aslist, $back->aslist);
+my $maxd = 0;
+for my $i (0 .. $#$a) {
+    my $d = abs($a->[$i] - $b->[$i]);
+    $maxd = $d if $d > $maxd;
+}
+cmp_ok($maxd, '<', 1e-6, 'save/load roundtrip is exact');
+
+done_testing();

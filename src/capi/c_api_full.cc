@@ -5,6 +5,7 @@
 #include "c_api.h"
 
 #include <Python.h>
+#include <dlfcn.h>
 
 #include <cstring>
 #include <mutex>
@@ -19,6 +20,15 @@ std::once_flag g_py_once;
 void EnsurePython() {
   std::call_once(g_py_once, [] {
     if (!Py_IsInitialized()) {
+      // When a host (perl, R, ...) dlopens this library, libpython arrives
+      // RTLD_LOCAL and Python's own C extensions then fail to resolve
+      // Py* symbols. Re-open the already-loaded libpython RTLD_GLOBAL so
+      // the interpreter's extension modules link against it.
+      Dl_info info;
+      if (dladdr(reinterpret_cast<void *>(&Py_IsInitialized), &info) &&
+          info.dli_fname != nullptr) {
+        dlopen(info.dli_fname, RTLD_GLOBAL | RTLD_NOW | RTLD_NOLOAD);
+      }
       Py_InitializeEx(0);
     }
   });

@@ -3,6 +3,7 @@
 #include "c_predict_api.h"
 
 #include <Python.h>
+#include <dlfcn.h>
 
 #include <mutex>
 #include <string>
@@ -22,6 +23,14 @@ struct Predictor {
 void EnsurePython() {
   std::call_once(g_py_once, [] {
     if (!Py_IsInitialized()) {
+      // Promote libpython to RTLD_GLOBAL first: a host that dlopens this
+      // library loads it RTLD_LOCAL, and Python's C extension modules
+      // would then fail to resolve Py* symbols (see c_api_full.cc).
+      Dl_info info;
+      if (dladdr(reinterpret_cast<void *>(&Py_IsInitialized), &info) &&
+          info.dli_fname != nullptr) {
+        dlopen(info.dli_fname, RTLD_GLOBAL | RTLD_NOW | RTLD_NOLOAD);
+      }
       Py_InitializeEx(0);
       g_we_initialized = true;
     }

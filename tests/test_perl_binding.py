@@ -1,0 +1,68 @@
+"""perl-package: AI::MXTPU (XS over src/capi/c_api.h) trains an MLP from
+pure Perl — the reference's perl-package (AI::MXNet) tier on this runtime
+(reference: perl-package/AI-MXNet/, which wraps include/mxnet/c_api.h the
+same way)."""
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "perl-package", "AI-MXTPU")
+CAPI_SO = os.path.join(REPO, "mxtpu", "native", "libmxtpu_capi.so")
+
+
+def _have_perl_toolchain():
+    if shutil.which("perl") is None:
+        return False
+    r = subprocess.run(
+        ["perl", "-MExtUtils::MakeMaker", "-MDynaLoader", "-e", "1"],
+        capture_output=True)
+    return r.returncode == 0
+
+
+@pytest.mark.skipif(not _have_perl_toolchain(),
+                    reason="perl + ExtUtils::MakeMaker unavailable")
+def test_perl_binding_trains_mlp(tmp_path):
+    r = subprocess.run(["make", "-C", os.path.join(REPO, "src"), "capi"],
+                       capture_output=True, text=True)
+    if not os.path.exists(CAPI_SO):
+        pytest.skip("libmxtpu_capi.so did not build: %s"
+                    % (r.stdout + r.stderr)[-400:])
+
+    # build the XS module (idempotent; blib/ is gitignored)
+    env = dict(os.environ)
+    b = subprocess.run(["perl", "Makefile.PL"], cwd=PKG, env=env,
+                       capture_output=True, text=True)
+    assert b.returncode == 0, b.stdout + b.stderr
+    b = subprocess.run(["make"], cwd=PKG, env=env,
+                       capture_output=True, text=True)
+    assert b.returncode == 0, b.stdout + b.stderr
+
+    # artifacts for the perl test: symbol JSON + separable blobs
+    import mxtpu as mx
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    net.save(str(tmp_path / "mlp.json"))
+    rng = np.random.RandomState(0)
+    n, dim, classes = 256, 16, 4
+    centers = rng.randn(classes, dim) * 3
+    y = rng.randint(0, classes, n)
+    X = (centers[y] + rng.randn(n, dim)).astype("float32")
+    (tmp_path / "data.bin").write_bytes(X.tobytes())
+    (tmp_path / "labels.bin").write_bytes(y.astype("float32").tobytes())
+
+    env.update(JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               MXTPU_PERL_TEST_DIR=str(tmp_path))
+    out = subprocess.run(
+        ["perl", "-Mblib", os.path.join("t", "train_mlp.t")],
+        cwd=PKG, env=env, capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "not ok" not in out.stdout, out.stdout
+    assert "ok" in out.stdout, out.stdout
