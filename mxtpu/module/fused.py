@@ -294,6 +294,9 @@ class FusedTrainStep:
         trainable = tuple(self.trainable)
         apply_update = self._apply
 
+        import os
+        remat = os.environ.get("MXTPU_REMAT", "0") != "0"
+
         def step(params, aux, opt_state, batch, lrs, wds, rng):
             fixed = {n: v for n, v in params.items() if n not in trainable}
 
@@ -304,6 +307,12 @@ class FusedTrainStep:
                 outs, auxu = run(env, aux, rng)
                 return outs, auxu
 
+            if remat:
+                # trade recompute for activation traffic / memory
+                # (MXTPU_REMAT=1): useful when the step is HBM-bound or the
+                # model spills; mirrors the reference's memory mirroring
+                # (__mirror_stage__, src/executor/graph_executor.cc)
+                f = jax.checkpoint(f)
             train_p = {n: params[n] for n in trainable}
             (outs, auxu), vjp = jax.vjp(f, train_p)
             cts = ([jnp.ones_like(o) for o in outs],
