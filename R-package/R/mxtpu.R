@@ -1,0 +1,178 @@
+# mxtpu R binding — stock-R (`dyn.load` + `.C`) over the adapter in
+# R-package/src/mxtpu_r.c, which sits on the C training ABI
+# (src/capi/c_api.h). Role parity: the reference's R-package training API
+# (R-package/R/ over include/mxnet/c_api.h).
+#
+# Usage:
+#   source("R-package/R/mxtpu.R")
+#   mx.init("/path/to/repo/mxtpu/native")
+#   sym  <- mx.symbol.load("mlp-symbol.json")
+#   exec <- mx.executor.bind(sym, shapes = list(data = c(32, 16),
+#                                               softmax_label = c(32)))
+
+mx.init <- function(native_dir) {
+  dyn.load(file.path(native_dir, "libmxtpu_r.so"))
+  invisible(TRUE)
+}
+
+.mx.check <- function(rc, where) {
+  if (rc != 0) {
+    buf <- paste(rep(" ", 512), collapse = "")
+    err <- .C("mx_r_last_error", msg = buf)$msg
+    stop(sprintf("%s: %s", where, err))
+  }
+}
+
+# ------------------------------------------------------------------ NDArray
+mx.nd.zeros <- function(shape, dtype = 0L, dev.type = 1L, dev.id = 0L) {
+  r <- .C("mx_r_ndarray_create", as.integer(shape), length(shape),
+          as.integer(dtype), as.integer(dev.type), as.integer(dev.id),
+          id = integer(1), rc = integer(1))
+  .mx.check(r$rc, "mx.nd.zeros")
+  structure(list(id = r$id), class = "mx.ndarray")
+}
+
+mx.nd.array <- function(values, shape = NULL) {
+  if (is.null(shape)) shape <- if (is.matrix(values)) dim(values) else length(values)
+  arr <- mx.nd.zeros(shape)
+  mx.nd.set(arr, as.numeric(values))
+  arr
+}
+
+mx.nd.set <- function(arr, values) {
+  r <- .C("mx_r_ndarray_set", as.integer(arr$id), as.numeric(values),
+          length(values), rc = integer(1))
+  .mx.check(r$rc, "mx.nd.set")
+  invisible(arr)
+}
+
+mx.nd.values <- function(arr) {
+  shp <- mx.nd.shape(arr)
+  n <- prod(shp)
+  r <- .C("mx_r_ndarray_get", as.integer(arr$id), vals = numeric(n),
+          as.integer(n), rc = integer(1))
+  .mx.check(r$rc, "mx.nd.values")
+  r$vals
+}
+
+mx.nd.shape <- function(arr) {
+  r <- .C("mx_r_ndarray_shape", as.integer(arr$id), ndim = integer(1),
+          shape = integer(32), rc = integer(1))
+  .mx.check(r$rc, "mx.nd.shape")
+  r$shape[seq_len(r$ndim)]
+}
+
+mx.nd.wait.all <- function() {
+  r <- .C("mx_r_ndarray_wait_all", rc = integer(1))
+  .mx.check(r$rc, "mx.nd.wait.all")
+  invisible(TRUE)
+}
+
+# ------------------------------------------------------------------- Symbol
+mx.symbol.load.json <- function(json) {
+  r <- .C("mx_r_symbol_from_json", json, id = integer(1), rc = integer(1))
+  .mx.check(r$rc, "mx.symbol.load.json")
+  structure(list(id = r$id), class = "mx.symbol")
+}
+
+mx.symbol.load <- function(path) {
+  mx.symbol.load.json(paste(readLines(path, warn = FALSE), collapse = "\n"))
+}
+
+.mx.symbol.list <- function(sym, what) {
+  buf <- paste(rep(" ", 8192), collapse = "")
+  r <- .C("mx_r_symbol_list", as.integer(sym$id), as.integer(what),
+          out = buf, rc = integer(1))
+  .mx.check(r$rc, "mx.symbol.list")
+  strsplit(r$out, "\n", fixed = TRUE)[[1]]
+}
+
+mx.symbol.arguments <- function(sym) .mx.symbol.list(sym, 0L)
+mx.symbol.outputs <- function(sym) .mx.symbol.list(sym, 1L)
+mx.symbol.auxiliary.states <- function(sym) .mx.symbol.list(sym, 2L)
+
+# ----------------------------------------------------------------- Executor
+mx.executor.bind <- function(sym, shapes, grad.req = "write",
+                             dev.type = 1L, dev.id = 0L) {
+  nms <- names(shapes)
+  indptr <- c(0L, cumsum(vapply(shapes, length, 1L)))
+  data <- as.integer(unlist(shapes))
+  r <- .C("mx_r_executor_bind", as.integer(sym$id), as.integer(dev.type),
+          as.integer(dev.id), grad.req, nms, length(nms),
+          as.integer(indptr), data, id = integer(1), rc = integer(1))
+  .mx.check(r$rc, "mx.executor.bind")
+  structure(list(id = r$id), class = "mx.executor")
+}
+
+mx.executor.forward <- function(exec, is.train = TRUE) {
+  r <- .C("mx_r_executor_forward", as.integer(exec$id),
+          as.integer(is.train), rc = integer(1))
+  .mx.check(r$rc, "mx.executor.forward")
+  invisible(exec)
+}
+
+mx.executor.backward <- function(exec) {
+  r <- .C("mx_r_executor_backward", as.integer(exec$id), rc = integer(1))
+  .mx.check(r$rc, "mx.executor.backward")
+  invisible(exec)
+}
+
+.mx.wrap.nd <- function(id) structure(list(id = id), class = "mx.ndarray")
+
+mx.executor.output <- function(exec, index = 0L) {
+  r <- .C("mx_r_executor_output", as.integer(exec$id), as.integer(index),
+          id = integer(1), rc = integer(1))
+  .mx.check(r$rc, "mx.executor.output")
+  .mx.wrap.nd(r$id)
+}
+
+mx.executor.arg <- function(exec, name) {
+  r <- .C("mx_r_executor_arg", as.integer(exec$id), name, id = integer(1),
+          rc = integer(1))
+  .mx.check(r$rc, "mx.executor.arg")
+  .mx.wrap.nd(r$id)
+}
+
+mx.executor.grad <- function(exec, name) {
+  r <- .C("mx_r_executor_grad", as.integer(exec$id), name, id = integer(1),
+          rc = integer(1))
+  .mx.check(r$rc, "mx.executor.grad")
+  .mx.wrap.nd(r$id)
+}
+
+# ------------------------------------------------------------------ KVStore
+mx.kv.create <- function(type = "local") {
+  r <- .C("mx_r_kvstore_create", type, id = integer(1), rc = integer(1))
+  .mx.check(r$rc, "mx.kv.create")
+  structure(list(id = r$id), class = "mx.kvstore")
+}
+
+mx.kv.init <- function(kv, key, arr) {
+  r <- .C("mx_r_kvstore_init", as.integer(kv$id), key, as.integer(arr$id),
+          rc = integer(1))
+  .mx.check(r$rc, "mx.kv.init")
+  invisible(kv)
+}
+
+mx.kv.push <- function(kv, key, arr) {
+  r <- .C("mx_r_kvstore_push", as.integer(kv$id), key, as.integer(arr$id),
+          rc = integer(1))
+  .mx.check(r$rc, "mx.kv.push")
+  invisible(kv)
+}
+
+mx.kv.pull <- function(kv, key, arr) {
+  r <- .C("mx_r_kvstore_pull", as.integer(kv$id), key, as.integer(arr$id),
+          rc = integer(1))
+  .mx.check(r$rc, "mx.kv.pull")
+  invisible(kv)
+}
+
+mx.kv.set.optimizer <- function(kv, name = "sgd", lr = 0.01, wd = 0,
+                                momentum = 0, rescale.grad = 1) {
+  r <- .C("mx_r_kvstore_set_optimizer", as.integer(kv$id), name,
+          as.numeric(lr), as.numeric(wd), as.numeric(momentum),
+          as.numeric(rescale.grad), rc = integer(1))
+  .mx.check(r$rc, "mx.kv.set.optimizer")
+  invisible(kv)
+}

@@ -1,0 +1,212 @@
+/* R adapter for the mxtpu C training ABI (src/capi/c_api.h).
+ *
+ * Role parity: the reference's R-package wraps include/mxnet/c_api.h via
+ * Rcpp (R-package/src/). This adapter instead exposes base-R `.C`-callable
+ * entry points (all-pointer signatures, no R headers needed), so it builds
+ * without an R installation and `dyn.load` + `.C` drive it from stock R.
+ *
+ * Opaque runtime handles never cross into R: the adapter keeps an id ->
+ * handle table and R code passes integer ids. Every function writes its
+ * status into *rc (0 ok, -1 failure; message via mx_r_last_error).
+ */
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "c_api.h"
+
+#define MXR_MAX_HANDLES 65536
+
+static void *g_handles[MXR_MAX_HANDLES];
+static int g_next = 1; /* 0 stays invalid */
+
+static int put_handle(void *h) {
+  if (g_next >= MXR_MAX_HANDLES) return -1;
+  g_handles[g_next] = h;
+  return g_next++;
+}
+
+static void *get_handle(int id) {
+  if (id <= 0 || id >= MXR_MAX_HANDLES) return NULL;
+  return g_handles[id];
+}
+
+void mx_r_last_error(char **msg) {
+  /* R passes a character vector; we overwrite its first element's buffer
+   * is not allowed — instead R calls this with an out-string it copies.
+   * Simplest contract: return pointer via strncpy into caller buffer of
+   * 512 bytes (first element pre-allocated from R with a wide string). */
+  const char *e = MXGetLastError();
+  if (msg != NULL && msg[0] != NULL) {
+    strncpy(msg[0], e == NULL ? "" : e, 511);
+    msg[0][511] = 0;
+  }
+}
+
+void mx_r_ndarray_create(int *shape, int *ndim, int *dtype, int *dev_type,
+                         int *dev_id, int *out_id, int *rc) {
+  mx_uint shp[32];
+  int i;
+  for (i = 0; i < *ndim && i < 32; ++i) shp[i] = (mx_uint)shape[i];
+  NDArrayHandle h;
+  *rc = MXNDArrayCreate(shp, (mx_uint)*ndim, *dev_type, *dev_id, 0, *dtype,
+                        &h);
+  *out_id = (*rc == 0) ? put_handle(h) : 0;
+}
+
+void mx_r_ndarray_free(int *id, int *rc) {
+  *rc = MXNDArrayFree(get_handle(*id));
+  g_handles[*id] = NULL;
+}
+
+/* values cross as double (R's native numeric); the adapter converts. */
+void mx_r_ndarray_set(int *id, double *vals, int *n, int *rc) {
+  float *buf = (float *)malloc((size_t)(*n) * sizeof(float));
+  int i;
+  for (i = 0; i < *n; ++i) buf[i] = (float)vals[i];
+  *rc = MXNDArraySyncCopyFromCPU(get_handle(*id), buf,
+                                 (uint64_t)(*n) * sizeof(float));
+  free(buf);
+}
+
+void mx_r_ndarray_get(int *id, double *vals, int *n, int *rc) {
+  float *buf = (float *)malloc((size_t)(*n) * sizeof(float));
+  *rc = MXNDArraySyncCopyToCPU(get_handle(*id), buf,
+                               (uint64_t)(*n) * sizeof(float));
+  if (*rc == 0) {
+    int i;
+    for (i = 0; i < *n; ++i) vals[i] = (double)buf[i];
+  }
+  free(buf);
+}
+
+void mx_r_ndarray_shape(int *id, int *out_ndim, int *out_shape, int *rc) {
+  mx_uint ndim;
+  const mx_uint *dims;
+  *rc = MXNDArrayGetShape(get_handle(*id), &ndim, &dims);
+  if (*rc == 0) {
+    mx_uint i;
+    *out_ndim = (int)ndim;
+    for (i = 0; i < ndim && i < 32; ++i) out_shape[i] = (int)dims[i];
+  }
+}
+
+void mx_r_ndarray_wait_all(int *rc) { *rc = MXNDArrayWaitAll(); }
+
+void mx_r_symbol_from_json(char **json, int *out_id, int *rc) {
+  SymbolHandle h;
+  *rc = MXSymbolCreateFromJSON(json[0], &h);
+  *out_id = (*rc == 0) ? put_handle(h) : 0;
+}
+
+void mx_r_symbol_free(int *id, int *rc) {
+  *rc = MXSymbolFree(get_handle(*id));
+  g_handles[*id] = NULL;
+}
+
+/* names are returned packed into a caller-provided buffer, '\n'-joined */
+static void join_names(mx_uint n, const char **arr, char **out) {
+  size_t off = 0, cap = 8191;
+  mx_uint i;
+  out[0][0] = 0;
+  for (i = 0; i < n; ++i) {
+    size_t l = strlen(arr[i]);
+    if (off + l + 2 > cap) break;
+    memcpy(out[0] + off, arr[i], l);
+    off += l;
+    out[0][off++] = '\n';
+  }
+  if (off > 0) off--; /* drop trailing separator */
+  out[0][off] = 0;
+}
+
+void mx_r_symbol_list(int *id, int *what, char **out, int *rc) {
+  mx_uint n;
+  const char **arr;
+  if (*what == 0)
+    *rc = MXSymbolListArguments(get_handle(*id), &n, &arr);
+  else if (*what == 1)
+    *rc = MXSymbolListOutputs(get_handle(*id), &n, &arr);
+  else
+    *rc = MXSymbolListAuxiliaryStates(get_handle(*id), &n, &arr);
+  if (*rc == 0) join_names(n, arr, out);
+}
+
+void mx_r_executor_bind(int *sym_id, int *dev_type, int *dev_id,
+                        char **grad_req, char **names, int *n_names,
+                        int *shape_indptr, int *shape_data, int *out_id,
+                        int *rc) {
+  const char *nm[64];
+  mx_uint indptr[65];
+  mx_uint data[256];
+  int i, total = shape_indptr[*n_names];
+  for (i = 0; i < *n_names && i < 64; ++i) nm[i] = names[i];
+  for (i = 0; i <= *n_names && i < 65; ++i)
+    indptr[i] = (mx_uint)shape_indptr[i];
+  for (i = 0; i < total && i < 256; ++i) data[i] = (mx_uint)shape_data[i];
+  ExecutorHandle h;
+  *rc = MXExecutorSimpleBind(get_handle(*sym_id), *dev_type, *dev_id,
+                             grad_req[0], (mx_uint)*n_names, nm, indptr,
+                             data, &h);
+  *out_id = (*rc == 0) ? put_handle(h) : 0;
+}
+
+void mx_r_executor_forward(int *id, int *is_train, int *rc) {
+  *rc = MXExecutorForward(get_handle(*id), *is_train);
+}
+
+void mx_r_executor_backward(int *id, int *rc) {
+  *rc = MXExecutorBackward(get_handle(*id));
+}
+
+void mx_r_executor_output(int *id, int *index, int *out_id, int *rc) {
+  NDArrayHandle h;
+  *rc = MXExecutorOutput(get_handle(*id), (mx_uint)*index, &h);
+  *out_id = (*rc == 0) ? put_handle(h) : 0;
+}
+
+void mx_r_executor_arg(int *id, char **name, int *out_id, int *rc) {
+  NDArrayHandle h;
+  *rc = MXExecutorArg(get_handle(*id), name[0], &h);
+  *out_id = (*rc == 0) ? put_handle(h) : 0;
+}
+
+void mx_r_executor_grad(int *id, char **name, int *out_id, int *rc) {
+  NDArrayHandle h;
+  *rc = MXExecutorGrad(get_handle(*id), name[0], &h);
+  *out_id = (*rc == 0) ? put_handle(h) : 0;
+}
+
+void mx_r_executor_free(int *id, int *rc) {
+  *rc = MXExecutorFree(get_handle(*id));
+  g_handles[*id] = NULL;
+}
+
+void mx_r_kvstore_create(char **type, int *out_id, int *rc) {
+  KVStoreHandle h;
+  *rc = MXKVStoreCreate(type[0], &h);
+  *out_id = (*rc == 0) ? put_handle(h) : 0;
+}
+
+void mx_r_kvstore_free(int *id, int *rc) {
+  *rc = MXKVStoreFree(get_handle(*id));
+  g_handles[*id] = NULL;
+}
+
+void mx_r_kvstore_init(int *id, char **key, int *nd_id, int *rc) {
+  *rc = MXKVStoreInit(get_handle(*id), key[0], get_handle(*nd_id));
+}
+
+void mx_r_kvstore_push(int *id, char **key, int *nd_id, int *rc) {
+  *rc = MXKVStorePush(get_handle(*id), key[0], get_handle(*nd_id));
+}
+
+void mx_r_kvstore_pull(int *id, char **key, int *nd_id, int *rc) {
+  *rc = MXKVStorePull(get_handle(*id), key[0], get_handle(*nd_id));
+}
+
+void mx_r_kvstore_set_optimizer(int *id, char **name, double *lr, double *wd,
+                                double *momentum, double *rescale, int *rc) {
+  *rc = MXKVStoreSetOptimizer(get_handle(*id), name[0], (float)*lr,
+                              (float)*wd, (float)*momentum, (float)*rescale);
+}

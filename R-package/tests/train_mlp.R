@@ -1,0 +1,59 @@
+# Trains the MLP the pytest gate generated (symbol JSON + packed blobs)
+# from pure R: Symbol -> bind -> forward/backward -> KVStore optimizer.
+# Mirrors src/capi/train_demo.c and perl-package/AI-MXTPU/t/train_mlp.t.
+# Driven by tests/test_r_binding.py (skips when Rscript is absent).
+args <- commandArgs(trailingOnly = TRUE)
+if (length(args) < 2) stop("usage: train_mlp.R <native_dir> <artifact_dir>")
+source(file.path(dirname(sub("--file=", "", grep("--file=",
+  commandArgs(), value = TRUE))), "..", "R", "mxtpu.R"))
+mx.init(args[1])
+dir <- args[2]
+
+n <- 256L; dim <- 16L; classes <- 4L
+
+sym <- mx.symbol.load(file.path(dir, "mlp.json"))
+arg.names <- mx.symbol.arguments(sym)
+stopifnot(length(arg.names) >= 5)
+
+exec <- mx.executor.bind(sym, shapes = list(data = c(n, dim),
+                                            softmax_label = c(n)))
+
+# feed data/labels from the packed float32 blobs
+dcon <- file(file.path(dir, "data.bin"), "rb")
+X <- readBin(dcon, numeric(), n * dim, size = 4); close(dcon)
+lcon <- file(file.path(dir, "labels.bin"), "rb")
+y <- readBin(lcon, numeric(), n, size = 4); close(lcon)
+mx.nd.set(mx.executor.arg(exec, "data"), X)
+mx.nd.set(mx.executor.arg(exec, "softmax_label"), y)
+
+# init params (deterministic LCG uniform), register with the kvstore
+kv <- mx.kv.create("local")
+mx.kv.set.optimizer(kv, "sgd", lr = 0.5, momentum = 0.9,
+                    rescale.grad = 1 / n)
+params <- setdiff(arg.names, c("data", "softmax_label"))
+set.seed(12345)
+for (p in params) {
+  w <- mx.executor.arg(exec, p)
+  total <- prod(mx.nd.shape(w))
+  mx.nd.set(w, runif(total, -0.1, 0.1))
+  mx.kv.init(kv, p, w)
+}
+
+for (epoch in 1:60) {
+  mx.executor.forward(exec, TRUE)
+  mx.executor.backward(exec)
+  for (p in params) {
+    mx.kv.push(kv, p, mx.executor.grad(exec, p))
+    mx.kv.pull(kv, p, mx.executor.arg(exec, p))
+  }
+}
+mx.nd.wait.all()
+
+mx.executor.forward(exec, FALSE)
+probs <- matrix(mx.nd.values(mx.executor.output(exec, 0L)),
+                nrow = n, byrow = TRUE)
+pred <- max.col(probs) - 1
+acc <- mean(pred == y)
+cat(sprintf("ACCURACY %.4f\n", acc))
+if (acc <= 0.9) stop(sprintf("accuracy %.4f below gate", acc))
+cat("R BINDING OK\n")
