@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Train a decoder-only transformer LM on character data via Module.fit.
+
+The long-context counterpart of examples/rnn/lstm_bucketing.py: same
+Module training loop, but the model is mxtpu.models.transformer (flash
+attention, O(T) residuals). With --seq-parallel the identical weights run
+a ring-attention sequence-parallel forward over a 'seq' mesh axis —
+the path a multi-chip pod uses for sequences too long for one chip.
+
+Synthetic corpus by default (deterministic arithmetic text), or pass
+--text FILE for a real one. Prints per-epoch perplexity; exits nonzero
+if perplexity fails to improve, so it doubles as an integration gate.
+"""
+import argparse
+import logging
+import math
+
+import numpy as np
+
+import mxtpu as mx
+
+
+def make_corpus(n_chars=40000, seed=7):
+    """Deterministic 'a+b=c;' arithmetic text — structured enough that a
+    small LM's perplexity falls fast."""
+    rng = np.random.RandomState(seed)
+    out = []
+    while sum(len(s) for s in out) < n_chars:
+        a, b = rng.randint(0, 50), rng.randint(0, 50)
+        out.append("%d+%d=%d;" % (a, b, a + b))
+    return "".join(out)[:n_chars]
+
+
+def batches(text, vocab, seq_len, batch_size):
+    ids = np.array([vocab[c] for c in text], dtype="float32")
+    n_tok = (len(ids) - 1) // seq_len * seq_len
+    x = ids[:n_tok].reshape(-1, seq_len)
+    y = ids[1:n_tok + 1].reshape(-1, seq_len)
+    n_batches = x.shape[0] // batch_size
+    for i in range(n_batches):
+        xs = x[i * batch_size:(i + 1) * batch_size]
+        ys = y[i * batch_size:(i + 1) * batch_size].reshape(-1)
+        yield xs, ys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--text", default=None)
+    ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--num-heads", type=int, default=4)
+    ap.add_argument("--d-model", type=int, default=128)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--seq-parallel", action="store_true",
+                    help="validate a ring-attention sequence-parallel "
+                         "forward with the trained weights")
+    args = ap.parse_args(argv)
+
+    text = (open(args.text).read() if args.text else make_corpus())
+    vocab = {c: i for i, c in enumerate(sorted(set(text)))}
+    V = len(vocab)
+    logging.info("corpus %d chars, vocab %d", len(text), V)
+
+    net = mx.models.get_transformer_lm(
+        vocab_size=V, seq_len=args.seq_len, num_layers=args.num_layers,
+        num_heads=args.num_heads, d_model=args.d_model)
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=[("data", (args.batch_size, args.seq_len))],
+             label_shapes=[("softmax_label",
+                            (args.batch_size * args.seq_len,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": args.lr})
+
+    ppls = []
+    for epoch in range(args.epochs):
+        tot_nll, tot_tok = 0.0, 0
+        for xs, ys in batches(text, vocab, args.seq_len, args.batch_size):
+            db = mx.io.DataBatch(data=[mx.nd.array(xs)],
+                                 label=[mx.nd.array(ys)])
+            mod.forward_backward(db)
+            mod.update()
+            out = mod.get_outputs()[0].asnumpy()
+            nll = -np.log(out[np.arange(len(ys)), ys.astype(int)] + 1e-9)
+            tot_nll += nll.sum()
+            tot_tok += len(ys)
+        ppl = math.exp(tot_nll / tot_tok)
+        ppls.append(ppl)
+        logging.info("Epoch[%d] perplexity=%.3f", epoch, ppl)
+
+    if args.seq_parallel:
+        _validate_seq_parallel(mod, vocab, text, args)
+
+    if len(ppls) > 1 and not ppls[-1] < ppls[0]:
+        raise SystemExit("perplexity did not improve: %s" % ppls)
+    return ppls
+
+
+def _validate_seq_parallel(mod, vocab, text, args):
+    """Ring attention over a seq-sharded mesh reproduces the single-device
+    attention with the TRAINED layer-0 q/k/v projections applied to real
+    token embeddings from the corpus."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxtpu.ops.attention import flash_attention
+    from mxtpu.parallel import make_mesh, ring_attention
+
+    n_dev = len(jax.devices())
+    if n_dev < 2 or args.seq_len % n_dev:
+        logging.info("seq-parallel check skipped (%d devices)", n_dev)
+        return
+    arg_params, _ = mod.get_params()
+    w = {k: v.asnumpy().astype("float32") for k, v in arg_params.items()}
+    T, H = args.seq_len, args.num_heads
+    dh = args.d_model // H
+
+    # real tokens -> trained embedding + position -> trained LN -> q/k/v
+    ids = np.array([vocab[c] for c in text[:2 * T]]).reshape(2, T)
+    h = w["tok_emb_weight"][ids] + w["pos_emb"]
+    mu = h.mean(-1, keepdims=True)
+    sd = np.sqrt(h.var(-1, keepdims=True) + 1e-5)
+    ln = (h - mu) / sd * w["l0_ln1_gamma"] + w["l0_ln1_beta"]
+
+    def proj(tag):
+        p = ln @ w["l0_%s_weight" % tag].T + w["l0_%s_bias" % tag]
+        return jnp.asarray(p.reshape(2, T, H, dh))  # ring layout (B,T,H,D)
+
+    q, k, v = proj("q"), proj("k"), proj("v")
+    ref = flash_attention(jnp.transpose(q, (0, 2, 1, 3)),
+                          jnp.transpose(k, (0, 2, 1, 3)),
+                          jnp.transpose(v, (0, 2, 1, 3)), causal=True)
+    mesh = make_mesh(shape=(1, n_dev), axis_names=("data", "seq"))
+    out = ring_attention(q, k, v, mesh=mesh, axis_name="seq", causal=True)
+    out = jnp.transpose(out, (0, 2, 1, 3))
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-4, atol=2e-5)
+    logging.info("seq-parallel ring attention matches flash on the "
+                 "trained layer-0 q/k/v (T=%d over %d devices)", T, n_dev)
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    main()

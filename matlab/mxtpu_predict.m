@@ -1,0 +1,81 @@
+function out = mxtpu_predict(symbol_file, param_file, data, varargin)
+%MXTPU_PREDICT Run inference through the C predict ABI from MATLAB.
+%   OUT = MXTPU_PREDICT(SYMBOL_FILE, PARAM_FILE, DATA) loads a trained
+%   model (symbol JSON + .params saved by mxtpu) and returns the network
+%   output for DATA (numeric array, batch along the first dimension).
+%
+%   OUT = MXTPU_PREDICT(..., 'InputName', NAME) overrides the input name
+%   (default 'data').
+%
+%   Role parity: the reference's matlab/ predict-only wrapper over
+%   libmxnet_predict (matlab/+mxnet/model.m, c_predict_api.h). This
+%   wrapper drives the identical four-call ABI:
+%     MXPredCreate -> MXPredSetInput -> MXPredForward -> MXPredGetOutput
+%   against mxtpu/native/libmxtpu_predict.so (build: make -C src predict).
+%
+%   Requires the library + header on the path:
+%     addpath <repo>/matlab
+%     setenv('MXTPU_NATIVE', '<repo>/mxtpu/native');
+
+p = inputParser;
+addParameter(p, 'InputName', 'data');
+parse(p, varargin{:});
+input_name = p.Results.InputName;
+
+native = getenv('MXTPU_NATIVE');
+if isempty(native)
+    error('set MXTPU_NATIVE to the mxtpu/native directory');
+end
+header = fullfile(fileparts(mfilename('fullpath')), ...
+                  '..', 'src', 'capi', 'c_predict_api.h');
+if ~libisloaded('libmxtpu_predict')
+    loadlibrary(fullfile(native, 'libmxtpu_predict.so'), header, ...
+                'alias', 'libmxtpu_predict');
+end
+
+symbol_json = fileread(symbol_file);
+fid = fopen(param_file, 'rb');
+param_bytes = fread(fid, inf, '*uint8');
+fclose(fid);
+
+% input shape: MATLAB dims reversed into C row-major order
+shape = uint32(fliplr(size(data)));
+indptr = uint32([0, numel(shape)]);
+
+handle = libpointer('voidPtrPtr');
+rc = calllib('libmxtpu_predict', 'MXPredCreate', symbol_json, ...
+             param_bytes, numel(param_bytes), 1, 0, 1, {input_name}, ...
+             indptr, shape, handle);
+assert(rc == 0, mxtpu_last_error());
+
+flat = single(permute(data, ndims(data):-1:1));  % row-major flatten
+rc = calllib('libmxtpu_predict', 'MXPredSetInput', handle, input_name, ...
+             flat(:), numel(flat));
+assert(rc == 0, mxtpu_last_error());
+
+rc = calllib('libmxtpu_predict', 'MXPredForward', handle);
+assert(rc == 0, mxtpu_last_error());
+
+% output 0 shape, then the data
+dim = libpointer('uint32Ptr', 0);
+pshape = libpointer('uint32PtrPtr');
+rc = calllib('libmxtpu_predict', 'MXPredGetOutputShape', handle, 0, ...
+             pshape, dim);
+assert(rc == 0, mxtpu_last_error());
+setdatatype(pshape.Value, 'uint32Ptr', double(dim.Value));
+oshape = double(pshape.Value.Value(:)');
+
+n = prod(oshape);
+buf = libpointer('singlePtr', zeros(n, 1, 'single'));
+rc = calllib('libmxtpu_predict', 'MXPredGetOutput', handle, 0, buf, n);
+assert(rc == 0, mxtpu_last_error());
+
+out = reshape(buf.Value, fliplr(oshape));   % back to MATLAB column-major
+out = permute(out, numel(oshape):-1:1);
+
+calllib('libmxtpu_predict', 'MXPredFree', handle);
+end
+
+function msg = mxtpu_last_error()
+msg = calllib('libmxtpu_predict', 'MXGetLastError');
+end

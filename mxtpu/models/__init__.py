@@ -7,7 +7,9 @@ from . import lenet
 from . import alexnet
 from . import vgg
 from . import inception_bn
+from . import transformer
 from .mlp import get_symbol as get_mlp
+from .transformer import get_symbol as get_transformer_lm
 from .lenet import get_symbol as get_lenet
 from .resnet import get_symbol as get_resnet
 from .alexnet import get_symbol as get_alexnet

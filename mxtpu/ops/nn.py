@@ -201,6 +201,41 @@ register("BatchNorm", _batch_norm,
          num_outputs=lambda a: 3 if a.output_mean_var else 1,
          aliases=("BatchNorm_v1",))
 
+
+def _layer_norm(a, data, gamma, beta):
+    """Normalize over one axis with learned scale/shift (the transformer
+    family's workhorse; the reference gained nn.LayerNorm post-0.11 —
+    src/operator/nn/layer_norm.cc in later MXNet, whose extra outputs are
+    (mean, STD)). Statistics follow _batch_norm's traffic discipline: one
+    multi-output sum/sum-of-squares reduction with f32 accumulation and
+    the convert inlined, never a materialized f32 copy of the input."""
+    ax = int(a.get("axis", -1)) % data.ndim
+    n = data.shape[ax]
+    s1 = jnp.sum(data, axis=ax, keepdims=True, dtype=jnp.float32)
+    s2 = jnp.sum(jnp.square(data.astype(jnp.float32)), axis=ax,
+                 keepdims=True)
+    mean = s1 / n
+    # clamp: the E[x^2]-E[x]^2 cancellation can go slightly negative
+    var = jnp.maximum(s2 / n - jnp.square(mean), 0.0)
+    inv = lax.rsqrt(var + a.eps)
+    bshape = tuple(data.shape[ax] if i == ax else 1
+                   for i in range(data.ndim))
+    out32 = (data.astype(jnp.float32) - mean) * inv \
+        * gamma.astype(jnp.float32).reshape(bshape) \
+        + beta.astype(jnp.float32).reshape(bshape)
+    out = out32.astype(data.dtype)
+    if a.output_mean_var:
+        return (out,
+                jnp.squeeze(mean, ax).astype(data.dtype),
+                jnp.squeeze(jnp.sqrt(var + a.eps), ax).astype(data.dtype))
+    return out
+
+
+register("LayerNorm", _layer_norm,
+         arg_names=["data", "gamma", "beta"],
+         attrs={"eps": 1e-5, "axis": -1, "output_mean_var": False},
+         num_outputs=lambda a: 3 if a.output_mean_var else 1)
+
 # ---------------------------------------------------------------- activations
 
 
@@ -652,6 +687,15 @@ def _bn_infer(a, shapes):
 
 
 _get_op("BatchNorm").infer_args = _bn_infer
+
+
+def _ln_infer(a, shapes):
+    data = shapes[0]
+    c = (data[int(a.get("axis", -1)) % len(data)],)
+    return [data, c, c]
+
+
+_get_op("LayerNorm").infer_args = _ln_infer
 
 
 def _in_infer(a, shapes):

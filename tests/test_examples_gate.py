@@ -50,6 +50,21 @@ def test_lstm_bucketing_gate():
         "perplexity did not fall: %s" % (ppl,)
 
 
+def test_transformer_lm_gate():
+    """Transformer LM through examples/transformer_lm/train_lm.py:
+    perplexity falls AND the trained-weights seq-parallel ring-attention
+    check agrees with single-device flash attention."""
+    _example("transformer_lm", "train_lm.py")
+    import mxtpu as mx
+    import train_lm
+    mx.random.seed(7)  # deterministic init regardless of suite order
+    ppl = train_lm.main(["--epochs", "2", "--seq-len", "32",
+                         "--d-model", "64", "--num-heads", "4",
+                         "--seq-parallel"])
+    assert len(ppl) == 2
+    assert ppl[1] < ppl[0] * 0.8, "perplexity did not fall: %s" % (ppl,)
+
+
 def test_ssd_gate(tmp_path):
     """SSD through examples/ssd/train.py + evaluate.py: mAP on painted
     synthetic boxes must improve materially over the untrained net."""

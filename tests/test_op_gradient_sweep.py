@@ -100,6 +100,7 @@ SPECS = {
                       attrs={"fix_gamma": False, "use_global_stats": True},
                       aux="bn"),  # filled by suffix in the driver
     "InstanceNorm": dict(primary={"data": S4}),
+    "LayerNorm": dict(primary={"data": S}),
     "L2Normalization": dict(primary={"data": S}),
     "LRN": dict(primary={"data": S4}, attrs={"nsize": 3}),
     "Activation": dict(primary={"data": S}, attrs={"act_type": "tanh"}),
