@@ -121,3 +121,65 @@ def test_resnet_block_forward_consistency():
                         name="sc"), b), act_type="relu")
     check_consistency(net, _ctx_list(accel, data=(2, 4, 8, 8)),
                       rtol=2e-3, atol=2e-3)
+
+
+def test_pallas_flash_kernel_on_chip():
+    """The compiled (non-interpret) Pallas flash kernel must match the
+    reference attention math on the real chip — values and gradients.
+    CPU runs exercise the same kernel only in interpret mode, so this is
+    the one test that validates the Mosaic-lowered kernel itself.
+
+    Runs in a watchdogged subprocess: a wedged device relay hangs the
+    first jax call forever, and that must SKIP the tier, not hang it."""
+    import subprocess
+    import sys
+
+    # NO parent-process jax call here: against a wedged relay the first
+    # jax call hangs forever, and this test's contract is to skip, not
+    # hang — so the accelerator probe lives inside the subprocess too.
+    code = r"""
+import sys
+import numpy as np, jax, jax.numpy as jnp
+if jax.default_backend() == "cpu":
+    print("NO_ACCELERATOR")
+    sys.exit(0)
+from mxtpu.ops import attention as att
+rng = np.random.RandomState(0)
+B, H, T, D = 2, 4, 384, 64  # off-block-multiple T exercises the tail
+q = jnp.asarray(rng.randn(B, H, T, D).astype("float32") * 0.5)
+k = jnp.asarray(rng.randn(B, H, T, D).astype("float32") * 0.5)
+v = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+
+def ref(q, k, v):
+    s = jnp.einsum("bhtd,bhsd->bhts", q, k) / np.sqrt(D)
+    mask = np.tril(np.ones((T, T), bool))
+    s = jnp.where(mask, s, -1e30)
+    return jnp.einsum("bhts,bhsd->bhtd", jax.nn.softmax(s, axis=-1), v)
+
+with jax.default_matmul_precision("highest"):
+    out = att.flash_attention(q, k, v, causal=True, block_q=128,
+                              block_k=128)
+    expect = ref(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               rtol=2e-3, atol=2e-3)
+    g = jax.grad(lambda a, b, c: att.flash_attention(
+        a, b, c, causal=True).sum())(q, k, v)
+    g_ref = jax.grad(lambda a, b, c: ref(a, b, c).sum())(q, k, v)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=5e-3, atol=5e-3)
+print("PALLAS_ON_CHIP_OK")
+"""
+    import os
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    try:
+        r = subprocess.run([sys.executable, "-u", "-c", code], env=env,
+                           capture_output=True, text=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        pytest.skip("device relay hung during Mosaic compile/run "
+                    "(wedged tunnel)")
+    if "NO_ACCELERATOR" in r.stdout:
+        pytest.skip("subprocess saw no accelerator backend")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "PALLAS_ON_CHIP_OK" in r.stdout
