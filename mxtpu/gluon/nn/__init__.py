@@ -1,7 +1,7 @@
 """Gluon neural-network layers (parity: python/mxnet/gluon/nn/)."""
 from .basic_layers import (Activation, BatchNorm, Dense, Dropout, Embedding,
                            Flatten, HybridLambda, HybridSequential, Lambda,
-                           LeakyReLU, Sequential)
+                           LayerNorm, LeakyReLU, Sequential)
 from .conv_layers import (AvgPool1D, AvgPool2D, AvgPool3D, Conv1D,
                           Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D,
                           Conv3DTranspose, GlobalAvgPool1D, GlobalAvgPool2D,

@@ -196,6 +196,42 @@ class BatchNorm(HybridBlock):
                              for k, v in self._kwargs.items()]))
 
 
+class LayerNorm(HybridBlock):
+    """Layer normalization over the last axis (the transformer family's
+    norm; the reference Gluon gained nn.LayerNorm post-0.11 —
+    python/mxnet/gluon/nn/basic_layers.py in later MXNet)."""
+
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._kwargs = {"axis": axis, "eps": epsilon}
+        self.gamma = self.params.get("gamma",
+                                     grad_req="write" if scale else "null",
+                                     shape=(in_channels,),
+                                     init=_init_of(gamma_initializer),
+                                     allow_deferred_init=True,
+                                     differentiable=scale)
+        self.beta = self.params.get("beta",
+                                    grad_req="write" if center else "null",
+                                    shape=(in_channels,),
+                                    init=_init_of(beta_initializer),
+                                    allow_deferred_init=True,
+                                    differentiable=center)
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.LayerNorm(x, gamma, beta, **self._kwargs)
+
+    def __repr__(self):
+        s = "{name}({content}"
+        in_channels = self.gamma.shape[0] if self.gamma.shape else 0
+        s += ", in_channels={0})".format(in_channels)
+        return s.format(name=self.__class__.__name__,
+                        content=", ".join(
+                            ["=".join([k, v.__repr__()])
+                             for k, v in self._kwargs.items()]))
+
+
 class LeakyReLU(HybridBlock):
     def __init__(self, alpha, **kwargs):
         super().__init__(**kwargs)

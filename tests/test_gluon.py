@@ -231,3 +231,30 @@ def test_trainer_fused_sweep_matches_classic(tmp_path):
     for k in w_plain:
         np.testing.assert_allclose(w_fused[k], w_plain[k], rtol=2e-3,
                                    atol=2e-4, err_msg=k)
+
+
+def test_layernorm_block():
+    """nn.LayerNorm: deferred in_channels init, hybridized numerics vs
+    numpy, gradients flow to gamma/beta."""
+    from mxtpu import autograd
+    from mxtpu.gluon import nn
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.LayerNorm())
+    net.initialize()
+    net.hybridize()
+    rng = np.random.RandomState(0)
+    x = mx.nd.array((rng.randn(2, 5, 8) * 10 + 100).astype("float32"))
+    with autograd.record():
+        y = net(x)
+        loss = (y * y).mean()
+    loss.backward()
+    xn = x.asnumpy()
+    ref = (xn - xn.mean(-1, keepdims=True)) / \
+        np.sqrt(xn.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(y.asnumpy(), ref, rtol=1e-4, atol=1e-4)
+    params = net.collect_params()
+    gkey = [k for k in params.keys() if k.endswith("gamma")][0]
+    assert params[gkey].shape == (8,)  # deferred init resolved
+    assert float(np.abs(params[gkey].grad().asnumpy()).sum()) > 0
