@@ -233,9 +233,12 @@ class Executor:
     def _forward_profiled(self, is_train, raw_args, raw_aux, rng):
         """Node-at-a-time eager execution with a device sync + trace span
         per node: true per-layer timings for mx.profiler (the role of the
-        reference's per-op engine stats, src/engine/profiler.cc:152).
-        Slower than the fused program by design; only used while the
-        profiler is running in operator mode."""
+        reference's per-op engine stats, src/engine/profiler.cc:152) and
+        per-op tensor stats for mx.monitor (the reference's executor
+        monitor callback sees EVERY op output, not just graph outputs —
+        python/mxnet/monitor.py stat_helper). Slower than the fused
+        program by design; only used while a profiler or monitor is
+        active."""
         from . import profiler as _prof
         topo = self._symbol._topo()
         node_index = {id(n): i for i, n in enumerate(topo)}
@@ -262,6 +265,10 @@ class Executor:
                               t0, _time.perf_counter() * 1e6,
                               category=node.op.name)
             n_vis = node.op.n_out(attrs)
+            if self._monitor_callback is not None:
+                from .symbol.symbol import _output_names
+                for i, oname in enumerate(_output_names(node, n_vis)):
+                    self._monitor_callback(oname, NDArray(outs[i], self._ctx))
             for i in range(n_vis):
                 env[(id(node), i)] = outs[i]
             if node.op.aux_names and len(outs) > n_vis:
@@ -283,7 +290,13 @@ class Executor:
         rng = _rnd.next_key()
         raw_args, raw_aux = self._raw_args(), self._raw_aux()
         from . import profiler as _prof
-        if _prof.ops_enabled():
+        # monitor parity needs per-op outputs, but only on batches the
+        # monitor is actually sampling (Monitor.tic arms `activated`);
+        # off-interval batches keep the fused program
+        mon_active = (self._monitor_callback is not None and
+                      getattr(self._monitor_callback, "is_active",
+                              lambda: True)())
+        if _prof.ops_enabled() or mon_active:
             self._fwd_snapshot = (raw_args, raw_aux, rng)
             outs, auxu = self._forward_profiled(is_train, raw_args, raw_aux,
                                                 rng)
@@ -306,11 +319,7 @@ class Executor:
             self._pending_grads = None
         if is_train:
             self._apply_aux(auxu)
-        outputs = self._wrap_outputs(outs)
-        if self._monitor_callback is not None:
-            for name, arr in zip(self.output_names, outputs):
-                self._monitor_callback(name, arr)
-        return outputs
+        return self._wrap_outputs(outs)
 
     def backward(self, out_grads=None, is_train=True):
         if not self._grad_arg_names():
