@@ -240,6 +240,10 @@ class Executor:
         program by design; only used while a profiler or monitor is
         active."""
         from . import profiler as _prof
+        from .symbol.symbol import _output_names
+        mon_live = (self._monitor_callback is not None and
+                    getattr(self._monitor_callback, "is_active",
+                            lambda: True)())
         topo = self._symbol._topo()
         node_index = {id(n): i for i, n in enumerate(topo)}
         aux_nodes = self._symbol._aux_node_set()
@@ -265,8 +269,7 @@ class Executor:
                               t0, _time.perf_counter() * 1e6,
                               category=node.op.name)
             n_vis = node.op.n_out(attrs)
-            if self._monitor_callback is not None:
-                from .symbol.symbol import _output_names
+            if mon_live:
                 for i, oname in enumerate(_output_names(node, n_vis)):
                     self._monitor_callback(oname, NDArray(outs[i], self._ctx))
             for i in range(n_vis):
@@ -310,6 +313,7 @@ class Executor:
         # masks, pre-update aux) instead of a fresh stochastic forward
         self._fwd_snapshot = (raw_args, raw_aux, rng)
         want_grad = bool(self._grad_arg_names())
+        self._profiled_pending = False  # this forward is fused, not eager
         if is_train and want_grad:
             outs, auxu, grads = self._get_fn("fwd_bwd")(raw_args, raw_aux, rng)
             self._pending_grads = grads
@@ -392,6 +396,12 @@ class Executor:
                     raise MXNetError("Found name \"%s\" not in aux states" % name)
 
     def set_monitor_callback(self, callback):
+        """Install a per-op output callback (reference parity: the monitor
+        sees EVERY op's outputs). While the callback is active, forwards
+        run node-at-a-time — much slower than the fused program, and a
+        training backward recomputes the fused forward. Attach an
+        ``is_active`` attribute returning False on unsampled batches (as
+        mx.monitor.Monitor does) to keep those on the fast path."""
         self._monitor_callback = callback
 
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):

@@ -107,3 +107,17 @@ def test_train_imagenet_on_packed_rec(tmp_path):
         "--batch-size", "16", "--num-epochs", "2", "--kv-store", "local",
         "--speedometer-period", "2"])
     assert speed > 0, "no steady-state throughput measured"
+
+
+def test_gluon_word_lm_gate():
+    """Imperative Gluon LSTM LM through examples/gluon/word_language_model
+    (parity: the reference's example/gluon/word_language_model): validation
+    perplexity must fall on the synthetic Markov corpus."""
+    _example("gluon", "word_language_model.py")
+    import mxtpu as mx
+    import word_language_model
+    mx.random.seed(11)
+    ppl = word_language_model.main(["--epochs", "4", "--n-tokens", "8000",
+                                    "--num-hidden", "48", "--lr", "2"])
+    assert len(ppl) == 4
+    assert ppl[-1] < ppl[0] * 0.5, "val ppl did not fall: %s" % (ppl,)
