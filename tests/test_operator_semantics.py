@@ -1,0 +1,216 @@
+"""Semantic value oracles for the trickier operators — the parts of the
+reference's test_operator.py (tests/python/unittest/test_operator.py)
+beyond elementwise/np-trivial ops: indexing/gather families, ordering,
+padding, shape manipulators, grouped/dilated convolution, pooling
+conventions, and sampling ops. Every test compares against an
+independent numpy computation."""
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import nd
+
+
+def _rand(shape, seed=0, lo=-2.0, hi=2.0):
+    rng = np.random.RandomState(seed)
+    return rng.uniform(lo, hi, size=shape).astype("float32")
+
+
+def test_take_axis0_oracle():
+    w = _rand((5, 3))
+    idx = np.array([0, 4, 2, 2], "float32")
+    out = nd.take(nd.array(w), nd.array(idx)).asnumpy()
+    np.testing.assert_allclose(out, w[idx.astype(int)])
+
+
+def test_gather_nd_oracle():
+    x = _rand((3, 4, 5))
+    # indices (M, N): M leading dims indexed, trailing dims kept
+    ind = np.array([[0, 2, 1], [3, 0, 2]], "float32")  # (2, N=3)
+    out = nd.gather_nd(nd.array(x), nd.array(ind)).asnumpy()
+    ref = x[ind[0].astype(int), ind[1].astype(int)]
+    np.testing.assert_allclose(out, ref)
+
+
+def test_one_hot_on_off_values():
+    out = nd.one_hot(nd.array([1.0, 0.0, 3.0]), depth=4, on_value=7.0,
+                     off_value=-1.0).asnumpy()
+    ref = np.full((3, 4), -1.0, "float32")
+    for i, j in enumerate([1, 0, 3]):
+        ref[i, j] = 7.0
+    np.testing.assert_allclose(out, ref)
+
+
+def test_topk_value_and_indices():
+    x = _rand((3, 8), seed=3)
+    idx = nd.topk(nd.array(x), k=3, axis=-1).asnumpy()  # ret_typ=indices
+    vals = nd.topk(nd.array(x), k=3, axis=-1, ret_typ="value").asnumpy()
+    ref_idx = np.argsort(-x, axis=-1)[:, :3]
+    np.testing.assert_allclose(idx, ref_idx.astype("float32"))
+    np.testing.assert_allclose(vals, -np.sort(-x, axis=-1)[:, :3], rtol=1e-6)
+
+
+def test_sort_argsort_descending():
+    x = _rand((4, 6), seed=5)
+    np.testing.assert_allclose(
+        nd.sort(nd.array(x), axis=-1, is_ascend=False).asnumpy(),
+        -np.sort(-x, axis=-1), rtol=1e-6)
+    np.testing.assert_allclose(
+        nd.argsort(nd.array(x), axis=-1).asnumpy(),
+        np.argsort(x, axis=-1).astype("float32"))
+
+
+def test_pad_constant_and_edge():
+    x = _rand((1, 2, 3, 4), seed=7)
+    pw = (0, 0, 0, 0, 1, 2, 2, 1)
+    out_c = nd.Pad(nd.array(x), mode="constant", pad_width=pw,
+                   constant_value=3.5).asnumpy()
+    ref_c = np.pad(x, ((0, 0), (0, 0), (1, 2), (2, 1)), mode="constant",
+                   constant_values=3.5)
+    np.testing.assert_allclose(out_c, ref_c)
+    out_e = nd.Pad(nd.array(x), mode="edge", pad_width=pw).asnumpy()
+    ref_e = np.pad(x, ((0, 0), (0, 0), (1, 2), (2, 1)), mode="edge")
+    np.testing.assert_allclose(out_e, ref_e)
+
+
+def test_tile_repeat_flip_swapaxis():
+    x = _rand((2, 3, 4), seed=9)
+    np.testing.assert_allclose(nd.tile(nd.array(x), reps=(2, 1, 3)).asnumpy(),
+                               np.tile(x, (2, 1, 3)))
+    np.testing.assert_allclose(
+        nd.repeat(nd.array(x), repeats=3, axis=1).asnumpy(),
+        np.repeat(x, 3, axis=1))
+    np.testing.assert_allclose(nd.flip(nd.array(x), axis=2).asnumpy(),
+                               x[:, :, ::-1])
+    np.testing.assert_allclose(
+        nd.SwapAxis(nd.array(x), dim1=0, dim2=2).asnumpy(),
+        np.swapaxes(x, 0, 2))
+
+
+def test_broadcast_axis_oracle():
+    x = _rand((1, 3, 1), seed=11)
+    out = nd.broadcast_axis(nd.array(x), axis=(0, 2), size=(4, 2)).asnumpy()
+    np.testing.assert_allclose(out, np.broadcast_to(x, (4, 3, 2)))
+
+
+def test_batch_dot_transpose_flags():
+    a = _rand((2, 3, 4), seed=13)
+    b = _rand((2, 5, 4), seed=14)
+    out = nd.batch_dot(nd.array(a), nd.array(b), transpose_b=True).asnumpy()
+    ref = np.einsum("bik,bjk->bij", a, b)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    out2 = nd.batch_dot(nd.array(a.transpose(0, 2, 1)), nd.array(b),
+                        transpose_a=True, transpose_b=True).asnumpy()
+    np.testing.assert_allclose(out2, ref, rtol=1e-5)
+
+
+def test_grouped_convolution_oracle():
+    """num_group=C_in == depthwise: each output channel sees one input
+    channel (reference conv with num_group, src/operator/convolution)."""
+    c, h = 4, 6
+    x = _rand((2, c, h, h), seed=17)
+    w = _rand((c, 1, 3, 3), seed=18)
+    out = nd.Convolution(nd.array(x), weight=nd.array(w), no_bias=True,
+                         kernel=(3, 3), num_filter=c, num_group=c).asnumpy()
+    # per-channel correlate oracle
+    ref = np.zeros((2, c, h - 2, h - 2), "float32")
+    for n in range(2):
+        for ch in range(c):
+            for i in range(h - 2):
+                for j in range(h - 2):
+                    ref[n, ch, i, j] = (x[n, ch, i:i + 3, j:j + 3]
+                                        * w[ch, 0]).sum()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_dilated_convolution_oracle():
+    x = _rand((1, 1, 7, 7), seed=19)
+    w = _rand((1, 1, 3, 3), seed=20)
+    out = nd.Convolution(nd.array(x), weight=nd.array(w), no_bias=True,
+                         kernel=(3, 3), num_filter=1,
+                         dilate=(2, 2)).asnumpy()
+    ref = np.zeros((1, 1, 3, 3), "float32")
+    for i in range(3):
+        for j in range(3):
+            patch = x[0, 0, i:i + 5:2, j:j + 5:2]
+            ref[0, 0, i, j] = (patch * w[0, 0]).sum()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_pooling_conventions():
+    """'valid' floors the output size, 'full' ceils (pooling-inl.h
+    pooling_convention); avg pooling divides by the window size."""
+    x = _rand((1, 1, 5, 5), seed=21)
+    val = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                     pool_type="max").asnumpy()
+    assert val.shape == (1, 1, 2, 2)
+    full = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                      pool_type="max",
+                      pooling_convention="full").asnumpy()
+    assert full.shape == (1, 1, 3, 3)
+    np.testing.assert_allclose(full[0, 0, 2, 2], x[0, 0, 4, 4])
+    g = nd.Pooling(nd.array(x), kernel=(2, 2), pool_type="avg",
+                   global_pool=True).asnumpy()
+    np.testing.assert_allclose(g.reshape(()), x.mean(), rtol=1e-6)
+
+
+def test_upsampling_nearest_oracle():
+    x = _rand((1, 2, 3, 3), seed=23)
+    out = nd.UpSampling(nd.array(x), scale=2,
+                        sample_type="nearest").asnumpy()
+    ref = x.repeat(2, axis=2).repeat(2, axis=3)
+    np.testing.assert_allclose(out, ref)
+
+
+def test_sequence_ops_oracle():
+    """SequenceMask/SequenceLast/SequenceReverse with per-batch lengths
+    (sequence_mask.cc et al: axis 0 is time)."""
+    T, B, D = 4, 3, 2
+    x = _rand((T, B, D), seed=25)
+    lens = np.array([2, 4, 1], "float32")
+    m = nd.SequenceMask(nd.array(x), nd.array(lens),
+                        use_sequence_length=True, value=-9.0).asnumpy()
+    ref = x.copy()
+    for b, l in enumerate(lens.astype(int)):
+        ref[l:, b, :] = -9.0
+    np.testing.assert_allclose(m, ref)
+    last = nd.SequenceLast(nd.array(x), nd.array(lens),
+                           use_sequence_length=True).asnumpy()
+    np.testing.assert_allclose(
+        last, np.stack([x[int(l) - 1, b] for b, l in enumerate(lens)]))
+    rev = nd.SequenceReverse(nd.array(x), nd.array(lens),
+                             use_sequence_length=True).asnumpy()
+    for b, l in enumerate(lens.astype(int)):
+        np.testing.assert_allclose(rev[:l, b], x[:l, b][::-1])
+        np.testing.assert_allclose(rev[l:, b], x[l:, b])
+
+
+def test_slice_axis_oracle():
+    x = _rand((4, 6), seed=27)
+    out = nd.slice_axis(nd.array(x), axis=1, begin=1, end=5).asnumpy()
+    np.testing.assert_allclose(out, x[:, 1:5])
+    neg = nd.slice_axis(nd.array(x), axis=0, begin=-2, end=None).asnumpy()
+    np.testing.assert_allclose(neg, x[-2:])
+
+
+def test_grid_generator_bilinear_sampler_identity():
+    """An affine identity grid sampled bilinearly reproduces the input
+    (spatial transformer pair, grid_generator.cc + bilinear_sampler.cc)."""
+    x = _rand((1, 1, 5, 5), seed=29)
+    theta = nd.array(np.array([[1, 0, 0, 0, 1, 0]], "float32"))
+    grid = nd.GridGenerator(theta, transform_type="affine",
+                            target_shape=(5, 5))
+    out = nd.BilinearSampler(nd.array(x), grid).asnumpy()
+    np.testing.assert_allclose(out, x, rtol=1e-5, atol=1e-5)
+
+
+def test_reduction_axis_keepdims_matrix():
+    x = _rand((2, 3, 4), seed=31)
+    for op, ref in [("sum", np.sum), ("max", np.max), ("min", np.min),
+                    ("prod", np.prod), ("mean", np.mean)]:
+        out = getattr(nd, op)(nd.array(x), axis=(0, 2),
+                              keepdims=True).asnumpy()
+        np.testing.assert_allclose(out, ref(x, axis=(0, 2), keepdims=True),
+                                   rtol=1e-5)
+    # negative axis
+    np.testing.assert_allclose(nd.sum(nd.array(x), axis=-1).asnumpy(),
+                               x.sum(-1), rtol=1e-5)
