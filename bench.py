@@ -74,7 +74,7 @@ def main():
     from mxtpu.models import resnet
 
     batch = int(float(os.environ.get("BENCH_BATCH", 256)))
-    iters = int(float(os.environ.get("BENCH_ITERS", 30)))
+    iters = int(float(os.environ.get("BENCH_ITERS", 60)))
 
     sym = resnet.get_symbol(num_classes=1000, num_layers=50,
                             image_shape=(3, 224, 224))

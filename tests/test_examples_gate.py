@@ -121,3 +121,16 @@ def test_gluon_word_lm_gate():
                                     "--num-hidden", "48", "--lr", "2"])
     assert len(ppl) == 4
     assert ppl[-1] < ppl[0] * 0.5, "val ppl did not fall: %s" % (ppl,)
+
+
+def test_gluon_super_resolution_gate():
+    """ESPCN-style super resolution through examples/gluon/
+    super_resolution.py (parity: the reference's gluon example): val PSNR
+    must rise clearly above the untrained net's."""
+    _example("gluon", "super_resolution.py")
+    import mxtpu as mx
+    import super_resolution
+    mx.random.seed(3)
+    psnrs = super_resolution.main(["--epochs", "2"])
+    assert psnrs[-1] > psnrs[0] + 3.0, \
+        "PSNR did not improve enough: %s" % (psnrs,)
