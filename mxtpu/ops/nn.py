@@ -82,18 +82,41 @@ register("Convolution", _convolution,
 
 
 def _deconvolution(a, data, weight, bias=None):
+    """Transposed convolution as the explicit gradient-of-conv form:
+    lhs_dilation=stride + spatially-flipped weight. Weight layout is the
+    reference's (C_in, C_out/g, *k) (deconvolution-inl.h); verified
+    element-for-element against torch.nn.functional.conv_transpose
+    across channel/stride/pad/output_padding/group combinations
+    (tests/test_operator_semantics.py)."""
     nd = len(a.kernel)
+    k = tuple(int(x) for x in a.kernel)
     stride = _tup(a.stride, nd, 1)
+    dilate = _tup(a.dilate, nd, 1)
     pad = _tup(a.pad, nd, 0)
     adj = _tup(a.adj, nd, 0)
-    # transposed conv == gradient of forward conv; weight layout IOHW like the ref
-    out = lax.conv_transpose(
-        data, weight, strides=stride,
-        padding=[(p, p - adj[i]) for i, p in enumerate(pad)],
-        dimension_numbers=(_CONV_DNUMS[nd][0],
-                           _CONV_DNUMS[nd][1].replace("O", "X").replace("I", "O").replace("X", "I"),
-                           _CONV_DNUMS[nd][2]),
-        transpose_kernel=True)
+    g = int(a.num_group)
+    ke = tuple(dilate[i] * (k[i] - 1) + 1 for i in range(nd))  # effective
+    if a.target_shape:
+        tgt = _tup(a.target_shape, nd, 0)
+        adj = tuple(
+            tgt[i] - ((data.shape[2 + i] - 1) * stride[i]
+                      - 2 * pad[i] + ke[i])
+            for i in range(nd))
+    ci = weight.shape[0]
+    co = weight.shape[1] * g
+    w = weight[(slice(None), slice(None)) + (slice(None, None, -1),) * nd]
+    # (C_in, C_out/g, *k) -> blockwise (C_out, C_in/g, *k) so XLA's grouped
+    # conv sees the standard O/I layout
+    w = w.reshape((g, ci // g, co // g) + k)
+    w = jnp.swapaxes(w, 1, 2).reshape((co, ci // g) + k)
+    out = lax.conv_general_dilated(
+        data, w, window_strides=(1,) * nd,
+        padding=[(ke[i] - 1 - pad[i], ke[i] - 1 - pad[i] + adj[i])
+                 for i in range(nd)],
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=_CONV_DNUMS[nd],
+        feature_group_count=g)
     if bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * nd)
     return out

@@ -134,3 +134,18 @@ def test_gluon_super_resolution_gate():
     psnrs = super_resolution.main(["--epochs", "2"])
     assert psnrs[-1] > psnrs[0] + 3.0, \
         "PSNR did not improve enough: %s" % (psnrs,)
+
+
+def test_gluon_dcgan_gate():
+    """DCGAN through examples/gluon/dcgan.py (parity: the reference's
+    example/gluon/dcgan.py): the Conv2DTranspose generator must at some
+    point genuinely fool the discriminator (min fake-detection < 0.9,
+    vs ~1.0 against an untrained generator)."""
+    _example("gluon", "dcgan.py")
+    import mxtpu as mx
+    import dcgan
+    mx.random.seed(5)
+    acc0, min_acc = dcgan.main(["--epochs", "4"])
+    assert min_acc < 0.9, \
+        "generator never fooled the discriminator: first=%s min=%s" \
+        % (acc0, min_acc)

@@ -214,3 +214,39 @@ def test_reduction_axis_keepdims_matrix():
     # negative axis
     np.testing.assert_allclose(nd.sum(nd.array(x), axis=-1).asnumpy(),
                                x.sum(-1), rtol=1e-5)
+
+
+def test_deconvolution_torch_oracle():
+    """Deconvolution matches torch.conv_transpose2d element-for-element
+    across channels/stride/pad/output_padding/groups (the reference's
+    (C_in, C_out/g, kH, kW) weight convention, deconvolution-inl.h).
+    Guards the transposed-channel bug that C_in == C_out shapes hide."""
+    torch = __import__("torch")
+    F = torch.nn.functional
+    rng = np.random.RandomState(0)
+    cases = [(3, 5, 4, 2, 1, 0, 1, 1), (16, 8, 4, 1, 0, 0, 1, 1),
+             (4, 4, 3, 1, 1, 0, 1, 1), (2, 3, 4, 2, 1, 1, 1, 1),
+             (4, 6, 3, 2, 1, 0, 2, 1), (3, 4, 3, 2, 1, 0, 1, 2),
+             (4, 6, 3, 1, 2, 0, 2, 2)]
+    for ci, co, k, s, p, a, g, d in cases:
+        x = rng.randn(2, ci, 5, 5).astype("float32")
+        w = rng.randn(ci, co // g, k, k).astype("float32")
+        ref = F.conv_transpose2d(torch.from_numpy(x), torch.from_numpy(w),
+                                 stride=s, padding=p, output_padding=a,
+                                 groups=g, dilation=d).numpy()
+        out = nd.Deconvolution(nd.array(x), weight=nd.array(w),
+                               kernel=(k, k), num_filter=co, stride=(s, s),
+                               pad=(p, p), adj=(a, a), dilate=(d, d),
+                               num_group=g).asnumpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4,
+                                   err_msg=str((ci, co, k, s, p, a, g, d)))
+    # target_shape overrides adj (deconvolution-inl.h target_shape)
+    x = rng.randn(1, 2, 4, 4).astype("float32")
+    w = rng.randn(2, 3, 4, 4).astype("float32")
+    out = nd.Deconvolution(nd.array(x), weight=nd.array(w), kernel=(4, 4),
+                           num_filter=3, stride=(2, 2), pad=(1, 1),
+                           target_shape=(9, 9)).asnumpy()
+    assert out.shape == (1, 3, 9, 9)
+    ref = F.conv_transpose2d(torch.from_numpy(x), torch.from_numpy(w),
+                             stride=2, padding=1, output_padding=1).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
