@@ -264,7 +264,8 @@ class FusedTrainStep:
     # ------------------------------------------------ state staging
     def _put(self, v, spec=P()):
         if self._mesh is not None:
-            return jax.device_put(v, NamedSharding(self._mesh, spec))
+            from ..parallel.mesh import mesh_put
+            return mesh_put(self._mesh, v, spec)  # multi-host safe
         return jax.device_put(v, self.devices[0])
 
     def load(self, arg_params, aux_params):

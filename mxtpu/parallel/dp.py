@@ -52,6 +52,10 @@ def _adam(p, g, m, v, lr, b1, b2, eps, wd, rescale, t):
     return p - lr * mhat / (jnp.sqrt(vhat) + eps), m_new, v_new
 
 
+
+from .mesh import mesh_put as _mesh_put  # multi-host-safe placement
+
+
 class DataParallelTrainer:
     """Whole-step-fused trainer for a Symbol over a device mesh."""
 
@@ -122,13 +126,15 @@ class DataParallelTrainer:
         else:
             self._ospecs = pspecs
         self._params = {
-            n: jax.device_put(v, NamedSharding(self.mesh, pspecs[n]))
+            n: _mesh_put(self.mesh, v, pspecs[n])
             for n, v in params.items()}
-        self._aux = {n: jax.device_put(v, NamedSharding(self.mesh, P()))
+        self._aux = {n: _mesh_put(self.mesh, v, P())
                      for n, v in aux.items()}
         def put_state(n, v):
-            return jax.device_put(jnp.zeros_like(v),
-                                  NamedSharding(self.mesh, self._ospecs[n]))
+            # zeros from metadata: materializing zeros_like(v) on device
+            # and pulling it back would round-trip every state buffer
+            zeros = _np.zeros(v.shape, v.dtype)
+            return _mesh_put(self.mesh, zeros, self._ospecs[n])
 
         if self.optimizer in ("sgd", "nag") and self.momentum:
             self._opt_state = {n: put_state(n, v)
@@ -219,8 +225,7 @@ class DataParallelTrainer:
         for n in self.data_names + self.label_names:
             v = batch[n]
             arr = getattr(v, "_data", v)
-            b[n] = jax.device_put(
-                jnp.asarray(arr), NamedSharding(self.mesh, P("data")))
+            b[n] = _mesh_put(self.mesh, arr, P("data"))
         rng = _rnd.next_key()
         self._params, self._aux, self._opt_state, outs = self._step_fn(
             self._params, self._aux, self._opt_state, b, rng,

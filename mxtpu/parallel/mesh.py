@@ -6,7 +6,43 @@ from __future__ import annotations
 import numpy as _np
 
 import jax
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding
+
+
+def _spans_processes(mesh):
+    """Whether the mesh includes devices of other processes (cached —
+    meshes are immutable and this runs on the training hot path)."""
+    cached = _SPANS.get(id(mesh))
+    if cached is None:
+        me = jax.process_index()
+        cached = any(d.process_index != me for d in mesh.devices.flat)
+        _SPANS[id(mesh)] = cached
+    return cached
+
+
+_SPANS = {}
+
+
+def mesh_put(mesh, value, spec):
+    """Place ``value`` onto NamedSharding(mesh, spec), multi-host safe.
+
+    Single-process meshes use plain ``device_put``. On a process-spanning
+    mesh ``device_put`` of host data would need cross-host transfers for
+    non-addressable shards, so: host values go through
+    ``make_array_from_callback`` (each process materializes only the
+    shards its own devices hold, slicing the SAME global value — SPMD
+    callers pass identical data), and already-global jax Arrays reshard
+    through a jitted identity, which lowers to collectives."""
+    sharding = NamedSharding(mesh, spec)
+    if not _spans_processes(mesh):
+        return jax.device_put(value, sharding)
+    if isinstance(value, jax.Array) and not value.is_fully_addressable:
+        if value.sharding == sharding:
+            return value
+        return jax.jit(lambda x: x, out_shardings=sharding)(value)
+    value = _np.asarray(value)
+    return jax.make_array_from_callback(value.shape, sharding,
+                                        lambda idx: value[idx])
 
 _current = None
 
