@@ -197,3 +197,43 @@ def kvstore_rank(h):
 
 def kvstore_num_workers(h):
     return int(_get(h).num_workers)
+
+
+# ------------------------------------------------- imperative op invoke
+def list_all_op_names():
+    from .ops.registry import list_ops
+    return sorted(set(list_ops()))
+
+
+def imperative_invoke(op_name, in_handles, keys, vals):
+    """Generic op call (reference MXImperativeInvoke, c_api.h): inputs are
+    NDArray handles, keys/vals are string attrs parsed by the op's spec;
+    returns a list of new output handles."""
+    import ast
+
+    from . import ndarray as nd
+    from .ops.registry import Required, get_op
+
+    op = get_op(str(op_name))
+    arrays = [_get(h) for h in in_handles]
+    kwargs = {}
+    spec = op.attrs_spec
+    for k, v in zip(keys, vals):
+        k, v = str(k), str(v)
+        default = spec.get(k)
+        proto = default.proto if isinstance(default, Required) else default
+        if k in spec and proto is None:
+            # untyped attr (e.g. axis defaulting to None): best-effort
+            # literal parse, the dmlc::Parameter behavior. Typed attrs
+            # stay strings — op.parse_attrs converts them downstream.
+            try:
+                kwargs[k] = ast.literal_eval(v)
+            except (ValueError, SyntaxError):
+                kwargs[k] = v
+        else:
+            kwargs[k] = v
+    fn = getattr(nd, op.name)
+    outs = fn(*arrays, **kwargs)
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    return [_register(o) for o in outs]

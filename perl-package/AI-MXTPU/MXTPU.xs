@@ -378,3 +378,40 @@ mxtpu__kvstore_group_size(h)
     RETVAL = r;
   OUTPUT:
     RETVAL
+
+void
+mxtpu__imperative_invoke(op_name, in_ref, keys_ref, vals_ref)
+    const char *op_name
+    SV *in_ref
+    SV *keys_ref
+    SV *vals_ref
+  PPCODE:
+    AV *iav = (AV *)SvRV(in_ref);
+    AV *kav = (AV *)SvRV(keys_ref);
+    AV *vav = (AV *)SvRV(vals_ref);
+    mx_uint ni = (mx_uint)(av_len(iav) + 1);
+    mx_uint np = (mx_uint)(av_len(kav) + 1);
+    if ((mx_uint)(av_len(vav) + 1) != np)
+        croak("imperative_invoke: %u keys but %ld vals", np,
+              (long)(av_len(vav) + 1));
+    NDArrayHandle *ins;
+    const char **keys;
+    const char **vals;
+    Newx(ins, ni ? ni : 1, NDArrayHandle);
+    SAVEFREEPV(ins);
+    Newx(keys, np ? np : 1, const char *);
+    SAVEFREEPV(keys);
+    Newx(vals, np ? np : 1, const char *);
+    SAVEFREEPV(vals);
+    for (mx_uint i = 0; i < ni; ++i)
+        ins[i] = INT2PTR(void *, SvUV(*av_fetch(iav, i, 0)));
+    for (mx_uint i = 0; i < np; ++i) {
+        keys[i] = SvPV_nolen(*av_fetch(kav, i, 0));
+        vals[i] = SvPV_nolen(*av_fetch(vav, i, 0));
+    }
+    mx_uint no;
+    NDArrayHandle *outs;
+    if (MXImperativeInvoke(op_name, ni, ins, &no, &outs, np, keys, vals) != 0)
+        croak("MXImperativeInvoke(%s): %s", op_name, MXGetLastError());
+    for (mx_uint i = 0; i < no; ++i)
+        XPUSHs(sv_2mortal(newSVuv(PTR2UV(outs[i]))));

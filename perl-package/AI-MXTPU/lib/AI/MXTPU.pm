@@ -15,6 +15,17 @@ XSLoader::load('AI::MXTPU', $VERSION);
 our %DTYPE = (float32 => 0, float64 => 1, float16 => 2, uint8 => 3,
               int32 => 4, int8 => 5, int64 => 6, bfloat16 => 7);
 
+sub invoke {
+    # AI::MXTPU::invoke($op_name, [@ndarrays], %string_attrs) -> NDArray(s)
+    my ($op, $ins, %attrs) = @_;
+    my @keys = sort keys %attrs;
+    my @vals = map { "$attrs{$_}" } @keys;
+    my @hs = map { $_->handle } @$ins;
+    my @out = AI::MXTPU::_imperative_invoke($op, \@hs, \@keys, \@vals);
+    my @wrapped = map { AI::MXTPU::NDArray->_new_from_handle($_) } @out;
+    return wantarray ? @wrapped : $wrapped[0];
+}
+
 # ------------------------------------------------------------------ NDArray
 package AI::MXTPU::NDArray;
 use strict;

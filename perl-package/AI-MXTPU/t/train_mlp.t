@@ -95,4 +95,10 @@ for my $i (0 .. $#$a) {
 }
 cmp_ok($maxd, '<', 1e-6, 'save/load roundtrip is exact');
 
+# generic imperative op dispatch from perl (MXImperativeInvoke)
+my $ia = AI::MXTPU::NDArray->from_list([2, 3], [1, 2, 3, 4, 5, 6]);
+my $sum = AI::MXTPU::invoke('sum', [$ia], axis => 1, keepdims => 1);
+is_deeply([map { 0 + $_ } @{ $sum->aslist }], [6, 15],
+          'imperative sum(axis=1) from perl');
+
 done_testing();

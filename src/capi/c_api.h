@@ -43,6 +43,19 @@ int MXNDArrayLoad(const char *fname, mx_uint *out_size,
                   NDArrayHandle **out_arr, mx_uint *out_name_size,
                   const char ***out_names);
 
+/* ---------------- Imperative ops ---------------- */
+/* Generic op invocation (reference MXImperativeInvoke): run ANY of the
+ * registered operators on NDArray handles. param_keys/param_vals are
+ * string attrs parsed through the op's parameter spec, exactly like the
+ * reference's dmlc::Parameter string parsing. *num_outputs/*outputs
+ * (and MXListAllOpNames' outputs) are backed by per-thread arenas valid
+ * until the next call on the same thread. */
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
+int MXImperativeInvoke(const char *op_name, mx_uint num_inputs,
+                       NDArrayHandle *inputs, mx_uint *num_outputs,
+                       NDArrayHandle **outputs, mx_uint num_params,
+                       const char **param_keys, const char **param_vals);
+
 /* ---------------- Symbol ---------------- */
 int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
 int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json);

@@ -291,6 +291,50 @@ MXTPU_SYM_LIST(MXSymbolListOutputs, "symbol_list_outputs")
 MXTPU_SYM_LIST(MXSymbolListAuxiliaryStates, "symbol_list_aux")
 #undef MXTPU_SYM_LIST
 
+/* ---------------- Imperative ops ---------------- */
+
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge("list_all_op_names", PyTuple_New(0));
+  if (res == nullptr) return -1;
+  StringListOut(res, out_size, out_array);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXImperativeInvoke(const char *op_name, mx_uint num_inputs,
+                       NDArrayHandle *inputs, mx_uint *num_outputs,
+                       NDArrayHandle **outputs, mx_uint num_params,
+                       const char **param_keys, const char **param_vals) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *ins = PyList_New(num_inputs);
+  for (mx_uint i = 0; i < num_inputs; ++i) {
+    PyList_SetItem(ins, i, PyLong_FromLong(HandleToId(inputs[i])));
+  }
+  PyObject *keys = PyList_New(num_params);
+  PyObject *vals = PyList_New(num_params);
+  for (mx_uint i = 0; i < num_params; ++i) {
+    PyList_SetItem(keys, i, PyUnicode_FromString(param_keys[i]));
+    PyList_SetItem(vals, i, PyUnicode_FromString(param_vals[i]));
+  }
+  PyObject *res = CallBridge(
+      "imperative_invoke",
+      Py_BuildValue("(sNNN)", op_name, ins, keys, vals));
+  if (res == nullptr) return -1;
+  g_handle_arena.clear();
+  Py_ssize_t n = PyList_Size(res);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    g_handle_arena.push_back(reinterpret_cast<void *>(
+        PyLong_AsLong(PyList_GetItem(res, i))));
+  }
+  Py_DECREF(res);
+  *num_outputs = static_cast<mx_uint>(n);
+  *outputs = g_handle_arena.data();
+  return 0;
+}
+
 /* ---------------- Executor ---------------- */
 
 int MXExecutorSimpleBind(SymbolHandle sym, int dev_type, int dev_id,
