@@ -66,7 +66,36 @@ def _null_metric():
     return _Null()
 
 
+def _wait_for_backend():
+    """Probe backend init in SUBPROCESSES first: a wedged device relay
+    hangs the first jax call forever, and a hang in a child is retryable
+    while a hang in this process is not. Bounded by BENCH_WAIT_TRIES."""
+    import subprocess
+    tries = int(float(os.environ.get("BENCH_WAIT_TRIES", 3)))
+    err = b""
+    for i in range(tries):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-u", "-c", "import jax; jax.devices()"],
+                capture_output=True, timeout=90)
+            if r.returncode == 0:
+                return True
+            err = r.stderr[-400:]
+        except subprocess.TimeoutExpired:
+            err = b"probe timed out (hung backend init)"
+        if i < tries - 1:
+            time.sleep(30)
+    if tries:
+        sys.stderr.write("bench: backend probe failed: %s\n"
+                         % err.decode("utf-8", "replace"))
+    return tries == 0  # explicit opt-out is not a failure
+
+
 def main():
+    if not _wait_for_backend():
+        # keep going anyway: the in-process watchdog still bounds a hang,
+        # and a CPU fallback run is better than no measurement
+        sys.stderr.write("bench: proceeding without a healthy backend\n")
     import jax
     import jax.numpy as jnp
 
