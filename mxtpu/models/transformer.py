@@ -53,18 +53,27 @@ def _ffn_block(h, d_model, d_ff, prefix, dropout):
 
 
 def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, d_model=128,
-               d_ff=None, dropout=0.0):
+               d_ff=None, dropout=0.0, max_len=None):
     """Causal LM: data (B, T) int tokens -> SoftmaxOutput over (B*T, vocab).
 
     Train with label = data shifted left by one (next-token prediction),
     flattened to (B*T,).
+
+    ``max_len`` sizes the learned positional table independently of this
+    symbol's seq_len, so BucketingModule buckets of different lengths
+    share ONE ``pos_emb`` (the transformer analogue of the LSTM bucketing
+    LM's shared parameters — each bucket slices the common table).
     """
     d_ff = d_ff or 4 * d_model
     assert d_model % num_heads == 0, "d_model must divide into heads"
+    max_len = max_len or seq_len
+    assert max_len >= seq_len, "max_len must cover seq_len"
     data = sym.Variable("data")
     h = sym.Embedding(data, input_dim=vocab_size, output_dim=d_model,
                       name="tok_emb")
-    pos = sym.Variable("pos_emb", shape=(1, seq_len, d_model))
+    pos = sym.Variable("pos_emb", shape=(1, max_len, d_model))
+    if max_len != seq_len:
+        pos = sym.slice_axis(pos, axis=1, begin=0, end=seq_len)
     h = sym.broadcast_add(h, pos)
     for i in range(num_layers):
         p = "l%d" % i

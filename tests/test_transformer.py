@@ -104,3 +104,50 @@ def test_data_parallel_mesh_training():
                       + 1e-9).mean()
         losses.append(nll)
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_bucketing_shares_transformer_params():
+    """BucketingModule over transformer symbols of different sequence
+    lengths shares ONE parameter set (pos_emb sized by max_len, sliced
+    per bucket) — the transformer analogue of the LSTM bucketing LM."""
+    buckets = [8, 16]
+    max_len = max(buckets)
+    vocab = 30
+
+    def gen(key):
+        net = mx.models.get_transformer_lm(
+            vocab_size=vocab, seq_len=key, num_layers=1, num_heads=2,
+            d_model=16, max_len=max_len)
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(gen, default_bucket_key=max_len)
+    rng = np.random.RandomState(0)
+
+    def batch(T):
+        toks = (rng.randint(0, vocab, (4, T))).astype("float32")
+        lab = ((toks.reshape(-1) + 1) % vocab).astype("float32")
+        return mx.io.DataBatch(
+            data=[mx.nd.array(toks)], label=[mx.nd.array(lab)],
+            bucket_key=T, provide_data=[("data", (4, T))],
+            provide_label=[("softmax_label", (4 * T,))])
+
+    mod.bind(data_shapes=[("data", (4, max_len))],
+             label_shapes=[("softmax_label", (4 * max_len,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01})
+    losses = {8: [], 16: []}
+    for i in range(30):
+        T = buckets[i % 2]
+        db = batch(T)
+        mod.forward_backward(db)
+        mod.update()
+        out = mod.get_outputs()[0].asnumpy()
+        lab = db.label[0].asnumpy().astype(int)
+        losses[T].append(-np.log(out[np.arange(len(lab)), lab] + 1e-9)
+                         .mean())
+    # both buckets train through the SHARED weights
+    assert losses[8][-1] < losses[8][0] * 0.7, losses[8]
+    assert losses[16][-1] < losses[16][0] * 0.7, losses[16]
+    arg_params, _ = mod.get_params()
+    assert arg_params["pos_emb"].shape == (1, max_len, 16)
