@@ -319,7 +319,10 @@ class NDArray:
         return invoke_op("transpose", [self], {"axes": axes or ()})[0]
 
     def tostype(self, stype):
-        return self
+        if stype in (None, "default"):
+            return self
+        from .sparse import cast_storage
+        return cast_storage(self, stype)
 
 
 # ---------------------------------------------------------------- invoke

@@ -435,7 +435,34 @@ def add(lhs, rhs):
         _np.add.at(data, _np.searchsorted(idx, ridx), _np.asarray(rhs._sp_data))
         return RowSparseNDArray(data, idx, lhs.shape, lhs.context)
     if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
-        # csr + csr stays csr (reference elemwise_binary_op csr kernels);
-        # merged on host via the dense bridge — row-merge kernel TODO
-        return _dense_to_csr(lhs.asnumpy() + rhs.asnumpy(), lhs.context)
+        # csr + csr stays csr (reference elemwise_binary_op csr kernels):
+        # O(nnz log nnz) triplet merge — never densifies, so huge sparse
+        # matrices with small nnz stay cheap
+        def triplets(m):
+            indptr = _np.asarray(m._sp_indptr).astype(_np.int64)
+            rows = _np.repeat(_np.arange(len(indptr) - 1),
+                              _np.diff(indptr))
+            return rows, _np.asarray(m._sp_indices), _np.asarray(m._sp_data)
+
+        r1, c1, v1 = triplets(lhs)
+        r2, c2, v2 = triplets(rhs)
+        r = _np.concatenate([r1, r2])
+        c = _np.concatenate([c1, c2])
+        v = _np.concatenate([v1, v2])
+        order = _np.lexsort((c, r))
+        r, c, v = r[order], c[order], v[order]
+        if len(r):
+            first = _np.ones(len(r), bool)
+            first[1:] = (r[1:] != r[:-1]) | (c[1:] != c[:-1])
+            grp = _np.cumsum(first) - 1
+            vals = _np.zeros(int(grp[-1]) + 1, v.dtype)
+            _np.add.at(vals, grp, v)
+            rr, cc = r[first], c[first]
+        else:
+            vals = v
+            rr, cc = r, c
+        counts = _np.bincount(rr, minlength=lhs.shape[0])
+        indptr = _np.concatenate([[0], _np.cumsum(counts)])
+        return CSRNDArray(vals, cc.astype(_np.int32),
+                          indptr.astype(_np.int32), lhs.shape, lhs.context)
     return NDArray(lhs._data + rhs._data, lhs._ctx)
