@@ -25,6 +25,7 @@ from . import symbol as sym
 from . import random
 from . import random as rnd
 from . import autograd
+from . import name
 from .executor import Executor
 
 # subsystems imported lazily-but-eagerly; order matters (no cycles)

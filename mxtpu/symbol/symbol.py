@@ -22,24 +22,50 @@ __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
 
 
 class NameManager:
-    """Auto-names composed ops: conv0, conv1, ... (parity python/mxnet/name.py)."""
+    """Auto-names composed ops: conv0, conv1, ... (parity
+    python/mxnet/name.py). Instances are context managers — entering one
+    scopes subsequent auto-naming to its counter, and ``Prefix`` (in
+    ``mxtpu.name``) prepends a string, exactly the reference's
+    ``with mx.name.Prefix('net_'):`` idiom."""
 
     _tls = threading.local()
 
-    @classmethod
-    def get(cls, name, hint):
+    def __init__(self):
+        self._counter = {}
+        self._prev = []  # a STACK, so re-entering the same instance nests
+        # correctly (the reference's single-slot _old corrupts restoration
+        # on `with p: with p:` — a deliberate fix, not a parity break)
+
+    def _name(self, name, hint):
         if name:
             return name
-        if not hasattr(cls._tls, "counter"):
-            cls._tls.counter = {}
-        c = cls._tls.counter
-        idx = c.get(hint, 0)
-        c[hint] = idx + 1
+        idx = self._counter.get(hint, 0)
+        self._counter[hint] = idx + 1
         return "%s%d" % (hint, idx)
+
+    def __enter__(self):
+        self._prev.append(getattr(NameManager._tls, "current", None))
+        NameManager._tls.current = self
+        return self
+
+    def __exit__(self, *exc):
+        NameManager._tls.current = self._prev.pop()
+        return False
+
+    @classmethod
+    def _current(cls):
+        cur = getattr(cls._tls, "current", None)
+        if cur is None:
+            cur = cls._tls.current = NameManager()
+        return cur
+
+    @classmethod
+    def get(cls, name, hint):
+        return cls._current()._name(name, hint)
 
     @classmethod
     def reset(cls):
-        cls._tls.counter = {}
+        cls._current()._counter = {}
 
 
 class _Node:

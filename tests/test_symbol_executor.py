@@ -197,3 +197,21 @@ def test_visualization_plot_network_graph():
     dot = mx.visualization.plot_network(net, shape={"data": (1, 8)})
     src = getattr(dot, "source", None) or str(dot)
     assert "fc_viz" in src
+
+
+def test_name_manager_prefix_scope():
+    """mx.name.Prefix / NameManager context scoping (parity
+    python/mxnet/name.py): auto-names inside the scope get the prefix and
+    a fresh counter; the outer counter resumes after exit."""
+    import mxtpu as mx
+    a = mx.sym.FullyConnected(mx.sym.Variable("x"), num_hidden=4)
+    with mx.name.Prefix("net_"):
+        b = mx.sym.FullyConnected(mx.sym.Variable("x"), num_hidden=4)
+        c = mx.sym.Activation(b, act_type="relu")
+    d = mx.sym.FullyConnected(mx.sym.Variable("x"), num_hidden=4)
+    na, nb, nc, ndm = (s.list_outputs()[0] for s in (a, b, c, d))
+    assert nb.startswith("net_fullyconnected0")
+    assert nc.startswith("net_activation0")
+    assert not ndm.startswith("net_")
+    # the outer manager's counter advanced past 'a', unaffected by scope
+    assert ndm.split("_output")[0] != na.split("_output")[0]
