@@ -11,7 +11,7 @@ Usage parity with the reference Python package:
 """
 from __future__ import annotations
 
-__version__ = "0.1.0"
+from .libinfo import __version__  # single source of truth
 
 from . import base
 from .base import MXNetError, MXTPUError
@@ -26,6 +26,9 @@ from . import random
 from . import random as rnd
 from . import autograd
 from . import name
+from . import log
+from . import registry
+from . import libinfo
 from .executor import Executor
 
 # subsystems imported lazily-but-eagerly; order matters (no cycles)

@@ -1,0 +1,23 @@
+"""Library locator + version (parity: python/mxnet/libinfo.py —
+find_lib_path() for the native runtime and the package __version__)."""
+from __future__ import annotations
+
+import os
+
+__version__ = "0.1.0"
+
+
+def find_lib_path():
+    """Paths of the native runtime libraries that exist on disk
+    (libmxtpu / libmxtpu_capi / libmxtpu_predict), reference
+    find_lib_path semantics: raises when the core runtime is absent."""
+    native = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "native")
+    names = ["libmxtpu.so", "libmxtpu_capi.so", "libmxtpu_predict.so"]
+    paths = [os.path.join(native, n) for n in names]
+    found = [p for p in paths if os.path.exists(p)]
+    if not any(p.endswith("libmxtpu.so") for p in found):
+        raise RuntimeError(
+            "core native runtime libmxtpu.so not found under %s "
+            "(run: make -C src all)" % native)
+    return found

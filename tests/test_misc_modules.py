@@ -1,0 +1,73 @@
+"""mx.registry / mx.log / mx.libinfo / mx.name small-parity modules
+(reference: python/mxnet/{registry,log,libinfo,name}.py)."""
+import logging
+
+import pytest
+
+import mxtpu as mx
+from mxtpu.base import MXNetError
+
+
+def test_registry_register_alias_create():
+    class Animal:
+        def __init__(self, sound="?"):
+            self.sound = sound
+
+    register = mx.registry.get_register_func(Animal, "animal")
+    alias = mx.registry.get_alias_func(Animal, "animal")
+    create = mx.registry.get_create_func(Animal, "animal")
+
+    @alias("doggo", "pup")
+    class Dog(Animal):
+        pass
+
+    register(Dog)
+    assert isinstance(create("dog"), Dog)
+    assert isinstance(create("PUP"), Dog)
+    inst = Dog()
+    assert create(inst) is inst
+    a = create('["doggo", {"sound": "woof"}]')
+    assert isinstance(a, Dog) and a.sound == "woof"
+    b = create('{"animal": "dog", "sound": "arf"}')
+    assert b.sound == "arf"
+    with pytest.raises(MXNetError):
+        create("cat")
+    with pytest.raises(MXNetError):
+        register(int)
+
+
+def test_registry_override_warns():
+    class Base:
+        pass
+
+    register = mx.registry.get_register_func(Base, "base")
+
+    class A(Base):
+        pass
+
+    register(A, "thing")
+
+    class B(Base):
+        pass
+
+    with pytest.warns(UserWarning):
+        register(B, "thing")
+
+
+def test_log_get_logger(tmp_path, capsys):
+    log_file = str(tmp_path / "x.log")
+    lg = mx.log.get_logger("mxtpu_test_file", filename=log_file,
+                           level=mx.log.INFO)
+    lg.info("hello %d", 7)
+    lg2 = mx.log.get_logger("mxtpu_test_file")  # idempotent
+    assert lg2 is lg and len(lg.handlers) == 1
+    for h in lg.handlers:
+        h.flush()
+    text = open(log_file).read()
+    assert "hello 7" in text and text.startswith("I ")
+
+
+def test_libinfo():
+    paths = mx.libinfo.find_lib_path()
+    assert any(p.endswith("libmxtpu.so") for p in paths)
+    assert mx.libinfo.__version__ == mx.__version__
