@@ -1,3 +1,5 @@
 """mx.contrib — experimental namespaces (parity python/mxnet/contrib/)."""
 from . import autograd  # noqa: F401
+from . import ndarray  # noqa: F401
+from . import symbol  # noqa: F401
 from . import tensorboard  # noqa: F401

@@ -1,0 +1,7 @@
+"""Contrib NDArray ops namespace (parity: python/mxnet/contrib/ndarray.py —
+re-exports the same registry-backed ops as ``mx.nd.contrib``)."""
+from ..ndarray import contrib as _c
+
+
+def __getattr__(name):
+    return getattr(_c, name)

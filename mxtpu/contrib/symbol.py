@@ -1,0 +1,7 @@
+"""Contrib Symbol ops namespace (parity: python/mxnet/contrib/symbol.py —
+re-exports the same registry-backed ops as ``mx.sym.contrib``)."""
+from ..symbol import contrib as _c
+
+
+def __getattr__(name):
+    return getattr(_c, name)

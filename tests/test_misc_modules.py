@@ -71,3 +71,16 @@ def test_libinfo():
     paths = mx.libinfo.find_lib_path()
     assert any(p.endswith("libmxtpu.so") for p in paths)
     assert mx.libinfo.__version__ == mx.__version__
+
+
+def test_contrib_namespace_modules():
+    """mx.contrib.ndarray / mx.contrib.symbol re-export the registry
+    contrib namespaces (reference python/mxnet/contrib/{ndarray,symbol})."""
+    import numpy as np
+    x = mx.nd.array(np.ones((2, 4), "f"))
+    out = mx.contrib.ndarray.fft(x)
+    assert out.shape == (2, 8)
+    s = mx.contrib.symbol.fft(mx.sym.Variable("d"))
+    assert s.list_outputs()[0].endswith("_output")
+    with pytest.raises(AttributeError):
+        mx.contrib.ndarray.not_a_real_op
