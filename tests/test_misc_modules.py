@@ -84,3 +84,38 @@ def test_contrib_namespace_modules():
     assert s.list_outputs()[0].endswith("_output")
     with pytest.raises(AttributeError):
         mx.contrib.ndarray.not_a_real_op
+
+
+def _tools_path():
+    import os
+    import sys
+    p = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+
+def test_parse_log_tool(tmp_path):
+    _tools_path()
+    import parse_log
+    lf = tmp_path / "t.log"
+    lf.write_text(
+        "INFO:root:Epoch[0] Train-accuracy=0.5\n"
+        "INFO:root:Epoch[0] Validation-accuracy=0.4\n"
+        "INFO:root:Epoch[0] Time cost=2.5\n"
+        "INFO:root:Epoch[1] Train-accuracy=0.8\n"
+        "INFO:root:Epoch[1] Validation-accuracy=0.7\n"
+        "INFO:root:Epoch[1] Time cost=2.2\n")
+    table = parse_log.parse(lf.read_text().splitlines())
+    assert table[1]["train"] == 0.8 and table[0]["time"] == 2.5
+    md = parse_log.render(table, "markdown")
+    assert md.splitlines()[2].startswith("| 0 |")
+
+
+def test_measure_bandwidth_tool():
+    _tools_path()
+    import measure_bandwidth
+    res = measure_bandwidth.run([0.5], iters=2)
+    names = {r["collective"] for r in res}
+    assert names == {"psum", "reduce_scatter", "all_gather"}
+    assert all(r["algo_gbps"] > 0 for r in res)

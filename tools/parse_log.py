@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Parse a training log into a markdown or CSV table (role parity:
+tools/parse_log.py — the reference's epoch-metric log scraper, matched to
+this framework's fit-loop log lines:
+
+    Epoch[3] Train-accuracy=0.912
+    Epoch[3] Validation-accuracy=0.887
+    Epoch[3] Time cost=12.345
+
+Usage: python tools/parse_log.py LOGFILE [--format markdown|csv]
+"""
+import argparse
+import re
+import sys
+
+_PATTERNS = [
+    ("train", re.compile(r".*Epoch\[(\d+)\] Train-\S+=([-.\deE]+)")),
+    ("valid", re.compile(r".*Epoch\[(\d+)\] Validation-\S+=([-.\deE]+)")),
+    ("time", re.compile(r".*Epoch\[(\d+)\] Time cost=([-.\deE]+)")),
+]
+
+
+def parse(lines):
+    """{epoch: {"train": mean, "valid": mean, "time": sum}}"""
+    acc = {}
+    for line in lines:
+        for key, rx in _PATTERNS:
+            m = rx.match(line)
+            if m is None:
+                continue
+            epoch, val = int(m.group(1)), float(m.group(2))
+            slot = acc.setdefault(epoch, {k: [] for k, _ in _PATTERNS})
+            slot[key].append(val)
+            break
+    out = {}
+    for epoch, slot in sorted(acc.items()):
+        out[epoch] = {
+            "train": sum(slot["train"]) / len(slot["train"])
+            if slot["train"] else float("nan"),
+            "valid": sum(slot["valid"]) / len(slot["valid"])
+            if slot["valid"] else float("nan"),
+            "time": sum(slot["time"]),
+        }
+    return out
+
+
+def render(table, fmt):
+    rows = [(e, v["train"], v["valid"], v["time"])
+            for e, v in sorted(table.items())]
+    if fmt == "csv":
+        lines = ["epoch,train,valid,time"]
+        lines += ["%d,%.6g,%.6g,%.6g" % r for r in rows]
+    else:
+        lines = ["| epoch | train | valid | time |",
+                 "| --- | --- | --- | --- |"]
+        lines += ["| %d | %.6g | %.6g | %.6g |" % r for r in rows]
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("logfile")
+    ap.add_argument("--format", choices=["markdown", "csv"],
+                    default="markdown")
+    args = ap.parse_args(argv)
+    with open(args.logfile) as f:
+        table = parse(f)
+    out = render(table, args.format)
+    print(out)
+    return table
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main() else 1)
