@@ -5,6 +5,7 @@ from __future__ import annotations
 import math
 
 from .. import ndarray as nd
+from ..base import MXNetError
 from ..ndarray import NDArray
 
 
@@ -54,3 +55,53 @@ def clip_global_norm(arrays, max_norm):
         for arr in arrays:
             arr *= scale
     return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    """Whether the file's sha1 matches (parity utils.py check_sha1)."""
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            sha1.update(chunk)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None):
+    """Fetch a URL to a local file (parity utils.py download: skip when the
+    file exists with a matching hash; verify the hash after fetching)."""
+    import os
+    from urllib.request import urlopen
+
+    tail = url.split("/")[-1]
+    if path is None or os.path.isdir(path):
+        if not tail:
+            raise MXNetError("cannot derive a file name from %r; pass "
+                             "an explicit path" % url)
+        fname = tail if path is None else os.path.join(path, tail)
+    else:
+        fname = path
+    if not overwrite and os.path.exists(fname) and \
+            (sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
+    d = os.path.dirname(os.path.abspath(fname))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    # stream into a temp sibling and rename only on success, so an
+    # interrupted or hash-failed fetch never leaves a poisoned cache file
+    tmp = fname + ".part%d" % os.getpid()
+    try:
+        with urlopen(url) as r, open(tmp, "wb") as f:
+            while True:
+                chunk = r.read(1 << 20)
+                if not chunk:
+                    break
+                f.write(chunk)
+        if sha1_hash is not None and not check_sha1(tmp, sha1_hash):
+            raise OSError("downloaded file %s failed sha1 verification"
+                          % fname)
+        os.replace(tmp, fname)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+    return fname

@@ -119,3 +119,29 @@ def test_measure_bandwidth_tool():
     names = {r["collective"] for r in res}
     assert names == {"psum", "reduce_scatter", "all_gather"}
     assert all(r["algo_gbps"] > 0 for r in res)
+
+
+def test_gluon_utils_sha1_and_download(tmp_path):
+    """check_sha1 + download local-file semantics (gluon/utils.py parity;
+    the network path is exercised against a file:// URL so the gate runs
+    offline)."""
+    import hashlib
+
+    from mxtpu.gluon import utils as gutils
+
+    src = tmp_path / "blob.bin"
+    src.write_bytes(b"mxtpu" * 100)
+    digest = hashlib.sha1(src.read_bytes()).hexdigest()
+    assert gutils.check_sha1(str(src), digest)
+    assert not gutils.check_sha1(str(src), "0" * 40)
+    url = "file://" + str(src)
+    out = gutils.download(url, path=str(tmp_path / "copy.bin"),
+                          sha1_hash=digest)
+    assert open(out, "rb").read() == src.read_bytes()
+    # cached: second call with matching hash does not re-fetch
+    before = (tmp_path / "copy.bin").stat().st_mtime_ns
+    gutils.download(url, path=str(tmp_path / "copy.bin"), sha1_hash=digest)
+    assert (tmp_path / "copy.bin").stat().st_mtime_ns == before
+    with pytest.raises(OSError):
+        gutils.download(url, path=str(tmp_path / "bad.bin"),
+                        sha1_hash="0" * 40)
