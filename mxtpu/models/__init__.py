@@ -8,8 +8,12 @@ from . import alexnet
 from . import vgg
 from . import inception_bn
 from . import transformer
+from . import googlenet
+from . import inception_v3
 from .mlp import get_symbol as get_mlp
 from .transformer import get_symbol as get_transformer_lm
+from .googlenet import get_symbol as get_googlenet
+from .inception_v3 import get_symbol as get_inception_v3
 from .lenet import get_symbol as get_lenet
 from .resnet import get_symbol as get_resnet
 from .alexnet import get_symbol as get_alexnet

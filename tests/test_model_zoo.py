@@ -79,3 +79,34 @@ def test_symbol_model_factories():
         arg_shapes, out_shapes, _ = s.infer_shape(
             data=shape, softmax_label=(shape[0],))
         assert out_shapes[0] == (shape[0], 10), (get, out_shapes)
+
+
+def test_googlenet_symbol_forward():
+    """GoogLeNet symbol family (symbols/googlenet.py parity): shape chain
+    through the inception concat blocks + a forward."""
+    net = mx.models.get_googlenet(num_classes=10)
+    args, outs, _ = net.infer_shape(data=(1, 3, 224, 224),
+                                    softmax_label=(1,))
+    assert outs == [(1, 10)]
+    exe = net.simple_bind(ctx=mx.cpu(), data=(1, 3, 224, 224),
+                          softmax_label=(1,), grad_req="null")
+    rng = np.random.RandomState(0)
+    for k, v in exe.arg_dict.items():
+        if k != "softmax_label":
+            v[:] = mx.nd.array(rng.uniform(-0.05, 0.05, v.shape)
+                               .astype("float32"))
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (1, 10)
+    np.testing.assert_allclose(out.sum(), 1.0, rtol=1e-4)
+
+
+def test_inception_v3_symbol_shapes():
+    """Inception-v3 symbol family (symbols/inception-v3.py parity):
+    module grammar A/B/C + reductions yields the paper's 2048-d trunk."""
+    net = mx.models.get_inception_v3(num_classes=7)
+    args, outs, _ = net.infer_shape(data=(2, 3, 299, 299),
+                                    softmax_label=(2,))
+    assert outs == [(2, 7)]
+    # module-C trunk: 320 + (384+384) + (384+384) + 192 = 2048 channels
+    d = dict(zip(net.list_arguments(), args))
+    assert d["fc1_weight"] == (7, 2048)
