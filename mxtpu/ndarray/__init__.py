@@ -164,3 +164,40 @@ def _cvcopyMakeBorder(src, top, bot, left, right, type=0, value=0.0, **kw):
 imread = _cvimread
 imdecode = _cvimdecode
 imresize = _cvimresize
+
+
+# ------------------------------------------------- module-level arithmetic
+# (parity: ndarray.py:1748-2610 add/subtract/multiply/divide/modulo/power/
+# maximum/minimum/true_divide — array-or-scalar on either side; the
+# NDArray operator overloads already broadcast and promote, so the plain
+# Python operators cover every combination including scalar-scalar)
+import builtins as _builtins
+import operator as _op
+
+add = _op.add
+subtract = _op.sub
+multiply = _op.mul
+divide = _op.truediv
+true_divide = _op.truediv
+modulo = _op.mod
+power = _op.pow
+
+
+def maximum(lhs, rhs):
+    """Element-wise maximum (parity ndarray.py maximum)."""
+    if isinstance(lhs, NDArray):
+        return broadcast_maximum(lhs, rhs) if isinstance(rhs, NDArray) \
+            else _maximum_scalar(lhs, scalar=float(rhs))
+    if isinstance(rhs, NDArray):
+        return _maximum_scalar(rhs, scalar=float(lhs))
+    return _builtins.max(lhs, rhs)  # nd.max (the reduce op) shadows the builtin here
+
+
+def minimum(lhs, rhs):
+    """Element-wise minimum (parity ndarray.py minimum)."""
+    if isinstance(lhs, NDArray):
+        return broadcast_minimum(lhs, rhs) if isinstance(rhs, NDArray) \
+            else _minimum_scalar(lhs, scalar=float(rhs))
+    if isinstance(rhs, NDArray):
+        return _minimum_scalar(rhs, scalar=float(lhs))
+    return _builtins.min(lhs, rhs)

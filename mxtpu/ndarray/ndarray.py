@@ -235,8 +235,14 @@ class NDArray:
     def __mod__(self, o):
         return self._binop(o, "broadcast_mod", "_mod_scalar")
 
+    def __rmod__(self, o):
+        return invoke_op("_rmod_scalar", [self], {"scalar": float(o)})[0]
+
     def __pow__(self, o):
         return self._binop(o, "broadcast_power", "_power_scalar")
+
+    def __rpow__(self, o):
+        return invoke_op("_rpower_scalar", [self], {"scalar": float(o)})[0]
 
     def __neg__(self):
         return invoke_op("negative", [self], {})[0]

@@ -161,3 +161,26 @@ def test_arange_ordering():
     assert np.allclose(nd.argsort(x).asnumpy(), [1, 2, 0])
     assert np.allclose(nd.topk(x, k=2, ret_typ="value").asnumpy(), [3, 2])
     assert np.allclose(nd.argmax(x, axis=0).asnumpy(), 0)
+
+
+def test_module_level_arithmetic():
+    """mx.nd.add/subtract/multiply/divide/power/maximum/minimum accept
+    array-or-scalar on either side (parity ndarray.py:1748-2610)."""
+    a = nd.array(np.full((2, 3), 6.0, "float32"))
+    b = nd.array(np.full((2, 3), 4.0, "float32"))
+    assert float(nd.add(a, b).asnumpy()[0, 0]) == 10
+    assert float(nd.subtract(a, 1).asnumpy()[0, 0]) == 5
+    assert float(nd.multiply(2, a).asnumpy()[0, 0]) == 12
+    assert float(nd.divide(a, b).asnumpy()[0, 0]) == 1.5
+    assert float(nd.true_divide(a, 3).asnumpy()[0, 0]) == 2
+    assert float(nd.modulo(a, b).asnumpy()[0, 0]) == 2
+    assert float(nd.power(a, 2).asnumpy()[0, 0]) == 36
+    assert float(nd.maximum(a, 7).asnumpy()[0, 0]) == 7
+    assert float(nd.minimum(7, a).asnumpy()[0, 0]) == 6
+    assert nd.add(2, 3) == 5 and nd.maximum(2, 3) == 3
+    # scalar-LHS for the non-commutative ops (reflected dunders)
+    assert float(nd.power(2, nd.array(np.full((2,), 3.0, "f")))
+                 .asnumpy()[0]) == 8
+    assert float(nd.modulo(7, b).asnumpy()[0, 0]) == 3
+    assert float(nd.subtract(10, a).asnumpy()[0, 0]) == 4
+    assert float(nd.divide(12, b).asnumpy()[0, 0]) == 3
