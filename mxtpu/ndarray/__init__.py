@@ -201,3 +201,15 @@ def minimum(lhs, rhs):
     if isinstance(rhs, NDArray):
         return _minimum_scalar(rhs, scalar=float(lhs))
     return _builtins.min(lhs, rhs)
+
+
+def hypot(lhs, rhs):
+    """sqrt(lhs^2 + rhs^2) elementwise, array-or-scalar on either side
+    (parity ndarray.py hypot)."""
+    if isinstance(lhs, NDArray):
+        return broadcast_hypot(lhs, rhs) if isinstance(rhs, NDArray) \
+            else _hypot_scalar(lhs, scalar=float(rhs))
+    if isinstance(rhs, NDArray):
+        return _hypot_scalar(rhs, scalar=float(lhs))
+    import math
+    return math.hypot(lhs, rhs)

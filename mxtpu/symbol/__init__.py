@@ -59,3 +59,45 @@ from ..base import PrefixOpNamespace as _PrefixNS  # noqa: E402
 
 contrib = _PrefixNS(_mod, "_contrib_")
 linalg = _PrefixNS(_mod, "_linalg_")
+
+
+# ------------------------------------------------- module-level math
+# (parity: symbol/symbol.py:2267-2446 pow/maximum/minimum/hypot —
+# symbol-or-scalar on either side, plain numbers fall through to python)
+from .symbol import _compose as _sym_compose  # noqa: E402
+from ..ops.registry import get_op as _get_op  # noqa: E402
+
+
+def _sym_binop(left, right, op, scalar_op, plain):
+    """4-way symbol/scalar dispatch shared by the module math functions
+    (commutative ops only: the swapped-operand path reuses scalar_op)."""
+    if isinstance(left, Symbol) and isinstance(right, Symbol):
+        return _sym_compose(_get_op(op), None, [left, right], {})
+    if isinstance(left, Symbol):
+        return _sym_compose(_get_op(scalar_op), None, [left],
+                            {"scalar": float(right)})
+    if isinstance(right, Symbol):
+        return _sym_compose(_get_op(scalar_op), None, [right],
+                            {"scalar": float(left)})
+    return plain(left, right)
+
+
+def pow(base, exp):  # noqa: A001  (parity name)
+    return base ** exp  # Symbol dunders (incl. __rpow__) dispatch
+
+
+def maximum(left, right):
+    import builtins
+    return _sym_binop(left, right, "_maximum", "_maximum_scalar",
+                      builtins.max)
+
+
+def minimum(left, right):
+    import builtins
+    return _sym_binop(left, right, "_minimum", "_minimum_scalar",
+                      builtins.min)
+
+
+def hypot(left, right):
+    import math
+    return _sym_binop(left, right, "_hypot", "_hypot_scalar", math.hypot)

@@ -248,6 +248,17 @@ class Symbol:
     def __pow__(self, o):
         return self._binop(o, "_power", "_power_scalar")
 
+    def __rpow__(self, o):
+        return _compose(get_op("_rpower_scalar"), None, [self],
+                        {"scalar": float(o)})
+
+    def __mod__(self, o):
+        return self._binop(o, "_mod", "_mod_scalar")
+
+    def __rmod__(self, o):
+        return _compose(get_op("_rmod_scalar"), None, [self],
+                        {"scalar": float(o)})
+
     def __neg__(self):
         return _compose(get_op("negative"), None, [self], {})
 

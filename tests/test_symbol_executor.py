@@ -215,3 +215,28 @@ def test_name_manager_prefix_scope():
     assert not ndm.startswith("net_")
     # the outer manager's counter advanced past 'a', unaffected by scope
     assert ndm.split("_output")[0] != na.split("_output")[0]
+
+
+def test_symbol_module_math():
+    """sym.pow/maximum/minimum/hypot with symbol-or-scalar operands, and
+    the reflected %/** dunders (parity symbol.py:2267-2446)."""
+    import numpy as np
+    x = mx.sym.Variable("x")
+
+    def run(sym_out, xv):
+        exe = sym_out.simple_bind(ctx=mx.cpu(), x=(len(xv),),
+                                  grad_req="null")
+        exe.arg_dict["x"][:] = mx.nd.array(np.asarray(xv, "float32"))
+        return exe.forward()[0].asnumpy()
+
+    np.testing.assert_allclose(run(mx.sym.pow(3, x), [2, 3]), [9, 27])
+    np.testing.assert_allclose(run(mx.sym.maximum(x, 2.5), [2, 3]),
+                               [2.5, 3])
+    np.testing.assert_allclose(run(mx.sym.minimum(2.5, x), [2, 3]),
+                               [2, 2.5])
+    np.testing.assert_allclose(run(mx.sym.hypot(x, 4.0), [3, 0]), [5, 4])
+    np.testing.assert_allclose(run(2 % x, [3, 5]), [2, 2])
+    np.testing.assert_allclose(run(2 ** x, [2, 3]), [4, 8])
+    assert mx.sym.pow(2, 3) == 8 and mx.sym.maximum(2, 5) == 5
+    y = mx.sym.Variable("y")
+    assert "hypot" in mx.sym.hypot(x, y).list_outputs()[0]
