@@ -207,6 +207,19 @@ class Symbol:
                 out[node.name] = d
         return out
 
+    def list_attr(self, recursive=False):
+        """Attributes of THIS symbol's head node (parity symbol.py
+        list_attr; recursive=True was deprecated in the reference — use
+        attr_dict())."""
+        if recursive:
+            raise MXNetError(
+                "list_attr(recursive=True) is deprecated; use attr_dict()")
+        node = self._outputs[0][0]
+        out = {k: attr_repr(v) for k, v in node.attrs.items()
+               if not k.startswith("__")}
+        out.update(node._extra_attrs)
+        return out
+
     def _set_attr(self, **kwargs):
         for node, _ in self._outputs:
             node._extra_attrs.update({k: str(v) for k, v in kwargs.items()})

@@ -240,3 +240,14 @@ def test_symbol_module_math():
     assert mx.sym.pow(2, 3) == 8 and mx.sym.maximum(2, 5) == 5
     y = mx.sym.Variable("y")
     assert "hypot" in mx.sym.hypot(x, y).list_outputs()[0]
+
+
+def test_list_attr():
+    """Symbol.list_attr returns this node's attrs (parity list_attr);
+    recursive=True is the reference's deprecated path and raises."""
+    f = mx.sym.FullyConnected(mx.sym.Variable("x"), num_hidden=4, name="fc")
+    assert f.list_attr()["num_hidden"] == "4"
+    v = mx.sym.Variable("w", lr_mult=2.0)
+    assert v.list_attr()["__lr_mult__"] == "2.0"
+    with pytest.raises(mx.base.MXNetError):
+        f.list_attr(recursive=True)
