@@ -352,3 +352,59 @@ def make_synthetic_mnist_idx(directory, n_train=2048, n_test=512, seed=0):
     _write_idx(os.path.join(directory, "t10k-images-idx3-ubyte"), tei, True)
     _write_idx(os.path.join(directory, "t10k-labels-idx1-ubyte"), tel, False)
     return directory
+
+
+def np_reduce(dat, axis, keepdims, numpy_reduce_func):
+    """Apply a numpy reduce function over (possibly several) axes with
+    keepdims semantics (parity test_utils.py:383 — the oracle helper the
+    reference's reduction tests are written against)."""
+    if isinstance(axis, int):
+        axis = [axis]
+    else:
+        axis = list(axis) if axis is not None else list(range(dat.ndim))
+    ret = dat
+    for i in reversed(sorted(axis)):
+        ret = numpy_reduce_func(ret, axis=i)
+    if keepdims:
+        shape = list(dat.shape)
+        for i in axis:
+            shape[i] = 1
+        ret = ret.reshape(tuple(shape))
+    return ret
+
+
+def rand_sparse_ndarray(shape, stype, density=None, dtype=None):
+    """Random sparse NDArray + its dense numpy twin (parity
+    test_utils.py:244): returns (sparse_nd, (values-ish tuple)) — here the
+    dense numpy array stands in for the component tuple since components
+    are reconstructable from the array."""
+    from .ndarray import sparse as _sp
+    density = 0.3 if density is None else density
+    dtype = _np.float32 if dtype is None else _np.dtype(dtype)
+    dense = _np.random.uniform(-1, 1, size=shape).astype(dtype)
+    dense[_np.random.uniform(size=shape) > density] = 0
+    if stype == "csr":
+        arr = _sp.csr_matrix(dense)
+    elif stype == "row_sparse":
+        arr = _sp.row_sparse_array(dense)
+    else:
+        raise ValueError("unknown storage type %s" % stype)
+    return arr, dense
+
+
+def create_sparse_array(shape, stype, data_init=None, density=0.5,
+                        dtype=None):
+    """Sparse NDArray filled from data_init or random (parity
+    test_utils.py:324)."""
+    dtype = _np.float32 if dtype is None else _np.dtype(dtype)
+    if data_init is not None:
+        dense = _np.full(shape, data_init, dtype)
+    else:
+        dense = _np.random.uniform(0, 1, size=shape).astype(dtype)
+        dense[_np.random.uniform(size=shape) > density] = 0
+    from .ndarray import sparse as _sp
+    if stype == "csr":
+        return _sp.csr_matrix(dense)
+    if stype == "row_sparse":
+        return _sp.row_sparse_array(dense)
+    raise ValueError("unknown storage type %s" % stype)

@@ -145,3 +145,25 @@ def test_gluon_utils_sha1_and_download(tmp_path):
     with pytest.raises(OSError):
         gutils.download(url, path=str(tmp_path / "bad.bin"),
                         sha1_hash="0" * 40)
+
+
+def test_test_utils_sparse_helpers():
+    """np_reduce / rand_sparse_ndarray / create_sparse_array parity
+    helpers (reference test_utils.py:244-420)."""
+    import numpy as np
+
+    from mxtpu import test_utils as tu
+
+    r = tu.np_reduce(np.arange(24).reshape(2, 3, 4).astype("f"), (0, 2),
+                     True, np.sum)
+    assert r.shape == (1, 3, 1)
+    np.testing.assert_allclose(
+        r, np.arange(24).reshape(2, 3, 4).sum((0, 2), keepdims=True))
+    sp, dense = tu.rand_sparse_ndarray((6, 5), "csr", density=0.4)
+    assert sp.stype == "csr"
+    np.testing.assert_allclose(sp.asnumpy(), dense)
+    rs = tu.create_sparse_array((4, 4), "row_sparse", data_init=2.0)
+    assert rs.stype == "row_sparse"
+    np.testing.assert_allclose(rs.asnumpy(), np.full((4, 4), 2.0))
+    with pytest.raises(ValueError):
+        tu.create_sparse_array((4, 4), "nonsense")
