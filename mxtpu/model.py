@@ -182,7 +182,7 @@ class FeedForward:
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             logger=None, work_load_list=None, monitor=None,
             eval_end_callback=None, eval_batch_end_callback=None):
-        data = self._prepare_iter(X, y)
+        data = self._prepare_iter(X, y, shuffle=True)
         label_name = data.provide_label[0][0] if data.provide_label else "softmax_label"
         mod = self._get_module(
             data_names=[d[0] for d in data.provide_data],
@@ -199,11 +199,15 @@ class FeedForward:
                 num_epoch=self.num_epoch, monitor=monitor)
         self.arg_params, self.aux_params = mod.get_params()
 
-    def _prepare_iter(self, X, y=None):
+    def _prepare_iter(self, X, y=None, shuffle=False):
+        """numpy -> NDArrayIter; ONLY the training path shuffles — predict
+        and score must keep row order or their outputs misalign with the
+        caller's labels (reference model.py _init_iter is_train split)."""
         from .io import DataIter, NDArrayIter
         if isinstance(X, DataIter):
             return X
-        return NDArrayIter(X, y, batch_size=self.numpy_batch_size, shuffle=True)
+        return NDArrayIter(X, y, batch_size=self.numpy_batch_size,
+                           shuffle=shuffle)
 
     def predict(self, X, num_batch=None, return_data=False, reset=True):
         data = self._prepare_iter(X)

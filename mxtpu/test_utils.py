@@ -38,8 +38,10 @@ def rand_shape_3d(dim0=10, dim1=10, dim2=10):
 
 
 def rand_ndarray(shape, stype="default", density=None):
-    arr = nd.array(_rng.uniform(-1, 1, size=shape))
-    return arr
+    if stype != "default":
+        arr, _ = rand_sparse_ndarray(shape, stype, density=density)
+        return arr
+    return nd.array(_rng.uniform(-1, 1, size=shape))
 
 
 def random_arrays(*shapes):
@@ -373,23 +375,24 @@ def np_reduce(dat, axis, keepdims, numpy_reduce_func):
     return ret
 
 
+def _dense_to_sparse(dense, stype):
+    from .ndarray import sparse as _sp
+    if stype == "csr":
+        return _sp.csr_matrix(dense)
+    if stype == "row_sparse":
+        return _sp.row_sparse_array(dense)
+    raise ValueError("unknown storage type %s" % stype)
+
+
 def rand_sparse_ndarray(shape, stype, density=None, dtype=None):
     """Random sparse NDArray + its dense numpy twin (parity
-    test_utils.py:244): returns (sparse_nd, (values-ish tuple)) — here the
-    dense numpy array stands in for the component tuple since components
-    are reconstructable from the array."""
-    from .ndarray import sparse as _sp
+    test_utils.py:244). Draws from the module's seeded _rng like the
+    other random helpers."""
     density = 0.3 if density is None else density
     dtype = _np.float32 if dtype is None else _np.dtype(dtype)
-    dense = _np.random.uniform(-1, 1, size=shape).astype(dtype)
-    dense[_np.random.uniform(size=shape) > density] = 0
-    if stype == "csr":
-        arr = _sp.csr_matrix(dense)
-    elif stype == "row_sparse":
-        arr = _sp.row_sparse_array(dense)
-    else:
-        raise ValueError("unknown storage type %s" % stype)
-    return arr, dense
+    dense = _rng.uniform(-1, 1, size=shape).astype(dtype)
+    dense[_rng.uniform(size=shape) > density] = 0
+    return _dense_to_sparse(dense, stype), dense
 
 
 def create_sparse_array(shape, stype, data_init=None, density=0.5,
@@ -400,11 +403,6 @@ def create_sparse_array(shape, stype, data_init=None, density=0.5,
     if data_init is not None:
         dense = _np.full(shape, data_init, dtype)
     else:
-        dense = _np.random.uniform(0, 1, size=shape).astype(dtype)
-        dense[_np.random.uniform(size=shape) > density] = 0
-    from .ndarray import sparse as _sp
-    if stype == "csr":
-        return _sp.csr_matrix(dense)
-    if stype == "row_sparse":
-        return _sp.row_sparse_array(dense)
-    raise ValueError("unknown storage type %s" % stype)
+        dense = _rng.uniform(0, 1, size=shape).astype(dtype)
+        dense[_rng.uniform(size=shape) > density] = 0
+    return _dense_to_sparse(dense, stype)

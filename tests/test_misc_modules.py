@@ -167,3 +167,27 @@ def test_test_utils_sparse_helpers():
     np.testing.assert_allclose(rs.asnumpy(), np.full((4, 4), 2.0))
     with pytest.raises(ValueError):
         tu.create_sparse_array((4, 4), "nonsense")
+
+
+def test_feedforward_predict_row_order():
+    """FeedForward legacy API end to end: fit on blobs, predict keeps the
+    caller's ROW ORDER (the training iterator shuffles, predict must not
+    — reference model.py _init_iter is_train split)."""
+    import numpy as np
+
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Variable("data"), num_hidden=3, name="fc"), name="softmax")
+    rng = np.random.RandomState(0)
+    centers = rng.randn(3, 6) * 3
+    y = rng.randint(0, 3, 90)
+    X = (centers[y] + rng.randn(90, 6)).astype("float32")
+    mx.random.seed(4)
+    ff = mx.model.FeedForward(symbol=net, num_epoch=8, learning_rate=0.3,
+                              numpy_batch_size=30)
+    ff.fit(X=X, y=y.astype("float32"))
+    acc = (ff.predict(X).argmax(1) == y).mean()
+    assert acc > 0.9, acc
+    it = mx.io.NDArrayIter(X, y.astype("float32"), batch_size=30)
+    sc = ff.score(it)  # score rides the unshuffled path; iter carries labels
+    val = sc if np.isscalar(sc) else dict(sc).get("accuracy")
+    assert val > 0.9, sc
