@@ -152,9 +152,59 @@ class NDArray:
 
     # ------------------------------------------------ indexing
     def __getitem__(self, key):
+        if _ag.is_recording():
+            # slicing must ride the tape or backward silently treats the
+            # view as a constant (zero grads); basic keys lower to the
+            # registered slice/take ops, anything fancier raises rather
+            # than sever the tape
+            if isinstance(key, NDArray):
+                return invoke_op("take", [self, key],
+                                 {"axis": 0, "mode": "clip"})[0]
+            rec = self._basic_index_recorded(key)
+            if rec is None:
+                raise MXNetError(
+                    "autograd: index %r is not differentiable-recordable; "
+                    "use basic slices/ints or take() while recording"
+                    % (key,))
+            return rec
         if isinstance(key, NDArray):
             key = key._data.astype(jnp.int32)
         return NDArray(self._data[key], self._ctx)
+
+    def _basic_index_recorded(self, key):
+        """Lower int/slice (and tuples of them) onto the slice op (+
+        Reshape for dropped integer axes); None for unsupported keys."""
+        ks = key if isinstance(key, tuple) else (key,)
+        if len(ks) > self.ndim:
+            return None
+        begin, end, drop = [], [], []
+        for d, k in enumerate(ks):
+            if isinstance(k, (bool, _np.bool_)):
+                return None  # bool is an int subclass but means masking
+            if isinstance(k, (int, _np.integer)):
+                b = int(k) + (self.shape[d] if k < 0 else 0)
+                begin.append(b)
+                end.append(b + 1)
+                drop.append(d)
+            elif isinstance(k, slice):
+                if k.step not in (None, 1):
+                    return None
+                begin.append(k.start)
+                end.append(k.stop)
+            else:
+                return None
+        out = invoke_op("slice", [self],
+                        {"begin": tuple(begin), "end": tuple(end)})[0]
+        if out.size == 0:
+            # empty view: gradient contribution is zero by construction, and
+            # Reshape's shape mini-language cannot spell a literal 0 dim —
+            # return the plain (constant) view
+            return NDArray(self._data[key], self._ctx)
+        if drop:
+            kept = [s for i, s in enumerate(out.shape) if i not in drop]
+            out = invoke_op("Reshape", [out],
+                            {"shape": tuple(kept)})[0]
+        return out
 
     def __setitem__(self, key, value):
         if isinstance(value, NDArray):

@@ -123,3 +123,36 @@ def test_function_custom_grad():
         z = y.sum()
     z.backward()
     assert np.allclose(x.grad.asnumpy(), [7, 7])
+
+
+def test_getitem_records_on_tape():
+    """Slicing under autograd.record must flow gradients (previously the
+    view bypassed the tape and backward returned silent zeros): basic
+    slices, integer rows, NDArray-index take, and a loud error for
+    non-recordable fancy keys."""
+    x = nd.array(np.ones((4,), "float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = (x[1:3] * 3.0).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [0, 3, 3, 0])
+
+    w = nd.array(np.arange(12).reshape(3, 4).astype("float32"))
+    w.attach_grad()
+    with autograd.record():
+        z = (w[1] * 2.0).sum()
+    z.backward()
+    np.testing.assert_allclose(w.grad.asnumpy()[1], np.full(4, 2.0))
+    np.testing.assert_allclose(w.grad.asnumpy()[0], np.zeros(4))
+
+    t = nd.array(np.arange(6).reshape(3, 2).astype("float32"))
+    t.attach_grad()
+    with autograd.record():
+        u = t[nd.array(np.array([0.0, 2.0], "float32"))].sum()
+    u.backward()
+    np.testing.assert_allclose(t.grad.asnumpy(),
+                               [[1, 1], [0, 0], [1, 1]])
+
+    with pytest.raises(mx.base.MXNetError):
+        with autograd.record():
+            x[::2]
