@@ -82,7 +82,7 @@ class NDArray:
 
     @property
     def T(self):
-        return NDArray(jnp.transpose(self._data), self._ctx)
+        return invoke_op("transpose", [self], {})[0]
 
     @property
     def handle(self):
@@ -104,11 +104,12 @@ class NDArray:
         return self.asnumpy().reshape(())[()]
 
     def astype(self, dtype):
-        return NDArray(self._data.astype(_np.dtype(dtype)), self._ctx)
+        return invoke_op("Cast", [self],
+                         {"dtype": str(_np.dtype(dtype))})[0]
 
     def copy(self):
-        # +0 forces a fresh buffer (asarray would alias the same jax.Array)
-        return NDArray(self._data + 0, self._ctx)
+        # _copy yields a fresh buffer AND rides the autograd tape
+        return invoke_op("_copy", [self], {})[0]
 
     def copyto(self, other):
         if isinstance(other, NDArray):
@@ -129,13 +130,14 @@ class NDArray:
         return invoke_op("Reshape", [self], {"shape": tuple(shape)})[0]
 
     def broadcast_to(self, shape):
-        return NDArray(jnp.broadcast_to(self._data, tuple(shape)), self._ctx)
+        return invoke_op("broadcast_to", [self],
+                         {"shape": tuple(shape)})[0]
 
     def expand_dims(self, axis):
-        return NDArray(jnp.expand_dims(self._data, axis), self._ctx)
+        return invoke_op("expand_dims", [self], {"axis": int(axis)})[0]
 
     def flatten(self):
-        return NDArray(self._data.reshape(self.shape[0], -1), self._ctx)
+        return invoke_op("Flatten", [self], {})[0]
 
     # ------------------------------------------------ autograd
     def attach_grad(self, grad_req="write", stype=None):

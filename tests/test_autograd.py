@@ -156,3 +156,29 @@ def test_getitem_records_on_tape():
     with pytest.raises(mx.base.MXNetError):
         with autograd.record():
             x[::2]
+
+
+def test_view_methods_record_on_tape():
+    """T / flatten / broadcast_to / expand_dims must ride the tape like
+    reshape does — each previously built a raw view whose gradient was a
+    silent zero."""
+    x = nd.array(np.ones((2, 2), "float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = x.reshape((4,)).sum() + x.flatten().sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.full((2, 2), 2.0))
+    with autograd.record():
+        z = (x.T * 3).sum() + x.broadcast_to((2, 2)).sum() \
+            + x.expand_dims(0).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.full((2, 2), 5.0))
+    # a REAL broadcast: the vjp must sum the cotangent back over the
+    # broadcast dim; astype/copy must also stay on the tape
+    b = nd.array(np.ones((1, 2), "float32"))
+    b.attach_grad()
+    with autograd.record():
+        w = b.broadcast_to((3, 2)).sum() + b.astype("float32").sum() \
+            + b.copy().sum()
+    w.backward()
+    np.testing.assert_allclose(b.grad.asnumpy(), np.full((1, 2), 5.0))
