@@ -498,7 +498,14 @@ def concatenate(arrays, axis=0, always_copy=True):
 
 
 def moveaxis(tensor, source, destination):
-    return NDArray(jnp.moveaxis(tensor._data, source, destination), tensor._ctx)
+    """Move one axis to a new position, via the transpose op so the
+    result stays on the autograd tape (parity ndarray.py moveaxis)."""
+    nd_ = tensor.ndim
+    src = source % nd_
+    dst = destination % nd_
+    axes = [i for i in range(nd_) if i != src]
+    axes.insert(dst, src)
+    return invoke_op("transpose", [tensor], {"axes": tuple(axes)})[0]
 
 
 def onehot_encode(indices, out):
