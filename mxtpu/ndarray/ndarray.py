@@ -501,6 +501,9 @@ def moveaxis(tensor, source, destination):
     """Move one axis to a new position, via the transpose op so the
     result stays on the autograd tape (parity ndarray.py moveaxis)."""
     nd_ = tensor.ndim
+    if not (-nd_ <= source < nd_ and -nd_ <= destination < nd_):
+        raise MXNetError("moveaxis: axis out of range for %d-d array"
+                         % nd_)
     src = source % nd_
     dst = destination % nd_
     axes = [i for i in range(nd_) if i != src]

@@ -34,3 +34,72 @@ def next_key():
 
 # imperative sampling front-ends (mx.random.uniform etc.) are generated onto
 # mxtpu.ndarray and re-exported from mxtpu/__init__.py
+
+
+# ------------------------------------------------- module-level samplers
+# (parity: python/mxnet/random.py — mx.random.uniform/normal/... re-export
+# the scalar-parameter sampling ops; NDArray-parameter variants live on
+# nd.sample_*). Thin delegation to the generated nd.* sampler front-ends
+# (one shared attr-plumbing path), plus explicit ctx placement, which the
+# zero-input invoke path cannot infer. Late imports: ndarray imports this
+# module at startup.
+
+def _placed(arr, ctx):
+    if ctx is None:
+        return arr
+    import jax
+
+    from .ndarray import NDArray
+    return NDArray(jax.device_put(arr._data, ctx.jax_device), ctx)
+
+
+def uniform(low=0.0, high=1.0, shape=(1,), dtype="float32", ctx=None):
+    from . import ndarray as nd
+    return _placed(nd.uniform(low=float(low), high=float(high),
+                              shape=shape, dtype=dtype), ctx)
+
+
+def normal(loc=0.0, scale=1.0, shape=(1,), dtype="float32", ctx=None):
+    from . import ndarray as nd
+    return _placed(nd.random_normal(loc=float(loc), scale=float(scale),
+                                    shape=shape, dtype=dtype), ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=(1,), dtype="float32", ctx=None):
+    from . import ndarray as nd
+    return _placed(nd.random_gamma(alpha=float(alpha), beta=float(beta),
+                                   shape=shape, dtype=dtype), ctx)
+
+
+def exponential(lam=1.0, shape=(1,), dtype="float32", ctx=None):
+    from . import ndarray as nd
+    return _placed(nd.random_exponential(lam=float(lam), shape=shape,
+                                         dtype=dtype), ctx)
+
+
+def poisson(lam=1.0, shape=(1,), dtype="float32", ctx=None):
+    from . import ndarray as nd
+    return _placed(nd.random_poisson(lam=float(lam), shape=shape,
+                                     dtype=dtype), ctx)
+
+
+def negative_binomial(k=1, p=1.0, shape=(1,), dtype="float32", ctx=None):
+    from . import ndarray as nd
+    return _placed(nd.random_negative_binomial(k=int(k), p=float(p),
+                                               shape=shape, dtype=dtype),
+                   ctx)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(1,),
+                                  dtype="float32", ctx=None):
+    from . import ndarray as nd
+    return _placed(nd.random_generalized_negative_binomial(
+        mu=float(mu), alpha=float(alpha), shape=shape, dtype=dtype), ctx)
+
+
+def multinomial(data, shape=(), get_prob=False, dtype="int32"):
+    # default shape=() matches the reference sampler: one draw per prob
+    # row, NO spurious trailing dim (sample_multinomial_op.h)
+    from . import ndarray as nd
+    return nd.sample_multinomial(data, shape=shape, get_prob=get_prob,
+                                 dtype=dtype)

@@ -191,3 +191,25 @@ def test_feedforward_predict_row_order():
     sc = ff.score(it)  # score rides the unshuffled path; iter carries labels
     val = sc if np.isscalar(sc) else dict(sc).get("accuracy")
     assert val > 0.9, sc
+
+
+def test_random_module_samplers():
+    """mx.random.uniform/normal/poisson/... module samplers (parity
+    python/mxnet/random.py re-exports), seeded-reproducible."""
+    import numpy as np
+
+    mx.random.seed(3)
+    u = mx.random.uniform(2, 5, shape=(1000,)).asnumpy()
+    assert u.min() > 2 and u.max() < 5
+    n = mx.random.normal(10, 0.5, shape=(2000,)).asnumpy()
+    assert abs(n.mean() - 10) < 0.1
+    p = mx.random.poisson(4.0, shape=(2000,)).asnumpy()
+    assert abs(p.mean() - 4) < 0.3
+    g = mx.random.gamma(2.0, 3.0, shape=(3000,)).asnumpy()
+    assert abs(g.mean() - 6.0) < 0.5  # E[gamma(a, b)] = a*b
+    m = mx.random.multinomial(
+        mx.nd.array(np.array([0.0, 1.0, 0.0], "float32")), shape=(5,))
+    assert (m.asnumpy() == 1).all()
+    mx.random.seed(3)
+    u2 = mx.random.uniform(2, 5, shape=(1000,)).asnumpy()
+    np.testing.assert_allclose(u, u2)
