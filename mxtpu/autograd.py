@@ -25,6 +25,7 @@ def _st():
         _tls.recording = False
         _tls.training = False
         _tls.tape = []
+        _tls.tape_uids = set()  # uids consumed or produced by tape entries
     return _tls
 
 
@@ -106,8 +107,17 @@ def record_op(op, attrs, in_arrays, out_arrays, rng=None):
                       [x._data for x in in_arrays],
                       [y._uid for y in out_arrays], rng)
     st.tape.append(entry)
+    st.tape_uids.update(entry.in_ids)
+    st.tape_uids.update(entry.out_ids)
     for y in out_arrays:
         y._tape_entry = entry
+
+
+def on_tape(uid):
+    """Whether an array participates in the live tape (as input or output).
+    Mutating such an array while recording would desynchronize the array
+    from the value the tape captured — the in-place guard's predicate."""
+    return uid in _st().tape_uids
 
 
 import weakref
@@ -225,6 +235,7 @@ def backward(outputs, head_grads=None, retain_graph=False, train_mode=True):
             v.grad._data = g.astype(v.grad._data.dtype)
     if not retain_graph:
         _st().tape.clear()
+        _st().tape_uids.clear()
 
 
 def get_symbol(x):

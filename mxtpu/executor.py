@@ -46,14 +46,53 @@ def _with_matmul_precision(fn):
     return wrapped
 
 
-def _trace_graph(symbol, is_train, placements=None):
+def _block_boundaries(symbol):
+    """Node ids of the graph's dataflow cut vertices: non-variable nodes
+    past which no earlier intermediate is live (every value computed before
+    the node and consumed after it flows *through* it). For chain-of-blocks
+    models these are exactly the block boundaries — in ResNet, the
+    activations after each residual join (the reference's memory-mirroring
+    stage markers, __mirror_stage__ in example symbols /
+    src/executor/graph_executor.cc InitFullGraph mirror option). Runs of
+    directly-chained cuts are collapsed to their most downstream node, so a
+    stem like conv→bn→relu→pool contributes one boundary, not four."""
+    topo = symbol._topo()
+    idx = {id(n): i for i, n in enumerate(topo)}
+    last_use = {}
+    for n in topo:
+        for src, _ in n.inputs:
+            if not src.is_variable:
+                last_use[id(src)] = max(last_use.get(id(src), -1), idx[id(n)])
+    cuts = []
+    live_horizon = -1  # furthest consumer of anything computed so far
+    for i, n in enumerate(topo):
+        if not n.is_variable and live_horizon <= i:
+            cuts.append(n)
+        live_horizon = max(live_horizon, last_use.get(id(n), -1))
+    cut_ids = {id(n) for n in cuts}
+    for n in cuts:
+        srcs = [s for s, _ in n.inputs if not s.is_variable]
+        if len(srcs) == 1 and id(srcs[0]) in cut_ids:
+            cut_ids.discard(id(srcs[0]))
+    # the graph outputs themselves are always saved; tagging them is noise
+    for n, _ in symbol._outputs:
+        cut_ids.discard(id(n))
+    return cut_ids
+
+
+def _trace_graph(symbol, is_train, placements=None, remat_tags=None):
     """Return fn(arg_vals, aux_vals, rng) -> (outputs, aux_updates_dict).
 
     ``placements`` maps a ctx-group name to a jax Device or Sharding:
     nodes tagged ``__ctx_group__`` (AttrScope / group2ctx, the reference's
     model-parallel mechanism — graph_executor.cc AssignContext) get their
     outputs placed there; XLA inserts the cross-device transfers that the
-    reference realized as _CrossDeviceCopy nodes."""
+    reference realized as _CrossDeviceCopy nodes.
+
+    ``remat_tags`` maps node ids to checkpoint_name tags; under a
+    ``jax.checkpoint`` wrapper with a save_only_these_names policy the
+    tagged activations are the ONLY residuals kept for backward — the
+    selective-rematerialization hook (see module/fused.py)."""
     topo = symbol._topo()
     node_index = {id(n): i for i, n in enumerate(topo)}
     aux_nodes = symbol._aux_node_set()
@@ -88,6 +127,11 @@ def _trace_graph(symbol, is_train, placements=None):
                     outs = tuple(jax.device_put(o, placements[grp])
                                  for o in outs)
             n_vis = node.op.n_out(attrs)
+            if remat_tags and id(node) in remat_tags:
+                from jax.ad_checkpoint import checkpoint_name
+                tag = remat_tags[id(node)]
+                outs = tuple(checkpoint_name(o, tag) if i < n_vis else o
+                             for i, o in enumerate(outs))
             for i in range(n_vis):
                 env[(id(node), i)] = outs[i]
             # aux updates propagate back to the feeding aux variable

@@ -228,7 +228,42 @@ class FusedTrainStep:
         optimizer.idx2name = idx2name
         self._idx2name = idx2name
         self._name_idx = [name2idx[n] for n in self.trainable]
-        self._run = _trace_graph(symbol, is_train=True)
+        # Selective rematerialization (MXTPU_REMAT):
+        #   none/0 — keep every residual XLA wants. DEFAULT: measured
+        #            fastest on v5e for ResNet-50 (docs/perf.md r3 table —
+        #            the step is bandwidth-bound and recompute re-streams
+        #            the same bytes, so remat LOSES throughput here; it
+        #            remains the memory-capacity lever, not a speed lever)
+        #   block  — save ONLY block-boundary activations (dataflow cut
+        #            vertices, executor._block_boundaries); backward
+        #            recomputes each block's interior. Largest memory
+        #            saving short of 'all'.
+        #   conv   — save boundaries + every Convolution output; backward
+        #            recomputes only the cheap elementwise interior (BN
+        #            normalize, relu) from the saved conv outputs.
+        #   all/1  — whole-forward jax.checkpoint (the memory-mirroring
+        #            analogue, MXNET_BACKWARD_DO_MIRROR)
+        import os
+        self._remat = os.environ.get("MXTPU_REMAT", "none").lower()
+        if self._remat in ("0", "none", "", "false"):
+            self._remat = "none"
+        elif self._remat in ("1", "all", "true"):
+            self._remat = "all"
+        elif self._remat not in ("block", "conv"):
+            raise ValueError(
+                "MXTPU_REMAT=%r not recognized (use none/block/conv/all)"
+                % os.environ["MXTPU_REMAT"])
+        tags = None
+        if self._remat in ("block", "conv"):
+            from ..executor import _block_boundaries
+            tags = {i: "mxtpu_boundary" for i in _block_boundaries(symbol)}
+            if self._remat == "conv":
+                for n in symbol._topo():
+                    if (not n.is_variable
+                            and n.op.name in ("Convolution", "FullyConnected")
+                            and id(n) not in tags):
+                        tags[id(n)] = "mxtpu_conv"
+        self._run = _trace_graph(symbol, is_train=True, remat_tags=tags)
         self._mesh = None
         if len(self.devices) > 1:
             self._mesh = Mesh(_np.array(self.devices), ("data",))
@@ -295,8 +330,7 @@ class FusedTrainStep:
         trainable = tuple(self.trainable)
         apply_update = self._apply
 
-        import os
-        remat = os.environ.get("MXTPU_REMAT", "0") != "0"
+        remat = self._remat
 
         def step(params, aux, opt_state, batch, lrs, wds, rng):
             fixed = {n: v for n, v in params.items() if n not in trainable}
@@ -308,12 +342,19 @@ class FusedTrainStep:
                 outs, auxu = run(env, aux, rng)
                 return outs, auxu
 
-            if remat:
-                # trade recompute for activation traffic / memory
-                # (MXTPU_REMAT=1): useful when the step is HBM-bound or the
-                # model spills; mirrors the reference's memory mirroring
-                # (__mirror_stage__, src/executor/graph_executor.cc)
+            if remat == "all":
+                # trade recompute for activation traffic / memory: mirrors
+                # the reference's memory mirroring (__mirror_stage__,
+                # src/executor/graph_executor.cc)
                 f = jax.checkpoint(f)
+            elif remat == "block":
+                f = jax.checkpoint(
+                    f, policy=jax.checkpoint_policies.save_only_these_names(
+                        "mxtpu_boundary"))
+            elif remat == "conv":
+                f = jax.checkpoint(
+                    f, policy=jax.checkpoint_policies.save_only_these_names(
+                        "mxtpu_boundary", "mxtpu_conv"))
             train_p = {n: params[n] for n in trainable}
             (outs, auxu), vjp = jax.vjp(f, train_p)
             cts = ([jnp.ones_like(o) for o in outs],

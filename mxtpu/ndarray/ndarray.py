@@ -174,12 +174,19 @@ class NDArray:
         return NDArray(self._data[key], self._ctx)
 
     def _basic_index_recorded(self, key):
-        """Lower int/slice (and tuples of them) onto the slice op (+
-        Reshape for dropped integer axes); None for unsupported keys."""
+        """Lower int/slice (and tuples of them) onto the slice op (+ take
+        for strided axes, Reshape for dropped integer axes); None for
+        unsupported keys."""
         ks = key if isinstance(key, tuple) else (key,)
+        if any(k is Ellipsis for k in ks):
+            i = next(i for i, k in enumerate(ks) if k is Ellipsis)
+            fill = self.ndim - (len(ks) - 1)
+            if fill < 0 or any(k is Ellipsis for k in ks[i + 1:]):
+                return None
+            ks = ks[:i] + (slice(None),) * fill + ks[i + 1:]
         if len(ks) > self.ndim:
             return None
-        begin, end, drop = [], [], []
+        begin, end, drop, strided = [], [], [], []
         for d, k in enumerate(ks):
             if isinstance(k, (bool, _np.bool_)):
                 return None  # bool is an int subclass but means masking
@@ -190,13 +197,24 @@ class NDArray:
                 drop.append(d)
             elif isinstance(k, slice):
                 if k.step not in (None, 1):
-                    return None
-                begin.append(k.start)
-                end.append(k.stop)
+                    # strided/reversed axis: leave it whole here, gather the
+                    # selected indices afterwards with take (rides the tape)
+                    begin.append(None)
+                    end.append(None)
+                    strided.append((d, k))
+                else:
+                    begin.append(k.start)
+                    end.append(k.stop)
             else:
                 return None
         out = invoke_op("slice", [self],
                         {"begin": tuple(begin), "end": tuple(end)})[0]
+        for d, k in strided:
+            idx = _np.arange(*k.indices(self.shape[d]), dtype=_np.int32)
+            if idx.size == 0:
+                return NDArray(self._data[key], self._ctx)  # empty: constant
+            out = invoke_op("take", [out, NDArray(jnp.asarray(idx), self._ctx)],
+                            {"axis": d, "mode": "clip"})[0]
         if out.size == 0:
             # empty view: gradient contribution is zero by construction, and
             # Reshape's shape mini-language cannot spell a literal 0 dim —
@@ -209,6 +227,7 @@ class NDArray:
         return out
 
     def __setitem__(self, key, value):
+        self._inplace_guard()
         if isinstance(value, NDArray):
             v = value._data
         elif isinstance(value, (int, float)):
@@ -325,7 +344,12 @@ class NDArray:
         return self._uid
 
     def _inplace_guard(self):
-        if _ag.is_recording() and self._tape_entry is not None:
+        # an array is off-limits for mutation while recording if the tape
+        # has captured it anywhere — as an op OUTPUT (_tape_entry) or as an
+        # op INPUT (a leaf consumed by a recorded op); mutating the latter
+        # silently desynchronizes the array from the value backward uses
+        if _ag.is_recording() and (self._tape_entry is not None
+                                   or _ag.on_tape(self._uid)):
             raise MXNetError("Inplace update of a recorded array is not "
                              "supported when recording with autograd")
 

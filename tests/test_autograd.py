@@ -153,9 +153,17 @@ def test_getitem_records_on_tape():
     np.testing.assert_allclose(t.grad.asnumpy(),
                                [[1, 1], [0, 0], [1, 1]])
 
+    # strided/reversed slices ride the tape via slice + take
+    s = nd.array(np.arange(5).astype("float32"))
+    s.attach_grad()
+    with autograd.record():
+        v = (s[::2] * 2.0).sum() + (s[::-1] * 3.0).sum()
+    v.backward()
+    np.testing.assert_allclose(s.grad.asnumpy(), [5, 3, 5, 3, 5])
+
     with pytest.raises(mx.base.MXNetError):
         with autograd.record():
-            x[::2]
+            x[np.array([True, False, True, False])]  # masking: not recordable
 
 
 def test_view_methods_record_on_tape():
