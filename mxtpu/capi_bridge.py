@@ -237,3 +237,194 @@ def imperative_invoke(op_name, in_handles, keys, vals):
     if not isinstance(outs, (list, tuple)):
         outs = [outs]
     return [_register(o) for o in outs]
+
+
+def imperative_invoke_out(op_name, in_handles, keys, vals, out_handles):
+    """MXImperativeInvoke with caller-provided outputs (the reference's
+    in-place form, c_api_ndarray.cc: *outputs non-NULL on entry): results
+    are written into the given arrays, e.g. `sgd_update(w, g, out=w)` for
+    the C client's in-place optimizer step."""
+    from . import autograd as ag
+
+    if ag.is_recording():
+        # same guard as invoke_op(out=): an in-place write would silently
+        # sever the tape (reference raises here too)
+        raise RuntimeError("Inplace operations (out=) are not supported "
+                           "when recording with autograd")
+    new = imperative_invoke(op_name, in_handles, keys, vals)
+    if len(new) != len(out_handles):
+        for nh in new:
+            free(nh)  # don't pin the results in the registry on failure
+        raise RuntimeError("op %s: %d outputs but %d destinations"
+                           % (op_name, len(new), len(out_handles)))
+    import jax
+
+    for nh, oh in zip(new, out_handles):
+        dst, src = _get(oh), _get(nh)
+        # keep the destination on ITS device (same reason __setitem__
+        # device_puts): the result may have been computed elsewhere
+        dst._data = jax.device_put(src._data, dst._data.sharding)
+        free(nh)
+    return 0
+
+
+# ------------------------------------------------------------- DataIter
+# Reference group: include/mxnet/c_api.h MXListDataIters /
+# MXDataIterCreateIter / MXDataIterNext / MXDataIterGetData|Label|PadNum.
+# An iterator handle owns the Python DataIter plus its current batch.
+
+class _IterState:
+    __slots__ = ("it", "batch")
+
+    def __init__(self, it):
+        self.it = it
+        self.batch = None
+
+
+def list_data_iters():
+    from .io import _ITER_REG
+    return sorted(str(n) for n in _ITER_REG._map)
+
+
+def data_iter_create(name, keys, vals):
+    """Create a registered iterator from string kwargs (the reference's
+    dmlc::Parameter string parsing, c_api.cc MXDataIterCreateIter)."""
+    import ast
+
+    from . import io as _io
+
+    kwargs = {}
+    for k, v in zip(keys, vals):
+        k, v = str(k), str(v)
+        try:
+            kwargs[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            kwargs[k] = v
+    if str(name) == "NDArrayIter":
+        data = kwargs.pop("data", None)
+        label = kwargs.pop("label", None)
+        it = _io.NDArrayIter(data=_get(data) if data is not None else None,
+                             label=_get(label) if label is not None else None,
+                             **kwargs)
+    else:
+        it = _io.create_iterator(str(name), **kwargs)
+    return _register(_IterState(it))
+
+
+def data_iter_before_first(h):
+    st = _get(h)
+    st.it.reset()
+    st.batch = None
+    return 0
+
+
+def data_iter_next(h):
+    st = _get(h)
+    try:
+        st.batch = next(st.it)
+    except StopIteration:
+        st.batch = None
+        return 0
+    return 1
+
+
+def _batch_field(h, field):
+    st = _get(h)
+    if st.batch is None:
+        raise RuntimeError("DataIter: no current batch (call Next first)")
+    arrs = getattr(st.batch, field)
+    if not arrs:
+        raise RuntimeError("DataIter: batch has no %s" % field)
+    return _register(arrs[0])
+
+
+def data_iter_data(h):
+    return _batch_field(h, "data")
+
+
+def data_iter_label(h):
+    return _batch_field(h, "label")
+
+
+def data_iter_pad(h):
+    st = _get(h)
+    return int(getattr(st.batch, "pad", 0) or 0)
+
+
+# ------------------------------------------------------------- Autograd
+# Reference group: MXAutogradSetIsRecording/SetIsTraining, MarkVariables,
+# MXAutogradBackward(Ex), MXNDArrayGetGrad (include/mxnet/c_api.h).
+
+def autograd_set_recording(flag):
+    from . import autograd as ag
+    return int(ag.set_recording(bool(flag)))
+
+
+def autograd_set_training(flag):
+    from . import autograd as ag
+    return int(ag.set_training(bool(flag)))
+
+
+def autograd_is_recording():
+    from . import autograd as ag
+    return int(ag.is_recording())
+
+
+def autograd_mark_variables(var_handles, grad_handles, reqs):
+    from . import autograd as ag
+    _REQ = {0: "null", 1: "write", 2: "add"}
+    variables = [_get(h) for h in var_handles]
+    grads = [_get(h) for h in grad_handles]
+    ag.mark_variables(variables, grads,
+                      [_REQ.get(int(r), "write") for r in reqs])
+    return 0
+
+
+def autograd_backward(out_handles, ograd_handles, retain_graph):
+    from . import autograd as ag
+    outs = [_get(h) for h in out_handles]
+    heads = None
+    if ograd_handles:
+        heads = [_get(h) for h in ograd_handles]
+    ag.backward(outs, heads, retain_graph=bool(retain_graph))
+    return 0
+
+
+def ndarray_get_grad(h):
+    arr = _get(h)
+    if arr.grad is None:
+        raise RuntimeError("NDArray has no grad buffer (mark it first)")
+    return _register(arr.grad)
+
+
+# ------------------------------------------------------------- RecordIO
+# Reference group: MXRecordIOWriterCreate/WriteRecord,
+# MXRecordIOReaderCreate/ReadRecord (include/mxnet/c_api.h; recordio pack
+# format src/core/recordio.cc).
+
+def recordio_writer_create(uri):
+    from .recordio import MXRecordIO
+    r = MXRecordIO(str(uri), "w")
+    return _register(r)
+
+
+def recordio_write(h, buf):
+    _get(h).write(bytes(buf))
+    return 0
+
+
+def recordio_reader_create(uri):
+    from .recordio import MXRecordIO
+    r = MXRecordIO(str(uri), "r")
+    return _register(r)
+
+
+def recordio_read(h):
+    """None = end of file; b"" is a legitimate zero-length record."""
+    rec = _get(h).read()
+    return None if rec is None else bytes(rec)
+
+
+def recordio_close(h):
+    _get(h).close()
+    return free(h)

@@ -409,8 +409,8 @@ mxtpu__imperative_invoke(op_name, in_ref, keys_ref, vals_ref)
         keys[i] = SvPV_nolen(*av_fetch(kav, i, 0));
         vals[i] = SvPV_nolen(*av_fetch(vav, i, 0));
     }
-    mx_uint no;
-    NDArrayHandle *outs;
+    mx_uint no = 0;
+    NDArrayHandle *outs = NULL;
     if (MXImperativeInvoke(op_name, ni, ins, &no, &outs, np, keys, vals) != 0)
         croak("MXImperativeInvoke(%s): %s", op_name, MXGetLastError());
     for (mx_uint i = 0; i < no; ++i)

@@ -22,6 +22,8 @@ typedef void *NDArrayHandle;
 typedef void *SymbolHandle;
 typedef void *ExecutorHandle;
 typedef void *KVStoreHandle;
+typedef void *DataIterHandle;
+typedef void *RecordIOHandle;
 
 const char *MXGetLastError(void);
 
@@ -47,9 +49,16 @@ int MXNDArrayLoad(const char *fname, mx_uint *out_size,
 /* Generic op invocation (reference MXImperativeInvoke): run ANY of the
  * registered operators on NDArray handles. param_keys/param_vals are
  * string attrs parsed through the op's parameter spec, exactly like the
- * reference's dmlc::Parameter string parsing. *num_outputs/*outputs
- * (and MXListAllOpNames' outputs) are backed by per-thread arenas valid
- * until the next call on the same thread. */
+ * reference's dmlc::Parameter string parsing.
+ *
+ * *outputs is IN/OUT, like the reference's (c_api_ndarray.cc): callers
+ * wanting newly-allocated results MUST initialize *outputs = NULL and
+ * *num_outputs = 0 before the call; the results then arrive in a
+ * per-thread arena valid until the next call on the same thread. If
+ * *outputs is non-NULL on entry it names *num_outputs preallocated
+ * destination arrays and the op writes into them in place (e.g.
+ * sgd_update(w, g) with out = w) — not allowed while autograd is
+ * recording. MXListAllOpNames' strings use the same per-thread arena. */
 int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
 int MXImperativeInvoke(const char *op_name, mx_uint num_inputs,
                        NDArrayHandle *inputs, mx_uint *num_outputs,
@@ -83,6 +92,54 @@ int MXExecutorOutput(ExecutorHandle exec, mx_uint index, NDArrayHandle *out);
 int MXExecutorArg(ExecutorHandle exec, const char *name, NDArrayHandle *out);
 int MXExecutorGrad(ExecutorHandle exec, const char *name, NDArrayHandle *out);
 int MXExecutorFree(ExecutorHandle exec);
+
+/* ---------------- DataIter ----------------
+ * Reference group: include/mxnet/c_api.h MXListDataIters /
+ * MXDataIterCreateIter / MXDataIterNext / MXDataIterGetData|Label|PadNum.
+ * Iterators are created by registered name (MNISTIter, CSVIter,
+ * LibSVMIter, ImageRecordIter, ...) from string parameters, exactly like
+ * the reference's dmlc::Parameter parsing. GetData/GetLabel return
+ * NDArray handles owned by the caller (free with MXNDArrayFree); they
+ * stay valid after the next MXDataIterNext. */
+int MXListDataIters(mx_uint *out_size, const char ***out_array);
+int MXDataIterCreateIter(const char *name, mx_uint num_params,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out);
+int MXDataIterFree(DataIterHandle handle);
+int MXDataIterBeforeFirst(DataIterHandle handle);
+int MXDataIterNext(DataIterHandle handle, int *out);
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad);
+
+/* ---------------- Autograd ----------------
+ * Reference group: MXAutogradSetIsRecording / MXAutogradMarkVariables /
+ * MXAutogradBackward / MXNDArrayGetGrad — the tape-based imperative
+ * training path through the ABI (src/c_api/c_api_ndarray.cc). grad_req
+ * codes: 0=null 1=write 2=add (include/mxnet/op_attr_types.h:44-59). */
+int MXAutogradSetIsRecording(int is_recording, int *prev);
+int MXAutogradSetIsTraining(int is_training, int *prev);
+int MXAutogradIsRecording(int *curr);
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle *var_handles,
+                            mx_uint *grad_reqs, NDArrayHandle *grad_handles);
+int MXAutogradBackward(mx_uint num_output, NDArrayHandle *output_handles,
+                       NDArrayHandle *ograd_handles, int retain_graph);
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out);
+
+/* ---------------- RecordIO ----------------
+ * Reference group: MXRecordIOWriterCreate/WriteRecord + reader side
+ * (dmlc recordio framing, src/core/recordio.cc). ReadRecord returns a
+ * pointer into a per-thread buffer valid until the next read on the
+ * same thread; end of file sets *out_buf = NULL (a zero-length record
+ * returns a non-NULL buffer with *out_size = 0). */
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out);
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                uint64_t size);
+int MXRecordIOWriterFree(RecordIOHandle handle);
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out);
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, const char **out_buf,
+                               uint64_t *out_size);
+int MXRecordIOReaderFree(RecordIOHandle handle);
 
 /* ---------------- KVStore ---------------- */
 int MXKVStoreCreate(const char *type, KVStoreHandle *out);

