@@ -1,0 +1,69 @@
+package ml.dmlc.mxtpu;
+
+/**
+ * Raw JNI surface over the C training ABI (src/capi/c_api.h) — the JVM
+ * binding's seam, parity with the reference's scala-package native layer
+ * (/root/reference/scala-package/core/src/main/scala/ml/dmlc/mxnet/LibInfo.scala,
+ * which declares the same @native methods over include/mxnet/c_api.h).
+ * Handles are opaque longs; failures surface as RuntimeException with the
+ * native MXGetLastError message.
+ *
+ * Load order: the capi library must be resolvable (java.library.path or
+ * LD_LIBRARY_PATH must include mxtpu/native), then libmxtpu_jni.
+ */
+public final class LibMXTPU {
+  static {
+    System.loadLibrary("mxtpu_jni");
+  }
+
+  private LibMXTPU() {}
+
+  // NDArray
+  public static native long ndarrayCreate(int[] shape, int dtype);
+  public static native void ndarrayFree(long handle);
+  public static native void ndarrayCopyFrom(long handle, float[] data);
+  public static native void ndarrayCopyTo(long handle, float[] out);
+  public static native int[] ndarrayShape(long handle);
+  public static native void waitAll();
+
+  // imperative dispatch; outs == null allocates, non-null writes in place
+  public static native long[] imperativeInvoke(
+      String op, long[] inputs, String[] keys, String[] vals, long[] outs);
+
+  // autograd
+  public static native int autogradSetRecording(int flag);
+  public static native int autogradSetTraining(int flag);
+  public static native void autogradMarkVariables(
+      long[] vars, int[] gradReqs, long[] grads);
+  public static native void autogradBackward(long[] outputs);
+  public static native long ndarrayGetGrad(long handle);
+
+  // symbol / executor
+  public static native long symbolFromJson(String json);
+  public static native String[] symbolArguments(long handle);
+  public static native long executorSimpleBind(
+      long symbol, String gradReq, String[] inputNames, int[][] shapes);
+  public static native void executorForward(long exec, int isTrain);
+  public static native void executorBackward(long exec);
+  public static native long executorArg(long exec, String name);
+  public static native long executorGrad(long exec, String name);
+  public static native long executorOutput(long exec, int index);
+
+  // kvstore
+  public static native long kvstoreCreate(String type);
+  public static native void kvstoreSetOptimizer(
+      long kv, String name, float lr, float wd, float momentum,
+      float rescaleGrad);
+  public static native void kvstoreInit(long kv, String key, long value);
+  public static native void kvstorePush(long kv, String key, long value);
+  public static native void kvstorePull(long kv, String key, long out);
+
+  // data iterators
+  public static native long dataIterCreate(
+      String name, String[] keys, String[] vals);
+  public static native void dataIterBeforeFirst(long handle);
+  public static native int dataIterNext(long handle);
+  public static native long dataIterData(long handle);
+  public static native long dataIterLabel(long handle);
+  public static native int dataIterPadNum(long handle);
+}

@@ -1,0 +1,84 @@
+package ml.dmlc.mxtpu;
+
+/**
+ * Minimal Module-style training helper (parity: the reference's
+ * scala-package Model/FeedForward flow over Symbol + Executor + KVStore —
+ * scala-package/core/src/main/scala/ml/dmlc/mxnet/Model.scala). Binds a
+ * symbol from JSON, initializes parameters, and runs
+ * forward/backward + optimizer-on-kvstore updates.
+ */
+public final class Module implements AutoCloseable {
+  private final long symbol;
+  private final long exec;
+  private final long kv;
+  private final String[] paramNames;
+
+  public Module(String symbolJson, String[] inputNames, int[][] inputShapes,
+                float lr, float momentum, float rescaleGrad) {
+    symbol = LibMXTPU.symbolFromJson(symbolJson);
+    exec = LibMXTPU.executorSimpleBind(symbol, "write", inputNames,
+                                       inputShapes);
+    kv = LibMXTPU.kvstoreCreate("local");
+    LibMXTPU.kvstoreSetOptimizer(kv, "sgd", lr, 0.0f, momentum, rescaleGrad);
+
+    String[] args = LibMXTPU.symbolArguments(symbol);
+    java.util.List<String> params = new java.util.ArrayList<>();
+    java.util.Set<String> inputs = new java.util.HashSet<>();
+    java.util.Collections.addAll(inputs, inputNames);
+    for (String a : args) {
+      if (!inputs.contains(a)) params.add(a);
+    }
+    paramNames = params.toArray(new String[0]);
+
+    // deterministic uniform(-0.1, 0.1) init, as the C demo does
+    long seed = 12345;
+    for (String p : paramNames) {
+      long w = LibMXTPU.executorArg(exec, p);
+      int[] shape = LibMXTPU.ndarrayShape(w);
+      int total = 1;
+      for (int d : shape) total *= d;
+      float[] init = new float[total];
+      for (int i = 0; i < total; ++i) {
+        seed = seed * 1103515245L + 12345L;
+        init[i] = (((seed >> 16) & 0x7fff) / 32768.0f - 0.5f) * 0.2f;
+      }
+      LibMXTPU.ndarrayCopyFrom(w, init);
+      LibMXTPU.kvstoreInit(kv, p, w);
+      LibMXTPU.ndarrayFree(w);
+    }
+  }
+
+  public void setInput(String name, float[] data) {
+    long a = LibMXTPU.executorArg(exec, name);
+    LibMXTPU.ndarrayCopyFrom(a, data);
+    LibMXTPU.ndarrayFree(a);
+  }
+
+  /** One epoch over the bound full batch: fwd, bwd, push/pull updates. */
+  public void step() {
+    LibMXTPU.executorForward(exec, 1);
+    LibMXTPU.executorBackward(exec);
+    for (String p : paramNames) {
+      long g = LibMXTPU.executorGrad(exec, p);
+      long w = LibMXTPU.executorArg(exec, p);
+      LibMXTPU.kvstorePush(kv, p, g);
+      LibMXTPU.kvstorePull(kv, p, w);
+      LibMXTPU.ndarrayFree(g);
+      LibMXTPU.ndarrayFree(w);
+    }
+  }
+
+  public float[] predict(int outputSize) {
+    LibMXTPU.executorForward(exec, 0);
+    long out = LibMXTPU.executorOutput(exec, 0);
+    float[] res = new float[outputSize];
+    LibMXTPU.ndarrayCopyTo(out, res);
+    LibMXTPU.ndarrayFree(out);
+    return res;
+  }
+
+  @Override
+  public void close() {
+    LibMXTPU.waitAll();
+  }
+}

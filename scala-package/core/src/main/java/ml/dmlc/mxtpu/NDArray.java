@@ -1,0 +1,81 @@
+package ml.dmlc.mxtpu;
+
+/**
+ * JVM NDArray over the C ABI (parity: the reference's
+ * scala-package/core/src/main/scala/ml/dmlc/mxnet/NDArray.scala, same
+ * handle-wrapping design). float32, CPU-context creation; device placement
+ * and dtype propagation happen inside the runtime.
+ */
+public final class NDArray implements AutoCloseable {
+  final long handle;
+  private boolean closed = false;
+
+  NDArray(long handle) {
+    this.handle = handle;
+  }
+
+  /** Raw ABI handle for LibMXTPU calls that take handle arrays. */
+  public long handle() {
+    return handle;
+  }
+
+  public static NDArray zeros(int... shape) {
+    return new NDArray(LibMXTPU.ndarrayCreate(shape, 0));
+  }
+
+  public static NDArray fromArray(float[] data, int... shape) {
+    NDArray a = zeros(shape);
+    a.set(data);
+    return a;
+  }
+
+  public void set(float[] data) {
+    LibMXTPU.ndarrayCopyFrom(handle, data);
+  }
+
+  public float[] toArray() {
+    int n = 1;
+    for (int d : shape()) n *= d;
+    float[] out = new float[n];
+    LibMXTPU.ndarrayCopyTo(handle, out);
+    return out;
+  }
+
+  public int[] shape() {
+    return LibMXTPU.ndarrayShape(handle);
+  }
+
+  public NDArray grad() {
+    return new NDArray(LibMXTPU.ndarrayGetGrad(handle));
+  }
+
+  /** Generic registered-op call; returns newly allocated outputs. */
+  public static NDArray[] invoke(
+      String op, NDArray[] inputs, String[] keys, String[] vals) {
+    long[] in = new long[inputs.length];
+    for (int i = 0; i < inputs.length; ++i) in[i] = inputs[i].handle;
+    long[] out = LibMXTPU.imperativeInvoke(op, in, keys, vals, null);
+    NDArray[] res = new NDArray[out.length];
+    for (int i = 0; i < out.length; ++i) res[i] = new NDArray(out[i]);
+    return res;
+  }
+
+  /** In-place registered-op call: results land in {@code outs}. */
+  public static void invokeInPlace(
+      String op, NDArray[] inputs, String[] keys, String[] vals,
+      NDArray[] outs) {
+    long[] in = new long[inputs.length];
+    for (int i = 0; i < inputs.length; ++i) in[i] = inputs[i].handle;
+    long[] oh = new long[outs.length];
+    for (int i = 0; i < outs.length; ++i) oh[i] = outs[i].handle;
+    LibMXTPU.imperativeInvoke(op, in, keys, vals, oh);
+  }
+
+  @Override
+  public void close() {
+    if (!closed) {
+      LibMXTPU.ndarrayFree(handle);
+      closed = true;
+    }
+  }
+}

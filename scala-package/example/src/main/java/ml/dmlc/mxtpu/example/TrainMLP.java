@@ -1,0 +1,83 @@
+package ml.dmlc.mxtpu.example;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.file.Files;
+import java.nio.file.Paths;
+
+import ml.dmlc.mxtpu.Module;
+import ml.dmlc.mxtpu.NDArray;
+import ml.dmlc.mxtpu.LibMXTPU;
+
+/**
+ * JVM training smoke (parity: the reference's scala-package
+ * examples/.../neuralnetwork/MLP training flow): loads a symbol JSON and a
+ * float32 blob dataset, trains via Module (executor + kvstore sgd), and
+ * prints "ACCURACY &lt;float&gt;". Also exercises the imperative +
+ * autograd path on a tiny expression to prove the tape works from the JVM.
+ *
+ * usage: TrainMLP sym.json data.bin labels.bin n dim classes epochs
+ */
+public final class TrainMLP {
+  private TrainMLP() {}
+
+  static float[] readFloats(String path, int n) throws Exception {
+    byte[] raw = Files.readAllBytes(Paths.get(path));
+    ByteBuffer bb = ByteBuffer.wrap(raw).order(ByteOrder.LITTLE_ENDIAN);
+    float[] out = new float[n];
+    bb.asFloatBuffer().get(out);
+    return out;
+  }
+
+  public static void main(String[] args) throws Exception {
+    String symJson = new String(Files.readAllBytes(Paths.get(args[0])));
+    int n = Integer.parseInt(args[3]);
+    int dim = Integer.parseInt(args[4]);
+    int classes = Integer.parseInt(args[5]);
+    int epochs = args.length > 6 ? Integer.parseInt(args[6]) : 60;
+    float[] data = readFloats(args[1], n * dim);
+    float[] labels = readFloats(args[2], n);
+
+    // tape smoke: d/dx sum((x*x)) == 2x through the JVM autograd surface
+    try (NDArray x = NDArray.fromArray(new float[] {1f, 2f, 3f}, 3);
+         NDArray gx = NDArray.zeros(3)) {
+      LibMXTPU.autogradMarkVariables(
+          new long[] {x.handle()}, new int[] {1}, new long[] {gx.handle()});
+      LibMXTPU.autogradSetTraining(1);
+      LibMXTPU.autogradSetRecording(1);
+      NDArray[] y =
+          NDArray.invoke("elemwise_mul", new NDArray[] {x, x}, null, null);
+      NDArray[] s = NDArray.invoke("sum", y, null, null);
+      LibMXTPU.autogradSetRecording(0);
+      LibMXTPU.autogradSetTraining(0);
+      LibMXTPU.autogradBackward(new long[] {s[0].handle()});
+      float[] g = x.grad().toArray();
+      if (Math.abs(g[0] - 2f) > 1e-5 || Math.abs(g[2] - 6f) > 1e-5) {
+        System.err.println("AUTOGRAD_MISMATCH " + g[0] + " " + g[2]);
+        System.exit(1);
+      }
+      System.out.println("AUTOGRAD_OK");
+    }
+
+    try (Module mod = new Module(
+             symJson, new String[] {"data", "softmax_label"},
+             new int[][] {{n, dim}, {n}}, 0.5f, 0.9f, 1.0f / n)) {
+      mod.setInput("data", data);
+      mod.setInput("softmax_label", labels);
+      for (int e = 0; e < epochs; ++e) {
+        mod.step();
+      }
+      float[] probs = mod.predict(n * classes);
+      int correct = 0;
+      for (int i = 0; i < n; ++i) {
+        int best = 0;
+        for (int c = 1; c < classes; ++c) {
+          if (probs[i * classes + c] > probs[i * classes + best]) best = c;
+        }
+        if (best == (int) labels[i]) ++correct;
+      }
+      System.out.printf("ACCURACY %.4f%n", (double) correct / n);
+    }
+  }
+
+}

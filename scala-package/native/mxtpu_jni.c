@@ -1,0 +1,449 @@
+/* JNI shim over the full C training ABI (src/capi/c_api.h) — the JVM
+ * binding's native seam, parity with the reference's scala-package JNI
+ * layer (/root/reference/scala-package/native/src/main/native/
+ * ml_dmlc_mxnet_native_c_api.cc, which wraps include/mxnet/c_api.h the
+ * same way). Handles cross the boundary as jlong; every failed call
+ * throws java.lang.RuntimeException carrying MXGetLastError().
+ *
+ * Build (needs a JDK for jni.h):
+ *   gcc -shared -fPIC -I$JAVA_HOME/include -I$JAVA_HOME/include/linux \
+ *       -I../../src/capi mxtpu_jni.c -L../../mxtpu/native -lmxtpu_capi \
+ *       -o libmxtpu_jni.so
+ */
+#include <jni.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "c_api.h"
+
+static void throw_mx(JNIEnv *env, const char *where) {
+  jclass cls = (*env)->FindClass(env, "java/lang/RuntimeException");
+  char msg[1024];
+  snprintf(msg, sizeof msg, "%s: %s", where, MXGetLastError());
+  (*env)->ThrowNew(env, cls, msg);
+}
+
+#define JCHECK(call, ret)            \
+  if ((call) != 0) {                 \
+    throw_mx(env, #call);            \
+    return ret;                      \
+  }
+
+/* ---------------- NDArray ---------------- */
+
+JNIEXPORT jlong JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_ndarrayCreate(
+    JNIEnv *env, jclass cls, jintArray jshape, jint dtype) {
+  (void)cls;
+  jsize ndim = (*env)->GetArrayLength(env, jshape);
+  jint *dims = (*env)->GetIntArrayElements(env, jshape, NULL);
+  mx_uint shape[16];
+  for (jsize i = 0; i < ndim && i < 16; ++i) shape[i] = (mx_uint)dims[i];
+  (*env)->ReleaseIntArrayElements(env, jshape, dims, JNI_ABORT);
+  NDArrayHandle h;
+  JCHECK(MXNDArrayCreate(shape, (mx_uint)ndim, 1, 0, 0, dtype, &h), 0);
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT void JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_ndarrayFree(
+    JNIEnv *env, jclass cls, jlong h) {
+  (void)cls;
+  JCHECK(MXNDArrayFree((NDArrayHandle)(intptr_t)h), );
+}
+
+JNIEXPORT void JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_ndarrayCopyFrom(
+    JNIEnv *env, jclass cls, jlong h, jfloatArray jdata) {
+  (void)cls;
+  jsize n = (*env)->GetArrayLength(env, jdata);
+  jfloat *data = (*env)->GetFloatArrayElements(env, jdata, NULL);
+  int rc = MXNDArraySyncCopyFromCPU((NDArrayHandle)(intptr_t)h, data,
+                                    (uint64_t)n * 4);
+  (*env)->ReleaseFloatArrayElements(env, jdata, data, JNI_ABORT);
+  if (rc != 0) throw_mx(env, "MXNDArraySyncCopyFromCPU");
+}
+
+JNIEXPORT void JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_ndarrayCopyTo(
+    JNIEnv *env, jclass cls, jlong h, jfloatArray jout) {
+  (void)cls;
+  jsize n = (*env)->GetArrayLength(env, jout);
+  jfloat *out = (*env)->GetFloatArrayElements(env, jout, NULL);
+  int rc = MXNDArraySyncCopyToCPU((NDArrayHandle)(intptr_t)h, out,
+                                  (uint64_t)n * 4);
+  (*env)->ReleaseFloatArrayElements(env, jout, out, rc == 0 ? 0 : JNI_ABORT);
+  if (rc != 0) throw_mx(env, "MXNDArraySyncCopyToCPU");
+}
+
+JNIEXPORT jintArray JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_ndarrayShape(
+    JNIEnv *env, jclass cls, jlong h) {
+  (void)cls;
+  mx_uint ndim;
+  const mx_uint *shape;
+  JCHECK(MXNDArrayGetShape((NDArrayHandle)(intptr_t)h, &ndim, &shape), NULL);
+  jintArray out = (*env)->NewIntArray(env, (jsize)ndim);
+  jint tmp[16];
+  for (mx_uint i = 0; i < ndim && i < 16; ++i) tmp[i] = (jint)shape[i];
+  (*env)->SetIntArrayRegion(env, out, 0, (jsize)ndim, tmp);
+  return out;
+}
+
+JNIEXPORT void JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_waitAll(
+    JNIEnv *env, jclass cls) {
+  (void)cls;
+  JCHECK(MXNDArrayWaitAll(), );
+}
+
+/* ---------------- imperative invoke ---------------- */
+
+static void fill_cstrings(JNIEnv *env, jobjectArray arr, const char **out,
+                          int n) {
+  for (int i = 0; i < n; ++i) {
+    jstring s = (jstring)(*env)->GetObjectArrayElement(env, arr, i);
+    out[i] = (*env)->GetStringUTFChars(env, s, NULL);
+  }
+}
+
+static void release_cstrings(JNIEnv *env, jobjectArray arr, const char **strs,
+                             int n) {
+  for (int i = 0; i < n; ++i) {
+    jstring s = (jstring)(*env)->GetObjectArrayElement(env, arr, i);
+    (*env)->ReleaseStringUTFChars(env, s, strs[i]);
+  }
+}
+
+JNIEXPORT jlongArray JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_imperativeInvoke(
+    JNIEnv *env, jclass cls, jstring jop, jlongArray jins, jobjectArray jkeys,
+    jobjectArray jvals, jlongArray jouts) {
+  (void)cls;
+  const char *op = (*env)->GetStringUTFChars(env, jop, NULL);
+  jsize ni = (*env)->GetArrayLength(env, jins);
+  jlong *ins = (*env)->GetLongArrayElements(env, jins, NULL);
+  NDArrayHandle in_h[64];
+  for (jsize i = 0; i < ni && i < 64; ++i) {
+    in_h[i] = (NDArrayHandle)(intptr_t)ins[i];
+  }
+  (*env)->ReleaseLongArrayElements(env, jins, ins, JNI_ABORT);
+  jsize np = jkeys ? (*env)->GetArrayLength(env, jkeys) : 0;
+  const char *keys[32], *vals[32];
+  if (np > 0) {
+    fill_cstrings(env, jkeys, keys, np);
+    fill_cstrings(env, jvals, vals, np);
+  }
+  mx_uint n_out = 0;
+  NDArrayHandle *outs = NULL;
+  NDArrayHandle fixed[16];
+  if (jouts != NULL) { /* in-place form: caller-provided destinations */
+    n_out = (mx_uint)(*env)->GetArrayLength(env, jouts);
+    jlong *oh = (*env)->GetLongArrayElements(env, jouts, NULL);
+    for (mx_uint i = 0; i < n_out && i < 16; ++i) {
+      fixed[i] = (NDArrayHandle)(intptr_t)oh[i];
+    }
+    (*env)->ReleaseLongArrayElements(env, jouts, oh, JNI_ABORT);
+    outs = fixed;
+  }
+  int rc = MXImperativeInvoke(op, (mx_uint)ni, in_h, &n_out, &outs, np, keys,
+                              vals);
+  if (np > 0) {
+    release_cstrings(env, jkeys, keys, np);
+    release_cstrings(env, jvals, vals, np);
+  }
+  (*env)->ReleaseStringUTFChars(env, jop, op);
+  if (rc != 0) {
+    throw_mx(env, "MXImperativeInvoke");
+    return NULL;
+  }
+  jlongArray jres = (*env)->NewLongArray(env, (jsize)n_out);
+  jlong tmp[64];
+  for (mx_uint i = 0; i < n_out && i < 64; ++i) {
+    tmp[i] = (jlong)(intptr_t)outs[i];
+  }
+  (*env)->SetLongArrayRegion(env, jres, 0, (jsize)n_out, tmp);
+  return jres;
+}
+
+/* ---------------- autograd ---------------- */
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_autogradSetRecording(
+    JNIEnv *env, jclass cls, jint flag) {
+  (void)cls;
+  int prev = 0;
+  JCHECK(MXAutogradSetIsRecording(flag, &prev), 0);
+  return prev;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_autogradSetTraining(
+    JNIEnv *env, jclass cls, jint flag) {
+  (void)cls;
+  int prev = 0;
+  JCHECK(MXAutogradSetIsTraining(flag, &prev), 0);
+  return prev;
+}
+
+JNIEXPORT void JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_autogradMarkVariables(
+    JNIEnv *env, jclass cls, jlongArray jvars, jintArray jreqs,
+    jlongArray jgrads) {
+  (void)cls;
+  jsize n = (*env)->GetArrayLength(env, jvars);
+  jlong *vars = (*env)->GetLongArrayElements(env, jvars, NULL);
+  jlong *grads = (*env)->GetLongArrayElements(env, jgrads, NULL);
+  jint *reqs = (*env)->GetIntArrayElements(env, jreqs, NULL);
+  NDArrayHandle vh[64], gh[64];
+  mx_uint rq[64];
+  for (jsize i = 0; i < n && i < 64; ++i) {
+    vh[i] = (NDArrayHandle)(intptr_t)vars[i];
+    gh[i] = (NDArrayHandle)(intptr_t)grads[i];
+    rq[i] = (mx_uint)reqs[i];
+  }
+  (*env)->ReleaseLongArrayElements(env, jvars, vars, JNI_ABORT);
+  (*env)->ReleaseLongArrayElements(env, jgrads, grads, JNI_ABORT);
+  (*env)->ReleaseIntArrayElements(env, jreqs, reqs, JNI_ABORT);
+  JCHECK(MXAutogradMarkVariables((mx_uint)n, vh, rq, gh), );
+}
+
+JNIEXPORT void JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_autogradBackward(
+    JNIEnv *env, jclass cls, jlongArray jouts) {
+  (void)cls;
+  jsize n = (*env)->GetArrayLength(env, jouts);
+  jlong *outs = (*env)->GetLongArrayElements(env, jouts, NULL);
+  NDArrayHandle oh[16];
+  for (jsize i = 0; i < n && i < 16; ++i) {
+    oh[i] = (NDArrayHandle)(intptr_t)outs[i];
+  }
+  (*env)->ReleaseLongArrayElements(env, jouts, outs, JNI_ABORT);
+  JCHECK(MXAutogradBackward((mx_uint)n, oh, NULL, 0), );
+}
+
+JNIEXPORT jlong JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_ndarrayGetGrad(
+    JNIEnv *env, jclass cls, jlong h) {
+  (void)cls;
+  NDArrayHandle g;
+  JCHECK(MXNDArrayGetGrad((NDArrayHandle)(intptr_t)h, &g), 0);
+  return (jlong)(intptr_t)g;
+}
+
+/* ---------------- Symbol / Executor ---------------- */
+
+JNIEXPORT jlong JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_symbolFromJson(
+    JNIEnv *env, jclass cls, jstring jjson) {
+  (void)cls;
+  const char *json = (*env)->GetStringUTFChars(env, jjson, NULL);
+  SymbolHandle h;
+  int rc = MXSymbolCreateFromJSON(json, &h);
+  (*env)->ReleaseStringUTFChars(env, jjson, json);
+  if (rc != 0) {
+    throw_mx(env, "MXSymbolCreateFromJSON");
+    return 0;
+  }
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT jobjectArray JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_symbolArguments(
+    JNIEnv *env, jclass cls, jlong h) {
+  (void)cls;
+  mx_uint n;
+  const char **names;
+  JCHECK(MXSymbolListArguments((SymbolHandle)(intptr_t)h, &n, &names), NULL);
+  jobjectArray out = (*env)->NewObjectArray(
+      env, (jsize)n, (*env)->FindClass(env, "java/lang/String"), NULL);
+  for (mx_uint i = 0; i < n; ++i) {
+    (*env)->SetObjectArrayElement(env, out, (jsize)i,
+                                  (*env)->NewStringUTF(env, names[i]));
+  }
+  return out;
+}
+
+JNIEXPORT jlong JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_executorSimpleBind(
+    JNIEnv *env, jclass cls, jlong sym, jstring jreq, jobjectArray jnames,
+    jobjectArray jshapes) {
+  (void)cls;
+  const char *req = (*env)->GetStringUTFChars(env, jreq, NULL);
+  jsize n = (*env)->GetArrayLength(env, jnames);
+  const char *names[16];
+  fill_cstrings(env, jnames, names, n);
+  mx_uint indptr[17], shapes[64], pos = 0;
+  indptr[0] = 0;
+  for (jsize i = 0; i < n && i < 16; ++i) {
+    jintArray row = (jintArray)(*env)->GetObjectArrayElement(env, jshapes, i);
+    jsize nd = (*env)->GetArrayLength(env, row);
+    jint *dims = (*env)->GetIntArrayElements(env, row, NULL);
+    for (jsize j = 0; j < nd && pos < 64; ++j) shapes[pos++] = (mx_uint)dims[j];
+    (*env)->ReleaseIntArrayElements(env, row, dims, JNI_ABORT);
+    indptr[i + 1] = pos;
+  }
+  ExecutorHandle exec;
+  int rc = MXExecutorSimpleBind((SymbolHandle)(intptr_t)sym, 1, 0, req,
+                                (mx_uint)n, names, indptr, shapes, &exec);
+  release_cstrings(env, jnames, names, n);
+  (*env)->ReleaseStringUTFChars(env, jreq, req);
+  if (rc != 0) {
+    throw_mx(env, "MXExecutorSimpleBind");
+    return 0;
+  }
+  return (jlong)(intptr_t)exec;
+}
+
+JNIEXPORT void JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_executorForward(
+    JNIEnv *env, jclass cls, jlong exec, jint isTrain) {
+  (void)cls;
+  JCHECK(MXExecutorForward((ExecutorHandle)(intptr_t)exec, isTrain), );
+}
+
+JNIEXPORT void JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_executorBackward(
+    JNIEnv *env, jclass cls, jlong exec) {
+  (void)cls;
+  JCHECK(MXExecutorBackward((ExecutorHandle)(intptr_t)exec), );
+}
+
+JNIEXPORT jlong JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_executorArg(
+    JNIEnv *env, jclass cls, jlong exec, jstring jname) {
+  (void)cls;
+  const char *name = (*env)->GetStringUTFChars(env, jname, NULL);
+  NDArrayHandle h;
+  int rc = MXExecutorArg((ExecutorHandle)(intptr_t)exec, name, &h);
+  (*env)->ReleaseStringUTFChars(env, jname, name);
+  if (rc != 0) {
+    throw_mx(env, "MXExecutorArg");
+    return 0;
+  }
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT jlong JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_executorGrad(
+    JNIEnv *env, jclass cls, jlong exec, jstring jname) {
+  (void)cls;
+  const char *name = (*env)->GetStringUTFChars(env, jname, NULL);
+  NDArrayHandle h;
+  int rc = MXExecutorGrad((ExecutorHandle)(intptr_t)exec, name, &h);
+  (*env)->ReleaseStringUTFChars(env, jname, name);
+  if (rc != 0) {
+    throw_mx(env, "MXExecutorGrad");
+    return 0;
+  }
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT jlong JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_executorOutput(
+    JNIEnv *env, jclass cls, jlong exec, jint idx) {
+  (void)cls;
+  NDArrayHandle h;
+  JCHECK(MXExecutorOutput((ExecutorHandle)(intptr_t)exec, (mx_uint)idx, &h),
+         0);
+  return (jlong)(intptr_t)h;
+}
+
+/* ---------------- KVStore ---------------- */
+
+JNIEXPORT jlong JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_kvstoreCreate(
+    JNIEnv *env, jclass cls, jstring jtype) {
+  (void)cls;
+  const char *type = (*env)->GetStringUTFChars(env, jtype, NULL);
+  KVStoreHandle h;
+  int rc = MXKVStoreCreate(type, &h);
+  (*env)->ReleaseStringUTFChars(env, jtype, type);
+  if (rc != 0) {
+    throw_mx(env, "MXKVStoreCreate");
+    return 0;
+  }
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT void JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_kvstoreSetOptimizer(
+    JNIEnv *env, jclass cls, jlong kv, jstring jname, jfloat lr, jfloat wd,
+    jfloat momentum, jfloat rescale) {
+  (void)cls;
+  const char *name = (*env)->GetStringUTFChars(env, jname, NULL);
+  int rc = MXKVStoreSetOptimizer((KVStoreHandle)(intptr_t)kv, name, lr, wd,
+                                 momentum, rescale);
+  (*env)->ReleaseStringUTFChars(env, jname, name);
+  if (rc != 0) throw_mx(env, "MXKVStoreSetOptimizer");
+}
+
+JNIEXPORT void JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_kvstoreInit(
+    JNIEnv *env, jclass cls, jlong kv, jstring jkey, jlong val) {
+  (void)cls;
+  const char *key = (*env)->GetStringUTFChars(env, jkey, NULL);
+  int rc = MXKVStoreInit((KVStoreHandle)(intptr_t)kv, key,
+                         (NDArrayHandle)(intptr_t)val);
+  (*env)->ReleaseStringUTFChars(env, jkey, key);
+  if (rc != 0) throw_mx(env, "MXKVStoreInit");
+}
+
+JNIEXPORT void JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_kvstorePush(
+    JNIEnv *env, jclass cls, jlong kv, jstring jkey, jlong val) {
+  (void)cls;
+  const char *key = (*env)->GetStringUTFChars(env, jkey, NULL);
+  int rc = MXKVStorePush((KVStoreHandle)(intptr_t)kv, key,
+                         (NDArrayHandle)(intptr_t)val);
+  (*env)->ReleaseStringUTFChars(env, jkey, key);
+  if (rc != 0) throw_mx(env, "MXKVStorePush");
+}
+
+JNIEXPORT void JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_kvstorePull(
+    JNIEnv *env, jclass cls, jlong kv, jstring jkey, jlong out) {
+  (void)cls;
+  const char *key = (*env)->GetStringUTFChars(env, jkey, NULL);
+  int rc = MXKVStorePull((KVStoreHandle)(intptr_t)kv, key,
+                         (NDArrayHandle)(intptr_t)out);
+  (*env)->ReleaseStringUTFChars(env, jkey, key);
+  if (rc != 0) throw_mx(env, "MXKVStorePull");
+}
+
+/* ---------------- DataIter ---------------- */
+
+JNIEXPORT jlong JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_dataIterCreate(
+    JNIEnv *env, jclass cls, jstring jname, jobjectArray jkeys,
+    jobjectArray jvals) {
+  (void)cls;
+  const char *name = (*env)->GetStringUTFChars(env, jname, NULL);
+  jsize np = (*env)->GetArrayLength(env, jkeys);
+  const char *keys[32], *vals[32];
+  fill_cstrings(env, jkeys, keys, np);
+  fill_cstrings(env, jvals, vals, np);
+  DataIterHandle h;
+  int rc = MXDataIterCreateIter(name, (mx_uint)np, keys, vals, &h);
+  release_cstrings(env, jkeys, keys, np);
+  release_cstrings(env, jvals, vals, np);
+  (*env)->ReleaseStringUTFChars(env, jname, name);
+  if (rc != 0) {
+    throw_mx(env, "MXDataIterCreateIter");
+    return 0;
+  }
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT void JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_dataIterBeforeFirst(
+    JNIEnv *env, jclass cls, jlong h) {
+  (void)cls;
+  JCHECK(MXDataIterBeforeFirst((DataIterHandle)(intptr_t)h), );
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_dataIterNext(
+    JNIEnv *env, jclass cls, jlong h) {
+  (void)cls;
+  int more = 0;
+  JCHECK(MXDataIterNext((DataIterHandle)(intptr_t)h, &more), 0);
+  return more;
+}
+
+JNIEXPORT jlong JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_dataIterData(
+    JNIEnv *env, jclass cls, jlong h) {
+  (void)cls;
+  NDArrayHandle out;
+  JCHECK(MXDataIterGetData((DataIterHandle)(intptr_t)h, &out), 0);
+  return (jlong)(intptr_t)out;
+}
+
+JNIEXPORT jlong JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_dataIterLabel(
+    JNIEnv *env, jclass cls, jlong h) {
+  (void)cls;
+  NDArrayHandle out;
+  JCHECK(MXDataIterGetLabel((DataIterHandle)(intptr_t)h, &out), 0);
+  return (jlong)(intptr_t)out;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_dataIterPadNum(
+    JNIEnv *env, jclass cls, jlong h) {
+  (void)cls;
+  int pad = 0;
+  JCHECK(MXDataIterGetPadNum((DataIterHandle)(intptr_t)h, &pad), 0);
+  return pad;
+}

@@ -1,0 +1,93 @@
+"""JVM binding (scala-package parity, VERDICT r2 #5): a JNI shim over the
+C training ABI with NDArray/Module classes; a JVM client trains an MLP to
+>0.9 accuracy and exercises the autograd tape. Gated on a JDK being
+present (javac + jni.h), the way the R binding gates on Rscript."""
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CAPI_SO = os.path.join(REPO, "mxtpu", "native", "libmxtpu_capi.so")
+JNI_SO = os.path.join(REPO, "mxtpu", "native", "libmxtpu_jni.so")
+
+
+def _java_home():
+    javac = shutil.which("javac")
+    if javac is None:
+        return None
+    home = os.environ.get("JAVA_HOME")
+    if home and os.path.exists(os.path.join(home, "include", "jni.h")):
+        return home
+    # derive from the javac path (…/bin/javac)
+    cand = os.path.dirname(os.path.dirname(os.path.realpath(javac)))
+    if os.path.exists(os.path.join(cand, "include", "jni.h")):
+        return cand
+    return None
+
+
+def test_jvm_client_trains_mlp(tmp_path):
+    home = _java_home()
+    if home is None:
+        pytest.skip("no JDK (javac/jni.h) on this machine")
+    r = subprocess.run(["make", "-C", os.path.join(REPO, "src"), "capi"],
+                       capture_output=True, text=True)
+    if not os.path.exists(CAPI_SO):
+        pytest.skip("libmxtpu_capi.so did not build: %s"
+                    % (r.stdout + r.stderr)[-300:])
+
+    # 1. build the JNI shim
+    r = subprocess.run(
+        ["gcc", "-shared", "-fPIC",
+         "-I", os.path.join(home, "include"),
+         "-I", os.path.join(home, "include", "linux"),
+         "-I", os.path.join(REPO, "src", "capi"),
+         os.path.join(REPO, "scala-package", "native", "mxtpu_jni.c"),
+         "-L", os.path.dirname(CAPI_SO), "-lmxtpu_capi",
+         "-Wl,-rpath," + os.path.dirname(CAPI_SO),
+         "-o", JNI_SO],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+    # 2. compile the Java sources
+    srcs = []
+    for root, _, files in os.walk(os.path.join(REPO, "scala-package")):
+        srcs += [os.path.join(root, f) for f in files if f.endswith(".java")]
+    classes = str(tmp_path / "classes")
+    r = subprocess.run(["javac", "-d", classes] + srcs,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+    # 3. dataset + symbol, as the C-ABI test builds them
+    import mxtpu as mx
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    sym_path = str(tmp_path / "mlp.json")
+    net.save(sym_path)
+    rng = np.random.RandomState(0)
+    n, dim, classes_n = 256, 16, 4
+    centers = rng.randn(classes_n, dim) * 3
+    y = rng.randint(0, classes_n, n)
+    X = (centers[y] + rng.randn(n, dim)).astype("float32")
+    (tmp_path / "data.bin").write_bytes(X.tobytes())
+    (tmp_path / "labels.bin").write_bytes(y.astype("float32").tobytes())
+
+    # 4. run the JVM client
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    out = subprocess.run(
+        ["java", "-cp", classes,
+         "-Djava.library.path=" + os.path.dirname(CAPI_SO),
+         "ml.dmlc.mxtpu.example.TrainMLP", sym_path,
+         str(tmp_path / "data.bin"), str(tmp_path / "labels.bin"),
+         str(n), str(dim), str(classes_n), "60"],
+        capture_output=True, text=True, env=env, timeout=900)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "AUTOGRAD_OK" in out.stdout, out.stdout
+    acc = float([ln for ln in out.stdout.splitlines()
+                 if "ACCURACY" in ln][0].split()[1])
+    assert acc > 0.9, "JVM training reached only %.3f" % acc
