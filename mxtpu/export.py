@@ -23,17 +23,9 @@ _MAGIC = b"MXTPUAOT"
 _VERSION = 1
 
 
-def export_serving(symbol, arg_params, aux_params, data_shapes, path,
-                   platforms=None):
-    """Serialize an inference-ready program to `path`.
-
-    symbol: inference Symbol; arg_params/aux_params: trained NDArray (or
-    array) dicts — baked into the program as constants; data_shapes:
-    {input_name: shape} for the data inputs that remain runtime arguments.
-    platforms: e.g. ("cpu", "tpu") for a cross-platform artifact (defaults
-    to the current backend).
-    """
-    import jax
+def _build_serve(symbol, arg_params, aux_params, data_shapes):
+    """Closure over the inference graph with weights baked in: returns
+    (serve_fn, inputs_dict) where serve_fn(*data_vals) -> tuple(outputs)."""
     import jax.numpy as jnp
 
     from .executor import _trace_graph
@@ -58,6 +50,24 @@ def export_serving(symbol, arg_params, aux_params, data_shapes, path,
         env.update(dict(zip(inputs.keys(), data_vals)))
         outs, _aux = run(env, aux, rng)
         return tuple(outs)
+
+    return serve, inputs
+
+
+def export_serving(symbol, arg_params, aux_params, data_shapes, path,
+                   platforms=None):
+    """Serialize an inference-ready program to `path`.
+
+    symbol: inference Symbol; arg_params/aux_params: trained NDArray (or
+    array) dicts — baked into the program as constants; data_shapes:
+    {input_name: shape} for the data inputs that remain runtime arguments.
+    platforms: e.g. ("cpu", "tpu") for a cross-platform artifact (defaults
+    to the current backend).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    serve, inputs = _build_serve(symbol, arg_params, aux_params, data_shapes)
 
     example = [jax.ShapeDtypeStruct(tuple(s), jnp.float32)
                for s in inputs.values()]
@@ -101,3 +111,42 @@ def load_serving(path):
         return exported.call(*vals)
 
     return fn, meta
+
+
+def export_frozen_graph(symbol, arg_params, aux_params, data_shapes, path):
+    """Python-FREE deployment artifact (the amalgamation story told
+    honestly): the inference program as a frozen TensorFlow GraphDef that
+    a plain C/C++ binary executes through the stable TF C API
+    (libtensorflow) with NO CPython in-process — the role the reference's
+    amalgamated libmxnet_predict plays for its c_predict_api clients
+    (amalgamation/amalgamation.py; MXNET_PREDICT_ONLY NaiveEngine path
+    src/engine/engine.cc:38-47).
+
+    Writes `path` (binary GraphDef) and `path + ".json"` ({inputs:
+    [{name, tensor, shape}], outputs: [{name, tensor}]}) naming the graph
+    tensors a client feeds/fetches. See src/predict/tf_predict.c.
+    """
+    import json as _json
+
+    import tensorflow as tf
+    from jax.experimental import jax2tf
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+
+    serve, inputs = _build_serve(symbol, arg_params, aux_params, data_shapes)
+    specs = [tf.TensorSpec(tuple(s), tf.float32, name=n)
+             for n, s in inputs.items()]
+    tff = tf.function(jax2tf.convert(serve), input_signature=specs)
+    frozen = convert_variables_to_constants_v2(tff.get_concrete_function())
+    graph_def = frozen.graph.as_graph_def()
+    with open(path, "wb") as f:
+        f.write(graph_def.SerializeToString())
+    meta = {
+        "inputs": [{"name": n, "tensor": t.name, "shape": list(t.shape)}
+                   for (n, _), t in zip(inputs.items(), frozen.inputs)],
+        "outputs": [{"name": n, "tensor": t.name}
+                    for n, t in zip(symbol.list_outputs(), frozen.outputs)],
+    }
+    with open(path + ".json", "w") as f:
+        _json.dump(meta, f, indent=1)
+    return path

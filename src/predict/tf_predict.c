@@ -1,0 +1,149 @@
+/*
+ * Python-free predict runner (VERDICT r2 #6): loads a frozen-GraphDef
+ * artifact written by mxtpu.export.export_frozen_graph and runs inference
+ * through the STABLE TensorFlow C API — no CPython, no mxtpu, no jax in
+ * this process. This is the amalgamation role of the reference
+ * (amalgamation/README.md: a single predict-only library a C client
+ * links; c_predict_api.h:77-152 four-call flow) realized over the XLA
+ * toolchain: train in Python, freeze to a graph, serve from plain C.
+ *
+ * usage: tf_predict <graph.pb> <input_tensor> <output_tensor> \
+ *                   <input.bin> <n_in_floats> <n_out_floats>
+ * Reads float32 little-endian input, prints each output value, one per
+ * line ("OUT <v>"), then "PREDICT_OK".
+ *
+ * Build: gcc -I$TF/include tf_predict.c $TF/libtensorflow_cc.so.2 \
+ *            $TF/libtensorflow_framework.so.2 -Wl,-rpath,$TF -o tf_predict
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "tensorflow/c/c_api.h"
+
+static void *read_file(const char *path, size_t *size) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  *size = (size_t)ftell(f);
+  fseek(f, 0, SEEK_SET);
+  void *buf = malloc(*size);
+  if (fread(buf, 1, *size, f) != *size) {
+    fclose(f);
+    free(buf);
+    return NULL;
+  }
+  fclose(f);
+  return buf;
+}
+
+static void free_buf(void *data, size_t len, void *arg) {
+  (void)len;
+  (void)arg;
+  free(data);
+}
+
+/* "name:0" -> {op-name, index} */
+static TF_Output resolve(TF_Graph *graph, const char *tensor) {
+  char name[256];
+  int idx = 0;
+  const char *colon = strrchr(tensor, ':');
+  if (colon) {
+    size_t n = (size_t)(colon - tensor);
+    if (n >= sizeof name) n = sizeof name - 1;
+    memcpy(name, tensor, n);
+    name[n] = 0;
+    idx = atoi(colon + 1);
+  } else {
+    snprintf(name, sizeof name, "%s", tensor);
+  }
+  TF_Output out;
+  out.oper = TF_GraphOperationByName(graph, name);
+  out.index = idx;
+  return out;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 7) {
+    fprintf(stderr,
+            "usage: %s graph.pb in_tensor out_tensor input.bin n_in n_out\n",
+            argv[0]);
+    return 2;
+  }
+  size_t gd_size, in_size;
+  void *gd = read_file(argv[1], &gd_size);
+  float *input = (float *)read_file(argv[4], &in_size);
+  long n_in = atol(argv[5]), n_out = atol(argv[6]);
+  if (!gd || !input || in_size < (size_t)n_in * 4) {
+    fprintf(stderr, "cannot read inputs\n");
+    return 2;
+  }
+
+  TF_Status *st = TF_NewStatus();
+  TF_Graph *graph = TF_NewGraph();
+  TF_Buffer *buf = TF_NewBufferFromString(gd, gd_size);
+  TF_ImportGraphDefOptions *opts = TF_NewImportGraphDefOptions();
+  TF_GraphImportGraphDef(graph, buf, opts, st);
+  if (TF_GetCode(st) != TF_OK) {
+    fprintf(stderr, "import: %s\n", TF_Message(st));
+    return 1;
+  }
+  TF_DeleteImportGraphDefOptions(opts);
+  TF_DeleteBuffer(buf);
+
+  TF_SessionOptions *sopts = TF_NewSessionOptions();
+  TF_Session *sess = TF_NewSession(graph, sopts, st);
+  if (TF_GetCode(st) != TF_OK) {
+    fprintf(stderr, "session: %s\n", TF_Message(st));
+    return 1;
+  }
+  TF_DeleteSessionOptions(sopts);
+
+  TF_Output in_op = resolve(graph, argv[2]);
+  TF_Output out_op = resolve(graph, argv[3]);
+  if (in_op.oper == NULL || out_op.oper == NULL) {
+    fprintf(stderr, "tensor not found (%s / %s)\n", argv[2], argv[3]);
+    return 1;
+  }
+
+  /* input tensor takes ownership of the file buffer */
+  int ndims;
+  int64_t dims[16];
+  {
+    int nd = TF_GraphGetTensorNumDims(graph, in_op, st);
+    TF_GraphGetTensorShape(graph, in_op, dims, nd, st);
+    ndims = nd;
+    int64_t total = 1;
+    for (int i = 0; i < nd; ++i) {
+      if (dims[i] < 0) dims[i] = 1; /* unknown batch: runner uses 1 */
+      total *= dims[i];
+    }
+    if (total != n_in) {
+      fprintf(stderr, "input size %ld != graph %ld\n", n_in, (long)total);
+      return 1;
+    }
+  }
+  TF_Tensor *in_t = TF_NewTensor(TF_FLOAT, dims, ndims, input,
+                                 (size_t)n_in * 4, free_buf, NULL);
+  TF_Tensor *out_t = NULL;
+  TF_SessionRun(sess, NULL, &in_op, &in_t, 1, &out_op, &out_t, 1, NULL, 0,
+                NULL, st);
+  if (TF_GetCode(st) != TF_OK) {
+    fprintf(stderr, "run: %s\n", TF_Message(st));
+    return 1;
+  }
+  const float *out = (const float *)TF_TensorData(out_t);
+  for (long i = 0; i < n_out; ++i) {
+    printf("OUT %.6f\n", out[i]);
+  }
+  printf("PREDICT_OK\n");
+
+  TF_DeleteTensor(in_t);
+  TF_DeleteTensor(out_t);
+  TF_CloseSession(sess, st);
+  TF_DeleteSession(sess, st);
+  TF_DeleteGraph(graph);
+  TF_DeleteStatus(st);
+  free(gd);
+  return 0;
+}
