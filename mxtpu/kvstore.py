@@ -57,6 +57,9 @@ class KVStore:
                 # control traffic.
                 self._env = env
                 self._client = kvs.KVClient(env["uri"], env["port"])
+                # liveness pings back the dead-node detector
+                # (ps-lite heartbeat role, kvstore.h:328)
+                self._client.start_heartbeat(env["worker_id"])
                 if "async" in kind:
                     self._client.send_command("sync_mode", False)
                 self._client.barrier()
@@ -215,6 +218,16 @@ class KVStore:
     def send_command_to_servers(self, head, body):
         if self._client is not None:
             self._client.send_command(head, body)
+
+    def num_dead_node(self, node_id=0, timeout=60):
+        """Workers the server marks dead — silent for > ``timeout`` sec
+        after their heartbeat started, excluding clean shutdowns. Parity:
+        include/mxnet/kvstore.h:328 get_num_dead_node (node_id kept for
+        signature parity; this transport has one worker group)."""
+        del node_id
+        if self._client is not None:
+            return self._client.num_dead_node(timeout)
+        return 0
 
     def close(self):
         """Stop the worker's server connection (sends STOP; the server

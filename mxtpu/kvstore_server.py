@@ -76,6 +76,8 @@ class KVServer:
         self.cv = threading.Condition()
         self.barrier_counts = {}
         self.init_ranks = {}     # key -> lowest rank that initialized it
+        self.heartbeats = {}     # rank -> monotonic time of last heartbeat
+        self.stopped_ranks = set()  # clean shutdowns are not "dead"
         self.stops_seen = 0
         self._stop = False
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -135,9 +137,15 @@ class KVServer:
                     _send_msg(conn, self._handle_barrier(*msg[1:]))
                 elif op == "COMMAND":
                     _send_msg(conn, self._handle_command(*msg[1:]))
+                elif op == "HEARTBEAT":
+                    _send_msg(conn, self._handle_heartbeat(*msg[1:]))
+                elif op == "NUM_DEAD":
+                    _send_msg(conn, self._handle_num_dead(*msg[1:]))
                 elif op == "STOP":
                     with self.cv:
                         self.stops_seen += 1
+                        if len(msg) > 1 and msg[1] is not None:
+                            self.stopped_ranks.add(int(msg[1]))
                         self.cv.notify_all()
                     _send_msg(conn, ("OK",))
                     return
@@ -215,6 +223,40 @@ class KVServer:
                     return ("ERR", "barrier timeout")
             return ("OK",)
 
+    def _handle_heartbeat(self, rank):
+        """ps-lite heartbeat role: workers ping periodically; any ping
+        refreshes liveness (reference: ps-lite Postoffice heartbeats
+        backing include/mxnet/kvstore.h:328 get_num_dead_node)."""
+        import time
+
+        with self.cv:
+            self.heartbeats[int(rank)] = time.monotonic()
+            self.cv.notify_all()
+        return ("OK",)
+
+    def _handle_num_dead(self, timeout_sec):
+        """Count workers that have gone silent for > timeout_sec.
+
+        Dead = a rank that (a) heartbeated at least once and then stopped
+        for longer than the timeout, or (b) never heartbeated although
+        some other worker has (it failed before joining) — excluding
+        ranks that sent a clean STOP. Mirrors get_num_dead_node
+        (include/mxnet/kvstore.h:328) with node_id = kWorkerGroup."""
+        import time
+
+        now = time.monotonic()
+        with self.cv:
+            if not self.heartbeats:
+                return ("OK", 0)
+            dead = 0
+            for r in range(self.num_workers):
+                if r in self.stopped_ranks:
+                    continue
+                last = self.heartbeats.get(r)
+                if last is None or now - last > float(timeout_sec):
+                    dead += 1
+            return ("OK", dead)
+
     def _handle_command(self, head, body):
         """Controller channel (kStopServer/kSyncMode/kSetOptimizer parity)."""
         with self.cv:
@@ -252,6 +294,8 @@ class KVClient:
         self._lock = threading.Lock()
         self._barrier_id = 0
         self._push_counts = {}
+        self._hb_stop = None
+        self._rank = None
 
     def _rpc(self, *msg):
         with self._lock:
@@ -280,9 +324,39 @@ class KVClient:
     def send_command(self, head, body):
         self._rpc("COMMAND", head, body)
 
+    def start_heartbeat(self, rank, interval=None):
+        """Ping the server every ``interval`` seconds from a daemon thread
+        (ps-lite heartbeat role; MXTPU_HEARTBEAT_INTERVAL overrides)."""
+        import time
+
+        if self._hb_stop is not None:
+            return
+        if interval is None:
+            interval = float(os.environ.get("MXTPU_HEARTBEAT_INTERVAL", 1.0))
+        self._rank = int(rank)
+        self._hb_stop = threading.Event()
+        self._rpc("HEARTBEAT", self._rank)  # register liveness immediately
+
+        def loop():
+            while not self._hb_stop.wait(interval):
+                try:
+                    self._rpc("HEARTBEAT", self._rank)
+                except (MXNetError, ConnectionError, OSError):
+                    return
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+
+    def num_dead_node(self, timeout=60):
+        """How many workers the server considers dead (silent longer than
+        ``timeout`` seconds) — parity include/mxnet/kvstore.h:328."""
+        return int(self._rpc("NUM_DEAD", float(timeout))[1])
+
     def stop(self):
+        if self._hb_stop is not None:
+            self._hb_stop.set()
         try:
-            self._rpc("STOP")
+            self._rpc("STOP", self._rank)
         except (MXNetError, ConnectionError):
             pass
         self._sock.close()

@@ -201,3 +201,77 @@ def test_dist_kvstore_collective_values():
         outs.append(out.decode())
         assert p.returncode == 0, out.decode()
     assert all("WORKER_OK" in o for o in outs)
+
+
+def test_dead_node_detection():
+    """ps-lite heartbeat parity (VERDICT r2 #9, kvstore.h:328): kill a
+    worker mid-run with SIGKILL; the surviving worker's num_dead_node
+    rises to 1 within the timeout, while clean shutdowns never count."""
+    import signal
+    import textwrap as tw
+    import time
+
+    from mxtpu.kvstore_server import KVServer
+
+    n = 2
+    server = KVServer(0, n)
+    server.run_in_thread()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               MXTPU_ROOT_URI="127.0.0.1",
+               MXTPU_ROOT_PORT=str(server.port),
+               MXTPU_NUM_WORKERS=str(n),
+               MXTPU_ROLE="worker",
+               MXTPU_HEARTBEAT_INTERVAL="0.2")
+
+    victim_src = tw.dedent("""
+        import os, sys, time
+        sys.path.insert(0, %r)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import mxtpu as mx
+        kv = mx.kv.create("dist_sync")
+        print("VICTIM_UP", flush=True)
+        time.sleep(600)  # heartbeats until killed
+    """) % REPO
+
+    watcher_src = tw.dedent("""
+        import os, sys, time
+        sys.path.insert(0, %r)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import mxtpu as mx
+        kv = mx.kv.create("dist_sync")
+        # both alive at first
+        assert kv.num_dead_node(timeout=1.5) == 0, "false positive"
+        print("BOTH_ALIVE", flush=True)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if kv.num_dead_node(timeout=1.5) == 1:
+                print("DEAD_DETECTED", flush=True)
+                kv.close()
+                sys.exit(0)
+            time.sleep(0.3)
+        print("NEVER_DETECTED", flush=True)
+        sys.exit(1)
+    """) % REPO
+
+    victim = subprocess.Popen(
+        [sys.executable, "-c", victim_src],
+        env=dict(env, MXTPU_WORKER_ID="0"),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    watcher = subprocess.Popen(
+        [sys.executable, "-c", watcher_src],
+        env=dict(env, MXTPU_WORKER_ID="1"),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    # wait for the victim to be up (its heartbeat registered), then
+    # SIGKILL it — an abrupt death, no clean STOP
+    t0 = time.time()
+    line = victim.stdout.readline().decode()
+    assert "VICTIM_UP" in line, line
+    time.sleep(1.0)  # let the watcher see the all-alive state
+    victim.send_signal(signal.SIGKILL)
+    victim.wait(timeout=30)
+
+    out, _ = watcher.communicate(timeout=60)
+    assert watcher.returncode == 0, out.decode()
+    assert "DEAD_DETECTED" in out.decode(), out.decode()
+    assert time.time() - t0 < 60
