@@ -33,12 +33,20 @@ def stack_stage_params(stage_params_list):
 
 
 def pipeline_apply(stage_fn, stacked_params, x, mesh=None,
-                   axis_name="pipe", num_microbatches=None):
+                   axis_name="pipe", num_microbatches=None,
+                   batch_axis=None):
     """Run ``x`` through n_stages pipelined stages.
 
     stacked_params: pytree with leading stage axis, sharded over
-    ``axis_name``. x: (batch, ...) replicated input. Returns (batch, ...)
-    output of the final stage (replicated).
+    ``axis_name``. x: (batch, ...) input. Returns (batch, ...) output of
+    the final stage.
+
+    ``batch_axis`` composes pipeline with data parallelism (dp x pp): on
+    a 2-D mesh like ('data', 'pipe') the batch dimension shards over
+    ``batch_axis`` while stages shard over ``axis_name`` — each data-
+    parallel row runs its own pipeline on its batch shard, and the stage
+    params replicate across rows. None (default) keeps the input
+    replicated (pure pp).
 
     Schedule: T = n_micro + n_stages - 1 ticks. At each tick every device
     runs its stage on the activation it holds, then activations rotate one
@@ -48,14 +56,18 @@ def pipeline_apply(stage_fn, stacked_params, x, mesh=None,
     if mesh is None:
         from .mesh import current_mesh
         mesh = current_mesh()
-    n_stages = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    n_stages = axis_sizes[axis_name]
     batch = x.shape[0]
-    n_micro = num_microbatches or n_stages
-    assert batch % n_micro == 0, "batch must divide into microbatches"
-    mb = batch // n_micro
+    n_micro = num_microbatches if num_microbatches is not None else n_stages
+    assert n_micro >= 1, "num_microbatches must be >= 1"
+    dp = axis_sizes[batch_axis] if batch_axis else 1
+    assert batch % (n_micro * dp) == 0, \
+        "batch must divide into microbatches on every data-parallel row"
+    mb = batch // dp // n_micro
 
     pspec = P(axis_name)       # stage axis of the stacked params
-    xspec = P()                # input/output replicated
+    xspec = P(batch_axis) if batch_axis else P()
 
     def local_fn(params, xl):
         # params: this device's stage slice (leading axis length 1)
@@ -89,14 +101,17 @@ def pipeline_apply(stage_fn, stacked_params, x, mesh=None,
                                              xl.dtype))
         acts0 = jnp.zeros((mb,) + xl.shape[1:], xl.dtype)
         outputs0 = jnp.zeros((n_micro,) + out_shape.shape, out_shape.dtype)
-        acts0 = _to_varying(acts0, axis_name)
-        outputs0 = _to_varying(outputs0, axis_name)
+        # with a composed data axis the activations vary over BOTH axes
+        # (each data row pipelines its own shard)
+        vary = (axis_name, batch_axis) if batch_axis else axis_name
+        acts0 = _to_varying(acts0, vary)
+        outputs0 = _to_varying(outputs0, vary)
         (acts, outputs), _ = lax.scan(tick, (acts0, outputs0),
                                       jnp.arange(n_ticks))
         # only the last stage holds real outputs; share them with everyone
         outputs = lax.psum(
             jnp.where(sidx == n_stages - 1, outputs, 0.0), axis_name)
-        return outputs.reshape(batch, *out_shape.shape[1:])
+        return outputs.reshape(xl.shape[0], *out_shape.shape[1:])
 
     return shard_map(local_fn, mesh=mesh,
                      in_specs=(jax.tree.map(lambda _: pspec, stacked_params),

@@ -472,3 +472,59 @@ def test_dp_weight_update_sharding_matches_replicated():
     for n in results[False]:
         np.testing.assert_allclose(results[True][n], results[False][n],
                                    rtol=2e-4, atol=2e-5, err_msg=n)
+
+
+def test_composed_dp_pp_matches_sequential_and_trains():
+    """VERDICT r2 #10: the parallelism axes must COMPOSE. dp x pp on a
+    ('data','pipe') 2-D mesh: batch shards over 'data', stage params over
+    'pipe'. Checks (a) numerical equality with the sequential stage chain
+    and (b) loss moves when training THROUGH the composed program."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from mxtpu.parallel import make_mesh
+    from mxtpu.parallel.pipeline import pipeline_apply, stack_stage_params
+
+    n_pipe, d = 4, 8
+    mesh = make_mesh(shape=(2, n_pipe), axis_names=("data", "pipe"))
+    rng = np.random.RandomState(1)
+    stage_params = [{"w": jnp.asarray(rng.randn(d, d).astype("float32")
+                                      * 0.4),
+                     "b": jnp.zeros((d,), jnp.float32)}
+                    for _ in range(n_pipe)]
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    batch = 16  # 8 per data row, 2 microbatches of 4
+    x = jnp.asarray(rng.randn(batch, d).astype("float32"))
+    stacked = stack_stage_params(stage_params)
+
+    # (a) equality with the sequential chain
+    out = pipeline_apply(stage_fn, stacked, x, mesh=mesh,
+                         num_microbatches=2, batch_axis="data")
+    ref = x
+    for p in stage_params:
+        ref = stage_fn(p, ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+    # (b) train through the composed program: loss must fall
+    y = jnp.asarray(rng.randn(batch, d).astype("float32"))
+
+    def loss_fn(params):
+        o = pipeline_apply(stage_fn, params, x, mesh=mesh,
+                           num_microbatches=2, batch_axis="data")
+        return jnp.mean((o - y) ** 2)
+
+    @jax.jit
+    def step(params):
+        l, g = jax.value_and_grad(loss_fn)(params)
+        return l, jax.tree.map(lambda p, gr: p - 0.3 * gr, params, g)
+
+    params = stacked
+    losses = []
+    for _ in range(6):
+        l, params = step(params)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.9, losses
