@@ -205,17 +205,17 @@ def list_all_op_names():
     return sorted(set(list_ops()))
 
 
-def imperative_invoke(op_name, in_handles, keys, vals):
-    """Generic op call (reference MXImperativeInvoke, c_api.h): inputs are
-    NDArray handles, keys/vals are string attrs parsed by the op's spec;
-    returns a list of new output handles."""
+def _parse_op_attrs(op, keys, vals):
+    """String attrs -> kwargs for a REGISTERED op, the dmlc::Parameter
+    behavior: typed attrs stay strings (op.parse_attrs converts them
+    downstream); only untyped attrs (proto None, e.g. axis defaulting to
+    None) get a best-effort literal parse. Shared by every ABI entry that
+    names an op (imperative invoke, atomic-symbol creation), so the two
+    paths can never parse the same key/val arrays differently."""
     import ast
 
-    from . import ndarray as nd
-    from .ops.registry import Required, get_op
+    from .ops.registry import Required
 
-    op = get_op(str(op_name))
-    arrays = [_get(h) for h in in_handles]
     kwargs = {}
     spec = op.attrs_spec
     for k, v in zip(keys, vals):
@@ -223,15 +223,25 @@ def imperative_invoke(op_name, in_handles, keys, vals):
         default = spec.get(k)
         proto = default.proto if isinstance(default, Required) else default
         if k in spec and proto is None:
-            # untyped attr (e.g. axis defaulting to None): best-effort
-            # literal parse, the dmlc::Parameter behavior. Typed attrs
-            # stay strings — op.parse_attrs converts them downstream.
             try:
                 kwargs[k] = ast.literal_eval(v)
             except (ValueError, SyntaxError):
                 kwargs[k] = v
         else:
             kwargs[k] = v
+    return kwargs
+
+
+def imperative_invoke(op_name, in_handles, keys, vals):
+    """Generic op call (reference MXImperativeInvoke, c_api.h): inputs are
+    NDArray handles, keys/vals are string attrs parsed by the op's spec;
+    returns a list of new output handles."""
+    from . import ndarray as nd
+    from .ops.registry import get_op
+
+    op = get_op(str(op_name))
+    arrays = [_get(h) for h in in_handles]
+    kwargs = _parse_op_attrs(op, keys, vals)
     fn = getattr(nd, op.name)
     outs = fn(*arrays, **kwargs)
     if not isinstance(outs, (list, tuple)):
@@ -289,17 +299,9 @@ def list_data_iters():
 def data_iter_create(name, keys, vals):
     """Create a registered iterator from string kwargs (the reference's
     dmlc::Parameter string parsing, c_api.cc MXDataIterCreateIter)."""
-    import ast
-
     from . import io as _io
 
-    kwargs = {}
-    for k, v in zip(keys, vals):
-        k, v = str(k), str(v)
-        try:
-            kwargs[k] = ast.literal_eval(v)
-        except (ValueError, SyntaxError):
-            kwargs[k] = v
+    kwargs = _parse_string_attrs(keys, vals)
     if str(name) == "NDArrayIter":
         data = kwargs.pop("data", None)
         label = kwargs.pop("label", None)
@@ -428,3 +430,80 @@ def recordio_read(h):
 def recordio_close(h):
     _get(h).close()
     return free(h)
+
+
+# ------------------------------------------------------------- Symbol build
+# Reference group: MXSymbolCreateVariable / MXSymbolCreateAtomicSymbol /
+# MXSymbolCompose / MXSymbolInferShape (src/c_api/c_api_symbolic.cc) — a C
+# client composes models natively instead of shipping JSON from Python.
+
+class _AtomicSymbol:
+    """An op + parsed attrs awaiting MXSymbolCompose (the reference's
+    atomic-symbol handle state)."""
+
+    __slots__ = ("op_name", "kwargs")
+
+    def __init__(self, op_name, kwargs):
+        self.op_name = op_name
+        self.kwargs = kwargs
+
+
+def _parse_string_attrs(keys, vals):
+    import ast
+
+    kwargs = {}
+    for k, v in zip(keys, vals):
+        k, v = str(k), str(v)
+        try:
+            kwargs[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            kwargs[k] = v
+    return kwargs
+
+
+def symbol_create_variable(name):
+    from .symbol import Variable
+    return _register(Variable(str(name)))
+
+
+def symbol_create_atomic(op_name, keys, vals):
+    from .ops.registry import get_op
+    op = get_op(str(op_name))  # unknown-op errors surface at creation time
+    return _register(_AtomicSymbol(str(op_name),
+                                   _parse_op_attrs(op, keys, vals)))
+
+
+def symbol_compose(h, name, arg_handles):
+    """Bind inputs to an atomic symbol IN PLACE (the reference mutates the
+    handle: c_api_symbolic.cc MXSymbolCompose)."""
+    from .symbol import create as sym_create
+
+    st = _get(h)
+    if not isinstance(st, _AtomicSymbol):
+        raise RuntimeError("SymbolCompose: handle is already composed")
+    inputs = [_get(a) for a in arg_handles]
+    composed = sym_create(st.op_name, inputs, st.kwargs,
+                          name=str(name) if name else None)
+    with _lock:
+        _handles[int(h)] = composed
+    return 0
+
+
+def symbol_infer_shape_out(h, names, shapes):
+    """Output shapes given named input shapes (the out third of the
+    reference's MXSymbolInferShape triple)."""
+    sym = _get(h)
+    kw = {str(n): tuple(int(d) for d in s) for n, s in zip(names, shapes)}
+    _arg, out, _aux = sym.infer_shape(**kw)
+    return [tuple(int(d) for d in s) for s in out]
+
+
+def random_seed(seed):
+    from . import random as _random
+    _random.seed(int(seed))
+    return 0
+
+
+def version():
+    from .libinfo import __version__
+    return str(__version__)

@@ -66,6 +66,27 @@ int MXImperativeInvoke(const char *op_name, mx_uint num_inputs,
                        const char **param_keys, const char **param_vals);
 
 /* ---------------- Symbol ---------------- */
+/* Native model composition (reference MXSymbolCreateVariable /
+ * MXSymbolCreateAtomicSymbol / MXSymbolCompose / MXSymbolInferShape,
+ * src/c_api/c_api_symbolic.cc): a C client builds models without
+ * Python-authored JSON. CreateAtomicSymbol holds the op + string attrs;
+ * Compose binds inputs IN PLACE on the same handle. InferShapeOut
+ * returns the output shapes (per-thread arena). */
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+int MXSymbolCreateAtomicSymbol(const char *op_name, mx_uint num_params,
+                               const char **keys, const char **vals,
+                               SymbolHandle *out);
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    SymbolHandle *args);
+int MXSymbolInferShapeOut(SymbolHandle sym, mx_uint num_inputs,
+                          const char **input_names,
+                          const mx_uint *shape_indptr,
+                          const mx_uint *shape_data, mx_uint *out_size,
+                          const mx_uint **out_ndims,
+                          const mx_uint ***out_shapes);
+int MXGetVersion(const char **out);
+int MXRandomSeed(int seed);
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype);
 int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
 int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json);
 int MXSymbolFree(SymbolHandle sym);

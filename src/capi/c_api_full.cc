@@ -102,6 +102,10 @@ thread_local std::vector<const char *> g_ptr_arena;
 thread_local std::vector<mx_uint> g_shape_arena;
 thread_local std::string g_json_arena;
 thread_local std::vector<void *> g_handle_arena;
+thread_local std::vector<mx_uint> g_ndims_arena;
+thread_local std::vector<std::vector<mx_uint>> g_shapes_arena;
+thread_local std::vector<const mx_uint *> g_shape_ptr_arena;
+thread_local std::string g_version_arena;
 
 int StringListOut(PyObject *list, mx_uint *out_size,
                   const char ***out_array) {
@@ -248,6 +252,142 @@ int MXNDArrayLoad(const char *fname, mx_uint *out_size,
   Py_DECREF(res);
   *out_size = static_cast<mx_uint>(n);
   *out_arr = reinterpret_cast<NDArrayHandle *>(g_handle_arena.data());
+  return 0;
+}
+
+/* ---------------- Symbol composition ---------------- */
+
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge("symbol_create_variable",
+                             Py_BuildValue("(s)", name));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolCreateAtomicSymbol(const char *op_name, mx_uint num_params,
+                               const char **keys, const char **vals,
+                               SymbolHandle *out) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *ks = PyList_New(num_params);
+  PyObject *vs = PyList_New(num_params);
+  for (mx_uint i = 0; i < num_params; ++i) {
+    PyList_SetItem(ks, i, PyUnicode_FromString(keys[i]));
+    PyList_SetItem(vs, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject *res = CallBridge("symbol_create_atomic",
+                             Py_BuildValue("(sNN)", op_name, ks, vs));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    SymbolHandle *args) {
+  GilGuard gil;
+  PyObject *arr = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyList_SetItem(arr, i, PyLong_FromLong(HandleToId(args[i])));
+  }
+  PyObject *res = CallBridge(
+      "symbol_compose",
+      Py_BuildValue("(lsN)", HandleToId(sym), name ? name : "", arr));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolInferShapeOut(SymbolHandle sym, mx_uint num_inputs,
+                          const char **input_names,
+                          const mx_uint *shape_indptr,
+                          const mx_uint *shape_data, mx_uint *out_size,
+                          const mx_uint **out_ndims,
+                          const mx_uint ***out_shapes) {
+  GilGuard gil;
+  PyObject *names = PyList_New(num_inputs);
+  PyObject *shapes = PyList_New(num_inputs);
+  for (mx_uint i = 0; i < num_inputs; ++i) {
+    PyList_SetItem(names, i, PyUnicode_FromString(input_names[i]));
+    mx_uint lo = shape_indptr[i], hi = shape_indptr[i + 1];
+    PyObject *shp = PyList_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j) {
+      PyList_SetItem(shp, j - lo, PyLong_FromUnsignedLong(shape_data[j]));
+    }
+    PyList_SetItem(shapes, i, shp);
+  }
+  PyObject *res = CallBridge(
+      "symbol_infer_shape_out",
+      Py_BuildValue("(lNN)", HandleToId(sym), names, shapes));
+  if (res == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(res);
+  g_ndims_arena.clear();
+  g_shapes_arena.clear();
+  g_shape_ptr_arena.clear();
+  g_shapes_arena.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *shp = PyList_GetItem(res, i);
+    Py_ssize_t nd = PyTuple_Size(shp);
+    g_ndims_arena.push_back(static_cast<mx_uint>(nd));
+    for (Py_ssize_t j = 0; j < nd; ++j) {
+      g_shapes_arena[i].push_back(static_cast<mx_uint>(
+          PyLong_AsUnsignedLong(PyTuple_GetItem(shp, j))));
+    }
+  }
+  for (auto &v : g_shapes_arena) g_shape_ptr_arena.push_back(v.data());
+  Py_DECREF(res);
+  *out_size = static_cast<mx_uint>(n);
+  *out_ndims = g_ndims_arena.data();
+  *out_shapes = g_shape_ptr_arena.data();
+  return 0;
+}
+
+int MXGetVersion(const char **out) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge("version", PyTuple_New(0));
+  if (res == nullptr) return -1;
+  g_version_arena = PyUnicode_AsUTF8(res);
+  Py_DECREF(res);
+  *out = g_version_arena.c_str();
+  return 0;
+}
+
+int MXRandomSeed(int seed) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge("random_seed", Py_BuildValue("(i)", seed));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype) {
+  GilGuard gil;
+  PyObject *res = CallBridge("ndarray_dtype",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  const char *name = PyUnicode_AsUTF8(res);
+  /* reverse of MXNDArrayCreate's kDtype table (mshadow enum order) */
+  static const char *kDtype[] = {"float32", "float64", "float16", "uint8",
+                                 "int32", "int8", "int64", "bfloat16"};
+  int code = -1;
+  for (int i = 0; i < 8; ++i) {
+    if (std::strcmp(name, kDtype[i]) == 0) {
+      code = i;
+      break;
+    }
+  }
+  Py_DECREF(res);
+  if (code < 0) {
+    g_last_error = std::string("MXNDArrayGetDType: unknown dtype ") + name;
+    return -1;
+  }
+  *out_dtype = code;
   return 0;
 }
 

@@ -307,3 +307,99 @@ int main(int argc, char **argv) {
     want = [b"record-%d-payload" % i for i in range(3)] + [b""] + \
         [b"record-%d-payload" % i for i in range(3, 5)]
     assert got == want
+
+
+def test_c_symbol_composition(tmp_path):
+    """Native model composition through the ABI (reference
+    MXSymbolCreateAtomicSymbol/Compose/InferShape): a C client builds the
+    MLP itself — no Python-authored JSON — infers output shapes, trains
+    via the executor, and the saved JSON round-trips in Python."""
+    ok, log = _build()
+    if not ok:
+        pytest.skip("libmxtpu_capi.so did not build: %s" % log[-400:])
+    src = r"""
+#include <stdio.h>
+#include <string.h>
+#include "c_api.h"
+#define CHECK(x) if ((x) != 0) { \
+    fprintf(stderr, "FAIL %s: %s\n", #x, MXGetLastError()); return 1; }
+int main(int argc, char **argv) {
+  const char *ver = NULL;
+  CHECK(MXGetVersion(&ver));
+  CHECK(MXRandomSeed(7));
+
+  SymbolHandle data, fc1, act, fc2, sm;
+  CHECK(MXSymbolCreateVariable("data", &data));
+
+  const char *k1[] = {"num_hidden"}; const char *v1[] = {"16"};
+  CHECK(MXSymbolCreateAtomicSymbol("FullyConnected", 1, k1, v1, &fc1));
+  CHECK(MXSymbolCompose(fc1, "fc1", 1, (SymbolHandle[]){data}));
+
+  const char *k2[] = {"act_type"}; const char *v2[] = {"relu"};
+  CHECK(MXSymbolCreateAtomicSymbol("Activation", 1, k2, v2, &act));
+  CHECK(MXSymbolCompose(act, "relu1", 1, (SymbolHandle[]){fc1}));
+
+  const char *k3[] = {"num_hidden"}; const char *v3[] = {"4"};
+  CHECK(MXSymbolCreateAtomicSymbol("FullyConnected", 1, k3, v3, &fc2));
+  CHECK(MXSymbolCompose(fc2, "fc2", 1, (SymbolHandle[]){act}));
+
+  CHECK(MXSymbolCreateAtomicSymbol("SoftmaxOutput", 0, NULL, NULL, &sm));
+  CHECK(MXSymbolCompose(sm, "softmax", 1, (SymbolHandle[]){fc2}));
+
+  /* shape inference through the ABI */
+  const char *in_names[] = {"data"};
+  mx_uint indptr[] = {0, 2};
+  mx_uint shp[] = {8, 6};
+  mx_uint n_out; const mx_uint *ndims; const mx_uint **oshapes;
+  CHECK(MXSymbolInferShapeOut(sm, 1, in_names, indptr, shp,
+                              &n_out, &ndims, &oshapes));
+  if (n_out != 1 || ndims[0] != 2 || oshapes[0][0] != 8 ||
+      oshapes[0][1] != 4) {
+    fprintf(stderr, "infer shape wrong: %u [%u,%u]\n", n_out,
+            oshapes[0][0], oshapes[0][1]);
+    return 1;
+  }
+
+  /* the composed net is bindable and trainable */
+  const char *bind_names[] = {"data", "softmax_label"};
+  mx_uint bindptr[] = {0, 2, 3};
+  mx_uint bshp[] = {8, 6, 8};
+  ExecutorHandle exec;
+  CHECK(MXExecutorSimpleBind(sm, 1, 0, "write", 2, bind_names, bindptr,
+                             bshp, &exec));
+  CHECK(MXExecutorForward(exec, 1));
+  CHECK(MXExecutorBackward(exec));
+
+  /* JSON round-trip for the python cross-check */
+  const char *json = NULL;
+  CHECK(MXSymbolSaveToJSON(sm, &json));
+  FILE *f = fopen(argv[1], "w");
+  if (f == NULL) { fprintf(stderr, "FAIL fopen(%s)\n", argv[1]); return 1; }
+  fputs(json, f);
+  fclose(f);
+  printf("COMPOSE_OK %s\n", ver);
+  return 0;
+}
+"""
+    (tmp_path / "compose.c").write_text(src)
+    exe = str(tmp_path / "compose")
+    inc = os.path.join(REPO, "src", "capi")
+    json_path = str(tmp_path / "composed.json")
+    r = subprocess.run(
+        ["gcc", "-std=c99", "-I", inc, str(tmp_path / "compose.c"),
+         "-o", exe, "-L", os.path.dirname(CAPI_SO), "-lmxtpu_capi",
+         "-Wl,-rpath," + os.path.dirname(CAPI_SO)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    out = subprocess.run([exe, json_path], capture_output=True, text=True,
+                         env=env, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "COMPOSE_OK" in out.stdout
+
+    import mxtpu as mx
+    loaded = mx.sym.load(json_path)
+    assert loaded.list_outputs() == ["softmax_output"]
+    assert "fc1_weight" in loaded.list_arguments()
+    shapes, _, _ = loaded.infer_shape(data=(8, 6))
+    assert dict(zip(loaded.list_arguments(), shapes))["fc2_weight"] == (4, 16)
