@@ -22,6 +22,25 @@ addParameter(p, 'InputName', 'data');
 parse(p, varargin{:});
 input_name = p.Results.InputName;
 
+% Preferred path (works in BOTH MATLAB and GNU Octave): the compiled MEX
+% gateway (matlab/mxtpu_predict_mex.c, built with `mex` or
+% `mkoctfile --mex`). Octave has no loadlibrary, so the MEX is the only
+% route there; in MATLAB it simply skips the header parse.
+if exist('mxtpu_predict_mex', 'file') == 3
+    symbol_json = fileread(symbol_file);
+    fid = fopen(param_file, 'rb');
+    param_bytes = fread(fid, inf, '*uint8');
+    fclose(fid);
+    shape = uint32(fliplr(size(data)));
+    flat = single(permute(data, ndims(data):-1:1));
+    [flat_out, oshape] = mxtpu_predict_mex(symbol_json, param_bytes, ...
+                                           input_name, flat(:), shape);
+    oshape = double(oshape);
+    out = reshape(flat_out, fliplr(oshape));
+    out = permute(out, numel(oshape):-1:1);
+    return
+end
+
 native = getenv('MXTPU_NATIVE');
 if isempty(native)
     error('set MXTPU_NATIVE to the mxtpu/native directory');

@@ -1,0 +1,83 @@
+/* MEX gateway over the C predict ABI — makes the matlab/ wrapper
+ * EXECUTABLE under GNU Octave (mkoctfile --mex) as well as MATLAB,
+ * replacing the loadlibrary path that Octave lacks. Role parity: the
+ * reference's matlab predict-only wrapper (matlab/+mxnet/model.m over
+ * c_predict_api.h:77-152).
+ *
+ * [out, oshape] = mxtpu_predict_mex(symbol_json, param_bytes, ...
+ *                                   input_name, data_flat, shape)
+ *   symbol_json : char row vector (model JSON)
+ *   param_bytes : uint8 vector (.params file bytes)
+ *   input_name  : char row vector (e.g. 'data')
+ *   data_flat   : single vector, C-row-major flattened input
+ *   shape       : uint32 row vector, C-order input shape
+ * Returns the flat single output of head 0 and its C-order shape.
+ *
+ * Build: mkoctfile --mex -I../src/capi mxtpu_predict_mex.c \
+ *          -L../mxtpu/native -lmxtpu_predict \
+ *          -Wl,-rpath=../mxtpu/native
+ */
+#include <stdint.h>
+#include <string.h>
+
+#include "mex.h"
+
+#include "c_predict_api.h"
+
+static void die(PredictorHandle h, const char *where) {
+  if (h != NULL) MXPredFree(h);
+  mexErrMsgIdAndTxt("mxtpu:predict", "%s: %s", where, MXGetLastError());
+}
+
+void mexFunction(int nlhs, mxArray *plhs[], int nrhs,
+                 const mxArray *prhs[]) {
+  if (nrhs != 5) {
+    mexErrMsgIdAndTxt("mxtpu:usage",
+                      "usage: mxtpu_predict_mex(json, params, name, "
+                      "data, shape)");
+  }
+  char *json = mxArrayToString(prhs[0]);
+  char *name = mxArrayToString(prhs[2]);
+  const uint8_t *params = (const uint8_t *)mxGetData(prhs[1]);
+  size_t n_params = mxGetNumberOfElements(prhs[1]);
+  const float *data = (const float *)mxGetData(prhs[3]);
+  size_t n_data = mxGetNumberOfElements(prhs[3]);
+  const uint32_t *shape = (const uint32_t *)mxGetData(prhs[4]);
+  mx_uint ndim = (mx_uint)mxGetNumberOfElements(prhs[4]);
+
+  mx_uint indptr[2] = {0, ndim};
+  const char *input_keys[1];
+  input_keys[0] = name;
+
+  PredictorHandle h = NULL;
+  if (MXPredCreate(json, params, (int)n_params, 1, 0, 1, input_keys,
+                   indptr, shape, &h) != 0) {
+    die(NULL, "MXPredCreate");
+  }
+  if (MXPredSetInput(h, name, data, (mx_uint)n_data) != 0) {
+    die(h, "MXPredSetInput");
+  }
+  if (MXPredForward(h) != 0) die(h, "MXPredForward");
+
+  mx_uint *oshape = NULL;
+  mx_uint odim = 0;
+  if (MXPredGetOutputShape(h, 0, &oshape, &odim) != 0) {
+    die(h, "MXPredGetOutputShape");
+  }
+  size_t total = 1;
+  for (mx_uint i = 0; i < odim; ++i) total *= oshape[i];
+
+  plhs[0] = mxCreateNumericMatrix((mwSize)total, 1, mxSINGLE_CLASS,
+                                  mxREAL);
+  if (MXPredGetOutput(h, 0, (float *)mxGetData(plhs[0]),
+                      (mx_uint)total) != 0) {
+    die(h, "MXPredGetOutput");
+  }
+  if (nlhs > 1) {
+    plhs[1] = mxCreateNumericMatrix(1, odim, mxUINT32_CLASS, mxREAL);
+    memcpy(mxGetData(plhs[1]), oshape, odim * sizeof(uint32_t));
+  }
+  MXPredFree(h);
+  mxFree(json);
+  mxFree(name);
+}
