@@ -213,3 +213,34 @@ def hypot(lhs, rhs):
         return _hypot_scalar(rhs, scalar=float(lhs))
     import math
     return math.hypot(lhs, rhs)
+
+
+# ----------------------------------------------------- reference name aliases
+# The reference registers these as CPU-only NDArray ops (image decode ops in
+# src/io/image_io.cc; sparse_retain in src/operator/tensor/). Here the
+# implementations live in mx.image / the sparse module (host-side OpenCV and
+# CPU gather are not jax-traceable, so they stay out of the traceable op
+# registry); the reference-parity names delegate.
+_sparse_retain = sparse.sparse_retain
+
+
+def _cvimread(filename, flag=1, to_rgb=True, **kw):
+    from ..image import image as _img
+    return _img.imread(filename, flag=flag, to_rgb=to_rgb)
+
+
+def _cvimdecode(buf, flag=1, to_rgb=True, **kw):
+    from ..image import image as _img
+    return _img.imdecode(buf, flag=flag, to_rgb=to_rgb)
+
+
+def _cvimresize(src, w, h, interp=1, **kw):
+    from ..image import image as _img
+    return _img.imresize(src, w, h, interp=interp)
+
+
+def _cvcopyMakeBorder(src, top, bot, left, right, border_type=0,
+                      value=0.0, **kw):
+    from ..image import image as _img
+    return _img.copyMakeBorder(src, top, bot, left, right,
+                               border_type=border_type, value=value)
