@@ -10,6 +10,9 @@ from . import inception_bn
 from . import transformer
 from . import googlenet
 from . import inception_v3
+from . import resnext
+from . import mobilenet
+from . import resnet_v1
 from .mlp import get_symbol as get_mlp
 from .transformer import get_symbol as get_transformer_lm
 from .googlenet import get_symbol as get_googlenet

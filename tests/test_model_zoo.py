@@ -110,3 +110,42 @@ def test_inception_v3_symbol_shapes():
     # module-C trunk: 320 + (384+384) + (384+384) + 192 = 2048 channels
     d = dict(zip(net.list_arguments(), args))
     assert d["fc1_weight"] == (7, 2048)
+
+
+def test_symbol_factories_round3():
+    """resnext / mobilenet / resnet_v1 symbol factories (parity:
+    example/image-classification/symbols/{resnext,mobilenet,resnet-v1}.py
+    — the BASELINE.md resnext quality rows' architectures): shapes infer,
+    a train step runs, grouped/depthwise convs lower through XLA."""
+    import numpy as np
+    import mxtpu as mx
+    from mxtpu.models import mobilenet, resnet_v1, resnext
+
+    cases = [
+        (resnext.get_symbol(num_classes=10, num_layers=26,
+                            image_shape=(3, 32, 32), num_group=8), 1370),
+        (mobilenet.get_symbol(num_classes=10, multiplier=0.25), None),
+        (resnet_v1.get_symbol(num_classes=10, num_layers=18,
+                              image_shape=(3, 32, 32)), None),
+    ]
+    for net, _ in cases:
+        shape = (2, 3, 224, 224) if "sep1" in str(net.list_arguments()) \
+            else (2, 3, 32, 32)
+        shapes, out_shapes, _ = net.infer_shape(data=shape)
+        assert out_shapes[0] == (2, 10), out_shapes
+        mod = mx.mod.Module(net, context=mx.cpu(0))
+        mod.bind(data_shapes=[("data", shape)],
+                 label_shapes=[("softmax_label", (shape[0],))])
+        mod.init_params(mx.initializer.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.01})
+        rng = np.random.RandomState(0)
+        batch = mx.io.DataBatch(
+            data=[mx.nd.array(rng.rand(*shape).astype("float32"))],
+            label=[mx.nd.array(rng.randint(0, 10, (shape[0],))
+                               .astype("float32"))])
+        mod.forward_backward(batch)
+        mod.update()
+        out = mod.get_outputs()[0].asnumpy()
+        assert out.shape == (shape[0], 10)
+        assert np.all(np.isfinite(out))
