@@ -149,3 +149,26 @@ def test_symbol_factories_round3():
         out = mod.get_outputs()[0].asnumpy()
         assert out.shape == (shape[0], 10)
         assert np.all(np.isfinite(out))
+
+
+def test_inception_v4_symbol():
+    """inception-v4 factory (parity symbols/inception-v4.py): paper block
+    layout, shapes infer at 299x299, forward runs."""
+    import numpy as np
+    import mxtpu as mx
+    from mxtpu.models import inception_v4
+
+    net = inception_v4.get_symbol(num_classes=10)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 299, 299))
+    assert out_shapes[0] == (1, 10)
+    ex = net.simple_bind(mx.cpu(), data=(1, 3, 299, 299), grad_req="null")
+    rng = np.random.RandomState(0)
+    for n in ex.arg_dict:
+        if n != "data" and n != "softmax_label":
+            ex.arg_dict[n][:] = mx.nd.array(
+                rng.randn(*ex.arg_dict[n].shape).astype("float32") * 0.05)
+    ex.arg_dict["data"][:] = mx.nd.array(
+        rng.rand(1, 3, 299, 299).astype("float32"))
+    out = ex.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (1, 10) and np.all(np.isfinite(out))
+    assert abs(out.sum() - 1.0) < 1e-3  # softmax head
