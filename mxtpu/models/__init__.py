@@ -14,6 +14,7 @@ from . import resnext
 from . import mobilenet
 from . import resnet_v1
 from . import inception_v4
+from . import inception_resnet_v2
 from .mlp import get_symbol as get_mlp
 from .transformer import get_symbol as get_transformer_lm
 from .googlenet import get_symbol as get_googlenet

@@ -172,3 +172,25 @@ def test_inception_v4_symbol():
     out = ex.forward(is_train=False)[0].asnumpy()
     assert out.shape == (1, 10) and np.all(np.isfinite(out))
     assert abs(out.sum() - 1.0) < 1e-3  # softmax head
+
+
+def test_inception_resnet_v2_symbol():
+    """inception-resnet-v2 factory (parity symbols/inception-resnet-v2.py):
+    residual-scaled blocks, shapes infer at 299x299, forward finite."""
+    import numpy as np
+    import mxtpu as mx
+    from mxtpu.models import inception_resnet_v2 as irv2
+
+    net = irv2.get_symbol(num_classes=10)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 299, 299))
+    assert out_shapes[0] == (1, 10)
+    ex = net.simple_bind(mx.cpu(), data=(1, 3, 299, 299), grad_req="null")
+    rng = np.random.RandomState(0)
+    for n in ex.arg_dict:
+        if n not in ("data", "softmax_label"):
+            ex.arg_dict[n][:] = mx.nd.array(
+                rng.randn(*ex.arg_dict[n].shape).astype("float32") * 0.05)
+    ex.arg_dict["data"][:] = mx.nd.array(
+        rng.rand(1, 3, 299, 299).astype("float32"))
+    out = ex.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (1, 10) and np.all(np.isfinite(out))
