@@ -49,6 +49,15 @@ def main(argv=None):
         net = mx.models.get_vgg(num_classes=args.num_classes)
     elif args.network == "inception-bn":
         net = mx.models.get_inception_bn(num_classes=args.num_classes)
+    elif args.network in ("resnet-v1", "resnext", "mobilenet", "googlenet",
+                          "inception-v3", "inception-v4",
+                          "inception-resnet-v2"):
+        mod_name = args.network.replace("-", "_")
+        factory = getattr(mx.models, mod_name).get_symbol
+        kw = {"num_classes": args.num_classes}
+        if args.network in ("resnet-v1", "resnext"):
+            kw.update(num_layers=args.num_layers, image_shape=shape)
+        net = factory(**kw)
     else:
         raise SystemExit("unknown network %s" % args.network)
 

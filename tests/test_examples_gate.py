@@ -149,3 +149,24 @@ def test_gluon_dcgan_gate():
     assert min_acc < 0.9, \
         "generator never fooled the discriminator: first=%s min=%s" \
         % (acc0, min_acc)
+
+
+def test_train_imagenet_network_flag_variants(tmp_path):
+    """The --network dispatch covers the full symbols/ family: run one
+    tiny epoch with resnext (grouped conv) and mobilenet (depthwise) on
+    packed recordio data — the config-2 flow exercised for the round-3
+    factories."""
+    sys.path.insert(0, os.path.join(_ROOT, "tools"))
+    _example("image_classification", "train_imagenet.py")
+    import bench_input
+    import train_imagenet
+
+    rec = bench_input.make_rec(str(tmp_path / "synth.rec"), 32, edge=40)
+    for network in ("resnext", "resnet-v1"):
+        speed = train_imagenet.main([
+            "--data-train", rec, "--network", network, "--num-layers", "26"
+            if network == "resnext" else "18",
+            "--image-shape", "3,32,32", "--num-classes", "10",
+            "--batch-size", "16", "--num-epochs", "1", "--kv-store",
+            "local", "--speedometer-period", "1"])
+        assert speed > 0, network
