@@ -24,6 +24,10 @@ COMBOS = [
     ("lhs", "--xla_tpu_enable_latency_hiding_scheduler=true"),
     ("vmem64+no_rwb",
      "--xla_tpu_scoped_vmem_limit_kib=65536 --xla_tpu_rwb_fusion=false"),
+    ("vmem128", "--xla_tpu_scoped_vmem_limit_kib=131072"),
+    ("lhs+vmem64",
+     "--xla_tpu_enable_latency_hiding_scheduler=true"
+     " --xla_tpu_scoped_vmem_limit_kib=65536"),
 ]
 
 
