@@ -257,3 +257,25 @@ def test_native_cpp_unit_tier():
     out = subprocess.run([exe], capture_output=True, text=True, timeout=300)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "NATIVE_UNIT_OK" in out.stdout
+
+
+def test_native_cpp_unit_tier_tsan():
+    """The same native tier under ThreadSanitizer — the engine's MR/SW
+    dependency protocol proven race-free by a sanitizer, not just by
+    construction (beyond the reference, which has no TSAN integration).
+    Skips where the toolchain lacks -fsanitize=thread."""
+    import os
+    import subprocess
+
+    import pytest
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    exe = os.path.join(repo, "mxtpu", "native", "native_unit_test_tsan")
+    subprocess.run(["make", "-C", os.path.join(repo, "src"), "tsan"],
+                   capture_output=True, text=True)
+    if not os.path.exists(exe):
+        pytest.skip("toolchain lacks -fsanitize=thread")
+    out = subprocess.run([exe], capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "NATIVE_UNIT_OK" in out.stdout
+    assert "WARNING: ThreadSanitizer" not in out.stderr, out.stderr
