@@ -170,3 +170,14 @@ def test_train_imagenet_network_flag_variants(tmp_path):
             "--batch-size", "16", "--num-epochs", "1", "--kv-store",
             "local", "--speedometer-period", "1"])
         assert speed > 0, network
+
+
+def test_model_parallel_lstm_gate():
+    """group2ctx model parallelism end to end (parity:
+    example/model-parallel-lstm/lstm.py): a 2-layer LSTM LM with layer
+    groups placed on two devices trains and perplexity falls."""
+    _example("rnn", "model_parallel_lstm.py")
+    import model_parallel_lstm
+    ppl = model_parallel_lstm.main(["--epochs", "3", "--n-tokens", "3000"])
+    assert len(ppl) == 3
+    assert ppl[-1] < ppl[0] * 0.97, "perplexity did not fall: %s" % (ppl,)
