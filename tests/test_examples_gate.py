@@ -181,3 +181,15 @@ def test_model_parallel_lstm_gate():
     ppl = model_parallel_lstm.main(["--epochs", "3", "--n-tokens", "3000"])
     assert len(ppl) == 3
     assert ppl[-1] < ppl[0] * 0.97, "perplexity did not fall: %s" % (ppl,)
+
+
+def test_sparse_linear_classification_gate():
+    """Sparse pipeline end to end (parity: example/sparse/
+    linear_classification.py): LibSVM csr batches + row_sparse weight via
+    kvstore row_sparse_pull + server-side SGD; accuracy must climb well
+    above chance."""
+    _example("sparse", "linear_classification.py")
+    import linear_classification
+    accs = linear_classification.main(["--epochs", "5",
+                                       "--num-examples", "512"])
+    assert accs[-1] > 0.8, "sparse training reached only %s" % (accs,)
