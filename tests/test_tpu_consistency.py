@@ -123,6 +123,21 @@ def test_resnet_block_forward_consistency():
                       rtol=2e-3, atol=2e-3)
 
 
+def test_grouped_and_depthwise_conv_consistency():
+    """Grouped (resnext cardinality) and depthwise (mobilenet) convs:
+    feature_group_count lowering must agree between CPU and the chip."""
+    accel = _require_accel()
+    data = sym.Variable("data")
+    g = sym.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=8,
+                        num_group=4, no_bias=True, name="grouped")
+    check_consistency(g, _ctx_list(accel, data=(2, 8, 6, 6)),
+                      rtol=2e-3, atol=2e-3)
+    dw = sym.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=8,
+                         num_group=8, no_bias=True, name="depthwise")
+    check_consistency(dw, _ctx_list(accel, data=(2, 8, 6, 6)),
+                      rtol=2e-3, atol=2e-3)
+
+
 def test_pallas_flash_kernel_on_chip():
     """The compiled (non-interpret) Pallas flash kernel must match the
     reference attention math on the real chip — values and gradients.
