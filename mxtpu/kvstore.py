@@ -170,18 +170,24 @@ class KVStore:
         keys, outs = self._normalize(key, out)
         rids = row_ids if isinstance(row_ids, list) else [row_ids]
         for k, o in zip(keys, outs):
-            src = self._store[k]
             olist = o if isinstance(o, list) else [o]
             rlist = rids if len(rids) == len(olist) else rids * len(olist)
             for dst, rid in zip(olist, rlist):
                 if isinstance(dst, RowSparseNDArray):
                     rows = _np.unique(
                         rid.asnumpy().astype(_np.int64).reshape(-1))
-                    gathered = src._data[rows]
+                    if self._client is not None:
+                        # dist path: ship ONLY the requested rows from the
+                        # server (KVStoreDist::PullRowSparse_ semantics)
+                        gathered = jax.numpy.asarray(
+                            self._client.pull_rows(k, rows))
+                    else:
+                        gathered = self._store[k]._data[rows]
                     dst._sp_data = gathered
                     dst._sp_indices = jax.numpy.asarray(rows)
                     dst._dense_cache = None
                 else:
+                    src = self._store[k]
                     dst._data = jax.device_put(src._data,
                                                dst.context.jax_device)
 

@@ -131,6 +131,8 @@ class KVServer:
                     _send_msg(conn, self._handle_push(*msg[1:]))
                 elif op == "PULL":
                     _send_msg(conn, self._handle_pull(*msg[1:]))
+                elif op == "PULL_ROWS":
+                    _send_msg(conn, self._handle_pull_rows(*msg[1:]))
                 elif op == "INIT":
                     _send_msg(conn, self._handle_init(*msg[1:]))
                 elif op == "BARRIER":
@@ -213,6 +215,21 @@ class KVServer:
                 if not self.cv.wait(timeout=60):
                     return ("ERR", "pull timeout on key %r" % (key,))
             return ("OK", self.store[key], self.versions.get(key, 0))
+
+    def _handle_pull_rows(self, key, rows, min_version):
+        """Row-subset pull (parity KVStoreDist::PullRowSparse_ /
+        ps-lite ZPull with a row-id key range): ships ONLY the requested
+        rows — the bandwidth contract that makes embedding-scale
+        row_sparse workers viable."""
+        with self.cv:
+            while (key not in self.store
+                   or (self.sync_mode
+                       and self.versions.get(key, 0) < min_version)):
+                if not self.cv.wait(timeout=60):
+                    return ("ERR", "pull_rows timeout on key %r" % (key,))
+            idx = _np.asarray(rows, dtype=_np.int64).reshape(-1)
+            return ("OK", self.store[key][idx],
+                    self.versions.get(key, 0))
 
     def _handle_barrier(self, bid):
         with self.cv:
@@ -315,6 +332,12 @@ class KVClient:
     def pull(self, key):
         # sync semantics: see every push round this worker contributed to
         resp = self._rpc("PULL", key, self._push_counts.get(key, 0))
+        return resp[1]
+
+    def pull_rows(self, key, rows):
+        """Row-subset pull of a server-resident weight (row_sparse)."""
+        resp = self._rpc("PULL_ROWS", key, _np.asarray(rows),
+                         self._push_counts.get(key, 0))
         return resp[1]
 
     def barrier(self):

@@ -275,3 +275,37 @@ def test_dead_node_detection():
     assert watcher.returncode == 0, out.decode()
     assert "DEAD_DETECTED" in out.decode(), out.decode()
     assert time.time() - t0 < 60
+
+
+def test_dist_row_sparse_pull():
+    """Row-subset pulls from the SERVER (parity KVStoreDist::
+    PullRowSparse_): each worker pulls only its requested rows of a
+    server-resident weight and sees exact values after a push round."""
+    src = textwrap.dedent("""
+        import os, sys
+        import numpy as np
+        sys.path.insert(0, %r)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import mxtpu as mx
+        from mxtpu import nd
+
+        kv = mx.kv.create("dist_sync")
+        rank, nw = kv.rank, kv.num_workers
+        shape = (8, 3)
+        init = np.arange(24, dtype="float32").reshape(shape)
+        kv.init(5, mx.nd.array(init))
+        # each worker pushes ones; merged sum assigned => value nw
+        kv.push(5, mx.nd.ones(shape))
+        out = nd.sparse.zeros("row_sparse", shape)
+        rows = mx.nd.array(np.array([1.0, 4.0, 6.0], "float32"))
+        kv.row_sparse_pull(5, out=out, row_ids=rows)
+        dense = out.asnumpy()
+        expect = np.zeros(shape, "float32")
+        expect[[1, 4, 6]] = nw
+        assert np.allclose(dense, expect), (dense, expect)
+        kv.barrier()
+        kv.close()
+        print("WORKER_OK", rank)
+    """) % REPO
+    outs = _run_cluster(src, n=2)
+    assert all("WORKER_OK" in o for o in outs)
