@@ -1,0 +1,104 @@
+"""Fast-gradient-sign adversarial examples (parity: example/adversary/ —
+train a small net, then perturb inputs along the INPUT gradient's sign
+and watch accuracy collapse).
+
+Exercises the imperative autograd path with gradients taken w.r.t. DATA
+(mark_variables on the input batch), the flow the reference's adversary
+notebook drives through mx.autograd.
+
+Run:  python fgsm_mnist.py --epochs 3 --epsilon 0.2
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd, nd
+
+
+def synth_digits(n, rng):
+    """Synthetic 10-class 'glyph' images (8x8): distinct random prototype
+    per class + noise — linearly separable enough for a tiny net."""
+    protos = rng.rand(10, 64) > 0.55
+    y = rng.randint(0, 10, n)
+    X = protos[y].astype("float32")
+    X += rng.randn(n, 64).astype("float32") * 0.25
+    return X.reshape(n, 1, 8, 8).clip(0, 1), y.astype("float32")
+
+
+def forward(params, x, y=None):
+    c = nd.Convolution(x, params["cw"], params["cb"], kernel=(3, 3),
+                       num_filter=8)
+    a = nd.Activation(c, act_type="relu")
+    p = nd.Pooling(a, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    f = nd.Flatten(p)
+    fc = nd.FullyConnected(f, params["fw"], params["fb"], num_hidden=10)
+    if y is None:
+        return fc
+    return fc, nd.SoftmaxOutput(fc, y, normalization="batch")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--epsilon", type=float, default=0.2)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-examples", type=int, default=1024)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    X, Y = synth_digits(args.num_examples, rng)
+
+    params = {
+        "cw": nd.array(rng.randn(8, 1, 3, 3).astype("float32") * 0.3),
+        "cb": nd.array(np.zeros(8, "float32")),
+        "fw": nd.array(rng.randn(10, 72).astype("float32") * 0.1),
+        "fb": nd.array(np.zeros(10, "float32")),
+    }
+    for p in params.values():
+        p.attach_grad()
+
+    bs = args.batch_size
+    for e in range(args.epochs):
+        for i in range(0, len(X), bs):
+            xb = nd.array(X[i:i + bs])
+            yb = nd.array(Y[i:i + bs])
+            with autograd.record():
+                _, sm = forward(params, xb, yb)
+            sm.backward()
+            for p in params.values():
+                nd.sgd_update(p, p.grad, lr=0.5, out=p)
+
+    def accuracy(Xe):
+        correct = 0
+        for i in range(0, len(Xe), bs):
+            fc = forward(params, nd.array(Xe[i:i + bs]))
+            correct += int((fc.asnumpy().argmax(1)
+                            == Y[i:i + bs].astype(int)).sum())
+        return correct / len(Xe)
+
+    clean_acc = accuracy(X)
+
+    # FGSM: gradient of the loss w.r.t. the INPUT, one signed step
+    X_adv = np.empty_like(X)
+    for i in range(0, len(X), bs):
+        xb = nd.array(X[i:i + bs])
+        yb = nd.array(Y[i:i + bs])
+        xb.attach_grad()
+        with autograd.record():
+            _, sm = forward(params, xb, yb)
+        sm.backward()
+        X_adv[i:i + bs] = np.clip(
+            X[i:i + bs] + args.epsilon * np.sign(xb.grad.asnumpy()), 0, 1)
+    adv_acc = accuracy(X_adv)
+
+    logging.info("clean accuracy %.3f, adversarial accuracy %.3f",
+                 clean_acc, adv_acc)
+    return clean_acc, adv_acc
+
+
+if __name__ == "__main__":
+    clean, adv = main()
+    print("clean %.3f adversarial %.3f" % (clean, adv))

@@ -193,3 +193,15 @@ def test_sparse_linear_classification_gate():
     accs = linear_classification.main(["--epochs", "5",
                                        "--num-examples", "512"])
     assert accs[-1] > 0.8, "sparse training reached only %s" % (accs,)
+
+
+def test_adversary_fgsm_gate():
+    """FGSM adversarial examples (parity: example/adversary): input-space
+    gradients through the imperative tape — clean accuracy high, one
+    signed-gradient step collapses it."""
+    _example("adversary", "fgsm_mnist.py")
+    import fgsm_mnist
+    clean, adv = fgsm_mnist.main(["--epochs", "3", "--epsilon", "0.3",
+                                  "--num-examples", "768"])
+    assert clean > 0.95, clean
+    assert adv < clean - 0.2, (clean, adv)
