@@ -205,3 +205,13 @@ def test_adversary_fgsm_gate():
                                   "--num-examples", "768"])
     assert clean > 0.95, clean
     assert adv < clean - 0.2, (clean, adv)
+
+
+def test_text_cnn_gate():
+    """Kim-CNN sentence classification (parity:
+    example/cnn_text_classification): embedding + parallel conv widths +
+    max-over-time through Module.fit; val accuracy > 0.9."""
+    _example("cnn_text_classification", "text_cnn.py")
+    import text_cnn
+    acc = text_cnn.main(["--epochs", "4"])
+    assert acc > 0.9, acc
