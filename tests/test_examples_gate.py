@@ -215,3 +215,14 @@ def test_text_cnn_gate():
     import text_cnn
     acc = text_cnn.main(["--epochs", "4"])
     assert acc > 0.9, acc
+
+
+def test_bi_lstm_sort_gate():
+    """BidirectionalCell end to end (parity: example/bi-lstm-sort): a
+    BiLSTM learns to emit the sorted input sequence — each position
+    depends on the WHOLE sequence, so the backward direction must work;
+    held-out token accuracy > 0.85."""
+    _example("bi-lstm-sort", "sort_io.py")
+    import sort_io
+    acc = sort_io.main(["--epochs", "5", "--num-examples", "1536"])
+    assert acc > 0.85, acc
