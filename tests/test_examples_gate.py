@@ -226,3 +226,12 @@ def test_bi_lstm_sort_gate():
     import sort_io
     acc = sort_io.main(["--epochs", "5", "--num-examples", "1536"])
     assert acc > 0.85, acc
+
+
+def test_multitask_gate():
+    """Two loss heads on one trunk via sym.Group (parity:
+    example/multi-task): both tasks learn jointly."""
+    _example("multi-task", "multitask_mnist.py")
+    import multitask_mnist
+    d, p = multitask_mnist.main(["--epochs", "4"])
+    assert d > 0.95 and p > 0.95, (d, p)
