@@ -1,0 +1,67 @@
+"""SVM-output training (parity: example/svm_mnist/svm_mnist.py — the
+SVMOutput head: hinge-loss gradients instead of softmax cross-entropy,
+both the L1 margin and squared-hinge `use_linear` variants).
+
+Run:  python svm_mnist.py --epochs 4
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+
+
+def synth(n, rng):
+    protos = rng.rand(10, 64) > 0.55
+    y = rng.randint(0, 10, n)
+    X = protos[y].astype("float32") + rng.randn(n, 64).astype("float32") * 0.2
+    return X, y.astype("float32")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-examples", type=int, default=1024)
+    ap.add_argument("--margin", type=float, default=1.0)
+    ap.add_argument("--squared", action="store_true",
+                    help="squared hinge (SVMOutput use_linear=False role)")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(2)
+    X, Y = synth(args.num_examples, rng)
+    it = mx.io.NDArrayIter(X, Y, batch_size=args.batch_size, shuffle=True,
+                           label_name="svm_label")
+
+    data = mx.sym.Variable("data")
+    lbl = mx.sym.Variable("svm_label")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=10, name="fc2")
+    net = mx.sym.SVMOutput(fc2, lbl, margin=args.margin,
+                           use_linear=not args.squared, name="svm")
+
+    mod = mx.mod.Module(net, context=mx.cpu(0), label_names=("svm_label",))
+    mod.fit(it, num_epoch=args.epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            eval_metric=mx.metric.Accuracy(),
+            initializer=mx.initializer.Xavier())
+
+    it.reset()
+    correct = total = 0
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        out = mod.get_outputs()[0].asnumpy()
+        n_valid = out.shape[0] - batch.pad
+        correct += int((out.argmax(1)[:n_valid]
+                        == batch.label[0].asnumpy()[:n_valid]).sum())
+        total += n_valid
+    acc = correct / total
+    logging.info("train accuracy %.3f", acc)
+    return acc
+
+
+if __name__ == "__main__":
+    print("accuracy %.3f" % main())

@@ -235,3 +235,12 @@ def test_multitask_gate():
     import multitask_mnist
     d, p = multitask_mnist.main(["--epochs", "4"])
     assert d > 0.95 and p > 0.95, (d, p)
+
+
+def test_svm_output_gate():
+    """SVMOutput hinge-loss head end to end (parity: example/svm_mnist):
+    both the linear-hinge and squared-hinge variants train."""
+    _example("svm_mnist", "svm_mnist.py")
+    import svm_mnist
+    assert svm_mnist.main(["--epochs", "4"]) > 0.95
+    assert svm_mnist.main(["--epochs", "4", "--squared"]) > 0.95
