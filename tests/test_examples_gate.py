@@ -244,3 +244,13 @@ def test_svm_output_gate():
     import svm_mnist
     assert svm_mnist.main(["--epochs", "4"]) > 0.95
     assert svm_mnist.main(["--epochs", "4", "--squared"]) > 0.95
+
+
+def test_autoencoder_gate():
+    """AE reconstruction through LinearRegressionOutput (parity:
+    example/autoencoder): bottleneck reconstruction captures most of the
+    low-rank data's power."""
+    _example("autoencoder", "autoencoder.py")
+    import autoencoder
+    mse, var = autoencoder.main(["--epochs", "5"])
+    assert mse < 0.35 * var, (mse, var)
