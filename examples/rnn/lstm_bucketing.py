@@ -47,6 +47,9 @@ def main(argv=None):
     ap.add_argument("--num-layers", type=int, default=2)
     ap.add_argument("--num-epochs", type=int, default=3)
     ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--fused", action="store_true",
+                    help="fused multi-layer RNN op (cudnn_lstm_bucketing "
+                         "parity; lowers to an XLA while loop)")
     ap.add_argument("--lr", type=float, default=0.01)
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
@@ -62,10 +65,17 @@ def main(argv=None):
     train = mx.rnn.BucketSentenceIter(sentences, args.batch_size,
                                       buckets=buckets)
 
-    stack = mx.rnn.SequentialRNNCell()
-    for i in range(args.num_layers):
-        stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden,
-                                  prefix="lstm_l%d_" % i))
+    if args.fused:
+        # the cudnn_lstm_bucketing.py variant: one fused multi-layer op
+        # (here an XLA while-loop RNN instead of cuDNN)
+        stack = mx.rnn.FusedRNNCell(args.num_hidden,
+                                    num_layers=args.num_layers,
+                                    mode="lstm", prefix="lstm_")
+    else:
+        stack = mx.rnn.SequentialRNNCell()
+        for i in range(args.num_layers):
+            stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden,
+                                      prefix="lstm_l%d_" % i))
 
     def sym_gen(seq_len):
         data = mx.sym.Variable("data")

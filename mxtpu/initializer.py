@@ -50,6 +50,12 @@ class Initializer:
             self._init_beta(name, arr)
         elif name.endswith("weight"):
             self._init_weight(name, arr)
+        elif name.endswith("parameters"):
+            # fused-RNN flat parameter vectors (FusedRNNCell). Structured
+            # initializers (Xavier et al.) cannot see the per-matrix fans
+            # in a flat vector — wrap them in initializer.FusedRNN, which
+            # unpacks, initializes each matrix, and repacks.
+            self._init_weight(name, arr)
         elif name.endswith("moving_mean") or name.endswith("running_mean"):
             self._init_zero(name, arr)
         elif name.endswith("moving_var") or name.endswith("running_var"):
@@ -219,16 +225,64 @@ class LSTMBias(Initializer):
 
 @register
 class FusedRNN(Initializer):
+    """Initialize a FusedRNNCell's flat ``parameters`` vector the way the
+    reference does (initializer.py:726): unpack into per-gate matrices,
+    run the wrapped initializer on each WEIGHT matrix (so fan-in/fan-out
+    are the per-matrix ones, not the flat vector's), zero the biases with
+    the LSTM forget-gate bias set to ``forget_bias``, and repack. Without
+    this, Xavier sees one huge 1-D blob and the fused cell trains far
+    slower than its unfused equivalent."""
+
     def __init__(self, init=None, num_hidden=0, num_layers=0, mode="lstm",
                  bidirectional=False, forget_bias=1.0):
-        super().__init__()
         if isinstance(init, str):
             klass, kwargs = json.loads(init)
             init = _REG.get(klass)(**kwargs)
         self._init = init or Uniform(0.07)
+        # kwargs feed dumps(): the __init__-attr round trip (Variable attr
+        # -> json -> this ctor) must reconstruct the SAME geometry, or the
+        # rebuilt instance silently falls back to the flat init
+        super().__init__(init=self._init.dumps(), num_hidden=int(num_hidden),
+                         num_layers=int(num_layers), mode=mode,
+                         bidirectional=bool(bidirectional),
+                         forget_bias=float(forget_bias))
+        self._num_hidden = int(num_hidden)
+        self._num_layers = int(num_layers)
+        self._mode = mode
+        self._bidirectional = bool(bidirectional)
+        self._forget_bias = float(forget_bias)
 
     def _init_weight(self, name, arr):
-        self._init._init_weight(name, arr)
+        import numpy as _np
+
+        from .ndarray import array as _nd_array
+        from .ops.rnn import rnn_pack_weights, rnn_unpack_weights
+
+        if not (self._num_hidden and self._num_layers):
+            # cell geometry unknown: fall back to the wrapped init
+            self._init._init_weight(name, arr)
+            return
+        h, L = self._num_hidden, self._num_layers
+        from .ops.rnn import rnn_infer_input_size
+        num_input = rnn_infer_input_size(arr.size, L, h, self._mode,
+                                         self._bidirectional)
+        pieces = rnn_unpack_weights(_np.zeros(arr.size, _np.float32), L,
+                                    num_input, h, self._mode,
+                                    self._bidirectional)
+        for k, v in pieces.items():
+            if k.endswith("_weight"):
+                tmp = _nd_array(_np.zeros(v.shape, "float32"))
+                self._init._init_weight(k, tmp)
+                pieces[k] = tmp.asnumpy()
+            elif "i2h_f_bias" in k and self._mode == "lstm":
+                # net forget bias = forget_bias (h2h bias stays zero;
+                # the op adds bx + bh)
+                pieces[k] = _np.full(v.shape, self._forget_bias, "float32")
+            else:
+                pieces[k] = _np.zeros(v.shape, "float32")
+        flat = rnn_pack_weights(pieces, L, num_input, h, self._mode,
+                                self._bidirectional)
+        arr[:] = _nd_array(flat.reshape(arr.shape))
 
 
 class Mixed:

@@ -40,7 +40,8 @@ from jax import lax
 from ..base import MXNetError
 from .registry import Required, register
 
-__all__ = ["rnn_param_size", "rnn_pack_weights", "rnn_unpack_weights",
+__all__ = ["rnn_param_size", "rnn_infer_input_size",
+           "rnn_pack_weights", "rnn_unpack_weights",
            "GATE_COUNT", "GATE_NAMES"]
 
 GATE_COUNT = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
@@ -68,6 +69,19 @@ def rnn_param_size(num_layers, input_size, state_size, mode,
     for l in range(num_layers):
         total += d * sum(_layer_sizes(mode, l, input_size, state_size, d))
     return total
+
+
+def rnn_infer_input_size(flat_size, num_layers, state_size, mode,
+                         bidirectional=False):
+    """Inverse of rnn_param_size in the input dimension: recover the
+    layer-0 input size from a flat ``parameters`` vector's length. The
+    single source of truth for this arithmetic — FusedRNNCell's weight
+    unpacking and the FusedRNN initializer both resolve geometry here."""
+    d = 2 if bidirectional else 1
+    g = GATE_COUNT[mode]
+    h = state_size
+    return int(flat_size // d // h // g) - \
+        (num_layers - 1) * (h + d * h + 2) - h - 2
 
 
 def _unpack(params, num_layers, input_size, state_size, mode, num_directions):

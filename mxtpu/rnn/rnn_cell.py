@@ -323,7 +323,17 @@ class FusedRNNCell(BaseRNNCell):
         self._directions = ["l", "r"] if bidirectional else ["l"]
         if mode not in GATE_COUNT:
             raise MXNetError("invalid fused RNN mode %s" % mode)
-        self._parameter = self.params.get("parameters")
+        # the flat blob carries its OWN structured initializer as the
+        # Variable __init__ attr (reference pattern: attr wins over the
+        # fit-level initializer, initializer.py:38-41) — a plain Xavier
+        # at fit() level would otherwise see one huge 1-D vector
+        from ..initializer import FusedRNN as _FusedRNNInit
+        from ..initializer import Xavier as _Xavier
+        self._parameter = self.params.get(
+            "parameters", init=_FusedRNNInit(
+                _Xavier(factor_type="in", magnitude=2.34),
+                num_hidden=num_hidden, num_layers=num_layers, mode=mode,
+                bidirectional=bidirectional, forget_bias=forget_bias))
 
     @property
     def state_info(self):
@@ -352,10 +362,10 @@ class FusedRNNCell(BaseRNNCell):
         args = dict(args)
         arr = args.pop(self._parameter.name)
         from ..ndarray import array as _nd_array
-        b = len(self._directions)
+        from ..ops.rnn import rnn_infer_input_size
         h = self._num_hidden
-        num_input = int(arr.size // b // h // self._num_gates) - \
-            (self._num_layers - 1) * (h + b * h + 2) - h - 2
+        num_input = rnn_infer_input_size(arr.size, self._num_layers, h,
+                                         self._mode, self._bidirectional)
         for k, v in rnn_unpack_weights(arr.asnumpy(), self._num_layers,
                                        num_input, h, self._mode,
                                        self._bidirectional).items():

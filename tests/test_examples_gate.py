@@ -254,3 +254,18 @@ def test_autoencoder_gate():
     import autoencoder
     mse, var = autoencoder.main(["--epochs", "5"])
     assert mse < 0.35 * var, (mse, var)
+
+
+def test_lstm_bucketing_fused_gate():
+    """The fused variant (cudnn_lstm_bucketing.py parity: one multi-layer
+    RNN op lowered to an XLA while loop) trains under BucketingModule."""
+    _example("rnn", "lstm_bucketing.py")
+    import mxtpu as mx
+    import lstm_bucketing
+    mx.random.seed(7)
+    np.random.seed(7)  # NDArrayIter shuffle rides the global numpy RNG
+    ppl = lstm_bucketing.main([
+        "--fused", "--num-epochs", "8", "--num-hidden", "64",
+        "--num-embed", "32"])
+    assert min(ppl[2:]) < ppl[0] * 0.85, \
+        "fused perplexity did not fall: %s" % (ppl,)
