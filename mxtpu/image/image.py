@@ -313,6 +313,48 @@ class SaturationJitterAug(Augmenter):
         return [nd.array(img * alpha + gray * (1.0 - alpha))]
 
 
+class HueJitterAug(Augmenter):
+    """Random hue shift in YIQ space (parity image.py HueJitterAug)."""
+
+    _u = _np.array([[0.299, 0.587, 0.114],
+                    [0.596, -0.274, -0.321],
+                    [0.211, -0.523, 0.311]], _np.float32)
+    _v = _np.array([[1.0, 0.956, 0.621],
+                    [1.0, -0.272, -0.647],
+                    [1.0, -1.107, 1.705]], _np.float32)
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        img = _as_np(src).astype(_np.float32)
+        alpha = _pyrandom.uniform(-self.hue, self.hue)
+        a = _np.pi * alpha
+        rot = _np.array([[1, 0, 0],
+                         [0, _np.cos(a), -_np.sin(a)],
+                         [0, _np.sin(a), _np.cos(a)]], _np.float32)
+        t = self._v.T @ rot @ self._u.T
+        return [nd.array(img @ t.astype(_np.float32))]
+
+
+class RandomGrayAug(Augmenter):
+    """Randomly convert to 3-channel grayscale (parity RandomGrayAug)."""
+
+    _coef = _np.array([[[0.299, 0.587, 0.114]]], _np.float32)
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            img = _as_np(src).astype(_np.float32)
+            gray = (img * self._coef).sum(axis=2, keepdims=True)
+            return [nd.array(_np.broadcast_to(gray, img.shape).copy())]
+        return [src if hasattr(src, "asnumpy") else nd.array(_as_np(src))]
+
+
 class ColorJitterAug(RandomOrderAug):
     def __init__(self, brightness, contrast, saturation):
         ts = []

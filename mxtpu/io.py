@@ -424,6 +424,39 @@ def CSVIter(data_csv, data_shape, label_csv=None, label_shape=(1,),
                        last_batch_handle="pad" if round_batch else "discard")
 
 
+class MXDataIter(DataIter):
+    """Wrapper over a registered native-style iterator (parity io.py:740
+    MXDataIter — there, the Python face of every C++ iterator). Here the
+    registered iterators are already Python objects, so this delegates;
+    it exists so code written against the reference's `isinstance(it,
+    mx.io.MXDataIter)` / explicit-wrapper idioms ports unchanged. The C
+    ABI's MXDataIterCreateIter route (src/capi/c_api.h) serves actual
+    foreign-language clients."""
+
+    def __init__(self, underlying, data_name="data", label_name="softmax_label"):
+        super().__init__()
+        self._it = underlying
+        self.data_name = data_name
+        self.label_name = label_name
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_it"], name)
+
+    def reset(self):
+        self._it.reset()
+
+    def next(self):
+        return self._it.next()
+
+    @property
+    def provide_data(self):
+        return self._it.provide_data
+
+    @property
+    def provide_label(self):
+        return self._it.provide_label
+
+
 def create_iterator(name, **kwargs):
     return _ITER_REG.create(name, **kwargs)
 

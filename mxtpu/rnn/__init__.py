@@ -1,7 +1,8 @@
 """mx.rnn: symbolic recurrent cells, bucketed iterators, RNN checkpoints.
 
 Parity: python/mxnet/rnn/ (rnn_cell.py, io.py, rnn.py)."""
-from .rnn_cell import (BaseRNNCell, BidirectionalCell, DropoutCell,
+from .rnn_cell import (BaseConvRNNCell, BaseRNNCell, BidirectionalCell,
+                       ConvGRUCell, ConvLSTMCell, ConvRNNCell, DropoutCell,
                        FusedRNNCell, GRUCell, LSTMCell, ModifierCell,
                        ResidualCell, RNNCell, RNNParams, SequentialRNNCell,
                        ZoneoutCell)

@@ -15,7 +15,8 @@ from ..ops.rnn import (GATE_COUNT, rnn_pack_weights, rnn_param_size,
 
 __all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
            "FusedRNNCell", "SequentialRNNCell", "BidirectionalCell",
-           "DropoutCell", "ModifierCell", "ZoneoutCell", "ResidualCell"]
+           "DropoutCell", "ModifierCell", "ZoneoutCell", "ResidualCell",
+           "BaseConvRNNCell", "ConvRNNCell", "ConvLSTMCell", "ConvGRUCell"]
 
 
 class RNNParams(object):
@@ -718,3 +719,147 @@ def _cells_pack_weights(cells, args):
     for cell in cells:
         args = cell.pack_weights(args)
     return args
+
+
+class BaseConvRNNCell(BaseRNNCell):
+    """Convolutional recurrent cells: states are NCHW feature maps and the
+    i2h/h2h transforms are Convolutions (parity rnn_cell.py:1094 — the
+    ConvRNN/ConvLSTM/ConvGRU family). TPU note: each step's two convs plus
+    the gate elementwise fuse into a couple of MXU ops under XLA, and
+    unroll produces a static chain the compiler pipelines."""
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel=(3, 3),
+                 h2h_dilate=(1, 1), i2h_kernel=(3, 3), i2h_stride=(1, 1),
+                 i2h_pad=(1, 1), i2h_dilate=(1, 1), activation="tanh",
+                 prefix="", params=None):
+        super().__init__(prefix=prefix, params=params)
+        if h2h_kernel[0] % 2 != 1 or h2h_kernel[1] % 2 != 1:
+            raise MXNetError("h2h_kernel must be odd, got %s"
+                             % (h2h_kernel,))
+        self._h2h_kernel = tuple(h2h_kernel)
+        self._h2h_dilate = tuple(h2h_dilate)
+        self._h2h_pad = (h2h_dilate[0] * (h2h_kernel[0] - 1) // 2,
+                         h2h_dilate[1] * (h2h_kernel[1] - 1) // 2)
+        self._i2h_kernel = tuple(i2h_kernel)
+        self._i2h_stride = tuple(i2h_stride)
+        self._i2h_pad = tuple(i2h_pad)
+        self._i2h_dilate = tuple(i2h_dilate)
+        self._num_hidden = num_hidden
+        self._input_shape = tuple(input_shape)  # (C, H, W) per sample
+        self._activation = activation
+        # state spatial dims from the i2h conv geometry
+        c, h, w = self._input_shape
+        oh = (h + 2 * i2h_pad[0] - i2h_dilate[0] * (i2h_kernel[0] - 1)
+              - 1) // i2h_stride[0] + 1
+        ow = (w + 2 * i2h_pad[1] - i2h_dilate[1] * (i2h_kernel[1] - 1)
+              - 1) // i2h_stride[1] + 1
+        self._state_hw = (oh, ow)
+        self._iW = self.params.get("i2h_weight")
+        self._ib = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hb = self.params.get("h2h_bias")
+
+    @property
+    def _gates(self):
+        return 1
+
+    @property
+    def state_info(self):
+        oh, ow = self._state_hw
+        return [{"shape": (0, self._num_hidden, oh, ow),
+                 "__layout__": "NCHW"}]
+
+    def _conv_sums(self, inputs, state, name):
+        """i2h(inputs) + h2h(state), num_filter = gates * num_hidden."""
+        nf = self._gates * self._num_hidden
+        i2h = symbol.Convolution(inputs, self._iW, self._ib,
+                                 kernel=self._i2h_kernel,
+                                 stride=self._i2h_stride,
+                                 pad=self._i2h_pad,
+                                 dilate=self._i2h_dilate,
+                                 num_filter=nf, name="%si2h" % name)
+        h2h = symbol.Convolution(state, self._hW, self._hb,
+                                 kernel=self._h2h_kernel,
+                                 pad=self._h2h_pad,
+                                 dilate=self._h2h_dilate,
+                                 num_filter=nf, name="%sh2h" % name)
+        return i2h, h2h
+
+
+class ConvRNNCell(BaseConvRNNCell):
+    """Plain conv recurrence: h' = act(i2h(x) + h2h(h)) (rnn_cell.py:1176)."""
+
+    def __init__(self, input_shape, num_hidden, prefix="ConvRNN_", **kw):
+        super().__init__(input_shape, num_hidden, prefix=prefix, **kw)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h, h2h = self._conv_sums(inputs, states[0], name)
+        out = self._get_activation(i2h + h2h, self._activation,
+                                   name="%sout" % name)
+        return out, [out]
+
+
+class ConvLSTMCell(BaseConvRNNCell):
+    """Conv LSTM (Shi et al. 2015; rnn_cell.py:1249): the four gates are
+    channel slices of one i2h+h2h conv pair."""
+
+    def __init__(self, input_shape, num_hidden, prefix="ConvLSTM_",
+                 forget_bias=1.0, **kw):
+        super().__init__(input_shape, num_hidden, prefix=prefix, **kw)
+        self._forget_bias = forget_bias
+
+    @property
+    def _gates(self):
+        return 4
+
+    @property
+    def state_info(self):
+        oh, ow = self._state_hw
+        return [{"shape": (0, self._num_hidden, oh, ow),
+                 "__layout__": "NCHW"}] * 2
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h, h2h = self._conv_sums(inputs, states[0], name)
+        gates = i2h + h2h
+        sl = symbol.SliceChannel(gates, num_outputs=4, axis=1,
+                                 name="%sslice" % name)
+        i = symbol.Activation(sl[0], act_type="sigmoid")
+        f = symbol.Activation(sl[1] + self._forget_bias,
+                              act_type="sigmoid")
+        c_in = self._get_activation(sl[2], self._activation)
+        o = symbol.Activation(sl[3], act_type="sigmoid")
+        c = f * states[1] + i * c_in
+        h = o * self._get_activation(c, self._activation,
+                                     name="%sout" % name)
+        return h, [h, c]
+
+
+class ConvGRUCell(BaseConvRNNCell):
+    """Conv GRU (rnn_cell.py:1339): reset/update/candidate gates as
+    channel slices."""
+
+    def __init__(self, input_shape, num_hidden, prefix="ConvGRU_", **kw):
+        super().__init__(input_shape, num_hidden, prefix=prefix, **kw)
+
+    @property
+    def _gates(self):
+        return 3
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h, h2h = self._conv_sums(inputs, states[0], name)
+        i_sl = symbol.SliceChannel(i2h, num_outputs=3, axis=1,
+                                   name="%si_slice" % name)
+        h_sl = symbol.SliceChannel(h2h, num_outputs=3, axis=1,
+                                   name="%sh_slice" % name)
+        r = symbol.Activation(i_sl[0] + h_sl[0], act_type="sigmoid")
+        z = symbol.Activation(i_sl[1] + h_sl[1], act_type="sigmoid")
+        cand = self._get_activation(i_sl[2] + r * h_sl[2],
+                                    self._activation)
+        out = z * states[0] + (1 - z) * cand
+        return out, [out]

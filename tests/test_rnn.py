@@ -207,3 +207,36 @@ def test_bucket_sentence_iter_shapes():
     d = b.data[0].asnumpy()
     lbl = b.label[0].asnumpy()
     assert np.allclose(d[:, 1:], lbl[:, :-1])
+
+
+def test_conv_rnn_cells_unroll_and_train():
+    """ConvRNN/ConvLSTM/ConvGRU cells (parity rnn_cell.py:1094-1380):
+    NCHW feature-map states, conv gates; unroll binds, forward is finite,
+    and gradients reach the conv weights."""
+    import numpy as np
+    import mxtpu as mx
+
+    B, T, C, H, W, NH = 2, 3, 4, 8, 8, 6
+    rng = np.random.RandomState(0)
+    for cls, n_states in ((mx.rnn.ConvRNNCell, 1),
+                          (mx.rnn.ConvLSTMCell, 2),
+                          (mx.rnn.ConvGRUCell, 1)):
+        cell = cls(input_shape=(C, H, W), num_hidden=NH)
+        data = mx.sym.Variable("data")
+        steps = [mx.sym.Reshape(mx.sym.slice_axis(
+            data, axis=1, begin=t, end=t + 1), shape=(-1, C, H, W))
+            for t in range(T)]
+        outs, states = cell.unroll(T, inputs=steps)
+        assert len(states) == n_states
+        net = mx.sym.sum(outs[-1])
+        shapes, _, _ = net.infer_shape(data=(B, T, C, H, W))
+        args = {n: mx.nd.array(rng.randn(*s).astype("float32") * 0.2)
+                for n, s in zip(net.list_arguments(), shapes)}
+        grads = {n: mx.nd.zeros(v.shape) for n, v in args.items()
+                 if n != "data"}
+        ex = net.bind(mx.cpu(), args, args_grad=grads)
+        out = ex.forward(is_train=True)[0].asnumpy()
+        assert np.isfinite(out).all()
+        ex.backward()
+        g = grads[cell._iW.list_arguments()[0]].asnumpy()
+        assert np.abs(g).max() > 0, cls.__name__
