@@ -26,6 +26,7 @@ from . import random
 from . import random as rnd
 from . import autograd
 from . import name
+from . import symbol_doc
 from . import log
 from . import registry
 from . import libinfo

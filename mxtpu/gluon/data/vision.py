@@ -126,3 +126,39 @@ class ImageRecordDataset(dataset.RecordFileDataset):
         if self._transform is not None:
             return self._transform(img, header.label)
         return img, header.label
+
+
+class ImageFolderDataset(dataset.Dataset):
+    """Images laid out as root/<class-name>/<img> (parity gluon/data/
+    vision.py:235): folder names become integer labels via ``synsets``."""
+
+    def __init__(self, root, flag=1, transform=None):
+        import os
+
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = (".jpg", ".jpeg", ".png")
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for fname in sorted(os.listdir(path)):
+                if fname.lower().endswith(self._exts):
+                    self.items.append((os.path.join(path, fname), label))
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, idx):
+        from ...image import image as _img
+
+        path, label = self.items[idx]
+        img = _img.imread(path, flag=self._flag)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label

@@ -211,3 +211,207 @@ class CTCLoss(Loss):
             label = F.swapaxes(label, dim1=0, dim2=1)
         loss = F.CTCLoss(pred, label)
         return _apply_weighting(F, loss, self._weight, sample_weight)
+
+
+class Huber(Loss):
+    """Trimmed-mean robust loss: quadratic within ``rho``, linear outside
+    (parity loss.py:390)."""
+
+    def __init__(self, rho=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        err = F.abs(pred - label)
+        loss = (err > self._rho) * (err - 0.5 * self._rho) + \
+            (err <= self._rho) * (0.5 / self._rho) * F.square(err)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class EpsilonInsensitive(Loss):
+    """SVR-style dead-zone loss: |err| beyond epsilon (parity loss.py:429)."""
+
+    def __init__(self, epsilon=0.1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._epsilon = epsilon
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.maximum(F.abs(pred - label) - self._epsilon,
+                         F.zeros_like(pred))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class SoftMargin(Loss):
+    """Binary hinge max(0, 1 - y*f) with labels in {-1, 1} (loss.py:462)."""
+
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.maximum(1.0 - pred * label, F.zeros_like(pred))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class SquaredSoftMargin(Loss):
+    """Squared binary hinge (parity loss.py:491)."""
+
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.square(F.maximum(1.0 - pred * label, F.zeros_like(pred)))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class Exponential(Loss):
+    """AdaBoost-style exp(-y*f) (parity loss.py:520)."""
+
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.exp(-pred * label)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class Logistic(Loss):
+    """Binary logistic log(1 + exp(-y*f)), labels in {-1, 1}
+    (parity loss.py:549)."""
+
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.log(1.0 + F.exp(-pred * label))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class Quantile(Loss):
+    """Koenker's pinball loss estimating the tau-quantile
+    (parity loss.py:578)."""
+
+    def __init__(self, tau=0.5, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._tau = tau
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        err = pred - label
+        loss = F.maximum(self._tau * err, (self._tau - 1.0) * err)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class Langford(Loss):
+    """Smoothed hinge (Langford): quadratic near the margin, linear
+    beyond (parity loss.py:615)."""
+
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        margin = F.maximum(F.zeros_like(pred), 1.0 - pred * label)
+        loss = (margin < 1.0) * 0.5 * F.square(margin) + \
+            (margin >= 1.0) * (margin - 0.5)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class DualKL(Loss):
+    """Dual (Fenchel) KL-divergence estimator between samples labeled
+    +1 (from p) and -1 (from q) (parity loss.py:654)."""
+
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = (label == -1) * F.exp(pred) - (label == 1) * (pred + 1.0)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class RelativeNovelty(Loss):
+    """Relative novelty detector of Song, Teo & Smola 2009
+    (parity loss.py:699)."""
+
+    def __init__(self, rho=0.1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        base = -(pred > 0) * (pred + 1.0) - (pred <= 0) * F.exp(pred)
+        loss = (label == 1) * base + (label == -1) * F.exp(pred - self._rho)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class LogCosh(Loss):
+    """Smooth L1 via log cosh, computed overflow-safely
+    (parity loss.py:741)."""
+
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        err = F.abs(label - pred)
+        loss = err + F.log(0.5 + 0.5 * F.exp(-2.0 * err))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class Poisson(Loss):
+    """Poisson regression loss exp(f) - f*y (unnormalized NLL,
+    parity loss.py:773)."""
+
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.exp(pred) - pred * label
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class MaxMargin(Loss):
+    """Multiclass soft-margin with a task-loss matrix ``delta``
+    (parity loss.py:809): loss = max_y' [f(y') + delta(y', y)] - f(y).
+    Without an explicit delta the 0/1 matrix is used (built lazily at
+    the first imperative call; symbolic use requires passing delta)."""
+
+    def __init__(self, delta=None, axis=-1, weight=None, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._axis = axis
+        self._delta = delta
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        if self._delta is None:
+            if F is not nd_mod:
+                raise MXNetError(
+                    "MaxMargin: pass delta explicitly for symbolic use")
+            import numpy as _np
+            classes = pred.shape[self._axis]
+            self._delta = nd_mod.array(
+                (1.0 - _np.eye(classes)).astype("float32"))
+        loss = -F.pick(pred, label, axis=self._axis, keepdims=True)
+        loss = loss + F.max(pred + F.take(self._delta, label),
+                            axis=self._axis, keepdims=True)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)

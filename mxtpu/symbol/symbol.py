@@ -275,6 +275,40 @@ class Symbol:
     def __neg__(self):
         return _compose(get_op("negative"), None, [self], {})
 
+    # rich comparisons emit 0/1-valued symbols (reference symbol.py
+    # __gt__/__ge__/__lt__/__le__/__eq__/__ne__ over broadcast_* ops) —
+    # the mask idiom losses use: (err > rho) * penalty
+    def __gt__(self, o):
+        return self._binop(o, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binop(o, "broadcast_greater_equal",
+                           "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binop(o, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binop(o, "broadcast_lesser_equal",
+                           "_lesser_equal_scalar")
+
+    def __eq__(self, o):
+        # SCALAR comparisons build the 0/1 mask op; Symbol-to-Symbol
+        # equality stays Python identity (symbols live in dicts/sets all
+        # over the executor — use sym.broadcast_equal explicitly for an
+        # elementwise compare of two symbols)
+        if isinstance(o, (int, float)) and not isinstance(o, bool):
+            return self._binop(o, "broadcast_equal", "_equal_scalar")
+        return NotImplemented
+
+    def __ne__(self, o):
+        if isinstance(o, (int, float)) and not isinstance(o, bool):
+            return self._binop(o, "broadcast_not_equal",
+                               "_not_equal_scalar")
+        return NotImplemented
+
+    __hash__ = object.__hash__
+
     def __repr__(self):
         return "<Symbol %s>" % (self.name or ",".join(self.list_outputs()))
 

@@ -258,3 +258,62 @@ def test_layernorm_block():
     gkey = [k for k in params.keys() if k.endswith("gamma")][0]
     assert params[gkey].shape == (8,)  # deferred init resolved
     assert float(np.abs(params[gkey].grad().asnumpy()).sum()) > 0
+
+
+def test_round3_loss_family_numeric():
+    """The 13 round-3 losses (parity loss.py:390-861) match their numpy
+    formulas and work under hybridize."""
+    import numpy as np
+    from mxtpu import gluon, nd
+    L = gluon.loss
+
+    rng = np.random.RandomState(3)
+    p = rng.randn(8, 1).astype("float32")
+    y = rng.choice([-1.0, 1.0], (8, 1)).astype("float32")
+    r = rng.randn(8, 1).astype("float32")
+
+    def run(loss, lab):
+        return loss(nd.array(p), nd.array(lab)).asnumpy()
+
+    m = p * y
+    np.testing.assert_allclose(
+        run(L.SoftMargin(), y), np.maximum(0, 1 - m).mean(1), rtol=1e-5)
+    np.testing.assert_allclose(
+        run(L.SquaredSoftMargin(), y),
+        (np.maximum(0, 1 - m) ** 2).mean(1), rtol=1e-5)
+    np.testing.assert_allclose(
+        run(L.Exponential(), y), np.exp(-m).mean(1), rtol=1e-5)
+    np.testing.assert_allclose(
+        run(L.Logistic(), y), np.log1p(np.exp(-m)).mean(1), rtol=1e-5)
+    err = np.abs(p - r)
+    rho = 1.0
+    np.testing.assert_allclose(
+        run(L.Huber(rho), r),
+        np.where(err < rho, 0.5 / rho * err ** 2,
+                 err - 0.5 * rho).mean(1), rtol=1e-5)
+    np.testing.assert_allclose(
+        run(L.Quantile(0.3), r),
+        np.maximum(0.3 * (p - r), -0.7 * (p - r)).mean(1), rtol=1e-5)
+    np.testing.assert_allclose(
+        run(L.LogCosh(), r),
+        (err + np.log(0.5 + 0.5 * np.exp(-2 * err))).mean(1),
+        rtol=1e-4, atol=1e-6)
+    lam = np.abs(rng.randn(8, 1)).astype("float32")
+    np.testing.assert_allclose(
+        run(L.Poisson(), lam), (np.exp(p) - p * lam).mean(1), rtol=1e-5)
+
+    # hybridized path agrees for a parameter-free loss
+    hl = L.Huber(0.7)
+    hl.hybridize()
+    got = hl(nd.array(p), nd.array(r)).asnumpy()
+    e = np.abs(p - r)
+    want = np.where(e < 0.7, 0.5 / 0.7 * e ** 2, e - 0.35).mean(1)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    # MaxMargin: correct class with big margin -> zero loss
+    logits = np.full((2, 4), -5.0, "float32")
+    logits[0, 1] = 5.0
+    logits[1, 2] = 5.0
+    lbl = np.array([1.0, 2.0], "float32")
+    out = L.MaxMargin()(nd.array(logits), nd.array(lbl)).asnumpy()
+    np.testing.assert_allclose(out, np.zeros(2), atol=1e-5)
