@@ -244,3 +244,23 @@ def _cvcopyMakeBorder(src, top, bot, left, right, border_type=0,
     from ..image import image as _img
     return _img.copyMakeBorder(src, top, bot, left, right,
                                border_type=border_type, value=value)
+
+
+# module-level comparison functions (parity ndarray.py equal/not_equal/
+# greater/greater_equal/lesser/lesser_equal — NDArray or scalar rhs)
+def _cmp_fn(broadcast_name, scalar_name):
+    def fn(lhs, rhs):
+        from .ndarray import NDArray, invoke_op
+        if isinstance(rhs, NDArray):
+            return invoke_op(broadcast_name, [lhs, rhs], {})[0]
+        return invoke_op(scalar_name, [lhs], {"scalar": float(rhs)})[0]
+    fn.__name__ = broadcast_name.replace("broadcast_", "")
+    return fn
+
+
+equal = _cmp_fn("broadcast_equal", "_equal_scalar")
+not_equal = _cmp_fn("broadcast_not_equal", "_not_equal_scalar")
+greater = _cmp_fn("broadcast_greater", "_greater_scalar")
+greater_equal = _cmp_fn("broadcast_greater_equal", "_greater_equal_scalar")
+lesser = _cmp_fn("broadcast_lesser", "_lesser_scalar")
+lesser_equal = _cmp_fn("broadcast_lesser_equal", "_lesser_equal_scalar")

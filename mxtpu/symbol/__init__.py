@@ -51,8 +51,16 @@ for _name in list_ops():
         setattr(_mod, _name, _make_sym_fn(_name, _op))
 
 for _pub, _priv in [("uniform", "_random_uniform"), ("normal", "_random_normal"),
-                    ("zeros", "_zeros"), ("ones", "_ones")]:
+                    ("zeros", "_zeros"), ("ones", "_ones"),
+                    ("arange", "_arange")]:
     setattr(_mod, _pub, _make_sym_fn(_priv, get_op(_priv)))
+
+
+def full(shape, val, dtype="float32", **kwargs):
+    """Constant-filled symbol (parity symbol.py full): _ones * val."""
+    one = _make_sym_fn("_ones", get_op("_ones"))(shape=shape, dtype=dtype,
+                                                 **kwargs)
+    return one * float(val)
 
 
 from ..base import PrefixOpNamespace as _PrefixNS  # noqa: E402
