@@ -269,3 +269,13 @@ def test_lstm_bucketing_fused_gate():
         "--num-embed", "32"])
     assert min(ppl[2:]) < ppl[0] * 0.85, \
         "fused perplexity did not fall: %s" % (ppl,)
+
+
+def test_nce_loss_gate():
+    """NCE training (parity: example/nce-loss): binary noise-contrastive
+    objective with unigram negatives; the NCE-trained embeddings beat the
+    unigram baseline by a wide margin under FULL-softmax evaluation."""
+    _example("nce-loss", "nce_lm.py")
+    import nce_lm
+    acc, base = nce_lm.main(["--epochs", "6", "--lr", "1.0"])
+    assert acc > 3 * base, (acc, base)
