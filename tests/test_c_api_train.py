@@ -370,6 +370,10 @@ int main(int argc, char **argv) {
   CHECK(MXExecutorForward(exec, 1));
   CHECK(MXExecutorBackward(exec));
 
+  /* drain in-flight async work before teardown (reference clients
+   * WaitAll before exit; skipping it races process teardown) */
+  CHECK(MXNDArrayWaitAll());
+
   /* JSON round-trip for the python cross-check */
   const char *json = NULL;
   CHECK(MXSymbolSaveToJSON(sm, &json));
