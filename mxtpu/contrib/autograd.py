@@ -71,3 +71,21 @@ def grad(func, argnum=None):
         return grad_with_loss_func(*args)[0]
 
     return wrapped
+
+
+class TrainingStateScope:
+    """Scope flipping the global training flag (parity
+    contrib/autograd.py:53)."""
+
+    def __init__(self, enter_state):
+        self._enter_state = bool(enter_state)
+        self._prev = None
+
+    def __enter__(self):
+        from .. import autograd as _ag
+        self._prev = _ag.set_training(self._enter_state)
+        return self
+
+    def __exit__(self, *args):
+        from .. import autograd as _ag
+        _ag.set_training(self._prev)

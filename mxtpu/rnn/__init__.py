@@ -7,5 +7,5 @@ from .rnn_cell import (BaseConvRNNCell, BaseRNNCell, BidirectionalCell,
                        ResidualCell, RNNCell, RNNParams, SequentialRNNCell,
                        ZoneoutCell)
 from .io import BucketSentenceIter, encode_sentences
-from .rnn import (do_rnn_checkpoint, load_rnn_checkpoint,
+from .rnn import (do_rnn_checkpoint, load_rnn_checkpoint, rnn_unroll,
                   save_rnn_checkpoint)

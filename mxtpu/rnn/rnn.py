@@ -33,3 +33,14 @@ def do_rnn_checkpoint(cells, prefix, period=1):
         if (iter_no + 1) % period == 0:
             save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
     return _callback
+
+
+def rnn_unroll(cell, length, inputs=None, begin_state=None,
+               input_prefix="", layout="NTC"):
+    """Deprecated alias for cell.unroll (parity rnn/rnn.py:26)."""
+    import warnings
+
+    del input_prefix
+    warnings.warn("rnn_unroll is deprecated; call cell.unroll directly.")
+    return cell.unroll(length=length, inputs=inputs,
+                       begin_state=begin_state, layout=layout)
