@@ -32,7 +32,22 @@ json.dump({"date": datetime.datetime.now().isoformat(),
            "command": "MXTPU_TEST_TPU=1 pytest tests/ -m tpu -q"},
           open("TPU_CONSISTENCY.json", "w"), indent=1)
 EOF
-        echo "$(date -Is) consistency rc=$rc ($tail); running flag sweep" >> tpu_watch.log
+        echo "$(date -Is) consistency rc=$rc ($tail); running bench" >> tpu_watch.log
+        BENCH_ITERS=40 timeout 1500 python bench.py \
+            > /tmp/tpu_bench_line.json 2>/dev/null
+        python - <<'EOF'
+import datetime, json
+try:
+    line = [l for l in open("/tmp/tpu_bench_line.json")
+            if l.startswith("{")][-1]
+    data = json.loads(line)
+except Exception as e:
+    data = {"error": str(e)}
+data["date"] = datetime.datetime.now().isoformat()
+data["captured_by"] = "tools/tpu_opportunist.sh (opportunistic, driver-independent)"
+json.dump(data, open("TPU_BENCH_OPPORTUNISTIC.json", "w"), indent=1)
+EOF
+        echo "$(date -Is) bench captured; running flag sweep" >> tpu_watch.log
         timeout 4500 python tools/flag_sweep.py 40 > flag_sweep_results.txt 2>&1
         echo "$(date -Is) flag sweep done" >> tpu_watch.log
         exit 0
