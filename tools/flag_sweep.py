@@ -35,7 +35,10 @@ def main():
     iters = sys.argv[1] if len(sys.argv) > 1 else "40"
     results = []
     for name, flags in COMBOS:
-        env = dict(os.environ, BENCH_ITERS=iters, BENCH_TIMEOUT="900")
+        # BENCH_NO_LASTGOOD: sweep combos (some deliberately degraded) must
+        # not overwrite the headline last-good record bench.py falls back on
+        env = dict(os.environ, BENCH_ITERS=iters, BENCH_TIMEOUT="900",
+                   BENCH_NO_LASTGOOD="1")
         if flags:
             env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flags).strip()
         r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
