@@ -400,18 +400,24 @@ class MaxMargin(Loss):
         super().__init__(weight, batch_axis, **kwargs)
         self._axis = axis
         self._delta = delta
+        self._delta_explicit = delta is not None
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if self._delta is None:
+        delta = self._delta
+        if not self._delta_explicit:
             if F is not nd_mod:
                 raise MXNetError(
                     "MaxMargin: pass delta explicitly for symbolic use")
             import numpy as _np
             classes = pred.shape[self._axis]
-            self._delta = nd_mod.array(
-                (1.0 - _np.eye(classes)).astype("float32"))
+            # rebuild when the class count changes: the same loss instance
+            # may serve tasks with different label spaces
+            if delta is None or delta.shape[0] != classes:
+                delta = nd_mod.array(
+                    (1.0 - _np.eye(classes)).astype("float32"))
+                self._delta = delta
         loss = -F.pick(pred, label, axis=self._axis, keepdims=True)
-        loss = loss + F.max(pred + F.take(self._delta, label),
+        loss = loss + F.max(pred + F.take(delta, label),
                             axis=self._axis, keepdims=True)
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
         return F.mean(loss, axis=self._batch_axis, exclude=True)

@@ -440,7 +440,13 @@ class MXDataIter(DataIter):
         self.label_name = label_name
 
     def __getattr__(self, name):
-        return getattr(self.__dict__["_it"], name)
+        # AttributeError (not KeyError) when _it is unset — e.g. lookups
+        # during __init__/copy/pickle — keeps hasattr/getattr protocols sound
+        try:
+            it = self.__dict__["_it"]
+        except KeyError:
+            raise AttributeError(name)
+        return getattr(it, name)
 
     def reset(self):
         self._it.reset()

@@ -77,6 +77,8 @@ class KVServer:
         self.barrier_counts = {}
         self.init_ranks = {}     # key -> lowest rank that initialized it
         self.heartbeats = {}     # rank -> monotonic time of last heartbeat
+        import time as _time
+        self._started = _time.monotonic()  # epoch for never-heartbeated ranks
         self.stopped_ranks = set()  # clean shutdowns are not "dead"
         self.stops_seen = 0
         self._stop = False
@@ -255,10 +257,13 @@ class KVServer:
         """Count workers that have gone silent for > timeout_sec.
 
         Dead = a rank that (a) heartbeated at least once and then stopped
-        for longer than the timeout, or (b) never heartbeated although
-        some other worker has (it failed before joining) — excluding
-        ranks that sent a clean STOP. Mirrors get_num_dead_node
-        (include/mxnet/kvstore.h:328) with node_id = kWorkerGroup."""
+        for longer than the timeout, or (b) never heartbeated for more
+        than the timeout measured from server start (it failed before
+        joining; the server-start epoch keeps a live-but-slow worker in a
+        staggered launch from being counted dead the moment a faster
+        sibling heartbeats first) — excluding ranks that sent a clean
+        STOP. Mirrors get_num_dead_node (include/mxnet/kvstore.h:328)
+        with node_id = kWorkerGroup."""
         import time
 
         now = time.monotonic()
@@ -269,8 +274,11 @@ class KVServer:
             for r in range(self.num_workers):
                 if r in self.stopped_ranks:
                     continue
-                last = self.heartbeats.get(r)
-                if last is None or now - last > float(timeout_sec):
+                # a never-heartbeated rank is measured from server start so
+                # a live-but-slow worker in a staggered launch isn't counted
+                # dead the moment a faster sibling heartbeats first
+                last = self.heartbeats.get(r, self._started)
+                if now - last > float(timeout_sec):
                     dead += 1
             return ("OK", dead)
 

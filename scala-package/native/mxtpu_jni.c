@@ -24,6 +24,18 @@ static void throw_mx(JNIEnv *env, const char *where) {
   (*env)->ThrowNew(env, cls, msg);
 }
 
+/* malloc that throws OutOfMemoryError instead of letting callers write
+ * through NULL — sizes here are caller-controlled since the fixed caps
+ * were removed */
+static void *jmalloc(JNIEnv *env, size_t n) {
+  void *p = malloc(n > 0 ? n : 1);
+  if (p == NULL) {
+    jclass cls = (*env)->FindClass(env, "java/lang/OutOfMemoryError");
+    (*env)->ThrowNew(env, cls, "mxtpu_jni: native allocation failed");
+  }
+  return p;
+}
+
 #define JCHECK(call, ret)            \
   if ((call) != 0) {                 \
     throw_mx(env, #call);            \
@@ -37,11 +49,20 @@ JNIEXPORT jlong JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_ndarrayCreate(
   (void)cls;
   jsize ndim = (*env)->GetArrayLength(env, jshape);
   jint *dims = (*env)->GetIntArrayElements(env, jshape, NULL);
-  mx_uint shape[16];
-  for (jsize i = 0; i < ndim && i < 16; ++i) shape[i] = (mx_uint)dims[i];
+  mx_uint *shape = (mx_uint *)jmalloc(env, sizeof(mx_uint) * (size_t)ndim);
+  if (shape == NULL) {
+    (*env)->ReleaseIntArrayElements(env, jshape, dims, JNI_ABORT);
+    return 0;
+  }
+  for (jsize i = 0; i < ndim; ++i) shape[i] = (mx_uint)dims[i];
   (*env)->ReleaseIntArrayElements(env, jshape, dims, JNI_ABORT);
   NDArrayHandle h;
-  JCHECK(MXNDArrayCreate(shape, (mx_uint)ndim, 1, 0, 0, dtype, &h), 0);
+  int rc = MXNDArrayCreate(shape, (mx_uint)ndim, 1, 0, 0, dtype, &h);
+  free(shape);
+  if (rc != 0) {
+    throw_mx(env, "MXNDArrayCreate");
+    return 0;
+  }
   return (jlong)(intptr_t)h;
 }
 
@@ -80,9 +101,11 @@ JNIEXPORT jintArray JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_ndarrayShape(
   const mx_uint *shape;
   JCHECK(MXNDArrayGetShape((NDArrayHandle)(intptr_t)h, &ndim, &shape), NULL);
   jintArray out = (*env)->NewIntArray(env, (jsize)ndim);
-  jint tmp[16];
-  for (mx_uint i = 0; i < ndim && i < 16; ++i) tmp[i] = (jint)shape[i];
+  jint *tmp = (jint *)jmalloc(env, sizeof(jint) * (size_t)ndim);
+  if (tmp == NULL) return NULL;
+  for (mx_uint i = 0; i < ndim; ++i) tmp[i] = (jint)shape[i];
   (*env)->SetIntArrayRegion(env, out, 0, (jsize)ndim, tmp);
+  free(tmp);
   return out;
 }
 
@@ -94,20 +117,31 @@ JNIEXPORT void JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_waitAll(
 
 /* ---------------- imperative invoke ---------------- */
 
-static void fill_cstrings(JNIEnv *env, jobjectArray arr, const char **out,
-                          int n) {
+/* malloc-sized pinned-string array: the param/shape counts here are caller
+ * controlled (an ImageRecordIter config easily exceeds any fixed cap), so
+ * every fill is heap-allocated to the exact JNI array length. Each element
+ * ref is deleted as soon as its chars are pinned — JNI only guarantees 16
+ * live local refs per native frame. */
+static const char **alloc_cstrings(JNIEnv *env, jobjectArray arr, int n) {
+  const char **out = (const char **)jmalloc(env, sizeof(char *) * (size_t)n);
+  if (out == NULL) return NULL;
   for (int i = 0; i < n; ++i) {
     jstring s = (jstring)(*env)->GetObjectArrayElement(env, arr, i);
     out[i] = (*env)->GetStringUTFChars(env, s, NULL);
+    (*env)->DeleteLocalRef(env, s);
   }
+  return out;
 }
 
-static void release_cstrings(JNIEnv *env, jobjectArray arr, const char **strs,
-                             int n) {
+static void free_cstrings(JNIEnv *env, jobjectArray arr, const char **strs,
+                          int n) {
+  if (strs == NULL) return;
   for (int i = 0; i < n; ++i) {
     jstring s = (jstring)(*env)->GetObjectArrayElement(env, arr, i);
     (*env)->ReleaseStringUTFChars(env, s, strs[i]);
+    (*env)->DeleteLocalRef(env, s);
   }
+  free((void *)strs);
 }
 
 JNIEXPORT jlongArray JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_imperativeInvoke(
@@ -117,24 +151,48 @@ JNIEXPORT jlongArray JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_imperativeInvoke(
   const char *op = (*env)->GetStringUTFChars(env, jop, NULL);
   jsize ni = (*env)->GetArrayLength(env, jins);
   jlong *ins = (*env)->GetLongArrayElements(env, jins, NULL);
-  NDArrayHandle in_h[64];
-  for (jsize i = 0; i < ni && i < 64; ++i) {
+  NDArrayHandle *in_h =
+      (NDArrayHandle *)jmalloc(env, sizeof(NDArrayHandle) * (size_t)ni);
+  if (in_h == NULL) {
+    (*env)->ReleaseLongArrayElements(env, jins, ins, JNI_ABORT);
+    (*env)->ReleaseStringUTFChars(env, jop, op);
+    return NULL;
+  }
+  for (jsize i = 0; i < ni; ++i) {
     in_h[i] = (NDArrayHandle)(intptr_t)ins[i];
   }
   (*env)->ReleaseLongArrayElements(env, jins, ins, JNI_ABORT);
   jsize np = jkeys ? (*env)->GetArrayLength(env, jkeys) : 0;
-  const char *keys[32], *vals[32];
+  const char **keys = NULL, **vals = NULL;
   if (np > 0) {
-    fill_cstrings(env, jkeys, keys, np);
-    fill_cstrings(env, jvals, vals, np);
+    keys = alloc_cstrings(env, jkeys, np);
+    vals = keys ? alloc_cstrings(env, jvals, np) : NULL;
+    if (keys == NULL || vals == NULL) {
+      free_cstrings(env, jkeys, keys, np);
+      free(in_h);
+      (*env)->ReleaseStringUTFChars(env, jop, op);
+      return NULL;
+    }
   }
   mx_uint n_out = 0;
   NDArrayHandle *outs = NULL;
-  NDArrayHandle fixed[16];
+  NDArrayHandle *fixed = NULL;
   if (jouts != NULL) { /* in-place form: caller-provided destinations */
     n_out = (mx_uint)(*env)->GetArrayLength(env, jouts);
     jlong *oh = (*env)->GetLongArrayElements(env, jouts, NULL);
-    for (mx_uint i = 0; i < n_out && i < 16; ++i) {
+    fixed = (NDArrayHandle *)jmalloc(env,
+                                     sizeof(NDArrayHandle) * (size_t)n_out);
+    if (fixed == NULL) {
+      (*env)->ReleaseLongArrayElements(env, jouts, oh, JNI_ABORT);
+      if (np > 0) {
+        free_cstrings(env, jkeys, keys, np);
+        free_cstrings(env, jvals, vals, np);
+      }
+      free(in_h);
+      (*env)->ReleaseStringUTFChars(env, jop, op);
+      return NULL;
+    }
+    for (mx_uint i = 0; i < n_out; ++i) {
       fixed[i] = (NDArrayHandle)(intptr_t)oh[i];
     }
     (*env)->ReleaseLongArrayElements(env, jouts, oh, JNI_ABORT);
@@ -142,21 +200,29 @@ JNIEXPORT jlongArray JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_imperativeInvoke(
   }
   int rc = MXImperativeInvoke(op, (mx_uint)ni, in_h, &n_out, &outs, np, keys,
                               vals);
+  free(in_h);
   if (np > 0) {
-    release_cstrings(env, jkeys, keys, np);
-    release_cstrings(env, jvals, vals, np);
+    free_cstrings(env, jkeys, keys, np);
+    free_cstrings(env, jvals, vals, np);
   }
   (*env)->ReleaseStringUTFChars(env, jop, op);
   if (rc != 0) {
+    free(fixed);
     throw_mx(env, "MXImperativeInvoke");
     return NULL;
   }
   jlongArray jres = (*env)->NewLongArray(env, (jsize)n_out);
-  jlong tmp[64];
-  for (mx_uint i = 0; i < n_out && i < 64; ++i) {
+  jlong *tmp = (jlong *)jmalloc(env, sizeof(jlong) * (size_t)n_out);
+  if (tmp == NULL) {
+    free(fixed);
+    return NULL;
+  }
+  for (mx_uint i = 0; i < n_out; ++i) {
     tmp[i] = (jlong)(intptr_t)outs[i];
   }
   (*env)->SetLongArrayRegion(env, jres, 0, (jsize)n_out, tmp);
+  free(tmp);
+  free(fixed);
   return jres;
 }
 
@@ -186,9 +252,18 @@ JNIEXPORT void JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_autogradMarkVariables(
   jlong *vars = (*env)->GetLongArrayElements(env, jvars, NULL);
   jlong *grads = (*env)->GetLongArrayElements(env, jgrads, NULL);
   jint *reqs = (*env)->GetIntArrayElements(env, jreqs, NULL);
-  NDArrayHandle vh[64], gh[64];
-  mx_uint rq[64];
-  for (jsize i = 0; i < n && i < 64; ++i) {
+  size_t cap = (size_t)n;
+  NDArrayHandle *vh = (NDArrayHandle *)jmalloc(env, sizeof(NDArrayHandle) * cap);
+  NDArrayHandle *gh = (NDArrayHandle *)jmalloc(env, sizeof(NDArrayHandle) * cap);
+  mx_uint *rq = (mx_uint *)jmalloc(env, sizeof(mx_uint) * cap);
+  if (vh == NULL || gh == NULL || rq == NULL) {
+    (*env)->ReleaseLongArrayElements(env, jvars, vars, JNI_ABORT);
+    (*env)->ReleaseLongArrayElements(env, jgrads, grads, JNI_ABORT);
+    (*env)->ReleaseIntArrayElements(env, jreqs, reqs, JNI_ABORT);
+    free(vh); free(gh); free(rq);
+    return;
+  }
+  for (jsize i = 0; i < n; ++i) {
     vh[i] = (NDArrayHandle)(intptr_t)vars[i];
     gh[i] = (NDArrayHandle)(intptr_t)grads[i];
     rq[i] = (mx_uint)reqs[i];
@@ -196,7 +271,11 @@ JNIEXPORT void JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_autogradMarkVariables(
   (*env)->ReleaseLongArrayElements(env, jvars, vars, JNI_ABORT);
   (*env)->ReleaseLongArrayElements(env, jgrads, grads, JNI_ABORT);
   (*env)->ReleaseIntArrayElements(env, jreqs, reqs, JNI_ABORT);
-  JCHECK(MXAutogradMarkVariables((mx_uint)n, vh, rq, gh), );
+  int rc = MXAutogradMarkVariables((mx_uint)n, vh, rq, gh);
+  free(vh);
+  free(gh);
+  free(rq);
+  if (rc != 0) throw_mx(env, "MXAutogradMarkVariables");
 }
 
 JNIEXPORT void JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_autogradBackward(
@@ -204,12 +283,19 @@ JNIEXPORT void JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_autogradBackward(
   (void)cls;
   jsize n = (*env)->GetArrayLength(env, jouts);
   jlong *outs = (*env)->GetLongArrayElements(env, jouts, NULL);
-  NDArrayHandle oh[16];
-  for (jsize i = 0; i < n && i < 16; ++i) {
+  NDArrayHandle *oh =
+      (NDArrayHandle *)jmalloc(env, sizeof(NDArrayHandle) * (size_t)n);
+  if (oh == NULL) {
+    (*env)->ReleaseLongArrayElements(env, jouts, outs, JNI_ABORT);
+    return;
+  }
+  for (jsize i = 0; i < n; ++i) {
     oh[i] = (NDArrayHandle)(intptr_t)outs[i];
   }
   (*env)->ReleaseLongArrayElements(env, jouts, outs, JNI_ABORT);
-  JCHECK(MXAutogradBackward((mx_uint)n, oh, NULL, 0), );
+  int rc = MXAutogradBackward((mx_uint)n, oh, NULL, 0);
+  free(oh);
+  if (rc != 0) throw_mx(env, "MXAutogradBackward");
 }
 
 JNIEXPORT jlong JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_ndarrayGetGrad(
@@ -257,22 +343,41 @@ JNIEXPORT jlong JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_executorSimpleBind(
   (void)cls;
   const char *req = (*env)->GetStringUTFChars(env, jreq, NULL);
   jsize n = (*env)->GetArrayLength(env, jnames);
-  const char *names[16];
-  fill_cstrings(env, jnames, names, n);
-  mx_uint indptr[17], shapes[64], pos = 0;
+  const char **names = alloc_cstrings(env, jnames, n);
+  if (names == NULL) {
+    (*env)->ReleaseStringUTFChars(env, jreq, req);
+    return 0;
+  }
+  /* two passes: count total dims, then fill exact-size heap arrays */
+  size_t total = 0;
+  for (jsize i = 0; i < n; ++i) {
+    jintArray row = (jintArray)(*env)->GetObjectArrayElement(env, jshapes, i);
+    total += (size_t)(*env)->GetArrayLength(env, row);
+  }
+  mx_uint *indptr = (mx_uint *)jmalloc(env, sizeof(mx_uint) * ((size_t)n + 1));
+  mx_uint *shapes = (mx_uint *)jmalloc(env, sizeof(mx_uint) * total);
+  if (indptr == NULL || shapes == NULL) {
+    free(indptr); free(shapes);
+    free_cstrings(env, jnames, names, n);
+    (*env)->ReleaseStringUTFChars(env, jreq, req);
+    return 0;
+  }
+  mx_uint pos = 0;
   indptr[0] = 0;
-  for (jsize i = 0; i < n && i < 16; ++i) {
+  for (jsize i = 0; i < n; ++i) {
     jintArray row = (jintArray)(*env)->GetObjectArrayElement(env, jshapes, i);
     jsize nd = (*env)->GetArrayLength(env, row);
     jint *dims = (*env)->GetIntArrayElements(env, row, NULL);
-    for (jsize j = 0; j < nd && pos < 64; ++j) shapes[pos++] = (mx_uint)dims[j];
+    for (jsize j = 0; j < nd; ++j) shapes[pos++] = (mx_uint)dims[j];
     (*env)->ReleaseIntArrayElements(env, row, dims, JNI_ABORT);
     indptr[i + 1] = pos;
   }
   ExecutorHandle exec;
   int rc = MXExecutorSimpleBind((SymbolHandle)(intptr_t)sym, 1, 0, req,
                                 (mx_uint)n, names, indptr, shapes, &exec);
-  release_cstrings(env, jnames, names, n);
+  free(indptr);
+  free(shapes);
+  free_cstrings(env, jnames, names, n);
   (*env)->ReleaseStringUTFChars(env, jreq, req);
   if (rc != 0) {
     throw_mx(env, "MXExecutorSimpleBind");
@@ -395,13 +500,12 @@ JNIEXPORT jlong JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_dataIterCreate(
   (void)cls;
   const char *name = (*env)->GetStringUTFChars(env, jname, NULL);
   jsize np = (*env)->GetArrayLength(env, jkeys);
-  const char *keys[32], *vals[32];
-  fill_cstrings(env, jkeys, keys, np);
-  fill_cstrings(env, jvals, vals, np);
+  const char **keys = alloc_cstrings(env, jkeys, np);
+  const char **vals = alloc_cstrings(env, jvals, np);
   DataIterHandle h;
   int rc = MXDataIterCreateIter(name, (mx_uint)np, keys, vals, &h);
-  release_cstrings(env, jkeys, keys, np);
-  release_cstrings(env, jvals, vals, np);
+  free_cstrings(env, jkeys, keys, np);
+  free_cstrings(env, jvals, vals, np);
   (*env)->ReleaseStringUTFChars(env, jname, name);
   if (rc != 0) {
     throw_mx(env, "MXDataIterCreateIter");

@@ -372,6 +372,12 @@ int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype) {
                              Py_BuildValue("(l)", HandleToId(handle)));
   if (res == nullptr) return -1;
   const char *name = PyUnicode_AsUTF8(res);
+  if (name == nullptr) {  /* bridge returned a non-str */
+    PyErr_Clear();
+    g_last_error = "MXNDArrayGetDType: dtype bridge returned non-string";
+    Py_DECREF(res);
+    return -1;
+  }
   /* reverse of MXNDArrayCreate's kDtype table (mshadow enum order) */
   static const char *kDtype[] = {"float32", "float64", "float16", "uint8",
                                  "int32", "int8", "int64", "bfloat16"};
@@ -382,11 +388,13 @@ int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype) {
       break;
     }
   }
-  Py_DECREF(res);
   if (code < 0) {
+    /* copy before DECREF: `name` points into `res`'s utf8 buffer */
     g_last_error = std::string("MXNDArrayGetDType: unknown dtype ") + name;
+    Py_DECREF(res);
     return -1;
   }
+  Py_DECREF(res);
   *out_dtype = code;
   return 0;
 }
