@@ -507,3 +507,247 @@ def random_seed(seed):
 def version():
     from .libinfo import __version__
     return str(__version__)
+
+
+# ------------------------------------------------------------- CachedOp
+# Reference group: MXCreateCachedOp/MXInvokeCachedOp/MXFreeCachedOp
+# (include/mxnet/c_api.h:764-790, src/c_api/c_api_ndarray.cc:633-738) — a
+# symbol cached for fast repeated imperative invocation (Gluon hybridize's
+# engine). TPU-native: one bound executor per input-signature; repeat
+# invokes update the bound arrays in place so the jitted XLA program is
+# reused without retracing.
+class _CCachedOp:
+    def __init__(self, sym):
+        self.sym = sym
+        self.arg_names = sym.list_arguments()
+        self.aux_names = sym.list_auxiliary_states()
+        self._execs = {}
+
+    def invoke(self, arrays):
+        from .base import MXNetError
+        n_args, n_aux = len(self.arg_names), len(self.aux_names)
+        if len(arrays) != n_args + n_aux:
+            raise MXNetError(
+                "CachedOp expects %d inputs (%d args + %d aux), got %d"
+                % (n_args + n_aux, n_args, n_aux, len(arrays)))
+        key = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+        exe = self._execs.get(key)
+        if exe is None:
+            # bind PRIVATE arrays: binding the caller's NDArrays would let
+            # later invokes mutate earlier handles behind the caller's back
+            from .context import current_context
+            from .ndarray import NDArray
+            args = {n: NDArray(a._data, a.context)
+                    for n, a in zip(self.arg_names, arrays[:n_args])}
+            aux = {n: NDArray(a._data, a.context)
+                   for n, a in zip(self.aux_names, arrays[n_args:])}
+            exe = self.sym.bind(current_context(), args, grad_req="null",
+                                aux_states=aux)
+            self._execs[key] = exe
+        for name, arr in zip(self.arg_names, arrays[:n_args]):
+            exe.arg_dict[name]._data = arr._data
+        for name, arr in zip(self.aux_names, arrays[n_args:]):
+            exe.aux_dict[name]._data = arr._data
+        exe.forward(is_train=False)
+        return exe.outputs
+
+
+def cached_op_create(sym_h):
+    return _register(_CCachedOp(_get(sym_h)))
+
+
+def cached_op_invoke(h, in_handles):
+    op = _get(h)
+    outs = op.invoke([_get(x) for x in in_handles])
+    return [_register(o) for o in outs]
+
+
+# ------------------------------------------------------------- Profiler
+# Reference group: MXSetProfilerConfig/MXSetProfilerState/MXDumpProfile
+# (include/mxnet/c_api.h:215-239, src/engine/profiler.cc:152).
+def profiler_set_config(mode, filename):
+    from . import profiler as prof
+    prof.profiler_set_config(mode={0: "symbolic", 1: "all"}.get(int(mode),
+                                                                "symbolic"),
+                             filename=str(filename))
+    return 0
+
+
+def profiler_set_state(state):
+    from . import profiler as prof
+    prof.profiler_set_state({0: "stop", 1: "run"}.get(int(state), "stop"))
+    return 0
+
+
+def profiler_dump():
+    from . import profiler as prof
+    prof.dump_profile()
+    return 0
+
+
+# ------------------------------------------------------------- BindEX
+def executor_bind_ex(sym_h, dev_type, dev_id, arg_hs, grad_hs, reqs,
+                     aux_hs):
+    """Full bind with caller-provided arrays (reference MXExecutorBindEX,
+    include/mxnet/c_api.h:1337): in_args/arg_grads/aux positional over
+    list_arguments()/list_auxiliary_states(); grad handle 0 => no grad
+    storage for that arg; req codes 0=null 1=write 2=add
+    (include/mxnet/op_attr_types.h:44-59)."""
+    from .base import MXNetError
+    sym = _get(sym_h)
+    arg_names = sym.list_arguments()
+    aux_names = sym.list_auxiliary_states()
+    args = dict(zip(arg_names, (_get(h) for h in arg_hs)))
+    # include/mxnet/op_attr_types.h:44-59: 0=kNullOp 1=kWriteTo 2=kAddTo
+    # (3=kWriteInplace is executor-internal in the reference; rejected)
+    req_names = {0: "null", 1: "write", 2: "add"}
+    grads = {}
+    req_map = {}
+    for name, gh, rq in zip(arg_names, grad_hs, reqs):
+        if int(rq) not in req_names:
+            raise MXNetError("BindEX: bad grad_req code %d for '%s' "
+                             "(0=null 1=write 2=add)" % (int(rq), name))
+        req_map[name] = req_names[int(rq)]
+        if int(gh) != 0:
+            grads[name] = _get(gh)
+    aux = dict(zip(aux_names, (_get(h) for h in aux_hs)))
+    exe = sym.bind(_ctx(dev_type, dev_id), args, args_grad=grads,
+                   grad_req=req_map, aux_states=aux)
+    return _register(exe)
+
+
+def executor_reshape(exec_h, partial_shaping, allow_up_sizing, names,
+                     shapes):
+    """New executor with new input shapes sharing the old one's parameter
+    arrays (reference MXExecutorReshape, include/mxnet/c_api.h:1399)."""
+    exe = _get(exec_h)
+    kw = {str(n): tuple(int(d) for d in s) for n, s in zip(names, shapes)}
+    new = exe.reshape(partial_shaping=bool(partial_shaping),
+                      allow_up_sizing=bool(allow_up_sizing), **kw)
+    return _register(new)
+
+
+# ------------------------------------------------------------- C custom op
+# Reference: MXCustomOpRegister (include/mxnet/c_api.h:1906,
+# src/operator/custom/custom.cc:45-253) lets a C client register an op the
+# graph can call. The reference protocol is an MXCallbackList of enum-tagged
+# function pointers; here the C side fills an MXTPUCustomOpInfo struct
+# (src/capi/c_api.h) and the op body runs as the same host-callback path as
+# Python custom ops (ops/custom.py jax.pure_callback), float32 buffers.
+def custom_op_register_c(op_type, info_addr):
+    import ctypes
+
+    from . import operator as _operator
+
+    c_uint = ctypes.c_uint
+    PU = ctypes.POINTER(c_uint)
+    PPU = ctypes.POINTER(PU)
+    PF = ctypes.POINTER(ctypes.c_float)
+    PPF = ctypes.POINTER(PF)
+    INFER = ctypes.CFUNCTYPE(ctypes.c_int, c_uint, PU, PPU, c_uint, PU, PU,
+                             ctypes.c_void_p)
+    FWD = ctypes.CFUNCTYPE(ctypes.c_int, c_uint, PPF, PU, PPU, c_uint, PPF,
+                           ctypes.c_void_p)
+    BWD = ctypes.CFUNCTYPE(ctypes.c_int, c_uint, PPF, c_uint, PPF, PU, PPU,
+                           PPF, ctypes.c_void_p)
+
+    class _CInfo(ctypes.Structure):
+        _fields_ = [("num_inputs", c_uint), ("num_outputs", c_uint),
+                    ("infer_shape", ctypes.c_void_p),
+                    ("forward", ctypes.c_void_p),
+                    ("backward", ctypes.c_void_p),
+                    ("user", ctypes.c_void_p)]
+
+    info = _CInfo.from_address(int(info_addr))
+    n_in, n_out = int(info.num_inputs), int(info.num_outputs)
+    infer_fp = INFER(info.infer_shape) if info.infer_shape else None
+    fwd_fp = FWD(info.forward) if info.forward else None
+    bwd_fp = BWD(info.backward) if info.backward else None
+    user = ctypes.c_void_p(info.user)
+
+    def _shape_args(shapes):
+        """(ndims array, shape-ptr array) for const mx_uint*/mx_uint**."""
+        ndims = (c_uint * len(shapes))(*(len(s) for s in shapes))
+        rows = [(c_uint * len(s))(*s) for s in shapes]
+        ptrs = (PU * len(shapes))(*(ctypes.cast(r, PU) for r in rows))
+        return ndims, ptrs, rows
+
+    def _float_ptrs(arrays):
+        ptrs = (PF * len(arrays))(
+            *(a.ctypes.data_as(PF) for a in arrays))
+        return ptrs
+
+    class _COp(_operator.CustomOp):
+        def __init__(self, in_shapes):
+            self._in_shapes = [tuple(int(d) for d in s) for s in in_shapes]
+
+        def forward(self, is_train, req, in_data, out_data, aux):
+            ins = [_np.ascontiguousarray(x.asnumpy(), dtype=_np.float32)
+                   for x in in_data]
+            outs = [_np.zeros(o.shape, _np.float32) for o in out_data]
+            ndims, sptrs, _keep = _shape_args([x.shape for x in ins])
+            rc = fwd_fp(c_uint(len(ins)), _float_ptrs(ins), ndims, sptrs,
+                        c_uint(len(outs)), _float_ptrs(outs), user)
+            if rc != 0:
+                from .base import MXNetError
+                raise MXNetError("%s: C forward returned %d" % (op_type, rc))
+            for dst, r, src in zip(out_data, req, outs):
+                self.assign(dst, r, src)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            if bwd_fp is None:
+                for dst, r in zip(in_grad, req):
+                    self.assign(dst, r, _np.zeros(dst.shape, _np.float32))
+                return
+            ograds = [_np.ascontiguousarray(g.asnumpy(), dtype=_np.float32)
+                      for g in out_grad]
+            ins = [_np.ascontiguousarray(x.asnumpy(), dtype=_np.float32)
+                   for x in in_data]
+            igrads = [_np.zeros(x.shape, _np.float32) for x in ins]
+            ndims, sptrs, _keep = _shape_args([x.shape for x in ins])
+            rc = bwd_fp(c_uint(len(ograds)), _float_ptrs(ograds),
+                        c_uint(len(ins)), _float_ptrs(ins), ndims, sptrs,
+                        _float_ptrs(igrads), user)
+            if rc != 0:
+                from .base import MXNetError
+                raise MXNetError("%s: C backward returned %d" % (op_type, rc))
+            for dst, r, src in zip(in_grad, req, igrads):
+                self.assign(dst, r, src)
+
+    class _CProp(_operator.CustomOpProp):
+        def __init__(self, **kwargs):
+            super().__init__(need_top_grad=True)
+
+        def list_arguments(self):
+            return ["data%d" % i for i in range(n_in)]
+
+        def list_outputs(self):
+            return ["output%d" % i for i in range(n_out)]
+
+        def infer_shape(self, in_shape):
+            if infer_fp is None:
+                return in_shape, [list(in_shape[0])] * n_out, []
+            ndims, sptrs, _keep = _shape_args(
+                [tuple(int(d) for d in s) for s in in_shape])
+            outs = []
+            for j in range(n_out):
+                ond = c_uint(0)
+                dims = (c_uint * 8)()
+                rc = infer_fp(c_uint(len(in_shape)), ndims, sptrs,
+                              c_uint(j), ctypes.byref(ond),
+                              ctypes.cast(dims, PU), user)
+                if rc != 0:
+                    from .base import MXNetError
+                    raise MXNetError("%s: C infer_shape returned %d"
+                                     % (op_type, rc))
+                outs.append([int(dims[i]) for i in range(ond.value)])
+            return in_shape, outs, []
+
+        def infer_type(self, in_type):
+            return in_type, [_np.float32] * n_out, []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            return _COp(in_shapes)
+
+    _operator._REGISTRY[str(op_type)] = _CProp
+    return 0

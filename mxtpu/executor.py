@@ -147,6 +147,52 @@ def _trace_graph(symbol, is_train, placements=None, remat_tags=None):
     return run
 
 
+def eager_run_range(symbol, env, aux_updates, start, stop, is_train,
+                    raw_args, raw_aux, rng, topo=None, trace_hook=None,
+                    output_hook=None):
+    """Execute topo nodes ``[start, stop)`` eagerly into ``env`` — the one
+    node-at-a-time walk shared by the profiled/monitored forward and the
+    predict API's PartialForward stepping (reference
+    GraphExecutor::PartialForward, src/executor/graph_executor.cc:86).
+
+    ``trace_hook(node, fn)`` wraps the op call (profiling spans);
+    ``output_hook(node, n_vis, outs)`` observes visible outputs (monitor).
+    Aux-state updates (e.g. BN running stats in train mode) accumulate
+    into ``aux_updates`` keyed by the feeding aux variable's name."""
+    topo = topo if topo is not None else symbol._topo()
+    node_index = {id(n): i for i, n in enumerate(topo)}
+    aux_nodes = symbol._aux_node_set()
+    for node in topo[start:stop]:
+        if node.is_variable:
+            src = raw_aux if id(node) in aux_nodes else raw_args
+            env[(id(node), 0)] = src[node.name]
+            continue
+        attrs = node.parsed_attrs()
+        if "__is_train__" in node.op.attrs_spec:
+            attrs = type(attrs)(attrs)
+            attrs["__is_train__"] = is_train
+        ins = [env[(id(s), i)] for s, i in node.inputs]
+        key = jax.random.fold_in(rng, node_index[id(node)]) \
+            if node.op.needs_rng else None
+
+        def call(node=node, attrs=attrs, ins=ins, key=key):
+            return node.op.trace(attrs, ins, rng=key)
+
+        outs = trace_hook(node, call) if trace_hook else call()
+        n_vis = node.op.n_out(attrs)
+        if output_hook is not None:
+            output_hook(node, n_vis, outs)
+        for i in range(n_vis):
+            env[(id(node), i)] = outs[i]
+        if node.op.aux_names and len(outs) > n_vis:
+            names = node.op.input_names(attrs, n=len(node.inputs))
+            for j, an in enumerate(node.op.aux_names):
+                idx = names.index(an)
+                src = node.inputs[idx][0]
+                if src.is_variable:
+                    aux_updates[src.name] = outs[n_vis + j]
+
+
 class Executor:
     """Bound computation (one device context per executor, like the reference)."""
 
@@ -289,42 +335,27 @@ class Executor:
                     getattr(self._monitor_callback, "is_active",
                             lambda: True)())
         topo = self._symbol._topo()
-        node_index = {id(n): i for i, n in enumerate(topo)}
-        aux_nodes = self._symbol._aux_node_set()
         env = {}
         aux_updates = {}
         import time as _time
-        for node in topo:
-            if node.is_variable:
-                src = raw_aux if id(node) in aux_nodes else raw_args
-                env[(id(node), 0)] = src[node.name]
-                continue
-            attrs = node.parsed_attrs()
-            if "__is_train__" in node.op.attrs_spec:
-                attrs = type(attrs)(attrs)
-                attrs["__is_train__"] = is_train
-            ins = [env[(id(n), i)] for n, i in node.inputs]
-            key = jax.random.fold_in(rng, node_index[id(node)]) \
-                if node.op.needs_rng else None
+
+        def trace_hook(node, call):
             t0 = _time.perf_counter() * 1e6
-            outs = node.op.trace(attrs, ins, rng=key)
+            outs = call()
             jax.block_until_ready(outs)
             _prof.record_span(node.name or node.op.name,
                               t0, _time.perf_counter() * 1e6,
                               category=node.op.name)
-            n_vis = node.op.n_out(attrs)
+            return outs
+
+        def output_hook(node, n_vis, outs):
             if mon_live:
                 for i, oname in enumerate(_output_names(node, n_vis)):
                     self._monitor_callback(oname, NDArray(outs[i], self._ctx))
-            for i in range(n_vis):
-                env[(id(node), i)] = outs[i]
-            if node.op.aux_names and len(outs) > n_vis:
-                names = node.op.input_names(attrs, n=len(node.inputs))
-                for j, an in enumerate(node.op.aux_names):
-                    idx = names.index(an)
-                    src = node.inputs[idx][0]
-                    if src.is_variable:
-                        aux_updates[src.name] = outs[n_vis + j]
+
+        eager_run_range(self._symbol, env, aux_updates, 0, len(topo),
+                        is_train, raw_args, raw_aux, rng, topo=topo,
+                        trace_hook=trace_hook, output_hook=output_hook)
         outs = [env[(id(n), i)] for n, i in self._symbol._outputs]
         return outs, aux_updates
 

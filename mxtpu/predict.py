@@ -27,14 +27,29 @@ class Predictor:
 
     def __init__(self, symbol_json_str, param_bytes_or_dict, ctx=None,
                  input_shapes=None, dev_type=None, dev_id=0,
-                 output_index=None):
+                 output_index=None, output_names=None):
         if input_shapes is None:
             raise MXNetError("Predictor requires input_shapes")
         self._ctx = ctx or cpu()
         symbol = sym_mod.load_json(symbol_json_str) \
             if isinstance(symbol_json_str, str) else symbol_json_str
-        if output_index is not None:
-            # MXPredCreatePartialOut contract: predict an internal output
+        if output_names:
+            # MXPredCreatePartialOut contract (c_predict_api.h:110): keep
+            # only the named heads — internal layers allowed, the feature-
+            # extraction workflow. Accepts both "fc1" and "fc1_output".
+            internals = symbol.get_internals()
+            inames = internals.list_outputs()
+            heads = []
+            for want in output_names:
+                cand = [i for i, n in enumerate(inames)
+                        if n == want or n == str(want) + "_output"]
+                if not cand:
+                    raise MXNetError(
+                        "PartialOut: no internal output named '%s'" % want)
+                heads.append(internals[cand[-1]])
+            symbol = heads[0] if len(heads) == 1 else sym_mod.Group(heads)
+        elif output_index is not None:
+            # older single-index form of the same contract
             symbol = symbol.get_internals()[int(output_index)]
         self._symbol = symbol
         if isinstance(param_bytes_or_dict, (bytes, bytearray)):
@@ -99,6 +114,43 @@ class Predictor:
             self.set_input(k, v)
         self._executor.forward(is_train=False)
 
+    def partial_forward(self, step):
+        """MXPredPartialForward (c_predict_api.h:169): run the graph up to
+        topo node ``step`` and return how many nodes remain — the stepping
+        inspection workflow (reference GraphExecutor::PartialForward,
+        src/executor/graph_executor.cc:86). Nodes run eagerly one at a
+        time (no whole-graph XLA program), resuming from the previous
+        call's position; stepping backwards restarts from node 0. Outputs
+        are valid once 0 is returned."""
+        from . import random as _rnd
+        from .executor import eager_run_range
+        ex = self._executor
+        topo = ex._symbol._topo()
+        n = len(topo)
+        stop = max(0, min(int(step), n))
+        if not hasattr(self, "_pdone") or stop < self._pdone:
+            self._pdone = 0
+            self._penv = {}
+            self._prng = _rnd.next_key()
+        eager_run_range(ex._symbol, self._penv, {}, self._pdone, stop,
+                        False, ex._raw_args(), ex._raw_aux(), self._prng,
+                        topo=topo)
+        self._pdone = stop
+        if stop == n:
+            ex._wrap_outputs(
+                [self._penv[(id(s), i)] for s, i in ex._symbol._outputs])
+            # release the intermediate activations: only the outputs are
+            # needed once the walk completes, and on a big CNN the env
+            # pins every layer's tensors
+            self._penv = {}
+            self._pdone = 0
+        return n - stop
+
+    @property
+    def num_steps(self):
+        """Total partial-forward steps (graph topo length)."""
+        return len(self._executor._symbol._topo())
+
     def get_output(self, index=0):
         """MXPredGetOutput -> numpy."""
         return self._executor.outputs[index].asnumpy()
@@ -115,6 +167,17 @@ class Predictor:
         weights are reused)."""
         self._input_shapes.update(new_input_shapes)
         self._bind()
+
+    def reshaped(self, new_input_shapes):
+        """MXPredReshape's C contract: a NEW predictor with the new input
+        shapes sharing this one's weight arrays; this predictor stays
+        bound to its original shapes."""
+        shapes = dict(self._input_shapes)
+        shapes.update(new_input_shapes)
+        params = {"arg:%s" % k: v for k, v in self._arg_params.items()}
+        params.update({"aux:%s" % k: v for k, v in self._aux_params.items()})
+        return Predictor(self._symbol, params, ctx=self._ctx,
+                         input_shapes=shapes)
 
 
 def create(symbol_file, param_file, input_shapes, ctx=None):

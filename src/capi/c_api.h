@@ -24,6 +24,7 @@ typedef void *ExecutorHandle;
 typedef void *KVStoreHandle;
 typedef void *DataIterHandle;
 typedef void *RecordIOHandle;
+typedef void *CachedOpHandle;
 
 const char *MXGetLastError(void);
 
@@ -97,6 +98,27 @@ int MXSymbolListOutputs(SymbolHandle sym, mx_uint *out_size,
 int MXSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint *out_size,
                                 const char ***out_array);
 
+/* ---------------- CachedOp ----------------
+ * Reference group: MXCreateCachedOp / MXInvokeCachedOp / MXFreeCachedOp
+ * (include/mxnet/c_api.h:764-790) — cache a symbol for fast repeated
+ * imperative invocation (the engine behind Gluon hybridize). Inputs are
+ * the symbol's arguments then auxiliary states, in list order; outputs
+ * arrive in the per-thread handle arena (own them with MXNDArrayFree). */
+int MXCreateCachedOp(SymbolHandle sym, CachedOpHandle *out);
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                     NDArrayHandle *inputs, int *num_outputs,
+                     NDArrayHandle **outputs);
+int MXFreeCachedOp(CachedOpHandle handle);
+
+/* ---------------- Profiler ----------------
+ * Reference group: MXSetProfilerConfig / MXSetProfilerState /
+ * MXDumpProfile (include/mxnet/c_api.h:215-239). mode: 0 = symbolic ops
+ * only, 1 = all ops; state: 0 = stop, 1 = run. Dump writes the
+ * chrome://tracing JSON to the configured filename. */
+int MXSetProfilerConfig(int mode, const char *filename);
+int MXSetProfilerState(int state);
+int MXDumpProfile(void);
+
 /* ---------------- Executor ---------------- */
 /* simple-bind with explicit input shapes; every other argument is
  * allocated and initialized to zeros (fill via MXExecutorArg +
@@ -106,6 +128,23 @@ int MXExecutorSimpleBind(SymbolHandle sym, int dev_type, int dev_id,
                          const char **input_names,
                          const mx_uint *shape_indptr,
                          const mx_uint *shape_data, ExecutorHandle *out);
+/* Full bind with caller-provided arrays (reference MXExecutorBindEX,
+ * include/mxnet/c_api.h:1337): in_args positional over list_arguments(),
+ * aux_states over list_auxiliary_states(); arg_grad_store[i] = NULL for
+ * no gradient storage; grad_req_type codes 0=null 1=write 2=add
+ * (include/mxnet/op_attr_types.h:44-59). Gradients accumulate into the
+ * caller's arrays on MXExecutorBackward. */
+int MXExecutorBindEX(SymbolHandle sym, int dev_type, int dev_id,
+                     mx_uint len, NDArrayHandle *in_args,
+                     NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                     mx_uint aux_states_len, NDArrayHandle *aux_states,
+                     ExecutorHandle shared_exec, ExecutorHandle *out);
+/* New executor with new input shapes sharing the old executor's parameter
+ * arrays (reference MXExecutorReshape, include/mxnet/c_api.h:1399). */
+int MXExecutorReshape(int partial_shaping, int allow_up_sizing,
+                      ExecutorHandle shared_exec, mx_uint num_inputs,
+                      const char **input_names, const mx_uint *shape_indptr,
+                      const mx_uint *shape_data, ExecutorHandle *out);
 int MXExecutorForward(ExecutorHandle exec, int is_train);
 int MXExecutorBackward(ExecutorHandle exec);
 int MXExecutorOutputs(ExecutorHandle exec, mx_uint *out_size);
@@ -161,6 +200,38 @@ int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out);
 int MXRecordIOReaderReadRecord(RecordIOHandle handle, const char **out_buf,
                                uint64_t *out_size);
 int MXRecordIOReaderFree(RecordIOHandle handle);
+
+/* ---------------- C custom ops ----------------
+ * Reference: MXCustomOpRegister (include/mxnet/c_api.h:1906,
+ * src/operator/custom/custom.cc) — register an operator whose body is C
+ * code; graphs then instantiate it as Custom(op_type=<name>) from any
+ * frontend, including MXImperativeInvoke and symbol composition, with
+ * autograd support. The reference's MXCallbackList protocol is replaced
+ * by this explicit struct (same capability, simpler ABI); bodies run as
+ * host callbacks on float32 buffers.
+ *
+ * infer_shape: fill out_ndim/out_shape (cap 8 dims) for output out_index
+ *   given the input shapes; NULL => every output takes input 0's shape.
+ * forward: read num_in flat float32 input buffers, write num_out output
+ *   buffers (pre-allocated to the inferred shapes).
+ * backward: read output cotangents + inputs, write input gradients;
+ *   NULL => zero gradients.
+ * Every callback returns 0 on success. `user` is passed through. */
+typedef struct MXTPUCustomOpInfo {
+  mx_uint num_inputs;
+  mx_uint num_outputs;
+  int (*infer_shape)(mx_uint num_in, const mx_uint *in_ndims,
+                     const mx_uint **in_shapes, mx_uint out_index,
+                     mx_uint *out_ndim, mx_uint *out_shape, void *user);
+  int (*forward)(mx_uint num_in, const float **in_data,
+                 const mx_uint *in_ndims, const mx_uint **in_shapes,
+                 mx_uint num_out, float **out_data, void *user);
+  int (*backward)(mx_uint num_out, const float **out_grads, mx_uint num_in,
+                  const float **in_data, const mx_uint *in_ndims,
+                  const mx_uint **in_shapes, float **in_grads, void *user);
+  void *user;
+} MXTPUCustomOpInfo;
+int MXCustomOpRegister(const char *op_type, const MXTPUCustomOpInfo *info);
 
 /* ---------------- KVStore ---------------- */
 int MXKVStoreCreate(const char *type, KVStoreHandle *out);

@@ -909,3 +909,156 @@ int MXRecordIOReaderFree(RecordIOHandle handle) {
 }
 
 }  /* extern "C" */
+
+/* ---------------- CachedOp ---------------- */
+
+int MXCreateCachedOp(SymbolHandle sym, CachedOpHandle *out) {
+  GilGuard gil;
+  PyObject *res =
+      CallBridge("cached_op_create", Py_BuildValue("(l)", HandleToId(sym)));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                     NDArrayHandle *inputs, int *num_outputs,
+                     NDArrayHandle **outputs) {
+  GilGuard gil;
+  PyObject *ins = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    PyList_SetItem(ins, i, PyLong_FromLong(HandleToId(inputs[i])));
+  }
+  PyObject *res = CallBridge(
+      "cached_op_invoke", Py_BuildValue("(lN)", HandleToId(handle), ins));
+  if (res == nullptr) return -1;
+  g_handle_arena.clear();
+  Py_ssize_t n = PyList_Size(res);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    g_handle_arena.push_back(reinterpret_cast<void *>(
+        static_cast<intptr_t>(PyLong_AsLong(PyList_GetItem(res, i)))));
+  }
+  Py_DECREF(res);
+  *num_outputs = static_cast<int>(n);
+  *outputs = g_handle_arena.data();
+  return 0;
+}
+
+int MXFreeCachedOp(CachedOpHandle handle) {
+  GilGuard gil;
+  PyObject *res = CallBridge("free", Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+/* ---------------- Profiler ---------------- */
+
+int MXSetProfilerConfig(int mode, const char *filename) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res =
+      CallBridge("profiler_set_config", Py_BuildValue("(is)", mode, filename));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSetProfilerState(int state) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res =
+      CallBridge("profiler_set_state", Py_BuildValue("(i)", state));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXDumpProfile(void) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge("profiler_dump", PyTuple_New(0));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+/* ---------------- BindEX / Reshape ---------------- */
+
+int MXExecutorBindEX(SymbolHandle sym, int dev_type, int dev_id,
+                     mx_uint len, NDArrayHandle *in_args,
+                     NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                     mx_uint aux_states_len, NDArrayHandle *aux_states,
+                     ExecutorHandle shared_exec, ExecutorHandle *out) {
+  (void)shared_exec;  /* memory sharing is XLA's job in this runtime */
+  GilGuard gil;
+  PyObject *args = PyList_New(len);
+  PyObject *grads = PyList_New(len);
+  PyObject *reqs = PyList_New(len);
+  for (mx_uint i = 0; i < len; ++i) {
+    PyList_SetItem(args, i, PyLong_FromLong(HandleToId(in_args[i])));
+    PyList_SetItem(grads, i,
+                   PyLong_FromLong(arg_grad_store == nullptr
+                                       ? 0
+                                       : HandleToId(arg_grad_store[i])));
+    PyList_SetItem(reqs, i,
+                   PyLong_FromUnsignedLong(
+                       grad_req_type == nullptr ? 0 : grad_req_type[i]));
+  }
+  PyObject *aux = PyList_New(aux_states_len);
+  for (mx_uint i = 0; i < aux_states_len; ++i) {
+    PyList_SetItem(aux, i, PyLong_FromLong(HandleToId(aux_states[i])));
+  }
+  PyObject *res = CallBridge(
+      "executor_bind_ex",
+      Py_BuildValue("(liiNNNN)", HandleToId(sym), dev_type, dev_id, args,
+                    grads, reqs, aux));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorReshape(int partial_shaping, int allow_up_sizing,
+                      ExecutorHandle shared_exec, mx_uint num_inputs,
+                      const char **input_names, const mx_uint *shape_indptr,
+                      const mx_uint *shape_data, ExecutorHandle *out) {
+  GilGuard gil;
+  PyObject *names = PyList_New(num_inputs);
+  PyObject *shapes = PyList_New(num_inputs);
+  for (mx_uint i = 0; i < num_inputs; ++i) {
+    PyList_SetItem(names, i, PyUnicode_FromString(input_names[i]));
+    const mx_uint lo = shape_indptr[i], hi = shape_indptr[i + 1];
+    PyObject *shp = PyTuple_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j) {
+      PyTuple_SetItem(shp, j - lo, PyLong_FromUnsignedLong(shape_data[j]));
+    }
+    PyList_SetItem(shapes, i, shp);
+  }
+  PyObject *res = CallBridge(
+      "executor_reshape",
+      Py_BuildValue("(liiNN)", HandleToId(shared_exec), partial_shaping,
+                    allow_up_sizing, names, shapes));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+/* ---------------- C custom ops ---------------- */
+
+int MXCustomOpRegister(const char *op_type, const MXTPUCustomOpInfo *info) {
+  EnsurePython();
+  GilGuard gil;
+  /* the bridge copies every field (function pointers + user) into Python
+   * objects during this call, so the caller's struct only needs to live
+   * for the duration of the call */
+  PyObject *res = CallBridge(
+      "custom_op_register_c",
+      Py_BuildValue("(sL)", op_type,
+                    static_cast<long long>(reinterpret_cast<intptr_t>(info))));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}

@@ -72,6 +72,43 @@ extern "C" {
 
 const char *MXGetLastError(void) { return g_last_error.c_str(); }
 
+namespace {
+
+/* Refresh the cached output shapes from the Python predictor (used at
+ * creation and after reshape). Assumes the GIL. */
+void CacheOutShapes(PyObject *pred, Predictor *handle) {
+  handle->out_shapes.clear();
+  PyObject *n_out = PyObject_GetAttrString(pred, "num_outputs");
+  const long n = n_out ? PyLong_AsLong(n_out) : 0;
+  Py_XDECREF(n_out);
+  for (long i = 0; i < n; ++i) {
+    PyObject *shp = PyObject_CallMethod(pred, "get_output_shape", "l", i);
+    std::vector<mx_uint> dims;
+    if (shp != nullptr) {
+      const Py_ssize_t ndim = PySequence_Size(shp);
+      for (Py_ssize_t d = 0; d < ndim; ++d) {
+        PyObject *item = PySequence_GetItem(shp, d);
+        dims.push_back(static_cast<mx_uint>(PyLong_AsLong(item)));
+        Py_DECREF(item);
+      }
+      Py_DECREF(shp);
+    }
+    handle->out_shapes.push_back(std::move(dims));
+  }
+}
+
+/* Shared body of MXPredCreate / MXPredCreatePartialOut: output_keys ==
+ * nullptr means full-graph outputs. Assumes Python is initialized. */
+int CreatePredictorImpl(const char *symbol_json_str, const void *param_bytes,
+                        int param_size, int dev_type,
+                        mx_uint num_input_nodes, const char **input_keys,
+                        const mx_uint *input_shape_indptr,
+                        const mx_uint *input_shape_data,
+                        mx_uint num_output_nodes, const char **output_keys,
+                        PredictorHandle *out);
+
+}  // namespace
+
 int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
                  int param_size, int dev_type, int dev_id,
                  mx_uint num_input_nodes, const char **input_keys,
@@ -79,6 +116,37 @@ int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
                  const mx_uint *input_shape_data, PredictorHandle *out) {
   (void)dev_id;
   EnsurePython();
+  return CreatePredictorImpl(symbol_json_str, param_bytes, param_size,
+                             dev_type, num_input_nodes, input_keys,
+                             input_shape_indptr, input_shape_data, 0, nullptr,
+                             out);
+}
+
+int MXPredCreatePartialOut(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id, mx_uint num_input_nodes,
+                           const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           mx_uint num_output_nodes, const char **output_keys,
+                           PredictorHandle *out) {
+  (void)dev_id;
+  EnsurePython();
+  return CreatePredictorImpl(symbol_json_str, param_bytes, param_size,
+                             dev_type, num_input_nodes, input_keys,
+                             input_shape_indptr, input_shape_data,
+                             num_output_nodes, output_keys, out);
+}
+
+namespace {
+
+int CreatePredictorImpl(const char *symbol_json_str, const void *param_bytes,
+                        int param_size, int dev_type,
+                        mx_uint num_input_nodes, const char **input_keys,
+                        const mx_uint *input_shape_indptr,
+                        const mx_uint *input_shape_data,
+                        mx_uint num_output_nodes, const char **output_keys,
+                        PredictorHandle *out) {
   GilGuard gil;
   PyObject *mod = PyImport_ImportModule("mxtpu.predict");
   if (mod == nullptr) {
@@ -109,6 +177,14 @@ int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
   PyObject *json = PyUnicode_FromString(symbol_json_str);
   PyObject *kwargs = PyDict_New();
   PyDict_SetItemString(kwargs, "input_shapes", shapes);
+  if (output_keys != nullptr && num_output_nodes > 0) {
+    PyObject *outs_list = PyList_New(num_output_nodes);
+    for (mx_uint i = 0; i < num_output_nodes; ++i) {
+      PyList_SetItem(outs_list, i, PyUnicode_FromString(output_keys[i]));
+    }
+    PyDict_SetItemString(kwargs, "output_names", outs_list);
+    Py_DECREF(outs_list);
+  }
   // dev_type 1=cpu keeps default ctx; anything else also uses the default
   // context (tpu when available) — device selection is XLA's job.
   (void)dev_type;
@@ -126,28 +202,12 @@ int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
   }
   auto *handle = new Predictor();
   handle->obj = pred;
-  // cache output shapes
-  PyObject *n_out = PyObject_GetAttrString(pred, "num_outputs");
-  const long n = n_out ? PyLong_AsLong(n_out) : 0;
-  Py_XDECREF(n_out);
-  for (long i = 0; i < n; ++i) {
-    PyObject *shp =
-        PyObject_CallMethod(pred, "get_output_shape", "l", i);
-    std::vector<mx_uint> dims;
-    if (shp != nullptr) {
-      const Py_ssize_t ndim = PySequence_Size(shp);
-      for (Py_ssize_t d = 0; d < ndim; ++d) {
-        PyObject *item = PySequence_GetItem(shp, d);
-        dims.push_back(static_cast<mx_uint>(PyLong_AsLong(item)));
-        Py_DECREF(item);
-      }
-      Py_DECREF(shp);
-    }
-    handle->out_shapes.push_back(std::move(dims));
-  }
+  CacheOutShapes(pred, handle);
   *out = handle;
   return 0;
 }
+
+}  // namespace
 
 int MXPredGetOutputShape(PredictorHandle h, mx_uint index,
                          mx_uint **shape_data, mx_uint *shape_ndim) {
@@ -208,6 +268,50 @@ int MXPredForward(PredictorHandle h) {
     return -1;
   }
   Py_DECREF(r);
+  return 0;
+}
+
+int MXPredPartialForward(PredictorHandle h, int step, int *step_left) {
+  auto *p = static_cast<Predictor *>(h);
+  GilGuard gil;
+  PyObject *r = PyObject_CallMethod(p->obj, "partial_forward", "i", step);
+  if (r == nullptr) {
+    CapturePyError("partial_forward");
+    return -1;
+  }
+  *step_left = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredReshape(mx_uint num_input_nodes, const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data, PredictorHandle handle,
+                  PredictorHandle *out) {
+  auto *p = static_cast<Predictor *>(handle);
+  GilGuard gil;
+  PyObject *shapes = PyDict_New();
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    const mx_uint lo = input_shape_indptr[i];
+    const mx_uint hi = input_shape_indptr[i + 1];
+    PyObject *shape = PyTuple_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j) {
+      PyTuple_SET_ITEM(shape, j - lo,
+                       PyLong_FromUnsignedLong(input_shape_data[j]));
+    }
+    PyDict_SetItemString(shapes, input_keys[i], shape);
+    Py_DECREF(shape);
+  }
+  PyObject *pred = PyObject_CallMethod(p->obj, "reshaped", "O", shapes);
+  Py_DECREF(shapes);
+  if (pred == nullptr) {
+    CapturePyError("reshaped");
+    return -1;
+  }
+  auto *nh = new Predictor();
+  nh->obj = pred;
+  CacheOutShapes(pred, nh);
+  *out = nh;
   return 0;
 }
 

@@ -38,6 +38,21 @@ int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
                  const mx_uint *input_shape_indptr,
                  const mx_uint *input_shape_data, PredictorHandle *out);
 
+/*
+ * Create a predictor whose outputs are the named heads — internal layer
+ * outputs allowed (feature extraction). Parity:
+ * include/mxnet/c_predict_api.h:110 MXPredCreatePartialOut. output_keys
+ * accept either the layer name ("fc1") or its output name ("fc1_output").
+ */
+int MXPredCreatePartialOut(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id, mx_uint num_input_nodes,
+                           const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           mx_uint num_output_nodes, const char **output_keys,
+                           PredictorHandle *out);
+
 int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
                          mx_uint **shape_data, mx_uint *shape_ndim);
 
@@ -45,6 +60,22 @@ int MXPredSetInput(PredictorHandle handle, const char *key,
                    const mx_float *data, mx_uint size);
 
 int MXPredForward(PredictorHandle handle);
+
+/*
+ * Run the graph up to topo node `step`; *step_left returns how many nodes
+ * remain (0 => outputs are valid). Parity:
+ * include/mxnet/c_predict_api.h:169 MXPredPartialForward.
+ */
+int MXPredPartialForward(PredictorHandle handle, int step, int *step_left);
+
+/*
+ * Rebind the predictor with new input shapes (weights reused; new XLA
+ * executable per shape set). Parity: c_predict_api.h MXPredReshape.
+ */
+int MXPredReshape(mx_uint num_input_nodes, const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data, PredictorHandle handle,
+                  PredictorHandle *out);
 
 int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
                     mx_uint size);

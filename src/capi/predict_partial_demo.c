@@ -1,0 +1,121 @@
+/* Pure-C feature-extraction client: MXPredCreatePartialOut on an internal
+ * layer, MXPredPartialForward stepping, MXPredReshape (reference surface
+ * include/mxnet/c_predict_api.h:110,169). Usage:
+ *   predict_partial_demo <symbol.json> <params.bin> <internal_head_name>
+ * Prints "PARTIAL OK <feat_dim>" on success. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "c_predict_api.h"
+
+#define CHECK(cond, msg)                                     \
+  if (!(cond)) {                                             \
+    fprintf(stderr, "FAIL %s: %s\n", msg, MXGetLastError()); \
+    exit(1);                                                 \
+  }
+
+static char *read_file(const char *path, long *out_sz) {
+  FILE *f = fopen(path, "rb");
+  if (f == NULL) return NULL;
+  fseek(f, 0, SEEK_END);
+  long sz = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char *buf = (char *)malloc(sz + 1);
+  if (fread(buf, 1, sz, f) != (size_t)sz) {
+    fclose(f);
+    free(buf);
+    return NULL;
+  }
+  buf[sz] = 0;
+  fclose(f);
+  *out_sz = sz;
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s <symbol.json> <params.bin> <head>\n", argv[0]);
+    return 2;
+  }
+  long json_sz = 0, param_sz = 0;
+  char *json = read_file(argv[1], &json_sz);
+  CHECK(json != NULL, "read symbol json");
+  char *params = read_file(argv[2], &param_sz);
+  CHECK(params != NULL, "read params");
+
+  enum { BATCH = 2, DIM = 8 };
+  const char *in_keys[1] = {"data"};
+  mx_uint indptr[2] = {0, 2};
+  mx_uint sdata[2] = {BATCH, DIM};
+
+  /* 1. partial-out predictor on the internal feature head */
+  const char *out_keys[1] = {argv[3]};
+  PredictorHandle pred;
+  CHECK(MXPredCreatePartialOut(json, params, (int)param_sz, 1, 0, 1, in_keys,
+                               indptr, sdata, 1, out_keys, &pred) == 0,
+        "PredCreatePartialOut");
+  mx_uint *oshape = NULL, ondim = 0;
+  CHECK(MXPredGetOutputShape(pred, 0, &oshape, &ondim) == 0, "out shape");
+  CHECK(ondim == 2 && oshape[0] == BATCH, "feature head rank/batch");
+  mx_uint feat_dim = oshape[1];
+
+  float input[BATCH * DIM];
+  int i;
+  for (i = 0; i < BATCH * DIM; ++i) input[i] = 0.05f * (float)i;
+  CHECK(MXPredSetInput(pred, "data", input, BATCH * DIM) == 0, "set input");
+  CHECK(MXPredForward(pred) == 0, "forward");
+  float *feats = (float *)malloc(sizeof(float) * BATCH * feat_dim);
+  CHECK(MXPredGetOutput(pred, 0, feats, BATCH * feat_dim) == 0, "get feats");
+  float norm = 0;
+  for (i = 0; i < (int)(BATCH * feat_dim); ++i) norm += feats[i] * feats[i];
+  CHECK(norm > 1e-10, "features nonzero");
+
+  /* 2. full predictor, stepped with MXPredPartialForward */
+  PredictorHandle full;
+  CHECK(MXPredCreate(json, params, (int)param_sz, 1, 0, 1, in_keys, indptr,
+                     sdata, &full) == 0,
+        "PredCreate");
+  CHECK(MXPredSetInput(full, "data", input, BATCH * DIM) == 0, "set input 2");
+  int left = -1, step = 1, guard = 0;
+  do {
+    CHECK(MXPredPartialForward(full, step, &left) == 0, "partial forward");
+    ++step;
+    CHECK(++guard < 10000, "partial forward terminates");
+  } while (left > 0);
+  mx_uint *fshape = NULL, fndim = 0;
+  CHECK(MXPredGetOutputShape(full, 0, &fshape, &fndim) == 0, "full shape");
+  mx_uint out_n = 1;
+  for (i = 0; i < (int)fndim; ++i) out_n *= fshape[i];
+  float *probs = (float *)malloc(sizeof(float) * out_n);
+  CHECK(MXPredGetOutput(full, 0, probs, out_n) == 0, "stepped output");
+  /* softmax rows sum to 1 */
+  float s0 = 0;
+  for (i = 0; i < (int)(out_n / BATCH); ++i) s0 += probs[i];
+  CHECK(s0 > 0.99f && s0 < 1.01f, "stepped softmax row sums to 1");
+
+  /* 3. reshape to a larger batch; original handle stays valid */
+  mx_uint sdata2[2] = {BATCH * 2, DIM};
+  PredictorHandle big;
+  CHECK(MXPredReshape(1, in_keys, indptr, sdata2, full, &big) == 0,
+        "PredReshape");
+  mx_uint *bshape = NULL, bndim = 0;
+  CHECK(MXPredGetOutputShape(big, 0, &bshape, &bndim) == 0, "reshaped shape");
+  CHECK(bshape[0] == BATCH * 2, "reshaped batch");
+  float input2[BATCH * 2 * DIM];
+  for (i = 0; i < BATCH * 2 * DIM; ++i) input2[i] = 0.01f * (float)i;
+  CHECK(MXPredSetInput(big, "data", input2, BATCH * 2 * DIM) == 0,
+        "reshaped input");
+  CHECK(MXPredForward(big) == 0, "reshaped forward");
+  CHECK(MXPredForward(full) == 0, "original handle still forwards");
+
+  MXPredFree(pred);
+  MXPredFree(full);
+  MXPredFree(big);
+  free(feats);
+  free(probs);
+  free(json);
+  free(params);
+  printf("PARTIAL OK %u\n", feat_dim);
+  return 0;
+}
