@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Generate the C++ op surface (include/mxtpu-cpp/op.h) from the live op
+registry — the reference's cpp-package/OpWrapperGenerator.py flow, which
+enumerates ops via MXSymbolGetAtomicSymbolInfo and emits one typed wrapper
+per op (cpp-package/include/mxnet-cpp/op.h pattern).
+
+For every registered op this emits, in namespace mxtpu::cpp::op:
+  * a Symbol-composing wrapper:
+      Symbol <name>(const std::string &symbol_name, <tensor inputs...>,
+                    <required attrs, typed>,
+                    const std::map<std::string, std::string> &kwargs = {})
+    Null Symbols auto-create Variables (weights/bias).
+  * an imperative wrapper on NDArrays returning std::vector<NDArray>.
+Optional attrs travel in the kwargs map (stringly, the dmlc::Parameter
+format the runtime parses anyway).
+
+Run from the repo root:  python cpp-package/OpWrapperGenerator.py
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mxtpu.ops import registry as _registry  # noqa: E402
+from mxtpu.ops.registry import AttrDict, Required  # noqa: E402
+
+OUT = os.path.join(REPO, "cpp-package", "include", "mxtpu-cpp", "op.h")
+
+CPP_KEYWORDS = {
+    "auto", "bool", "break", "case", "catch", "char", "class", "const",
+    "continue", "default", "delete", "do", "double", "else", "enum",
+    "explicit", "export", "extern", "false", "float", "for", "friend",
+    "goto", "if", "inline", "int", "long", "namespace", "new", "operator",
+    "private", "protected", "public", "register", "return", "short",
+    "signed", "sizeof", "static", "struct", "switch", "template", "this",
+    "throw", "true", "try", "typedef", "typeid", "typename", "union",
+    "unsigned", "using", "virtual", "void", "volatile", "while",
+}
+
+
+def cpp_ident(name):
+    """Legal, non-reserved C++ identifier for an op or attr name."""
+    out = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+    while "__" in out:  # double underscore is reserved everywhere
+        out = out.replace("__", "_")
+    if out and out[0] == "_" and len(out) > 1 and out[1].isupper():
+        out = "Op" + out  # _X... is reserved at any scope
+    if out in CPP_KEYWORDS:
+        out += "_"
+    return out
+
+
+def attr_cpp_type(proto_or_default):
+    """C++ parameter type + SetParam-compatible pass style for an attr."""
+    proto = (proto_or_default.proto
+             if isinstance(proto_or_default, Required) else
+             type(proto_or_default)
+             if proto_or_default is not None else None)
+    if proto is bool:
+        return "bool"
+    if proto is int:
+        return "int"
+    if proto is float:
+        return "double"
+    if proto is str:
+        return "const std::string &"
+    if proto in (tuple, list):
+        return "const Shape &"
+    return None  # untyped: kwargs only
+
+
+def op_inputs(op):
+    """Static tensor-input list, or None when it is attr-dependent."""
+    if op.variadic:
+        return None
+    if callable(op.arg_names):
+        try:
+            return list(op.arg_names(AttrDict()))
+        except Exception:
+            return None
+    return list(op.arg_names)
+
+
+def emit_op(name, op):
+    fn = cpp_ident(name)
+    inputs = op_inputs(op)
+    required = [(k, attr_cpp_type(v)) for k, v in op.attrs_spec.items()
+                if isinstance(v, Required) and k != op.variadic]
+    # required attrs whose type we cannot express go through kwargs; the
+    # runtime raises "required attr missing" if the caller omits them
+    typed_req = [(k, t) for k, t in required if t is not None]
+
+    lines = []
+
+    def sig_attrs():
+        parts = []
+        for k, t in typed_req:
+            parts.append("%s %s" % (t, cpp_ident(k)) if t.endswith("&")
+                         else "%s %s" % (t, cpp_ident(k)))
+        parts.append("const std::map<std::string, std::string> &kwargs = {}")
+        return parts
+
+    def body_params(var):
+        b = []
+        for k, t in typed_req:
+            b.append('  %s.SetParam("%s", %s);' % (var, k, cpp_ident(k)))
+        b.append("  for (const auto &kv : kwargs) "
+                 "%s.SetParam(kv.first, kv.second);" % var)
+        return b
+
+    # ---- Symbol wrapper ----
+    if inputs is None:
+        in_sig = ["const std::vector<Symbol> &data"]
+        in_body = ["  for (const auto &s : data) op_.AddInput(s);"]
+    else:
+        in_sig = ["const Symbol &%s" % cpp_ident(n) for n in inputs]
+        in_body = ['  op_.SetInput("%s", %s);' % (n, cpp_ident(n))
+                   for n in inputs]
+    params = ", ".join(["const std::string &symbol_name"] + in_sig +
+                       sig_attrs())
+    lines.append("inline Symbol %s(%s) {" % (fn, params))
+    lines.append('  Operator op_("%s");' % name)
+    lines += body_params("op_")
+    lines += in_body
+    lines.append("  return op_.CreateSymbol(symbol_name);")
+    lines.append("}")
+
+    # ---- imperative wrapper ----
+    if inputs is None:
+        nd_sig = ["const std::vector<NDArray> &data"]
+        nd_body = ["  for (const auto &a : data) op_.AddInput(a);"]
+    else:
+        nd_sig = ["const NDArray &%s" % cpp_ident(n) for n in inputs]
+        nd_body = ["  op_.AddInput(%s);" % cpp_ident(n) for n in inputs]
+    params = ", ".join(nd_sig + sig_attrs())
+    lines.append("inline std::vector<NDArray> %s(%s) {" % (fn, params))
+    lines.append('  Operator op_("%s");' % name)
+    lines += body_params("op_")
+    lines += nd_body
+    lines.append("  return op_.Invoke();")
+    lines.append("}")
+    lines.append("")
+    return lines
+
+
+def main():
+    ops = _registry._OPS
+    # canonical names only: emit each OpDef once under its .name, plus
+    # aliases that produce a distinct C++ identifier
+    seen_idents = set()
+    out = [
+        "/* GENERATED FILE — do not edit. Regenerate with",
+        " *   python cpp-package/OpWrapperGenerator.py",
+        " * One typed wrapper per registered op (the reference's",
+        " * cpp-package/include/mxnet-cpp/op.h surface, generated from the",
+        " * op registry the same way its OpWrapperGenerator.py does). */",
+        "#ifndef MXTPU_CPP_OP_H_",
+        "#define MXTPU_CPP_OP_H_",
+        "",
+        "#include <map>",
+        "#include <string>",
+        "#include <vector>",
+        "",
+        '#include "operator.h"',
+        "",
+        "namespace mxtpu {",
+        "namespace cpp {",
+        "namespace op {",
+        "",
+    ]
+    n_emitted = 0
+    for name in sorted(ops):
+        op = ops[name]
+        ident = cpp_ident(name)
+        if ident in seen_idents:
+            continue
+        seen_idents.add(ident)
+        out += emit_op(name, op)
+        n_emitted += 1
+    out += [
+        "}  // namespace op",
+        "}  // namespace cpp",
+        "}  // namespace mxtpu",
+        "",
+        "#endif  // MXTPU_CPP_OP_H_",
+        "",
+    ]
+    with open(OUT, "w") as f:
+        f.write("\n".join(out))
+    print("emitted %d ops -> %s" % (n_emitted, OUT))
+
+
+if __name__ == "__main__":
+    main()

@@ -88,12 +88,27 @@ class Symbol {
     Check(MXSymbolCreateFromJSON(json.c_str(), &h), "SymbolCreateFromJSON");
     return Symbol(h);
   }
+  static Symbol Variable(const std::string &name) {
+    SymbolHandle h;
+    Check(MXSymbolCreateVariable(name.c_str(), &h), "SymbolCreateVariable");
+    return Symbol(h);
+  }
+  /* null symbol: passed to a generated op wrapper it means "auto-create a
+   * Variable for this input" (weights/bias), the nnvm auto-var behavior */
+  Symbol() = default;
   explicit Symbol(SymbolHandle h) : h_(h) {}
   Symbol(Symbol &&o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  Symbol &operator=(Symbol &&o) noexcept {
+    if (h_ != nullptr) MXSymbolFree(h_);
+    h_ = o.h_;
+    o.h_ = nullptr;
+    return *this;
+  }
   Symbol(const Symbol &) = delete;
   ~Symbol() {
     if (h_ != nullptr) MXSymbolFree(h_);
   }
+  bool IsNull() const { return h_ == nullptr; }
 
   std::vector<std::string> ListArguments() const {
     mx_uint n = 0;

@@ -1,0 +1,154 @@
+/*
+ * Generic op invocation for the C++ API — the role of the reference's
+ * cpp-package Operator class (cpp-package/include/mxnet-cpp/operator.h):
+ * set string params and named inputs, then either compose a Symbol node
+ * or invoke imperatively on NDArrays. The generated per-op wrappers in
+ * op.h (built by cpp-package/OpWrapperGenerator.py from the live op
+ * registry, the reference's OpWrapperGenerator.py flow) all funnel
+ * through this class.
+ */
+#ifndef MXTPU_CPP_OPERATOR_H_
+#define MXTPU_CPP_OPERATOR_H_
+
+#include <map>
+#include <sstream>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "mxtpu_cpp.hpp"
+
+namespace mxtpu {
+namespace cpp {
+
+/* Shape: serialized as "(a, b,)" — the dmlc::Parameter tuple format the
+ * runtime's attr parser reads. */
+class Shape {
+ public:
+  Shape() = default;
+  Shape(std::initializer_list<mx_uint> dims) : dims_(dims) {}
+  explicit Shape(const std::vector<mx_uint> &dims) : dims_(dims) {}
+  Shape(mx_uint d0) : dims_{d0} {}
+  Shape(mx_uint d0, mx_uint d1) : dims_{d0, d1} {}
+  Shape(mx_uint d0, mx_uint d1, mx_uint d2) : dims_{d0, d1, d2} {}
+  Shape(mx_uint d0, mx_uint d1, mx_uint d2, mx_uint d3)
+      : dims_{d0, d1, d2, d3} {}
+  bool empty() const { return dims_.empty(); }
+  std::string Str() const {
+    std::ostringstream os;
+    os << "(";
+    for (auto d : dims_) os << d << ",";
+    os << ")";
+    return os.str();
+  }
+  const std::vector<mx_uint> &data() const { return dims_; }
+
+ private:
+  std::vector<mx_uint> dims_;
+};
+
+class Operator {
+ public:
+  explicit Operator(const std::string &op_name) : op_(op_name) {}
+
+  Operator &SetParam(const std::string &k, const std::string &v) {
+    params_.emplace_back(k, v);
+    return *this;
+  }
+  Operator &SetParam(const std::string &k, const char *v) {
+    return SetParam(k, std::string(v));
+  }
+  Operator &SetParam(const std::string &k, bool v) {
+    return SetParam(k, std::string(v ? "true" : "false"));
+  }
+  Operator &SetParam(const std::string &k, int v) {
+    return SetParam(k, std::to_string(v));
+  }
+  Operator &SetParam(const std::string &k, mx_uint v) {
+    return SetParam(k, std::to_string(v));
+  }
+  Operator &SetParam(const std::string &k, int64_t v) {
+    return SetParam(k, std::to_string(v));
+  }
+  Operator &SetParam(const std::string &k, double v) {
+    std::ostringstream os;
+    os << v;
+    return SetParam(k, os.str());
+  }
+  Operator &SetParam(const std::string &k, const Shape &v) {
+    return SetParam(k, v.Str());
+  }
+
+  /* named symbol input ("data", "weight", ...); empty name = positional.
+   * A null Symbol is skipped: the runtime auto-creates a Variable for the
+   * missing input (nnvm auto-var — how fc weights get made). */
+  Operator &SetInput(const std::string &name, const Symbol &s) {
+    if (s.handle() == nullptr) return *this;
+    sym_in_keys_.push_back(name);
+    sym_in_.push_back(s.handle());
+    return *this;
+  }
+  Operator &AddInput(const Symbol &s) { return SetInput("", s); }
+
+  /* imperative inputs are positional, in the op's declared order */
+  Operator &AddInput(const NDArray &nd) {
+    nd_in_.push_back(nd.handle());
+    return *this;
+  }
+
+  /* Compose a graph node (reference Operator::CreateSymbol). */
+  Symbol CreateSymbol(const std::string &name = "") {
+    std::vector<const char *> keys, vals;
+    for (const auto &kv : params_) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    SymbolHandle h;
+    Check(MXSymbolCreateAtomicSymbol(op_.c_str(),
+                                     static_cast<mx_uint>(keys.size()),
+                                     keys.data(), vals.data(), &h),
+          "CreateAtomicSymbol");
+    std::vector<const char *> in_keys;
+    for (const auto &k : sym_in_keys_) in_keys.push_back(k.c_str());
+    if (MXSymbolComposeKeyed(h, name.empty() ? nullptr : name.c_str(),
+                             static_cast<mx_uint>(sym_in_.size()),
+                             in_keys.data(), sym_in_.data()) != 0) {
+      MXSymbolFree(h);
+      Check(-1, "SymbolComposeKeyed");
+    }
+    return Symbol(h);
+  }
+
+  /* Imperative invocation (reference Operator::Invoke). */
+  std::vector<NDArray> Invoke() {
+    std::vector<const char *> keys, vals;
+    for (const auto &kv : params_) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    mx_uint n_out = 0;
+    NDArrayHandle *outs = nullptr;
+    Check(MXImperativeInvoke(op_.c_str(),
+                             static_cast<mx_uint>(nd_in_.size()),
+                             nd_in_.data(), &n_out, &outs,
+                             static_cast<mx_uint>(keys.size()), keys.data(),
+                             vals.data()),
+          "ImperativeInvoke");
+    std::vector<NDArray> result;
+    result.reserve(n_out);
+    for (mx_uint i = 0; i < n_out; ++i) result.emplace_back(outs[i]);
+    return result;
+  }
+
+ private:
+  std::string op_;
+  std::vector<std::pair<std::string, std::string>> params_;
+  std::vector<std::string> sym_in_keys_;
+  std::vector<SymbolHandle> sym_in_;
+  std::vector<NDArrayHandle> nd_in_;
+};
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_OPERATOR_H_

@@ -473,20 +473,8 @@ def symbol_create_atomic(op_name, keys, vals):
                                    _parse_op_attrs(op, keys, vals)))
 
 
-def symbol_compose(h, name, arg_handles):
-    """Bind inputs to an atomic symbol IN PLACE (the reference mutates the
-    handle: c_api_symbolic.cc MXSymbolCompose)."""
-    from .symbol import create as sym_create
-
-    st = _get(h)
-    if not isinstance(st, _AtomicSymbol):
-        raise RuntimeError("SymbolCompose: handle is already composed")
-    inputs = [_get(a) for a in arg_handles]
-    composed = sym_create(st.op_name, inputs, st.kwargs,
-                          name=str(name) if name else None)
-    with _lock:
-        _handles[int(h)] = composed
-    return 0
+# symbol_compose (positional) is defined below as a delegation to
+# symbol_compose_keyed — one composition path, no drift.
 
 
 def symbol_infer_shape_out(h, names, shapes):
@@ -751,3 +739,53 @@ def custom_op_register_c(op_type, info_addr):
 
     _operator._REGISTRY[str(op_type)] = _CProp
     return 0
+
+
+def symbol_compose_keyed(h, name, keys, arg_handles):
+    """Keyed in-place composition (full reference MXSymbolCompose
+    signature, src/c_api/c_api_symbolic.cc: keys name the op's tensor
+    inputs, e.g. weight=..., so callers need not know declared order).
+    Empty-string key => positional. Mirrors nnvm's composition errors:
+    unknown keywords, keyword/positional mixes, and keywords on variadic
+    ops are rejected instead of silently building a wrong graph."""
+    from .base import MXNetError
+    from .ops.registry import get_op
+    from .symbol import create as sym_create
+
+    st = _get(h)
+    if not isinstance(st, _AtomicSymbol):
+        raise RuntimeError("SymbolCompose: handle is already composed")
+    pos, kw = [], {}
+    for k, a in zip(keys, arg_handles):
+        if k:
+            kw[str(k)] = _get(a)
+        else:
+            pos.append(_get(a))
+    if kw:
+        op = get_op(st.op_name)
+        if op.variadic:
+            raise MXNetError(
+                "SymbolCompose: op %s takes a variadic input list; keyword "
+                "inputs are not accepted" % st.op_name)
+        if pos:
+            raise MXNetError(
+                "SymbolCompose: op %s: mixing positional and keyword "
+                "inputs is not supported" % st.op_name)
+        wanted = set(op.input_names(op.parse_attrs(st.kwargs)))
+        unknown = set(kw) - wanted
+        if unknown:
+            raise MXNetError(
+                "SymbolCompose: op %s has no input(s) %s (inputs: %s)"
+                % (st.op_name, sorted(unknown), sorted(wanted)))
+    composed = sym_create(st.op_name, pos, st.kwargs,
+                          name=str(name) if name else None,
+                          kwarg_syms=kw or None)
+    with _lock:
+        _handles[int(h)] = composed
+    return 0
+
+
+def symbol_compose(h, name, arg_handles):
+    """Positional composition = keyed composition with no keys."""
+    return symbol_compose_keyed(h, name, [""] * len(arg_handles),
+                                arg_handles)

@@ -79,6 +79,13 @@ int MXSymbolCreateAtomicSymbol(const char *op_name, mx_uint num_params,
                                SymbolHandle *out);
 int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
                     SymbolHandle *args);
+/* Keyed composition — the reference MXSymbolCompose's full signature
+ * (src/c_api/c_api_symbolic.cc): keys name the op's tensor inputs
+ * ("weight", "bias", ...); keys == NULL or keys[i] == "" means
+ * positional. Used by the generated cpp-package op wrappers. */
+int MXSymbolComposeKeyed(SymbolHandle sym, const char *name,
+                         mx_uint num_args, const char **keys,
+                         SymbolHandle *args);
 int MXSymbolInferShapeOut(SymbolHandle sym, mx_uint num_inputs,
                           const char **input_names,
                           const mx_uint *shape_indptr,

@@ -289,14 +289,24 @@ int MXSymbolCreateAtomicSymbol(const char *op_name, mx_uint num_params,
 
 int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
                     SymbolHandle *args) {
+  /* positional composition = keyed composition with no keys */
+  return MXSymbolComposeKeyed(sym, name, num_args, nullptr, args);
+}
+
+int MXSymbolComposeKeyed(SymbolHandle sym, const char *name,
+                         mx_uint num_args, const char **keys,
+                         SymbolHandle *args) {
   GilGuard gil;
+  PyObject *ks = PyList_New(num_args);
   PyObject *arr = PyList_New(num_args);
   for (mx_uint i = 0; i < num_args; ++i) {
+    const char *k = (keys != nullptr && keys[i] != nullptr) ? keys[i] : "";
+    PyList_SetItem(ks, i, PyUnicode_FromString(k));
     PyList_SetItem(arr, i, PyLong_FromLong(HandleToId(args[i])));
   }
   PyObject *res = CallBridge(
-      "symbol_compose",
-      Py_BuildValue("(lsN)", HandleToId(sym), name ? name : "", arr));
+      "symbol_compose_keyed",
+      Py_BuildValue("(lsNN)", HandleToId(sym), name ? name : "", ks, arr));
   if (res == nullptr) return -1;
   Py_DECREF(res);
   return 0;
