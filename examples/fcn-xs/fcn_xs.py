@@ -1,0 +1,117 @@
+"""FCN semantic segmentation (parity: example/fcn-xs — fully-convolutional
+net: conv trunk, 1x1 score convolution, Deconvolution upsampling back to
+input resolution, Crop alignment, and per-pixel SoftmaxOutput with
+``multi_output=True``, the fcn-xs head in symbol_fcnxs.py).
+
+Synthetic task: segment images containing a bright rectangle into
+{background, rectangle} pixel classes.
+
+Run:  python fcn_xs.py --epochs 6
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+
+
+def fcn_symbol(num_classes=2, workspace=256):
+    """Downsample 4x with two conv/pool stages, score with a 1x1 conv,
+    upsample 4x with a stride-4 Deconvolution, Crop to the input, per-pixel
+    softmax (symbol_fcnxs.py pattern)."""
+    data = mx.sym.Variable("data")
+    conv1 = mx.sym.Convolution(data, kernel=(3, 3), pad=(1, 1),
+                               num_filter=16, name="conv1")
+    act1 = mx.sym.Activation(conv1, act_type="relu")
+    pool1 = mx.sym.Pooling(act1, kernel=(2, 2), stride=(2, 2),
+                           pool_type="max", name="pool1")
+    conv2 = mx.sym.Convolution(pool1, kernel=(3, 3), pad=(1, 1),
+                               num_filter=32, name="conv2")
+    act2 = mx.sym.Activation(conv2, act_type="relu")
+    pool2 = mx.sym.Pooling(act2, kernel=(2, 2), stride=(2, 2),
+                           pool_type="max", name="pool2")
+    score = mx.sym.Convolution(pool2, kernel=(1, 1), num_filter=num_classes,
+                               name="score")
+    # kernel=2*stride, pad=stride/2: the fcn-xs upsampling arithmetic
+    up = mx.sym.Deconvolution(score, kernel=(8, 8), stride=(4, 4),
+                              pad=(2, 2), num_filter=num_classes,
+                              name="bigscore")
+    crop = mx.sym.Crop(up, data, name="crop")
+    # normalization='valid' divides the per-pixel gradients by the pixel
+    # count — without it the summed gradient explodes (the reference's
+    # fcn-xs compensates with a 1e-10 lr, solver.py)
+    return mx.sym.SoftmaxOutput(crop, multi_output=True, use_ignore=True,
+                                ignore_label=-1, normalization="valid",
+                                name="softmax")
+
+
+def synth_segmentation(n, img, rng):
+    X = rng.randn(n, 1, img, img).astype("float32") * 0.3
+    Y = np.zeros((n, img, img), "float32")
+    for i in range(n):
+        h, w = rng.randint(img // 4, img // 2, 2)
+        r, c = rng.randint(0, img - h), rng.randint(0, img - w)
+        X[i, 0, r:r + h, c:c + w] += 1.5
+        Y[i, r:r + h, c:c + w] = 1.0
+    return X, Y
+
+
+def pixel_accuracy(mod, it, n, img):
+    it.reset()
+    correct = total = 0
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        probs = mod.get_outputs()[0].asnumpy()
+        pred = probs.argmax(axis=1)
+        lab = batch.label[0].asnumpy()
+        correct += (pred == lab).sum()
+        total += lab.size
+    return correct / float(total)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--num-images", type=int, default=256)
+    ap.add_argument("--img", type=int, default=16)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(5)
+    X, Y = synth_segmentation(args.num_images, args.img, rng)
+    nval = args.num_images // 4
+    train = mx.io.NDArrayIter(X[:-nval], Y[:-nval], args.batch_size,
+                              shuffle=True, label_name="softmax_label")
+    val = mx.io.NDArrayIter(X[-nval:], Y[-nval:], args.batch_size,
+                            label_name="softmax_label")
+
+    net = fcn_symbol()
+    mod = mx.mod.Module(net, context=mx.cpu(0),
+                        label_names=("softmax_label",))
+    mod.fit(train, num_epoch=args.epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9},
+            eval_metric=PixAcc(),
+            initializer=mx.initializer.Xavier())
+
+    acc = pixel_accuracy(mod, val, nval, args.img)
+    logging.info("fcn-xs val pixel accuracy: %.4f", acc)
+    return acc
+
+
+class PixAcc(mx.metric.EvalMetric):
+    """Per-pixel accuracy over the (b, c, h, w) softmax output."""
+
+    def __init__(self):
+        super().__init__("pixacc")
+
+    def update(self, labels, preds):
+        pred = preds[0].asnumpy().argmax(axis=1)
+        lab = labels[0].asnumpy()
+        self.sum_metric += float((pred == lab).sum())
+        self.num_inst += lab.size
+
+
+if __name__ == "__main__":
+    main()

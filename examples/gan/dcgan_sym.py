@@ -1,0 +1,173 @@
+"""Symbolic DCGAN (parity: example/gan/dcgan.py — the MODULE-level GAN
+loop, distinct from the Gluon one in examples/gluon/dcgan.py): generator
+and discriminator as two Modules, trained with the reference's exact
+mechanics — ``inputs_need_grad=True`` on D, fake/real gradient
+accumulation (run D on fakes, copy grads, run on reals, add, update), and
+G updated through ``D.get_input_grads()`` fed to ``G.backward(diffD)``.
+
+Run:  python dcgan_sym.py --epochs 3
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+
+
+def make_dcgan_sym(ngf, ndf, nc, img=16, z=16, fix_gamma=True):
+    """Small DCGAN pair for img x img images (reference make_dcgan_sym
+    shape, example/gan/dcgan.py:27, scaled down: 4->16 in two deconv
+    doublings)."""
+    BatchNorm = mx.sym.BatchNorm
+    rand = mx.sym.Variable("rand")
+    g1 = mx.sym.Deconvolution(rand, name="g1", kernel=(4, 4),
+                              num_filter=ngf * 2, no_bias=True)
+    gbn1 = BatchNorm(g1, name="gbn1", fix_gamma=fix_gamma)
+    gact1 = mx.sym.Activation(gbn1, act_type="relu")
+    g2 = mx.sym.Deconvolution(gact1, name="g2", kernel=(4, 4), stride=(2, 2),
+                              pad=(1, 1), num_filter=ngf, no_bias=True)
+    gbn2 = BatchNorm(g2, name="gbn2", fix_gamma=fix_gamma)
+    gact2 = mx.sym.Activation(gbn2, act_type="relu")
+    g3 = mx.sym.Deconvolution(gact2, name="g3", kernel=(4, 4), stride=(2, 2),
+                              pad=(1, 1), num_filter=nc, no_bias=True)
+    gout = mx.sym.Activation(g3, name="gact3", act_type="tanh")
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    d1 = mx.sym.Convolution(data, name="d1", kernel=(4, 4), stride=(2, 2),
+                            pad=(1, 1), num_filter=ndf, no_bias=True)
+    dact1 = mx.sym.LeakyReLU(d1, name="dact1", act_type="leaky", slope=0.2)
+    d2 = mx.sym.Convolution(dact1, name="d2", kernel=(4, 4), stride=(2, 2),
+                            pad=(1, 1), num_filter=ndf * 2, no_bias=True)
+    dbn2 = BatchNorm(d2, name="dbn2", fix_gamma=fix_gamma)
+    dact2 = mx.sym.LeakyReLU(dbn2, name="dact2", act_type="leaky", slope=0.2)
+    d3 = mx.sym.Convolution(dact2, name="d3", kernel=(4, 4), num_filter=1,
+                            no_bias=True)
+    d3 = mx.sym.Flatten(d3)
+    dloss = mx.sym.LogisticRegressionOutput(d3, label, name="dloss")
+    return gout, dloss
+
+
+class RandIter(mx.io.DataIter):
+    """Endless N(0,1) latent batches (reference RandIter)."""
+
+    def __init__(self, batch_size, ndim):
+        super().__init__()
+        self.batch_size = batch_size
+        self.ndim = ndim
+        self.provide_data = [mx.io.DataDesc("rand",
+                                            (batch_size, ndim, 1, 1))]
+        self.provide_label = []
+
+    def iter_next(self):
+        return True
+
+    def getdata(self):
+        return [mx.nd.random_normal(0, 1.0,
+                                    shape=(self.batch_size, self.ndim, 1, 1))]
+
+
+def synth_images(n, img, rng):
+    """Blobby 'digits': bright disc at a class-dependent offset, in
+    [-1, 1] like the reference's rescaled MNIST."""
+    ys, xs = np.mgrid[0:img, 0:img]
+    X = np.zeros((n, 1, img, img), "float32")
+    for i in range(n):
+        cy, cx = rng.randint(img // 4, 3 * img // 4, 2)
+        r = rng.randint(2, img // 4)
+        X[i, 0] = ((ys - cy) ** 2 + (xs - cx) ** 2 <= r * r).astype("float32")
+    return X * 2.0 - 1.0
+
+
+def facc(label, pred):
+    pred = pred.ravel()
+    label = label.ravel()
+    return float(((pred > 0.5) == label).mean())
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-images", type=int, default=256)
+    ap.add_argument("--lr", type=float, default=0.0005)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    img, z, nc = 16, 16, 1
+    rng = np.random.RandomState(4)
+    X = synth_images(args.num_images, img, rng)
+    train_iter = mx.io.NDArrayIter(X, batch_size=args.batch_size)
+    rand_iter = RandIter(args.batch_size, z)
+    label = mx.nd.zeros((args.batch_size,))
+
+    symG, symD = make_dcgan_sym(ngf=16, ndf=16, nc=nc, img=img, z=z)
+
+    modG = mx.mod.Module(symG, data_names=("rand",), label_names=None,
+                         context=mx.cpu(0))
+    modG.bind(data_shapes=rand_iter.provide_data)
+    modG.init_params(initializer=mx.initializer.Normal(0.02))
+    modG.init_optimizer(optimizer="adam",
+                        optimizer_params={"learning_rate": args.lr,
+                                          "beta1": 0.5, "wd": 0.0})
+
+    modD = mx.mod.Module(symD, data_names=("data",), label_names=("label",),
+                         context=mx.cpu(0))
+    modD.bind(data_shapes=train_iter.provide_data,
+              label_shapes=[mx.io.DataDesc("label", (args.batch_size,))],
+              inputs_need_grad=True)
+    modD.init_params(initializer=mx.initializer.Normal(0.02))
+    modD.init_optimizer(optimizer="adam",
+                        optimizer_params={"learning_rate": args.lr,
+                                          "beta1": 0.5, "wd": 0.0})
+
+    mACC = mx.metric.CustomMetric(facc)
+    first_acc = None
+    min_fake_acc = 1.0
+
+    for epoch in range(args.epochs):
+        train_iter.reset()
+        for batch in train_iter:
+            rbatch = rand_iter.next()
+            modG.forward(rbatch, is_train=True)
+            outG = modG.get_outputs()
+
+            # D on fakes: keep the gradients, don't step yet
+            label[:] = 0
+            modD.forward(mx.io.DataBatch(outG, [label]), is_train=True)
+            modD.backward()
+            gradD = [[g.copyto(g.context) for g in grads]
+                     for grads in modD._exec_group.grad_arrays]
+            mACC.reset()
+            modD.update_metric(mACC, [label])
+            fake_acc = mACC.get()[1]
+            if first_acc is None:
+                first_acc = fake_acc
+            min_fake_acc = min(min_fake_acc, fake_acc)
+
+            # D on reals: accumulate fake grads, then one update
+            label[:] = 1
+            batch.label = [label]
+            modD.forward(batch, is_train=True)
+            modD.backward()
+            for gradsr, gradsf in zip(modD._exec_group.grad_arrays, gradD):
+                for gr, gf in zip(gradsr, gradsf):
+                    gr += gf
+            modD.update()
+
+            # G through D's input gradients
+            label[:] = 1
+            modD.forward(mx.io.DataBatch(outG, [label]), is_train=True)
+            modD.backward()
+            diffD = modD.get_input_grads()
+            modG.backward(diffD)
+            modG.update()
+        logging.info("epoch %d: fake-detect acc %.3f (min %.3f)",
+                     epoch, fake_acc, min_fake_acc)
+
+    return first_acc, min_fake_acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,156 @@
+"""Neural style transfer (parity: example/neural-style/nstyle.py — the
+input-space optimization flow: style Gram matrices via the
+``FullyConnected(x, x, no_bias=True)`` dot-trick, target Variables,
+symbolic sum-of-squares losses, an executor bound with gradient on the
+DATA variable, and optimizer steps applied to the image itself).
+
+The reference extracts features with downloaded VGG19 weights; offline
+here, the feature net is a small fixed random conv stack — the transfer
+machinery (grams, losses, input-space gradients) is identical.
+
+Run:  python nstyle.py --iters 40
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+
+
+def feature_symbol():
+    """Two conv feature maps (relu1/relu2) standing in for the VGG relus
+    (model_vgg19.py get_symbol returns style + content layer groups)."""
+    data = mx.sym.Variable("data")
+    conv1 = mx.sym.Convolution(data, kernel=(3, 3), pad=(1, 1),
+                               num_filter=8, no_bias=True, name="feat_conv1")
+    relu1 = mx.sym.Activation(conv1, act_type="relu", name="feat_relu1")
+    conv2 = mx.sym.Convolution(relu1, kernel=(3, 3), pad=(1, 1),
+                               stride=(2, 2), num_filter=16, no_bias=True,
+                               name="feat_conv2")
+    relu2 = mx.sym.Activation(conv2, act_type="relu", name="feat_relu2")
+    style = mx.sym.Group([relu1, relu2])
+    content = relu2
+    return style, content
+
+
+def style_gram_symbol(input_size, style):
+    """Gram matrix per style layer via the reference's FC dot-trick
+    (nstyle.py:120-131)."""
+    _, output_shapes, _ = style.infer_shape(
+        data=(1, 1, input_size[0], input_size[1]))
+    gram_list = []
+    grad_scale = []
+    for i in range(len(style.list_outputs())):
+        shape = output_shapes[i]
+        x = mx.sym.Reshape(style[i], target_shape=(int(shape[1]),
+                                                   int(np.prod(shape[2:]))))
+        gram = mx.sym.FullyConnected(x, x, no_bias=True,
+                                     num_hidden=int(shape[1]))
+        gram_list.append(gram)
+        grad_scale.append(float(np.prod(shape[1:])) * shape[1])
+    return mx.sym.Group(gram_list), grad_scale
+
+
+def get_loss(gram, content):
+    """Sum-of-squares losses against target Variables (nstyle.py:134)."""
+    gram_loss = []
+    for i in range(len(gram.list_outputs())):
+        gvar = mx.sym.Variable("target_gram_%d" % i)
+        gram_loss.append(mx.sym.sum(mx.sym.square(gvar - gram[i])))
+    cvar = mx.sym.Variable("target_content")
+    content_loss = mx.sym.sum(mx.sym.square(cvar - content))
+    return mx.sym.Group(gram_loss), content_loss
+
+
+def _fixed_feature_args(rng, sym, size):
+    """Fixed random feature weights, shared by every executor."""
+    args = {}
+    arg_shapes, _, _ = sym.infer_shape(data=(1, 1, size[0], size[1]))
+    for name, shape in zip(sym.list_arguments(), arg_shapes):
+        if name.startswith("feat_"):
+            args[name] = mx.nd.array(
+                (rng.randn(*shape) * 0.4).astype("float32"))
+    return args
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=40)
+    ap.add_argument("--size", type=int, default=24)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--content-weight", type=float, default=10.0)
+    ap.add_argument("--style-weight", type=float, default=1.0)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    size = (args.size, args.size)
+    rng = np.random.RandomState(6)
+    # content: smooth blob; style: diagonal stripes
+    ys, xs = np.mgrid[0:size[0], 0:size[1]]
+    content_np = np.exp(-((ys - size[0] / 2) ** 2 +
+                          (xs - size[1] / 2) ** 2) / 40.0)
+    content_np = content_np[None, None].astype("float32")
+    style_np = (np.sin((ys + xs) * 0.8) > 0).astype("float32")[None, None]
+
+    style, content = feature_symbol()
+    gram, gscale = style_gram_symbol(size, style)
+    feat_args = _fixed_feature_args(rng, style, size)
+
+    # pass 1: record style grams + content features of the two sources
+    ex = mx.sym.Group([gram, content]).bind(
+        mx.cpu(), dict(feat_args, data=mx.nd.array(style_np)),
+        grad_req="null")
+    ex.forward()
+    n_gram = len(gram.list_outputs())
+    style_targets = [o.copyto(mx.cpu()) for o in ex.outputs[:n_gram]]
+    ex.arg_dict["data"][:] = content_np
+    ex.forward()
+    content_target = ex.outputs[n_gram].copyto(mx.cpu())
+
+    # pass 2: loss executor, gradient ON THE IMAGE only
+    style_loss, content_loss = get_loss(gram, content)
+    total = mx.sym.Group([style_loss, content_loss])
+    img = mx.nd.array(rng.uniform(-0.1, 0.1, (1, 1) + size)
+                      .astype("float32"))
+    arg_map = dict(feat_args, data=img)
+    for i, t in enumerate(style_targets):
+        arg_map["target_gram_%d" % i] = t
+    arg_map["target_content"] = content_target
+    grad_req = {n: "null" for n in total.list_arguments()}
+    grad_req["data"] = "write"
+    data_grad = mx.nd.zeros(img.shape)
+    ex = total.bind(mx.cpu(), arg_map, args_grad={"data": data_grad},
+                    grad_req=grad_req)
+
+    opt = mx.optimizer.create("adam", learning_rate=args.lr)
+    updater = mx.optimizer.get_updater(opt)
+    first = last = None
+    for it in range(args.iters):
+        ex.forward(is_train=True)
+        losses = [float(o.asnumpy()) for o in ex.outputs]
+        weighted = (args.style_weight *
+                    sum(l / s for l, s in zip(losses[:n_gram], gscale)) +
+                    args.content_weight * losses[n_gram] /
+                    float(np.prod(content_target.shape)))
+        if first is None:
+            first = weighted
+        last = weighted
+        # head grads: weight each loss output like the reference's
+        # grad_scale bookkeeping
+        heads = [mx.nd.array(np.array(args.style_weight / s, "float32"))
+                 for s in gscale]
+        heads.append(mx.nd.array(np.array(
+            args.content_weight / float(np.prod(content_target.shape)),
+            "float32")))
+        ex.backward(heads)
+        updater(0, data_grad, img)
+        if it % 10 == 0:
+            logging.info("iter %d: weighted loss %.5f", it, weighted)
+
+    logging.info("nstyle: loss %.5f -> %.5f", first, last)
+    return first, last
+
+
+if __name__ == "__main__":
+    main()

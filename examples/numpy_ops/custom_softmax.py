@@ -1,0 +1,100 @@
+"""Custom-op training: a softmax loss head written as a numpy CustomOp
+(parity: example/numpy-ops/custom_softmax.py — mx.operator.CustomOp /
+CustomOpProp with need_top_grad=False, registered and instantiated as
+``mx.sym.Custom(op_type=...)``). The op body runs as an XLA host callback
+(ops/custom.py pure_callback), so the same graph path works jitted.
+
+Run:  python custom_softmax.py --epochs 6
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+
+
+class NumpySoftmax(mx.operator.CustomOp):
+    """Forward: row softmax. Backward: softmax - onehot(label) — the
+    loss-head gradient, ignoring incoming cotangents (need_top_grad=False,
+    exactly the reference example's Softmax)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = np.exp(x - x.max(axis=1).reshape((x.shape[0], 1)))
+        y /= y.sum(axis=1).reshape((x.shape[0], 1))
+        self.assign(out_data[0], req[0], y)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        lab = in_data[1].asnumpy().ravel().astype(np.int64)
+        y = out_data[0].asnumpy().copy()
+        y[np.arange(lab.shape[0]), lab] -= 1.0
+        self.assign(in_grad[0], req[0], y)
+
+
+@mx.operator.register("numpy_softmax")
+class NumpySoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = [in_shape[0][0]]
+        return [data_shape, label_shape], [data_shape], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return NumpySoftmax()
+
+
+def synth(n, rng, classes=10, dim=64):
+    protos = rng.rand(classes, dim) > 0.5
+    y = rng.randint(0, classes, n)
+    X = protos[y].astype("float32") + rng.randn(n, dim).astype("float32") * 0.3
+    return X, y.astype("float32")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-examples", type=int, default=1024)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(3)
+    X, Y = synth(args.num_examples, rng)
+    nval = args.num_examples // 4
+    train = mx.io.NDArrayIter(X[:-nval], Y[:-nval], args.batch_size,
+                              shuffle=True, label_name="softmax_label")
+    val = mx.io.NDArrayIter(X[-nval:], Y[-nval:], args.batch_size,
+                            label_name="softmax_label")
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=10, name="fc2")
+    net = mx.sym.Custom(fc2, label, op_type="numpy_softmax", name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.cpu(0),
+                        label_names=("softmax_label",))
+    mod.fit(train, eval_data=val, num_epoch=args.epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            eval_metric=mx.metric.Accuracy(),
+            initializer=mx.initializer.Xavier())
+
+    metric = mx.metric.Accuracy()
+    mod.score(val, metric)
+    acc = metric.get()[1]
+    logging.info("custom-softmax val accuracy: %.4f", acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""Matrix-factorization recommender (parity: example/recommenders/
+matrix_fact.py + demo1-MF: user/item Embeddings, inner-product rating
+prediction, LinearRegressionOutput head, RMSE metric). Synthetic
+MovieLens-shaped data from ground-truth low-rank factors.
+
+Run:  python matrix_fact.py --epochs 8
+"""
+import argparse
+import logging
+import math
+
+import numpy as np
+
+import mxtpu as mx
+
+
+def plain_net(max_user, max_item, k):
+    """pred(u, i) = <user_emb[u], item_emb[i]> (demo1-MF plain_net)."""
+    user = mx.sym.Variable("user")
+    item = mx.sym.Variable("item")
+    score = mx.sym.Variable("score")
+    user = mx.sym.Embedding(user, input_dim=max_user, output_dim=k,
+                            name="user_emb")
+    item = mx.sym.Embedding(item, input_dim=max_item, output_dim=k,
+                            name="item_emb")
+    pred = user * item
+    pred = mx.sym.sum(pred, axis=1)
+    pred = mx.sym.Flatten(pred)
+    return mx.sym.LinearRegressionOutput(pred, score, name="lro")
+
+
+def rmse(label, pred):
+    pred = pred.ravel()
+    label = label.ravel()
+    return math.sqrt(float(((label - pred) ** 2).mean()))
+
+
+def synth_ratings(n, max_user, max_item, k, rng, noise=0.1):
+    """Ratings from hidden low-rank factors — learnable to ~`noise` RMSE."""
+    U = rng.randn(max_user, k).astype("float32") / math.sqrt(k)
+    V = rng.randn(max_item, k).astype("float32") / math.sqrt(k)
+    u = rng.randint(0, max_user, n)
+    i = rng.randint(0, max_item, n)
+    r = (U[u] * V[i]).sum(axis=1) + rng.randn(n).astype("float32") * noise
+    return u.astype("float32"), i.astype("float32"), r.astype("float32")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--num-ratings", type=int, default=8192)
+    ap.add_argument("--max-user", type=int, default=100)
+    ap.add_argument("--max-item", type=int, default=80)
+    ap.add_argument("--factors", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(11)
+    u, i, r = synth_ratings(args.num_ratings, args.max_user, args.max_item,
+                            args.factors, rng)
+    nval = args.num_ratings // 8
+    train = mx.io.NDArrayIter({"user": u[:-nval], "item": i[:-nval]},
+                              r[:-nval], args.batch_size, shuffle=True,
+                              label_name="score")
+    val = mx.io.NDArrayIter({"user": u[-nval:], "item": i[-nval:]},
+                            r[-nval:], args.batch_size, label_name="score")
+
+    net = plain_net(args.max_user, args.max_item, args.factors)
+    mod = mx.mod.Module(net, context=mx.cpu(0),
+                        data_names=("user", "item"), label_names=("score",))
+    metric = mx.metric.create(rmse)
+    mod.fit(train, eval_data=val, num_epoch=args.epochs, optimizer="adam",
+            optimizer_params={"learning_rate": args.lr, "wd": 1e-4},
+            eval_metric=metric,
+            initializer=mx.initializer.Normal(0.05))
+
+    final = mx.metric.create(rmse)
+    mod.score(val, final)
+    score = final.get()[1]
+    logging.info("matrix-factorization val RMSE: %.4f", score)
+    return score
+
+
+if __name__ == "__main__":
+    main()

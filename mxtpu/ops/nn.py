@@ -393,7 +393,11 @@ def _regression(name, link, grad_fn):
 
     def bwd(a, res, g):
         out, label = res
-        grad = grad_fn(out, label) * a.grad_scale
+        # the reference reshapes label to the prediction's shape
+        # (regression_output-inl.h), so (b,) labels pair with (b, 1) preds
+        # without broadcasting into a (b, b) gradient
+        lab = label.reshape(out.shape) if label.shape != out.shape else label
+        grad = grad_fn(out, lab) * a.grad_scale
         return grad.astype(out.dtype), jnp.zeros_like(label)
 
     core.defvjp(fwd, bwd)

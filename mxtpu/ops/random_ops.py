@@ -21,32 +21,51 @@ def _shape_dtype(a):
     return shape, dtype
 
 
-def _sampler(name, draw):
+_SAMPLER_DEFAULTS = {"low": 0.0, "high": 1.0, "loc": 0.0, "scale": 1.0,
+                     "lam": 1.0, "alpha": 1.0, "beta": 1.0, "k": 1,
+                     "p": 1.0, "mu": 1.0, "sigma": 1.0}
+
+
+def _sampler(name, draw, lead=()):
+    """``lead``: the distribution's own parameters, in the reference's
+    declared order (src/operator/random/sample_op.cc) — they come FIRST in
+    attrs_spec so positional calls like ``nd.random_normal(0, 1.0,
+    shape=...)`` map loc/scale the way the reference signature does."""
+    attrs = {k: _SAMPLER_DEFAULTS[k] for k in lead}
+    # reference positional order after the distribution params:
+    # shape, ctx, dtype (sample_op.cc SampleUniformParam et al.)
+    attrs.update({"shape": (), "ctx": "", "dtype": "float32"})
+    for k, v in _SAMPLER_DEFAULTS.items():
+        attrs.setdefault(k, v)
+
     def impl(a, rng):
         shape, dtype = _shape_dtype(a)
         return draw(a, rng, shape, dtype)
 
-    register(name, impl, arg_names=[], needs_rng=True,
-             attrs={"shape": (), "dtype": "float32", "ctx": "",
-                    "low": 0.0, "high": 1.0, "loc": 0.0, "scale": 1.0,
-                    "lam": 1.0, "alpha": 1.0, "beta": 1.0, "k": 1, "p": 1.0,
-                    "mu": 1.0, "sigma": 1.0})
+    register(name, impl, arg_names=[], needs_rng=True, attrs=attrs)
 
 
 _sampler("_random_uniform",
-         lambda a, r, s, d: jax.random.uniform(r, s, d, a.low, a.high))
+         lambda a, r, s, d: jax.random.uniform(r, s, d, a.low, a.high),
+         lead=("low", "high"))
 _sampler("_random_normal",
-         lambda a, r, s, d: a.loc + a.scale * jax.random.normal(r, s, d))
+         lambda a, r, s, d: a.loc + a.scale * jax.random.normal(r, s, d),
+         lead=("loc", "scale"))
 _sampler("_random_gamma",
-         lambda a, r, s, d: (a.beta * jax.random.gamma(r, a.alpha, s)).astype(d))
+         lambda a, r, s, d: (a.beta * jax.random.gamma(r, a.alpha, s)).astype(d),
+         lead=("alpha", "beta"))
 _sampler("_random_exponential",
-         lambda a, r, s, d: (jax.random.exponential(r, s) / a.lam).astype(d))
+         lambda a, r, s, d: (jax.random.exponential(r, s) / a.lam).astype(d),
+         lead=("lam",))
 _sampler("_random_poisson",
-         lambda a, r, s, d: jax.random.poisson(r, a.lam, s).astype(d))
+         lambda a, r, s, d: jax.random.poisson(r, a.lam, s).astype(d),
+         lead=("lam",))
 _sampler("_random_negative_binomial",
-         lambda a, r, s, d: _neg_binomial(r, float(a.k), float(a.p), s).astype(d))
+         lambda a, r, s, d: _neg_binomial(r, float(a.k), float(a.p), s).astype(d),
+         lead=("k", "p"))
 _sampler("_random_generalized_negative_binomial",
-         lambda a, r, s, d: _gen_neg_binomial(r, float(a.mu), float(a.alpha), s).astype(d))
+         lambda a, r, s, d: _gen_neg_binomial(r, float(a.mu), float(a.alpha), s).astype(d),
+         lead=("mu", "alpha"))
 
 
 def _neg_binomial(rng, k, p, shape):

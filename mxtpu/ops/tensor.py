@@ -272,13 +272,27 @@ def _infer_reshape(shape_spec, in_shape):
 
 
 def _reshape(a, x):
+    shape = a.shape
+    if not shape and a.target_shape:
+        # legacy target_shape (reference matrix_op.cc Reshape: deprecated
+        # but accepted; a 0 dim is inferred from the remaining dims;
+        # keep_highest=True ignores the first target dim and keeps the
+        # input's leading dim, matrix_op-inl.h)
+        tgt = tuple(a.target_shape)
+        if a.keep_highest:
+            tgt = (x.shape[0],) + tgt[1:]
+        shape = tuple(-1 if d == 0 else d for d in tgt)
+    if not shape:
+        raise MXNetError("Reshape requires shape= (or legacy target_shape=)")
     if a.reverse:
-        rev = _infer_reshape(tuple(reversed(a.shape)), tuple(reversed(x.shape)))
+        rev = _infer_reshape(tuple(reversed(shape)), tuple(reversed(x.shape)))
         return jnp.reshape(x, tuple(reversed(rev)))
-    return jnp.reshape(x, _infer_reshape(a.shape, x.shape))
+    return jnp.reshape(x, _infer_reshape(shape, x.shape))
 
 
-register("Reshape", _reshape, attrs={"shape": Required(tuple), "reverse": False},
+register("Reshape", _reshape,
+         attrs={"shape": (), "target_shape": (), "reverse": False,
+                "keep_highest": False},
          aliases=("reshape",))
 register("Flatten", lambda a, x: jnp.reshape(x, (x.shape[0], -1)), attrs={},
          aliases=("flatten",))

@@ -279,3 +279,69 @@ def test_nce_loss_gate():
     import nce_lm
     acc, base = nce_lm.main(["--epochs", "6", "--lr", "1.0"])
     assert acc > 3 * base, (acc, base)
+
+
+def test_numpy_ops_custom_softmax_gate():
+    """Custom-op softmax head (examples/numpy_ops/custom_softmax.py,
+    parity example/numpy-ops/custom_softmax.py): the numpy CustomOp loss
+    trains an MLP to >0.9 val accuracy through the host-callback path."""
+    _example("numpy_ops", "custom_softmax.py")
+    import mxtpu as mx
+    mx.random.seed(42)  # deterministic init regardless of suite order
+    import custom_softmax
+    acc = custom_softmax.main(["--epochs", "6"])
+    assert acc > 0.9, "custom-softmax MLP reached only %.3f" % acc
+
+
+def test_recommenders_matrix_fact_gate():
+    """Matrix factorization (examples/recommenders/matrix_fact.py, parity
+    example/recommenders/matrix_fact.py): embeddings + inner product +
+    LinearRegressionOutput recover low-rank ratings to RMSE < 0.35
+    (ground-truth noise is 0.1; untrained is ~1.0)."""
+    _example("recommenders", "matrix_fact.py")
+    import mxtpu as mx
+    mx.random.seed(42)  # deterministic init regardless of suite order
+    import matrix_fact
+    score = matrix_fact.main(["--epochs", "8"])
+    assert score < 0.35, "MF val RMSE stuck at %.3f" % score
+
+
+def test_gan_symbolic_gate():
+    """Symbolic DCGAN (examples/gan/dcgan_sym.py, parity
+    example/gan/dcgan.py): the Module-level GAN loop — inputs_need_grad,
+    fake/real grad accumulation, G updated through D.get_input_grads() —
+    must let the generator genuinely fool the discriminator at some
+    point (min fake-detect accuracy < 0.9)."""
+    _example("gan", "dcgan_sym.py")
+    import mxtpu as mx
+    import dcgan_sym
+    mx.random.seed(7)
+    first_acc, min_acc = dcgan_sym.main(["--epochs", "3"])
+    assert min_acc < 0.9, \
+        "generator never fooled D: first=%s min=%s" % (first_acc, min_acc)
+
+
+def test_fcn_xs_gate():
+    """FCN segmentation (examples/fcn-xs/fcn_xs.py, parity
+    example/fcn-xs/symbol_fcnxs.py): conv trunk + 1x1 score +
+    Deconvolution upsample + Crop + multi_output SoftmaxOutput reaches
+    >0.9 per-pixel accuracy on separable rectangles."""
+    _example("fcn-xs", "fcn_xs.py")
+    import mxtpu as mx
+    mx.random.seed(42)  # deterministic init regardless of suite order
+    import fcn_xs
+    acc = fcn_xs.main(["--epochs", "12"])
+    assert acc > 0.9, "fcn-xs pixel accuracy stuck at %.3f" % acc
+
+
+def test_neural_style_gate():
+    """Neural style (examples/neural-style/nstyle.py, parity
+    example/neural-style/nstyle.py): input-space optimization against
+    Gram/content targets — the weighted loss must fall by >60%."""
+    _example("neural-style", "nstyle.py")
+    import mxtpu as mx
+    mx.random.seed(42)  # deterministic init regardless of suite order
+    import nstyle
+    first, last = nstyle.main(["--iters", "40"])
+    assert last < first * 0.4, \
+        "style loss barely moved: %.5f -> %.5f" % (first, last)
