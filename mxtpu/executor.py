@@ -228,6 +228,15 @@ class Executor:
         self._pending_grads = None
         self._fns = {}
         self._monitor_callback = None
+        # Adaptive heads-mode: callers that drive backward(out_grads)
+        # (Module's unfused path with an external loss — the reference's
+        # GraphExecutor keeps backward a separate cached program,
+        # src/executor/graph_executor.cc RunOps) flip this on; subsequent
+        # forwards then run the "fwd_vjp" program, which returns the vjp
+        # closure (a jax pytree) alongside the outputs so backward applies
+        # it directly instead of recomputing the whole forward.
+        self._heads_mode = False
+        self._cached_vjp = None
 
     def _as_dict(self, vals, names, what, allow_missing=False):
         if isinstance(vals, dict):
@@ -300,6 +309,36 @@ class Executor:
                 return outs, auxu, grads
 
             fn = jax.jit(fbh)
+        elif kind == "fwd_vjp":
+            # Forward that also returns the vjp closure. jax.vjp's result
+            # is a registered pytree (its leaves are the saved residuals),
+            # so it round-trips through jit; holding it keeps the
+            # residuals alive on device until backward consumes them.
+            run = _trace_graph(self._symbol, is_train=True,
+                               placements=self._placements)
+            gnames = tuple(self._grad_arg_names())
+
+            def fv(arg_vals, aux_vals, rng):
+                gvals = {n: arg_vals[n] for n in gnames}
+                other = {n: v for n, v in arg_vals.items() if n not in gnames}
+
+                def f(gv):
+                    av = dict(other)
+                    av.update(gv)
+                    return run(av, aux_vals, rng)
+
+                (outs, auxu), vjp = jax.vjp(f, gvals)
+                return outs, auxu, vjp
+
+            fn = jax.jit(fv)
+        elif kind == "vjp_apply":
+            def va(vjp, head_grads, auxu):
+                (grads,) = vjp((list(head_grads),
+                                {k: jnp.zeros_like(v)
+                                 for k, v in auxu.items()}))
+                return grads
+
+            fn = jax.jit(va)
         else:
             raise MXNetError("unknown program kind %s" % kind)
         fn = _with_matmul_precision(fn)
@@ -379,6 +418,7 @@ class Executor:
             outs, auxu = self._forward_profiled(is_train, raw_args, raw_aux,
                                                 rng)
             self._pending_grads = None
+            self._cached_vjp = None
             self._profiled_pending = is_train and bool(self._grad_arg_names())
             if is_train:
                 self._apply_aux(auxu)
@@ -389,9 +429,17 @@ class Executor:
         self._fwd_snapshot = (raw_args, raw_aux, rng)
         want_grad = bool(self._grad_arg_names())
         self._profiled_pending = False  # this forward is fused, not eager
+        self._cached_vjp = None
         if is_train and want_grad:
-            outs, auxu, grads = self._get_fn("fwd_bwd")(raw_args, raw_aux, rng)
-            self._pending_grads = grads
+            if self._heads_mode:
+                outs, auxu, vjp = self._get_fn("fwd_vjp")(raw_args, raw_aux,
+                                                          rng)
+                self._cached_vjp = (vjp, auxu)
+                self._pending_grads = None
+            else:
+                outs, auxu, grads = self._get_fn("fwd_bwd")(raw_args,
+                                                            raw_aux, rng)
+                self._pending_grads = grads
         else:
             kind = "fwd_train" if is_train else "fwd_eval"
             outs, auxu = self._get_fn(kind)(raw_args, raw_aux, rng)
@@ -405,6 +453,11 @@ class Executor:
             return
         if out_grads is None:
             grads = self._pending_grads
+            if grads is None and self._cached_vjp is not None:
+                vjp, auxu = self._cached_vjp
+                cts = [jnp.ones_like(o._data) for o in self.outputs]
+                grads = self._get_fn("vjp_apply")(vjp, cts, auxu)
+                self._cached_vjp = None
             if grads is None and getattr(self, "_profiled_pending", False):
                 # profiled forward ran node-by-node; grads come from the
                 # fused program, timed as one 'backward' span
@@ -420,17 +473,33 @@ class Executor:
         else:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
-            snap = getattr(self, "_fwd_snapshot", None)
-            if snap is not None:
-                raw_args, raw_aux, rng = snap
+            if self._cached_vjp is not None:
+                # fast path: the forward ran in heads-mode and kept its vjp
+                # closure — apply it to the caller's head gradients without
+                # re-running the forward
+                vjp, auxu = self._cached_vjp
+                grads = self._get_fn("vjp_apply")(
+                    vjp, [g._data for g in out_grads], auxu)
+                self._cached_vjp = None
             else:
-                raw_args, raw_aux, rng = (self._raw_args(), self._raw_aux(),
-                                          _rnd.next_key())
-            outs, _auxu, grads = self._get_fn("fwd_bwd_heads")(
-                raw_args, raw_aux, rng, [g._data for g in out_grads])
-            # aux updates were already applied by the matching forward;
-            # replaying here must not double-apply them
-            self._wrap_outputs(outs)
+                # first explicit-head backward on this executor: the
+                # matching forward didn't save residuals, so replay
+                # forward+backward as one program — and flip heads-mode so
+                # every subsequent forward caches its vjp (kills the 2x
+                # forward cost from iteration 2 on)
+                self._heads_mode = True
+                snap = getattr(self, "_fwd_snapshot", None)
+                if snap is not None:
+                    raw_args, raw_aux, rng = snap
+                else:
+                    raw_args, raw_aux, rng = (self._raw_args(),
+                                              self._raw_aux(),
+                                              _rnd.next_key())
+                outs, _auxu, grads = self._get_fn("fwd_bwd_heads")(
+                    raw_args, raw_aux, rng, [g._data for g in out_grads])
+                # aux updates were already applied by the matching forward;
+                # replaying here must not double-apply them
+                self._wrap_outputs(outs)
         for n, g in grads.items():
             req = self.grad_req.get(n, "null")
             dst = self.grad_dict.get(n)
