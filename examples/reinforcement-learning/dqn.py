@@ -1,0 +1,212 @@
+"""Deep Q-Network on a deterministic grid world (parity: the reference's
+example/reinforcement-learning/dqn — replay memory, epsilon-greedy
+exploration, target network, TD(0) regression; dqn_demo.py trains via a
+Q-value regression head exactly as here).
+
+TPU-native shape: the Q-network is one fused Module program (forward,
+TD-target regression backward, SGD update in a single jitted step); the
+environment and replay buffer stay host-side numpy, feeding fixed-shape
+batches so nothing retraces.
+
+Run:  python dqn.py --updates 400
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+
+
+class GridWorld:
+    """5x5 deterministic grid: start anywhere, goal at (4,4); reward +1 at
+    the goal, -0.01 per step. Observation = one-hot cell index."""
+
+    def __init__(self, n=5, max_steps=40, seed=0):
+        self.n = n
+        self.max_steps = max_steps
+        self._rng = np.random.RandomState(seed)
+        self.n_obs = n * n
+        self.n_act = 4  # up, down, left, right
+        self.reset()
+
+    def reset(self, pos=None):
+        self._pos = (tuple(pos) if pos is not None else
+                     (self._rng.randint(self.n), self._rng.randint(self.n)))
+        if self._pos == (self.n - 1, self.n - 1):
+            self._pos = (0, 0)
+        self._t = 0
+        return self._obs()
+
+    def _obs(self):
+        o = np.zeros(self.n_obs, dtype=np.float32)
+        o[self._pos[0] * self.n + self._pos[1]] = 1.0
+        return o
+
+    def step(self, act):
+        r, c = self._pos
+        dr, dc = ((-1, 0), (1, 0), (0, -1), (0, 1))[act]
+        self._pos = (min(max(r + dr, 0), self.n - 1),
+                     min(max(c + dc, 0), self.n - 1))
+        self._t += 1
+        done = self._pos == (self.n - 1, self.n - 1)
+        reward = 1.0 if done else -0.01
+        if self._t >= self.max_steps:
+            done = True
+        return self._obs(), reward, done
+
+
+class ReplayMemory:
+    """Uniform-sampling circular replay buffer (the reference keeps frames
+    in a numpy ring the same way, replay_memory.py)."""
+
+    def __init__(self, size, n_obs, rng):
+        self.size = size
+        self._rng = rng
+        self.obs = np.zeros((size, n_obs), np.float32)
+        self.act = np.zeros(size, np.int64)
+        self.rew = np.zeros(size, np.float32)
+        self.nxt = np.zeros((size, n_obs), np.float32)
+        self.done = np.zeros(size, np.float32)
+        self._n = 0
+        self._i = 0
+
+    def add(self, o, a, r, o2, d):
+        i = self._i
+        self.obs[i], self.act[i], self.rew[i] = o, a, r
+        self.nxt[i], self.done[i] = o2, float(d)
+        self._i = (i + 1) % self.size
+        self._n = min(self._n + 1, self.size)
+
+    def sample(self, k):
+        idx = self._rng.randint(0, self._n, k)
+        return (self.obs[idx], self.act[idx], self.rew[idx],
+                self.nxt[idx], self.done[idx])
+
+    def __len__(self):
+        return self._n
+
+
+def q_symbol(n_act, n_hidden=64):
+    data = mx.sym.Variable("data")
+    target = mx.sym.Variable("qtarget")
+    h = mx.sym.FullyConnected(data, num_hidden=n_hidden, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=n_hidden, name="fc2")
+    h = mx.sym.Activation(h, act_type="relu")
+    q = mx.sym.FullyConnected(h, num_hidden=n_act, name="qout")
+    return mx.sym.LinearRegressionOutput(q, target, name="td")
+
+
+def _batch(obs, tgt):
+    return mx.io.DataBatch(data=[mx.nd.array(obs)],
+                           label=[mx.nd.array(tgt)])
+
+
+def _predict_q(mod, obs, n_act, batch):
+    """Q-values for a (k, n_obs) observation block, padded to the bound
+    batch size (the network is compiled for one fixed shape)."""
+    k = obs.shape[0]
+    pad = np.zeros((batch, obs.shape[1]), np.float32)
+    pad[:k] = obs
+    mod.forward(_batch(pad, np.zeros((batch, n_act), np.float32)),
+                is_train=False)
+    return mod.get_outputs()[0].asnumpy()[:k]
+
+
+def greedy_action(mod, env, batch, o):
+    q = _predict_q(mod, o[None, :], env.n_act, batch)
+    return int(np.argmax(q[0]))
+
+
+def greedy_return(mod, env, batch, starts):
+    """Average undiscounted return of the greedy policy over fixed starts."""
+    totals = []
+    for s in starts:
+        o = env.reset(pos=s)
+        done, ret = False, 0.0
+        while not done:
+            o, r, done = env.step(greedy_action(mod, env, batch, o))
+            ret += r
+        totals.append(ret)
+    return float(np.mean(totals))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--updates", type=int, default=400)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--gamma", type=float, default=0.95)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--target-sync", type=int, default=25)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    rng = np.random.RandomState(args.seed)
+    mx.random.seed(args.seed)
+
+    env = GridWorld(seed=args.seed)
+    mem = ReplayMemory(4000, env.n_obs, rng)
+    sym = q_symbol(env.n_act)
+    batch = args.batch_size
+
+    def make_mod(for_training):
+        mod = mx.mod.Module(sym, data_names=("data",),
+                            label_names=("qtarget",), context=mx.cpu())
+        mod.bind(data_shapes=[("data", (batch, env.n_obs))],
+                 label_shapes=[("qtarget", (batch, env.n_act))],
+                 for_training=for_training)
+        return mod
+
+    qnet = make_mod(True)
+    qnet.init_params(mx.initializer.Xavier())
+    qnet.init_optimizer(optimizer="sgd",
+                        optimizer_params={"learning_rate": args.lr,
+                                          "momentum": 0.9})
+    tnet = make_mod(False)
+    tnet.init_params(mx.initializer.Xavier())
+
+    def sync_target():
+        a, x = qnet.get_params()
+        tnet.set_params(a, x)
+
+    sync_target()
+
+    eps, eps_min, eps_decay = 1.0, 0.05, 0.995
+    o = env.reset()
+    for upd in range(args.updates):
+        # interact: a handful of env steps per gradient update
+        for _ in range(4):
+            if rng.rand() < eps:
+                a = rng.randint(env.n_act)
+            else:
+                a = greedy_action(qnet, env, batch, o)
+            o2, r, done = env.step(a)
+            mem.add(o, a, r, o2, done)
+            o = env.reset() if done else o2
+        eps = max(eps_min, eps * eps_decay)
+        if len(mem) < batch:
+            continue
+        obs, act, rew, nxt, done = mem.sample(batch)
+        # TD target: r + gamma * max_a' Q_target(s', a') on live transitions
+        qn = _predict_q(tnet, nxt, env.n_act, batch)
+        tgt = _predict_q(qnet, obs, env.n_act, batch).copy()
+        tgt[np.arange(batch), act] = rew + args.gamma * (1 - done) * \
+            qn.max(axis=1)
+        b = _batch(obs, tgt)
+        qnet.forward(b, is_train=True)
+        qnet.backward()
+        qnet.update()
+        if (upd + 1) % args.target_sync == 0:
+            sync_target()
+        if (upd + 1) % 100 == 0:
+            logging.info("update %d eps=%.2f", upd + 1, eps)
+
+    starts = [(0, 0), (0, 4), (4, 0), (2, 2)]
+    ret = greedy_return(qnet, env, batch, starts)
+    logging.info("greedy mean return over fixed starts: %.3f", ret)
+    return ret
+
+
+if __name__ == "__main__":
+    print("greedy return: %.3f" % main())

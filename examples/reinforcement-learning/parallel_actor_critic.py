@@ -1,0 +1,155 @@
+"""Parallel advantage actor-critic (parity: the reference's
+example/reinforcement-learning/parallel_actor_critic — many environments
+stepped in lockstep, one batched policy+value network, policy-gradient +
+value-regression + entropy update per rollout chunk).
+
+TPU-native shape: the environments are a VECTORIZED numpy CartPole (one
+array op steps all of them), so the network always sees a fixed
+(n_envs*t_max, obs) batch — no retracing, and the whole update (forward,
+losses, backward, clip, step) is one autograd tape over fused ops.
+
+Run:  python parallel_actor_critic.py --iters 250
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd, gluon
+
+
+class VecCartPole:
+    """Classic CartPole-v0 dynamics, vectorized over n environments.
+
+    Physics follows the standard Barto-Sutton-Anderson equations; an
+    episode ends when |x| > 2.4, |theta| > 12 deg, or after 200 steps."""
+
+    def __init__(self, n, seed=0):
+        self.n = n
+        self._rng = np.random.RandomState(seed)
+        self.state = np.zeros((n, 4), np.float32)
+        self.steps = np.zeros(n, np.int64)
+        self.reset(np.arange(n))
+
+    def reset(self, idx):
+        self.state[idx] = self._rng.uniform(-0.05, 0.05,
+                                            (len(idx), 4)).astype(np.float32)
+        self.steps[idx] = 0
+        return self.state.copy()
+
+    def step(self, act):
+        g, mc, mp, length, f, tau = 9.8, 1.0, 0.1, 0.5, 10.0, 0.02
+        x, xd, th, thd = (self.state[:, 0], self.state[:, 1],
+                          self.state[:, 2], self.state[:, 3])
+        force = np.where(act == 1, f, -f)
+        costh, sinth = np.cos(th), np.sin(th)
+        tmp = (force + mp * length * thd ** 2 * sinth) / (mc + mp)
+        thacc = (g * sinth - costh * tmp) / (
+            length * (4.0 / 3.0 - mp * costh ** 2 / (mc + mp)))
+        xacc = tmp - mp * length * thacc * costh / (mc + mp)
+        self.state = np.stack([x + tau * xd, xd + tau * xacc,
+                               th + tau * thd, thd + tau * thacc],
+                              axis=1).astype(np.float32)
+        self.steps += 1
+        done = ((np.abs(self.state[:, 0]) > 2.4) |
+                (np.abs(self.state[:, 2]) > 12 * np.pi / 180) |
+                (self.steps >= 200))
+        reward = np.ones(self.n, np.float32)
+        if done.any():
+            self.reset(np.nonzero(done)[0])
+        return self.state.copy(), reward, done
+
+
+class ACNet(gluon.Block):
+    """Shared trunk, softmax policy head + scalar value head (the
+    reference's model.py Agent builds the same two-headed net)."""
+
+    def __init__(self, n_act, n_hidden=64, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.fc = gluon.nn.Dense(n_hidden, activation="tanh")
+            self.policy = gluon.nn.Dense(n_act)
+            self.value = gluon.nn.Dense(1)
+
+    def forward(self, x):
+        h = self.fc(x)
+        return self.policy(h), self.value(h)
+
+
+def discount(rewards, dones, bootstrap, gamma):
+    """Backward-accumulated n-step returns, cut at episode boundaries."""
+    t_max, n = rewards.shape
+    out = np.zeros((t_max, n), np.float32)
+    run = bootstrap
+    for t in range(t_max - 1, -1, -1):
+        run = rewards[t] + gamma * run * (1.0 - dones[t])
+        out[t] = run
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=250)
+    ap.add_argument("--n-envs", type=int, default=16)
+    ap.add_argument("--t-max", type=int, default=8)
+    ap.add_argument("--gamma", type=float, default=0.99)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--entropy-wt", type=float, default=0.01)
+    ap.add_argument("--value-wt", type=float, default=0.5)
+    ap.add_argument("--seed", type=int, default=3)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(args.seed)
+    rng = np.random.RandomState(args.seed)
+
+    envs = VecCartPole(args.n_envs, seed=args.seed)
+    net = ACNet(n_act=2)
+    net.initialize(mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    obs = envs.state.copy()
+    ep_lengths = []  # completed-episode lengths, rolling
+    for it in range(args.iters):
+        obs_buf = np.zeros((args.t_max, args.n_envs, 4), np.float32)
+        act_buf = np.zeros((args.t_max, args.n_envs), np.int64)
+        rew_buf = np.zeros((args.t_max, args.n_envs), np.float32)
+        done_buf = np.zeros((args.t_max, args.n_envs), np.float32)
+        for t in range(args.t_max):
+            logits, _ = net(mx.nd.array(obs))
+            p = mx.nd.softmax(logits).asnumpy()
+            acts = (p.cumsum(axis=1) > rng.rand(args.n_envs, 1)).argmax(1)
+            steps_before = envs.steps.copy()
+            obs_buf[t], act_buf[t] = obs, acts
+            obs, rew_buf[t], done = envs.step(acts)
+            done_buf[t] = done.astype(np.float32)
+            ep_lengths.extend(steps_before[done] + 1)
+        _, v_boot = net(mx.nd.array(obs))
+        returns = discount(rew_buf, done_buf,
+                           v_boot.asnumpy().ravel(), args.gamma)
+
+        flat_obs = mx.nd.array(obs_buf.reshape(-1, 4))
+        flat_act = mx.nd.array(act_buf.reshape(-1).astype(np.float32))
+        flat_ret = mx.nd.array(returns.reshape(-1, 1))
+        with autograd.record():
+            logits, values = net(flat_obs)
+            logp = mx.nd.log_softmax(logits)
+            p = mx.nd.softmax(logits)
+            adv = (flat_ret - values).detach()
+            chosen = mx.nd.pick(logp, flat_act, axis=1, keepdims=True)
+            pg_loss = -(chosen * adv).mean()
+            v_loss = ((values - flat_ret) ** 2).mean()
+            ent = -(p * logp).sum(axis=1).mean()
+            loss = (pg_loss + args.value_wt * v_loss -
+                    args.entropy_wt * ent)
+        loss.backward()
+        trainer.step(1)
+        if (it + 1) % 50 == 0 and ep_lengths:
+            logging.info("iter %d mean episode length (last 20): %.1f",
+                         it + 1, np.mean(ep_lengths[-20:]))
+    return float(np.mean(ep_lengths[-20:])) if ep_lengths else 0.0
+
+
+if __name__ == "__main__":
+    print("mean episode length: %.1f" % main())

@@ -1,0 +1,107 @@
+"""Stochastic-depth residual CNN (parity: the reference's
+example/stochastic-depth/sd_cifar10.py + sd_module.py — residual blocks
+whose conv branch is dropped whole with a per-block "death rate" during
+training and always kept at inference).
+
+TPU-native shape: the reference drives per-block Bernoulli gates from a
+custom Module that re-plumbs the executor every batch (sd_module.py).
+Here the gate lives INSIDE the one traced program: ``Dropout`` on a
+scalar ones-tensor is exactly a whole-block Bernoulli gate — {0,
+1/(1-death_rate)} in training, identity at inference — so the whole
+stochastic net stays a single fused jit step with no host control flow.
+
+Run:  python sd_cifar10.py --epochs 8
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+
+
+def sd_block(data, num_filter, death_rate, name):
+    """Pre-act residual block whose branch dies whole with prob death_rate."""
+    b = mx.sym.BatchNorm(data, fix_gamma=False, name=name + "_bn1")
+    b = mx.sym.Activation(b, act_type="relu")
+    b = mx.sym.Convolution(b, num_filter=num_filter, kernel=(3, 3),
+                           pad=(1, 1), no_bias=True, name=name + "_conv1")
+    b = mx.sym.BatchNorm(b, fix_gamma=False, name=name + "_bn2")
+    b = mx.sym.Activation(b, act_type="relu")
+    b = mx.sym.Convolution(b, num_filter=num_filter, kernel=(3, 3),
+                           pad=(1, 1), no_bias=True, name=name + "_conv2")
+    # whole-branch Bernoulli gate: Dropout of a scalar one — zero (branch
+    # dead) or 1/(1-p) (inverted scaling) in train, exactly 1.0 at eval
+    gate = mx.sym.Dropout(mx.sym.full((1, 1), 1.0), p=death_rate,
+                          name=name + "_gate")
+    b = mx.sym.broadcast_mul(b, mx.sym.Reshape(gate, shape=(1, 1, 1, 1)))
+    return data + b
+
+
+def get_symbol(num_classes, num_blocks=3, death_mode="linear_decay",
+               death_rate=0.5):
+    """Death rates rise linearly with depth (the paper's linear_decay rule,
+    mirrored from the reference example's --death-mode)."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=16, kernel=(3, 3), pad=(1, 1),
+                             no_bias=True, name="conv0")
+    for i in range(num_blocks):
+        if death_mode == "linear_decay":
+            rate = death_rate * (i + 1) / num_blocks
+        else:
+            rate = death_rate
+        net = sd_block(net, 16, rate, "sd%d" % i)
+    net = mx.sym.BatchNorm(net, fix_gamma=False, name="bn_last")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, global_pool=True, pool_type="avg",
+                         kernel=(1, 1))
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def synth_images(n, num_classes, rng, size=16):
+    """Class-dependent blob patterns, learnable by a small conv net."""
+    y = rng.randint(0, num_classes, n)
+    X = rng.randn(n, 3, size, size).astype("f4") * 0.3
+    for i in range(n):
+        c = y[i]
+        r0, c0 = (c // 4) % 3, c % 4
+        X[i, c % 3, r0 * 4:r0 * 4 + 5, c0 * 3:c0 * 3 + 4] += 1.5
+    return X, y.astype("f4")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-classes", type=int, default=8)
+    ap.add_argument("--death-rate", type=float, default=0.5)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--seed", type=int, default=5)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(args.seed)
+    rng = np.random.RandomState(args.seed)
+
+    X, y = synth_images(1600, args.num_classes, rng)
+    Xv, yv = synth_images(320, args.num_classes, rng)
+    train = mx.io.NDArrayIter(X, y, batch_size=args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(Xv, yv, batch_size=args.batch_size)
+
+    sym = get_symbol(args.num_classes, death_rate=args.death_rate)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.fit(train, eval_data=val, num_epoch=args.epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-4},
+            initializer=mx.initializer.Xavier(factor_type="in",
+                                              magnitude=2.0),
+            eval_metric="acc")
+    score = mod.score(val, mx.metric.Accuracy())[0][1]
+    logging.info("final val acc: %.3f", score)
+    return score
+
+
+if __name__ == "__main__":
+    print("val acc: %.3f" % main())

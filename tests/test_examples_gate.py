@@ -345,3 +345,42 @@ def test_neural_style_gate():
     first, last = nstyle.main(["--iters", "40"])
     assert last < first * 0.4, \
         "style loss barely moved: %.5f -> %.5f" % (first, last)
+
+
+def test_dqn_gate():
+    """DQN on the deterministic grid world (examples/reinforcement-learning/
+    dqn.py, parity example/reinforcement-learning/dqn): replay + target net
+    + TD regression must produce a greedy policy that reaches the goal —
+    mean return over fixed starts > 0.5 (random policy is ~ -0.3)."""
+    _example("reinforcement-learning", "dqn.py")
+    import mxtpu as mx
+    mx.random.seed(42)
+    import dqn
+    ret = dqn.main(["--updates", "400"])
+    assert ret > 0.5, "greedy return stuck at %.3f" % ret
+
+
+def test_parallel_actor_critic_gate():
+    """Parallel A2C on vectorized CartPole (examples/reinforcement-learning/
+    parallel_actor_critic.py, parity example/reinforcement-learning/
+    parallel_actor_critic): mean episode length over the last completed
+    episodes must clear 50 (untrained policy balances ~10-25 steps)."""
+    _example("reinforcement-learning", "parallel_actor_critic.py")
+    import mxtpu as mx
+    mx.random.seed(42)
+    import parallel_actor_critic
+    steps = parallel_actor_critic.main(["--iters", "250"])
+    assert steps > 50, "episode length stuck at %.1f" % steps
+
+
+def test_stochastic_depth_gate():
+    """Stochastic-depth residual net (examples/stochastic-depth/
+    sd_cifar10.py, parity example/stochastic-depth): whole-branch Bernoulli
+    gates via in-graph Dropout-on-ones train to >0.85 val accuracy, and the
+    gates are identity at inference (deterministic eval)."""
+    _example("stochastic-depth", "sd_cifar10.py")
+    import mxtpu as mx
+    mx.random.seed(42)
+    import sd_cifar10
+    acc = sd_cifar10.main(["--epochs", "8"])
+    assert acc > 0.85, "stochastic-depth net reached only %.3f" % acc

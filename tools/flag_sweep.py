@@ -38,7 +38,7 @@ def main():
         # BENCH_NO_LASTGOOD: sweep combos (some deliberately degraded) must
         # not overwrite the headline last-good record bench.py falls back on
         env = dict(os.environ, BENCH_ITERS=iters, BENCH_TIMEOUT="900",
-                   BENCH_NO_LASTGOOD="1")
+                   BENCH_NO_LASTGOOD="1", BENCH_RECORDIO="0")
         if flags:
             env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flags).strip()
         r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
