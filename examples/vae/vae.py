@@ -1,0 +1,109 @@
+"""Variational autoencoder (parity: the reference's example/vae — MLP
+encoder to a diagonal-Gaussian latent, reparameterized sampling, MLP
+decoder to Bernoulli pixels, ELBO = reconstruction + KL to N(0, I)).
+
+TPU-native shape: the reparameterization noise comes from the framework's
+threaded PRNG (mx.nd.random_normal), so the whole ELBO step — encode,
+sample, decode, both loss terms, backward — is one autograd tape over
+fused ops with no host round trips.
+
+Run:  python vae.py --epochs 30
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd, gluon
+
+
+class VAE(gluon.Block):
+    def __init__(self, n_in, n_latent=4, n_hidden=64, **kw):
+        super().__init__(**kw)
+        self.n_latent = n_latent
+        with self.name_scope():
+            self.enc_h = gluon.nn.Dense(n_hidden, activation="tanh")
+            self.enc_mu = gluon.nn.Dense(n_latent)
+            self.enc_logvar = gluon.nn.Dense(n_latent)
+            self.dec_h = gluon.nn.Dense(n_hidden, activation="tanh")
+            self.dec_x = gluon.nn.Dense(n_in)
+
+    def forward(self, x):
+        h = self.enc_h(x)
+        mu, logvar = self.enc_mu(h), self.enc_logvar(h)
+        eps = mx.nd.random_normal(shape=mu.shape)
+        z = mu + mx.nd.exp(0.5 * logvar) * eps
+        logits = self.dec_x(self.dec_h(z))
+        return logits, mu, logvar
+
+
+def elbo_loss(x, logits, mu, logvar):
+    """Negative ELBO: Bernoulli reconstruction + analytic Gaussian KL."""
+    # log-sigmoid reconstruction, numerically stable
+    rec = (mx.nd.relu(logits) - logits * x +
+           mx.nd.log(1.0 + mx.nd.exp(-mx.nd.abs(logits)))).sum(axis=1)
+    kl = 0.5 * (mx.nd.exp(logvar) + mu ** 2 - 1.0 - logvar).sum(axis=1)
+    return (rec + kl).mean()
+
+
+def glyph_data(n, rng, size=8, protos=None):
+    """Binary prototype glyphs with pixel noise: a latent structure a 4-D
+    code can capture. Pass the same `protos` for train/val so both draw
+    from one distribution."""
+    if protos is None:
+        protos = (rng.rand(6, size * size) > 0.6).astype("f4")
+    idx = rng.randint(0, len(protos), n)
+    X = protos[idx]
+    flip = rng.rand(n, size * size) < 0.05
+    return np.abs(X - flip.astype("f4")), protos
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=30)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.005)
+    ap.add_argument("--seed", type=int, default=2)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(args.seed)
+    rng = np.random.RandomState(args.seed)
+
+    X, protos = glyph_data(1024, rng)
+    Xv, _ = glyph_data(256, rng, protos=protos)
+    net = VAE(X.shape[1])
+    net.initialize(mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    def val_elbo():
+        logits, mu, logvar = net(mx.nd.array(Xv))
+        return float(elbo_loss(mx.nd.array(Xv), logits, mu,
+                               logvar).asnumpy())
+
+    start = val_elbo()
+    n_batches = len(X) // args.batch_size
+    for ep in range(args.epochs):
+        perm = rng.permutation(len(X))
+        tot = 0.0
+        for b in range(n_batches):
+            xb = mx.nd.array(X[perm[b * args.batch_size:
+                                    (b + 1) * args.batch_size]])
+            with autograd.record():
+                logits, mu, logvar = net(xb)
+                loss = elbo_loss(xb, logits, mu, logvar)
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asnumpy())
+        if (ep + 1) % 10 == 0:
+            logging.info("epoch %d train -ELBO %.2f", ep + 1,
+                         tot / n_batches)
+    end = val_elbo()
+    logging.info("val -ELBO: %.2f -> %.2f", start, end)
+    return start, end
+
+
+if __name__ == "__main__":
+    s, e = main()
+    print("val -ELBO %.2f -> %.2f" % (s, e))

@@ -384,3 +384,74 @@ def test_stochastic_depth_gate():
     import sd_cifar10
     acc = sd_cifar10.main(["--epochs", "8"])
     assert acc > 0.85, "stochastic-depth net reached only %.3f" % acc
+
+
+def test_dec_gate():
+    """Deep Embedded Clustering (examples/dec/dec.py, parity
+    example/dec/dec.py): AE pretrain + Student-t KL refinement with
+    trainable centroids must reach >0.9 clustering accuracy on 4 blobs
+    through a 2-D bottleneck."""
+    _example("dec", "dec.py")
+    import mxtpu as mx
+    mx.random.seed(42)
+    import dec
+    acc = dec.main([])
+    assert acc > 0.9, "DEC cluster accuracy stuck at %.3f" % acc
+
+
+def test_vae_gate():
+    """Variational autoencoder (examples/vae/vae.py, parity example/vae):
+    reparameterized ELBO training must cut the validation negative ELBO to
+    under half its untrained value."""
+    _example("vae", "vae.py")
+    import mxtpu as mx
+    mx.random.seed(42)
+    import vae
+    start, end = vae.main(["--epochs", "30"])
+    assert end < 0.5 * start, "-ELBO %.2f -> %.2f (no real improvement)" \
+        % (start, end)
+
+
+def test_dsd_gate():
+    """Dense-Sparse-Dense retraining (examples/dsd/dsd.py, parity
+    example/dsd): magnitude pruning to 60% sparsity must actually zero the
+    weights mid-phase, and the final re-densified model must hold the dense
+    baseline's accuracy (within 2 points) or beat it."""
+    _example("dsd", "dsd.py")
+    import mxtpu as mx
+    mx.random.seed(42)
+    import dsd
+    dense, sparse, final, frac_zero = dsd.main([])
+    assert frac_zero > 0.55, "mask not applied: zero frac %.2f" % frac_zero
+    assert final >= dense - 0.02, \
+        "DSD lost accuracy: dense %.3f -> final %.3f" % (dense, final)
+
+
+def test_speech_acoustic_gate():
+    """Frame-level acoustic model (examples/speech-demo/speech_acoustic.py,
+    parity example/speech-demo): BiLSTM over synthetic filterbank frames
+    with per-frame cross-entropy must clear 0.9 frame accuracy (chance is
+    ~0.17 over 6 phoneme classes)."""
+    _example("speech-demo", "speech_acoustic.py")
+    import mxtpu as mx
+    mx.random.seed(42)
+    import speech_acoustic
+    acc = speech_acoustic.main(["--epochs", "8"])
+    assert acc > 0.9, "frame accuracy stuck at %.3f" % acc
+
+
+def test_sgld_bnn_gate():
+    """SGLD Bayesian net (examples/bayesian-methods/sgld_bnn.py, parity
+    example/bayesian-methods): posterior-ensemble prediction must classify
+    two-moons >0.9 and be more uncertain off-distribution than on it."""
+    _example("bayesian-methods", "sgld_bnn.py")
+    import mxtpu as mx
+    mx.random.seed(42)
+    import sgld_bnn
+    acc_single, acc_ens, h_mean, h_ens, spread = sgld_bnn.main(
+        ["--epochs", "30", "--burn-in", "15", "--lr", "0.0003"])
+    assert acc_ens > 0.9, "ensemble accuracy %.3f" % acc_ens
+    assert spread > 1e-4, "posterior collapsed: weight spread %.5f" % spread
+    # Jensen: mixture entropy dominates the mean per-sample entropy
+    assert h_ens >= h_mean - 1e-6, \
+        "mixture entropy %.3f below mean single %.3f" % (h_ens, h_mean)
