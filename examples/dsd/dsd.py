@@ -31,11 +31,15 @@ def mlp(num_classes):
                                 name="softmax")
 
 
-def synth(n, num_classes, rng, dim=64):
-    W = rng.randn(dim, num_classes).astype("f4")
+def synth(n, num_classes, rng, dim=64, W=None):
+    """Draw samples from a fixed ground-truth map W (pass the SAME W for
+    train and validation — separate draws would make val labels
+    uncorrelated with the trained mapping)."""
+    if W is None:
+        W = rng.randn(dim, num_classes).astype("f4")
     X = rng.randn(n, dim).astype("f4")
-    y = (X @ W + 0.5 * rng.randn(n, num_classes)).argmax(1)
-    return X, y.astype("f4")
+    y = (X @ W + 0.3 * rng.randn(n, num_classes)).argmax(1)
+    return X, y.astype("f4"), W
 
 
 def fit_epochs(mod, it, epochs, lr):
@@ -78,7 +82,7 @@ def apply_masks(mod, masks):
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--sparsity", type=float, default=0.6)
-    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--epochs", type=int, default=10)
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--seed", type=int, default=9)
     args = ap.parse_args(argv)
@@ -87,8 +91,8 @@ def main(argv=None):
     rng = np.random.RandomState(args.seed)
     num_classes = 6
 
-    X, y = synth(2000, num_classes, rng)
-    Xv, yv = synth(400, num_classes, rng)
+    X, y, W = synth(2000, num_classes, rng)
+    Xv, yv, _ = synth(400, num_classes, rng, W=W)
     it = mx.io.NDArrayIter(X, y, batch_size=args.batch_size, shuffle=True)
     val = mx.io.NDArrayIter(Xv, yv, batch_size=args.batch_size)
 
@@ -97,7 +101,7 @@ def main(argv=None):
     mod.init_params(mx.initializer.Xavier())
 
     # D: dense training
-    fit_epochs(mod, it, args.epochs, 0.1)
+    fit_epochs(mod, it, args.epochs, 0.05)
     acc_dense = mod.score(val, mx.metric.Accuracy())[0][1]
 
     # S: prune + masked retrain (mask re-applied after every update)

@@ -1,0 +1,372 @@
+"""Faster R-CNN end-to-end training on synthetic detection data (parity:
+example/rcnn/train_end2end.py — the two-stage detector wiring: RPN heads
+trained with anchor targets, `_contrib_Proposal` turning RPN outputs into
+ROIs, a python CustomOp assigning stage-2 targets to sampled proposals
+(the reference's rcnn/symbol/proposal_target.py layer), `ROIPooling` over
+the shared feature map, and joint classification + smooth-L1 bbox heads).
+
+Images are 3x64x64 with one painted rectangle whose class is its color
+channel (the same signal as examples/ssd/_synth.py). The gate is top-1
+detection accuracy: predicted class matches AND IoU > 0.5.
+
+Run:  python train_end2end.py --epochs 6
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu.ops.spatial import _gen_anchors
+
+IMG = 64
+STRIDE = 8
+FEAT = IMG // STRIDE
+SCALES = (2.0, 3.0)
+RATIOS = (1.0,)
+A = len(SCALES) * len(RATIOS)
+NUM_CLASSES = 3            # fg classes; stage-2 adds background as class 0
+ROIS_PER_IMG = 8
+POST_NMS = 16
+
+
+def _all_anchors():
+    """(A*H*W, 4) pixel anchors in label order a*H*W + y*W + x — the order
+    rpn_cls_score reshaped to (2, A, H, W) flattens to."""
+    base = _gen_anchors(STRIDE, SCALES, RATIOS)  # (A,4)
+    out = np.zeros((A, FEAT, FEAT, 4), np.float32)
+    for a in range(A):
+        for y in range(FEAT):
+            for x in range(FEAT):
+                sx, sy = x * STRIDE, y * STRIDE
+                out[a, y, x] = base[a] + [sx, sy, sx, sy]
+    return out.reshape(-1, 4)
+
+
+ANCHORS = _all_anchors()
+
+
+def _iou(boxes, gt):
+    """boxes (K,4), gt (4,) -> (K,) IoU with the +1 width convention."""
+    ix1 = np.maximum(boxes[:, 0], gt[0])
+    iy1 = np.maximum(boxes[:, 1], gt[1])
+    ix2 = np.minimum(boxes[:, 2], gt[2])
+    iy2 = np.minimum(boxes[:, 3], gt[3])
+    iw = np.maximum(ix2 - ix1 + 1, 0)
+    ih = np.maximum(iy2 - iy1 + 1, 0)
+    inter = iw * ih
+    area = ((boxes[:, 2] - boxes[:, 0] + 1) * (boxes[:, 3] - boxes[:, 1] + 1)
+            + (gt[2] - gt[0] + 1) * (gt[3] - gt[1] + 1) - inter)
+    return inter / np.maximum(area, 1e-6)
+
+
+def _bbox_transform(anchors, gt):
+    """Encode gt (4,) against anchors (K,4) -> (K,4) [dx,dy,dw,dh]."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1
+    ah = anchors[:, 3] - anchors[:, 1] + 1
+    acx = anchors[:, 0] + 0.5 * (aw - 1)
+    acy = anchors[:, 1] + 0.5 * (ah - 1)
+    gw = gt[2] - gt[0] + 1
+    gh = gt[3] - gt[1] + 1
+    gcx = gt[0] + 0.5 * (gw - 1)
+    gcy = gt[1] + 0.5 * (gh - 1)
+    return np.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                     np.log(gw / aw), np.log(gh / ah)], axis=-1)
+
+
+def _bbox_decode(rois, deltas):
+    """Decode stage-2 deltas (K,4) against roi boxes (K,4)."""
+    w = rois[:, 2] - rois[:, 0] + 1
+    h = rois[:, 3] - rois[:, 1] + 1
+    cx = rois[:, 0] + 0.5 * (w - 1)
+    cy = rois[:, 1] + 0.5 * (h - 1)
+    pcx = deltas[:, 0] * w + cx
+    pcy = deltas[:, 1] * h + cy
+    pw = np.exp(deltas[:, 2]) * w
+    ph = np.exp(deltas[:, 3]) * h
+    return np.stack([pcx - 0.5 * (pw - 1), pcy - 0.5 * (ph - 1),
+                     pcx + 0.5 * (pw - 1), pcy + 0.5 * (ph - 1)], axis=-1)
+
+
+def make_batch(rng, n):
+    """Returns data (N,3,64,64), im_info (N,3), rpn_label (N, A*H*W),
+    rpn_bbox_target (N,4A,H,W), rpn_bbox_weight, gt_boxes (N,1,5) px."""
+    x = rng.rand(n, 3, IMG, IMG).astype(np.float32) * 0.1
+    gt = np.zeros((n, 1, 5), np.float32)
+    lab = np.full((n, A * FEAT * FEAT), -1.0, np.float32)
+    btgt = np.zeros((n, 4 * A, FEAT, FEAT), np.float32)
+    bwt = np.zeros_like(btgt)
+    for b in range(n):
+        cls = rng.randint(0, NUM_CLASSES)
+        cx, cy = rng.uniform(0.3, 0.7, 2) * IMG
+        half = rng.uniform(7.0, 12.0, 2)
+        x1, y1 = max(cx - half[0], 1), max(cy - half[1], 1)
+        x2, y2 = min(cx + half[0], IMG - 2), min(cy + half[1], IMG - 2)
+        x[b, cls, int(y1):int(y2), int(x1):int(x2)] = 1.0
+        gt[b, 0] = [cls, x1, y1, x2, y2]
+        ious = _iou(ANCHORS, gt[b, 0, 1:])
+        pos = ious > 0.5
+        pos[np.argmax(ious)] = True
+        neg = ious < 0.3
+        lab[b, pos] = 1.0
+        # balance: keep ~3 negatives per positive, ignore the rest
+        neg_idx = np.where(neg & ~pos)[0]
+        keep = rng.permutation(neg_idx)[:max(3 * int(pos.sum()), 6)]
+        lab[b, keep] = 0.0
+        tgt = _bbox_transform(ANCHORS, gt[b, 0, 1:])
+        for idx in np.where(pos)[0]:
+            a, rem = divmod(idx, FEAT * FEAT)
+            fy, fx = divmod(rem, FEAT)
+            btgt[b, 4 * a:4 * a + 4, fy, fx] = tgt[idx]
+            bwt[b, 4 * a:4 * a + 4, fy, fx] = 1.0
+    info = np.tile(np.array([IMG, IMG, 1.0], np.float32), (n, 1))
+    return x, info, lab, btgt, bwt, gt
+
+
+class ProposalTarget(mx.operator.CustomOp):
+    """Stage-2 target assignment (reference rcnn proposal_target.py): sample
+    a fixed ROIS_PER_IMG proposals per image (gt box appended so positives
+    always exist), label each by IoU, and emit per-class bbox targets."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        rois = in_data[0].asnumpy()        # (N*POST, 5)
+        gts = in_data[1].asnumpy()         # (N, 1, 5)
+        n = gts.shape[0]
+        R = ROIS_PER_IMG
+        K1 = NUM_CLASSES + 1
+        out_rois = np.zeros((n * R, 5), np.float32)
+        labels = np.zeros((n * R,), np.float32)
+        btgt = np.zeros((n * R, 4 * K1), np.float32)
+        bwt = np.zeros_like(btgt)
+        per_img = rois.reshape(n, -1, 5)
+        for b in range(n):
+            # gt box joins the candidate pool so positives always exist
+            cand = np.concatenate([per_img[b][:, 1:], gts[b, :, 1:]])
+            ious = _iou(cand, gts[b, 0, 1:])
+            order = np.argsort(-ious)
+            fg = order[ious[order] > 0.5][:R // 2]
+            bg = order[ious[order] <= 0.5][:R - len(fg)]
+            pick = np.concatenate([fg, bg])
+            if len(pick) < R:              # degenerate: repeat best
+                pick = np.resize(pick, R)
+            sel = cand[pick]
+            out_rois[b * R:(b + 1) * R, 0] = b
+            out_rois[b * R:(b + 1) * R, 1:] = sel
+            cls = int(gts[b, 0, 0]) + 1
+            is_fg = ious[pick] > 0.5
+            labels[b * R:(b + 1) * R] = np.where(is_fg, cls, 0)
+            tgt = _bbox_transform(sel, gts[b, 0, 1:])
+            for i in np.where(is_fg)[0]:
+                btgt[b * R + i, 4 * cls:4 * cls + 4] = tgt[i]
+                bwt[b * R + i, 4 * cls:4 * cls + 4] = 1.0
+        for i, arr in enumerate([out_rois, labels, btgt, bwt]):
+            self.assign(out_data[i], req[i], arr)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        for i in range(len(in_grad)):
+            self.assign(in_grad[i], req[i],
+                        np.zeros(in_grad[i].shape, np.float32))
+
+
+@mx.operator.register("proposal_target")
+class ProposalTargetProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["rois", "gt_boxes"]
+
+    def list_outputs(self):
+        return ["rois_out", "label", "bbox_target", "bbox_weight"]
+
+    def infer_shape(self, in_shape):
+        n = in_shape[1][0]
+        R = n * ROIS_PER_IMG
+        K1 = NUM_CLASSES + 1
+        return in_shape, [[R, 5], [R], [R, 4 * K1], [R, 4 * K1]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return ProposalTarget()
+
+
+def backbone(data):
+    body = mx.sym.Convolution(data, num_filter=16, kernel=(3, 3),
+                              pad=(1, 1), name="conv1")
+    body = mx.sym.Activation(body, act_type="relu")
+    body = mx.sym.Pooling(body, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    body = mx.sym.Convolution(body, num_filter=32, kernel=(3, 3),
+                              pad=(1, 1), name="conv2")
+    body = mx.sym.Activation(body, act_type="relu")
+    body = mx.sym.Pooling(body, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    body = mx.sym.Convolution(body, num_filter=32, kernel=(3, 3),
+                              pad=(1, 1), stride=(2, 2), name="conv3")
+    return mx.sym.Activation(body, act_type="relu")
+
+
+def rpn_heads(feat):
+    rpn = mx.sym.Convolution(feat, num_filter=64, kernel=(3, 3), pad=(1, 1),
+                             name="rpn_conv")
+    rpn = mx.sym.Activation(rpn, act_type="relu")
+    score = mx.sym.Convolution(rpn, num_filter=2 * A, kernel=(1, 1),
+                               name="rpn_cls_score")
+    bbox = mx.sym.Convolution(rpn, num_filter=4 * A, kernel=(1, 1),
+                              name="rpn_bbox_pred")
+    return score, bbox
+
+
+def _proposal_rois(score, bbox, im_info, post_nms):
+    """softmax the RPN scores and run the Proposal op (grad-blocked — the
+    reference's proposal layer is likewise non-differentiable)."""
+    prob = mx.sym.Reshape(score, shape=(0, 2, -1))
+    prob = mx.sym.softmax(prob, axis=1)
+    prob = mx.sym.Reshape(prob, shape=(0, 2 * A, FEAT, FEAT))
+    return mx.sym.contrib.Proposal(
+        mx.sym.BlockGrad(prob), mx.sym.BlockGrad(bbox), im_info,
+        feature_stride=STRIDE, scales=SCALES, ratios=RATIOS,
+        rpn_pre_nms_top_n=A * FEAT * FEAT, rpn_post_nms_top_n=post_nms,
+        threshold=0.7, rpn_min_size=4)
+
+
+def stage2_heads(feat, rois):
+    pooled = mx.sym.ROIPooling(feat, rois, pooled_size=(4, 4),
+                               spatial_scale=1.0 / STRIDE)
+    flat = mx.sym.Flatten(pooled)
+    fc = mx.sym.FullyConnected(flat, num_hidden=128, name="fc6")
+    fc = mx.sym.Activation(fc, act_type="relu")
+    cls_score = mx.sym.FullyConnected(fc, num_hidden=NUM_CLASSES + 1,
+                                      name="cls_score")
+    bbox_pred = mx.sym.FullyConnected(fc, num_hidden=4 * (NUM_CLASSES + 1),
+                                      name="bbox_pred")
+    return cls_score, bbox_pred
+
+
+def build_train_symbol():
+    data = mx.sym.Variable("data")
+    im_info = mx.sym.Variable("im_info")
+    rpn_label = mx.sym.Variable("rpn_label")
+    rpn_bbox_target = mx.sym.Variable("rpn_bbox_target")
+    rpn_bbox_weight = mx.sym.Variable("rpn_bbox_weight")
+    gt_boxes = mx.sym.Variable("gt_boxes")
+
+    feat = backbone(data)
+    score, bbox = rpn_heads(feat)
+
+    score_2 = mx.sym.Reshape(score, shape=(0, 2, -1))
+    rpn_cls_loss = mx.sym.SoftmaxOutput(
+        score_2, rpn_label, multi_output=True, use_ignore=True,
+        ignore_label=-1, normalization="valid", name="rpn_cls_prob")
+    rpn_bbox_loss = mx.sym.MakeLoss(
+        mx.sym.sum(mx.sym.smooth_l1(rpn_bbox_weight * (bbox - rpn_bbox_target),
+                                    scalar=3.0)),
+        grad_scale=1.0 / (A * FEAT * FEAT), name="rpn_bbox_loss")
+
+    rois = _proposal_rois(score, bbox, im_info, POST_NMS)
+    group = mx.sym.Custom(rois, gt_boxes, op_type="proposal_target")
+    rois_out, s2_label, s2_tgt, s2_wt = (group[0], group[1], group[2],
+                                         group[3])
+
+    cls_score, bbox_pred = stage2_heads(feat, rois_out)
+    cls_loss = mx.sym.SoftmaxOutput(cls_score, s2_label,
+                                    normalization="batch", name="cls_prob")
+    bbox_loss = mx.sym.MakeLoss(
+        mx.sym.sum(mx.sym.smooth_l1(s2_wt * (bbox_pred - s2_tgt),
+                                    scalar=1.0)),
+        grad_scale=1.0 / ROIS_PER_IMG, name="bbox_loss")
+    return mx.sym.Group([rpn_cls_loss, rpn_bbox_loss, cls_loss, bbox_loss])
+
+
+def build_test_symbol():
+    data = mx.sym.Variable("data")
+    im_info = mx.sym.Variable("im_info")
+    feat = backbone(data)
+    score, bbox = rpn_heads(feat)
+    rois = _proposal_rois(score, bbox, im_info, ROIS_PER_IMG)
+    cls_score, bbox_pred = stage2_heads(feat, rois)
+    cls_prob = mx.sym.softmax(cls_score, axis=-1)
+    return mx.sym.Group([rois, cls_prob, bbox_pred])
+
+
+def evaluate(mod, rng, batches, batch_size):
+    """Top-1 detection accuracy: best-scored fg roi per image must carry the
+    right class and IoU>0.5 after bbox decode."""
+    correct = total = 0
+    for _ in range(batches):
+        x, info, _, _, _, gt = make_batch(rng, batch_size)
+        mod.forward(mx.io.DataBatch(
+            data=[mx.nd.array(x), mx.nd.array(info)], label=[], pad=0,
+            index=None), is_train=False)
+        rois, prob, deltas = [o.asnumpy() for o in mod.get_outputs()]
+        R = ROIS_PER_IMG
+        for b in range(batch_size):
+            p = prob[b * R:(b + 1) * R]
+            fg_score = p[:, 1:]
+            flat = np.argmax(fg_score)
+            ri, cls = divmod(int(flat), NUM_CLASSES)
+            roi = rois[b * R + ri, 1:]
+            d = deltas[b * R + ri, 4 * (cls + 1):4 * (cls + 2)]
+            box = _bbox_decode(roi[None, :], d[None, :])[0]
+            ok = (cls == int(gt[b, 0, 0]) and
+                  _iou(box[None, :], gt[b, 0, 1:])[0] > 0.5)
+            correct += int(ok)
+            total += 1
+    return correct / max(total, 1)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--batches-per-epoch", type=int, default=24)
+    ap.add_argument("--lr", type=float, default=0.003)
+    ap.add_argument("--seed", type=int, default=5)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(args.seed)
+
+    sym = build_train_symbol()
+    mod = mx.mod.Module(
+        sym, context=mx.cpu(0), data_names=("data", "im_info"),
+        label_names=("rpn_label", "rpn_bbox_target", "rpn_bbox_weight",
+                     "gt_boxes"))
+    n = args.batch_size
+    mod.bind(data_shapes=[("data", (n, 3, IMG, IMG)), ("im_info", (n, 3))],
+             label_shapes=[("rpn_label", (n, A * FEAT * FEAT)),
+                           ("rpn_bbox_target", (n, 4 * A, FEAT, FEAT)),
+                           ("rpn_bbox_weight", (n, 4 * A, FEAT, FEAT)),
+                           ("gt_boxes", (n, 1, 5))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": args.lr})
+
+    rng = np.random.RandomState(args.seed)
+    for epoch in range(args.epochs):
+        losses = []
+        for _ in range(args.batches_per_epoch):
+            x, info, lab, btgt, bwt, gt = make_batch(rng, n)
+            batch = mx.io.DataBatch(
+                data=[mx.nd.array(x), mx.nd.array(info)],
+                label=[mx.nd.array(lab), mx.nd.array(btgt),
+                       mx.nd.array(bwt), mx.nd.array(gt)],
+                pad=0, index=None)
+            mod.forward_backward(batch)
+            mod.update()
+            outs = mod.get_outputs()
+            losses.append(float(outs[1].asnumpy()) +
+                          float(outs[3].asnumpy()))
+        logging.info("Epoch[%d] rpn+rcnn bbox loss %.4f", epoch,
+                     np.mean(losses))
+
+    # share trained weights into the test symbol
+    test_mod = mx.mod.Module(build_test_symbol(), context=mx.cpu(0),
+                             data_names=("data", "im_info"), label_names=None)
+    test_mod.bind(data_shapes=[("data", (n, 3, IMG, IMG)),
+                               ("im_info", (n, 3))], for_training=False)
+    arg_params, aux_params = mod.get_params()
+    test_mod.set_params(arg_params, aux_params, allow_missing=False)
+    acc = evaluate(test_mod, np.random.RandomState(77), 8, n)
+    logging.info("detection accuracy %.3f", acc)
+    return acc
+
+
+if __name__ == "__main__":
+    print("rcnn detection accuracy %.3f" % main())

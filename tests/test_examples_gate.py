@@ -423,6 +423,7 @@ def test_dsd_gate():
     import dsd
     dense, sparse, final, frac_zero = dsd.main([])
     assert frac_zero > 0.55, "mask not applied: zero frac %.2f" % frac_zero
+    assert final > 0.8, "DSD model never learned: final %.3f" % final
     assert final >= dense - 0.02, \
         "DSD lost accuracy: dense %.3f -> final %.3f" % (dense, final)
 
@@ -455,3 +456,29 @@ def test_sgld_bnn_gate():
     # Jensen: mixture entropy dominates the mean per-sample entropy
     assert h_ens >= h_mean - 1e-6, \
         "mixture entropy %.3f below mean single %.3f" % (h_ens, h_mean)
+
+
+def test_lstm_ocr_ctc_gate():
+    """LSTM+CTC OCR (examples/ctc/lstm_ocr.py, parity example/ctc/
+    lstm_ocr.py + example/captcha): an unrolled two-layer LSTM over image
+    columns with the `_contrib_CTCLoss` head must read >0.8 of held-out
+    variable-length digit strips exactly (greedy CTC decode)."""
+    _example("ctc", "lstm_ocr.py")
+    import mxtpu as mx
+    mx.random.seed(42)  # deterministic init regardless of suite order
+    import lstm_ocr
+    acc = lstm_ocr.main(["--epochs", "25", "--lr", "0.01"])
+    assert acc > 0.8, "OCR sequence accuracy stuck at %.3f" % acc
+
+
+def test_rcnn_gate():
+    """Faster R-CNN (examples/rcnn/train_end2end.py, parity example/rcnn):
+    RPN anchor losses + `_contrib_Proposal` + CustomOp proposal-target
+    sampling + ROIPooling heads trained jointly must localize+classify
+    >0.8 of synthetic single-object scenes (IoU>0.5, right class)."""
+    _example("rcnn", "train_end2end.py")
+    import mxtpu as mx
+    mx.random.seed(42)  # deterministic init regardless of suite order
+    import train_end2end
+    acc = train_end2end.main(["--epochs", "6"])
+    assert acc > 0.8, "rcnn detection accuracy stuck at %.3f" % acc
