@@ -58,8 +58,9 @@ def main(argv=None):
     ap.add_argument("--lr", type=float, default=0.0003)
     ap.add_argument("--seed", type=int, default=8)
     args = ap.parse_args(argv)
+    args.epochs = max(args.epochs, 1)
     if args.burn_in >= args.epochs:   # guarantee a non-empty posterior
-        args.burn_in = max(args.epochs - 1, 0)
+        args.burn_in = args.epochs - 1
     logging.basicConfig(level=logging.INFO)
     mx.random.seed(args.seed)
     rng = np.random.RandomState(args.seed)

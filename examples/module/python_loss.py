@@ -1,0 +1,81 @@
+"""A numpy loss head behind a symbolic trunk via SequentialModule
+(parity: example/module/python_loss.py — the reference chains
+SequentialModule(Module(MLP), PythonLossModule(grad_func=mc_hinge_grad)):
+the multiclass-hinge gradient is computed in plain numpy on the host and
+injected back into the symbolic trunk's backward).
+
+Run:  python python_loss.py --epochs 8
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+
+
+def mc_hinge_grad(scores, labels):
+    """Crammer-Singer multiclass hinge subgradient, pure numpy."""
+    scores = scores.asnumpy()
+    labels = labels.asnumpy().astype(np.int64)
+    n, _ = scores.shape
+    grad = np.zeros_like(scores)
+    for i in range(n):
+        margin = 1.0 + scores[i] - scores[i, labels[i]]
+        margin[labels[i]] = 0.0
+        worst = margin.argmax()
+        if margin[worst] > 0:
+            grad[i, labels[i]] -= 1.0
+            grad[i, worst] += 1.0
+    return grad / n
+
+
+def synth(n, rng, classes=5, dim=32):
+    protos = (rng.rand(classes, dim) > 0.5).astype("f4")
+    y = rng.randint(0, classes, n)
+    X = protos[y] + rng.randn(n, dim).astype("f4") * 0.25
+    return X, y.astype("f4")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-examples", type=int, default=1024)
+    ap.add_argument("--seed", type=int, default=4)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(args.seed)
+
+    rng = np.random.RandomState(args.seed)
+    X, y = synth(args.num_examples, rng)
+    nval = args.num_examples // 4
+    train = mx.io.NDArrayIter(X[:-nval], y[:-nval], args.batch_size,
+                              shuffle=True, label_name="softmax_label")
+    val = mx.io.NDArrayIter(X[-nval:], y[-nval:], args.batch_size,
+                            label_name="softmax_label")
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=5, name="fc2")
+
+    mod = mx.mod.SequentialModule()
+    mod.add(mx.mod.Module(net, context=mx.cpu(0), label_names=()),
+            auto_wiring=True)
+    mod.add(mx.mod.PythonLossModule(grad_func=mc_hinge_grad),
+            take_labels=True, auto_wiring=True)
+
+    mod.fit(train, eval_data=val, num_epoch=args.epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            eval_metric="acc", initializer=mx.initializer.Xavier())
+
+    val.reset()
+    metric = mx.metric.Accuracy()
+    acc = mod.score(val, metric)[0][1]
+    logging.info("hinge-trained val accuracy %.3f", acc)
+    return acc
+
+
+if __name__ == "__main__":
+    print("python-loss val accuracy %.3f" % main())

@@ -482,3 +482,55 @@ def test_rcnn_gate():
     import train_end2end
     acc = train_end2end.main(["--epochs", "6"])
     assert acc > 0.8, "rcnn detection accuracy stuck at %.3f" % acc
+
+
+def test_python_loss_module_gate():
+    """SequentialModule + PythonLossModule (examples/module/python_loss.py,
+    parity example/module/python_loss.py): a numpy multiclass-hinge
+    gradient injected behind a symbolic trunk trains to >0.9."""
+    _example("module", "python_loss.py")
+    import mxtpu as mx
+    mx.random.seed(42)  # deterministic init regardless of suite order
+    import python_loss
+    acc = python_loss.main(["--epochs", "8"])
+    assert acc > 0.9, "hinge-loss MLP stuck at %.3f" % acc
+
+
+def test_time_major_rnn_gate():
+    """Time-major unroll (examples/rnn-time-major/rnn_cell_demo.py, parity
+    example/rnn-time-major): LSTM LM over (T, N) batches converges toward
+    the corpus noise floor."""
+    _example("rnn-time-major", "rnn_cell_demo.py")
+    import mxtpu as mx
+    mx.random.seed(42)  # deterministic init regardless of suite order
+    import rnn_cell_demo
+    hist = rnn_cell_demo.main(["--epochs", "6"])
+    assert hist[-1] < hist[0] * 0.6, "perplexity did not fall: %s" % hist
+    assert hist[-1] < 2.2, "final perplexity %.2f above noise floor" % hist[-1]
+
+
+def test_profiler_matmul_example():
+    """Profiler demo (examples/profiler/profiler_matmul.py, parity
+    example/profiler): every dot in the chain gets a chrome-trace span."""
+    import os
+    import tempfile
+    _example("profiler", "profiler_matmul.py")
+    import profiler_matmul
+    with tempfile.TemporaryDirectory() as d:
+        spans, dots = profiler_matmul.main(
+            ["--chain", "4", "--file", os.path.join(d, "t.json")])
+    assert dots == 4, "expected 4 dot spans, saw %d (total %d)" % (dots, spans)
+
+
+def test_memcost_example():
+    """Residual-memory plans (examples/memcost/inception_memcost.py,
+    parity example/memcost): block remat must cut the saved-activation
+    bytes by >2x vs keep-all, and whole-forward mirror below block."""
+    _example("memcost", "inception_memcost.py")
+    import inception_memcost
+    res = inception_memcost.main(["--batch-size", "4", "--image-size", "96"])
+    keep = res["keep_all"]["act_mb"]
+    block = res["block"]["act_mb"]
+    mirror = res["mirror"]["act_mb"]
+    assert block < keep / 2, "block remat saved nothing: %s" % (res,)
+    assert mirror <= block, "mirror above block: %s" % (res,)
