@@ -91,6 +91,39 @@ mx.symbol.arguments <- function(sym) .mx.symbol.list(sym, 0L)
 mx.symbol.outputs <- function(sym) .mx.symbol.list(sym, 1L)
 mx.symbol.auxiliary.states <- function(sym) .mx.symbol.list(sym, 2L)
 
+mx.symbol.Variable <- function(name) {
+  r <- .C("mx_r_symbol_variable", name, id = integer(1), rc = integer(1))
+  .mx.check(r$rc, "mx.symbol.Variable")
+  structure(list(id = r$id), class = "mx.symbol")
+}
+
+# Generic op composition: the seam the generated per-op wrappers
+# (R-package/R/ops.R, from R-package/gen_r_ops.py) sit on — the same
+# two-step the reference's R op functions make (CreateAtomicSymbol then
+# Compose, R-package/R/symbol.R).
+mx.symbol.create <- function(op, inputs = list(), params = list(),
+                             name = "") {
+  keys <- names(params)
+  if (is.null(keys)) keys <- character(0)
+  vals <- vapply(params, function(v) {
+    if (is.logical(v)) (if (v) "1" else "0")
+    else if (length(v) > 1) paste0("(", paste(v, collapse = ","), ")")
+    else as.character(v)
+  }, "")
+  r <- .C("mx_r_symbol_atomic", op, length(keys), keys, vals,
+          id = integer(1), rc = integer(1))
+  .mx.check(r$rc, paste0("mx.symbol.create(", op, ")"))
+  sym_id <- r$id
+  inputs <- inputs[!vapply(inputs, is.null, TRUE)]
+  in_keys <- names(inputs)
+  if (is.null(in_keys)) in_keys <- rep("", length(inputs))
+  in_ids <- vapply(inputs, function(s) as.integer(s$id), 1L)
+  r <- .C("mx_r_symbol_compose", as.integer(sym_id), name,
+          length(in_ids), in_keys, as.integer(in_ids), rc = integer(1))
+  .mx.check(r$rc, paste0("mx.symbol.create(", op, ") compose"))
+  structure(list(id = sym_id), class = "mx.symbol")
+}
+
 # ----------------------------------------------------------------- Executor
 mx.executor.bind <- function(sym, shapes, grad.req = "write",
                              dev.type = 1L, dev.id = 0L) {

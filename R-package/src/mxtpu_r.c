@@ -104,6 +104,43 @@ void mx_r_symbol_free(int *id, int *rc) {
   g_handles[*id] = NULL;
 }
 
+void mx_r_symbol_variable(char **name, int *out_id, int *rc) {
+  SymbolHandle h;
+  *rc = MXSymbolCreateVariable(name[0], &h);
+  *out_id = (*rc == 0) ? put_handle(h) : 0;
+}
+
+/* Atomic-op creation + keyed composition: the generated per-op R wrappers
+ * (R-package/R/ops.R, from R-package/gen_r_ops.py) sit on these two the
+ * way the reference's R op functions sit on MXSymbolCreateAtomicSymbol /
+ * MXSymbolCompose (R-package/R/symbol.R). Keys/vals arrive as R character
+ * vectors (char**), input symbols as an int-id vector. */
+void mx_r_symbol_atomic(char **op_name, int *nparam, char **keys,
+                        char **vals, int *out_id, int *rc) {
+  const char *ks[64];
+  const char *vs[64];
+  int n = *nparam;
+  if (n > 64) { *rc = -1; *out_id = 0; return; }
+  for (int i = 0; i < n; ++i) { ks[i] = keys[i]; vs[i] = vals[i]; }
+  SymbolHandle h;
+  *rc = MXSymbolCreateAtomicSymbol(op_name[0], (mx_uint)n, ks, vs, &h);
+  *out_id = (*rc == 0) ? put_handle(h) : 0;
+}
+
+void mx_r_symbol_compose(int *sym_id, char **name, int *nargs,
+                         char **arg_keys, int *arg_ids, int *rc) {
+  const char *ks[64];
+  SymbolHandle hs[64];
+  int n = *nargs;
+  if (n > 64) { *rc = -1; return; }
+  for (int i = 0; i < n; ++i) {
+    ks[i] = arg_keys[i];
+    hs[i] = get_handle(arg_ids[i]);
+  }
+  *rc = MXSymbolComposeKeyed(get_handle(*sym_id), name[0], (mx_uint)n, ks,
+                             hs);
+}
+
 /* names are returned packed into a caller-provided buffer, '\n'-joined */
 static void join_names(mx_uint n, const char **arr, char **out) {
   size_t off = 0, cap = 8191;

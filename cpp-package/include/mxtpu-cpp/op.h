@@ -719,16 +719,14 @@ inline std::vector<NDArray> ROIPooling(const NDArray &data, const NDArray &rois,
   return op_.Invoke();
 }
 
-inline Symbol Reshape(const std::string &symbol_name, const Symbol &data, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+inline Symbol Reshape(const std::string &symbol_name, const Symbol &data, const std::map<std::string, std::string> &kwargs = {}) {
   Operator op_("Reshape");
-  op_.SetParam("shape", shape);
   for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
   op_.SetInput("data", data);
   return op_.CreateSymbol(symbol_name);
 }
-inline std::vector<NDArray> Reshape(const NDArray &data, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+inline std::vector<NDArray> Reshape(const NDArray &data, const std::map<std::string, std::string> &kwargs = {}) {
   Operator op_("Reshape");
-  op_.SetParam("shape", shape);
   for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
   op_.AddInput(data);
   return op_.Invoke();
@@ -3578,16 +3576,14 @@ inline std::vector<NDArray> repeat(const NDArray &data, int repeats, const std::
   return op_.Invoke();
 }
 
-inline Symbol reshape(const std::string &symbol_name, const Symbol &data, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+inline Symbol reshape(const std::string &symbol_name, const Symbol &data, const std::map<std::string, std::string> &kwargs = {}) {
   Operator op_("reshape");
-  op_.SetParam("shape", shape);
   for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
   op_.SetInput("data", data);
   return op_.CreateSymbol(symbol_name);
 }
-inline std::vector<NDArray> reshape(const NDArray &data, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+inline std::vector<NDArray> reshape(const NDArray &data, const std::map<std::string, std::string> &kwargs = {}) {
   Operator op_("reshape");
-  op_.SetParam("shape", shape);
   for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
   op_.AddInput(data);
   return op_.Invoke();

@@ -1,0 +1,81 @@
+"""Mixing torch layers into an mxtpu graph (parity: example/torch/
+torch_module.py — the reference sandwiches Torch nn layers between MXNet
+symbols via the torch plugin; here `mx.th.as_symbol` wraps any
+torch.nn.Module as an in-graph op whose forward runs functional_call and
+whose backward runs torch.autograd, with the torch parameters trained by
+the mxtpu optimizer).
+
+Run:  python torch_module.py --epochs 6
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+
+
+def synth(n, rng, classes=6, dim=24):
+    protos = (rng.rand(classes, dim) > 0.5).astype("f4")
+    y = rng.randint(0, classes, n)
+    X = protos[y] + rng.randn(n, dim).astype("f4") * 0.25
+    return X, y.astype("f4")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-examples", type=int, default=1536)
+    ap.add_argument("--seed", type=int, default=2)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(args.seed)
+
+    import torch
+    import torch.nn as tnn
+    torch.manual_seed(args.seed)  # the wrapped block inits from torch's RNG
+    torch_block = tnn.Sequential(tnn.Linear(24, 48), tnn.ReLU(),
+                                 tnn.Linear(48, 48), tnn.Tanh())
+
+    data = mx.sym.Variable("data")
+    hidden = mx.th.as_symbol(torch_block, data, name="torch_block")
+    out = mx.sym.FullyConnected(hidden, num_hidden=6, name="fc_out")
+    net = mx.sym.SoftmaxOutput(out, mx.sym.Variable("softmax_label"),
+                               name="softmax")
+
+    rng = np.random.RandomState(args.seed)
+    X, y = synth(args.num_examples, rng)
+    nval = args.num_examples // 4
+    train = mx.io.NDArrayIter(X[:-nval], y[:-nval], args.batch_size,
+                              shuffle=True, label_name="softmax_label")
+    val = mx.io.NDArrayIter(X[-nval:], y[-nval:], args.batch_size,
+                            label_name="softmax_label")
+
+    mod = mx.mod.Module(net, context=mx.cpu(0))
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    # keep torch's own init for the wrapped block
+    arg, aux = mod.get_params()
+    mod.set_params({**arg, **mx.th.torch_params(torch_block, "torch_block")},
+                   aux, allow_missing=False)
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5,
+                                         "momentum": 0.9})
+    metric = mx.metric.Accuracy()
+    for epoch in range(args.epochs):
+        train.reset()
+        metric.reset()
+        for batch in train:
+            mod.forward_backward(batch)
+            mod.update()
+            mod.update_metric(metric, batch.label)
+        logging.info("Epoch[%d] train acc %.3f", epoch, metric.get()[1])
+    acc = mod.score(val, mx.metric.Accuracy())[0][1]
+    logging.info("val accuracy %.3f", acc)
+    return acc
+
+
+if __name__ == "__main__":
+    print("torch-in-graph val accuracy %.3f" % main())

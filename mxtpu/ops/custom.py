@@ -34,12 +34,24 @@ class _CustomOpDef(OpDef):
 
 
 def _prop_of(attrs):
-    kwargs = {k: v for k, v in attrs.items() if k != "op_type"}
+    kwargs = {k: v for k, v in attrs.items()
+              if k not in ("op_type", "__is_train__")}
     return _operator.make_prop(attrs["op_type"], kwargs)
 
 
-def _custom_fn(attrs, *inputs):
+def _custom_fn(attrs, rng, *inputs):
     prop = _prop_of(attrs)
+    # A uint32 seed derived from the op's traced PRNG key rides along as a
+    # callback operand (and as a custom_vjp residual), so a stochastic
+    # CustomOp body can draw the SAME randomness in every execution of
+    # this step's forward — including the vjp's re-trace — and in its
+    # backward. Exposed on the op instance as _mxtpu_rng_seed (used by the
+    # torch bridge to keep dropout masks consistent across fwd/bwd).
+    if rng is not None:
+        seed_arr = jax.random.key_data(rng).reshape(-1)[-1].astype(
+            jnp.uint32)
+    else:
+        seed_arr = jnp.uint32(0)
     n_args = len(prop.list_arguments())
     n_out = len(prop.list_outputs())
     n_aux = len(prop.list_auxiliary_states())
@@ -50,11 +62,24 @@ def _custom_fn(attrs, *inputs):
     _, out_dtypes, _ = prop.infer_type(list(in_dt[:n_args]))
     out_structs = tuple(jax.ShapeDtypeStruct(tuple(s), d)
                         for s, d in zip(out_shapes, out_dtypes))
+    # Executor tracing injects __is_train__ (declared in attrs_spec below,
+    # filled by _trace_graph); the imperative path has no executor, so the
+    # autograd scope flag decides — without this, a Custom op inside a
+    # bound executor always saw is_train=False (dropout-style CustomOps
+    # silently ran in eval mode during training).
     from .. import autograd as _ag
-    is_train = bool(_ag.is_training())
+    is_train = attrs.get("__is_train__")
+    if is_train is None:
+        is_train = bool(_ag.is_training())
+    is_train = bool(is_train)
 
-    def host_forward(*ins):
+    def _make_op(seed):
         op = prop.create_operator(None, [list(s) for s in in_shapes], in_dt)
+        op._mxtpu_rng_seed = int(_np.asarray(seed))
+        return op
+
+    def host_forward(seed, *ins):
+        op = _make_op(seed)
         in_data = [_operator._HostArray(_np.asarray(x)) for x in ins]
         out_data = [_operator._HostArray(_np.zeros(s.shape, s.dtype))
                     for s in out_structs]
@@ -64,8 +89,8 @@ def _custom_fn(attrs, *inputs):
         return tuple(o.asnumpy().astype(s.dtype)
                      for o, s in zip(out_data, out_structs))
 
-    def host_backward(ins, outs, cts):
-        op = prop.create_operator(None, [list(s) for s in in_shapes], in_dt)
+    def host_backward(seed, ins, outs, cts):
+        op = _make_op(seed)
         in_data = [_operator._HostArray(_np.asarray(x)) for x in ins]
         out_data = [_operator._HostArray(_np.asarray(y)) for y in outs]
         out_grad = [_operator._HostArray(_np.asarray(c)) for c in cts]
@@ -79,23 +104,26 @@ def _custom_fn(attrs, *inputs):
 
     @jax.custom_vjp
     def run(*ins):
-        return jax.pure_callback(host_forward, out_structs, *ins,
-                                 vmap_method="sequential")
+        return jax.pure_callback(host_forward, out_structs, seed_arr,
+                                 *ins, vmap_method="sequential")
 
     def run_fwd(*ins):
-        outs = jax.pure_callback(host_forward, out_structs, *ins,
-                                 vmap_method="sequential")
-        return outs, (ins, outs)
+        outs = jax.pure_callback(host_forward, out_structs, seed_arr,
+                                 *ins, vmap_method="sequential")
+        # seed rides in the residuals: run_bwd executes in a LATER trace
+        # (cached vjp), so it must not close over this trace's seed tracer
+        return outs, (seed_arr, ins, outs)
 
     def run_bwd(res, cts):
-        ins, outs = res
+        seed, ins, outs = res
         in_structs = tuple(jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
                            for x in ins)
         grads = jax.pure_callback(
-            lambda *flat: host_backward(flat[:len(ins)],
-                                        flat[len(ins):len(ins) + len(outs)],
-                                        flat[len(ins) + len(outs):]),
-            in_structs, *(tuple(ins) + tuple(outs) + tuple(cts)),
+            lambda s, *flat: host_backward(
+                s, flat[:len(ins)],
+                flat[len(ins):len(ins) + len(outs)],
+                flat[len(ins) + len(outs):]),
+            in_structs, seed, *(tuple(ins) + tuple(outs) + tuple(cts)),
             vmap_method="sequential")
         return tuple(grads)
 
@@ -113,10 +141,25 @@ def _custom_n_out(attrs):
     return len(_prop_of(attrs).list_outputs())
 
 
+def _custom_infer_args(attrs, in_shapes):
+    """Fill unknown input-Variable shapes from the prop's infer_shape —
+    the reference's bidirectional InferShape lets a custom prop declare
+    its parameter shapes (operator.py infer_shape returning corrected
+    in_shapes); exceptions here fall back to leaving shapes unknown."""
+    prop = _prop_of(attrs)
+    n_args = len(prop.list_arguments())
+    arg_shapes, _, aux_shapes = prop.infer_shape(
+        [list(s) if s is not None else None for s in in_shapes[:n_args]])
+    full = [tuple(s) if s is not None else None for s in arg_shapes]
+    full += [tuple(s) for s in aux_shapes]
+    return full + list(in_shapes[len(full):])
+
+
 register_op(_CustomOpDef(
     "Custom", _custom_fn, arg_names=_custom_arg_names,
-    attrs={"op_type": Required(str)}, num_outputs=_custom_n_out,
-    aliases=("_Custom",)))
+    attrs={"op_type": Required(str), "__is_train__": None},
+    num_outputs=_custom_n_out, needs_rng=True,
+    infer_args=_custom_infer_args, aliases=("_Custom",)))
 
 
 # ----------------------------------------------------------- _NoGradient

@@ -15,7 +15,8 @@ from .base import MXNetError
 from .context import cpu
 from .ndarray import NDArray, array
 
-__all__ = ["to_torch", "from_torch", "function", "TorchModule"]
+__all__ = ["to_torch", "from_torch", "function", "TorchModule",
+           "as_symbol", "torch_params"]
 
 
 def _torch():
@@ -116,3 +117,153 @@ def __getattr__(name):
     if not hasattr(torch, name):
         raise AttributeError("torch has no function %r" % name)
     return function(name)
+
+
+# ---------------------------------------------------------------- symbolic
+# The reference runs torch layers INSIDE the graph (plugin/torch/
+# torch_module-inl.h wraps a lua module as an Operator with
+# forward/backward). The TPU-native equivalent: a CustomOp host callback
+# whose forward is torch.func.functional_call and whose backward is
+# torch.autograd.grad — the torch parameters become ordinary mxtpu
+# Variables, trained by the mxtpu optimizer like any other weight.
+
+_SYM_MODULES = {}
+
+
+def _ensure_registered():
+    from . import operator as op
+
+    if "torch_module" in op._REGISTRY:
+        return
+
+    class _TorchSymOp(op.CustomOp):
+        """Backward re-runs the torch forward (the two callbacks cannot
+        share a torch graph across the XLA host-callback boundary), so
+        correctness for stochastic/stateful modules needs two guards:
+
+        - RNG: both passes run under torch.random.fork_rng seeded from
+          the op's TRACED PRNG seed (_mxtpu_rng_seed, derived from the
+          framework key the executor folds per node+step and shipped as
+          a callback operand + vjp residual), so dropout masks agree
+          between the output-producing forward, the vjp's forward, and
+          backward — and still differ across steps.
+        - buffers (BatchNorm running stats etc.): passed to
+          functional_call as clones in both passes so neither mutates
+          the module twice; the training forward writes the updated
+          clones back ONCE."""
+
+        def __init__(self, entry):
+            self._entry = entry
+
+        def _tensors(self, in_data):
+            torch = _torch()
+            mod, pnames = self._entry["module"], self._entry["pnames"]
+            x = torch.from_numpy(in_data[0].asnumpy().copy())
+            params = {pn: torch.from_numpy(in_data[i + 1].asnumpy().copy())
+                      for i, pn in enumerate(pnames)}
+            bufs = {bn: b.detach().clone()
+                    for bn, b in mod.named_buffers()}
+            return torch, mod, x, params, bufs
+
+        def forward(self, is_train, req, in_data, out_data, aux):
+            torch, mod, x, params, bufs = self._tensors(in_data)
+            was_training = mod.training
+            mod.train(bool(is_train))
+            try:
+                with torch.random.fork_rng(devices=[]):
+                    torch.manual_seed(self._entry["seed"]
+                                      ^ getattr(self, "_mxtpu_rng_seed", 0))
+                    with torch.no_grad():
+                        out = torch.func.functional_call(
+                            mod, {**params, **bufs}, (x,))
+                if is_train and bufs:
+                    with torch.no_grad():
+                        for bn, b in mod.named_buffers():
+                            b.copy_(bufs[bn])
+            finally:
+                mod.train(was_training)
+            self.assign(out_data[0], req[0], out.numpy())
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            torch, mod, x, params, bufs = self._tensors(in_data)
+            was_training = mod.training
+            mod.train(True)
+            try:
+                x.requires_grad_(True)
+                for t in params.values():
+                    t.requires_grad_(True)
+                with torch.random.fork_rng(devices=[]):
+                    torch.manual_seed(self._entry["seed"]
+                                      ^ getattr(self, "_mxtpu_rng_seed", 0))
+                    out = torch.func.functional_call(
+                        mod, {**params, **bufs}, (x,))
+                    g = torch.from_numpy(out_grad[0].asnumpy().copy())
+                    grads = torch.autograd.grad(
+                        out, [x] + list(params.values()), grad_outputs=g,
+                        allow_unused=True)
+            finally:
+                mod.train(was_training)
+            for i, t in enumerate(grads):
+                val = t.numpy() if t is not None else 0 * in_data[i].asnumpy()
+                self.assign(in_grad[i], req[i], val)
+
+    class _TorchSymProp(op.CustomOpProp):
+        def __init__(self, key=""):
+            super().__init__(need_top_grad=True)
+            self._entry = _SYM_MODULES[key]
+
+        def list_arguments(self):
+            return ["data"] + list(self._entry["argnames"])
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            import numpy as _np
+
+            torch = _torch()
+            mod, pnames = self._entry["module"], self._entry["pnames"]
+            params = dict(mod.named_parameters())
+            pshapes = [list(params[pn].shape) for pn in pnames]
+            with torch.no_grad():
+                out = torch.func.functional_call(
+                    mod, params,
+                    (torch.zeros(*in_shape[0], dtype=torch.float32),))
+            return [in_shape[0]] + pshapes, [list(out.shape)], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return _TorchSymOp(self._entry)
+
+    op.register("torch_module")(_TorchSymProp)
+
+
+def as_symbol(module, data, name):
+    """Compose a ``torch.nn.Module`` into a Symbol graph: returns a Symbol
+    whose extra inputs ``<name>_<param>`` are the module's parameters
+    (initialize them from ``torch_params(module, name)`` to keep torch's
+    init). Forward/backward run through torch on the host — the in-graph
+    counterpart of the reference's plugin/torch operator."""
+    from . import symbol as sym
+
+    _ensure_registered()
+    prev = _SYM_MODULES.get(name)
+    if prev is not None and prev["module"] is not module:
+        raise MXNetError(
+            "as_symbol name %r already wraps a different module — earlier "
+            "symbols would silently rebind; pick a unique name" % name)
+    pnames = [n for n, _ in module.named_parameters()]
+    argnames = [("%s_%s" % (name, pn)).replace(".", "_") for pn in pnames]
+    _SYM_MODULES[name] = {"module": module, "pnames": pnames,
+                          "argnames": argnames, "seed": hash(name) & 0xffff}
+    pvars = [sym.Variable(an) for an in argnames]
+    return sym.Custom(data, *pvars, op_type="torch_module", key=name,
+                      name=name)
+
+
+def torch_params(module, name):
+    """The module's current parameters as an arg_params dict matching the
+    Variable names ``as_symbol`` created (for Module.init_params/
+    set_params)."""
+    return {("%s_%s" % (name, pn)).replace(".", "_"):
+            array(p.detach().cpu().numpy())
+            for pn, p in module.named_parameters()}

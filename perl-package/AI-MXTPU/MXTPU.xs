@@ -164,6 +164,70 @@ mxtpu__symbol_to_json(h)
   OUTPUT:
     RETVAL
 
+UV
+mxtpu__symbol_variable(name)
+    const char *name
+  CODE:
+    SymbolHandle h;
+    if (MXSymbolCreateVariable(name, &h) != 0)
+        croak("MXSymbolCreateVariable: %s", MXGetLastError());
+    RETVAL = PTR2UV(h);
+  OUTPUT:
+    RETVAL
+
+UV
+mxtpu__symbol_atomic(op_name, keys_ref, vals_ref)
+    const char *op_name
+    SV *keys_ref
+    SV *vals_ref
+  CODE:
+    AV *ka = (AV *)SvRV(keys_ref);
+    AV *va = (AV *)SvRV(vals_ref);
+    if (av_len(ka) != av_len(va))
+        croak("_symbol_atomic: keys/vals length mismatch");
+    mx_uint n = (mx_uint)(av_len(ka) + 1);
+    const char **ks;
+    const char **vs;
+    Newx(ks, n ? n : 1, const char *);
+    Newx(vs, n ? n : 1, const char *);
+    for (mx_uint i = 0; i < n; ++i) {
+        ks[i] = SvPV_nolen(*av_fetch(ka, i, 0));
+        vs[i] = SvPV_nolen(*av_fetch(va, i, 0));
+    }
+    SymbolHandle h;
+    int rc = MXSymbolCreateAtomicSymbol(op_name, n, ks, vs, &h);
+    Safefree(ks);
+    Safefree(vs);
+    if (rc != 0) croak("MXSymbolCreateAtomicSymbol: %s", MXGetLastError());
+    RETVAL = PTR2UV(h);
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu__symbol_compose_keyed(h, name, keys_ref, handles_ref)
+    UV h
+    const char *name
+    SV *keys_ref
+    SV *handles_ref
+  CODE:
+    AV *ka = (AV *)SvRV(keys_ref);
+    AV *ha = (AV *)SvRV(handles_ref);
+    if (av_len(ka) != av_len(ha))
+        croak("_symbol_compose_keyed: keys/handles length mismatch");
+    mx_uint n = (mx_uint)(av_len(ha) + 1);
+    const char **ks;
+    SymbolHandle *hs;
+    Newx(ks, n ? n : 1, const char *);
+    Newx(hs, n ? n : 1, SymbolHandle);
+    for (mx_uint i = 0; i < n; ++i) {
+        ks[i] = SvPV_nolen(*av_fetch(ka, i, 0));
+        hs[i] = uv_handle(SvUV(*av_fetch(ha, i, 0)));
+    }
+    int rc = MXSymbolComposeKeyed(uv_handle(h), name, n, ks, hs);
+    Safefree(ks);
+    Safefree(hs);
+    if (rc != 0) croak("MXSymbolComposeKeyed: %s", MXGetLastError());
+
 void
 mxtpu__symbol_free(h)
     UV h

@@ -19,7 +19,10 @@ sub invoke {
     # AI::MXTPU::invoke($op_name, [@ndarrays], %string_attrs) -> NDArray(s)
     my ($op, $ins, %attrs) = @_;
     my @keys = sort keys %attrs;
-    my @vals = map { "$attrs{$_}" } @keys;
+    my @vals = map {
+        my $v = $attrs{$_};
+        ref $v eq 'ARRAY' ? '(' . join(',', @$v) . ')' : "$v";
+    } @keys;
     my @hs = map { $_->handle } @$ins;
     my @out = AI::MXTPU::_imperative_invoke($op, \@hs, \@keys, \@vals);
     my @wrapped = map { AI::MXTPU::NDArray->_new_from_handle($_) } @out;
@@ -82,6 +85,41 @@ use warnings;
 sub load_json {
     my ($class, $json) = @_;
     my $h = AI::MXTPU::_symbol_from_json($json);
+    return bless { h => $h }, $class;
+}
+
+sub var {
+    # AI::MXTPU::Symbol->var('data') — a free Variable node
+    my ($class, $name) = @_;
+    return bless { h => AI::MXTPU::_symbol_variable($name) }, $class;
+}
+
+sub create {
+    # Generic op composition (the seam AI::MXTPU::Ops generated wrappers
+    # use): AI::MXTPU::Symbol->create($op, {data => $sym, ...}, %attrs).
+    # Inputs compose keyed, so hash order never matters; attrs stringify
+    # the way the reference's perl layer passes params to the C ABI.
+    my ($class, $op, $inputs, %attrs) = @_;
+    my $name = delete $attrs{name} // '';
+    my @keys = sort keys %attrs;
+    # arrayref attrs become "(a,b)" — the runtime's tuple syntax (so
+    # kernel => [3,3] works like the python frontend's kernel=(3,3))
+    my @vals = map {
+        my $v = $attrs{$_};
+        ref $v eq 'ARRAY' ? '(' . join(',', @$v) . ')' : "$v";
+    } @keys;
+    my $h = AI::MXTPU::_symbol_atomic($op, \@keys, \@vals);
+    my (@ik, @ih);
+    if (ref $inputs eq 'HASH') {
+        for my $k (sort keys %$inputs) {
+            next unless defined $inputs->{$k};
+            push @ik, $k;
+            push @ih, $inputs->{$k}{h};
+        }
+    } else {
+        for my $s (@$inputs) { push @ik, ''; push @ih, $s->{h}; }
+    }
+    AI::MXTPU::_symbol_compose_keyed($h, $name, \@ik, \@ih);
     return bless { h => $h }, $class;
 }
 

@@ -115,3 +115,29 @@ def test_streaming_matches_reference():
             stream = att._streaming(q, k, v, 0.2, causal, block=64)
         np.testing.assert_allclose(np.asarray(stream), np.asarray(ref),
                                    rtol=2e-3, atol=2e-3)
+
+
+def test_pallas_epilogue_matches_reference():
+    """BN-apply+ReLU+add pallas kernel (ops/epilogue.py, interpret mode)
+    agrees with the XLA formulation, with and without the residual."""
+    import numpy as np
+    import jax.numpy as jnp
+    from mxtpu.ops.epilogue import (bn_apply_relu_add,
+                                    bn_apply_relu_add_reference, fold_bn)
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(96, 128), jnp.float32)
+    r = jnp.asarray(rng.randn(96, 128), jnp.float32)
+    gamma = jnp.asarray(rng.rand(128) + 0.5, jnp.float32)
+    beta = jnp.asarray(rng.randn(128), jnp.float32)
+    mean = jnp.asarray(rng.randn(128), jnp.float32)
+    var = jnp.asarray(rng.rand(128) + 0.1, jnp.float32)
+    scale, shift = fold_bn(gamma, beta, mean, var)
+    got = bn_apply_relu_add(x, scale, shift, r, block_m=32, interpret=True)
+    want = bn_apply_relu_add_reference(x, scale, shift, r)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    got2 = bn_apply_relu_add(x, scale, shift, None, block_m=32,
+                             interpret=True)
+    want2 = bn_apply_relu_add_reference(x, scale, shift, None)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(want2),
+                               rtol=1e-5, atol=1e-5)

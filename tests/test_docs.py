@@ -1,0 +1,36 @@
+"""Documentation rot guard: every dotted mx.* API name mentioned in the
+tutorials must resolve on the live package (the reference's docs are
+generated from the registry, which gives the same guarantee)."""
+import os
+import re
+
+import pytest
+
+import mxtpu as mx
+
+DOCS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "docs", "tutorials")
+
+# names like mx.nd.save / mx.gluon.loss.SoftmaxCrossEntropyLoss; stop at '('
+_PAT = re.compile(r"\bmx\.((?:[A-Za-z_][\w]*\.)*[A-Za-z_][\w]*)")
+
+# doc-prose tokens that are not attribute paths
+_SKIP = {"X", "sym.X"}
+
+
+@pytest.mark.parametrize("fname", sorted(os.listdir(DOCS)))
+def test_tutorial_names_resolve(fname):
+    text = open(os.path.join(DOCS, fname)).read()
+    missing = []
+    for m in _PAT.finditer(text):
+        path = m.group(1)
+        if path in _SKIP:
+            continue
+        obj = mx
+        for part in path.split("."):
+            obj = getattr(obj, part, None)
+            if obj is None:
+                missing.append(path)
+                break
+    assert not missing, "%s references unknown APIs: %s" % (
+        fname, sorted(set(missing)))

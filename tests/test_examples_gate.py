@@ -534,3 +534,14 @@ def test_memcost_example():
     mirror = res["mirror"]["act_mb"]
     assert block < keep / 2, "block remat saved nothing: %s" % (res,)
     assert mirror <= block, "mirror above block: %s" % (res,)
+
+
+def test_torch_module_example_gate():
+    """Torch-in-graph (examples/torch/torch_module.py, parity
+    example/torch): a torch.nn block inside the Symbol trains to >0.9."""
+    _example("torch", "torch_module.py")
+    import mxtpu as mx
+    mx.random.seed(42)  # deterministic init regardless of suite order
+    import torch_module
+    acc = torch_module.main(["--epochs", "6"])
+    assert acc > 0.9, "torch-in-graph accuracy stuck at %.3f" % acc

@@ -66,3 +66,62 @@ def test_perl_binding_trains_mlp(tmp_path):
     assert out.returncode == 0, out.stdout + out.stderr
     assert "not ok" not in out.stdout, out.stdout
     assert "ok" in out.stdout, out.stdout
+
+
+@pytest.mark.skipif(not _have_perl_toolchain(),
+                    reason="perl + ExtUtils::MakeMaker unavailable")
+def test_perl_full_op_surface(tmp_path):
+    """The generated 288-op perl surface (AI::MXTPU::Ops/NDOps from
+    perl-package/gen_perl_ops.py) composes and trains a model from pure
+    perl — the reference AI::MXNet's code-generated function-table tier."""
+    r = subprocess.run(["make", "-C", os.path.join(REPO, "src"), "capi"],
+                       capture_output=True, text=True)
+    if not os.path.exists(CAPI_SO):
+        pytest.skip("libmxtpu_capi.so did not build: %s"
+                    % (r.stdout + r.stderr)[-400:])
+    env = dict(os.environ)
+    b = subprocess.run(["perl", "Makefile.PL"], cwd=PKG, env=env,
+                       capture_output=True, text=True)
+    assert b.returncode == 0, b.stdout + b.stderr
+    b = subprocess.run(["make"], cwd=PKG, env=env,
+                       capture_output=True, text=True)
+    assert b.returncode == 0, b.stdout + b.stderr
+
+    import numpy as np  # noqa: F811 - reuse module-level alias
+
+    rng = np.random.RandomState(0)
+    n, dim, classes = 256, 16, 4
+    centers = rng.randn(classes, dim) * 3
+    y = rng.randint(0, classes, n)
+    X = (centers[y] + rng.randn(n, dim)).astype("float32")
+    (tmp_path / "data.bin").write_bytes(X.tobytes())
+    (tmp_path / "labels.bin").write_bytes(y.astype("float32").tobytes())
+
+    env.update(JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               MXTPU_PERL_TEST_DIR=str(tmp_path))
+    out = subprocess.run(
+        ["perl", "-Mblib", os.path.join("t", "compose_ops.t")],
+        cwd=PKG, env=env, capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "not ok" not in out.stdout, out.stdout
+
+
+def test_perl_op_surface_is_current():
+    """Regenerating Ops.pm/NDOps.pm reproduces the committed files (the
+    committed files are restored afterwards so a stale surface keeps
+    failing instead of self-healing on the second run)."""
+    ops_pm = os.path.join(PKG, "lib", "AI", "MXTPU", "Ops.pm")
+    ndops_pm = os.path.join(PKG, "lib", "AI", "MXTPU", "NDOps.pm")
+    before = open(ops_pm).read(), open(ndops_pm).read()
+    try:
+        r = subprocess.run(
+            ["python", os.path.join(REPO, "perl-package", "gen_perl_ops.py")],
+            capture_output=True, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO))
+        assert r.returncode == 0, r.stdout + r.stderr
+        after = open(ops_pm).read(), open(ndops_pm).read()
+        assert before == after, "committed perl op surface is stale — " \
+            "rerun perl-package/gen_perl_ops.py"
+    finally:
+        open(ops_pm, "w").write(before[0])
+        open(ndops_pm, "w").write(before[1])

@@ -251,3 +251,62 @@ def test_torch_dlpack_zero_copy():
     assert t.shape == (4,)
     back = mx.th.from_torch(t + 1)
     np.testing.assert_allclose(back.asnumpy(), [1, 2, 3, 4])
+
+
+def test_torch_module_in_graph():
+    """plugin/torch parity: a torch.nn block composed INTO a Symbol via
+    mx.th.as_symbol trains through Module — forward via functional_call,
+    backward via torch.autograd, torch params updated by the mxtpu
+    optimizer. Gradient check: mxtpu executor grads == torch autograd."""
+    import torch
+    import torch.nn as tnn
+
+    tmod = tnn.Sequential(tnn.Linear(6, 5), tnn.Tanh())
+    data = mx.sym.Variable("data")
+    out = mx.th.as_symbol(tmod, data, name="tb")
+    # bind standalone and compare input grads against torch directly
+    exe = out.simple_bind(ctx=mx.cpu(), data=(3, 6), grad_req="write")
+    tp = mx.th.torch_params(tmod, "tb")
+    for k, v in tp.items():
+        exe.arg_dict[k][:] = v
+    x = np.random.RandomState(1).randn(3, 6).astype("f4")
+    exe.arg_dict["data"][:] = mx.nd.array(x)
+    y = exe.forward(is_train=True)[0]
+    tx = torch.from_numpy(x).requires_grad_(True)
+    ty = tmod(tx)
+    np.testing.assert_allclose(y.asnumpy(), ty.detach().numpy(), rtol=1e-5,
+                               atol=1e-6)
+    head = np.ones(ty.shape, "f4")
+    exe.backward([mx.nd.array(head)])
+    ty.backward(torch.from_numpy(head))
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(),
+                               tx.grad.numpy(), rtol=1e-5, atol=1e-6)
+    # weight grads arrive too (named <name>_<param> with dots flattened)
+    g = exe.grad_dict["tb_0_weight"].asnumpy()
+    tw = dict(tmod.named_parameters())["0.weight"]
+    np.testing.assert_allclose(g, tw.grad.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_torch_module_in_graph_stochastic_consistency():
+    """Dropout inside a wrapped torch block: backward's recomputed forward
+    must reuse the SAME mask the loss saw (fork_rng + per-step seed), and
+    is_train=False must disable dropout entirely."""
+    import torch.nn as tnn
+
+    tmod = tnn.Sequential(tnn.Dropout(0.5))
+    data = mx.sym.Variable("data")
+    out = mx.th.as_symbol(tmod, data, name="tdrop")
+    exe = out.simple_bind(ctx=mx.cpu(), data=(64, 8), grad_req="write")
+    x = np.ones((64, 8), "f4")
+    exe.arg_dict["data"][:] = mx.nd.array(x)
+
+    y = exe.forward(is_train=True)[0].asnumpy()
+    mask = y != 0                       # the mask the loss saw
+    assert 0.2 < mask.mean() < 0.8, "dropout inactive in train mode"
+    exe.backward([mx.nd.array(np.ones_like(y))])
+    g = exe.grad_dict["data"].asnumpy()
+    # gradient flows exactly where THAT mask kept values: same zero set
+    np.testing.assert_array_equal(g != 0, mask)
+
+    y_eval = exe.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(y_eval, x)   # eval mode: identity

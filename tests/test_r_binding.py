@@ -106,3 +106,99 @@ def test_r_binding_trains_mlp(tmp_path):
         capture_output=True, text=True, env=env, timeout=600)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "R BINDING OK" in out.stdout, out.stdout + out.stderr
+
+
+def test_r_compose_entry_points():
+    """The atomic/compose adapter entries the generated R op wrappers
+    (R-package/R/ops.R) sit on: build an MLP symbol exactly as
+    mx.symbol.create does from R (.C all-pointer shapes), then bind and
+    step it."""
+    import ctypes
+
+    subprocess.run(["make", "-C", os.path.join(REPO, "src"), "r"],
+                   capture_output=True, text=True)
+    if not os.path.exists(R_SO):
+        pytest.skip("libmxtpu_r.so did not build")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["PYTHONPATH"] = REPO
+    lib = ctypes.CDLL(R_SO)
+    i32 = ctypes.c_int
+
+    def ip(v):
+        return ctypes.byref(i32(v))
+
+    def strv(*ss):
+        arr = (ctypes.c_char_p * max(len(ss), 1))()
+        for i, s in enumerate(ss):
+            arr[i] = s.encode()
+        return arr
+
+    def intv(*vs):
+        arr = (i32 * max(len(vs), 1))()
+        for i, v in enumerate(vs):
+            arr[i] = v
+        return arr
+
+    out_id, rc = i32(0), i32(0)
+    lib.mx_r_symbol_variable(strv("data"), ctypes.byref(out_id),
+                             ctypes.byref(rc))
+    assert rc.value == 0
+    data_id = out_id.value
+
+    # FullyConnected(data, num_hidden=8) -> SoftmaxOutput
+    lib.mx_r_symbol_atomic(strv("FullyConnected"), ip(1),
+                           strv("num_hidden"), strv("8"),
+                           ctypes.byref(out_id), ctypes.byref(rc))
+    assert rc.value == 0, "atomic FC failed"
+    fc_id = out_id.value
+    lib.mx_r_symbol_compose(ip(fc_id), strv("fc1"), ip(1), strv("data"),
+                            intv(data_id), ctypes.byref(rc))
+    assert rc.value == 0, "compose FC failed"
+
+    lib.mx_r_symbol_atomic(strv("SoftmaxOutput"), ip(0), strv(), strv(),
+                           ctypes.byref(out_id), ctypes.byref(rc))
+    assert rc.value == 0
+    sm_id = out_id.value
+    lib.mx_r_symbol_compose(ip(sm_id), strv("softmax"), ip(1),
+                            strv("data"), intv(fc_id), ctypes.byref(rc))
+    assert rc.value == 0
+
+    # arguments of the composed graph come back in order
+    buf = ctypes.create_string_buffer(8192)
+    pbuf = (ctypes.c_char_p * 1)(ctypes.cast(buf, ctypes.c_char_p))
+    lib.mx_r_symbol_list(ip(sm_id), ip(0), pbuf, ctypes.byref(rc))
+    assert rc.value == 0
+    args = buf.value.decode().split("\n")
+    assert args == ["data", "fc1_weight", "fc1_bias", "softmax_label"], args
+
+    # bind + one forward step through the same executor shims R uses
+    names = strv("data", "softmax_label")
+    indptr = intv(0, 2, 3)
+    dims = intv(4, 16, 4)
+    lib.mx_r_executor_bind(ip(sm_id), ip(1), ip(0), strv("write"),
+                           names, ip(2), indptr, dims,
+                           ctypes.byref(out_id), ctypes.byref(rc))
+    assert rc.value == 0, "bind failed"
+    exec_id = out_id.value
+    lib.mx_r_executor_forward(ip(exec_id), ip(1), ctypes.byref(rc))
+    assert rc.value == 0
+    lib.mx_r_executor_backward(ip(exec_id), ctypes.byref(rc))
+    assert rc.value == 0
+
+
+def test_r_op_surface_is_current():
+    """Regenerating ops.R reproduces the committed file (restored
+    afterwards so a stale surface keeps failing instead of self-healing
+    on the second run)."""
+    ops_r = os.path.join(REPO, "R-package", "R", "ops.R")
+    before = open(ops_r).read()
+    try:
+        r = subprocess.run(
+            ["python", os.path.join(REPO, "R-package", "gen_r_ops.py")],
+            capture_output=True, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO))
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert before == open(ops_r).read(), \
+            "committed R op surface is stale — rerun R-package/gen_r_ops.py"
+    finally:
+        open(ops_r, "w").write(before)

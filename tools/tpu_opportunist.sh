@@ -49,7 +49,9 @@ json.dump(data, open("TPU_BENCH_OPPORTUNISTIC.json", "w"), indent=1)
 EOF
         echo "$(date -Is) bench captured; running flag sweep" >> tpu_watch.log
         timeout 4500 python tools/flag_sweep.py 40 > flag_sweep_results.txt 2>&1
-        echo "$(date -Is) flag sweep done" >> tpu_watch.log
+        echo "$(date -Is) flag sweep done; running pallas epilogue A/B" >> tpu_watch.log
+        timeout 900 python tools/bench_epilogue.py 256 > epilogue_results.txt 2>&1
+        echo "$(date -Is) epilogue A/B done" >> tpu_watch.log
         exit 0
     fi
     echo "$(date -Is) tunnel down; retrying" >> tpu_watch.log
