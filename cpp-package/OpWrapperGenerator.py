@@ -83,7 +83,7 @@ def op_inputs(op):
     return list(op.arg_names)
 
 
-def emit_op(name, op):
+def emit_op(name, op, typed_shape=False):
     fn = cpp_ident(name)
     inputs = op_inputs(op)
     required = [(k, attr_cpp_type(v)) for k, v in op.attrs_spec.items()
@@ -91,6 +91,12 @@ def emit_op(name, op):
     # required attrs whose type we cannot express go through kwargs; the
     # runtime raises "required attr missing" if the caller omits them
     typed_req = [(k, t) for k, t in required if t is not None]
+    if typed_shape:
+        # second pass for ops whose `shape` attr is optional in the
+        # registry (e.g. Reshape also accepts legacy target_shape): keep
+        # the reference signature Reshape(name, data, Shape(...)) as an
+        # overload beside the kwargs form
+        typed_req = [("shape", "const Shape &")] + typed_req
 
     lines = []
 
@@ -178,6 +184,9 @@ def main():
             continue
         seen_idents.add(ident)
         out += emit_op(name, op)
+        shape_dflt = op.attrs_spec.get("shape")
+        if isinstance(shape_dflt, tuple) and not op.variadic:
+            out += emit_op(name, op, typed_shape=True)
         n_emitted += 1
     out += [
         "}  // namespace op",

@@ -732,6 +732,21 @@ inline std::vector<NDArray> Reshape(const NDArray &data, const std::map<std::str
   return op_.Invoke();
 }
 
+inline Symbol Reshape(const std::string &symbol_name, const Symbol &data, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("Reshape");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.SetInput("data", data);
+  return op_.CreateSymbol(symbol_name);
+}
+inline std::vector<NDArray> Reshape(const NDArray &data, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("Reshape");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.AddInput(data);
+  return op_.Invoke();
+}
+
 inline Symbol SVMOutput(const std::string &symbol_name, const Symbol &data, const Symbol &label, const std::map<std::string, std::string> &kwargs = {}) {
   Operator op_("SVMOutput");
   for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
@@ -1936,6 +1951,19 @@ inline std::vector<NDArray> _random_exponential(const std::map<std::string, std:
   return op_.Invoke();
 }
 
+inline Symbol _random_exponential(const std::string &symbol_name, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("_random_exponential");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  return op_.CreateSymbol(symbol_name);
+}
+inline std::vector<NDArray> _random_exponential(const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("_random_exponential");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  return op_.Invoke();
+}
+
 inline Symbol _random_gamma(const std::string &symbol_name, const std::map<std::string, std::string> &kwargs = {}) {
   Operator op_("_random_gamma");
   for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
@@ -1943,6 +1971,19 @@ inline Symbol _random_gamma(const std::string &symbol_name, const std::map<std::
 }
 inline std::vector<NDArray> _random_gamma(const std::map<std::string, std::string> &kwargs = {}) {
   Operator op_("_random_gamma");
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  return op_.Invoke();
+}
+
+inline Symbol _random_gamma(const std::string &symbol_name, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("_random_gamma");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  return op_.CreateSymbol(symbol_name);
+}
+inline std::vector<NDArray> _random_gamma(const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("_random_gamma");
+  op_.SetParam("shape", shape);
   for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
   return op_.Invoke();
 }
@@ -1958,6 +1999,19 @@ inline std::vector<NDArray> _random_generalized_negative_binomial(const std::map
   return op_.Invoke();
 }
 
+inline Symbol _random_generalized_negative_binomial(const std::string &symbol_name, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("_random_generalized_negative_binomial");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  return op_.CreateSymbol(symbol_name);
+}
+inline std::vector<NDArray> _random_generalized_negative_binomial(const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("_random_generalized_negative_binomial");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  return op_.Invoke();
+}
+
 inline Symbol _random_negative_binomial(const std::string &symbol_name, const std::map<std::string, std::string> &kwargs = {}) {
   Operator op_("_random_negative_binomial");
   for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
@@ -1965,6 +2019,19 @@ inline Symbol _random_negative_binomial(const std::string &symbol_name, const st
 }
 inline std::vector<NDArray> _random_negative_binomial(const std::map<std::string, std::string> &kwargs = {}) {
   Operator op_("_random_negative_binomial");
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  return op_.Invoke();
+}
+
+inline Symbol _random_negative_binomial(const std::string &symbol_name, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("_random_negative_binomial");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  return op_.CreateSymbol(symbol_name);
+}
+inline std::vector<NDArray> _random_negative_binomial(const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("_random_negative_binomial");
+  op_.SetParam("shape", shape);
   for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
   return op_.Invoke();
 }
@@ -1980,6 +2047,19 @@ inline std::vector<NDArray> _random_normal(const std::map<std::string, std::stri
   return op_.Invoke();
 }
 
+inline Symbol _random_normal(const std::string &symbol_name, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("_random_normal");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  return op_.CreateSymbol(symbol_name);
+}
+inline std::vector<NDArray> _random_normal(const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("_random_normal");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  return op_.Invoke();
+}
+
 inline Symbol _random_poisson(const std::string &symbol_name, const std::map<std::string, std::string> &kwargs = {}) {
   Operator op_("_random_poisson");
   for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
@@ -1991,6 +2071,19 @@ inline std::vector<NDArray> _random_poisson(const std::map<std::string, std::str
   return op_.Invoke();
 }
 
+inline Symbol _random_poisson(const std::string &symbol_name, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("_random_poisson");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  return op_.CreateSymbol(symbol_name);
+}
+inline std::vector<NDArray> _random_poisson(const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("_random_poisson");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  return op_.Invoke();
+}
+
 inline Symbol _random_uniform(const std::string &symbol_name, const std::map<std::string, std::string> &kwargs = {}) {
   Operator op_("_random_uniform");
   for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
@@ -1998,6 +2091,19 @@ inline Symbol _random_uniform(const std::string &symbol_name, const std::map<std
 }
 inline std::vector<NDArray> _random_uniform(const std::map<std::string, std::string> &kwargs = {}) {
   Operator op_("_random_uniform");
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  return op_.Invoke();
+}
+
+inline Symbol _random_uniform(const std::string &symbol_name, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("_random_uniform");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  return op_.CreateSymbol(symbol_name);
+}
+inline std::vector<NDArray> _random_uniform(const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("_random_uniform");
+  op_.SetParam("shape", shape);
   for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
   return op_.Invoke();
 }
@@ -3589,6 +3695,21 @@ inline std::vector<NDArray> reshape(const NDArray &data, const std::map<std::str
   return op_.Invoke();
 }
 
+inline Symbol reshape(const std::string &symbol_name, const Symbol &data, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("reshape");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.SetInput("data", data);
+  return op_.CreateSymbol(symbol_name);
+}
+inline std::vector<NDArray> reshape(const NDArray &data, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("reshape");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.AddInput(data);
+  return op_.Invoke();
+}
+
 inline Symbol reshape_like(const std::string &symbol_name, const Symbol &lhs, const Symbol &rhs, const std::map<std::string, std::string> &kwargs = {}) {
   Operator op_("reshape_like");
   for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
@@ -3713,6 +3834,21 @@ inline std::vector<NDArray> sample_exponential(const NDArray &data, const std::m
   return op_.Invoke();
 }
 
+inline Symbol sample_exponential(const std::string &symbol_name, const Symbol &data, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("sample_exponential");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.SetInput("data", data);
+  return op_.CreateSymbol(symbol_name);
+}
+inline std::vector<NDArray> sample_exponential(const NDArray &data, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("sample_exponential");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.AddInput(data);
+  return op_.Invoke();
+}
+
 inline Symbol sample_gamma(const std::string &symbol_name, const Symbol &lhs, const Symbol &rhs, const std::map<std::string, std::string> &kwargs = {}) {
   Operator op_("sample_gamma");
   for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
@@ -3722,6 +3858,23 @@ inline Symbol sample_gamma(const std::string &symbol_name, const Symbol &lhs, co
 }
 inline std::vector<NDArray> sample_gamma(const NDArray &lhs, const NDArray &rhs, const std::map<std::string, std::string> &kwargs = {}) {
   Operator op_("sample_gamma");
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.AddInput(lhs);
+  op_.AddInput(rhs);
+  return op_.Invoke();
+}
+
+inline Symbol sample_gamma(const std::string &symbol_name, const Symbol &lhs, const Symbol &rhs, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("sample_gamma");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.SetInput("lhs", lhs);
+  op_.SetInput("rhs", rhs);
+  return op_.CreateSymbol(symbol_name);
+}
+inline std::vector<NDArray> sample_gamma(const NDArray &lhs, const NDArray &rhs, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("sample_gamma");
+  op_.SetParam("shape", shape);
   for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
   op_.AddInput(lhs);
   op_.AddInput(rhs);
@@ -3743,6 +3896,23 @@ inline std::vector<NDArray> sample_generalized_negative_binomial(const NDArray &
   return op_.Invoke();
 }
 
+inline Symbol sample_generalized_negative_binomial(const std::string &symbol_name, const Symbol &lhs, const Symbol &rhs, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("sample_generalized_negative_binomial");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.SetInput("lhs", lhs);
+  op_.SetInput("rhs", rhs);
+  return op_.CreateSymbol(symbol_name);
+}
+inline std::vector<NDArray> sample_generalized_negative_binomial(const NDArray &lhs, const NDArray &rhs, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("sample_generalized_negative_binomial");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.AddInput(lhs);
+  op_.AddInput(rhs);
+  return op_.Invoke();
+}
+
 inline Symbol sample_multinomial(const std::string &symbol_name, const Symbol &data, const std::map<std::string, std::string> &kwargs = {}) {
   Operator op_("sample_multinomial");
   for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
@@ -3751,6 +3921,21 @@ inline Symbol sample_multinomial(const std::string &symbol_name, const Symbol &d
 }
 inline std::vector<NDArray> sample_multinomial(const NDArray &data, const std::map<std::string, std::string> &kwargs = {}) {
   Operator op_("sample_multinomial");
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.AddInput(data);
+  return op_.Invoke();
+}
+
+inline Symbol sample_multinomial(const std::string &symbol_name, const Symbol &data, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("sample_multinomial");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.SetInput("data", data);
+  return op_.CreateSymbol(symbol_name);
+}
+inline std::vector<NDArray> sample_multinomial(const NDArray &data, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("sample_multinomial");
+  op_.SetParam("shape", shape);
   for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
   op_.AddInput(data);
   return op_.Invoke();
@@ -3765,6 +3950,23 @@ inline Symbol sample_negative_binomial(const std::string &symbol_name, const Sym
 }
 inline std::vector<NDArray> sample_negative_binomial(const NDArray &lhs, const NDArray &rhs, const std::map<std::string, std::string> &kwargs = {}) {
   Operator op_("sample_negative_binomial");
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.AddInput(lhs);
+  op_.AddInput(rhs);
+  return op_.Invoke();
+}
+
+inline Symbol sample_negative_binomial(const std::string &symbol_name, const Symbol &lhs, const Symbol &rhs, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("sample_negative_binomial");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.SetInput("lhs", lhs);
+  op_.SetInput("rhs", rhs);
+  return op_.CreateSymbol(symbol_name);
+}
+inline std::vector<NDArray> sample_negative_binomial(const NDArray &lhs, const NDArray &rhs, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("sample_negative_binomial");
+  op_.SetParam("shape", shape);
   for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
   op_.AddInput(lhs);
   op_.AddInput(rhs);
@@ -3786,6 +3988,23 @@ inline std::vector<NDArray> sample_normal(const NDArray &lhs, const NDArray &rhs
   return op_.Invoke();
 }
 
+inline Symbol sample_normal(const std::string &symbol_name, const Symbol &lhs, const Symbol &rhs, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("sample_normal");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.SetInput("lhs", lhs);
+  op_.SetInput("rhs", rhs);
+  return op_.CreateSymbol(symbol_name);
+}
+inline std::vector<NDArray> sample_normal(const NDArray &lhs, const NDArray &rhs, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("sample_normal");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.AddInput(lhs);
+  op_.AddInput(rhs);
+  return op_.Invoke();
+}
+
 inline Symbol sample_poisson(const std::string &symbol_name, const Symbol &data, const std::map<std::string, std::string> &kwargs = {}) {
   Operator op_("sample_poisson");
   for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
@@ -3794,6 +4013,21 @@ inline Symbol sample_poisson(const std::string &symbol_name, const Symbol &data,
 }
 inline std::vector<NDArray> sample_poisson(const NDArray &data, const std::map<std::string, std::string> &kwargs = {}) {
   Operator op_("sample_poisson");
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.AddInput(data);
+  return op_.Invoke();
+}
+
+inline Symbol sample_poisson(const std::string &symbol_name, const Symbol &data, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("sample_poisson");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.SetInput("data", data);
+  return op_.CreateSymbol(symbol_name);
+}
+inline std::vector<NDArray> sample_poisson(const NDArray &data, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("sample_poisson");
+  op_.SetParam("shape", shape);
   for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
   op_.AddInput(data);
   return op_.Invoke();
@@ -3808,6 +4042,23 @@ inline Symbol sample_uniform(const std::string &symbol_name, const Symbol &lhs, 
 }
 inline std::vector<NDArray> sample_uniform(const NDArray &lhs, const NDArray &rhs, const std::map<std::string, std::string> &kwargs = {}) {
   Operator op_("sample_uniform");
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.AddInput(lhs);
+  op_.AddInput(rhs);
+  return op_.Invoke();
+}
+
+inline Symbol sample_uniform(const std::string &symbol_name, const Symbol &lhs, const Symbol &rhs, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("sample_uniform");
+  op_.SetParam("shape", shape);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.SetInput("lhs", lhs);
+  op_.SetInput("rhs", rhs);
+  return op_.CreateSymbol(symbol_name);
+}
+inline std::vector<NDArray> sample_uniform(const NDArray &lhs, const NDArray &rhs, const Shape & shape, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("sample_uniform");
+  op_.SetParam("shape", shape);
   for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
   op_.AddInput(lhs);
   op_.AddInput(rhs);

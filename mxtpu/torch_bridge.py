@@ -11,6 +11,8 @@ pairing torch autograd with a jax ``custom_vjp``.
 """
 from __future__ import annotations
 
+import zlib as _zlib
+
 from .base import MXNetError
 from .context import cpu
 from .ndarray import NDArray, array
@@ -254,7 +256,8 @@ def as_symbol(module, data, name):
     pnames = [n for n, _ in module.named_parameters()]
     argnames = [("%s_%s" % (name, pn)).replace(".", "_") for pn in pnames]
     _SYM_MODULES[name] = {"module": module, "pnames": pnames,
-                          "argnames": argnames, "seed": hash(name) & 0xffff}
+                          "argnames": argnames,
+                          "seed": _zlib.crc32(name.encode()) & 0xffff}
     pvars = [sym.Variable(an) for an in argnames]
     return sym.Custom(data, *pvars, op_type="torch_module", key=name,
                       name=name)

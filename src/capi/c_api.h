@@ -11,6 +11,7 @@
 #ifndef MXTPU_C_API_H_
 #define MXTPU_C_API_H_
 
+#include <stddef.h>
 #include <stdint.h>
 
 #ifdef __cplusplus
@@ -115,6 +116,9 @@ int MXCreateCachedOp(SymbolHandle sym, CachedOpHandle *out);
 int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
                      NDArrayHandle *inputs, int *num_outputs,
                      NDArrayHandle **outputs);
+int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, const int **out_stypes);
 int MXFreeCachedOp(CachedOpHandle handle);
 
 /* ---------------- Profiler ----------------
@@ -250,6 +254,245 @@ int MXKVStoreSetOptimizer(KVStoreHandle kv, const char *name, float lr,
                           float wd, float momentum, float rescale_grad);
 int MXKVStoreGetRank(KVStoreHandle kv, int *out);
 int MXKVStoreGetGroupSize(KVStoreHandle kv, int *out);
+
+/* ===================================================================
+ * Round-4 breadth tranche: the remaining reference c_api.h groups
+ * (include/mxnet/c_api.h). Same ABI conventions as above: rc 0/-1,
+ * message via MXGetLastError, per-thread return arenas valid until the
+ * next call on the same thread.
+ *
+ * Deviations, documented:
+ *  - MXSymbolGrad errors ("not implemented") — EXACT reference parity
+ *    (src/c_api/c_api_symbolic.cc:563 is LOG(FATAL) "not implemented").
+ *  - MXRtc* error with guidance: NVRTC/CUDA-source kernels have no TPU
+ *    analog; the adapted surface is the python mx.rtc (jax/pallas
+ *    bodies, mxtpu/rtc.py).
+ *  - Sparse NDArrays are read-introspectable from C (GetStorageType /
+ *    GetAux* / GetDataNDArray); construction happens through op invoke
+ *    (cast_storage) or the python frontend.
+ *  - MXDataIterGetIterInfo takes the iterator NAME (MXListDataIters here
+ *    returns names, not creator handles).
+ *  - KVStore keys are strings end-to-end (the reference's Ex variants);
+ *    MXKVStore{Init,Push,Pull}Ex are the batch forms.
+ *  - Not present (documented): MXCustomFunctionRecord (C-side autograd
+ *    Function; the python autograd.Function + MXCustomOpRegister cover
+ *    the capability) and MXNDArrayCreateSparseEx (sparse construction
+ *    goes through op invoke / the python frontend; the bridge-level
+ *    ndarray_create_sparse exists for embedding hosts).
+ */
+typedef void *FunctionHandle;
+typedef void *AtomicSymbolCreator;
+typedef void *RtcHandle;
+typedef void (*MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                                 NDArrayHandle local, void *handle);
+typedef void (*MXKVStoreStrUpdater)(const char *key, NDArrayHandle recv,
+                                    NDArrayHandle local, void *handle);
+typedef void (*MXKVStoreServerController)(int head, const char *body,
+                                          void *controller_handle);
+typedef void (*ExecutorMonitorCallback)(const char *name, NDArrayHandle arr,
+                                        void *callback_handle);
+
+/* NDArray tail */
+int MXNDArrayCreateNone(NDArrayHandle *out);
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out);
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out);
+int MXNDArraySlice(NDArrayHandle handle, mx_uint slice_begin,
+                   mx_uint slice_end, NDArrayHandle *out);
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, int *dims,
+                     NDArrayHandle *out);
+int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle *out);
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id);
+int MXNDArrayGetStorageType(NDArrayHandle handle, int *out_storage_type);
+int MXNDArrayWaitToRead(NDArrayHandle handle);
+int MXNDArrayWaitToWrite(NDArrayHandle handle);
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                          const char **out_buf);
+int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                              NDArrayHandle *out);
+int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
+                                 const NDArrayHandle handle_src,
+                                 const int i);
+int MXNDArrayGetGradState(NDArrayHandle handle, int *out);
+int MXNDArraySetGradState(NDArrayHandle handle, int state);
+int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata);
+int MXNDArrayGetAuxType(NDArrayHandle handle, mx_uint i, int *out_type);
+int MXNDArrayGetAuxNDArray(NDArrayHandle handle, mx_uint i,
+                           NDArrayHandle *out);
+int MXNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle *out);
+
+/* Symbol tail */
+int MXSymbolCopy(SymbolHandle symbol, SymbolHandle *out);
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+int MXSymbolSaveToFile(SymbolHandle symbol, const char *fname);
+int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                        SymbolHandle *out);
+int MXSymbolGetInternals(SymbolHandle symbol, SymbolHandle *out);
+int MXSymbolGetOutput(SymbolHandle symbol, mx_uint index,
+                      SymbolHandle *out);
+int MXSymbolGetChildren(SymbolHandle symbol, SymbolHandle *out);
+int MXSymbolGetName(SymbolHandle symbol, const char **out, int *success);
+int MXSymbolGetAttr(SymbolHandle symbol, const char *key, const char **out,
+                    int *success);
+int MXSymbolSetAttr(SymbolHandle symbol, const char *key,
+                    const char *value);
+int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
+                     const char ***out);
+int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint *out_size,
+                            const char ***out);
+int MXSymbolPrint(SymbolHandle symbol, const char **out_str);
+int MXSymbolGrad(SymbolHandle sym, mx_uint num_wrt, const char **wrt,
+                 SymbolHandle *out);
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char **keys,
+                       const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data, mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size,
+                       const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size,
+                       const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete);
+int MXSymbolInferShapePartial(SymbolHandle sym, mx_uint num_args,
+                              const char **keys, const mx_uint *arg_ind_ptr,
+                              const mx_uint *arg_shape_data,
+                              mx_uint *in_shape_size,
+                              const mx_uint **in_shape_ndim,
+                              const mx_uint ***in_shape_data,
+                              mx_uint *out_shape_size,
+                              const mx_uint **out_shape_ndim,
+                              const mx_uint ***out_shape_data,
+                              mx_uint *aux_shape_size,
+                              const mx_uint **aux_shape_ndim,
+                              const mx_uint ***aux_shape_data,
+                              int *complete);
+int MXSymbolInferType(SymbolHandle sym, mx_uint num_args, const char **keys,
+                      const int *arg_type_data, mx_uint *in_type_size,
+                      const int **in_type_data, mx_uint *out_type_size,
+                      const int **out_type_data, mx_uint *aux_type_size,
+                      const int **aux_type_data, int *complete);
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     AtomicSymbolCreator **out_array);
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **name);
+int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                const char **name, const char **description,
+                                mx_uint *num_args, const char ***arg_names,
+                                const char ***arg_type_infos,
+                                const char ***arg_descriptions,
+                                const char **key_var_num_args,
+                                const char **return_type);
+
+/* legacy Func group (ops exposed through the pre-NNVM function table) */
+int MXListFunctions(mx_uint *out_size, FunctionHandle **out_array);
+int MXGetFunction(const char *name, FunctionHandle *out);
+int MXFuncGetInfo(FunctionHandle fun, const char **name,
+                  const char **description, mx_uint *num_args,
+                  const char ***arg_names, const char ***arg_type_infos,
+                  const char ***arg_descriptions,
+                  const char **return_type);
+int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
+                   mx_uint *num_scalars, mx_uint *num_mutate_vars,
+                   int *type_mask);
+int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
+                 float *scalar_args, NDArrayHandle *mutate_vars);
+int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle *use_vars,
+                   float *scalar_args, NDArrayHandle *mutate_vars,
+                   int num_params, char **param_keys, char **param_vals);
+
+/* KVStore tail */
+int MXKVStoreBarrier(KVStoreHandle kv);
+int MXKVStoreGetType(KVStoreHandle kv, const char **type);
+int MXKVStoreGetNumDeadNode(KVStoreHandle kv, const int node_id,
+                            int *number, const int timeout_sec);
+int MXKVStoreIsWorkerNode(int *ret);
+int MXKVStoreIsServerNode(int *ret);
+int MXKVStoreIsSchedulerNode(int *ret);
+int MXKVStoreRunServer(KVStoreHandle kv,
+                       MXKVStoreServerController controller,
+                       void *controller_handle);
+int MXKVStoreSendCommmandToServers(KVStoreHandle kv, int cmd_id,
+                                   const char *cmd_body);
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle kv, const int do_barrier);
+int MXKVStoreInitEx(KVStoreHandle kv, mx_uint num, const char **keys,
+                    NDArrayHandle *vals);
+int MXKVStorePushEx(KVStoreHandle kv, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority);
+int MXKVStorePullEx(KVStoreHandle kv, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority);
+int MXKVStorePullRowSparse(KVStoreHandle kv, mx_uint num, const char **keys,
+                           NDArrayHandle *vals, const NDArrayHandle *row_ids,
+                           int priority);
+int MXKVStorePullRowSparseEx(KVStoreHandle kv, mx_uint num,
+                             const char **keys, NDArrayHandle *vals,
+                             const NDArrayHandle *row_ids, int priority);
+int MXKVStoreSetUpdater(KVStoreHandle kv, MXKVStoreUpdater updater,
+                        void *updater_handle);
+int MXKVStoreSetUpdaterEx(KVStoreHandle kv, MXKVStoreUpdater updater,
+                          MXKVStoreStrUpdater str_updater,
+                          void *updater_handle);
+
+/* autograd tail */
+int MXAutogradIsTraining(int *curr);
+int MXAutogradBackwardEx(mx_uint num_output, NDArrayHandle *output_handles,
+                         NDArrayHandle *ograd_handles, mx_uint num_variables,
+                         NDArrayHandle *var_handles, int retain_graph,
+                         int create_graph, int is_train,
+                         NDArrayHandle **grad_handles, int **grad_stypes);
+int MXAutogradComputeGradient(mx_uint num_output,
+                              NDArrayHandle *output_handles);
+int MXAutogradGetSymbol(NDArrayHandle handle, SymbolHandle *out);
+
+/* executor tail */
+int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id, mx_uint len,
+                   NDArrayHandle *in_args, NDArrayHandle *arg_grad_store,
+                   mx_uint *grad_req_type, mx_uint aux_states_len,
+                   NDArrayHandle *aux_states, ExecutorHandle *out);
+int MXExecutorBindX(SymbolHandle sym, int dev_type, int dev_id,
+                    mx_uint num_map_keys, const char **map_keys,
+                    const int *map_dev_types, const int *map_dev_ids,
+                    mx_uint len, NDArrayHandle *in_args,
+                    NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                    mx_uint aux_states_len, NDArrayHandle *aux_states,
+                    ExecutorHandle *out);
+int MXExecutorBackwardEx(ExecutorHandle exec, mx_uint len,
+                         NDArrayHandle *head_grads, int is_train);
+int MXExecutorPrint(ExecutorHandle exec, const char **out_str);
+int MXExecutorSetMonitorCallback(ExecutorHandle exec,
+                                 ExecutorMonitorCallback callback,
+                                 void *callback_handle);
+
+/* DataIter tail */
+int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                       uint64_t *out_size);
+int MXDataIterGetIterInfo(const char *name, const char **out_name,
+                          const char **out_desc);
+
+/* misc tail */
+int MXNotifyShutdown(void);
+int MXSetNumOMPThreads(int thread_num);
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos);
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t *pos);
+int MXInitPSEnv(mx_uint num_vars, const char **keys, const char **vals);
+int MXImperativeInvokeEx(const char *op_name, mx_uint num_inputs,
+                         NDArrayHandle *inputs, mx_uint *num_outputs,
+                         NDArrayHandle **outputs, mx_uint num_params,
+                         const char **param_keys, const char **param_vals,
+                         const int **out_stypes);
+
+/* Rtc (see deviation note above) */
+int MXRtcCreate(char *name, mx_uint num_input, mx_uint num_output,
+                char **input_names, char **output_names,
+                NDArrayHandle *inputs, NDArrayHandle *outputs, char *kernel,
+                RtcHandle *out);
+int MXRtcPush(RtcHandle handle, mx_uint num_input, mx_uint num_output,
+              NDArrayHandle *inputs, NDArrayHandle *outputs,
+              mx_uint gridDimX, mx_uint gridDimY, mx_uint gridDimZ,
+              mx_uint blockDimX, mx_uint blockDimY, mx_uint blockDimZ);
+int MXRtcFree(RtcHandle handle);
 
 #ifdef __cplusplus
 }

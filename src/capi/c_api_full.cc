@@ -1072,3 +1072,1197 @@ int MXCustomOpRegister(const char *op_type, const MXTPUCustomOpInfo *info) {
   Py_DECREF(res);
   return 0;
 }
+
+/* ================================================================ round-4
+ * C API breadth tranche: the remaining reference c_api.h groups
+ * (NDArray views/raw-bytes/sparse-read, Symbol manipulation + full
+ * InferShape/Type triples + op introspection, KVStore Ex-batch +
+ * server-role surface, autograd Ex, legacy Func group, executor
+ * Bind/Print/Monitor, misc). Each marshals into mxtpu.capi_bridge like
+ * everything above. */
+
+namespace {
+
+thread_local std::string g_print_arena;
+thread_local std::string g_bytes_arena;
+thread_local std::vector<std::string> g_str_arena2;
+thread_local std::vector<const char *> g_ptr_arena2;
+thread_local std::vector<std::string> g_str_arena3;
+thread_local std::vector<const char *> g_ptr_arena3;
+thread_local std::vector<std::string> g_str_arena4;
+thread_local std::vector<const char *> g_ptr_arena4;
+thread_local std::vector<void *> g_handle_arena2;
+thread_local std::vector<uint64_t> g_index_arena;
+/* per-call arenas for the InferShape triple */
+struct ShapeTriple {
+  std::vector<mx_uint> ndims[3];
+  std::vector<std::vector<mx_uint>> shapes[3];
+  std::vector<const mx_uint *> ptrs[3];
+};
+thread_local ShapeTriple g_triple;
+thread_local std::vector<int> g_type_arena[3];
+/* sorted op-name table backing AtomicSymbolCreator / FunctionHandle */
+std::vector<std::string> *OpTable() {
+  static std::vector<std::string> *table = nullptr;
+  if (table == nullptr) {
+    GilGuard gil;
+    PyObject *res = CallBridge("list_functions", PyTuple_New(0));
+    if (res == nullptr) return nullptr;
+    auto *t = new std::vector<std::string>();
+    Py_ssize_t n = PyList_Size(res);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      t->emplace_back(PyUnicode_AsUTF8(PyList_GetItem(res, i)));
+    }
+    Py_DECREF(res);
+    table = t;
+  }
+  return table;
+}
+
+int StrOut(PyObject *res, const char **out) {
+  g_print_arena = PyUnicode_AsUTF8(res);
+  Py_DECREF(res);
+  *out = g_print_arena.c_str();
+  return 0;
+}
+
+PyObject *HandleList(mx_uint n, NDArrayHandle *hs) {
+  PyObject *list = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    PyList_SetItem(list, i, PyLong_FromLong(HandleToId(hs[i])));
+  }
+  return list;
+}
+
+PyObject *StrList(mx_uint n, const char **ss) {
+  PyObject *list = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    PyList_SetItem(list, i, PyUnicode_FromString(ss[i]));
+  }
+  return list;
+}
+
+/* unpack a python [(d0,d1,...), ...] into slot k of the triple */
+void TripleSlot(PyObject *seq, int k, mx_uint *size, const mx_uint **ndims,
+                const mx_uint ***data) {
+  g_triple.ndims[k].clear();
+  g_triple.shapes[k].clear();
+  g_triple.ptrs[k].clear();
+  Py_ssize_t n = PySequence_Size(seq);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *t = PySequence_GetItem(seq, i);
+    Py_ssize_t nd = PySequence_Size(t);
+    std::vector<mx_uint> dims;
+    for (Py_ssize_t j = 0; j < nd; ++j) {
+      PyObject *d = PySequence_GetItem(t, j);
+      dims.push_back(static_cast<mx_uint>(PyLong_AsUnsignedLong(d)));
+      Py_DECREF(d);
+    }
+    g_triple.ndims[k].push_back(static_cast<mx_uint>(nd));
+    g_triple.shapes[k].push_back(std::move(dims));
+    Py_DECREF(t);
+  }
+  for (auto &s : g_triple.shapes[k]) g_triple.ptrs[k].push_back(s.data());
+  *size = static_cast<mx_uint>(n);
+  *ndims = g_triple.ndims[k].data();
+  *data = g_triple.ptrs[k].data();
+}
+
+}  // namespace
+
+extern "C" {
+
+/* ---------------- NDArray tail ---------------- */
+
+int MXNDArrayCreateNone(NDArrayHandle *out) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge("ndarray_create_none", PyTuple_New(0));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out) {
+  return MXNDArrayCreate(shape, ndim, dev_type, dev_id, delay_alloc, dtype,
+                         out);
+}
+
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "ndarray_at", Py_BuildValue("(lI)", HandleToId(handle), idx));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArraySlice(NDArrayHandle handle, mx_uint slice_begin,
+                   mx_uint slice_end, NDArrayHandle *out) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "ndarray_slice",
+      Py_BuildValue("(lII)", HandleToId(handle), slice_begin, slice_end));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, int *dims,
+                     NDArrayHandle *out) {
+  GilGuard gil;
+  PyObject *shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyTuple_SetItem(shp, i, PyLong_FromLong(dims[i]));
+  }
+  PyObject *res = CallBridge(
+      "ndarray_reshape", Py_BuildValue("(lN)", HandleToId(handle), shp));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle *out) {
+  GilGuard gil;
+  PyObject *res = CallBridge("ndarray_detach",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id) {
+  GilGuard gil;
+  PyObject *res = CallBridge("ndarray_context",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  *out_dev_type = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 0)));
+  *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 1)));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetStorageType(NDArrayHandle handle, int *out_storage_type) {
+  GilGuard gil;
+  PyObject *res = CallBridge("ndarray_storage_type",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  *out_storage_type = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  GilGuard gil;
+  PyObject *res = CallBridge("ndarray_wait_to_read",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayWaitToWrite(NDArrayHandle handle) {
+  GilGuard gil;
+  PyObject *res = CallBridge("ndarray_wait_to_write",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                          const char **out_buf) {
+  GilGuard gil;
+  PyObject *res = CallBridge("ndarray_save_raw_bytes",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  char *p;
+  Py_ssize_t n;
+  PyBytes_AsStringAndSize(res, &p, &n);
+  g_bytes_arena.assign(p, static_cast<size_t>(n));
+  Py_DECREF(res);
+  *out_size = g_bytes_arena.size();
+  *out_buf = g_bytes_arena.data();
+  return 0;
+}
+
+int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                              NDArrayHandle *out) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      static_cast<const char *>(buf), static_cast<Py_ssize_t>(size));
+  PyObject *res = CallBridge("ndarray_load_from_raw_bytes",
+                             Py_BuildValue("(N)", bytes));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
+                                 const NDArrayHandle handle_src,
+                                 const int i) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "ndarray_sync_copy_from_ndarray",
+      Py_BuildValue("(lli)", HandleToId(handle_dst),
+                    HandleToId(const_cast<void *>(handle_src)), i));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetGradState(NDArrayHandle handle, int *out) {
+  GilGuard gil;
+  PyObject *res = CallBridge("ndarray_grad_state",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArraySetGradState(NDArrayHandle handle, int state) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "ndarray_set_grad_state",
+      Py_BuildValue("(li)", HandleToId(handle), state));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata) {
+  GilGuard gil;
+  PyObject *res = CallBridge("ndarray_data_ptr",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  *out_pdata = reinterpret_cast<void *>(PyLong_AsSsize_t(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetAuxType(NDArrayHandle handle, mx_uint i, int *out_type) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "ndarray_aux_type", Py_BuildValue("(lI)", HandleToId(handle), i));
+  if (res == nullptr) return -1;
+  *out_type = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetAuxNDArray(NDArrayHandle handle, mx_uint i,
+                           NDArrayHandle *out) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "ndarray_aux_ndarray", Py_BuildValue("(lI)", HandleToId(handle), i));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle *out) {
+  GilGuard gil;
+  PyObject *res = CallBridge("ndarray_data_ndarray",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+/* ---------------- Symbol tail ---------------- */
+
+int MXSymbolCopy(SymbolHandle symbol, SymbolHandle *out) {
+  GilGuard gil;
+  PyObject *res = CallBridge("symbol_copy",
+                             Py_BuildValue("(l)", HandleToId(symbol)));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge("symbol_create_from_file",
+                             Py_BuildValue("(s)", fname));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolSaveToFile(SymbolHandle symbol, const char *fname) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "symbol_save_to_file",
+      Py_BuildValue("(ls)", HandleToId(symbol), fname));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                        SymbolHandle *out) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "symbol_create_group",
+      Py_BuildValue("(N)", HandleList(num_symbols, symbols)));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolGetInternals(SymbolHandle symbol, SymbolHandle *out) {
+  GilGuard gil;
+  PyObject *res = CallBridge("symbol_get_internals",
+                             Py_BuildValue("(l)", HandleToId(symbol)));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolGetOutput(SymbolHandle symbol, mx_uint index,
+                      SymbolHandle *out) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "symbol_get_output", Py_BuildValue("(lI)", HandleToId(symbol), index));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolGetChildren(SymbolHandle symbol, SymbolHandle *out) {
+  GilGuard gil;
+  PyObject *res = CallBridge("symbol_get_children",
+                             Py_BuildValue("(l)", HandleToId(symbol)));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolGetName(SymbolHandle symbol, const char **out, int *success) {
+  GilGuard gil;
+  PyObject *res = CallBridge("symbol_get_name",
+                             Py_BuildValue("(l)", HandleToId(symbol)));
+  if (res == nullptr) return -1;
+  *success = PyUnicode_GetLength(res) > 0 ? 1 : 0;
+  return StrOut(res, out);
+}
+
+int MXSymbolGetAttr(SymbolHandle symbol, const char *key, const char **out,
+                    int *success) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "symbol_get_attr", Py_BuildValue("(ls)", HandleToId(symbol), key));
+  if (res == nullptr) return -1;
+  *success = PyUnicode_GetLength(res) > 0 ? 1 : 0;
+  return StrOut(res, out);
+}
+
+int MXSymbolSetAttr(SymbolHandle symbol, const char *key,
+                    const char *value) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "symbol_set_attr",
+      Py_BuildValue("(lss)", HandleToId(symbol), key, value));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+static int ListAttrImpl(SymbolHandle symbol, int shallow, mx_uint *out_size,
+                        const char ***out) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "symbol_list_attr",
+      Py_BuildValue("(li)", HandleToId(symbol), shallow));
+  if (res == nullptr) return -1;
+  int rc = StringListOut(res, out_size, out);
+  *out_size /= 2; /* reference returns PAIR count; array holds 2n strings */
+  Py_DECREF(res);
+  return rc;
+}
+
+int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
+                     const char ***out) {
+  return ListAttrImpl(symbol, 0, out_size, out);
+}
+
+int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint *out_size,
+                            const char ***out) {
+  return ListAttrImpl(symbol, 1, out_size, out);
+}
+
+int MXSymbolPrint(SymbolHandle symbol, const char **out_str) {
+  GilGuard gil;
+  PyObject *res = CallBridge("symbol_print",
+                             Py_BuildValue("(l)", HandleToId(symbol)));
+  if (res == nullptr) return -1;
+  return StrOut(res, out_str);
+}
+
+int MXSymbolGrad(SymbolHandle sym, mx_uint num_wrt, const char **wrt,
+                 SymbolHandle *out) {
+  /* exact reference parity: src/c_api/c_api_symbolic.cc:563 is
+   * LOG(FATAL) "not implemented" — gradients come from Executor
+   * backward / autograd */
+  (void)sym; (void)num_wrt; (void)wrt; (void)out;
+  g_last_error = "MXSymbolGrad: not implemented (reference parity; use "
+                 "Executor backward or autograd)";
+  return -1;
+}
+
+static int InferShapeImpl(SymbolHandle sym, mx_uint num_args,
+                          const char **keys, const mx_uint *arg_ind_ptr,
+                          const mx_uint *arg_shape_data, int partial,
+                          mx_uint *in_shape_size,
+                          const mx_uint **in_shape_ndim,
+                          const mx_uint ***in_shape_data,
+                          mx_uint *out_shape_size,
+                          const mx_uint **out_shape_ndim,
+                          const mx_uint ***out_shape_data,
+                          mx_uint *aux_shape_size,
+                          const mx_uint **aux_shape_ndim,
+                          const mx_uint ***aux_shape_data, int *complete) {
+  GilGuard gil;
+  PyObject *names = PyList_New(num_args);
+  PyObject *shapes = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyList_SetItem(names, i, PyUnicode_FromString(keys[i]));
+    const mx_uint lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+    PyObject *shp = PyTuple_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j) {
+      PyTuple_SetItem(shp, j - lo, PyLong_FromUnsignedLong(
+                                       arg_shape_data[j]));
+    }
+    PyList_SetItem(shapes, i, shp);
+  }
+  PyObject *res = CallBridge(
+      "symbol_infer_shape_full",
+      Py_BuildValue("(lNNi)", HandleToId(sym), names, shapes, partial));
+  if (res == nullptr) return -1;
+  TripleSlot(PyTuple_GetItem(res, 0), 0, in_shape_size, in_shape_ndim,
+             in_shape_data);
+  TripleSlot(PyTuple_GetItem(res, 1), 1, out_shape_size, out_shape_ndim,
+             out_shape_data);
+  TripleSlot(PyTuple_GetItem(res, 2), 2, aux_shape_size, aux_shape_ndim,
+             aux_shape_data);
+  *complete = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 3)));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char **keys,
+                       const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data, mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size,
+                       const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size,
+                       const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete) {
+  return InferShapeImpl(sym, num_args, keys, arg_ind_ptr, arg_shape_data, 0,
+                        in_shape_size, in_shape_ndim, in_shape_data,
+                        out_shape_size, out_shape_ndim, out_shape_data,
+                        aux_shape_size, aux_shape_ndim, aux_shape_data,
+                        complete);
+}
+
+int MXSymbolInferShapePartial(SymbolHandle sym, mx_uint num_args,
+                              const char **keys, const mx_uint *arg_ind_ptr,
+                              const mx_uint *arg_shape_data,
+                              mx_uint *in_shape_size,
+                              const mx_uint **in_shape_ndim,
+                              const mx_uint ***in_shape_data,
+                              mx_uint *out_shape_size,
+                              const mx_uint **out_shape_ndim,
+                              const mx_uint ***out_shape_data,
+                              mx_uint *aux_shape_size,
+                              const mx_uint **aux_shape_ndim,
+                              const mx_uint ***aux_shape_data,
+                              int *complete) {
+  return InferShapeImpl(sym, num_args, keys, arg_ind_ptr, arg_shape_data, 1,
+                        in_shape_size, in_shape_ndim, in_shape_data,
+                        out_shape_size, out_shape_ndim, out_shape_data,
+                        aux_shape_size, aux_shape_ndim, aux_shape_data,
+                        complete);
+}
+
+int MXSymbolInferType(SymbolHandle sym, mx_uint num_args, const char **keys,
+                      const int *arg_type_data, mx_uint *in_type_size,
+                      const int **in_type_data, mx_uint *out_type_size,
+                      const int **out_type_data, mx_uint *aux_type_size,
+                      const int **aux_type_data, int *complete) {
+  GilGuard gil;
+  PyObject *names = PyList_New(num_args);
+  PyObject *types = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyList_SetItem(names, i, PyUnicode_FromString(keys[i]));
+    PyList_SetItem(types, i, PyLong_FromLong(arg_type_data[i]));
+  }
+  PyObject *res = CallBridge(
+      "symbol_infer_type",
+      Py_BuildValue("(lNN)", HandleToId(sym), names, types));
+  if (res == nullptr) return -1;
+  for (int k = 0; k < 3; ++k) {
+    PyObject *seq = PyTuple_GetItem(res, k);
+    g_type_arena[k].clear();
+    Py_ssize_t n = PySequence_Size(seq);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *v = PySequence_GetItem(seq, i);
+      g_type_arena[k].push_back(static_cast<int>(PyLong_AsLong(v)));
+      Py_DECREF(v);
+    }
+  }
+  Py_DECREF(res);
+  *in_type_size = static_cast<mx_uint>(g_type_arena[0].size());
+  *in_type_data = g_type_arena[0].data();
+  *out_type_size = static_cast<mx_uint>(g_type_arena[1].size());
+  *out_type_data = g_type_arena[1].data();
+  *aux_type_size = static_cast<mx_uint>(g_type_arena[2].size());
+  *aux_type_data = g_type_arena[2].data();
+  *complete = 1;
+  return 0;
+}
+
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     AtomicSymbolCreator **out_array) {
+  EnsurePython();
+  auto *table = OpTable();
+  if (table == nullptr) return -1;
+  g_handle_arena2.clear();
+  for (size_t i = 0; i < table->size(); ++i) {
+    g_handle_arena2.push_back(reinterpret_cast<void *>(i + 1));
+  }
+  *out_size = static_cast<mx_uint>(table->size());
+  *out_array = g_handle_arena2.data();
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **name) {
+  auto *table = OpTable();
+  size_t idx = reinterpret_cast<size_t>(creator) - 1;
+  if (table == nullptr || idx >= table->size()) {
+    g_last_error = "bad AtomicSymbolCreator";
+    return -1;
+  }
+  *name = (*table)[idx].c_str();
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                const char **name, const char **description,
+                                mx_uint *num_args, const char ***arg_names,
+                                const char ***arg_type_infos,
+                                const char ***arg_descriptions,
+                                const char **key_var_num_args,
+                                const char **return_type) {
+  auto *table = OpTable();
+  size_t idx = reinterpret_cast<size_t>(creator) - 1;
+  if (table == nullptr || idx >= table->size()) {
+    g_last_error = "bad AtomicSymbolCreator";
+    return -1;
+  }
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "symbol_get_atomic_symbol_info",
+      Py_BuildValue("(s)", (*table)[idx].c_str()));
+  if (res == nullptr) return -1;
+  *name = (*table)[idx].c_str();
+  g_print_arena = PyUnicode_AsUTF8(PyTuple_GetItem(res, 0));
+  *description = g_print_arena.c_str();
+  PyObject *an = PyTuple_GetItem(res, 1);
+  PyObject *at = PyTuple_GetItem(res, 2);
+  PyObject *ad = PyTuple_GetItem(res, 3);
+  Py_ssize_t n = PyList_Size(an);
+  g_str_arena2.clear(); g_ptr_arena2.clear();
+  g_str_arena3.clear(); g_ptr_arena3.clear();
+  g_str_arena4.clear(); g_ptr_arena4.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    g_str_arena2.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(an, i)));
+    g_str_arena3.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(at, i)));
+    g_str_arena4.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(ad, i)));
+  }
+  for (auto &s : g_str_arena2) g_ptr_arena2.push_back(s.c_str());
+  for (auto &s : g_str_arena3) g_ptr_arena3.push_back(s.c_str());
+  for (auto &s : g_str_arena4) g_ptr_arena4.push_back(s.c_str());
+  *num_args = static_cast<mx_uint>(n);
+  *arg_names = g_ptr_arena2.data();
+  *arg_type_infos = g_ptr_arena3.data();
+  *arg_descriptions = g_ptr_arena4.data();
+  g_json_arena = PyUnicode_AsUTF8(PyTuple_GetItem(res, 4));
+  *key_var_num_args = g_json_arena.c_str();
+  if (return_type != nullptr) *return_type = "";
+  Py_DECREF(res);
+  return 0;
+}
+
+/* ---------------- legacy Func group ---------------- */
+
+int MXListFunctions(mx_uint *out_size, FunctionHandle **out_array) {
+  EnsurePython();
+  auto *table = OpTable();
+  if (table == nullptr) return -1;
+  g_handle_arena2.clear();
+  for (size_t i = 0; i < table->size(); ++i) {
+    g_handle_arena2.push_back(reinterpret_cast<void *>(i + 1));
+  }
+  *out_size = static_cast<mx_uint>(table->size());
+  *out_array = const_cast<FunctionHandle *>(
+      reinterpret_cast<const FunctionHandle *>(g_handle_arena2.data()));
+  return 0;
+}
+
+int MXGetFunction(const char *name, FunctionHandle *out) {
+  EnsurePython();
+  auto *table = OpTable();
+  if (table == nullptr) return -1;
+  for (size_t i = 0; i < table->size(); ++i) {
+    if ((*table)[i] == name) {
+      *out = reinterpret_cast<FunctionHandle>(i + 1);
+      return 0;
+    }
+  }
+  g_last_error = std::string("no such function: ") + name;
+  return -1;
+}
+
+int MXFuncGetInfo(FunctionHandle fun, const char **name,
+                  const char **description, mx_uint *num_args,
+                  const char ***arg_names, const char ***arg_type_infos,
+                  const char ***arg_descriptions,
+                  const char **return_type) {
+  const char *key_var = nullptr;
+  return MXSymbolGetAtomicSymbolInfo(
+      const_cast<void *>(fun), name, description, num_args, arg_names,
+      arg_type_infos, arg_descriptions, &key_var, return_type);
+}
+
+int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
+                   mx_uint *num_scalars, mx_uint *num_mutate_vars,
+                   int *type_mask) {
+  auto *table = OpTable();
+  size_t idx = reinterpret_cast<size_t>(fun) - 1;
+  if (table == nullptr || idx >= table->size()) {
+    g_last_error = "bad FunctionHandle";
+    return -1;
+  }
+  GilGuard gil;
+  PyObject *res = CallBridge("func_describe",
+                             Py_BuildValue("(s)", (*table)[idx].c_str()));
+  if (res == nullptr) return -1;
+  *num_use_vars = static_cast<mx_uint>(
+      PyLong_AsUnsignedLong(PyTuple_GetItem(res, 0)));
+  *num_scalars = static_cast<mx_uint>(
+      PyLong_AsUnsignedLong(PyTuple_GetItem(res, 1)));
+  *num_mutate_vars = static_cast<mx_uint>(
+      PyLong_AsUnsignedLong(PyTuple_GetItem(res, 2)));
+  *type_mask = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(res, 3)));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
+                 float *scalar_args, NDArrayHandle *mutate_vars) {
+  (void)scalar_args;
+  auto *table = OpTable();
+  size_t idx = reinterpret_cast<size_t>(fun) - 1;
+  if (table == nullptr || idx >= table->size()) {
+    g_last_error = "bad FunctionHandle";
+    return -1;
+  }
+  mx_uint n_use, n_scalar, n_mut;
+  int mask;
+  if (MXFuncDescribe(fun, &n_use, &n_scalar, &n_mut, &mask) != 0) return -1;
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "func_invoke",
+      Py_BuildValue("(sNNN)", (*table)[idx].c_str(),
+                    HandleList(n_use, use_vars), PyList_New(0),
+                    HandleList(n_mut, mutate_vars)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle *use_vars,
+                   float *scalar_args, NDArrayHandle *mutate_vars,
+                   int num_params, char **param_keys, char **param_vals) {
+  (void)num_params; (void)param_keys; (void)param_vals;
+  return MXFuncInvoke(fun, use_vars, scalar_args, mutate_vars);
+}
+
+}  // extern "C"
+
+extern "C" {
+
+/* ---------------- KVStore tail ---------------- */
+
+int MXKVStoreBarrier(KVStoreHandle kv) {
+  GilGuard gil;
+  PyObject *res = CallBridge("kvstore_barrier",
+                             Py_BuildValue("(l)", HandleToId(kv)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreGetType(KVStoreHandle kv, const char **type) {
+  GilGuard gil;
+  PyObject *res = CallBridge("kvstore_type",
+                             Py_BuildValue("(l)", HandleToId(kv)));
+  if (res == nullptr) return -1;
+  return StrOut(res, type);
+}
+
+int MXKVStoreGetNumDeadNode(KVStoreHandle kv, const int node_id,
+                            int *number, const int timeout_sec) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "kvstore_num_dead_node",
+      Py_BuildValue("(lii)", HandleToId(kv), node_id, timeout_sec));
+  if (res == nullptr) return -1;
+  *number = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreIsWorkerNode(int *ret) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge("kvstore_is_worker", PyTuple_New(0));
+  if (res == nullptr) return -1;
+  *ret = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreIsServerNode(int *ret) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge("kvstore_is_server", PyTuple_New(0));
+  if (res == nullptr) return -1;
+  *ret = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreIsSchedulerNode(int *ret) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge("kvstore_is_scheduler", PyTuple_New(0));
+  if (res == nullptr) return -1;
+  *ret = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreRunServer(KVStoreHandle kv,
+                       MXKVStoreServerController controller,
+                       void *controller_handle) {
+  (void)controller_handle; /* bridged controller carries no user data */
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "kvstore_run_server",
+      Py_BuildValue("(lL)", HandleToId(kv),
+                    static_cast<long long>(
+                        reinterpret_cast<intptr_t>(controller))));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreSendCommmandToServers(KVStoreHandle kv, int cmd_id,
+                                   const char *cmd_body) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "kvstore_send_command",
+      Py_BuildValue("(lis)", HandleToId(kv), cmd_id, cmd_body));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle kv, const int do_barrier) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "kvstore_set_barrier_before_exit",
+      Py_BuildValue("(li)", HandleToId(kv), do_barrier));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreInitEx(KVStoreHandle kv, mx_uint num, const char **keys,
+                    NDArrayHandle *vals) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "kvstore_init_batch",
+      Py_BuildValue("(lNN)", HandleToId(kv), StrList(num, keys),
+                    HandleList(num, vals)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStorePushEx(KVStoreHandle kv, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "kvstore_push_batch",
+      Py_BuildValue("(lNNi)", HandleToId(kv), StrList(num, keys),
+                    HandleList(num, vals), priority));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStorePullEx(KVStoreHandle kv, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "kvstore_pull_batch",
+      Py_BuildValue("(lNNi)", HandleToId(kv), StrList(num, keys),
+                    HandleList(num, vals), priority));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStorePullRowSparseEx(KVStoreHandle kv, mx_uint num,
+                             const char **keys, NDArrayHandle *vals,
+                             const NDArrayHandle *row_ids, int priority) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "kvstore_pull_row_sparse",
+      Py_BuildValue("(lNNNi)", HandleToId(kv), StrList(num, keys),
+                    HandleList(num, vals),
+                    HandleList(num, const_cast<NDArrayHandle *>(row_ids)),
+                    priority));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStorePullRowSparse(KVStoreHandle kv, mx_uint num,
+                           const char **keys, NDArrayHandle *vals,
+                           const NDArrayHandle *row_ids, int priority) {
+  return MXKVStorePullRowSparseEx(kv, num, keys, vals, row_ids, priority);
+}
+
+int MXKVStoreSetUpdater(KVStoreHandle kv, MXKVStoreUpdater updater,
+                        void *updater_handle) {
+  (void)updater_handle; /* reference passes it back to the updater; the
+                           bridged updater closes over no user data */
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "kvstore_set_updater_c",
+      Py_BuildValue("(lL)", HandleToId(kv),
+                    static_cast<long long>(
+                        reinterpret_cast<intptr_t>(updater))));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXKVStoreSetUpdaterEx(KVStoreHandle kv, MXKVStoreUpdater updater,
+                          MXKVStoreStrUpdater str_updater,
+                          void *updater_handle) {
+  (void)str_updater;
+  return MXKVStoreSetUpdater(kv, updater, updater_handle);
+}
+
+/* ---------------- autograd tail ---------------- */
+
+int MXAutogradIsTraining(int *curr) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge("autograd_is_training", PyTuple_New(0));
+  if (res == nullptr) return -1;
+  *curr = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXAutogradBackwardEx(mx_uint num_output, NDArrayHandle *output_handles,
+                         NDArrayHandle *ograd_handles, mx_uint num_variables,
+                         NDArrayHandle *var_handles, int retain_graph,
+                         int create_graph, int is_train,
+                         NDArrayHandle **grad_handles, int **grad_stypes) {
+  (void)create_graph;
+  GilGuard gil;
+  PyObject *ogr = ograd_handles != nullptr
+                      ? HandleList(num_output, ograd_handles)
+                      : PyList_New(0);
+  PyObject *vars = var_handles != nullptr
+                       ? HandleList(num_variables, var_handles)
+                       : PyList_New(0);
+  PyObject *res = CallBridge(
+      "autograd_backward_ex",
+      Py_BuildValue("(NNNiii)", HandleList(num_output, output_handles), ogr,
+                    vars, retain_graph, create_graph, is_train));
+  if (res == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(res);
+  g_handle_arena2.clear();
+  g_type_arena[0].clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    g_handle_arena2.push_back(IdToHandle(PyList_GetItem(res, i)));
+    g_type_arena[0].push_back(0);
+  }
+  Py_DECREF(res);
+  if (grad_handles != nullptr) *grad_handles = g_handle_arena2.data();
+  if (grad_stypes != nullptr) *grad_stypes = g_type_arena[0].data();
+  return 0;
+}
+
+int MXAutogradComputeGradient(mx_uint num_output,
+                              NDArrayHandle *output_handles) {
+  return MXAutogradBackward(num_output, output_handles, nullptr, 0);
+}
+
+int MXAutogradGetSymbol(NDArrayHandle handle, SymbolHandle *out) {
+  GilGuard gil;
+  PyObject *res = CallBridge("autograd_get_symbol",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
+}
+
+/* ---------------- executor tail ---------------- */
+
+int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id, mx_uint len,
+                   NDArrayHandle *in_args, NDArrayHandle *arg_grad_store,
+                   mx_uint *grad_req_type, mx_uint aux_states_len,
+                   NDArrayHandle *aux_states, ExecutorHandle *out) {
+  return MXExecutorBindEX(sym, dev_type, dev_id, len, in_args,
+                          arg_grad_store, grad_req_type, aux_states_len,
+                          aux_states, nullptr, out);
+}
+
+int MXExecutorBindX(SymbolHandle sym, int dev_type, int dev_id,
+                    mx_uint num_map_keys, const char **map_keys,
+                    const int *map_dev_types, const int *map_dev_ids,
+                    mx_uint len, NDArrayHandle *in_args,
+                    NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                    mx_uint aux_states_len, NDArrayHandle *aux_states,
+                    ExecutorHandle *out) {
+  /* ctx-group maps place subgraphs on devices; on the TPU runtime that
+   * is symbol-attr driven (__ctx_group__ -> shardings), so the maps are
+   * accepted and the bind itself is the EX path */
+  (void)num_map_keys; (void)map_keys; (void)map_dev_types; (void)map_dev_ids;
+  return MXExecutorBindEX(sym, dev_type, dev_id, len, in_args,
+                          arg_grad_store, grad_req_type, aux_states_len,
+                          aux_states, nullptr, out);
+}
+
+int MXExecutorBackwardEx(ExecutorHandle exec, mx_uint len,
+                         NDArrayHandle *head_grads, int is_train) {
+  (void)is_train;
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "executor_backward_ex",
+      Py_BuildValue("(lN)", HandleToId(exec),
+                    head_grads != nullptr ? HandleList(len, head_grads)
+                                          : PyList_New(0)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXExecutorPrint(ExecutorHandle exec, const char **out_str) {
+  GilGuard gil;
+  PyObject *res = CallBridge("executor_print",
+                             Py_BuildValue("(l)", HandleToId(exec)));
+  if (res == nullptr) return -1;
+  return StrOut(res, out_str);
+}
+
+int MXExecutorSetMonitorCallback(ExecutorHandle exec,
+                                 ExecutorMonitorCallback callback,
+                                 void *callback_handle) {
+  (void)callback_handle;
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "executor_set_monitor_callback",
+      Py_BuildValue("(lL)", HandleToId(exec),
+                    static_cast<long long>(
+                        reinterpret_cast<intptr_t>(callback))));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+/* ---------------- DataIter tail ---------------- */
+
+int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                       uint64_t *out_size) {
+  GilGuard gil;
+  PyObject *res = CallBridge("data_iter_index",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(res);
+  g_index_arena.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    g_index_arena.push_back(PyLong_AsUnsignedLongLong(
+        PyList_GetItem(res, i)));
+  }
+  Py_DECREF(res);
+  *out_index = g_index_arena.data();
+  *out_size = static_cast<uint64_t>(n);
+  return 0;
+}
+
+int MXDataIterGetIterInfo(const char *name, const char **out_name,
+                          const char **out_desc) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge("data_iter_info", Py_BuildValue("(s)", name));
+  if (res == nullptr) return -1;
+  g_str_arena2.clear();
+  g_str_arena2.emplace_back(PyUnicode_AsUTF8(PyTuple_GetItem(res, 0)));
+  g_str_arena2.emplace_back(PyUnicode_AsUTF8(PyTuple_GetItem(res, 1)));
+  Py_DECREF(res);
+  *out_name = g_str_arena2[0].c_str();
+  *out_desc = g_str_arena2[1].c_str();
+  return 0;
+}
+
+/* ---------------- misc tail ---------------- */
+
+int MXNotifyShutdown(void) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge("notify_shutdown", PyTuple_New(0));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXSetNumOMPThreads(int thread_num) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge("set_num_omp_threads",
+                             Py_BuildValue("(i)", thread_num));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos) {
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "recordio_reader_seek",
+      Py_BuildValue("(ln)", HandleToId(handle),
+                    static_cast<Py_ssize_t>(pos)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t *pos) {
+  GilGuard gil;
+  PyObject *res = CallBridge("recordio_writer_tell",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  *pos = static_cast<size_t>(PyLong_AsSsize_t(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXInitPSEnv(mx_uint num_vars, const char **keys, const char **vals) {
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "init_ps_env",
+      Py_BuildValue("(NN)", StrList(num_vars, keys), StrList(num_vars, vals)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXImperativeInvokeEx(const char *op_name, mx_uint num_inputs,
+                         NDArrayHandle *inputs, mx_uint *num_outputs,
+                         NDArrayHandle **outputs, mx_uint num_params,
+                         const char **param_keys, const char **param_vals,
+                         const int **out_stypes) {
+  int rc = MXImperativeInvoke(op_name, num_inputs, inputs, num_outputs,
+                              outputs, num_params, param_keys, param_vals);
+  if (rc != 0) return rc;
+  g_type_arena[1].assign(static_cast<size_t>(*num_outputs), 0);
+  if (out_stypes != nullptr) *out_stypes = g_type_arena[1].data();
+  return 0;
+}
+
+/* ---------------- Rtc (reference parity stance) ---------------- */
+
+int MXRtcCreate(char *name, mx_uint num_input, mx_uint num_output,
+                char **input_names, char **output_names,
+                NDArrayHandle *inputs, NDArrayHandle *outputs, char *kernel,
+                RtcHandle *out) {
+  (void)name; (void)num_input; (void)num_output; (void)input_names;
+  (void)output_names; (void)inputs; (void)outputs; (void)kernel; (void)out;
+  g_last_error =
+      "MXRtcCreate: CUDA-source runtime compilation has no TPU analog; "
+      "use the python mx.rtc API (jax/pallas kernel bodies) instead "
+      "(mxtpu/rtc.py)";
+  return -1;
+}
+
+int MXRtcPush(RtcHandle handle, mx_uint num_input, mx_uint num_output,
+              NDArrayHandle *inputs, NDArrayHandle *outputs,
+              mx_uint gridDimX, mx_uint gridDimY, mx_uint gridDimZ,
+              mx_uint blockDimX, mx_uint blockDimY, mx_uint blockDimZ) {
+  (void)handle; (void)num_input; (void)num_output; (void)inputs;
+  (void)outputs; (void)gridDimX; (void)gridDimY; (void)gridDimZ;
+  (void)blockDimX; (void)blockDimY; (void)blockDimZ;
+  g_last_error = "MXRtcPush: no TPU analog (see MXRtcCreate)";
+  return -1;
+}
+
+int MXRtcFree(RtcHandle handle) {
+  (void)handle;
+  return 0;
+}
+
+}  // extern "C"
+
+
+namespace {
+thread_local std::vector<int> g_capi_tail_stypes;
+}  // namespace
+
+extern "C" int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
+                                  NDArrayHandle *inputs, int *num_outputs,
+                                  NDArrayHandle **outputs,
+                                  const int **out_stypes) {
+  int rc = MXInvokeCachedOp(handle, num_inputs, inputs, num_outputs,
+                            outputs);
+  if (rc != 0) return rc;
+  g_capi_tail_stypes.assign(static_cast<size_t>(*num_outputs), 0);
+  if (out_stypes != nullptr) *out_stypes = g_capi_tail_stypes.data();
+  return 0;
+}

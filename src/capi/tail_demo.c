@@ -1,0 +1,294 @@
+/* Pure-C exercise of the round-4 C-ABI breadth tranche: NDArray views +
+ * raw-bytes + context/stype, Symbol copy/group/attr/print + the full
+ * InferShape/InferType triples, op introspection (the surface reference
+ * bindings code-gen from), the legacy Func group, KVStore Ex-batch +
+ * C-updater + role queries, autograd BackwardEx, Executor Bind + Print +
+ * monitor callback. Prints TAIL OK on success. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "c_api.h"
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      fprintf(stderr, "FAIL %s:%d: %s (%s)\n", __FILE__, __LINE__,    \
+              #cond, MXGetLastError());                               \
+      exit(1);                                                        \
+    }                                                                 \
+  } while (0)
+
+static int g_updater_calls = 0;
+static void updater(int key, NDArrayHandle recv, NDArrayHandle local,
+                    void *handle) {
+  (void)key;
+  (void)handle;
+  /* local -= 0.5 * recv, through the imperative ABI */
+  mx_uint n_out = 1;
+  NDArrayHandle outs[1] = {local};
+  NDArrayHandle *outp = outs;
+  const char *keys[] = {"lr", "wd", "rescale_grad"};
+  const char *vals[] = {"0.5", "0", "1"};
+  NDArrayHandle ins[] = {local, recv};
+  CHECK(MXImperativeInvoke("sgd_update", 2, ins, &n_out, &outp, 3, keys,
+                           vals) == 0);
+  g_updater_calls++;
+}
+
+static int g_monitor_calls = 0;
+static void monitor_cb(const char *name, NDArrayHandle arr, void *h) {
+  (void)name;
+  (void)arr;
+  (void)h;
+  g_monitor_calls++;
+}
+
+int main(void) {
+  /* ---- NDArray tail ---- */
+  mx_uint shape[] = {4, 6};
+  NDArrayHandle a;
+  CHECK(MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, &a) == 0);
+  float buf[24];
+  for (int i = 0; i < 24; ++i) buf[i] = (float)i;
+  CHECK(MXNDArraySyncCopyFromCPU(a, buf, sizeof(buf)) == 0);
+  CHECK(MXNDArrayWaitToRead(a) == 0);
+  CHECK(MXNDArrayWaitToWrite(a) == 0);
+
+  NDArrayHandle row;
+  CHECK(MXNDArrayAt(a, 2, &row) == 0);
+  mx_uint ndim;
+  const mx_uint *dims;
+  CHECK(MXNDArrayGetShape(row, &ndim, &dims) == 0);
+  CHECK(ndim == 1 && dims[0] == 6);
+
+  NDArrayHandle sl;
+  CHECK(MXNDArraySlice(a, 1, 3, &sl) == 0);
+  CHECK(MXNDArrayGetShape(sl, &ndim, &dims) == 0);
+  CHECK(ndim == 2 && dims[0] == 2 && dims[1] == 6);
+
+  int rdims[] = {6, 4};
+  NDArrayHandle rs;
+  CHECK(MXNDArrayReshape(a, 2, rdims, &rs) == 0);
+  CHECK(MXNDArrayGetShape(rs, &ndim, &dims) == 0);
+  CHECK(dims[0] == 6 && dims[1] == 4);
+
+  int dev_type, dev_id, stype;
+  CHECK(MXNDArrayGetContext(a, &dev_type, &dev_id) == 0);
+  CHECK(dev_type >= 1);
+  CHECK(MXNDArrayGetStorageType(a, &stype) == 0);
+  CHECK(stype == 0);
+
+  size_t raw_n;
+  const char *raw;
+  CHECK(MXNDArraySaveRawBytes(a, &raw_n, &raw) == 0);
+  NDArrayHandle back;
+  CHECK(MXNDArrayLoadFromRawBytes(raw, raw_n, &back) == 0);
+  float check[24];
+  CHECK(MXNDArraySyncCopyToCPU(back, check, sizeof(check)) == 0);
+  CHECK(check[7] == 7.0f);
+
+  NDArrayHandle det;
+  CHECK(MXNDArrayDetach(a, &det) == 0);
+  void *pdata;
+  CHECK(MXNDArrayGetData(a, &pdata) == 0);
+  CHECK(((float *)pdata)[5] == 5.0f);
+
+  NDArrayHandle b;
+  CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, 0, &b) == 0);
+  CHECK(MXNDArraySyncCopyFromNDArray(b, a, -1) == 0);
+  CHECK(MXNDArraySyncCopyToCPU(b, check, sizeof(check)) == 0);
+  CHECK(check[23] == 23.0f);
+
+  /* ---- Symbol tail ---- */
+  SymbolHandle data, fc;
+  CHECK(MXSymbolCreateVariable("data", &data) == 0);
+  const char *akeys[] = {"num_hidden"};
+  const char *avals[] = {"8"};
+  CHECK(MXSymbolCreateAtomicSymbol("FullyConnected", 1, akeys, avals,
+                                   &fc) == 0);
+  const char *ckeys[] = {"data"};
+  SymbolHandle cargs[] = {data};
+  CHECK(MXSymbolComposeKeyed(fc, "fc1", 1, ckeys, cargs) == 0);
+
+  SymbolHandle cp;
+  CHECK(MXSymbolCopy(fc, &cp) == 0);
+  const char *name_out;
+  int success;
+  CHECK(MXSymbolGetName(cp, &name_out, &success) == 0);
+  CHECK(success == 1 && strcmp(name_out, "fc1") == 0);
+
+  CHECK(MXSymbolSetAttr(fc, "__ctx_group__", "dev1") == 0);
+  const char *attr_out;
+  CHECK(MXSymbolGetAttr(fc, "__ctx_group__", &attr_out, &success) == 0);
+  CHECK(success == 1 && strcmp(attr_out, "dev1") == 0);
+  mx_uint n_attr;
+  const char **attr_pairs;
+  CHECK(MXSymbolListAttrShallow(fc, &n_attr, &attr_pairs) == 0);
+  CHECK(n_attr >= 1);
+
+  SymbolHandle grp_in[] = {fc};
+  SymbolHandle grp;
+  CHECK(MXSymbolCreateGroup(1, grp_in, &grp) == 0);
+  SymbolHandle internals, out0, kids;
+  CHECK(MXSymbolGetInternals(fc, &internals) == 0);
+  CHECK(MXSymbolGetOutput(fc, 0, &out0) == 0);
+  CHECK(MXSymbolGetChildren(fc, &kids) == 0);
+  const char *pstr;
+  CHECK(MXSymbolPrint(fc, &pstr) == 0);
+  CHECK(strstr(pstr, "fc1") != NULL);
+
+  /* full InferShape triple */
+  const char *ikeys[] = {"data"};
+  mx_uint indptr[] = {0, 2};
+  mx_uint sdata[] = {2, 16};
+  mx_uint in_sz, out_sz, aux_sz;
+  const mx_uint *in_nd, *out_nd, *aux_nd;
+  const mx_uint **in_sh, **out_sh, **aux_sh;
+  int complete;
+  CHECK(MXSymbolInferShape(fc, 1, ikeys, indptr, sdata, &in_sz, &in_nd,
+                           &in_sh, &out_sz, &out_nd, &out_sh, &aux_sz,
+                           &aux_nd, &aux_sh, &complete) == 0);
+  CHECK(complete == 1 && in_sz == 3);      /* data, weight, bias */
+  CHECK(out_sz == 1 && out_sh[0][0] == 2 && out_sh[0][1] == 8);
+  CHECK(in_sh[1][0] == 8 && in_sh[1][1] == 16); /* fc1_weight */
+
+  int tkeys[] = {0};
+  mx_uint it_sz, ot_sz, at_sz;
+  const int *it_d, *ot_d, *at_d;
+  CHECK(MXSymbolInferType(fc, 1, ikeys, tkeys, &it_sz, &it_d, &ot_sz,
+                          &ot_d, &at_sz, &at_d, &complete) == 0);
+  CHECK(ot_sz == 1 && ot_d[0] == 0);
+
+  /* MXSymbolGrad: exact reference parity = not implemented */
+  SymbolHandle gout;
+  const char *wrt[] = {"data"};
+  CHECK(MXSymbolGrad(fc, 1, wrt, &gout) == -1);
+
+  /* ---- op introspection + Func group ---- */
+  mx_uint n_ops;
+  AtomicSymbolCreator *creators;
+  CHECK(MXSymbolListAtomicSymbolCreators(&n_ops, &creators) == 0);
+  CHECK(n_ops >= 288);
+  const char *op_name;
+  CHECK(MXSymbolGetAtomicSymbolName(creators[0], &op_name) == 0);
+  const char *desc, *key_var, *ret_type;
+  mx_uint n_args;
+  const char **arg_names, **arg_types, **arg_descs;
+  FunctionHandle conv_fn;
+  CHECK(MXGetFunction("Convolution", &conv_fn) == 0);
+  CHECK(MXSymbolGetAtomicSymbolInfo(conv_fn, &op_name, &desc, &n_args,
+                                    &arg_names, &arg_types, &arg_descs,
+                                    &key_var, &ret_type) == 0);
+  CHECK(strcmp(op_name, "Convolution") == 0 && n_args >= 3);
+
+  mx_uint n_funcs;
+  FunctionHandle *funcs;
+  CHECK(MXListFunctions(&n_funcs, &funcs) == 0);
+  CHECK(n_funcs == n_ops);
+  FunctionHandle relu_fn;
+  CHECK(MXGetFunction("relu", &relu_fn) == 0);
+  mx_uint nu, ns, nm;
+  int tmask;
+  CHECK(MXFuncDescribe(relu_fn, &nu, &ns, &nm, &tmask) == 0);
+  NDArrayHandle neg;
+  mx_uint nshape[] = {3};
+  CHECK(MXNDArrayCreate(nshape, 1, 1, 0, 0, 0, &neg) == 0);
+  float nvals[] = {-1.0f, 2.0f, -3.0f};
+  CHECK(MXNDArraySyncCopyFromCPU(neg, nvals, sizeof(nvals)) == 0);
+  NDArrayHandle relu_out;
+  CHECK(MXNDArrayCreate(nshape, 1, 1, 0, 0, 0, &relu_out) == 0);
+  NDArrayHandle use[] = {neg}, mut[] = {relu_out};
+  CHECK(MXFuncInvoke(relu_fn, use, NULL, mut) == 0);
+  float rvals[3];
+  CHECK(MXNDArraySyncCopyToCPU(relu_out, rvals, sizeof(rvals)) == 0);
+  CHECK(rvals[0] == 0.0f && rvals[1] == 2.0f && rvals[2] == 0.0f);
+
+  /* ---- KVStore tail ---- */
+  KVStoreHandle kv;
+  CHECK(MXKVStoreCreate("local", &kv) == 0);
+  const char *kv_type;
+  CHECK(MXKVStoreGetType(kv, &kv_type) == 0);
+  CHECK(strstr(kv_type, "local") != NULL);
+  int is_worker, is_server, is_sched;
+  CHECK(MXKVStoreIsWorkerNode(&is_worker) == 0 && is_worker == 1);
+  CHECK(MXKVStoreIsServerNode(&is_server) == 0 && is_server == 0);
+  CHECK(MXKVStoreIsSchedulerNode(&is_sched) == 0 && is_sched == 0);
+  CHECK(MXKVStoreBarrier(kv) == 0);
+  CHECK(MXKVStoreSetBarrierBeforeExit(kv, 0) == 0);
+  int dead;
+  CHECK(MXKVStoreGetNumDeadNode(kv, -1, &dead, 60) == 0 && dead == 0);
+
+  NDArrayHandle w0, g0;
+  mx_uint wshape[] = {2, 2};
+  CHECK(MXNDArrayCreate(wshape, 2, 1, 0, 0, 0, &w0) == 0);
+  CHECK(MXNDArrayCreate(wshape, 2, 1, 0, 0, 0, &g0) == 0);
+  float wv[] = {1, 1, 1, 1}, gv[] = {2, 2, 2, 2};
+  CHECK(MXNDArraySyncCopyFromCPU(w0, wv, sizeof(wv)) == 0);
+  CHECK(MXNDArraySyncCopyFromCPU(g0, gv, sizeof(gv)) == 0);
+  const char *kv_keys[] = {"3"};
+  NDArrayHandle kv_vals[] = {w0};
+  CHECK(MXKVStoreInitEx(kv, 1, kv_keys, kv_vals) == 0);
+  CHECK(MXKVStoreSetUpdater(kv, updater, NULL) == 0);
+  NDArrayHandle kv_grads[] = {g0};
+  CHECK(MXKVStorePushEx(kv, 1, kv_keys, kv_grads, 0) == 0);
+  NDArrayHandle pulled;
+  CHECK(MXNDArrayCreate(wshape, 2, 1, 0, 0, 0, &pulled) == 0);
+  NDArrayHandle kv_outs[] = {pulled};
+  CHECK(MXKVStorePullEx(kv, 1, kv_keys, kv_outs, 0) == 0);
+  float pv[4];
+  CHECK(MXNDArraySyncCopyToCPU(pulled, pv, sizeof(pv)) == 0);
+  CHECK(g_updater_calls == 1);
+  CHECK(pv[0] == 0.0f); /* 1 - 0.5*2 */
+
+  /* ---- executor Bind + Print + monitor ---- */
+  SymbolHandle net;
+  CHECK(MXSymbolCreateAtomicSymbol("SoftmaxOutput", 0, NULL, NULL,
+                                   &net) == 0);
+  SymbolHandle fc_for_net;
+  CHECK(MXSymbolCopy(fc, &fc_for_net) == 0);
+  const char *nkeys[] = {"data"};
+  SymbolHandle nargs[] = {fc_for_net};
+  CHECK(MXSymbolComposeKeyed(net, "softmax", 1, nkeys, nargs) == 0);
+  mx_uint nsym_in, dummy_nd;
+  const char **arg_list;
+  CHECK(MXSymbolListArguments(net, &nsym_in, &arg_list) == 0);
+  CHECK(nsym_in == 4); /* data, fc1_weight, fc1_bias, softmax_label */
+  NDArrayHandle in_args[4], arg_grads[4];
+  mx_uint reqs[4];
+  mx_uint shapes_in[4][2] = {{2, 16}, {8, 16}, {8, 1}, {2, 1}};
+  mx_uint ndims_in[4] = {2, 2, 1, 1};
+  for (int i = 0; i < 4; ++i) {
+    CHECK(MXNDArrayCreate(shapes_in[i], ndims_in[i], 1, 0, 0, 0,
+                          &in_args[i]) == 0);
+    CHECK(MXNDArrayCreate(shapes_in[i], ndims_in[i], 1, 0, 0, 0,
+                          &arg_grads[i]) == 0);
+    reqs[i] = 1;
+  }
+  ExecutorHandle exec;
+  CHECK(MXExecutorBind(net, 1, 0, 4, in_args, arg_grads, reqs, 0, NULL,
+                       &exec) == 0);
+  CHECK(MXExecutorSetMonitorCallback(exec, monitor_cb, NULL) == 0);
+  CHECK(MXExecutorForward(exec, 1) == 0);
+  CHECK(MXExecutorBackwardEx(exec, 0, NULL, 1) == 0);
+  const char *exec_str;
+  CHECK(MXExecutorPrint(exec, &exec_str) == 0);
+  CHECK(strstr(exec_str, "output") != NULL);
+
+  /* ---- misc ---- */
+  CHECK(MXSetNumOMPThreads(2) == 0);
+  const char *env_keys[] = {"DMLC_TAIL_DEMO"};
+  const char *env_vals[] = {"1"};
+  CHECK(MXInitPSEnv(1, env_keys, env_vals) == 0);
+  NDArrayHandle none_h;
+  CHECK(MXNDArrayCreateNone(&none_h) == 0);
+  RtcHandle rtc;
+  CHECK(MXRtcCreate((char *)"k", 0, 0, NULL, NULL, NULL, NULL,
+                    (char *)"__global__", &rtc) == -1);
+  CHECK(strstr(MXGetLastError(), "mx.rtc") != NULL);
+  CHECK(MXNotifyShutdown() == 0);
+
+  printf("TAIL OK (updater=%d monitor=%d)\n", g_updater_calls,
+         g_monitor_calls);
+  return 0;
+}

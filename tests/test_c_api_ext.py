@@ -140,3 +140,20 @@ def test_partial_forward_matches_full(tmp_path):
     p3.forward(data=x)
     feat = p3.get_output(0)
     assert feat.shape == (3, 5)
+
+
+def test_c_api_tail_groups(tmp_path):
+    """Round-4 breadth tranche from pure C (src/capi/tail_demo.c):
+    NDArray views/raw-bytes/context, Symbol copy/group/attrs/Print + full
+    InferShape/InferType triples, op introspection + legacy Func invoke,
+    KVStore Ex-batch with a C updater callback, Executor Bind/Print/
+    monitor, misc (OMP threads, PS env, Rtc parity stance)."""
+    ok, log = _build()
+    if not ok:
+        pytest.skip("libmxtpu_capi.so did not build: %s" % log[-400:])
+    exe = _cc("tail_demo.c", str(tmp_path / "tail_demo"), "mxtpu_capi")
+    r = subprocess.run([exe], capture_output=True, text=True, env=_env(),
+                       timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "TAIL OK" in r.stdout, r.stdout + r.stderr
+    assert "updater=1" in r.stdout
