@@ -63,6 +63,7 @@ def main(argv=None):
         args.burn_in = args.epochs - 1
     logging.basicConfig(level=logging.INFO)
     mx.random.seed(args.seed)
+    np.random.seed(args.seed)
     rng = np.random.RandomState(args.seed)
 
     X, y = two_moons(1200, rng)

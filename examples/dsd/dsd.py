@@ -88,6 +88,7 @@ def main(argv=None):
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     mx.random.seed(args.seed)
+    np.random.seed(args.seed)
     rng = np.random.RandomState(args.seed)
     num_classes = 6
 

@@ -322,6 +322,7 @@ def main(argv=None):
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     mx.random.seed(args.seed)
+    np.random.seed(args.seed)
 
     sym = build_train_symbol()
     mod = mx.mod.Module(

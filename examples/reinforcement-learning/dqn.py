@@ -144,6 +144,7 @@ def main(argv=None):
     logging.basicConfig(level=logging.INFO)
     rng = np.random.RandomState(args.seed)
     mx.random.seed(args.seed)
+    np.random.seed(args.seed)
 
     env = GridWorld(seed=args.seed)
     mem = ReplayMemory(4000, env.n_obs, rng)

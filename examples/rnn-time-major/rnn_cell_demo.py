@@ -89,6 +89,7 @@ def main(argv=None):
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     mx.random.seed(args.seed)
+    np.random.seed(args.seed)
 
     rng = np.random.RandomState(args.seed)
     X, Y = synth_corpus(args.num_seq, args.seq_len, args.vocab, rng)

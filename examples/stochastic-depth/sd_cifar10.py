@@ -82,6 +82,7 @@ def main(argv=None):
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     mx.random.seed(args.seed)
+    np.random.seed(args.seed)
     rng = np.random.RandomState(args.seed)
 
     X, y = synth_images(1600, args.num_classes, rng)

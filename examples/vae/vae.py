@@ -68,6 +68,7 @@ def main(argv=None):
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     mx.random.seed(args.seed)
+    np.random.seed(args.seed)
     rng = np.random.RandomState(args.seed)
 
     X, protos = glyph_data(1024, rng)

@@ -21,7 +21,12 @@ def _key():
 
 
 def seed(seed_state):
-    """Seed the global generator (parity mx.random.seed)."""
+    """Seed the global generator (parity mx.random.seed).
+
+    Reference semantics: this does NOT touch numpy's global RNG.
+    Host-side paths that draw from np.random (NDArrayIter shuffling, like
+    the reference's python/mxnet/io.py) need np.random.seed alongside —
+    the reference's own tests seed both."""
     _state.key = jax.random.PRNGKey(int(seed_state))
 
 

@@ -43,6 +43,7 @@ def test_lstm_bucketing_gate():
     import mxtpu as mx
     import lstm_bucketing
     mx.random.seed(7)  # deterministic init regardless of suite order
+    np.random.seed(7)  # NDArrayIter shuffle draws from numpy's global RNG
     ppl = lstm_bucketing.main([
         "--num-epochs", "6", "--num-hidden", "64", "--num-embed", "32"])
     assert len(ppl) == 6
@@ -58,6 +59,7 @@ def test_transformer_lm_gate():
     import mxtpu as mx
     import train_lm
     mx.random.seed(7)  # deterministic init regardless of suite order
+    np.random.seed(7)  # NDArrayIter shuffle draws from numpy's global RNG
     ppl = train_lm.main(["--epochs", "2", "--seq-len", "32",
                          "--d-model", "64", "--num-heads", "4",
                          "--seq-parallel"])
@@ -80,6 +82,7 @@ def test_ssd_gate(tmp_path):
     # seed immediately before training so the init draw is deterministic
     # regardless of suite order or the eval above
     mx.random.seed(2)
+    np.random.seed(2)  # NDArrayIter shuffle draws from numpy's global RNG
     _mod, metrics = ssd_train.main(common + [
         "--num-batches", "8", "--num-epochs", "12", "--lr", "0.05",
         "--prefix", prefix])
@@ -117,6 +120,7 @@ def test_gluon_word_lm_gate():
     import mxtpu as mx
     import word_language_model
     mx.random.seed(11)
+    np.random.seed(11)  # NDArrayIter shuffle draws from numpy's global RNG
     ppl = word_language_model.main(["--epochs", "4", "--n-tokens", "8000",
                                     "--num-hidden", "48", "--lr", "2"])
     assert len(ppl) == 4
@@ -131,6 +135,7 @@ def test_gluon_super_resolution_gate():
     import mxtpu as mx
     import super_resolution
     mx.random.seed(3)
+    np.random.seed(3)  # NDArrayIter shuffle draws from numpy's global RNG
     psnrs = super_resolution.main(["--epochs", "2"])
     assert psnrs[-1] > psnrs[0] + 3.0, \
         "PSNR did not improve enough: %s" % (psnrs,)
@@ -145,6 +150,7 @@ def test_gluon_dcgan_gate():
     import mxtpu as mx
     import dcgan
     mx.random.seed(5)
+    np.random.seed(5)  # NDArrayIter shuffle draws from numpy's global RNG
     acc0, min_acc = dcgan.main(["--epochs", "4"])
     assert min_acc < 0.9, \
         "generator never fooled the discriminator: first=%s min=%s" \
@@ -263,6 +269,7 @@ def test_lstm_bucketing_fused_gate():
     import mxtpu as mx
     import lstm_bucketing
     mx.random.seed(7)
+    np.random.seed(7)  # NDArrayIter shuffle draws from numpy's global RNG
     np.random.seed(7)  # NDArrayIter shuffle rides the global numpy RNG
     ppl = lstm_bucketing.main([
         "--fused", "--num-epochs", "8", "--num-hidden", "64",
@@ -288,6 +295,7 @@ def test_numpy_ops_custom_softmax_gate():
     _example("numpy_ops", "custom_softmax.py")
     import mxtpu as mx
     mx.random.seed(42)  # deterministic init regardless of suite order
+    np.random.seed(42)  # NDArrayIter shuffle draws from numpy's global RNG
     import custom_softmax
     acc = custom_softmax.main(["--epochs", "6"])
     assert acc > 0.9, "custom-softmax MLP reached only %.3f" % acc
@@ -301,6 +309,7 @@ def test_recommenders_matrix_fact_gate():
     _example("recommenders", "matrix_fact.py")
     import mxtpu as mx
     mx.random.seed(42)  # deterministic init regardless of suite order
+    np.random.seed(42)  # NDArrayIter shuffle draws from numpy's global RNG
     import matrix_fact
     score = matrix_fact.main(["--epochs", "8"])
     assert score < 0.35, "MF val RMSE stuck at %.3f" % score
@@ -316,6 +325,7 @@ def test_gan_symbolic_gate():
     import mxtpu as mx
     import dcgan_sym
     mx.random.seed(7)
+    np.random.seed(7)  # NDArrayIter shuffle draws from numpy's global RNG
     first_acc, min_acc = dcgan_sym.main(["--epochs", "3"])
     assert min_acc < 0.9, \
         "generator never fooled D: first=%s min=%s" % (first_acc, min_acc)
@@ -329,6 +339,7 @@ def test_fcn_xs_gate():
     _example("fcn-xs", "fcn_xs.py")
     import mxtpu as mx
     mx.random.seed(42)  # deterministic init regardless of suite order
+    np.random.seed(42)  # NDArrayIter shuffle draws from numpy's global RNG
     import fcn_xs
     acc = fcn_xs.main(["--epochs", "12"])
     assert acc > 0.9, "fcn-xs pixel accuracy stuck at %.3f" % acc
@@ -341,6 +352,7 @@ def test_neural_style_gate():
     _example("neural-style", "nstyle.py")
     import mxtpu as mx
     mx.random.seed(42)  # deterministic init regardless of suite order
+    np.random.seed(42)  # NDArrayIter shuffle draws from numpy's global RNG
     import nstyle
     first, last = nstyle.main(["--iters", "40"])
     assert last < first * 0.4, \
@@ -355,6 +367,7 @@ def test_dqn_gate():
     _example("reinforcement-learning", "dqn.py")
     import mxtpu as mx
     mx.random.seed(42)
+    np.random.seed(42)  # NDArrayIter shuffle draws from numpy's global RNG
     import dqn
     ret = dqn.main(["--updates", "400"])
     assert ret > 0.5, "greedy return stuck at %.3f" % ret
@@ -368,6 +381,7 @@ def test_parallel_actor_critic_gate():
     _example("reinforcement-learning", "parallel_actor_critic.py")
     import mxtpu as mx
     mx.random.seed(42)
+    np.random.seed(42)  # NDArrayIter shuffle draws from numpy's global RNG
     import parallel_actor_critic
     steps = parallel_actor_critic.main(["--iters", "250"])
     assert steps > 50, "episode length stuck at %.1f" % steps
@@ -381,6 +395,7 @@ def test_stochastic_depth_gate():
     _example("stochastic-depth", "sd_cifar10.py")
     import mxtpu as mx
     mx.random.seed(42)
+    np.random.seed(42)  # NDArrayIter shuffle draws from numpy's global RNG
     import sd_cifar10
     acc = sd_cifar10.main(["--epochs", "8"])
     assert acc > 0.85, "stochastic-depth net reached only %.3f" % acc
@@ -394,6 +409,7 @@ def test_dec_gate():
     _example("dec", "dec.py")
     import mxtpu as mx
     mx.random.seed(42)
+    np.random.seed(42)  # NDArrayIter shuffle draws from numpy's global RNG
     import dec
     acc = dec.main([])
     assert acc > 0.9, "DEC cluster accuracy stuck at %.3f" % acc
@@ -406,6 +422,7 @@ def test_vae_gate():
     _example("vae", "vae.py")
     import mxtpu as mx
     mx.random.seed(42)
+    np.random.seed(42)  # NDArrayIter shuffle draws from numpy's global RNG
     import vae
     start, end = vae.main(["--epochs", "30"])
     assert end < 0.5 * start, "-ELBO %.2f -> %.2f (no real improvement)" \
@@ -420,6 +437,7 @@ def test_dsd_gate():
     _example("dsd", "dsd.py")
     import mxtpu as mx
     mx.random.seed(42)
+    np.random.seed(42)  # NDArrayIter shuffle draws from numpy's global RNG
     import dsd
     dense, sparse, final, frac_zero = dsd.main([])
     assert frac_zero > 0.55, "mask not applied: zero frac %.2f" % frac_zero
@@ -436,6 +454,7 @@ def test_speech_acoustic_gate():
     _example("speech-demo", "speech_acoustic.py")
     import mxtpu as mx
     mx.random.seed(42)
+    np.random.seed(42)  # NDArrayIter shuffle draws from numpy's global RNG
     import speech_acoustic
     acc = speech_acoustic.main(["--epochs", "8"])
     assert acc > 0.9, "frame accuracy stuck at %.3f" % acc
@@ -448,6 +467,7 @@ def test_sgld_bnn_gate():
     _example("bayesian-methods", "sgld_bnn.py")
     import mxtpu as mx
     mx.random.seed(42)
+    np.random.seed(42)  # NDArrayIter shuffle draws from numpy's global RNG
     import sgld_bnn
     acc_single, acc_ens, h_mean, h_ens, spread = sgld_bnn.main(
         ["--epochs", "30", "--burn-in", "15", "--lr", "0.0003"])
@@ -466,6 +486,7 @@ def test_lstm_ocr_ctc_gate():
     _example("ctc", "lstm_ocr.py")
     import mxtpu as mx
     mx.random.seed(42)  # deterministic init regardless of suite order
+    np.random.seed(42)  # NDArrayIter shuffle draws from numpy's global RNG
     import lstm_ocr
     acc = lstm_ocr.main(["--epochs", "25", "--lr", "0.01"])
     assert acc > 0.8, "OCR sequence accuracy stuck at %.3f" % acc
@@ -479,6 +500,7 @@ def test_rcnn_gate():
     _example("rcnn", "train_end2end.py")
     import mxtpu as mx
     mx.random.seed(42)  # deterministic init regardless of suite order
+    np.random.seed(42)  # NDArrayIter shuffle draws from numpy's global RNG
     import train_end2end
     acc = train_end2end.main(["--epochs", "6"])
     assert acc > 0.8, "rcnn detection accuracy stuck at %.3f" % acc
@@ -491,6 +513,7 @@ def test_python_loss_module_gate():
     _example("module", "python_loss.py")
     import mxtpu as mx
     mx.random.seed(42)  # deterministic init regardless of suite order
+    np.random.seed(42)  # NDArrayIter shuffle draws from numpy's global RNG
     import python_loss
     acc = python_loss.main(["--epochs", "8"])
     assert acc > 0.9, "hinge-loss MLP stuck at %.3f" % acc
@@ -503,6 +526,7 @@ def test_time_major_rnn_gate():
     _example("rnn-time-major", "rnn_cell_demo.py")
     import mxtpu as mx
     mx.random.seed(42)  # deterministic init regardless of suite order
+    np.random.seed(42)  # NDArrayIter shuffle draws from numpy's global RNG
     import rnn_cell_demo
     hist = rnn_cell_demo.main(["--epochs", "6"])
     assert hist[-1] < hist[0] * 0.6, "perplexity did not fall: %s" % hist
@@ -542,6 +566,16 @@ def test_torch_module_example_gate():
     _example("torch", "torch_module.py")
     import mxtpu as mx
     mx.random.seed(42)  # deterministic init regardless of suite order
+    np.random.seed(42)  # NDArrayIter shuffle draws from numpy's global RNG
     import torch_module
     acc = torch_module.main(["--epochs", "6"])
     assert acc > 0.9, "torch-in-graph accuracy stuck at %.3f" % acc
+
+
+def test_python_howto_examples():
+    """API how-tos (examples/python-howto/howtos.py, parity
+    example/python-howto): monitor stats, multi-output Group, conv
+    debugging, manual DataIter driving — all four mechanisms work."""
+    _example("python-howto", "howtos.py")
+    import howtos
+    assert howtos.main() is True
