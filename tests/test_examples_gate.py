@@ -269,7 +269,6 @@ def test_lstm_bucketing_fused_gate():
     import mxtpu as mx
     import lstm_bucketing
     mx.random.seed(7)
-    np.random.seed(7)  # NDArrayIter shuffle draws from numpy's global RNG
     np.random.seed(7)  # NDArrayIter shuffle rides the global numpy RNG
     ppl = lstm_bucketing.main([
         "--fused", "--num-epochs", "8", "--num-hidden", "64",

@@ -226,3 +226,25 @@ def test_map_metric():
     m2.update([mx.nd.array(label2)], [mx.nd.array(det)])
     _, val2 = m2.get()
     assert abs(val2 - 0.5) < 1e-6
+
+
+def test_benchmark_score_smoke():
+    """tools/benchmark_score.py (parity example/image-classification/
+    benchmark_score.py): the zoo inference sweep runs and reports img/s."""
+    import json
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "benchmark_score.py"),
+         "--networks", "resnet-18", "--batch-sizes", "2",
+         "--num-batches", "2", "--cpu"],
+        capture_output=True, text=True, timeout=600,
+        # PYTHONPATH=repo deliberately REPLACES the baked axon sitecustomize
+        # path: with the device relay wedged, that sitecustomize hangs any
+        # fresh interpreter at import (see .claude/skills/verify gotchas)
+        env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo))
+    assert r.returncode == 0, r.stdout + r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["images_per_sec"] > 0

@@ -51,7 +51,10 @@ EOF
         timeout 4500 python tools/flag_sweep.py 40 > flag_sweep_results.txt 2>&1
         echo "$(date -Is) flag sweep done; running pallas epilogue A/B" >> tpu_watch.log
         timeout 900 python tools/bench_epilogue.py 256 > epilogue_results.txt 2>&1
-        echo "$(date -Is) epilogue A/B done" >> tpu_watch.log
+        echo "$(date -Is) epilogue A/B done; running zoo inference sweep" >> tpu_watch.log
+        timeout 2400 python tools/benchmark_score.py --batch-sizes 1,32,128 \
+            --num-batches 50 --dtype bfloat16 > benchmark_score_results.txt 2>&1
+        echo "$(date -Is) zoo inference sweep done" >> tpu_watch.log
         exit 0
     fi
     echo "$(date -Is) tunnel down; retrying" >> tpu_watch.log
