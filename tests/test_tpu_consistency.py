@@ -138,6 +138,32 @@ def test_grouped_and_depthwise_conv_consistency():
                       rtol=2e-3, atol=2e-3)
 
 
+def _run_on_chip_subprocess(code, ok_token):
+    """Run pallas-kernel code against the real chip in a watchdogged
+    subprocess: a wedged device relay hangs the first jax call forever,
+    and that must SKIP the tier, not hang it. PYTHONPATH PREPENDS the
+    repo (replacing it would drop the axon plugin path, turning the
+    wedged-tunnel hang into a bogus unknown-backend failure)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    try:
+        r = subprocess.run([sys.executable, "-u", "-c", code], env=env,
+                           capture_output=True, text=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        pytest.skip("device relay hung during Mosaic compile/run "
+                    "(wedged tunnel)")
+    if "NO_ACCELERATOR" in r.stdout:
+        pytest.skip("subprocess saw no accelerator backend")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert ok_token in r.stdout
+
+
 def test_pallas_flash_kernel_on_chip():
     """The compiled (non-interpret) Pallas flash kernel must match the
     reference attention math on the real chip — values and gradients.
@@ -184,17 +210,37 @@ with jax.default_matmul_precision("highest"):
                                rtol=5e-3, atol=5e-3)
 print("PALLAS_ON_CHIP_OK")
 """
+    _run_on_chip_subprocess(code, "PALLAS_ON_CHIP_OK")
+
+
+def test_pallas_epilogue_kernel_on_chip():
+    """The Mosaic-compiled BN-apply+ReLU+add epilogue (ops/epilogue.py)
+    must match the XLA formulation on the real chip — CPU only exercises
+    interpret mode. Subprocess-watchdogged like the flash-kernel check."""
     import os
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))
-    try:
-        r = subprocess.run([sys.executable, "-u", "-c", code], env=env,
-                           capture_output=True, text=True, timeout=600)
-    except subprocess.TimeoutExpired:
-        pytest.skip("device relay hung during Mosaic compile/run "
-                    "(wedged tunnel)")
-    if "NO_ACCELERATOR" in r.stdout:
-        pytest.skip("subprocess saw no accelerator backend")
-    assert r.returncode == 0, r.stdout + r.stderr
-    assert "PALLAS_ON_CHIP_OK" in r.stdout
+    import subprocess
+    import sys
+
+    code = r"""
+import sys
+import numpy as np, jax, jax.numpy as jnp
+if jax.default_backend() == "cpu":
+    print("NO_ACCELERATOR")
+    sys.exit(0)
+from mxtpu.ops.epilogue import (bn_apply_relu_add,
+                                bn_apply_relu_add_reference, fold_bn)
+rng = np.random.RandomState(4)
+m, c = 4096, 256
+x = jnp.asarray(rng.randn(m, c), jnp.bfloat16)
+r = jnp.asarray(rng.randn(m, c), jnp.bfloat16)
+scale, shift = fold_bn(jnp.asarray(rng.rand(c) + 0.5, jnp.float32),
+                       jnp.asarray(rng.randn(c), jnp.float32),
+                       jnp.asarray(rng.randn(c), jnp.float32),
+                       jnp.asarray(rng.rand(c) + 0.1, jnp.float32))
+got = np.asarray(bn_apply_relu_add(x, scale, shift, r)).astype("f4")
+want = np.asarray(bn_apply_relu_add_reference(x, scale, shift, r)
+                  ).astype("f4")
+np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+print("EPILOGUE_ON_CHIP_OK")
+"""
+    _run_on_chip_subprocess(code, "EPILOGUE_ON_CHIP_OK")
