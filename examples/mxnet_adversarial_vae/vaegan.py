@@ -1,0 +1,176 @@
+"""Adversarial VAE (parity: example/mxnet_adversarial_vae/vaegan_mxnet.py
+— the VAE/GAN hybrid: a VAE encoder/decoder trained with the ELBO's KL
+term plus an ADVERSARIAL reconstruction signal from a discriminator,
+instead of (only) per-pixel likelihood; the discriminator trains on
+real vs reconstructed samples simultaneously).
+
+Three-way update per batch, as in the reference:
+  1. D: maximize log D(x) + log(1 - D(G(z|x)))           (real vs recon)
+  2. G (decoder): KL-free adversarial term via D's input gradients,
+     plus a feature-matching reconstruction loss
+  3. E (encoder): KL(q(z|x) || N(0,I)) + the same reconstruction path
+
+Run:  python vaegan.py --epochs 12
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import autograd, gluon
+
+
+class Encoder(gluon.Block):
+    def __init__(self, n_latent=4, n_hidden=64, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.h = gluon.nn.Dense(n_hidden, activation="tanh")
+            self.mu = gluon.nn.Dense(n_latent)
+            self.logvar = gluon.nn.Dense(n_latent)
+
+    def forward(self, x):
+        h = self.h(x)
+        return self.mu(h), self.logvar(h)
+
+
+class Decoder(gluon.Block):
+    def __init__(self, n_out, n_hidden=64, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.h = gluon.nn.Dense(n_hidden, activation="tanh")
+            self.x = gluon.nn.Dense(n_out, activation="sigmoid")
+
+    def forward(self, z):
+        return self.x(self.h(z))
+
+
+class Discriminator(gluon.Block):
+    """Binary real/recon head; the penultimate layer doubles as the
+    feature-matching target (the reference's 'Dis_l' layer role)."""
+
+    def __init__(self, n_hidden=64, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.feat = gluon.nn.Dense(n_hidden, activation="tanh")
+            self.out = gluon.nn.Dense(1)
+
+    def features(self, x):
+        return self.feat(x)
+
+    def forward(self, x):
+        return self.out(self.feat(x))
+
+
+def glyph_data(n, rng, size=8, protos=None):
+    if protos is None:
+        protos = (rng.rand(6, size * size) > 0.6).astype("f4")
+    idx = rng.randint(0, len(protos), n)
+    X = protos[idx]
+    flip = rng.rand(n, size * size) < 0.05
+    return np.abs(X - flip.astype("f4")), protos
+
+
+def bce(logit, target):
+    return (mx.nd.relu(logit) - logit * target +
+            mx.nd.log(1.0 + mx.nd.exp(-mx.nd.abs(logit)))).mean()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=12)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-examples", type=int, default=2048)
+    ap.add_argument("--n-latent", type=int, default=4)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    ap.add_argument("--seed", type=int, default=13)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(args.seed)
+    np.random.seed(args.seed)
+
+    rng = np.random.RandomState(args.seed)
+    X, protos = glyph_data(args.num_examples, rng)
+    Xv, _ = glyph_data(512, rng, protos=protos)
+    n_in = X.shape[1]
+
+    enc = Encoder(n_latent=args.n_latent)
+    dec = Decoder(n_out=n_in)
+    dis = Discriminator()
+    for net in (enc, dec, dis):
+        net.collect_params().initialize(mx.initializer.Xavier())
+    t_enc = gluon.Trainer(enc.collect_params(), "adam",
+                          {"learning_rate": args.lr})
+    t_dec = gluon.Trainer(dec.collect_params(), "adam",
+                          {"learning_rate": args.lr})
+    t_dis = gluon.Trainer(dis.collect_params(), "adam",
+                          {"learning_rate": args.lr})
+
+    it = mx.io.NDArrayIter(X, None, args.batch_size, shuffle=True)
+    d_accs, recs = [], []
+    for epoch in range(args.epochs):
+        it.reset()
+        d_correct = d_total = 0
+        rec_sum = 0.0
+        batches = 0
+        for batch in it:
+            x = batch.data[0]
+            bs = x.shape[0]
+
+            # ---- D step: real vs reconstruction. The VAE forward runs
+            # OUTSIDE the tape — only D's params need gradients here, and
+            # recording enc/dec would make backward replay them for
+            # all-zero grads
+            mu, logvar = enc(x)
+            eps = mx.nd.random_normal(shape=mu.shape)
+            z = mu + mx.nd.exp(0.5 * logvar) * eps
+            xr = dec(z)
+            with autograd.record():
+                d_real = dis(x)
+                d_fake = dis(xr)
+                loss_d = bce(d_real, mx.nd.ones((bs, 1))) + \
+                    bce(d_fake, mx.nd.zeros((bs, 1)))
+            loss_d.backward()
+            t_dis.step(bs)
+            d_correct += int((d_real.asnumpy() > 0).sum()
+                             + (d_fake.asnumpy() < 0).sum())
+            d_total += 2 * bs
+
+            # ---- G(dec) + E(enc) step: fool D + feature matching + KL
+            with autograd.record():
+                mu, logvar = enc(x)
+                eps = mx.nd.random_normal(shape=mu.shape)
+                z = mu + mx.nd.exp(0.5 * logvar) * eps
+                xr = dec(z)
+                adv = bce(dis(xr), mx.nd.ones((bs, 1)))
+                fm = ((dis.features(xr) - dis.features(x).detach()) ** 2
+                      ).mean()
+                kl = (0.5 * (mx.nd.exp(logvar) + mu ** 2 - 1.0 - logvar)
+                      .sum(axis=1)).mean()
+                pix = ((xr - x) ** 2).sum(axis=1).mean()
+                loss_g = adv + 10.0 * fm + 0.1 * kl + pix
+            loss_g.backward()
+            t_dec.step(bs)
+            t_enc.step(bs)
+            rec_sum += float(pix.asnumpy())
+            batches += 1
+
+        d_accs.append(d_correct / max(d_total, 1))
+        recs.append(rec_sum / max(batches, 1))
+        logging.info("Epoch[%d] D acc %.3f  recon mse %.3f", epoch,
+                     d_accs[-1], recs[-1])
+
+    # held-out reconstruction quality
+    mu, _ = enc(mx.nd.array(Xv))
+    xr = dec(mu).asnumpy()
+    val_mse = float(((xr - Xv) ** 2).sum(axis=1).mean())
+    data_power = float((Xv ** 2).sum(axis=1).mean())
+    logging.info("val recon mse %.3f (data power %.3f)", val_mse,
+                 data_power)
+    return d_accs, recs, val_mse, data_power
+
+
+if __name__ == "__main__":
+    d_accs, recs, mse, power = main()
+    print("vaegan: D acc %.3f, val recon mse %.3f / power %.3f"
+          % (d_accs[-1], mse, power))

@@ -578,3 +578,19 @@ def test_python_howto_examples():
     _example("python-howto", "howtos.py")
     import howtos
     assert howtos.main() is True
+
+
+def test_adversarial_vae_gate():
+    """VAE/GAN hybrid (examples/mxnet_adversarial_vae/vaegan.py, parity
+    example/mxnet_adversarial_vae): three-way E/G/D training must drive
+    reconstruction well below the data power while the discriminator
+    falls from certainty toward equilibrium."""
+    _example("mxnet_adversarial_vae", "vaegan.py")
+    import vaegan
+    # determinism comes from vaegan.main's own --seed (it reseeds both
+    # RNGs first thing)
+    d_accs, recs, mse, power = vaegan.main(["--epochs", "8"])
+    assert mse < power / 4, "reconstruction never learned: %.3f vs %.3f" \
+        % (mse, power)
+    assert recs[-1] < recs[0] * 0.8, "recon loss did not fall: %s" % recs
+    assert d_accs[-1] < 0.98, "D stayed certain: %s" % d_accs

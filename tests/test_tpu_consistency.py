@@ -168,13 +168,7 @@ def test_pallas_flash_kernel_on_chip():
     """The compiled (non-interpret) Pallas flash kernel must match the
     reference attention math on the real chip — values and gradients.
     CPU runs exercise the same kernel only in interpret mode, so this is
-    the one test that validates the Mosaic-lowered kernel itself.
-
-    Runs in a watchdogged subprocess: a wedged device relay hangs the
-    first jax call forever, and that must SKIP the tier, not hang it."""
-    import subprocess
-    import sys
-
+    the one test that validates the Mosaic-lowered kernel itself."""
     # NO parent-process jax call here: against a wedged relay the first
     # jax call hangs forever, and this test's contract is to skip, not
     # hang — so the accelerator probe lives inside the subprocess too.
@@ -217,10 +211,6 @@ def test_pallas_epilogue_kernel_on_chip():
     """The Mosaic-compiled BN-apply+ReLU+add epilogue (ops/epilogue.py)
     must match the XLA formulation on the real chip — CPU only exercises
     interpret mode. Subprocess-watchdogged like the flash-kernel check."""
-    import os
-    import subprocess
-    import sys
-
     code = r"""
 import sys
 import numpy as np, jax, jax.numpy as jnp
