@@ -117,28 +117,52 @@ void mx_r_symbol_variable(char **name, int *out_id, int *rc) {
  * vectors (char**), input symbols as an int-id vector. */
 void mx_r_symbol_atomic(char **op_name, int *nparam, char **keys,
                         char **vals, int *out_id, int *rc) {
-  const char *ks[64];
-  const char *vs[64];
+  const char *stack_ks[64];
+  const char *stack_vs[64];
   int n = *nparam;
-  if (n > 64) { *rc = -1; *out_id = 0; return; }
+  /* spill to the heap past 64 so wide op signatures never fail (and never
+   * leave MXGetLastError holding a stale message from a prior call) */
+  const char **ks = (n > 64) ? (const char **)malloc(n * sizeof(*ks))
+                             : stack_ks;
+  const char **vs = (n > 64) ? (const char **)malloc(n * sizeof(*vs))
+                             : stack_vs;
+  if (ks == NULL || vs == NULL) {
+    if (ks != stack_ks) free((void *)ks);
+    if (vs != stack_vs) free((void *)vs);
+    *rc = -1; *out_id = 0;
+    return;
+  }
   for (int i = 0; i < n; ++i) { ks[i] = keys[i]; vs[i] = vals[i]; }
   SymbolHandle h;
   *rc = MXSymbolCreateAtomicSymbol(op_name[0], (mx_uint)n, ks, vs, &h);
   *out_id = (*rc == 0) ? put_handle(h) : 0;
+  if (ks != stack_ks) free((void *)ks);
+  if (vs != stack_vs) free((void *)vs);
 }
 
 void mx_r_symbol_compose(int *sym_id, char **name, int *nargs,
                          char **arg_keys, int *arg_ids, int *rc) {
-  const char *ks[64];
-  SymbolHandle hs[64];
+  const char *stack_ks[64];
+  SymbolHandle stack_hs[64];
   int n = *nargs;
-  if (n > 64) { *rc = -1; return; }
+  const char **ks = (n > 64) ? (const char **)malloc(n * sizeof(*ks))
+                             : stack_ks;
+  SymbolHandle *hs = (n > 64) ? (SymbolHandle *)malloc(n * sizeof(*hs))
+                              : stack_hs;
+  if (ks == NULL || hs == NULL) {
+    if (ks != stack_ks) free((void *)ks);
+    if (hs != stack_hs) free(hs);
+    *rc = -1;
+    return;
+  }
   for (int i = 0; i < n; ++i) {
     ks[i] = arg_keys[i];
     hs[i] = get_handle(arg_ids[i]);
   }
   *rc = MXSymbolComposeKeyed(get_handle(*sym_id), name[0], (mx_uint)n, ks,
                              hs);
+  if (ks != stack_ks) free((void *)ks);
+  if (hs != stack_hs) free(hs);
 }
 
 /* names are returned packed into a caller-provided buffer, '\n'-joined */

@@ -873,11 +873,39 @@ def ndarray_load_from_raw_bytes(buf):
 
 
 def ndarray_sync_copy_from_ndarray(dst_h, src_h, loc):
+    """MXNDArraySyncCopyFromNDArray (reference src/c_api/c_api.cc:258-264
+    calls dst->SyncCopyFromNDArray(*src, -1, i)): `loc` indicates the DST
+    blob — loc<0 copies src's data into dst's data blob; loc>=0 writes src
+    into dst's loc-th aux blob (csr: 0=indptr, 1=indices; row_sparse:
+    0=indices, per include/mxnet/ndarray.h CSRAuxType/RowSparseAuxType)."""
     dst = _get(dst_h)
     src = _get(src_h)
-    if int(loc) >= 0:
-        src = src[int(loc)]
-    dst[:] = src
+    loc = int(loc)
+    stype = getattr(dst, "stype", "default")
+    if loc < 0:
+        if stype in ("csr", "row_sparse"):
+            # data BLOB of the sparse dst (nnz values), not a dense
+            # broadcast over the logical shape — this is the first call of
+            # the reference's sparse-assembly sequence (data, then aux)
+            dst._sp_data = src.asnumpy()
+        else:
+            dst[:] = src
+        return 0
+    host = src.asnumpy()
+    if stype == "csr":
+        if loc == 0:
+            dst._sp_indptr = host
+        elif loc == 1:
+            dst._sp_indices = host
+        else:
+            raise ValueError("csr has 2 aux blobs; got aux index %d" % loc)
+    elif stype == "row_sparse":
+        if loc != 0:
+            raise ValueError("row_sparse has 1 aux blob; got aux index %d"
+                             % loc)
+        dst._sp_indices = host
+    else:
+        raise ValueError("aux-blob copy (i=%d) into dense NDArray" % loc)
     return 0
 
 
@@ -893,9 +921,25 @@ def ndarray_set_grad_state(h, state):
 def ndarray_data_ptr(h):
     """Raw host pointer contract (MXNDArrayGetData): materialize a host
     copy pinned under the handle so the pointer stays valid until the
-    handle is freed (the reference returns a pointer into the chunk)."""
+    handle is freed (the reference returns a pointer into the chunk).
+
+    The pointer is STABLE per handle: a repeated call refreshes the same
+    pinned buffer in place (device -> host) rather than allocating a new
+    one, so pointers handed out earlier never dangle. The mirror is
+    read-only from the caller's perspective — writes through it are not
+    propagated back to the array; write via MXNDArraySyncCopyFromCPU
+    (documented in src/capi/c_api.h next to MXNDArrayGetData)."""
     import numpy as _np
-    host = _np.ascontiguousarray(_get(h).asnumpy())
+    host = _get(h).asnumpy()
+    pin = _HOST_PINS.get(int(h))
+    if (pin is not None and pin.shape == host.shape
+            and pin.dtype == host.dtype):
+        pin[...] = host
+        return pin.ctypes.data
+    # pin-miss: take an owned writable copy — asnumpy() can hand back a
+    # read-only view into a jax-owned host buffer whose lifetime we don't
+    # control
+    host = _np.array(host, order="C", copy=True)
     _HOST_PINS[int(h)] = host
     return host.ctypes.data
 
@@ -975,13 +1019,18 @@ def symbol_get_output(h, i):
 
 
 def symbol_get_name(h):
+    """Returns (found, value): the reference's MXSymbolGetName success flag
+    is found/not-found, not value non-emptiness — an op genuinely named ""
+    must still report found."""
     n = _get(h).name
-    return "" if n is None else str(n)
+    return (n is not None, "" if n is None else str(n))
 
 
 def symbol_get_attr(h, key):
+    """Returns (found, value) — see symbol_get_name; an attribute set to
+    the empty string is found with value ""."""
     v = _get(h).attr(str(key))
-    return "" if v is None else str(v)
+    return (v is not None, "" if v is None else str(v))
 
 
 def symbol_set_attr(h, key, val):
@@ -1257,14 +1306,25 @@ def func_describe(name):
     return (n_in, 0, n_out, 0)
 
 
-def func_invoke(name, used_handles, scalars, mutate_handles):
-    """Legacy MXFuncInvoke calling convention: positional input arrays,
-    float scalars, preallocated output arrays (mutate list)."""
+def func_invoke(name, used_handles, scalars, mutate_handles,
+                param_keys=(), param_vals=()):
+    """Legacy MXFuncInvoke(Ex) calling convention: positional input arrays,
+    float scalars, preallocated output arrays (mutate list), plus the Ex
+    variant's key/val op attributes (dropped attributes would silently run
+    the op with defaults — wrong numerics at rc=0)."""
     from .ops import registry as _reg
     op = _reg.get_op(str(name))
+    if scalars:
+        # registry ops carry everything as key/val attrs; func_describe
+        # declares 0 scalars, so a non-empty list here means a caller is
+        # bypassing the Describe contract — fail loud over silent drop
+        raise RuntimeError(
+            "MXFuncInvoke: op %s declares no scalar args but %d were "
+            "supplied" % (name, len(scalars)))
     ins = [_get(h) for h in used_handles]
     arrs = [getattr(x, "_data", x) for x in ins]
-    attrs = op.parse_attrs({})
+    attrs = op.parse_attrs({str(k): str(v)
+                            for k, v in zip(param_keys, param_vals)})
     outs = op.apply(attrs, arrs)
     for hh, o in zip(mutate_handles, outs):
         _get(hh)[:] = o

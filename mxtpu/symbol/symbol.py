@@ -195,7 +195,12 @@ class Symbol:
 
     def attr(self, key):
         node = self._outputs[0][0]
-        return node._extra_attrs.get(key) or node.attrs.get(key)
+        v = node._extra_attrs.get(key)
+        # explicit None check: an attribute set to "" is present, and the
+        # C ABI's found/not-found flag must report it as such
+        if v is None:
+            v = node.attrs.get(key)
+        return v
 
     def attr_dict(self):
         out = {}

@@ -317,6 +317,11 @@ int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
                                  const int i);
 int MXNDArrayGetGradState(NDArrayHandle handle, int *out);
 int MXNDArraySetGradState(NDArrayHandle handle, int state);
+/* Returns a stable per-handle host mirror of the array's data (repeated
+ * calls refresh and return the SAME buffer; freed with the handle).
+ * Deviation from the reference (which returns a pointer into the live
+ * chunk): the mirror is read-only — writes through it are not propagated
+ * to the device array; write via MXNDArraySyncCopyFromCPU instead. */
 int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata);
 int MXNDArrayGetAuxType(NDArrayHandle handle, mx_uint i, int *out_type);
 int MXNDArrayGetAuxNDArray(NDArrayHandle handle, mx_uint i,

@@ -1459,13 +1459,27 @@ int MXSymbolGetChildren(SymbolHandle symbol, SymbolHandle *out) {
   return 0;
 }
 
+/* bridge returns (found, value): success mirrors the reference's
+ * found/not-found flag, so an attr genuinely set to "" reports found=1 */
+static int FoundStrOut(PyObject *res, const char **out, int *success) {
+  if (!PyTuple_Check(res) || PyTuple_Size(res) != 2) {
+    g_last_error = "symbol attr bridge returned non-(found,value) result";
+    Py_DECREF(res);
+    return -1;
+  }
+  *success = PyObject_IsTrue(PyTuple_GetItem(res, 0)) ? 1 : 0;
+  PyObject *val = PyTuple_GetItem(res, 1);
+  Py_INCREF(val);
+  Py_DECREF(res);
+  return StrOut(val, out);
+}
+
 int MXSymbolGetName(SymbolHandle symbol, const char **out, int *success) {
   GilGuard gil;
   PyObject *res = CallBridge("symbol_get_name",
                              Py_BuildValue("(l)", HandleToId(symbol)));
   if (res == nullptr) return -1;
-  *success = PyUnicode_GetLength(res) > 0 ? 1 : 0;
-  return StrOut(res, out);
+  return FoundStrOut(res, out, success);
 }
 
 int MXSymbolGetAttr(SymbolHandle symbol, const char *key, const char **out,
@@ -1474,8 +1488,7 @@ int MXSymbolGetAttr(SymbolHandle symbol, const char *key, const char **out,
   PyObject *res = CallBridge(
       "symbol_get_attr", Py_BuildValue("(ls)", HandleToId(symbol), key));
   if (res == nullptr) return -1;
-  *success = PyUnicode_GetLength(res) > 0 ? 1 : 0;
-  return StrOut(res, out);
+  return FoundStrOut(res, out, success);
 }
 
 int MXSymbolSetAttr(SymbolHandle symbol, const char *key,
@@ -1784,9 +1797,10 @@ int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
   return 0;
 }
 
-int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
-                 float *scalar_args, NDArrayHandle *mutate_vars) {
-  (void)scalar_args;
+static int FuncInvokeImpl(FunctionHandle fun, NDArrayHandle *use_vars,
+                          float *scalar_args, NDArrayHandle *mutate_vars,
+                          int num_params, char **param_keys,
+                          char **param_vals) {
   auto *table = OpTable();
   size_t idx = reinterpret_cast<size_t>(fun) - 1;
   if (table == nullptr || idx >= table->size()) {
@@ -1797,21 +1811,44 @@ int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
   int mask;
   if (MXFuncDescribe(fun, &n_use, &n_scalar, &n_mut, &mask) != 0) return -1;
   GilGuard gil;
+  /* scalar count comes from MXFuncDescribe's own contract (the caller has
+   * no other way to size scalar_args); dropping supplied scalars/params on
+   * the floor would run the op with default attrs at rc=0 */
+  if (n_scalar > 0 && scalar_args == nullptr) {
+    g_last_error = "MXFuncInvoke: op declares scalar args but scalar_args "
+                   "is NULL";
+    return -1;
+  }
+  PyObject *scalars = PyList_New(n_scalar);
+  for (mx_uint i = 0; i < n_scalar; ++i) {
+    PyList_SetItem(scalars, i,
+                   PyFloat_FromDouble(static_cast<double>(scalar_args[i])));
+  }
   PyObject *res = CallBridge(
       "func_invoke",
-      Py_BuildValue("(sNNN)", (*table)[idx].c_str(),
-                    HandleList(n_use, use_vars), PyList_New(0),
-                    HandleList(n_mut, mutate_vars)));
+      Py_BuildValue("(sNNNNN)", (*table)[idx].c_str(),
+                    HandleList(n_use, use_vars), scalars,
+                    HandleList(n_mut, mutate_vars),
+                    StrList(static_cast<mx_uint>(num_params),
+                            const_cast<const char **>(param_keys)),
+                    StrList(static_cast<mx_uint>(num_params),
+                            const_cast<const char **>(param_vals))));
   if (res == nullptr) return -1;
   Py_DECREF(res);
   return 0;
 }
 
+int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
+                 float *scalar_args, NDArrayHandle *mutate_vars) {
+  return FuncInvokeImpl(fun, use_vars, scalar_args, mutate_vars, 0, nullptr,
+                        nullptr);
+}
+
 int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle *use_vars,
                    float *scalar_args, NDArrayHandle *mutate_vars,
                    int num_params, char **param_keys, char **param_vals) {
-  (void)num_params; (void)param_keys; (void)param_vals;
-  return MXFuncInvoke(fun, use_vars, scalar_args, mutate_vars);
+  return FuncInvokeImpl(fun, use_vars, scalar_args, mutate_vars, num_params,
+                        param_keys, param_vals);
 }
 
 }  // extern "C"

@@ -1,0 +1,222 @@
+"""C-ABI semantics regressions (advisor round-4 findings): aux-blob copy
+direction in MXNDArraySyncCopyFromNDArray, stable MXNDArrayGetData host
+pins, MXFuncInvokeEx attribute forwarding, found/not-found semantics of
+MXSymbolGetName/GetAttr, and the R adapter's >64-param spill path.
+
+Reference contracts: src/c_api/c_api.cc:258-264 (SyncCopyFromNDArray dst
+blob indicator), include/mxnet/c_api.h:392 (GetData), :1830 (FuncInvokeEx).
+"""
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CAPI_SO = os.path.join(REPO, "mxtpu", "native", "libmxtpu_capi.so")
+R_SO = os.path.join(REPO, "mxtpu", "native", "libmxtpu_r.so")
+
+
+def _build(target="capi"):
+    subprocess.run(["make", "-C", os.path.join(REPO, "src"), target],
+                   capture_output=True, text=True)
+
+
+# ------------------------------------------------------- bridge level
+
+def test_sync_copy_from_ndarray_dst_aux_blob():
+    """loc>=0 writes src into DST's loc-th aux blob (csr: indptr/indices;
+    row_sparse: indices) — not a slice of src into the whole dst."""
+    from mxtpu import capi_bridge as cb
+    from mxtpu.ndarray import array, sparse
+
+    csr = sparse.csr_matrix(
+        (np.array([1.0, 2.0]), np.array([0, 2]), np.array([0, 1, 2])),
+        shape=(2, 4))
+    dst_h = cb._register(csr)
+    # new indptr [0,0,2]: both nnz move to row 1
+    src_h = cb._register(array(np.array([0, 0, 2], dtype=np.int64)))
+    assert cb.ndarray_sync_copy_from_ndarray(dst_h, src_h, 0) == 0
+    np.testing.assert_array_equal(np.asarray(csr._sp_indptr), [0, 0, 2])
+    # new indices [1,3]
+    src2_h = cb._register(array(np.array([1, 3], dtype=np.int64)))
+    assert cb.ndarray_sync_copy_from_ndarray(dst_h, src2_h, 1) == 0
+    dense = csr.asnumpy()
+    expect = np.zeros((2, 4), dtype=np.float32)
+    expect[1, 1], expect[1, 3] = 1.0, 2.0
+    np.testing.assert_allclose(dense, expect)
+    with pytest.raises(ValueError):
+        cb.ndarray_sync_copy_from_ndarray(dst_h, src_h, 2)
+
+    rs = sparse.row_sparse_array(
+        (np.ones((1, 3), dtype=np.float32), np.array([0])), shape=(4, 3))
+    rs_h = cb._register(rs)
+    idx_h = cb._register(array(np.array([2], dtype=np.int64)))
+    assert cb.ndarray_sync_copy_from_ndarray(rs_h, idx_h, 0) == 0
+    assert rs.asnumpy()[2].sum() == 3.0 and rs.asnumpy()[0].sum() == 0.0
+
+
+def test_sync_copy_from_ndarray_sparse_data_blob():
+    """loc<0 with a sparse dst targets the nnz data BLOB (the first call
+    of the reference's sparse-assembly sequence), not a dense broadcast."""
+    from mxtpu import capi_bridge as cb
+    from mxtpu.ndarray import array, sparse
+
+    csr = sparse.csr_matrix(
+        (np.array([1.0, 2.0]), np.array([0, 2]), np.array([0, 1, 2])),
+        shape=(2, 4))
+    h = cb._register(csr)
+    vals_h = cb._register(array(np.array([5.0, 9.0], dtype=np.float32)))
+    assert cb.ndarray_sync_copy_from_ndarray(h, vals_h, -1) == 0
+    dense = csr.asnumpy()
+    assert dense[0, 0] == 5.0 and dense[1, 2] == 9.0
+
+
+def test_sync_copy_from_ndarray_dense_full_copy():
+    from mxtpu import capi_bridge as cb
+    from mxtpu.ndarray import array, zeros
+
+    dst = zeros((2, 3))
+    src = array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    dh, sh = cb._register(dst), cb._register(src)
+    assert cb.ndarray_sync_copy_from_ndarray(dh, sh, -1) == 0
+    np.testing.assert_allclose(dst.asnumpy(), src.asnumpy())
+    with pytest.raises(ValueError):  # aux copy into dense is an error
+        cb.ndarray_sync_copy_from_ndarray(dh, sh, 0)
+
+
+def test_data_ptr_stable_per_handle():
+    """Repeat MXNDArrayGetData calls return the SAME pinned buffer
+    (earlier pointers never dangle) with refreshed contents."""
+    from mxtpu import capi_bridge as cb
+    from mxtpu.ndarray import array
+
+    arr = array(np.arange(4, dtype=np.float32))
+    h = cb._register(arr)
+    p1 = cb.ndarray_data_ptr(h)
+    arr[:] = array(np.full((4,), 7.0, dtype=np.float32))
+    p2 = cb.ndarray_data_ptr(h)
+    assert p1 == p2
+    host = np.ctypeslib.as_array(
+        ctypes.cast(p1, ctypes.POINTER(ctypes.c_float)), shape=(4,))
+    np.testing.assert_allclose(host, 7.0)
+
+
+def test_func_invoke_forwards_attrs_and_rejects_scalars():
+    from mxtpu import capi_bridge as cb
+    from mxtpu.ndarray import array, zeros
+
+    src = array(np.array([-2.0, 0.5, 2.0], dtype=np.float32))
+    out = zeros((3,))
+    sh, oh = cb._register(src), cb._register(out)
+    cb.func_invoke("clip", [sh], [], [oh], ["a_min", "a_max"], ["0", "1"])
+    np.testing.assert_allclose(out.asnumpy(), [0.0, 0.5, 1.0])
+    with pytest.raises(RuntimeError):
+        cb.func_invoke("clip", [sh], [0.0], [oh], ["a_min", "a_max"],
+                       ["0", "1"])
+
+
+def test_symbol_attr_found_semantics_bridge():
+    from mxtpu import capi_bridge as cb
+    import mxtpu as mx
+
+    v = mx.sym.Variable("data")
+    v._set_attr(empty="")
+    h = cb._register(v)
+    assert cb.symbol_get_attr(h, "empty") == (True, "")
+    assert cb.symbol_get_attr(h, "absent") == (False, "")
+    assert cb.symbol_get_name(h) == (True, "data")
+
+
+# ------------------------------------------------------------ C level
+
+def _capi():
+    _build("capi")
+    if not os.path.exists(CAPI_SO):
+        pytest.skip("libmxtpu_capi.so did not build")
+    return ctypes.CDLL(CAPI_SO)
+
+
+def test_c_symbol_get_attr_empty_string_found():
+    lib = _capi()
+    import mxtpu as mx
+
+    sym_json = mx.sym.Variable("x").tojson().encode()
+    h = ctypes.c_void_p()
+    assert lib.MXSymbolCreateFromJSON(sym_json, ctypes.byref(h)) == 0
+    assert lib.MXSymbolSetAttr(h, b"marker", b"") == 0
+    out = ctypes.c_char_p()
+    success = ctypes.c_int(-1)
+    assert lib.MXSymbolGetAttr(h, b"marker", ctypes.byref(out),
+                               ctypes.byref(success)) == 0
+    assert success.value == 1 and out.value == b""
+    assert lib.MXSymbolGetAttr(h, b"absent", ctypes.byref(out),
+                               ctypes.byref(success)) == 0
+    assert success.value == 0
+    assert lib.MXSymbolGetName(h, ctypes.byref(out),
+                               ctypes.byref(success)) == 0
+    assert success.value == 1 and out.value == b"x"
+    lib.MXSymbolFree(h)
+
+
+def test_c_func_invoke_ex_forwards_params():
+    lib = _capi()
+    from mxtpu import capi_bridge as cb
+    from mxtpu.ndarray import array, zeros
+
+    fn = ctypes.c_void_p()
+    assert lib.MXGetFunction(b"clip", ctypes.byref(fn)) == 0
+
+    src = array(np.array([-2.0, 0.5, 2.0], dtype=np.float32))
+    out = zeros((3,))
+    sh, oh = cb._register(src), cb._register(out)
+    use = (ctypes.c_void_p * 1)(ctypes.c_void_p(sh))
+    mut = (ctypes.c_void_p * 1)(ctypes.c_void_p(oh))
+    keys = (ctypes.c_char_p * 2)(b"a_min", b"a_max")
+    vals = (ctypes.c_char_p * 2)(b"0", b"1")
+    rc = lib.MXFuncInvokeEx(fn, use, None, mut, 2, keys, vals)
+    assert rc == 0, ctypes.string_at(lib.MXGetLastError())
+    np.testing.assert_allclose(out.asnumpy(), [0.0, 0.5, 1.0])
+    # params required but not supplied: loud failure, not silent defaults
+    assert lib.MXFuncInvoke(fn, use, None, mut) == -1
+
+
+# ------------------------------------------------------------ R level
+
+def test_r_symbol_atomic_past_64_params():
+    """n>64 spills to the heap and reaches the C API (previously rc=-1
+    with a stale MXGetLastError message)."""
+    _build("r")
+    if not os.path.exists(R_SO):
+        pytest.skip("libmxtpu_r.so did not build")
+    lib = ctypes.CDLL(R_SO)
+
+    def _atomic(op, keys, vals):
+        n = len(keys)
+        rc = ctypes.c_int(0)
+        out_id = ctypes.c_int(0)
+        ks = (ctypes.c_char_p * max(n, 1))(*[k.encode() for k in keys])
+        vs = (ctypes.c_char_p * max(n, 1))(*[v.encode() for v in vals])
+        lib.mx_r_symbol_atomic(
+            ctypes.byref(ctypes.c_char_p(op.encode())),
+            ctypes.byref(ctypes.c_int(n)), ks, vs,
+            ctypes.byref(out_id), ctypes.byref(rc))
+        return rc.value
+
+    def _last_error():
+        buf = ctypes.create_string_buffer(512)
+        pbuf = ctypes.c_char_p(ctypes.addressof(buf))
+        lib.mx_r_last_error(ctypes.byref(pbuf))
+        return buf.value
+
+    # seed the last-error slot with a distinctive failure
+    assert _atomic("definitely_no_such_op", [], []) == -1
+    assert b"definitely_no_such_op" in _last_error()
+
+    # 70 params: the call must REACH the C API (pre-fix this returned -1
+    # before calling anything, leaving the stale message above in place)
+    keys = ["a_min", "a_max"] + ["bogus%d" % i for i in range(68)]
+    vals = ["0", "1"] + ["x"] * 68
+    rc = _atomic("clip", keys, vals)
+    assert rc == 0 or b"clip" in _last_error()
