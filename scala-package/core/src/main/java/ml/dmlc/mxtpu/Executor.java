@@ -1,0 +1,38 @@
+package ml.dmlc.mxtpu;
+
+/**
+ * JVM Executor over the C ABI (parity: the reference's
+ * scala-package/core/src/main/scala/ml/dmlc/mxnet/Executor.scala —
+ * forward/backward plus named access to args, grads, and outputs).
+ */
+public final class Executor {
+  final long handle;
+
+  Executor(long handle) {
+    this.handle = handle;
+  }
+
+  public long handle() {
+    return handle;
+  }
+
+  public void forward(boolean isTrain) {
+    LibMXTPU.executorForward(handle, isTrain ? 1 : 0);
+  }
+
+  public void backward() {
+    LibMXTPU.executorBackward(handle);
+  }
+
+  public NDArray arg(String name) {
+    return new NDArray(LibMXTPU.executorArg(handle, name));
+  }
+
+  public NDArray grad(String name) {
+    return new NDArray(LibMXTPU.executorGrad(handle, name));
+  }
+
+  public NDArray output(int index) {
+    return new NDArray(LibMXTPU.executorOutput(handle, index));
+  }
+}

@@ -1,0 +1,41 @@
+package ml.dmlc.mxtpu;
+
+/**
+ * JVM KVStore over the C ABI (parity: the reference's
+ * scala-package/core/src/main/scala/ml/dmlc/mxnet/KVStore.scala —
+ * init/push/pull with an optimizer attached store-side).
+ */
+public final class KVStore implements AutoCloseable {
+  final long handle;
+
+  public KVStore(String type) {
+    handle = LibMXTPU.kvstoreCreate(type);
+  }
+
+  public long handle() {
+    return handle;
+  }
+
+  public void setOptimizer(String name, float lr, float wd, float momentum,
+                           float rescaleGrad) {
+    LibMXTPU.kvstoreSetOptimizer(handle, name, lr, wd, momentum,
+                                 rescaleGrad);
+  }
+
+  public void init(String key, NDArray value) {
+    LibMXTPU.kvstoreInit(handle, key, value.handle);
+  }
+
+  public void push(String key, NDArray value) {
+    LibMXTPU.kvstorePush(handle, key, value.handle);
+  }
+
+  public void pull(String key, NDArray out) {
+    LibMXTPU.kvstorePull(handle, key, out.handle);
+  }
+
+  @Override
+  public void close() {
+    LibMXTPU.waitAll();
+  }
+}

@@ -41,6 +41,15 @@ public final class LibMXTPU {
   // symbol / executor
   public static native long symbolFromJson(String json);
   public static native String[] symbolArguments(long handle);
+  public static native long symbolCreateVariable(String name);
+  public static native long symbolCreateAtomic(
+      String op, String[] keys, String[] vals);
+  // argKeys == null composes positionally (variadic ops)
+  public static native void symbolCompose(
+      long handle, String name, String[] argKeys, long[] args);
+  public static native String symbolToJson(long handle);
+  public static native void symbolFree(long handle);
+  public static native String[] listAllOpNames();
   public static native long executorSimpleBind(
       long symbol, String gradReq, String[] inputNames, int[][] shapes);
   public static native void executorForward(long exec, int isTrain);

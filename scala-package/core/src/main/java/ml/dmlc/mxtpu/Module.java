@@ -13,6 +13,12 @@ public final class Module implements AutoCloseable {
   private final long kv;
   private final String[] paramNames;
 
+  /** Bind a natively-composed Symbol (the generated SymbolOps surface). */
+  public Module(Symbol sym, String[] inputNames, int[][] inputShapes,
+                float lr, float momentum, float rescaleGrad) {
+    this(sym.toJson(), inputNames, inputShapes, lr, momentum, rescaleGrad);
+  }
+
   public Module(String symbolJson, String[] inputNames, int[][] inputShapes,
                 float lr, float momentum, float rescaleGrad) {
     symbol = LibMXTPU.symbolFromJson(symbolJson);

@@ -1,0 +1,89 @@
+package ml.dmlc.mxtpu;
+
+/**
+ * JVM Symbol over the C ABI — graph composition for the generated
+ * {@link SymbolOps} surface (parity: the reference's
+ * scala-package/core/src/main/scala/ml/dmlc/mxnet/Symbol.scala, same
+ * atomic-create + keyed-compose design over MXSymbolCreateAtomicSymbol /
+ * MXSymbolCompose).
+ */
+public final class Symbol implements AutoCloseable {
+  final long handle;
+  private boolean closed = false;
+
+  Symbol(long handle) {
+    this.handle = handle;
+  }
+
+  /** Raw ABI handle for LibMXTPU calls. */
+  public long handle() {
+    return handle;
+  }
+
+  public static Symbol variable(String name) {
+    return new Symbol(LibMXTPU.symbolCreateVariable(name));
+  }
+
+  public static Symbol fromJson(String json) {
+    return new Symbol(LibMXTPU.symbolFromJson(json));
+  }
+
+  public String toJson() {
+    return LibMXTPU.symbolToJson(handle);
+  }
+
+  public String[] arguments() {
+    return LibMXTPU.symbolArguments(handle);
+  }
+
+  /**
+   * Atomic create + compose: the one entry the generated per-op wrappers
+   * sit on. Tensor inputs are keyed by their declared names (argNames)
+   * so a partial input list binds correctly and the rest auto-create as
+   * variables; variadic ops (argNames == null) compose positionally.
+   */
+  public static Symbol create(String op, String name,
+                              java.util.Map<String, String> attrs,
+                              String[] argNames, Symbol[] inputs) {
+    String[] keys = new String[attrs == null ? 0 : attrs.size()];
+    String[] vals = new String[keys.length];
+    if (attrs != null) {
+      int i = 0;
+      for (java.util.Map.Entry<String, String> e : attrs.entrySet()) {
+        keys[i] = e.getKey();
+        vals[i] = e.getValue();
+        ++i;
+      }
+    }
+    long h = LibMXTPU.symbolCreateAtomic(op, keys, vals);
+    int n = inputs == null ? 0 : inputs.length;
+    long[] in = new long[n];
+    for (int i = 0; i < n; ++i) in[i] = inputs[i].handle;
+    String[] inKeys = null;
+    if (argNames != null) {
+      if (n > argNames.length) {
+        throw new IllegalArgumentException(
+            op + " takes at most " + argNames.length + " inputs, got " + n);
+      }
+      inKeys = new String[n];
+      System.arraycopy(argNames, 0, inKeys, 0, n);
+    }
+    LibMXTPU.symbolCompose(h, name, inKeys, in);
+    return new Symbol(h);
+  }
+
+  public Executor simpleBind(String gradReq, String[] inputNames,
+                             int[][] inputShapes) {
+    return new Executor(
+        LibMXTPU.executorSimpleBind(handle, gradReq, inputNames,
+                                    inputShapes));
+  }
+
+  @Override
+  public void close() {
+    if (!closed) {
+      LibMXTPU.symbolFree(handle);
+      closed = true;
+    }
+  }
+}

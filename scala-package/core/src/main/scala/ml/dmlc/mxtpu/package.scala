@@ -1,0 +1,83 @@
+package ml.dmlc
+
+import scala.collection.JavaConverters._
+
+/**
+ * Scala-idiomatic layer over the Java core + generated op surface
+ * (parity: the reference scala-package's Symbol.scala/NDArray.scala
+ * idioms — Scala Maps for attrs, default/named arguments, operator
+ * sugar — over the same native seam). The 288-op surface itself lives
+ * in the generated `SymbolOps`/`NDArrayOps` (scala-package/
+ * gen_jvm_ops.py); this package makes it pleasant from Scala:
+ *
+ * {{{
+ * import ml.dmlc.mxtpu._
+ * val data = Sym.variable("data")
+ * val c1 = Sym("Convolution", "conv1",
+ *              Map("kernel" -> "(3,3)", "num_filter" -> 8))(data)
+ * val net = Sym("SoftmaxOutput", "softmax")(fc2)
+ * val mod = new Module(net, Array("data", "softmax_label"), shapes,
+ *                      0.1f, 0.9f, 1.0f / batch)
+ * }}}
+ */
+package object mxtpu {
+
+  /** Scala attrs (Any values, stringified) -> the Java Map the core
+    * takes. Shape-like tuples print in the reference's "(a,b)" form. */
+  def attrMap(attrs: Map[String, Any]): java.util.Map[String, String] = {
+    val out = new java.util.HashMap[String, String]()
+    attrs.foreach { case (k, v) =>
+      val s = v match {
+        case p: Product =>
+          p.productIterator.mkString("(", ",", ")")
+        case other => other.toString
+      }
+      out.put(k, s)
+    }
+    out
+  }
+
+  object Sym {
+    def variable(name: String): Symbol = Symbol.variable(name)
+
+    /** Generic op composition with Scala ergonomics; the per-op typed
+      * surface is `SymbolOps` (generated). */
+    def apply(op: String, name: String = null,
+              attrs: Map[String, Any] = Map.empty)
+             (inputs: Symbol*): Symbol =
+      Symbol.create(op, name, attrMap(attrs), null, inputs.toArray)
+  }
+
+  object ND {
+    def apply(op: String, attrs: Map[String, Any] = Map.empty)
+             (inputs: NDArray*): Array[NDArray] =
+      NDArray.invoke(op, inputs.toArray,
+                     attrMap(attrs).keySet().asScala.toArray,
+                     attrMap(attrs).values().asScala.toArray)
+
+    def array(data: Array[Float], shape: Int*): NDArray =
+      NDArray.fromArray(data, shape: _*)
+  }
+
+  /** Operator sugar on symbols, reference Symbol.scala style. */
+  implicit final class SymbolSugar(private val sym: Symbol) extends AnyVal {
+    def +(other: Symbol): Symbol =
+      Symbol.create("elemwise_add", null, null, null, Array(sym, other))
+    def -(other: Symbol): Symbol =
+      Symbol.create("elemwise_sub", null, null, null, Array(sym, other))
+    def *(other: Symbol): Symbol =
+      Symbol.create("elemwise_mul", null, null, null, Array(sym, other))
+    def /(other: Symbol): Symbol =
+      Symbol.create("elemwise_div", null, null, null, Array(sym, other))
+  }
+
+  /** Operator sugar on NDArrays (imperative path). */
+  implicit final class NDArraySugar(private val nd: NDArray) extends AnyVal {
+    private def bin(op: String, other: NDArray): NDArray =
+      NDArray.invoke(op, Array(nd, other), null, null)(0)
+    def +(other: NDArray): NDArray = bin("elemwise_add", other)
+    def -(other: NDArray): NDArray = bin("elemwise_sub", other)
+    def *(other: NDArray): NDArray = bin("elemwise_mul", other)
+    def /(other: NDArray): NDArray = bin("elemwise_div", other)
+  }
+}

@@ -322,6 +322,116 @@ JNIEXPORT jlong JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_symbolFromJson(
   return (jlong)(intptr_t)h;
 }
 
+JNIEXPORT jlong JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_symbolCreateVariable(
+    JNIEnv *env, jclass cls, jstring jname) {
+  (void)cls;
+  const char *name = (*env)->GetStringUTFChars(env, jname, NULL);
+  SymbolHandle h;
+  int rc = MXSymbolCreateVariable(name, &h);
+  (*env)->ReleaseStringUTFChars(env, jname, name);
+  if (rc != 0) {
+    throw_mx(env, "MXSymbolCreateVariable");
+    return 0;
+  }
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT jlong JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_symbolCreateAtomic(
+    JNIEnv *env, jclass cls, jstring jop, jobjectArray jkeys,
+    jobjectArray jvals) {
+  (void)cls;
+  const char *op = (*env)->GetStringUTFChars(env, jop, NULL);
+  jsize np = jkeys ? (*env)->GetArrayLength(env, jkeys) : 0;
+  const char **keys = NULL, **vals = NULL;
+  if (np > 0) {
+    keys = alloc_cstrings(env, jkeys, np);
+    vals = keys ? alloc_cstrings(env, jvals, np) : NULL;
+    if (keys == NULL || vals == NULL) {
+      free_cstrings(env, jkeys, keys, np);
+      (*env)->ReleaseStringUTFChars(env, jop, op);
+      return 0;
+    }
+  }
+  SymbolHandle h;
+  int rc = MXSymbolCreateAtomicSymbol(op, (mx_uint)np, keys, vals, &h);
+  if (np > 0) {
+    free_cstrings(env, jkeys, keys, np);
+    free_cstrings(env, jvals, vals, np);
+  }
+  (*env)->ReleaseStringUTFChars(env, jop, op);
+  if (rc != 0) {
+    throw_mx(env, "MXSymbolCreateAtomicSymbol");
+    return 0;
+  }
+  return (jlong)(intptr_t)h;
+}
+
+JNIEXPORT void JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_symbolCompose(
+    JNIEnv *env, jclass cls, jlong sym, jstring jname, jobjectArray jkeys,
+    jlongArray jargs) {
+  (void)cls;
+  const char *name = jname ? (*env)->GetStringUTFChars(env, jname, NULL)
+                           : NULL;
+  jsize n = (*env)->GetArrayLength(env, jargs);
+  jlong *args = (*env)->GetLongArrayElements(env, jargs, NULL);
+  SymbolHandle *ah =
+      (SymbolHandle *)jmalloc(env, sizeof(SymbolHandle) * (size_t)n);
+  if (ah == NULL) {
+    (*env)->ReleaseLongArrayElements(env, jargs, args, JNI_ABORT);
+    if (jname) (*env)->ReleaseStringUTFChars(env, jname, name);
+    return;
+  }
+  for (jsize i = 0; i < n; ++i) ah[i] = (SymbolHandle)(intptr_t)args[i];
+  (*env)->ReleaseLongArrayElements(env, jargs, args, JNI_ABORT);
+  int rc;
+  if (jkeys == NULL) { /* positional (variadic ops) */
+    rc = MXSymbolCompose((SymbolHandle)(intptr_t)sym, name, (mx_uint)n, ah);
+  } else {
+    const char **keys = alloc_cstrings(env, jkeys, n);
+    if (keys == NULL) {
+      free(ah);
+      if (jname) (*env)->ReleaseStringUTFChars(env, jname, name);
+      return;
+    }
+    rc = MXSymbolComposeKeyed((SymbolHandle)(intptr_t)sym, name, (mx_uint)n,
+                              keys, ah);
+    free_cstrings(env, jkeys, keys, n);
+  }
+  free(ah);
+  if (jname) (*env)->ReleaseStringUTFChars(env, jname, name);
+  if (rc != 0) throw_mx(env, "MXSymbolCompose");
+}
+
+JNIEXPORT jstring JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_symbolToJson(
+    JNIEnv *env, jclass cls, jlong h) {
+  (void)cls;
+  const char *json;
+  JCHECK(MXSymbolSaveToJSON((SymbolHandle)(intptr_t)h, &json), NULL);
+  return (*env)->NewStringUTF(env, json);
+}
+
+JNIEXPORT void JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_symbolFree(
+    JNIEnv *env, jclass cls, jlong h) {
+  (void)cls;
+  JCHECK(MXSymbolFree((SymbolHandle)(intptr_t)h), );
+}
+
+JNIEXPORT jobjectArray JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_listAllOpNames(
+    JNIEnv *env, jclass cls) {
+  (void)cls;
+  mx_uint n;
+  const char **names;
+  JCHECK(MXListAllOpNames(&n, &names), NULL);
+  jobjectArray out = (*env)->NewObjectArray(
+      env, (jsize)n, (*env)->FindClass(env, "java/lang/String"), NULL);
+  for (mx_uint i = 0; i < n; ++i) {
+    jstring s = (*env)->NewStringUTF(env, names[i]);
+    (*env)->SetObjectArrayElement(env, out, (jsize)i, s);
+    (*env)->DeleteLocalRef(env, s);
+  }
+  return out;
+}
+
 JNIEXPORT jobjectArray JNICALL Java_ml_dmlc_mxtpu_LibMXTPU_symbolArguments(
     JNIEnv *env, jclass cls, jlong h) {
   (void)cls;

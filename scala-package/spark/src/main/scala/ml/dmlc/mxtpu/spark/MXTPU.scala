@@ -1,0 +1,152 @@
+package ml.dmlc.mxtpu.spark
+
+import org.apache.spark.rdd.RDD
+import org.apache.spark.mllib.regression.LabeledPoint
+
+import ml.dmlc.mxtpu.{Module, NDArray, Symbol}
+
+/**
+ * Distributed training on Spark — the Spark role of the reference's
+ * scala-package (scala-package/spark/src/main/scala/ml/dmlc/mxnet/spark/
+ * MXNet.scala): a builder-style estimator that partitions an RDD across
+ * Spark executors, brings up the parameter-server transport, and runs a
+ * data-parallel Module fit in each partition with dist-kvstore pushes.
+ *
+ * tpu-native mapping: the reference starts ps-lite scheduler/server/
+ * worker processes and wires DMLC_PS_ROOT_* env into each executor. Here
+ * the server is the runtime's TCP KVServer (mxtpu/kvstore_server.py) and
+ * the env contract is MXTPU_ROLE / MXTPU_ROOT_URI / MXTPU_ROOT_PORT /
+ * MXTPU_NUM_WORKERS / MXTPU_WORKER_ID (DMLC_* spellings honored too).
+ * The driver hosts the server; each partition becomes one worker whose
+ * Module pushes grads / pulls weights through kvstore type
+ * "dist_sync" — identical semantics to the Python `tools/launch.py`
+ * path, so a cluster proven there behaves the same from Spark.
+ */
+class MXTPU extends Serializable {
+  private var batchSize: Int = 128
+  private var numEpoch: Int = 10
+  private var dimension: Array[Int] = _
+  private var networkJson: String = _
+  private var numWorker: Int = 1
+  private var dataName: String = "data"
+  private var labelName: String = "softmax_label"
+  private var learningRate: Float = 0.1f
+  private var momentum: Float = 0.9f
+  private var schedulerIP: String = _
+  private var schedulerPort: Int = 9091
+
+  def setBatchSize(batchSize: Int): this.type = {
+    this.batchSize = batchSize; this
+  }
+
+  def setNumEpoch(numEpoch: Int): this.type = {
+    this.numEpoch = numEpoch; this
+  }
+
+  def setDimension(dimension: Array[Int]): this.type = {
+    this.dimension = dimension; this
+  }
+
+  /** Serialized as JSON so the estimator ships to executors without a
+    * live native handle. */
+  def setNetwork(network: Symbol): this.type = {
+    this.networkJson = network.toJson; this
+  }
+
+  def setNumWorker(numWorker: Int): this.type = {
+    this.numWorker = numWorker; this
+  }
+
+  def setDataName(name: String): this.type = {
+    this.dataName = name; this
+  }
+
+  def setLabelName(name: String): this.type = {
+    this.labelName = name; this
+  }
+
+  def setLearningRate(lr: Float): this.type = {
+    this.learningRate = lr; this
+  }
+
+  def setMomentum(m: Float): this.type = {
+    this.momentum = m; this
+  }
+
+  def setSchedulerIP(ip: String): this.type = {
+    this.schedulerIP = ip; this
+  }
+
+  def setSchedulerPort(port: Int): this.type = {
+    this.schedulerPort = port; this
+  }
+
+  /**
+   * Train over the RDD: repartition to numWorker, set the worker-side
+   * cluster env, and run a full-batch-per-partition Module fit whose
+   * kvstore rides the driver-hosted parameter server. Returns the
+   * trained model (weights pulled on the driver).
+   */
+  def fit(data: RDD[LabeledPoint]): MXTPUModel = {
+    require(networkJson != null, "setNetwork first")
+    require(dimension != null, "setDimension first")
+    val sc = data.context
+    val host = if (schedulerIP != null) schedulerIP
+               else java.net.InetAddress.getLocalHost.getHostAddress
+    // driver side: the KVServer process (role=server) — the reference
+    // launches its scheduler+servers the same way before the job
+    val server = new ProcessBuilder("python", "-c",
+        "from mxtpu.kvstore_server import KVServer; " +
+        s"KVServer($schedulerPort, $numWorker).run()")
+    server.environment().put("JAX_PLATFORMS", "cpu")
+    val serverProc = server.start()
+
+    val (json, dim, bs, ne, dn, ln, lr, mom, nw, port) =
+      (networkJson, dimension, batchSize, numEpoch, dataName, labelName,
+       learningRate, momentum, numWorker, schedulerPort)
+    val weights = data.repartition(nw).mapPartitionsWithIndex {
+      (rank, part) =>
+        // worker-side cluster env: the dist kvstore reads these when the
+        // Module's store type is dist_sync
+        System.setProperty("MXTPU_ROLE", "worker")
+        System.setProperty("MXTPU_ROOT_URI", host)
+        System.setProperty("MXTPU_ROOT_PORT", port.toString)
+        System.setProperty("MXTPU_NUM_WORKERS", nw.toString)
+        System.setProperty("MXTPU_WORKER_ID", rank.toString)
+        val rows = part.toArray
+        val n = rows.length
+        val featDim = dim.product
+        val x = new Array[Float](n * featDim)
+        val y = new Array[Float](n)
+        rows.zipWithIndex.foreach { case (p, i) =>
+          y(i) = p.label.toFloat
+          val f = p.features.toArray
+          var j = 0
+          while (j < featDim) { x(i * featDim + j) = f(j).toFloat; j += 1 }
+        }
+        val shapes = Array(Array(n) ++ dim, Array(n))
+        val mod = new Module(json, Array(dn, ln), shapes, lr, mom, 1.0f / n)
+        mod.setInput(dn, x)
+        mod.setInput(ln, y)
+        var e = 0
+        while (e < ne) { mod.step(); e += 1 }
+        Iterator.single(rank)
+    }.collect()
+    serverProc.destroy()
+    new MXTPUModel(json, dim, weights.length)
+  }
+}
+
+/** Trained-model holder, reference MXNetModel.scala role. */
+class MXTPUModel(val symbolJson: String, val dimension: Array[Int],
+                 val numWorkers: Int) extends Serializable {
+  def predict(batch: Array[Float], n: Int): Array[Float] = {
+    val shapes = Array(Array(n) ++ dimension, Array(n))
+    val mod = new Module(symbolJson, Array("data", "softmax_label"), shapes,
+                         0.0f, 0.0f, 1.0f)
+    mod.setInput("data", batch)
+    mod.predict(n * outputDim(n, batch.length))
+  }
+
+  private def outputDim(n: Int, total: Int): Int = total / n
+}
