@@ -1,0 +1,126 @@
+"""Training nets built from in-graph caffe layers (parity:
+example/caffe/caffe_net.py — the reference composes MLP and LeNet from
+mx.symbol.CaffeOp layers specified by inline prototxt and trains them;
+here the caffe layers execute through the host-callback plugin
+mxtpu/caffe_bridge.py, their blobs trained by the mxtpu optimizer).
+
+Run:  python caffe_net.py --network mlp --epochs 10
+      python caffe_net.py --network lenet --epochs 10
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+
+
+def get_mlp(classes):
+    """Reference caffe_net.py get_mlp: InnerProduct+TanH stack from
+    inline prototxt, softmax head native."""
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.CaffeOp(
+        data_0=data, num_weight=2, name="fc1",
+        prototxt='layer{type:"InnerProduct" '
+                 'inner_product_param{num_output: 128}}')
+    act1 = mx.sym.CaffeOp(data_0=fc1, prototxt='layer{type:"TanH"}',
+                          name="act1")
+    fc2 = mx.sym.CaffeOp(
+        data_0=act1, num_weight=2, name="fc2",
+        prototxt='layer{type:"InnerProduct" '
+                 'inner_product_param{num_output: 64}}')
+    act2 = mx.sym.CaffeOp(data_0=fc2, prototxt='layer{type:"TanH"}',
+                          name="act2")
+    fc3 = mx.sym.CaffeOp(
+        data_0=act2, num_weight=2, name="fc3",
+        prototxt='layer{type:"InnerProduct" '
+                 'inner_product_param{num_output: %d}}' % classes)
+    return mx.sym.SoftmaxOutput(fc3, name="softmax")
+
+
+def get_lenet(classes):
+    """Reference caffe_net.py get_lenet: caffe conv/pool/tanh pipeline."""
+    data = mx.sym.Variable("data")
+    conv1 = mx.sym.CaffeOp(
+        data_0=data, num_weight=2, name="conv1",
+        prototxt='layer{type:"Convolution" convolution_param '
+                 '{num_output: 8 kernel_size: 3 stride: 1 pad: 1}}')
+    act1 = mx.sym.CaffeOp(data_0=conv1, prototxt='layer{type:"TanH"}',
+                          name="cact1")
+    pool1 = mx.sym.CaffeOp(
+        data_0=act1, name="pool1",
+        prototxt='layer{type:"Pooling" pooling_param '
+                 '{pool: MAX kernel_size: 2 stride: 2}}')
+    conv2 = mx.sym.CaffeOp(
+        data_0=pool1, num_weight=2, name="conv2",
+        prototxt='layer{type:"Convolution" convolution_param '
+                 '{num_output: 16 kernel_size: 3 stride: 1 pad: 1}}')
+    act2 = mx.sym.CaffeOp(data_0=conv2, prototxt='layer{type:"TanH"}',
+                          name="cact2")
+    pool2 = mx.sym.CaffeOp(
+        data_0=act2, name="pool2",
+        prototxt='layer{type:"Pooling" pooling_param '
+                 '{pool: MAX kernel_size: 2 stride: 2}}')
+    fc1 = mx.sym.CaffeOp(
+        data_0=pool2, num_weight=2, name="fc1",
+        prototxt='layer{type:"InnerProduct" '
+                 'inner_product_param{num_output: 64}}')
+    act3 = mx.sym.CaffeOp(data_0=fc1, prototxt='layer{type:"TanH"}',
+                          name="fact")
+    fc2 = mx.sym.CaffeOp(
+        data_0=act3, num_weight=2, name="fc2",
+        prototxt='layer{type:"InnerProduct" '
+                 'inner_product_param{num_output: %d}}' % classes)
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def synth_images(n, edge, classes, rng):
+    """Brightest-quadrant images: linearly inseparable, conv-learnable."""
+    y = rng.randint(0, classes, n)
+    X = rng.rand(n, 1, edge, edge).astype("f4") * 0.4
+    half = edge // 2
+    for i, c in enumerate(y):
+        r0, c0 = (c // 2) * half, (c % 2) * half
+        X[i, 0, r0:r0 + half, c0:c0 + half] += 1.0
+    return X, y.astype("f4")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", choices=["mlp", "lenet"], default="mlp")
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-examples", type=int, default=512)
+    ap.add_argument("--lr", type=float, default=0.2)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    rng = np.random.RandomState(args.seed)
+    mx.random.seed(args.seed)
+
+    classes = 4
+    if args.network == "mlp":
+        dim = 20
+        centers = rng.randn(classes, dim) * 3
+        y = rng.randint(0, classes, args.num_examples)
+        X = (centers[y] + rng.randn(args.num_examples, dim)).astype("f4")
+        y = y.astype("f4")
+        net = get_mlp(classes)
+    else:
+        X, y = synth_images(args.num_examples, 12, classes, rng)
+        net = get_lenet(classes)
+
+    it = mx.io.NDArrayIter(X, y, batch_size=args.batch_size, shuffle=True)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=args.epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(factor_type="in",
+                                              magnitude=2.34),
+            eval_data=it)
+    acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+    print("train-accuracy %.4f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

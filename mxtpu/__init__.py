@@ -64,6 +64,12 @@ from . import rtc
 from . import contrib
 from . import torch_bridge
 from . import torch_bridge as th
+from . import caffe_bridge
+from . import caffe_bridge as caffe
+# reference-parity call sites use mx.symbol.CaffeOp / CaffeLoss
+# (plugin/caffe registers into the symbol namespace the same way)
+symbol.CaffeOp = caffe_bridge.CaffeOp
+symbol.CaffeLoss = caffe_bridge.CaffeLoss
 
 from .model import FeedForward
 from .kvstore import create as _kv_create

@@ -1,0 +1,321 @@
+"""mx.caffe — in-graph caffe operators.
+
+Parity: the reference's caffe plugin (plugin/caffe/caffe_op.cc,
+caffe_loss.cc) which runs caffe layers and losses INSIDE the graph —
+``mx.symbol.CaffeOp(data_0=..., num_weight=2, prototxt="layer{...}")``
+with learnable blobs exposed as arguments ``0_weight``/``1_bias``
+(caffe_op-inl.h:239-251), and ``mx.symbol.CaffeLoss(data, label,
+prototxt=..., grad_scale=...)``.
+
+TPU-native design: where the reference links libcaffe and forwards into
+``caffe::Layer<Dtype>::Forward/Backward``, this plugin executes the
+layer's semantics on the host through torch autograd inside a CustomOp
+host callback (the same proven seam as mx.th.as_symbol,
+mxtpu/torch_bridge.py) — the graph stays jitted end to end with the
+callback spliced in, and the caffe blobs are ordinary mxtpu Variables
+trained by the framework optimizer. The prototxt layer spec rides as a
+symbol attribute, so CaffeOp graphs serialize/deserialize like any other
+symbol JSON.
+
+Supported layer types: InnerProduct, Convolution, Pooling (MAX/AVE,
+caffe ceil-mode), ReLU, TanH, Sigmoid, Dropout; losses: SoftmaxWithLoss,
+EuclideanLoss — the set the reference's example/caffe nets use.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .caffe_proto import as_list, parse_prototxt
+
+__all__ = ["CaffeOp", "CaffeLoss"]
+
+
+def _torch():
+    try:
+        import torch
+    except ImportError as e:  # pragma: no cover - torch is baked in
+        raise MXNetError("caffe bridge requires torch: %s" % e)
+    return torch
+
+
+def _layer_of(prototxt):
+    try:
+        msg = parse_prototxt(prototxt)
+    except ValueError as e:
+        raise MXNetError("CaffeOp prototxt parse error: %s" % e)
+    layers = as_list(msg.get("layer") or msg.get("layers"))
+    if not layers:
+        raise MXNetError("CaffeOp prototxt must contain a layer{...}: %r"
+                         % prototxt)
+    if len(layers) > 1:
+        raise MXNetError("CaffeOp runs ONE layer per op; got %d"
+                         % len(layers))
+    return layers[0]
+
+
+def _conv_geom(p):
+    k = p.get("kernel_size", p.get("kernel_h", 1))
+    kh, kw = int(p.get("kernel_h", k)), int(p.get("kernel_w", k))
+    s = p.get("stride", p.get("stride_h", 1))
+    sh, sw = int(p.get("stride_h", s)), int(p.get("stride_w", s))
+    pd = p.get("pad", p.get("pad_h", 0))
+    ph, pw = int(p.get("pad_h", pd)), int(p.get("pad_w", pd))
+    return (kh, kw), (sh, sw), (ph, pw)
+
+
+def _weight_shapes(layer, in_shape, num_weight):
+    """Blob shapes for the layer's learnable parameters, caffe
+    conventions (weight first, bias second)."""
+    ltype = str(layer.get("type"))
+    if num_weight == 0:
+        return []
+    if ltype == "InnerProduct":
+        p = layer.get("inner_product_param", {})
+        num_output = int(p["num_output"])
+        in_feat = 1
+        for d in in_shape[1:]:
+            in_feat *= int(d)
+        shapes = [[num_output, in_feat]]
+        if num_weight > 1:
+            shapes.append([num_output])
+        return shapes
+    if ltype == "Convolution":
+        p = layer.get("convolution_param", {})
+        num_output = int(p["num_output"])
+        group = int(p.get("group", 1))
+        (kh, kw), _, _ = _conv_geom(p)
+        shapes = [[num_output, int(in_shape[1]) // group, kh, kw]]
+        if num_weight > 1:
+            shapes.append([num_output])
+        return shapes
+    raise MXNetError("caffe layer %s takes no weights (num_weight=%d)"
+                     % (ltype, num_weight))
+
+
+def _forward(layer, x, weights, training, seed):
+    """Run the caffe layer on torch tensors (differentiable)."""
+    torch = _torch()
+    F = torch.nn.functional
+    ltype = str(layer.get("type"))
+    if ltype == "InnerProduct":
+        w = weights[0]
+        b = weights[1] if len(weights) > 1 else None
+        return F.linear(x.flatten(1), w, b)
+    if ltype == "Convolution":
+        p = layer.get("convolution_param", {})
+        _, stride, pad = _conv_geom(p)
+        group = int(p.get("group", 1))
+        dil = int(p.get("dilation", 1))
+        w = weights[0]
+        b = weights[1] if len(weights) > 1 else None
+        return F.conv2d(x, w, b, stride=stride, padding=pad,
+                        dilation=dil, groups=group)
+    if ltype == "Pooling":
+        p = layer.get("pooling_param", {})
+        if p.get("global_pooling"):
+            kind = str(p.get("pool", "MAX"))
+            return (F.adaptive_max_pool2d(x, 1) if kind == "MAX"
+                    else F.adaptive_avg_pool2d(x, 1))
+        kern, stride, pad = _conv_geom(p)
+        kind = str(p.get("pool", "MAX"))
+        if kind == "MAX":
+            # caffe pools with ceil-mode output sizing
+            return F.max_pool2d(x, kern, stride, pad, ceil_mode=True)
+        if kind == "AVE":
+            return F.avg_pool2d(x, kern, stride, pad, ceil_mode=True,
+                                count_include_pad=False)
+        raise MXNetError("unsupported caffe pool kind %s" % kind)
+    if ltype == "ReLU":
+        return F.relu(x)
+    if ltype == "TanH":
+        return torch.tanh(x)
+    if ltype == "Sigmoid":
+        return torch.sigmoid(x)
+    if ltype == "Dropout":
+        ratio = float(layer.get("dropout_param", {})
+                      .get("dropout_ratio", 0.5))
+        if not training:
+            return x
+        with torch.random.fork_rng(devices=[]):
+            torch.manual_seed(seed)
+            return F.dropout(x, p=ratio, training=True)
+    raise MXNetError("unsupported caffe layer type %r" % ltype)
+
+
+def _loss_forward(layer, data, label, grad_scale):
+    """loss value (scalar per batch mean, caffe normalization)."""
+    torch = _torch()
+    F = torch.nn.functional
+    ltype = str(layer.get("type"))
+    if ltype == "SoftmaxWithLoss":
+        return F.cross_entropy(data.flatten(1), label.long().flatten())
+    if ltype == "EuclideanLoss":
+        d = (data - label.reshape(data.shape)).flatten(1)
+        return (d * d).sum(dim=1).mean() / 2.0
+    raise MXNetError("unsupported caffe loss type %r" % ltype)
+
+
+def _ensure_registered():
+    from . import operator as op
+
+    if "CaffeOp" in op._REGISTRY:
+        return
+
+    class _CaffeOpOp(op.CustomOp):
+        def __init__(self, layer, num_weight):
+            self._layer = layer
+            self._num_weight = num_weight
+
+        def _tensors(self, in_data):
+            torch = _torch()
+            x = torch.from_numpy(in_data[0].asnumpy().copy())
+            ws = [torch.from_numpy(w.asnumpy().copy())
+                  for w in in_data[1:1 + self._num_weight]]
+            return torch, x, ws
+
+        def forward(self, is_train, req, in_data, out_data, aux):
+            torch, x, ws = self._tensors(in_data)
+            seed = getattr(self, "_mxtpu_rng_seed", 0)
+            with torch.no_grad():
+                out = _forward(self._layer, x, ws, bool(is_train), seed)
+            self.assign(out_data[0], req[0], out.numpy())
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            torch, x, ws = self._tensors(in_data)
+            seed = getattr(self, "_mxtpu_rng_seed", 0)
+            x.requires_grad_(True)
+            for w in ws:
+                w.requires_grad_(True)
+            out = _forward(self._layer, x, ws, True, seed)
+            g = torch.from_numpy(out_grad[0].asnumpy().copy())
+            grads = torch.autograd.grad(out, [x] + ws, grad_outputs=g,
+                                        allow_unused=True)
+            for i, t in enumerate(grads):
+                val = (t.numpy() if t is not None
+                       else 0 * in_data[i].asnumpy())
+                self.assign(in_grad[i], req[i], val)
+
+    class _CaffeOpProp(op.CustomOpProp):
+        def __init__(self, prototxt="", num_data="1", num_weight="0",
+                     num_out="1"):
+            super().__init__(need_top_grad=True)
+            self._layer = _layer_of(prototxt)
+            self._num_data = int(num_data)
+            self._num_weight = int(num_weight)
+            self._num_out = int(num_out)
+            if self._num_data != 1 or self._num_out != 1:
+                raise MXNetError(
+                    "CaffeOp here supports num_data=1, num_out=1 (layer "
+                    "type %s)" % self._layer.get("type"))
+
+        def list_arguments(self):
+            # reference caffe_op-inl.h:239-251 naming: data_i, then
+            # 0_weight, 1_bias
+            args = ["data_%d" % i for i in range(self._num_data)]
+            for i in range(self._num_weight):
+                args.append("%d_weight" % i if i == 0 else "%d_bias" % i)
+            return args
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            import numpy as _np
+
+            torch = _torch()
+            wshapes = _weight_shapes(self._layer, in_shape[0],
+                                     self._num_weight)
+            with torch.no_grad():
+                ws = [torch.zeros(*s) for s in wshapes]
+                out = _forward(self._layer,
+                               torch.zeros(*[int(d) for d in in_shape[0]]),
+                               ws, False, 0)
+            return [in_shape[0]] + wshapes, [list(out.shape)], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return _CaffeOpOp(self._layer, self._num_weight)
+
+    class _CaffeLossOp(op.CustomOp):
+        def __init__(self, layer, grad_scale):
+            self._layer = layer
+            self._grad_scale = grad_scale
+
+        def _tensors(self, in_data):
+            torch = _torch()
+            d = torch.from_numpy(in_data[0].asnumpy().copy())
+            lbl = torch.from_numpy(in_data[1].asnumpy().copy())
+            return torch, d, lbl
+
+        def forward(self, is_train, req, in_data, out_data, aux):
+            torch, d, lbl = self._tensors(in_data)
+            with torch.no_grad():
+                loss = _loss_forward(self._layer, d, lbl, self._grad_scale)
+            self.assign(out_data[0], req[0],
+                        loss.numpy().reshape(out_data[0].shape))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            # loss layer: gradient originates here (need_top_grad=False),
+            # scaled by grad_scale — reference caffe_loss.cc semantics
+            torch, d, lbl = self._tensors(in_data)
+            d.requires_grad_(True)
+            loss = _loss_forward(self._layer, d, lbl, self._grad_scale)
+            loss.backward()
+            self.assign(in_grad[0], req[0],
+                        (d.grad * self._grad_scale).numpy())
+            self.assign(in_grad[1], req[1], 0 * in_data[1].asnumpy())
+
+    class _CaffeLossProp(op.CustomOpProp):
+        def __init__(self, prototxt="", grad_scale="1.0"):
+            super().__init__(need_top_grad=False)
+            self._layer = _layer_of(prototxt)
+            self._grad_scale = float(grad_scale)
+
+        def list_arguments(self):
+            return ["data", "label"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            # caffe losses reduce to a scalar blob; shape (1,) keeps the
+            # executor's batched layout conventions
+            return [in_shape[0], in_shape[1]], [[1]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return _CaffeLossOp(self._layer, self._grad_scale)
+
+    op.register("CaffeOp")(_CaffeOpProp)
+    op.register("CaffeLoss")(_CaffeLossProp)
+
+
+def CaffeOp(*data, prototxt, num_weight=0, num_out=1, name=None, **kwargs):
+    """Symbol running one caffe layer in-graph (reference
+    mx.symbol.CaffeOp). Data inputs positionally or as data_0=...
+    kwargs; learnable blobs auto-create as Variables
+    ``<name>_0_weight``/``<name>_1_bias`` initialized by the Module
+    initializer like any other parameter."""
+    from . import symbol as sym
+
+    _ensure_registered()
+    data = list(data)
+    i = 0
+    while "data_%d" % i in kwargs:
+        data.append(kwargs.pop("data_%d" % i))
+        i += 1
+    if kwargs:
+        raise MXNetError("CaffeOp: unknown kwargs %s" % sorted(kwargs))
+    if not data:
+        raise MXNetError("CaffeOp needs at least one data input")
+    return sym.Custom(*data, op_type="CaffeOp", prototxt=prototxt,
+                      num_data=str(len(data)), num_weight=str(num_weight),
+                      num_out=str(num_out), name=name)
+
+
+def CaffeLoss(data, label, prototxt, grad_scale=1.0, name=None):
+    """Symbol running a caffe loss layer in-graph (reference
+    mx.symbol.CaffeLoss): forward emits the loss blob, backward injects
+    grad_scale * dLoss/ddata."""
+    from . import symbol as sym
+
+    _ensure_registered()
+    return sym.Custom(data, label, op_type="CaffeLoss", prototxt=prototxt,
+                      grad_scale=str(float(grad_scale)), name=name)

@@ -22,6 +22,8 @@ from .registry import AttrDict, OpDef, Required, register_op, register
 class _CustomOpDef(OpDef):
     """OpDef that keeps ALL kwargs (custom ops take arbitrary str params)."""
 
+    open_attrs = True  # JSON loader keeps every serialized attr
+
     def parse_attrs(self, kwargs):
         if "op_type" not in kwargs:
             raise MXNetError("Custom op requires op_type=")

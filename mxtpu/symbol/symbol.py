@@ -628,8 +628,16 @@ def load_json(json_str):
             op = get_op(meta["op"])
             inputs = [(built[i], idx) for i, idx, *_ in meta["inputs"]]
             user_attrs = {k: v for k, v in attrs.items() if k.startswith("__")}
-            op_attrs = {k: v for k, v in attrs.items()
-                        if not k.startswith("__") and k in op.attrs_spec}
+            # open-attr ops (Custom: arbitrary string params reach the
+            # CustomOpProp ctor) keep every serialized key, not just the
+            # declared spec — a loaded CaffeOp/torch_module graph needs
+            # its prototxt/num_weight back
+            if getattr(op, "open_attrs", False):
+                op_attrs = {k: v for k, v in attrs.items()
+                            if not k.startswith("__")}
+            else:
+                op_attrs = {k: v for k, v in attrs.items()
+                            if not k.startswith("__") and k in op.attrs_spec}
             if op.variadic and op.variadic in attrs:
                 op_attrs[op.variadic] = attrs[op.variadic]
             node = _Node(op, meta["name"], op_attrs, inputs)

@@ -594,3 +594,16 @@ def test_adversarial_vae_gate():
         % (mse, power)
     assert recs[-1] < recs[0] * 0.8, "recon loss did not fall: %s" % recs
     assert d_accs[-1] < 0.98, "D stayed certain: %s" % d_accs
+
+
+@pytest.mark.parametrize("network,epochs,floor", [("mlp", 10, 0.9),
+                                                  ("lenet", 8, 0.85)])
+def test_caffe_net_gate(network, epochs, floor):
+    """In-graph caffe layers (examples/caffe/caffe_net.py, parity
+    example/caffe/caffe_net.py): MLP and LeNet composed from
+    mx.sym.CaffeOp inline-prototxt layers must learn their synthetic
+    tasks through Module.fit."""
+    _example("caffe", "caffe_net.py")
+    import caffe_net
+    acc = caffe_net.main(["--network", network, "--epochs", str(epochs)])
+    assert acc > floor, "caffe %s reached only %.3f" % (network, acc)

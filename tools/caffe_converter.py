@@ -24,85 +24,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
 # --------------------------------------------------------------- prototxt
-def parse_prototxt(text):
-    """Parse protobuf text format into a dict; repeated keys -> lists."""
-    pos = [0]
-    n = len(text)
-
-    def skip_ws():
-        while pos[0] < n:
-            c = text[pos[0]]
-            if c == "#":
-                while pos[0] < n and text[pos[0]] != "\n":
-                    pos[0] += 1
-            elif c.isspace():
-                pos[0] += 1
-            else:
-                break
-
-    def token():
-        skip_ws()
-        start = pos[0]
-        while pos[0] < n and (text[pos[0]].isalnum() or
-                              text[pos[0]] in "_.-+"):
-            pos[0] += 1
-        return text[start:pos[0]]
-
-    def value():
-        skip_ws()
-        c = text[pos[0]]
-        if c == '"' or c == "'":
-            q = c
-            pos[0] += 1
-            start = pos[0]
-            while pos[0] < n and text[pos[0]] != q:
-                pos[0] += 1
-            v = text[start:pos[0]]
-            pos[0] += 1
-            return v
-        tok = token()
-        if tok in ("true", "false"):
-            return tok == "true"
-        try:
-            return int(tok)
-        except ValueError:
-            try:
-                return float(tok)
-            except ValueError:
-                return tok
-
-    def message():
-        out = {}
-        while True:
-            skip_ws()
-            if pos[0] >= n or text[pos[0]] == "}":
-                if pos[0] < n:
-                    pos[0] += 1
-                return out
-            key = token()
-            if not key:
-                raise ValueError("parse error at %d: %r" %
-                                 (pos[0], text[pos[0]:pos[0] + 20]))
-            skip_ws()
-            if text[pos[0]] == ":":
-                pos[0] += 1
-                v = value()
-            elif text[pos[0]] == "{":
-                pos[0] += 1
-                v = message()
-            else:
-                raise ValueError("expected ':' or '{' after %s" % key)
-            if key in out:
-                if not isinstance(out[key], list):
-                    out[key] = [out[key]]
-                out[key].append(v)
-            else:
-                out[key] = v
-    return message()
-
-
+# shared with the in-graph plugin (mxtpu/caffe_bridge.py)
+from mxtpu.caffe_proto import parse_prototxt  # noqa: E402,F401
 def _as_list(v):
     if v is None:
         return []
