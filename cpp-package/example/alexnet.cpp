@@ -1,0 +1,101 @@
+/* AlexNet in C++ through the generated op wrappers — the reference
+ * cpp-package/example/alexnet.cpp role: the full 5-conv/3-fc topology
+ * with LRN and dropout, composed from op.h and trained with the
+ * executor + kvstore flow. Width and input size scale down via CLI so
+ * the CI gate trains in seconds while the topology stays AlexNet.
+ *
+ * Usage: alexnet [epochs] [width_divisor]   Prints "ACCURACY <frac>". */
+#include <cstdio>
+#include <cstdlib>
+#include <random>
+
+#include "mxtpu-cpp/mxtpu_cpp.hpp"
+#include "mxtpu-cpp/op.h"
+#include "train_utils.hpp"
+
+using mxtpu::cpp::Executor;
+using mxtpu::cpp::KVStore;
+using mxtpu::cpp::Shape;
+using mxtpu::cpp::Symbol;
+
+namespace op = mxtpu::cpp::op;
+
+enum { N = 128, C = 1, EDGE = 16, CLASSES = 4 };
+
+static Symbol AlexNet(int classes, int div) {
+  Symbol data = Symbol::Variable("data");
+  /* stage 1: conv - relu - lrn - pool */
+  Symbol c1 = op::Convolution("conv1", data, Symbol(), Symbol(),
+                              Shape(3, 3), 96 / div, {{"pad", "(1, 1,)"}});
+  Symbol a1 = op::Activation("relu1", c1, "relu");
+  Symbol l1 = op::LRN("norm1", a1, 5, {{"alpha", "0.0001"},
+                                       {"beta", "0.75"}});
+  Symbol p1 = op::Pooling("pool1", l1, {{"kernel", "(2, 2,)"},
+                                        {"stride", "(2, 2,)"},
+                                        {"pool_type", "max"}});
+  /* stage 2 */
+  Symbol c2 = op::Convolution("conv2", p1, Symbol(), Symbol(),
+                              Shape(3, 3), 256 / div, {{"pad", "(1, 1,)"}});
+  Symbol a2 = op::Activation("relu2", c2, "relu");
+  Symbol l2 = op::LRN("norm2", a2, 5, {{"alpha", "0.0001"},
+                                       {"beta", "0.75"}});
+  Symbol p2 = op::Pooling("pool2", l2, {{"kernel", "(2, 2,)"},
+                                        {"stride", "(2, 2,)"},
+                                        {"pool_type", "max"}});
+  /* stage 3: conv3 - conv4 - conv5 - pool */
+  Symbol c3 = op::Convolution("conv3", p2, Symbol(), Symbol(),
+                              Shape(3, 3), 384 / div, {{"pad", "(1, 1,)"}});
+  Symbol a3 = op::Activation("relu3", c3, "relu");
+  Symbol c4 = op::Convolution("conv4", a3, Symbol(), Symbol(),
+                              Shape(3, 3), 384 / div, {{"pad", "(1, 1,)"}});
+  Symbol a4 = op::Activation("relu4", c4, "relu");
+  Symbol c5 = op::Convolution("conv5", a4, Symbol(), Symbol(),
+                              Shape(3, 3), 256 / div, {{"pad", "(1, 1,)"}});
+  Symbol a5 = op::Activation("relu5", c5, "relu");
+  Symbol p3 = op::Pooling("pool3", a5, {{"kernel", "(2, 2,)"},
+                                        {"stride", "(2, 2,)"},
+                                        {"pool_type", "max"}});
+  /* classifier: fc6 - dropout - fc7 - dropout - fc8 */
+  Symbol fl = op::Flatten("flatten", p3);
+  Symbol f6 = op::FullyConnected("fc6", fl, Symbol(), Symbol(),
+                                 4096 / (div * 8));
+  Symbol a6 = op::Activation("relu6", f6, "relu");
+  Symbol d6 = op::Dropout("drop6", a6, {{"p", "0.3"}});
+  Symbol f7 = op::FullyConnected("fc7", d6, Symbol(), Symbol(),
+                                 4096 / (div * 8));
+  Symbol a7 = op::Activation("relu7", f7, "relu");
+  Symbol d7 = op::Dropout("drop7", a7, {{"p", "0.3"}});
+  Symbol f8 = op::FullyConnected("fc8", d7, Symbol(), Symbol(), classes);
+  return op::SoftmaxOutput("softmax", f8, Symbol());
+}
+
+int main(int argc, char **argv) {
+  const int epochs = argc > 1 ? atoi(argv[1]) : 30;
+  const int div = argc > 2 ? atoi(argv[2]) : 8;
+
+  Symbol net = AlexNet(CLASSES, div);
+  std::mt19937 rng(11);
+  std::vector<float> images, labels;
+  extrain::QuadrantData(N, C, EDGE, CLASSES, &rng, &images, &labels);
+
+  Executor exec(net, 1, 0, "write",
+                {{"data", {N, C, EDGE, EDGE}}, {"softmax_label", {N}}});
+  std::vector<std::string> params = extrain::InitParams(
+      &exec, net, {"data", "softmax_label"}, &rng);
+  exec.Arg("data").CopyFrom(images.data(), images.size());
+  exec.Arg("softmax_label").CopyFrom(labels.data(), labels.size());
+
+  KVStore kv("local");
+  kv.SetOptimizer("sgd", 0.05f, 0.0f, 0.9f, 1.0f / N);
+  for (const auto &name : params) {
+    mxtpu::cpp::NDArray w = exec.Arg(name);
+    kv.Init(name, w);
+  }
+  for (int e = 0; e < epochs; ++e) {
+    extrain::Step(&exec, &kv, params);
+  }
+  mxtpu::cpp::WaitAll();
+  printf("ACCURACY %.4f\n",
+         extrain::Accuracy(&exec, labels, N, CLASSES));
+  return 0;
+}

@@ -1,0 +1,178 @@
+/* Char-level LSTM language model in C++ through the generated op
+ * wrappers — the reference cpp-package/example/charRNN.cpp role: an
+ * LSTM built from primitive ops (no RNN black box), unrolled over time
+ * with shared weights, trained to predict the next character, then
+ * greedy-sampled. The LSTM cell is composed exactly as the reference
+ * builds it: gates = i2h(x) + h2h(h), SliceChannel into i/f/o/g,
+ * c' = f*c + i*g, h' = o*tanh(c').
+ *
+ * Usage: char_rnn [epochs]   Prints "ACCURACY <frac>" (next-char) and a
+ * greedy sample line "SAMPLE <text>". */
+#include <cstdio>
+#include <cstdlib>
+#include <deque>
+#include <map>
+#include <random>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "mxtpu-cpp/mxtpu_cpp.hpp"
+#include "mxtpu-cpp/op.h"
+#include "train_utils.hpp"
+
+using mxtpu::cpp::Executor;
+using mxtpu::cpp::KVStore;
+using mxtpu::cpp::NDArray;
+using mxtpu::cpp::Operator;
+using mxtpu::cpp::Symbol;
+
+namespace op = mxtpu::cpp::op;
+
+enum { N = 64, T = 8, EMBED = 16, HIDDEN = 48 };
+
+static const char kText[] = "the quick brown fox jumps over the lazy dog. ";
+
+/* one LSTM step with shared weights; h/c passed by reference-to-slot */
+struct LSTMCell {
+  Symbol i2h_w = Symbol::Variable("i2h_weight");
+  Symbol i2h_b = Symbol::Variable("i2h_bias");
+  Symbol h2h_w = Symbol::Variable("h2h_weight");
+  Symbol h2h_b = Symbol::Variable("h2h_bias");
+
+  /* -> (h', c') — inputs taken by const-ref so callers keep ownership */
+  std::pair<Symbol, Symbol> Step(int t, const Symbol &x, const Symbol &h,
+                                 const Symbol &c) {
+    std::string st = std::to_string(t);
+    Symbol i2h = op::FullyConnected("i2h_" + st, x, i2h_w, i2h_b,
+                                    4 * HIDDEN);
+    Symbol h2h = op::FullyConnected("h2h_" + st, h, h2h_w, h2h_b,
+                                    4 * HIDDEN);
+    Symbol gates = op::elemwise_add("gates_" + st, i2h, h2h);
+    Symbol sl = op::SliceChannel("slice_" + st, gates, 4,
+                                 {{"axis", "1"}});
+    Symbol in_g = op::Activation("ig_" + st, sl[0], "sigmoid");
+    Symbol fg = op::Activation("fg_" + st, sl[1], "sigmoid");
+    Symbol og = op::Activation("og_" + st, sl[2], "sigmoid");
+    Symbol new_g = op::Activation("ng_" + st, sl[3], "tanh");
+    Symbol fc_ = op::elemwise_mul("fc_" + st, fg, c);
+    Symbol ig_ = op::elemwise_mul("in_" + st, in_g, new_g);
+    Symbol nc = op::elemwise_add("c_" + st, fc_, ig_);
+    Symbol ct = op::Activation("ct_" + st, nc, "tanh");
+    Symbol nh = op::elemwise_mul("h_" + st, og, ct);
+    return {std::move(nh), std::move(nc)};
+  }
+};
+
+/* unrolled LM over seq_len steps; logits concat time-major ([t0 batch;
+ * t1 batch; ...]) so labels flatten the same way */
+static Symbol BuildLM(int seq_len, int vocab, LSTMCell *cell) {
+  Symbol data = Symbol::Variable("data");
+  Symbol embed_w = Symbol::Variable("embed_weight");
+  Symbol embed = op::Embedding("embed", data, embed_w, vocab, EMBED);
+  Symbol steps = op::SliceChannel("tsplit", embed, seq_len,
+                                  {{"axis", "1"},
+                                   {"squeeze_axis", "True"}});
+  /* deques own every step's state (Symbol is move-only); the Concat
+   * Operator below takes const refs into stable deque storage */
+  std::deque<Symbol> hs, cs;
+  hs.push_back(Symbol::Variable("init_h"));
+  cs.push_back(Symbol::Variable("init_c"));
+  for (int t = 0; t < seq_len; ++t) {
+    Symbol x = steps[t];
+    auto next = cell->Step(t, x, hs.back(), cs.back());
+    hs.push_back(std::move(next.first));
+    cs.push_back(std::move(next.second));
+  }
+  Operator cat("Concat");
+  cat.SetParam("num_args", seq_len);
+  cat.SetParam("dim", 0);
+  for (size_t t = 1; t < hs.size(); ++t) cat.AddInput(hs[t]);
+  Symbol all_h = cat.CreateSymbol("all_h");
+  Symbol cls_w = Symbol::Variable("cls_weight");
+  Symbol cls_b = Symbol::Variable("cls_bias");
+  Symbol logits = op::FullyConnected("cls", all_h, cls_w, cls_b, vocab);
+  return op::SoftmaxOutput("softmax", logits, Symbol());
+}
+
+int main(int argc, char **argv) {
+  const int epochs = argc > 1 ? atoi(argv[1]) : 60;
+
+  /* vocab over the corpus */
+  std::string text;
+  for (int i = 0; i < 40; ++i) text += kText;
+  std::map<char, int> stoi;
+  std::vector<char> itos;
+  for (char ch : text) {
+    if (!stoi.count(ch)) {
+      stoi[ch] = (int)itos.size();
+      itos.push_back(ch);
+    }
+  }
+  const int vocab = (int)itos.size();
+
+  /* N windows of length T+1: input chars + next-char labels */
+  std::mt19937 rng(17);
+  std::uniform_int_distribution<int> off(0, (int)text.size() - T - 2);
+  std::vector<float> xs((size_t)N * T), ys((size_t)N * T);
+  for (int i = 0; i < N; ++i) {
+    int o = off(rng);
+    for (int t = 0; t < T; ++t) {
+      xs[(size_t)i * T + t] = (float)stoi[text[o + t]];
+      /* time-major labels to match the concat layout */
+      ys[(size_t)t * N + i] = (float)stoi[text[o + t + 1]];
+    }
+  }
+
+  LSTMCell cell;
+  Symbol net = BuildLM(T, vocab, &cell);
+  Executor exec(net, 1, 0, "write",
+                {{"data", {N, T}},
+                 {"softmax_label", {N * T}},
+                 {"init_h", {N, HIDDEN}},
+                 {"init_c", {N, HIDDEN}}});
+  std::vector<std::string> params = extrain::InitParams(
+      &exec, net, {"data", "softmax_label", "init_h", "init_c"}, &rng);
+  exec.Arg("data").CopyFrom(xs.data(), xs.size());
+  exec.Arg("softmax_label").CopyFrom(ys.data(), ys.size());
+  /* zero initial state (stays zero: inputs, not params) */
+  std::vector<float> zeros((size_t)N * HIDDEN, 0.f);
+  exec.Arg("init_h").CopyFrom(zeros.data(), zeros.size());
+  exec.Arg("init_c").CopyFrom(zeros.data(), zeros.size());
+
+  KVStore kv("local");
+  kv.SetOptimizer("sgd", 0.5f, 0.0f, 0.9f, 1.0f / (N * T));
+  for (const auto &name : params) {
+    NDArray w = exec.Arg(name);
+    kv.Init(name, w);
+  }
+  for (int e = 0; e < epochs; ++e) {
+    extrain::Step(&exec, &kv, params);
+  }
+  mxtpu::cpp::WaitAll();
+  printf("ACCURACY %.4f\n",
+         extrain::Accuracy(&exec, ys, N * T, vocab));
+
+  /* greedy sample: feed a seed window, emit argmax of the LAST step
+   * (row (T-1)*N + 0 of the time-major logits) */
+  std::string sample = text.substr(0, T);
+  for (int gen = 0; gen < 24; ++gen) {
+    std::vector<float> seed((size_t)N * T, 0.f);
+    for (int t = 0; t < T; ++t) {
+      seed[t] = (float)stoi[sample[sample.size() - T + t]];
+    }
+    exec.Arg("data").CopyFrom(seed.data(), seed.size());
+    exec.Forward(false);
+    NDArray out = exec.Output(0);
+    std::vector<float> probs(out.Size());
+    out.CopyTo(probs.data(), probs.size());
+    size_t row = (size_t)(T - 1) * N + 0;
+    int best = 0;
+    for (int k = 1; k < vocab; ++k) {
+      if (probs[row * vocab + k] > probs[row * vocab + best]) best = k;
+    }
+    sample += itos[best];
+  }
+  printf("SAMPLE %s\n", sample.c_str() + T);
+  return 0;
+}

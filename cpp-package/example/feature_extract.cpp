@@ -1,0 +1,138 @@
+/* Feature extraction in C++ — the reference
+ * cpp-package/example/feature_extract/ role: train (or load) a
+ * classifier, then bind an INTERNAL layer via GetInternals as its own
+ * executor, transfer the trained weights by name, and read embedding
+ * vectors for new inputs. The gate checks the features are
+ * discriminative: same-class pairs must be closer (cosine) than
+ * cross-class pairs.
+ *
+ * Usage: feature_extract [epochs]
+ * Prints "FEATURE_DIM <d>", "SAME <cos> CROSS <cos>", "FEATURES OK". */
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <random>
+#include <string>
+#include <vector>
+
+#include "mxtpu-cpp/mxtpu_cpp.hpp"
+#include "mxtpu-cpp/op.h"
+#include "train_utils.hpp"
+
+using mxtpu::cpp::Executor;
+using mxtpu::cpp::KVStore;
+using mxtpu::cpp::NDArray;
+using mxtpu::cpp::Symbol;
+
+namespace op = mxtpu::cpp::op;
+
+enum { N = 128, C = 1, EDGE = 12, CLASSES = 4, FEAT = 32 };
+
+static Symbol BuildNet() {
+  Symbol data = Symbol::Variable("data");
+  Symbol c1 = op::Convolution("conv1", data, Symbol(), Symbol(),
+                              mxtpu::cpp::Shape(3, 3), 8,
+                              {{"pad", "(1, 1,)"}});
+  Symbol a1 = op::Activation("relu1", c1, "relu");
+  Symbol p1 = op::Pooling("pool1", a1, {{"kernel", "(2, 2,)"},
+                                        {"stride", "(2, 2,)"},
+                                        {"pool_type", "max"}});
+  Symbol fl = op::Flatten("flatten", p1);
+  Symbol f1 = op::FullyConnected("feat", fl, Symbol(), Symbol(), FEAT);
+  Symbol a2 = op::Activation("featrelu", f1, "relu");
+  Symbol f2 = op::FullyConnected("cls", a2, Symbol(), Symbol(), CLASSES);
+  return op::SoftmaxOutput("softmax", f2, Symbol());
+}
+
+static double Cosine(const std::vector<float> &a,
+                     const std::vector<float> &b) {
+  double num = 0, na = 0, nb = 0;
+  for (size_t i = 0; i < a.size(); ++i) {
+    num += a[i] * b[i];
+    na += a[i] * a[i];
+    nb += b[i] * b[i];
+  }
+  return num / (std::sqrt(na) * std::sqrt(nb) + 1e-12);
+}
+
+int main(int argc, char **argv) {
+  const int epochs = argc > 1 ? atoi(argv[1]) : 30;
+
+  Symbol net = BuildNet();
+  std::mt19937 rng(19);
+  std::vector<float> images, labels;
+  extrain::QuadrantData(N, C, EDGE, CLASSES, &rng, &images, &labels);
+
+  /* ---- train the classifier */
+  Executor exec(net, 1, 0, "write",
+                {{"data", {N, C, EDGE, EDGE}}, {"softmax_label", {N}}});
+  std::vector<std::string> params = extrain::InitParams(
+      &exec, net, {"data", "softmax_label"}, &rng);
+  exec.Arg("data").CopyFrom(images.data(), images.size());
+  exec.Arg("softmax_label").CopyFrom(labels.data(), labels.size());
+  KVStore kv("local");
+  kv.SetOptimizer("sgd", 0.2f, 0.0f, 0.9f, 1.0f / N);
+  for (const auto &name : params) {
+    NDArray w = exec.Arg(name);
+    kv.Init(name, w);
+  }
+  for (int e = 0; e < epochs; ++e) {
+    extrain::Step(&exec, &kv, params);
+  }
+  mxtpu::cpp::WaitAll();
+
+  /* ---- pick the internal feature layer out of the trained graph */
+  Symbol internals = net.GetInternals();
+  std::vector<std::string> outs = internals.ListOutputs();
+  int feat_idx = -1;
+  for (size_t i = 0; i < outs.size(); ++i) {
+    if (outs[i] == "featrelu_output") feat_idx = (int)i;
+  }
+  if (feat_idx < 0) {
+    fprintf(stderr, "featrelu_output not in internals\n");
+    return 1;
+  }
+  Symbol feat_sym = internals.GetOutput((mx_uint)feat_idx);
+
+  /* ---- bind the feature executor, weights transferred by name */
+  Executor fexec(feat_sym, 1, 0, "null",
+                 {{"data", {N, C, EDGE, EDGE}}});
+  for (const auto &name : feat_sym.ListArguments()) {
+    if (name == "data") continue;
+    NDArray src = exec.Arg(name);
+    NDArray dst = fexec.Arg(name);
+    std::vector<float> buf(src.Size());
+    src.CopyTo(buf.data(), buf.size());
+    dst.CopyFrom(buf.data(), buf.size());
+  }
+  fexec.Arg("data").CopyFrom(images.data(), images.size());
+  fexec.Forward(false);
+  NDArray fout = fexec.Output(0);
+  std::vector<float> feats(fout.Size());
+  fout.CopyTo(feats.data(), feats.size());
+  const int dim = (int)(fout.Size() / N);
+  printf("FEATURE_DIM %d\n", dim);
+
+  /* ---- discriminativeness: labels cycle i%CLASSES, so i and
+   * i+CLASSES share a class, i and i+1 do not */
+  auto vec = [&](int i) {
+    return std::vector<float>(feats.begin() + (size_t)i * dim,
+                              feats.begin() + (size_t)(i + 1) * dim);
+  };
+  double same = 0, cross = 0;
+  int pairs = 0;
+  for (int i = 0; i + CLASSES + 1 < N; i += CLASSES) {
+    same += Cosine(vec(i), vec(i + CLASSES));
+    cross += Cosine(vec(i), vec(i + 1));
+    ++pairs;
+  }
+  same /= pairs;
+  cross /= pairs;
+  printf("SAME %.4f CROSS %.4f\n", same, cross);
+  if (!(same > cross)) {
+    fprintf(stderr, "features not discriminative\n");
+    return 1;
+  }
+  printf("FEATURES OK\n");
+  return 0;
+}

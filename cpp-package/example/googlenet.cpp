@@ -1,0 +1,117 @@
+/* GoogLeNet (inception-v1) in C++ through the generated op wrappers —
+ * the reference cpp-package/example/googlenet.cpp role: ConvFactory and
+ * InceptionFactory helpers composing 4-tower inception modules, global
+ * pooling head, trained with the executor + kvstore flow. Width scales
+ * down via CLI so the CI gate is fast while the structure stays
+ * inception.
+ *
+ * Usage: googlenet [epochs] [width_divisor] [lr]
+ * Prints "ACCURACY <frac>". */
+#include <cstdio>
+#include <cstdlib>
+#include <random>
+#include <string>
+
+#include "mxtpu-cpp/mxtpu_cpp.hpp"
+#include "mxtpu-cpp/op.h"
+#include "train_utils.hpp"
+
+using mxtpu::cpp::Executor;
+using mxtpu::cpp::KVStore;
+using mxtpu::cpp::Operator;
+using mxtpu::cpp::Shape;
+using mxtpu::cpp::Symbol;
+
+namespace op = mxtpu::cpp::op;
+
+enum { N = 128, C = 3, EDGE = 16, CLASSES = 4 };
+
+static Symbol ConvFactory(const std::string &name, const Symbol &data,
+                          int num_filter, const Shape &kernel,
+                          const std::string &pad) {
+  Symbol conv = op::Convolution("conv_" + name, data, Symbol(), Symbol(),
+                                kernel, num_filter, {{"pad", pad}});
+  return op::Activation("relu_" + name, conv, "relu");
+}
+
+/* 4 towers: 1x1 | 1x1->3x3 | 1x1->5x5 | pool->1x1, channel-concat */
+static Symbol InceptionFactory(const std::string &name, const Symbol &data,
+                               int n1x1, int n3x3r, int n3x3, int n5x5r,
+                               int n5x5, int npool) {
+  Symbol t1 = ConvFactory(name + "_1x1", data, n1x1, Shape(1, 1),
+                          "(0, 0,)");
+  Symbol t2r = ConvFactory(name + "_3x3r", data, n3x3r, Shape(1, 1),
+                           "(0, 0,)");
+  Symbol t2 = ConvFactory(name + "_3x3", t2r, n3x3, Shape(3, 3),
+                          "(1, 1,)");
+  Symbol t3r = ConvFactory(name + "_5x5r", data, n5x5r, Shape(1, 1),
+                           "(0, 0,)");
+  Symbol t3 = ConvFactory(name + "_5x5", t3r, n5x5, Shape(5, 5),
+                          "(2, 2,)");
+  Symbol p = op::Pooling(name + "_pool", data, {{"kernel", "(3, 3,)"},
+                                                {"stride", "(1, 1,)"},
+                                                {"pad", "(1, 1,)"},
+                                                {"pool_type", "max"}});
+  Symbol t4 = ConvFactory(name + "_poolproj", p, npool, Shape(1, 1),
+                          "(0, 0,)");
+  Operator cat("Concat");
+  cat.SetParam("num_args", 4);
+  cat.SetParam("dim", 1);
+  cat.AddInput(t1);
+  cat.AddInput(t2);
+  cat.AddInput(t3);
+  cat.AddInput(t4);
+  return cat.CreateSymbol(name + "_concat");
+}
+
+int main(int argc, char **argv) {
+  const int epochs = argc > 1 ? atoi(argv[1]) : 40;
+  const int d = argc > 2 ? atoi(argv[2]) : 4;
+  const float lr = argc > 3 ? (float)atof(argv[3]) : 0.05f;
+
+  /* stem + two inception modules + global-avg head (the full-size
+   * filter plan divided by d) */
+  Symbol data = Symbol::Variable("data");
+  Symbol stem = ConvFactory("stem", data, 64 / d, Shape(3, 3), "(1, 1,)");
+  Symbol p1 = op::Pooling("pool1", stem, {{"kernel", "(2, 2,)"},
+                                          {"stride", "(2, 2,)"},
+                                          {"pool_type", "max"}});
+  Symbol in3a = InceptionFactory("in3a", p1, 64 / d, 96 / d, 128 / d,
+                                 16 / (d / 2 ? d / 2 : 1), 32 / d, 32 / d);
+  Symbol in3b = InceptionFactory("in3b", in3a, 128 / d, 128 / d, 192 / d,
+                                 32 / d, 96 / d, 64 / d);
+  Symbol p2 = op::Pooling("pool2", in3b, {{"kernel", "(2, 2,)"},
+                                          {"stride", "(2, 2,)"},
+                                          {"pool_type", "max"}});
+  Symbol gap = op::Pooling("global_pool", p2, {{"kernel", "(1, 1,)"},
+                                               {"global_pool", "True"},
+                                               {"pool_type", "avg"}});
+  Symbol fl = op::Flatten("flatten", gap);
+  Symbol fc = op::FullyConnected("fc1", fl, Symbol(), Symbol(), CLASSES);
+  Symbol net = op::SoftmaxOutput("softmax", fc, Symbol());
+
+  std::mt19937 rng(13);
+  std::vector<float> images, labels;
+  extrain::QuadrantData(N, C, EDGE, CLASSES, &rng, &images, &labels);
+
+  Executor exec(net, 1, 0, "write",
+                {{"data", {N, C, EDGE, EDGE}}, {"softmax_label", {N}}});
+  std::vector<std::string> params = extrain::InitParams(
+      &exec, net, {"data", "softmax_label"}, &rng);
+  exec.Arg("data").CopyFrom(images.data(), images.size());
+  exec.Arg("softmax_label").CopyFrom(labels.data(), labels.size());
+
+  KVStore kv("local");
+  kv.SetOptimizer("sgd", lr, 0.0f, 0.9f, 1.0f / N);
+  for (const auto &name : params) {
+    mxtpu::cpp::NDArray w = exec.Arg(name);
+    kv.Init(name, w);
+  }
+  for (int e = 0; e < epochs; ++e) {
+    extrain::Step(&exec, &kv, params);
+  }
+  mxtpu::cpp::WaitAll();
+  printf("ACCURACY %.4f\n",
+         extrain::Accuracy(&exec, labels, N, CLASSES));
+  return 0;
+}

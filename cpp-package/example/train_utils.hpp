@@ -1,0 +1,118 @@
+/* Shared scaffolding for the cpp-package example programs (the role the
+ * reference cpp-package examples repeat inline: param init, the
+ * kvstore-sgd epoch loop, argmax accuracy). Keeps each example focused
+ * on its network topology. */
+#ifndef MXTPU_CPP_EXAMPLE_TRAIN_UTILS_HPP_
+#define MXTPU_CPP_EXAMPLE_TRAIN_UTILS_HPP_
+
+#include <cmath>
+#include <random>
+#include <string>
+#include <vector>
+
+#include "mxtpu-cpp/mxtpu_cpp.hpp"
+
+namespace extrain {
+
+using mxtpu::cpp::Executor;
+using mxtpu::cpp::KVStore;
+using mxtpu::cpp::NDArray;
+using mxtpu::cpp::Symbol;
+
+/* Xavier-style init (factor=fan_in, the mx.initializer.Xavier formula):
+ * weights ~ uniform(+-sqrt(magnitude/fan_in)); 1-d args are biases (0)
+ * except BatchNorm gammas (1, zeros would kill the signal). Flat
+ * uniform stalls deep relu stacks — the init must scale per layer. */
+inline std::vector<std::string> InitParams(
+    Executor *exec, const Symbol &net,
+    const std::vector<std::string> &inputs, std::mt19937 *rng,
+    float magnitude = 2.34f) {
+  std::vector<std::string> params;
+  for (const auto &name : net.ListArguments()) {
+    bool is_input = false;
+    for (const auto &in : inputs) {
+      if (name == in) {
+        is_input = true;
+        break;
+      }
+    }
+    if (is_input) continue;
+    params.push_back(name);
+    NDArray arr = exec->Arg(name);
+    std::vector<mx_uint> shape = arr.Shape();
+    std::vector<float> buf(arr.Size());
+    if (shape.size() < 2) {
+      bool is_gamma = name.find("gamma") != std::string::npos;
+      for (auto &v : buf) v = is_gamma ? 1.0f : 0.0f;
+    } else {
+      float fan_in = 1.0f;
+      for (size_t d = 1; d < shape.size(); ++d) fan_in *= shape[d];
+      float scale = std::sqrt(magnitude / fan_in);
+      std::uniform_real_distribution<float> uni(-scale, scale);
+      for (auto &v : buf) v = uni(*rng);
+    }
+    arr.CopyFrom(buf.data(), buf.size());
+  }
+  return params;
+}
+
+/* one epoch: fwd, bwd, push grads / pull weights through the kvstore */
+inline void Step(Executor *exec, KVStore *kv,
+                 const std::vector<std::string> &params) {
+  exec->Forward(true);
+  exec->Backward();
+  for (const auto &name : params) {
+    NDArray g = exec->Grad(name);
+    NDArray w = exec->Arg(name);
+    kv->Push(name, g);
+    kv->Pull(name, &w);
+  }
+}
+
+/* argmax accuracy of output 0 against float labels */
+inline double Accuracy(Executor *exec, const std::vector<float> &labels,
+                       int n, int classes) {
+  exec->Forward(false);
+  NDArray out = exec->Output(0);
+  std::vector<float> probs(out.Size());
+  out.CopyTo(probs.data(), probs.size());
+  int correct = 0;
+  for (int i = 0; i < n; ++i) {
+    int best = 0;
+    for (int k = 1; k < classes; ++k) {
+      if (probs[i * classes + k] > probs[i * classes + best]) best = k;
+    }
+    if (best == (int)labels[i]) ++correct;
+  }
+  return (double)correct / n;
+}
+
+/* brightest-quadrant synthetic images: conv-learnable, not linear */
+inline void QuadrantData(int n, int channels, int edge, int classes,
+                         std::mt19937 *rng, std::vector<float> *images,
+                         std::vector<float> *labels) {
+  std::normal_distribution<float> noise(0.f, 0.3f);
+  images->assign((size_t)n * channels * edge * edge, 0.f);
+  labels->assign(n, 0.f);
+  int half = edge / 2;
+  for (int i = 0; i < n; ++i) {
+    int k = i % classes;
+    (*labels)[i] = (float)k;
+    int r0 = (k / 2) * half, c0 = (k % 2) * half;
+    for (int ch = 0; ch < channels; ++ch) {
+      for (int r = 0; r < edge; ++r) {
+        for (int c = 0; c < edge; ++c) {
+          float v = noise(*rng);
+          if (r >= r0 && r < r0 + half && c >= c0 && c < c0 + half) {
+            v += 1.0f;
+          }
+          (*images)[(((size_t)i * channels + ch) * edge + r) * edge + c] = v;
+        }
+      }
+    }
+  }
+}
+
+}  // namespace extrain
+
+#endif  // MXTPU_CPP_EXAMPLE_TRAIN_UTILS_HPP_

@@ -127,6 +127,20 @@ class Symbol {
     Check(MXSymbolSaveToJSON(h_, &js), "SaveToJSON");
     return std::string(js);
   }
+  /* i-th output of a multi-output symbol (SliceChannel gates etc.) */
+  Symbol GetOutput(mx_uint i) const {
+    SymbolHandle out;
+    Check(MXSymbolGetOutput(h_, i, &out), "GetOutput");
+    return Symbol(out);
+  }
+  Symbol operator[](int i) const { return GetOutput((mx_uint)i); }
+  /* every internal node as an output — the feature-extraction seam
+   * (reference cpp-package feature_extract flow) */
+  Symbol GetInternals() const {
+    SymbolHandle out;
+    Check(MXSymbolGetInternals(h_, &out), "GetInternals");
+    return Symbol(out);
+  }
   SymbolHandle handle() const { return h_; }
 
  private:

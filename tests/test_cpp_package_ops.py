@@ -65,3 +65,67 @@ def test_cpp_conv_train(tmp_path):
     acc = float([l for l in out.stdout.splitlines()
                  if "ACCURACY" in l][0].split()[1])
     assert acc > 0.9, "C++ conv training reached only %.3f" % acc
+
+
+def _cc_example(tmp_path, name):
+    exe = str(tmp_path / name)
+    src = os.path.join(REPO, "cpp-package", "example", name + ".cpp")
+    r = subprocess.run(
+        ["g++", "-std=c++17",
+         "-I", os.path.join(REPO, "cpp-package", "include"),
+         "-I", os.path.join(REPO, "cpp-package", "example"),
+         "-I", os.path.join(REPO, "src", "capi"), src, "-o", exe,
+         "-L", os.path.dirname(CAPI_SO), "-lmxtpu_capi",
+         "-Wl,-rpath," + os.path.dirname(CAPI_SO)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    return exe
+
+
+def _run_example(exe, args=()):
+    out = subprocess.run(
+        [exe] + list(args), capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 PYTHONPATH=REPO), timeout=900)
+    assert out.returncode == 0, out.stdout + out.stderr
+    return out.stdout
+
+
+def _accuracy_of(stdout):
+    line = [ln for ln in stdout.splitlines() if "ACCURACY" in ln][0]
+    return float(line.split()[1])
+
+
+@pytest.mark.parametrize("name,floor", [("alexnet", 0.9),
+                                        ("googlenet", 0.9)])
+def test_cpp_example_convnets(tmp_path, name, floor):
+    """Reference cpp-package conv examples (alexnet.cpp, googlenet.cpp):
+    the full topologies composed through the generated op surface train
+    on the quadrant task."""
+    ok, log = _build()
+    if not ok:
+        pytest.skip("libmxtpu_capi.so did not build: %s" % log[-400:])
+    acc = _accuracy_of(_run_example(_cc_example(tmp_path, name)))
+    assert acc > floor, "%s reached only %.3f" % (name, acc)
+
+
+def test_cpp_example_char_rnn(tmp_path):
+    """Reference charRNN.cpp: primitive-op LSTM LM unrolled with shared
+    weights learns next-char prediction and greedy-samples text."""
+    ok, log = _build()
+    if not ok:
+        pytest.skip("libmxtpu_capi.so did not build: %s" % log[-400:])
+    out = _run_example(_cc_example(tmp_path, "char_rnn"))
+    assert _accuracy_of(out) > 0.8, out
+    sample = [ln for ln in out.splitlines() if ln.startswith("SAMPLE ")][0]
+    assert len(sample.split(" ", 1)[1]) >= 20, out
+
+
+def test_cpp_example_feature_extract(tmp_path):
+    """Reference feature_extract flow: internal layer bound via
+    GetInternals, weights transferred by name, features discriminative."""
+    ok, log = _build()
+    if not ok:
+        pytest.skip("libmxtpu_capi.so did not build: %s" % log[-400:])
+    out = _run_example(_cc_example(tmp_path, "feature_extract"))
+    assert "FEATURES OK" in out, out
