@@ -40,27 +40,55 @@ import numpy as _np
 from .base import MXNetError
 
 _HDR = struct.Struct("<Q")
+# binary tensor framing: [payload_len][n_buffers][buf_len...] then the
+# pickle-5 payload, then each raw buffer. Tensor bytes travel OUT OF BAND
+# (pickle protocol 5 buffer_callback) — never copied into the pickle
+# stream — and land in preallocated buffers via recv_into on the other
+# side. This is the ps-lite zero-copy ZPush/ZPull role: the pickled
+# envelope stays tiny (op name, key, dtype, shape) while gradient-sized
+# payloads move as raw scatter/gather bytes.
+_FRAME = struct.Struct("<QI")
 
 
 def _send_msg(sock, obj):
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_HDR.pack(len(payload)) + payload)
+    bufs = []
+    payload = pickle.dumps(obj, protocol=5, buffer_callback=bufs.append)
+    raws = [b.raw() for b in bufs]
+    head = [_FRAME.pack(len(payload), len(raws))]
+    head += [_HDR.pack(r.nbytes) for r in raws]
+    head.append(payload)
+    sock.sendall(b"".join(head))
+    for r in raws:
+        sock.sendall(r)
+
+
+def _recv_into(sock, view):
+    n = len(view)
+    off = 0
+    while off < n:
+        got = sock.recv_into(view[off:], n - off)
+        if got == 0:
+            raise ConnectionError("kvstore peer closed")
+        off += got
 
 
 def _recv_exact(sock, n):
-    chunks = []
-    while n:
-        b = sock.recv(min(n, 1 << 20))
-        if not b:
-            raise ConnectionError("kvstore peer closed")
-        chunks.append(b)
-        n -= len(b)
-    return b"".join(chunks)
+    buf = bytearray(n)
+    _recv_into(sock, memoryview(buf))
+    return bytes(buf)
 
 
 def _recv_msg(sock):
-    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
-    return pickle.loads(_recv_exact(sock, n))
+    payload_len, nbuf = _FRAME.unpack(_recv_exact(sock, _FRAME.size))
+    sizes = [_HDR.unpack(_recv_exact(sock, _HDR.size))[0]
+             for _ in range(nbuf)]
+    payload = _recv_exact(sock, payload_len)
+    bufs = []
+    for sz in sizes:
+        b = bytearray(sz)
+        _recv_into(sock, memoryview(b))
+        bufs.append(b)
+    return pickle.loads(payload, buffers=bufs)
 
 
 class KVServer:
@@ -99,6 +127,10 @@ class KVServer:
             while not self._stop:
                 try:
                     conn, _ = self.sock.accept()
+                    # control messages (BARRIER/HEARTBEAT) are latency-
+                    # sensitive; don't let Nagle batch them
+                    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY,
+                                    1)
                 except OSError:
                     break
                 t = threading.Thread(target=self._serve_conn, args=(conn,),
@@ -310,6 +342,8 @@ class KVClient:
             try:
                 self._sock = socket.create_connection((uri, int(port)),
                                                       timeout=120)
+                self._sock.setsockopt(socket.IPPROTO_TCP,
+                                      socket.TCP_NODELAY, 1)
                 break
             except (ConnectionRefusedError, socket.timeout, OSError):
                 if time.monotonic() >= deadline:

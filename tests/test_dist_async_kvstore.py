@@ -105,3 +105,38 @@ def test_two_process_dist_async(tmp_path):
     # rank 0 observed rank 1's three async steps without pushing
     r0 = [l for l in outs[0].splitlines() if l.startswith("RANK0")]
     assert r0 and "-0.3" in r0[0], outs[0]
+
+
+def test_transport_bandwidth_at_gradient_sizes():
+    """Binary out-of-band framing (docs/dist_async_transport.md): a
+    64 MB tensor round-trips correctly and the loopback rate clears a
+    conservative floor — an accidental extra copy in the framing would
+    halve it and fail here."""
+    import time
+
+    import numpy as np
+
+    from mxtpu.kvstore_server import KVClient, KVServer
+
+    server = KVServer(0, num_workers=1)
+    server.run_in_thread()
+    client = KVClient("127.0.0.1", server.port)
+    arr = np.random.RandomState(0).rand(8 << 20)  # 64 MB float64
+    client.init("g", arr, rank=0)
+    client.push("g", arr)  # no updater: merged value is assigned
+    np.testing.assert_array_equal(client.pull("g"), arr)
+    reps = 4
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        client.push("g", arr)
+    push_rate = arr.nbytes * reps / (time.perf_counter() - t0) / 1e6
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = client.pull("g")
+    pull_rate = arr.nbytes * reps / (time.perf_counter() - t0) / 1e6
+    client.stop()
+    assert out.nbytes == arr.nbytes
+    # measured ~440/~1000 MB/s on the build machine; floor leaves 4x
+    # headroom for slow CI hosts
+    assert push_rate > 100, "push transport regressed: %.0f MB/s" % push_rate
+    assert pull_rate > 150, "pull transport regressed: %.0f MB/s" % pull_rate

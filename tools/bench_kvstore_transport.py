@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""dist_async/dist_sync transport bandwidth at gradient sizes.
+
+Measures KVClient->KVServer push and pull throughput over loopback TCP
+for tensors from 4 MB to 256 MB (ResNet-50's full gradient set is
+~100 MB fp32), with the binary out-of-band framing in
+mxtpu/kvstore_server.py. Loopback removes the NIC from the picture, so
+the number is the TRANSPORT STACK's ceiling (framing + pickle envelope +
+memcpy) — the part the framework owns; wire bandwidth then caps whichever
+is lower on a real cluster.
+
+Run: PYTHONPATH=. JAX_PLATFORMS=cpu python tools/bench_kvstore_transport.py
+Prints one JSON line; committed numbers live in
+docs/dist_async_transport.md.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxtpu.kvstore_server import KVClient, KVServer  # noqa: E402
+
+
+def bench_size(client, nbytes, reps):
+    arr = np.random.RandomState(0).rand(nbytes // 8).astype(np.float64)
+    key = "k%d" % nbytes
+    client.init(key, arr, rank=0)
+    # warm
+    client.push(key, arr)
+    client.pull(key)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        client.push(key, arr)
+    push_dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = client.pull(key)
+    pull_dt = time.perf_counter() - t0
+    assert out.nbytes == arr.nbytes
+    mb = nbytes / 1e6
+    return {"size_mb": round(mb, 1),
+            "push_MBps": round(mb * reps / push_dt, 1),
+            "pull_MBps": round(mb * reps / pull_dt, 1)}
+
+
+def main():
+    server = KVServer(0, num_workers=1)
+    server.run_in_thread()
+    client = KVClient("127.0.0.1", server.port)
+    rows = []
+    for nbytes, reps in [(4 << 20, 20), (64 << 20, 6), (256 << 20, 3)]:
+        rows.append(bench_size(client, nbytes, reps))
+    client.stop()
+    print(json.dumps({"metric": "kvstore_transport_loopback",
+                      "framing": "pickle5 out-of-band + recv_into",
+                      "rows": rows}))
+
+
+if __name__ == "__main__":
+    main()
