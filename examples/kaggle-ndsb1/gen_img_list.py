@@ -1,0 +1,88 @@
+"""Generate .lst image lists from a class-per-subfolder tree (parity:
+example/kaggle-ndsb1/gen_img_list.py — walk data/train/<class>/*.jpg,
+assign integer labels in sorted class order, optionally split into
+stratified tr/va lists).
+
+Run: python gen_img_list.py --image-folder data/train --out-folder data \
+        --train [--percent-val 0.25] [--stratified]
+Then pack with tools/im2rec.py and train with train_dsb.py.
+"""
+import argparse
+import os
+import random
+
+
+def list_classes(folder):
+    return sorted(d for d in os.listdir(folder)
+                  if os.path.isdir(os.path.join(folder, d)))
+
+
+def build_list(image_folder, train):
+    """[(idx, label, relpath)] + class names (label order)."""
+    items = []
+    if train:
+        classes = list_classes(image_folder)
+        for li, cls in enumerate(classes):
+            sub = os.path.join(image_folder, cls)
+            for img in sorted(os.listdir(sub)):
+                items.append((len(items), li, os.path.join(cls, img)))
+    else:
+        classes = []
+        for img in sorted(os.listdir(image_folder)):
+            items.append((len(items), 0, img))
+    return items, classes
+
+
+def write_lst(path, items):
+    with open(path, "w") as f:
+        for idx, label, rel in items:
+            f.write("%d\t%f\t%s\n" % (idx, float(label), rel))
+
+
+def split(items, percent_val, stratified, rng):
+    if not stratified:
+        items = list(items)
+        rng.shuffle(items)
+        n_va = int(len(items) * percent_val)
+        return items[n_va:], items[:n_va]
+    by_cls = {}
+    for it in items:
+        by_cls.setdefault(it[1], []).append(it)
+    tr, va = [], []
+    for cls in sorted(by_cls):
+        group = by_cls[cls]
+        rng.shuffle(group)
+        n_va = int(len(group) * percent_val)
+        va += group[:n_va]
+        tr += group[n_va:]
+    rng.shuffle(tr)
+    rng.shuffle(va)
+    return tr, va
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--image-folder", default="data/train")
+    ap.add_argument("--out-folder", default="data")
+    ap.add_argument("--out-file", default="train.lst")
+    ap.add_argument("--train", action="store_true")
+    ap.add_argument("--percent-val", type=float, default=0.25)
+    ap.add_argument("--stratified", action="store_true")
+    ap.add_argument("--seed", type=int, default=888)
+    args = ap.parse_args(argv)
+    rng = random.Random(args.seed)
+
+    items, classes = build_list(args.image_folder, args.train)
+    os.makedirs(args.out_folder, exist_ok=True)
+    write_lst(os.path.join(args.out_folder, args.out_file), items)
+    if args.train:
+        tr, va = split(items, args.percent_val, args.stratified, rng)
+        write_lst(os.path.join(args.out_folder, "tr.lst"), tr)
+        write_lst(os.path.join(args.out_folder, "va.lst"), va)
+        with open(os.path.join(args.out_folder, "classes.txt"), "w") as f:
+            f.write("\n".join(classes) + "\n")
+    return len(items), classes
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Predict class probabilities for the test .rec (parity:
+example/kaggle-ndsb1/predict_dsb.py — load the checkpoint, run the test
+set, dump a probabilities matrix aligned with the test .lst order).
+
+Run: python predict_dsb.py --model-prefix models/dsb --epoch 40 \
+        --test-rec data48/test.rec --num-classes 121 --out probs.npy
+"""
+import argparse
+
+import numpy as np
+
+import mxtpu as mx
+
+
+def predict(model_prefix, epoch, test_rec, num_classes, edge, batch_size):
+    sym, arg_params, aux_params = mx.model.load_checkpoint(model_prefix,
+                                                           epoch)
+    it = mx.io.ImageRecordIter(path_imgrec=test_rec,
+                               data_shape=(3, edge, edge),
+                               batch_size=batch_size, round_batch=True,
+                               scale=1.0 / 255)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=False)
+    mod.set_params(arg_params, aux_params)
+    probs = []
+    n_real = 0
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        out = mod.get_outputs()[0].asnumpy()
+        keep = batch.data[0].shape[0] - batch.pad
+        probs.append(out[:keep])
+        n_real += keep
+    return np.concatenate(probs, axis=0)[:, :num_classes]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-prefix", required=True)
+    ap.add_argument("--epoch", type=int, required=True)
+    ap.add_argument("--test-rec", required=True)
+    ap.add_argument("--num-classes", type=int, required=True)
+    ap.add_argument("--edge", type=int, default=48)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--out", default="probs.npy")
+    args = ap.parse_args(argv)
+    probs = predict(args.model_prefix, args.epoch, args.test_rec,
+                    args.num_classes, args.edge, args.batch_size)
+    np.save(args.out, probs)
+    print("wrote %s %s" % (args.out, probs.shape))
+    return probs
+
+
+if __name__ == "__main__":
+    main()

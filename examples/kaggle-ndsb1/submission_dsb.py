@@ -1,0 +1,47 @@
+"""Format a Kaggle NDSB-1 submission csv (parity:
+example/kaggle-ndsb1/submission_dsb.py — image,<121 class probs> rows,
+clipped and renormalized).
+
+Run: python submission_dsb.py --probs probs.npy --test-lst data/test.lst \
+        --classes data/classes.txt --out submission.csv
+"""
+import argparse
+import csv
+import os
+
+import numpy as np
+
+
+def write_submission(probs, image_names, class_names, out_path,
+                     clip=1e-15):
+    probs = np.clip(np.asarray(probs, dtype=np.float64), clip, 1.0)
+    probs /= probs.sum(axis=1, keepdims=True)
+    with open(out_path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["image"] + list(class_names))
+        for name, row in zip(image_names, probs):
+            w.writerow([name] + ["%.6f" % p for p in row])
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--probs", required=True)
+    ap.add_argument("--test-lst", required=True)
+    ap.add_argument("--classes", required=True)
+    ap.add_argument("--out", default="submission.csv")
+    args = ap.parse_args(argv)
+    probs = np.load(args.probs)
+    with open(args.classes) as f:
+        class_names = [ln.strip() for ln in f if ln.strip()]
+    names = []
+    with open(args.test_lst) as f:
+        for ln in f:
+            parts = ln.rstrip("\n").split("\t")
+            if parts and parts[-1]:
+                names.append(os.path.basename(parts[-1]))
+    write_submission(probs[:len(names)], names, class_names, args.out)
+    print("wrote %s (%d rows)" % (args.out, len(names)))
+
+
+if __name__ == "__main__":
+    main()

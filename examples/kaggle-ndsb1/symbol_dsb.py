@@ -1,0 +1,30 @@
+"""The NDSB-1 conv net (parity: example/kaggle-ndsb1/symbol_dsb.py —
+three conv/pool stages + two fc, softmax head), width parameterized so
+the CI gate trains in seconds at small scale."""
+import mxtpu as mx
+
+
+def get_symbol(num_classes, width=1.0):
+    w = lambda n: max(4, int(n * width))  # noqa: E731
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), pad=(1, 1),
+                             num_filter=w(32), name="conv1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Convolution(net, kernel=(3, 3), pad=(1, 1),
+                             num_filter=w(64), name="conv2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Convolution(net, kernel=(3, 3), pad=(1, 1),
+                             num_filter=w(128), name="conv3")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=w(256), name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Dropout(net, p=0.5)
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")

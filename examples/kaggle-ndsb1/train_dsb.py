@@ -1,0 +1,66 @@
+"""Train the NDSB-1 net on packed .rec files (parity:
+example/kaggle-ndsb1/train_dsb.py — ImageRecordIter over tr.rec/va.rec,
+Module.fit with checkpoints).
+
+Run after gen_img_list.py + tools/im2rec.py:
+    python train_dsb.py --data-dir data48 --num-classes 121 \
+        --num-epochs 40 --model-prefix models/dsb
+"""
+import argparse
+import logging
+import os
+
+import mxtpu as mx
+
+import symbol_dsb
+
+
+def get_iters(data_dir, edge, batch_size):
+    train = mx.io.ImageRecordIter(
+        path_imgrec=os.path.join(data_dir, "tr.rec"),
+        data_shape=(3, edge, edge), batch_size=batch_size,
+        shuffle=True, rand_crop=True, rand_mirror=True, scale=1.0 / 255)
+    val = mx.io.ImageRecordIter(
+        path_imgrec=os.path.join(data_dir, "va.rec"),
+        data_shape=(3, edge, edge), batch_size=batch_size,
+        scale=1.0 / 255)
+    return train, val
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", default="data48")
+    ap.add_argument("--num-classes", type=int, required=True)
+    ap.add_argument("--edge", type=int, default=48)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=40)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--optimizer", default="sgd")
+    ap.add_argument("--width", type=float, default=1.0)
+    ap.add_argument("--model-prefix", default=None)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    net = symbol_dsb.get_symbol(args.num_classes, width=args.width)
+    train, val = get_iters(args.data_dir, args.edge, args.batch_size)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    cb = (mx.callback.do_checkpoint(args.model_prefix)
+          if args.model_prefix else None)
+    opt_params = {"learning_rate": args.lr, "wd": 1e-4,
+                  "rescale_grad": 1.0 / args.batch_size}
+    if args.optimizer == "sgd":
+        opt_params["momentum"] = 0.9
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer=args.optimizer,
+            optimizer_params=opt_params,
+            initializer=mx.initializer.Xavier(factor_type="in",
+                                              magnitude=2.34),
+            epoch_end_callback=cb)
+    val.reset()
+    acc = dict(mod.score(val, mx.metric.Accuracy()))["accuracy"]
+    print("val-accuracy %.4f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

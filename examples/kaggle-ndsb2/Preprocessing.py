@@ -1,0 +1,93 @@
+"""Preprocess cardiac frame stacks into CSV tensors (parity:
+example/kaggle-ndsb2/Preprocessing.py — the reference walks DICOM SAX
+series, resizes each study's 30 frames to 64x64, and writes one
+data-csv row per study plus a label csv; here the input is a directory
+of per-study frame images, since DICOM readers aren't part of this
+image, and the tensor/CSV contract is identical).
+
+Layout:  <root>/<study_id>/frame_00.png ... frame_NN.png
+         <root>/labels.csv  rows: study_id,systole,diastole
+
+Run: python Preprocessing.py --root data/train --out-prefix train \
+        --frames 30 --edge 64
+Writes train-<edge>x<edge>-data.csv + train-label.csv, the files
+Train.py consumes.
+"""
+import argparse
+import csv
+import os
+
+import numpy as np
+
+
+def load_study(path, frames, edge):
+    import cv2
+
+    names = sorted(os.listdir(path))[:frames]
+    stack = []
+    for n in names:
+        img = cv2.imread(os.path.join(path, n), cv2.IMREAD_GRAYSCALE)
+        if img.shape != (edge, edge):
+            img = cv2.resize(img, (edge, edge))
+        stack.append(img.astype(np.float32))
+    while len(stack) < frames:  # short series wrap-pad like the reference
+        stack.append(stack[len(stack) % max(len(stack), 1)])
+    return np.stack(stack)  # (frames, edge, edge)
+
+
+def write_data_csv(root, out_prefix, frames, edge):
+    labels = {}
+    with open(os.path.join(root, "labels.csv")) as f:
+        for row in csv.reader(f):
+            if row and row[0] != "Id":
+                labels[row[0]] = (float(row[1]), float(row[2]))
+    studies = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    data_path = "%s-%dx%d-data.csv" % (out_prefix, edge, edge)
+    label_path = "%s-label.csv" % out_prefix
+    with open(data_path, "w") as df, open(label_path, "w") as lf:
+        for sid in studies:
+            stack = load_study(os.path.join(root, sid), frames, edge)
+            df.write(",".join("%g" % v for v in stack.reshape(-1)) + "\n")
+            sys_v, dia_v = labels[sid]
+            lf.write("%s,%g,%g\n" % (sid, sys_v, dia_v))
+    return data_path, label_path
+
+
+def encode_label(label_data, dim=600):
+    """volume -> CDF step target: target[j] = 1[volume < j]."""
+    systole = label_data[:, 1]
+    diastole = label_data[:, 2]
+    grid = np.arange(dim)
+    systole_encode = np.array([(x < grid) for x in systole], np.uint8)
+    diastole_encode = np.array([(x < grid) for x in diastole], np.uint8)
+    return systole_encode, diastole_encode
+
+
+def encode_csv(label_csv, systole_csv, diastole_csv, dim=600):
+    rows = []
+    with open(label_csv) as f:
+        for row in csv.reader(f):
+            rows.append([0.0, float(row[1]), float(row[2])])
+    systole_encode, diastole_encode = encode_label(np.asarray(rows), dim)
+    np.savetxt(systole_csv, systole_encode, delimiter=",", fmt="%g")
+    np.savetxt(diastole_csv, diastole_encode, delimiter=",", fmt="%g")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", required=True)
+    ap.add_argument("--out-prefix", required=True)
+    ap.add_argument("--frames", type=int, default=30)
+    ap.add_argument("--edge", type=int, default=64)
+    ap.add_argument("--cdf-dim", type=int, default=600)
+    args = ap.parse_args(argv)
+    data_path, label_path = write_data_csv(args.root, args.out_prefix,
+                                           args.frames, args.edge)
+    encode_csv(label_path, args.out_prefix + "-systole.csv",
+               args.out_prefix + "-diastole.csv", args.cdf_dim)
+    print("wrote %s, %s, encoded CDF targets" % (data_path, label_path))
+
+
+if __name__ == "__main__":
+    main()

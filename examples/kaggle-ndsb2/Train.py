@@ -1,0 +1,89 @@
+"""Train the NDSB-2 heart-volume nets (parity:
+example/kaggle-ndsb2/Train.py — frame-difference LeNet over the
+30-frame stack, 600-way CDF target through LogisticRegressionOutput,
+CSVIter input, CRPS metric, one net for systole and one for diastole).
+
+Run after Preprocessing.py:
+    python Train.py --data-prefix train --frames 30 --edge 64
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxtpu as mx
+
+
+def get_lenet(frames, cdf_dim, num_filter=40):
+    """Frame-difference LeNet: consecutive-frame diffs -> conv stack ->
+    CDF logits (the reference's get_lenet)."""
+    source = mx.sym.Variable("data")
+    source = (source - 128) * (1.0 / 128)
+    fr = mx.sym.SliceChannel(source, num_outputs=frames)
+    diffs = [fr[i + 1] - fr[i] for i in range(frames - 1)]
+    source = mx.sym.Concat(*diffs)
+    net = mx.sym.Convolution(source, kernel=(5, 5), num_filter=num_filter)
+    net = mx.sym.BatchNorm(net, fix_gamma=True)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2),
+                         stride=(2, 2))
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=num_filter)
+    net = mx.sym.BatchNorm(net, fix_gamma=True)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2),
+                         stride=(2, 2))
+    flatten = mx.sym.Flatten(net)
+    flatten = mx.sym.Dropout(flatten)
+    fc1 = mx.sym.FullyConnected(flatten, num_hidden=cdf_dim)
+    # named softmax so the CSVIter's default label name matches
+    return mx.sym.LogisticRegressionOutput(fc1, name="softmax")
+
+
+def CRPS(label, pred):
+    """Continuous Ranked Probability Score over the CDF encoding, with
+    the monotonicity projection the reference applies."""
+    pred = np.array(pred, copy=True)
+    for i in range(pred.shape[0]):
+        for j in range(pred.shape[1] - 1):
+            if pred[i, j] > pred[i, j + 1]:
+                pred[i, j + 1] = pred[i, j]
+    return np.sum(np.square(label - pred)) / label.size
+
+
+def train_one(target, args):
+    network = get_lenet(args.frames, args.cdf_dim, args.num_filter)
+    data_train = mx.io.CSVIter(
+        data_csv="%s-%dx%d-data.csv" % (args.data_prefix, args.edge,
+                                        args.edge),
+        data_shape=(args.frames, args.edge, args.edge),
+        label_csv="%s-%s.csv" % (args.data_prefix, target),
+        label_shape=(args.cdf_dim,), batch_size=args.batch_size)
+    model = mx.model.FeedForward(
+        ctx=mx.cpu(), symbol=network, num_epoch=args.num_epochs,
+        learning_rate=args.lr, wd=0.00001, momentum=0.9)
+    model.fit(X=data_train, eval_metric=mx.metric.np(CRPS))
+    data_train.reset()
+    score = model.score(data_train, eval_metric=mx.metric.np(CRPS))
+    print("%s train-CRPS %.4f" % (target, score))
+    return model, score
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-prefix", default="train")
+    ap.add_argument("--frames", type=int, default=30)
+    ap.add_argument("--edge", type=int, default=64)
+    ap.add_argument("--cdf-dim", type=int, default=600)
+    ap.add_argument("--num-filter", type=int, default=40)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-epochs", type=int, default=65)
+    ap.add_argument("--lr", type=float, default=0.001)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    _, sys_score = train_one("systole", args)
+    _, dia_score = train_one("diastole", args)
+    return sys_score, dia_score
+
+
+if __name__ == "__main__":
+    main()

@@ -1413,3 +1413,57 @@ def executor_set_monitor_callback(h, cb_addr):
 
     ex._monitor_callback = monitor
     return 0
+
+
+# ------------------------------------------------------------------ Rtc
+# String-source runtime compilation through the C ABI (reference
+# include/mxnet/c_api.h:1880 MXRtcCreate compiles CUDA C via NVRTC). The
+# TPU kernel language here is jax/pallas Python: the kernel string is the
+# BODY of a function whose declared input names are in scope as jax
+# arrays and which must assign every declared output name; the body is
+# compiled once via jax.jit (XLA) — or define pallas kernels inside it.
+
+class _RtcEntry:
+    def __init__(self, name, input_names, output_names, fn):
+        self.name = name
+        self.input_names = input_names
+        self.output_names = output_names
+        self.fn = fn
+
+
+def rtc_create(name, input_names, output_names, kernel_src):
+    import jax
+    import jax.numpy as jnp
+
+    input_names = [str(n) for n in input_names]
+    output_names = [str(n) for n in output_names]
+    code = compile(str(kernel_src), "<mxrtc:%s>" % name, "exec")
+    glb = {"jax": jax, "jnp": jnp, "np": jnp}
+
+    def fn(*args):
+        local = dict(zip(input_names, args))
+        exec(code, dict(glb), local)
+        missing = [o for o in output_names if o not in local]
+        if missing:
+            raise RuntimeError(
+                "rtc kernel %s did not assign outputs %s" % (name, missing))
+        return tuple(local[o] for o in output_names)
+
+    return _register(_RtcEntry(name, input_names, output_names,
+                               jax.jit(fn)))
+
+
+def rtc_push(h, in_handles, out_handles):
+    entry = _get(h)
+    if len(in_handles) != len(entry.input_names):
+        raise RuntimeError("rtc %s takes %d inputs, got %d"
+                           % (entry.name, len(entry.input_names),
+                              len(in_handles)))
+    args = [getattr(_get(i), "_data", _get(i)) for i in in_handles]
+    res = entry.fn(*args)
+    if len(out_handles) != len(res):
+        raise RuntimeError("rtc %s produces %d outputs, got %d handles"
+                           % (entry.name, len(res), len(out_handles)))
+    for oh, r in zip(out_handles, res):
+        _get(oh)[:] = r
+    return 0

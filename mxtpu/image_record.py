@@ -169,8 +169,17 @@ class ImageRecordIter(_io.DataIter):
                  preprocess_threads=4, prefetch_buffer=4, seed=0,
                  num_parts=1, part_index=0, round_batch=True,
                  data_name="data", label_name="softmax_label",
-                 aug_list=None, **kwargs):
+                 aug_list=None, dtype="float32", **kwargs):
         super().__init__(batch_size)
+        # uint8 variant (parity ImageRecordUInt8Iter,
+        # iter_image_recordio_2.cc:602): raw decoded pixels, no float
+        # normalization — callers normalize on-device where it's free
+        self._dtype = _np.dtype(dtype)
+        if self._dtype == _np.uint8 and (
+                any((mean_r, mean_g, mean_b, std_r, std_g, std_b))
+                or mean_img is not None or scale != 1.0):
+            raise MXNetError("ImageRecordUInt8Iter yields raw uint8 "
+                             "pixels; mean/std/scale do not apply")
         self.data_shape = tuple(int(x) for x in data_shape)
         self.label_width = int(label_width)
         self.shuffle = shuffle
@@ -232,7 +241,8 @@ class ImageRecordIter(_io.DataIter):
         self._pool = ThreadPoolExecutor(max_workers=int(preprocess_threads))
         self._prefetch_n = int(prefetch_buffer)
         self.provide_data = [_io.DataDesc(data_name,
-                                          (batch_size,) + self.data_shape)]
+                                          (batch_size,) + self.data_shape,
+                                          dtype=self._dtype)]
         if self.label_width > 1:
             self.provide_label = [_io.DataDesc(
                 label_name, (batch_size, self.label_width))]
@@ -326,7 +336,7 @@ class ImageRecordIter(_io.DataIter):
         if pad and not self.round_batch:
             return None
         decoded = list(self._pool.map(self._decode_one, raws))
-        data = _np.zeros((self.batch_size, h, w, c), _np.float32)
+        data = _np.zeros((self.batch_size, h, w, c), self._dtype)
         label = _np.zeros((self.batch_size, self.label_width), _np.float32)
         for i, (arr, lab) in enumerate(decoded):
             data[i] = arr.reshape(h, w, c)
@@ -392,3 +402,20 @@ class ImageDetRecordIter(_io.DataIter):
     @property
     def object_width(self):
         return self._it.object_width
+
+
+class ImageRecordUInt8Iter(ImageRecordIter):
+    """ImageRecordIter yielding raw ``uint8`` pixels (parity
+    ImageRecordUInt8Iter, src/io/iter_image_recordio_2.cc:602) — half
+    the host->device bytes; normalize on-device where it's free."""
+
+    def __init__(self, **kwargs):
+        kwargs["dtype"] = "uint8"
+        super().__init__(**kwargs)
+
+
+# The reference keeps its previous-generation iterator implementations
+# registered under _v1 names (src/io/iter_image_recordio.cc:337,361) so
+# old configs keep running; here one implementation serves both names.
+ImageRecordIter_v1 = ImageRecordIter
+ImageRecordUInt8Iter_v1 = ImageRecordUInt8Iter

@@ -468,12 +468,35 @@ def create_iterator(name, **kwargs):
 
 
 # ImageRecordIter / ImageDetRecordIter are provided by mxtpu.image (recordio
-# decode pipeline); imported lazily to avoid cycles.
+# decode pipeline); imported lazily to avoid cycles. Registered so C-ABI
+# clients create them by name (MXDataIterCreateIter), like the reference's
+# MXNET_REGISTER_IO_ITER names incl. the uint8 and _v1 variants
+# (src/io/iter_image_recordio.cc:337,361, iter_image_recordio_2.cc:602).
+@register_iter
 def ImageRecordIter(**kwargs):
     from .image_record import ImageRecordIter as _impl
     return _impl(**kwargs)
 
 
+@register_iter
+def ImageRecordUInt8Iter(**kwargs):
+    from .image_record import ImageRecordUInt8Iter as _impl
+    return _impl(**kwargs)
+
+
+@register_iter
+def ImageRecordIter_v1(**kwargs):
+    from .image_record import ImageRecordIter_v1 as _impl
+    return _impl(**kwargs)
+
+
+@register_iter
+def ImageRecordUInt8Iter_v1(**kwargs):
+    from .image_record import ImageRecordUInt8Iter_v1 as _impl
+    return _impl(**kwargs)
+
+
+@register_iter
 def ImageDetRecordIter(**kwargs):
     from .image_record import ImageDetRecordIter as _impl
     return _impl(**kwargs)

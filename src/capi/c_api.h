@@ -264,9 +264,12 @@ int MXKVStoreGetGroupSize(KVStoreHandle kv, int *out);
  * Deviations, documented:
  *  - MXSymbolGrad errors ("not implemented") — EXACT reference parity
  *    (src/c_api/c_api_symbolic.cc:563 is LOG(FATAL) "not implemented").
- *  - MXRtc* error with guidance: NVRTC/CUDA-source kernels have no TPU
- *    analog; the adapted surface is the python mx.rtc (jax/pallas
- *    bodies, mxtpu/rtc.py).
+ *  - MXRtc* is FUNCTIONAL with an adapted kernel language: the source
+ *    string is jax/pallas Python (the body of a function whose declared
+ *    input names are in scope and which assigns every output name),
+ *    compiled via jax.jit/XLA — not CUDA C, which has no TPU compiler.
+ *    Push's grid/block geometry is accepted and ignored (XLA tiles).
+ *    Python-side equivalent: mx.rtc (mxtpu/rtc.py).
  *  - Sparse NDArrays are read-introspectable from C (GetStorageType /
  *    GetAux* / GetDataNDArray); construction happens through op invoke
  *    (cast_storage) or the python frontend.

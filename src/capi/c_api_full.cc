@@ -2256,32 +2256,59 @@ int MXImperativeInvokeEx(const char *op_name, mx_uint num_inputs,
 
 /* ---------------- Rtc (reference parity stance) ---------------- */
 
+/* String-source runtime compilation (reference: NVRTC over CUDA C,
+ * src/common/mxrtc.cc). The TPU kernel language is jax/pallas Python:
+ * `kernel` is the body of a function whose declared input names are in
+ * scope as jax arrays and which assigns every declared output name; it
+ * compiles through jax.jit/XLA (define pallas kernels inside the body
+ * for hand-tiled ops). The initial inputs/outputs arrays only describe
+ * arity in the reference too — execution binds at Push time. */
 int MXRtcCreate(char *name, mx_uint num_input, mx_uint num_output,
                 char **input_names, char **output_names,
                 NDArrayHandle *inputs, NDArrayHandle *outputs, char *kernel,
                 RtcHandle *out) {
-  (void)name; (void)num_input; (void)num_output; (void)input_names;
-  (void)output_names; (void)inputs; (void)outputs; (void)kernel; (void)out;
-  g_last_error =
-      "MXRtcCreate: CUDA-source runtime compilation has no TPU analog; "
-      "use the python mx.rtc API (jax/pallas kernel bodies) instead "
-      "(mxtpu/rtc.py)";
-  return -1;
+  (void)inputs; (void)outputs;
+  EnsurePython();
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "rtc_create",
+      Py_BuildValue("(sNNs)", name,
+                    StrList(num_input,
+                            const_cast<const char **>(input_names)),
+                    StrList(num_output,
+                            const_cast<const char **>(output_names)),
+                    kernel));
+  if (res == nullptr) return -1;
+  *out = IdToHandle(res);
+  Py_DECREF(res);
+  return 0;
 }
 
+/* grid/block geometry has no meaning under XLA's tiling; accepted and
+ * ignored (documented deviation) */
 int MXRtcPush(RtcHandle handle, mx_uint num_input, mx_uint num_output,
               NDArrayHandle *inputs, NDArrayHandle *outputs,
               mx_uint gridDimX, mx_uint gridDimY, mx_uint gridDimZ,
               mx_uint blockDimX, mx_uint blockDimY, mx_uint blockDimZ) {
-  (void)handle; (void)num_input; (void)num_output; (void)inputs;
-  (void)outputs; (void)gridDimX; (void)gridDimY; (void)gridDimZ;
+  (void)gridDimX; (void)gridDimY; (void)gridDimZ;
   (void)blockDimX; (void)blockDimY; (void)blockDimZ;
-  g_last_error = "MXRtcPush: no TPU analog (see MXRtcCreate)";
-  return -1;
+  GilGuard gil;
+  PyObject *res = CallBridge(
+      "rtc_push",
+      Py_BuildValue("(lNN)", HandleToId(handle),
+                    HandleList(num_input, inputs),
+                    HandleList(num_output, outputs)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
+  return 0;
 }
 
 int MXRtcFree(RtcHandle handle) {
-  (void)handle;
+  GilGuard gil;
+  PyObject *res = CallBridge("free",
+                             Py_BuildValue("(l)", HandleToId(handle)));
+  if (res == nullptr) return -1;
+  Py_DECREF(res);
   return 0;
 }
 

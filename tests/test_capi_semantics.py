@@ -135,7 +135,11 @@ def _capi():
     _build("capi")
     if not os.path.exists(CAPI_SO):
         pytest.skip("libmxtpu_capi.so did not build")
-    return ctypes.CDLL(CAPI_SO)
+    lib = ctypes.CDLL(CAPI_SO)
+    # default int restype truncates the 64-bit pointer; string_at on the
+    # truncated value segfaults the moment an assert message evaluates
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    return lib
 
 
 def test_c_symbol_get_attr_empty_string_found():
@@ -176,7 +180,7 @@ def test_c_func_invoke_ex_forwards_params():
     keys = (ctypes.c_char_p * 2)(b"a_min", b"a_max")
     vals = (ctypes.c_char_p * 2)(b"0", b"1")
     rc = lib.MXFuncInvokeEx(fn, use, None, mut, 2, keys, vals)
-    assert rc == 0, ctypes.string_at(lib.MXGetLastError())
+    assert rc == 0, lib.MXGetLastError()
     np.testing.assert_allclose(out.asnumpy(), [0.0, 0.5, 1.0])
     # params required but not supplied: loud failure, not silent defaults
     assert lib.MXFuncInvoke(fn, use, None, mut) == -1
@@ -220,3 +224,39 @@ def test_r_symbol_atomic_past_64_params():
     vals = ["0", "1"] + ["x"] * 68
     rc = _atomic("clip", keys, vals)
     assert rc == 0 or b"clip" in _last_error()
+
+
+def test_c_rtc_string_source_kernel():
+    """MXRtcCreate/Push through the C ABI with a string kernel (the
+    reference's NVRTC role; here the TPU kernel language is jax Python
+    — see src/capi/c_api_full.cc MXRtcCreate): compile once, push on
+    NDArray handles, outputs land in the caller's arrays."""
+    lib = _capi()
+    from mxtpu import capi_bridge as cb
+    from mxtpu.ndarray import array, zeros
+
+    x = array(np.array([1.0, -2.0, 3.0], dtype=np.float32))
+    out = zeros((3,))
+    xh, oh = cb._register(x), cb._register(out)
+
+    names = (ctypes.c_char_p * 1)(b"x")
+    onames = (ctypes.c_char_p * 1)(b"y")
+    kernel = b"y = jnp.tanh(x) * 2.0"
+    h = ctypes.c_void_p()
+    rc = lib.MXRtcCreate(b"tanh2", 1, 1, names, onames, None, None,
+                         kernel, ctypes.byref(h))
+    assert rc == 0, lib.MXGetLastError()
+    ins = (ctypes.c_void_p * 1)(ctypes.c_void_p(xh))
+    outs = (ctypes.c_void_p * 1)(ctypes.c_void_p(oh))
+    assert lib.MXRtcPush(h, 1, 1, ins, outs, 1, 1, 1, 1, 1, 1) == 0, \
+        lib.MXGetLastError()
+    np.testing.assert_allclose(out.asnumpy(),
+                               2 * np.tanh([1.0, -2.0, 3.0]), rtol=1e-5)
+    assert lib.MXRtcFree(h) == 0
+
+    # a kernel that never assigns its output fails loudly at Push
+    h2 = ctypes.c_void_p()
+    assert lib.MXRtcCreate(b"bad", 1, 1, names, onames, None, None,
+                           b"z = x + 1", ctypes.byref(h2)) == 0
+    assert lib.MXRtcPush(h2, 1, 1, ins, outs, 1, 1, 1, 1, 1, 1) == -1
+    assert b"did not assign" in lib.MXGetLastError()

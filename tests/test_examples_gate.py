@@ -607,3 +607,152 @@ def test_caffe_net_gate(network, epochs, floor):
     import caffe_net
     acc = caffe_net.main(["--network", network, "--epochs", str(epochs)])
     assert acc > floor, "caffe %s reached only %.3f" % (network, acc)
+
+
+def test_kaggle_ndsb1_gate(tmp_path):
+    """Full NDSB-1 recipe (examples/kaggle-ndsb1, parity
+    example/kaggle-ndsb1): class-folder tree -> gen_img_list
+    (stratified) -> im2rec pack -> ImageRecordIter train w/ checkpoint
+    -> checkpoint predict -> kaggle submission csv."""
+    import csv
+    import subprocess
+
+    import cv2
+
+    _example("kaggle-ndsb1", "gen_img_list.py")
+    import gen_img_list
+    import predict_dsb
+    import submission_dsb
+    import train_dsb
+
+    # synthetic "plankton": class = dominant color channel pattern
+    rng = np.random.RandomState(5)
+    classes = ["copepod", "diatom", "protist", "shrimp"]
+    train_dir = tmp_path / "data" / "train"
+    test_dir = tmp_path / "data" / "test"
+    test_dir.mkdir(parents=True)
+    for li, cls in enumerate(classes):
+        sub = train_dir / cls
+        sub.mkdir(parents=True)
+        for i in range(24):
+            img = (rng.rand(32, 32, 3) * 60).astype(int)
+            img[..., li % 3] += 150
+            if li == 3:  # 4th class: bright everywhere
+                img += 120
+            img = np.clip(img, 0, 255).astype("uint8")
+            cv2.imwrite(str(sub / ("%s_%d.jpg" % (cls, i))), img)
+    for i in range(12):
+        li = i % 4
+        img = (rng.rand(32, 32, 3) * 60).astype(int)
+        img[..., li % 3] += 150
+        if li == 3:
+            img += 120
+        img = np.clip(img, 0, 255).astype("uint8")
+        cv2.imwrite(str(test_dir / ("t%03d.jpg" % i)), img)
+
+    data = str(tmp_path / "data")
+    gen_img_list.main(["--image-folder", str(train_dir),
+                       "--out-folder", data, "--train", "--stratified"])
+    gen_img_list.main(["--image-folder", str(test_dir),
+                       "--out-folder", data, "--out-file", "test.lst"])
+    # stratified split: every class in both lists
+    for lst in ("tr.lst", "va.lst"):
+        labels = {ln.split("\t")[1] for ln in open(os.path.join(data, lst))}
+        assert len(labels) == 4, (lst, labels)
+
+    im2rec = os.path.join(_ROOT, "tools", "im2rec.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_ROOT)
+    for name, root in [("tr", str(train_dir)), ("va", str(train_dir)),
+                       ("test", str(test_dir))]:
+        r = subprocess.run(
+            [sys.executable, im2rec, os.path.join(data, name), root,
+             "--resize", "24"], capture_output=True, text=True, env=env)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    prefix = str(tmp_path / "models" / "dsb")
+    os.makedirs(os.path.dirname(prefix))
+    acc = train_dsb.main(["--data-dir", data, "--num-classes", "4",
+                          "--edge", "24", "--batch-size", "24",
+                          "--num-epochs", "25", "--width", "0.5",
+                          "--optimizer", "adam", "--lr", "0.002",
+                          "--model-prefix", prefix])
+    assert acc > 0.8, "ndsb1 val accuracy only %.3f" % acc
+
+    probs = predict_dsb.main(["--model-prefix", prefix, "--epoch", "25",
+                              "--test-rec", os.path.join(data, "test.rec"),
+                              "--num-classes", "4", "--edge", "24",
+                              "--batch-size", "6",
+                              "--out", str(tmp_path / "probs.npy")])
+    assert probs.shape == (12, 4)
+
+    out_csv = str(tmp_path / "submission.csv")
+    submission_dsb.main(["--probs", str(tmp_path / "probs.npy"),
+                         "--test-lst", os.path.join(data, "test.lst"),
+                         "--classes", os.path.join(data, "classes.txt"),
+                         "--out", out_csv])
+    with open(out_csv) as f:
+        rows = list(csv.reader(f))
+    assert rows[0] == ["image"] + classes
+    assert len(rows) == 13
+    body = np.array([[float(x) for x in r[1:]] for r in rows[1:]])
+    np.testing.assert_allclose(body.sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_kaggle_ndsb2_gate(tmp_path):
+    """NDSB-2 recipe (examples/kaggle-ndsb2, parity
+    example/kaggle-ndsb2): synthetic beating-heart studies ->
+    Preprocessing (CSV tensors + CDF label encode) -> frame-diff LeNet
+    through CSVIter/FeedForward/LogisticRegressionOutput with the CRPS
+    metric; training must beat the predict-the-prior CRPS baseline."""
+    import csv as _csv
+
+    import cv2
+
+    _example("kaggle-ndsb2", "Preprocessing.py")
+    import Preprocessing
+    import Train
+
+    rng = np.random.RandomState(9)
+    frames, edge, cdf = 8, 24, 40
+    root = tmp_path / "train"
+    root.mkdir()
+    labels = []
+    for s in range(24):
+        sid = "s%03d" % s
+        (root / sid).mkdir()
+        base_r = rng.uniform(4, 9)       # diastole radius
+        amp = rng.uniform(0.3, 0.6)      # contraction amount
+        for t in range(frames):
+            phase = np.cos(2 * np.pi * t / frames) * 0.5 + 0.5
+            r = base_r * (1 - amp * phase)
+            img = np.zeros((edge, edge), np.uint8)
+            cv2.circle(img, (edge // 2, edge // 2), int(round(r)), 200,
+                       -1)
+            cv2.imwrite(str(root / sid / ("frame_%02d.png" % t)), img)
+        area = np.pi * base_r ** 2
+        labels.append((sid, area * (1 - amp) ** 2 / 20, area / 20))
+    with open(root / "labels.csv", "w", newline="") as f:
+        w = _csv.writer(f)
+        w.writerow(["Id", "Systole", "Diastole"])
+        for row in labels:
+            w.writerow([row[0], "%.2f" % row[1], "%.2f" % row[2]])
+
+    prefix = str(tmp_path / "train")
+    cwd = os.getcwd()
+    Preprocessing.main(["--root", str(root), "--out-prefix", prefix,
+                        "--frames", str(frames), "--edge", str(edge),
+                        "--cdf-dim", str(cdf)])
+    assert os.path.exists("%s-%dx%d-data.csv" % (prefix, edge, edge))
+
+    sys_score, dia_score = Train.main(
+        ["--data-prefix", prefix, "--frames", str(frames),
+         "--edge", str(edge), "--cdf-dim", str(cdf),
+         "--num-filter", "12", "--batch-size", "12",
+         "--num-epochs", "12", "--lr", "0.01"])
+
+    # baseline: predicting the mean encoded target everywhere
+    enc = np.loadtxt(prefix + "-systole.csv", delimiter=",")
+    base = Train.CRPS(enc, np.tile(enc.mean(0), (enc.shape[0], 1)))
+    assert sys_score < base * 0.6, (sys_score, base)
+    assert dia_score < base * 0.8, (dia_score, base)
+    assert os.getcwd() == cwd

@@ -184,3 +184,35 @@ def test_nd_cv_ops(tmp_path):
     assert out.shape == (8, 8, 3)
     small = mx.nd.imresize(out, 4, 4)
     assert small.shape == (4, 4, 3)
+
+
+def test_image_record_uint8_iter(tmp_path):
+    """ImageRecordUInt8Iter (parity iter_image_recordio_2.cc:602): raw
+    uint8 batches, byte-identical to the float iterator's pixels, half
+    the bytes; mean/std/scale rejected; _v1 aliases resolve; all four
+    names creatable through the registry (the C-ABI name path)."""
+    import mxtpu as mx
+
+    rec_path, idx_path = _make_rec(tmp_path, n=8)
+    kw = dict(path_imgrec=rec_path, path_imgidx=idx_path,
+              data_shape=(3, 32, 32), batch_size=4)
+    it8 = mx.io.ImageRecordUInt8Iter(**kw)
+    b8 = next(iter(it8))
+    assert b8.data[0].dtype == np.uint8
+    assert it8.provide_data[0].dtype == np.uint8
+
+    itf = mx.io.ImageRecordIter(**kw)
+    bf = next(iter(itf))
+    np.testing.assert_array_equal(b8.data[0].asnumpy(),
+                                  bf.data[0].asnumpy().astype(np.uint8))
+
+    with pytest.raises(mx.MXNetError):
+        mx.io.ImageRecordUInt8Iter(scale=1.0 / 255, **kw)
+
+    # _v1 aliases + registry (by-name creation, the MXDataIterCreateIter
+    # seam)
+    from mxtpu.io import create_iterator
+    for name in ("ImageRecordIter", "ImageRecordUInt8Iter",
+                 "ImageRecordIter_v1", "ImageRecordUInt8Iter_v1"):
+        it = create_iterator(name, **kw)
+        assert next(iter(it)).data[0].shape == (4, 3, 32, 32)

@@ -128,9 +128,11 @@ def test_conv_train_flow_via_c_abi_ctypes():
         pytest.skip("libmxtpu_capi.so did not build: %s"
                     % (r.stdout + r.stderr)[-300:])
     lib = ctypes.CDLL(CAPI_SO)
+    # default int restype truncates the pointer; string_at then segfaults
+    lib.MXGetLastError.restype = ctypes.c_char_p
 
     def err():
-        return ctypes.string_at(lib.MXGetLastError())
+        return lib.MXGetLastError()
 
     def atomic(op, attrs):
         n = len(attrs)
