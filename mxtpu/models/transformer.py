@@ -53,7 +53,7 @@ def _ffn_block(h, d_model, d_ff, prefix, dropout):
 
 
 def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, d_model=128,
-               d_ff=None, dropout=0.0, max_len=None):
+               d_ff=None, dropout=0.0, max_len=None, dtype=None):
     """Causal LM: data (B, T) int tokens -> SoftmaxOutput over (B*T, vocab).
 
     Train with label = data shifted left by one (next-token prediction),
@@ -63,6 +63,13 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, d_model=128,
     symbol's seq_len, so BucketingModule buckets of different lengths
     share ONE ``pos_emb`` (the transformer analogue of the LSTM bucketing
     LM's shared parameters — each bucket slices the common table).
+
+    ``dtype='bfloat16'`` casts activations to bf16 right after the
+    embedding (token ids stay f32 — bf16 integers are exact only to 256)
+    and casts the logits back to f32 before the softmax. The block
+    weights follow the activation dtype via the bidirectional InferType
+    rule, so every matmul tiles onto the MXU in bf16; optimizer state
+    stays f32 (mxtpu/module/fused.py).
     """
     d_ff = d_ff or 4 * d_model
     assert d_model % num_heads == 0, "d_model must divide into heads"
@@ -71,7 +78,11 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, d_model=128,
     data = sym.Variable("data")
     h = sym.Embedding(data, input_dim=vocab_size, output_dim=d_model,
                       name="tok_emb")
+    if dtype is not None:
+        h = sym.Cast(h, dtype=dtype)
     pos = sym.Variable("pos_emb", shape=(1, max_len, d_model))
+    if dtype is not None:
+        pos = sym.Cast(pos, dtype=dtype)
     if max_len != seq_len:
         pos = sym.slice_axis(pos, axis=1, begin=0, end=seq_len)
     h = sym.broadcast_add(h, pos)
@@ -82,4 +93,6 @@ def get_symbol(vocab_size, seq_len, num_layers=2, num_heads=4, d_model=128,
     h = sym.LayerNorm(h, name="ln_f")
     h = sym.reshape(h, shape=(-1, d_model))
     logits = sym.FullyConnected(h, num_hidden=vocab_size, name="lm_head")
+    if dtype is not None:
+        logits = sym.Cast(logits, dtype="float32")
     return sym.SoftmaxOutput(logits, name="softmax")
