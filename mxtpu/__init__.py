@@ -14,6 +14,10 @@ from __future__ import annotations
 from .libinfo import __version__  # single source of truth
 
 from . import base
+
+# multi-process CPU collectives (2-process kvstore tests, CPU pod runs)
+# need gloo selected before the CPU backend initializes
+base.select_cpu_collectives()
 from .base import MXNetError, MXTPUError
 from . import attribute
 from .attribute import AttrScope
@@ -60,6 +64,7 @@ from . import test_utils
 from . import parallel
 from . import operator
 from . import predict
+from . import serving
 from . import rtc
 from . import contrib
 from . import torch_bridge

@@ -143,3 +143,21 @@ class PrefixOpNamespace:
         n = len(self._prefix)
         return [k[n:] for k in dir(self._module)
                 if k.startswith(self._prefix)]
+
+
+def select_cpu_collectives():
+    """Select the gloo CPU-collectives implementation when this process is
+    part of a jax.distributed cluster. Must run BEFORE the CPU backend
+    initializes; the default 'none' makes any cross-process psum/allgather
+    fail with "Multiprocess computations aren't implemented on the CPU
+    backend". No-op when not distributed or on jax versions without the
+    flag. Called from package import AND from the dist kvstore constructor
+    so both `initialize → import mxtpu` and `import mxtpu → initialize`
+    orders are covered."""
+    try:
+        import jax
+        from jax._src import distributed as _jd
+        if getattr(_jd.global_state, "client", None) is not None:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # flag renamed/absent on other jax versions
+        pass

@@ -15,6 +15,8 @@ include/mxnet/op_attr_types.h:44-59 (kWriteTo/kAddTo/kNullOp).
 """
 from __future__ import annotations
 
+import threading as _threading
+
 import jax
 import jax.numpy as jnp
 import numpy as _np
@@ -24,7 +26,45 @@ from .context import Context, current_context
 from .ndarray import NDArray, zeros
 from . import random as _rnd
 
-__all__ = ["Executor"]
+__all__ = ["Executor", "add_build_listener", "remove_build_listener",
+           "program_build_count"]
+
+# ---------------------------------------------------------------- cache hooks
+# Program-construction observability for the serving layer: every time an
+# Executor builds a traced program (a cache miss in its per-kind table —
+# the event that leads to an XLA compile on first dispatch), listeners are
+# notified with (kind, executor). mxtpu.serving counts these to surface
+# executor-cache efficiency; warmup correctness is asserted by the count
+# staying flat under traffic.
+_BUILD_LISTENERS = []
+_BUILD_COUNT = [0]
+_BUILD_LOCK = _threading.Lock()
+
+
+def add_build_listener(fn):
+    """Register ``fn(kind, executor)`` called on every program build."""
+    _BUILD_LISTENERS.append(fn)
+    return fn
+
+
+def remove_build_listener(fn):
+    if fn in _BUILD_LISTENERS:
+        _BUILD_LISTENERS.remove(fn)
+
+
+def program_build_count():
+    """Total traced-program constructions since import (monotonic)."""
+    return _BUILD_COUNT[0]
+
+
+def _notify_build(kind, executor):
+    with _BUILD_LOCK:  # concurrent replica builds must not lose counts
+        _BUILD_COUNT[0] += 1
+    for fn in list(_BUILD_LISTENERS):
+        try:
+            fn(kind, executor)
+        except Exception:
+            pass
 
 
 def _with_matmul_precision(fn):
@@ -258,6 +298,7 @@ class Executor:
         fn = self._fns.get(kind)
         if fn is not None:
             return fn
+        _notify_build(kind, self)
         if kind == "fwd_eval":
             run = _trace_graph(self._symbol, is_train=False,
                                placements=self._placements)

@@ -65,6 +65,7 @@ def export_serving(symbol, arg_params, aux_params, data_shapes, path,
     to the current backend).
     """
     import jax
+    import jax.export  # not loaded by plain `import jax` on jax<0.5
     import jax.numpy as jnp
 
     serve, inputs = _build_serve(symbol, arg_params, aux_params, data_shapes)
@@ -93,6 +94,7 @@ def load_serving(path):
     """Load a .mxa artifact: returns (fn, meta). Pure jax — no mxtpu
     needed (deployable in a bare jax container or via PJRT in C++)."""
     import jax
+    import jax.export  # not loaded by plain `import jax` on jax<0.5
 
     with open(path, "rb") as f:
         magic = f.read(8)

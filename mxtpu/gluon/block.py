@@ -289,10 +289,7 @@ class HybridBlock(Block):
             else:
                 cargs.append(v.data())
         from ..ndarray.ndarray import invoke_op as _invoke
-        from ..ops import registry as _reg
-        if self._cached_op.name not in _reg._OPS:
-            _reg.register_op(self._cached_op)
-        outs = _invoke(self._cached_op.name, cargs, {})
+        outs = _invoke(self._cached_op, cargs, {})
         ret, _ = _regroup(outs, self._out_format)
         return ret
 

@@ -53,6 +53,10 @@ class KVStore:
         self._client = None
         self._env = None
         if kind.startswith("dist"):
+            # covers the mxtpu-first import order (the import-time call in
+            # mxtpu/__init__.py only sees clusters initialized earlier)
+            from .base import select_cpu_collectives
+            select_cpu_collectives()
             from . import kvstore_server as kvs
 
             env = kvs.cluster_env()

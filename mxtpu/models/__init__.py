@@ -15,6 +15,8 @@ from . import mobilenet
 from . import resnet_v1
 from . import inception_v4
 from . import inception_resnet_v2
+from . import serving_fixtures
+from .serving_fixtures import get_fixture as get_serving_fixture
 from .mlp import get_symbol as get_mlp
 from .transformer import get_symbol as get_transformer_lm
 from .googlenet import get_symbol as get_googlenet

@@ -77,11 +77,23 @@ class DataParallelExecutorGroup:
         self.slices = _split_input_slice(self.batch_size, self.workload)
         return self.slices
 
-    def _sliced_shape(self, shapes, i):
+    def _scaled_slice(self, islice, dim0):
+        """Scale a batch slice for arrays whose leading dim is a multiple of
+        the batch size (e.g. sequence-LM labels flattened to (B*T,)), so each
+        context receives the rows that match its data shard. dim0 == batch
+        (the common case) is the identity."""
+        if self.batch_size and dim0 != self.batch_size \
+                and dim0 % self.batch_size == 0:
+            k = dim0 // self.batch_size
+            return slice(islice.start * k, islice.stop * k)
+        return islice
+
+    def _sliced_shape(self, shapes, i, scale=False):
         out = []
         for desc in shapes:
             name, shape = desc[0], tuple(desc[1])
-            islice = self.slices[i]
+            islice = self._scaled_slice(self.slices[i], shape[0]) \
+                if scale else self.slices[i]
             out.append(DataDesc(name,
                                 (islice.stop - islice.start,) + shape[1:],
                                 getattr(desc, "dtype", "float32")))
@@ -97,7 +109,10 @@ class DataParallelExecutorGroup:
         self.execs = []
         for i, ctx in enumerate(self.contexts):
             dshapes = self._sliced_shape(data_shapes, i)
-            lshapes = self._sliced_shape(label_shapes, i) if label_shapes else []
+            # labels may carry a flattened (k*batch,) leading dim; bind
+            # them at the scaled size that forward() will actually feed
+            lshapes = self._sliced_shape(label_shapes, i, scale=True) \
+                if label_shapes else []
             input_shapes = {d.name: d.shape for d in dshapes}
             input_shapes.update({l.name: l.shape for l in lshapes})
             type_dict = {d.name: str(d.dtype) for d in dshapes + lshapes}
@@ -163,7 +178,8 @@ class DataParallelExecutorGroup:
                 feed[name] = arr[islice].as_in_context(self.contexts[i])
             for name, arr in zip(self.label_names, labels):
                 if name in exe.arg_dict:
-                    feed[name] = arr[islice].as_in_context(self.contexts[i])
+                    lslice = self._scaled_slice(islice, arr.shape[0])
+                    feed[name] = arr[lslice].as_in_context(self.contexts[i])
             exe.forward(is_train=is_train, **feed)
 
     def backward(self, out_grads=None):
@@ -173,7 +189,8 @@ class DataParallelExecutorGroup:
                 exe.backward()
             else:
                 islice = self.slices[i]
-                og = [g[islice].as_in_context(self.contexts[i])
+                og = [g[self._scaled_slice(islice, g.shape[0])]
+                      .as_in_context(self.contexts[i])
                       for g in out_grads]
                 exe.backward(out_grads=og)
 
@@ -196,7 +213,8 @@ class DataParallelExecutorGroup:
 
     def update_metric(self, eval_metric, labels):
         for texec, islice in zip(self.execs, self.slices):
-            labels_slice = [label[islice] for label in labels]
+            labels_slice = [label[self._scaled_slice(islice, label.shape[0])]
+                            for label in labels]
             eval_metric.update(labels_slice, texec.outputs)
 
     def install_monitor(self, mon):

@@ -414,7 +414,10 @@ def invoke_op(name, nd_inputs, attr_kwargs, out=None):
     """Imperative invoke: parity with MXImperativeInvokeEx → PushFCompute
     (src/c_api/c_api_ndarray.cc:491-611), with XLA async dispatch replacing the
     engine push and the autograd tape hook (RecordOp) preserved."""
-    op = get_op(name)
+    # `name` may be an OpDef directly (gluon CachedOps invoke their private
+    # opdef without polluting the global registry — the generated binding
+    # surfaces stamp its size, so it must stay import-deterministic)
+    op = get_op(name) if isinstance(name, str) else name
     if out is not None and _ag.is_recording():
         # matches the reference's error: in-place writes would silently sever
         # the tape (the dst keeps its old uid while the entry records a new one)

@@ -1,11 +1,42 @@
 """JAX version compatibility shims shared by the parallel modules."""
 from __future__ import annotations
 
+import inspect
+
 from jax import lax
+
+try:  # jax >= 0.6 exports shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_SHARD_MAP_HAS_VMA = "check_vma" in inspect.signature(_shard_map).parameters
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, check_vma=None,
+              **kwargs):
+    """shard_map across jax versions: new jax spells the replication-type
+    check ``check_vma``; old jax spells it ``check_rep`` AND its checker
+    rejects valid programs (e.g. equal-replication cond branches — the
+    pipeline scan), so on old jax the check defaults OFF. Values are
+    unaffected either way; the check is advisory."""
+    if _SHARD_MAP_HAS_VMA:
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+    else:
+        kwargs["check_rep"] = bool(check_vma) if check_vma is not None \
+            else False
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kwargs)
 
 if hasattr(lax, "pcast"):
     def _to_varying(x, axis_name):
         return lax.pcast(x, axis_name, to="varying")
-else:  # older JAX without pcast
+elif hasattr(lax, "pvary"):
     def _to_varying(x, axis_name):
         return lax.pvary(x, axis_name)
+else:
+    # jax <= 0.4.x: shard_map has no varying-axes type system; every
+    # value inside the mapped function is already device-varying
+    def _to_varying(x, axis_name):
+        return x

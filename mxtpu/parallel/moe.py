@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from ._compat import shard_map
 
 __all__ = ["moe_apply", "moe_apply_topk", "load_balancing_loss"]
 

@@ -12,7 +12,9 @@ is a single device call.
 """
 from __future__ import annotations
 
+import hashlib as _hashlib
 import io as _io
+from collections import OrderedDict as _OrderedDict
 
 import numpy as _np
 
@@ -23,11 +25,18 @@ from .context import cpu
 
 
 class Predictor:
-    """One bound inference graph (parity: the PredictorHandle object)."""
+    """One bound inference graph (parity: the PredictorHandle object).
+
+    Serving extensions beyond the C predict API: executors are cached per
+    input-shape set (``reshape`` back to a seen shape set reuses the
+    already-jitted program instead of retracing), and ``forward_batch``
+    pads arbitrary-size batches up to a small set of bucket sizes so a
+    server only ever dispatches pre-compiled shapes (mxtpu.serving)."""
 
     def __init__(self, symbol_json_str, param_bytes_or_dict, ctx=None,
                  input_shapes=None, dev_type=None, dev_id=0,
-                 output_index=None, output_names=None):
+                 output_index=None, output_names=None, bucket_sizes=None,
+                 max_cached_binds=8):
         if input_shapes is None:
             raise MXNetError("Predictor requires input_shapes")
         self._ctx = ctx or cpu()
@@ -59,6 +68,10 @@ class Predictor:
         self._arg_params = {}
         self._aux_params = {}
         for k, v in loaded.items():
+            # weights land on THIS predictor's device exactly once; a
+            # replica pool passes the same arrays per device, so reshaped()
+            # predictors share them copy-free (ctx already matches)
+            v = v.as_in_context(self._ctx)
             if k.startswith("arg:"):
                 self._arg_params[k[4:]] = v
             elif k.startswith("aux:"):
@@ -67,9 +80,40 @@ class Predictor:
                 self._arg_params[k] = v
         self._input_shapes = dict(input_shapes)
         self._inputs = {}
+        self._bucket_sizes = tuple(sorted(set(bucket_sizes))) \
+            if bucket_sizes else None
+        self._max_cached_binds = max(1, int(max_cached_binds))
+        self._bind_cache = _OrderedDict()  # shape key -> (exec, args, outs)
+        self._symbol_hash = None
         self._bind()
 
+    @property
+    def symbol_hash(self):
+        """Stable digest of the graph json — the executable-cache key
+        component identifying the MODEL (shapes/dtypes key the rest)."""
+        if self._symbol_hash is None:
+            self._symbol_hash = _hashlib.sha1(
+                self._symbol.tojson().encode()).hexdigest()[:16]
+        return self._symbol_hash
+
+    def _shape_key(self):
+        return tuple(sorted((k, tuple(v))
+                            for k, v in self._input_shapes.items()))
+
     def _bind(self):
+        key = self._shape_key()
+        hit = self._bind_cache.get(key)
+        if hit is not None:
+            self._bind_cache.move_to_end(key)
+            self._executor, self._arg_arrays, self._out_shapes = hit
+            return
+        self._bind_fresh()
+        self._bind_cache[key] = (self._executor, self._arg_arrays,
+                                 self._out_shapes)
+        while len(self._bind_cache) > self._max_cached_binds:
+            self._bind_cache.popitem(last=False)
+
+    def _bind_fresh(self):
         symbol = self._symbol
         arg_names = symbol.list_arguments()
         arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(
@@ -163,10 +207,36 @@ class Predictor:
         return len(self._out_shapes)
 
     def reshape(self, new_input_shapes):
-        """MXPredReshape: rebind with new shapes (new XLA executable;
-        weights are reused)."""
+        """MXPredReshape: rebind with new shapes. Weights are reused, and
+        a shape set seen before reuses its cached executor (and therefore
+        its jitted XLA program) instead of retracing."""
         self._input_shapes.update(new_input_shapes)
         self._bind()
+
+    def forward_batch(self, inputs):
+        """Serve a dict of numpy inputs with an ARBITRARY leading batch
+        dim: pad up to the smallest configured bucket size, run the cached
+        executor for that bucket shape, and slice the outputs back to the
+        true batch. Requires ``bucket_sizes`` at construction (else the
+        exact batch size is bound, shape-cached all the same). Returns a
+        list of numpy outputs."""
+        from .serving.batcher import pad_rows, pick_bucket
+        arrs = {k: _np.asarray(v) for k, v in inputs.items()}
+        ns = {a.shape[0] for a in arrs.values()}
+        if len(ns) != 1:
+            raise MXNetError("forward_batch: inconsistent leading dims")
+        n = ns.pop()
+        bucket = pick_bucket(n, self._bucket_sizes) \
+            if self._bucket_sizes else n
+        if bucket < n:
+            raise MXNetError(
+                "forward_batch: batch %d exceeds largest bucket %d"
+                % (n, bucket))
+        shapes = {k: (bucket,) + a.shape[1:] for k, a in arrs.items()}
+        if shapes != {k: tuple(v) for k, v in self._input_shapes.items()}:
+            self.reshape(shapes)
+        self.forward(**{k: pad_rows(a, bucket) for k, a in arrs.items()})
+        return [self.get_output(i)[:n] for i in range(self.num_outputs)]
 
     def reshaped(self, new_input_shapes):
         """MXPredReshape's C contract: a NEW predictor with the new input
@@ -177,7 +247,9 @@ class Predictor:
         params = {"arg:%s" % k: v for k, v in self._arg_params.items()}
         params.update({"aux:%s" % k: v for k, v in self._aux_params.items()})
         return Predictor(self._symbol, params, ctx=self._ctx,
-                         input_shapes=shapes)
+                         input_shapes=shapes,
+                         bucket_sizes=self._bucket_sizes,
+                         max_cached_binds=self._max_cached_binds)
 
 
 def create(symbol_file, param_file, input_shapes, ctx=None):

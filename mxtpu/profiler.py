@@ -70,10 +70,28 @@ def ops_enabled():
     return _state["running"] and _state["mode"] in _OP_MODES
 
 
-def record_span(name, begin_us, end_us, category="operator", tid=0):
-    """Record one op-level span (called by instrumented paths)."""
+_tids = {}
+
+
+def _thread_tid():
+    """Small stable tid for the calling thread (chrome://tracing lanes).
+    Multi-threaded callers (the serving dispatchers) get one lane each, so
+    concurrent spans don't corrupt B/E pairing in ``dumps()``."""
+    ident = threading.get_ident()
+    with _lock:
+        tid = _tids.get(ident)
+        if tid is None:
+            tid = _tids[ident] = len(_tids)
+        return tid
+
+
+def record_span(name, begin_us, end_us, category="operator", tid=None):
+    """Record one op-level span (called by instrumented paths). ``tid``
+    defaults to a per-thread lane."""
     if not _state["running"]:
         return
+    if tid is None:
+        tid = _thread_tid()
     with _lock:
         _events.append({"name": name, "cat": category, "ph": "B",
                         "ts": begin_us, "pid": 0, "tid": tid})

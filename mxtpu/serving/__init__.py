@@ -1,0 +1,30 @@
+"""mxtpu.serving — dynamic-batching inference runtime.
+
+The deployment layer above the single-request predict API: compiled
+Predictors become a high-throughput multi-replica service. Pieces:
+
+  * ``batcher``  — thread-safe queue coalescing requests into shape
+                   buckets under a latency deadline
+  * ``pool``     — per-device Predictor replicas with an LRU cache of
+                   compiled executables keyed (symbol hash, shape, dtype)
+  * ``server``   — in-process ``ServingSession`` + stdlib JSON-over-HTTP
+                   front-end with backpressure and graceful drain
+  * ``metrics``  — qps / batch-fill / queue-depth / latency-percentile /
+                   cache-hit observability, JSON + chrome://tracing
+
+See docs/serving.md for architecture and tuning.
+"""
+from .batcher import (BatcherClosed, DynamicBatcher, QueueFull, WorkItem,
+                      pad_rows, pick_bucket)
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .pool import ExecutorPool, default_contexts
+from .server import (DEFAULT_BUCKETS, ServingHTTPServer, ServingSession,
+                     serve)
+
+__all__ = [
+    "BatcherClosed", "DynamicBatcher", "QueueFull", "WorkItem",
+    "pad_rows", "pick_bucket",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "ExecutorPool", "default_contexts",
+    "DEFAULT_BUCKETS", "ServingHTTPServer", "ServingSession", "serve",
+]

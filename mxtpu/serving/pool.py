@@ -1,0 +1,165 @@
+"""Executor pool: N Predictor replicas with a shape-bucketed LRU cache.
+
+One replica per device (``jax.local_devices()``); on a CPU-only host the
+same scheme degrades gracefully to thread-level replicas over the host
+devices (the forced-8-device test mesh exercises the true multi-replica
+path). Each replica owns the model weights ON ITS DEVICE once, and an LRU
+of bound executors keyed ``(symbol-json hash, bucket shape, dtype)`` —
+the serving analogue of TVM's ahead-of-time module table: every shape the
+batcher can emit is compiled exactly once per replica (``warmup``), after
+which dispatch never traces.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+from ..base import MXNetError
+from ..context import Context
+from ..predict import Predictor
+
+__all__ = ["ExecutorPool", "default_contexts"]
+
+
+def default_contexts(max_replicas=None):
+    """One Context per local jax device (cpu(i) on CPU hosts, gpu(i) —
+    the accelerator alias — otherwise)."""
+    devs = jax.local_devices()
+    kind = "cpu" if devs[0].platform == "cpu" else "gpu"
+    n = len(devs) if max_replicas is None else min(len(devs), max_replicas)
+    return [Context(kind, i) for i in range(n)]
+
+
+class _Replica:
+    """One device's predictor: ONE weight copy + the shape-keyed executor
+    LRU that Predictor itself maintains (``_bind_cache``). The effective
+    cache identity is (symbol-json hash, bucket shapes, dtype): the symbol
+    hash and the float32 request dtype are fixed per replica, so the bind
+    cache's shape key carries the varying part."""
+
+    def __init__(self, symbol_json, params, example_shapes, ctx, cache_size,
+                 metrics=None, record_executor=None):
+        self.ctx = ctx
+        self.lock = threading.Lock()
+        self.metrics = metrics
+        self._record = record_executor or (lambda ex: None)
+        self.base = Predictor(symbol_json, params, ctx=ctx,
+                              input_shapes=example_shapes,
+                              max_cached_binds=cache_size)
+        self._record(self.base._executor)
+
+    def predictor_for(self, shapes):
+        """The replica predictor bound to exact input ``shapes`` (cached
+        executor reuse; caller must hold ``self.lock``)."""
+        key = tuple(sorted((k, tuple(v)) for k, v in shapes.items()))
+        cache = self.base._bind_cache
+        hit = key in cache
+        before = len(cache)
+        self.base.reshape(shapes)
+        self._record(self.base._executor)
+        if self.metrics:
+            self.metrics.counter(
+                "executor_cache_hits" if hit
+                else "executor_cache_misses").inc()
+            if not hit and len(cache) == before:
+                # the miss inserted one entry yet the cache didn't grow:
+                # the LRU evicted a compiled executable
+                self.metrics.counter("executor_cache_evictions").inc()
+        return self.base
+
+    def run(self, inputs):
+        """Forward one already-padded batch; returns list of np outputs."""
+        shapes = {k: tuple(v.shape) for k, v in inputs.items()}
+        with self.lock:
+            pred = self.predictor_for(shapes)
+            pred.forward(**inputs)
+            return [pred.get_output(i) for i in range(pred.num_outputs)]
+
+
+class ExecutorPool:
+    """Round-robin scheduler over device replicas.
+
+    ``example_shapes`` are per-request input shapes with a leading batch
+    dim of 1 (e.g. ``{"data": (1, 3, 32, 32)}``); bucketed batch shapes
+    substitute the bucket size for that leading 1.
+    """
+
+    def __init__(self, symbol_json, params, example_shapes, contexts=None,
+                 cache_size=8, metrics=None):
+        if not example_shapes:
+            raise MXNetError("ExecutorPool requires example_shapes")
+        self.example_shapes = {k: tuple(v) for k, v in example_shapes.items()}
+        contexts = contexts or default_contexts()
+        self.metrics = metrics
+        # executor ownership registry for the build-listener seam: ids are
+        # recorded under this dedicated lock at bind time, so membership
+        # checks never touch a replica's bind cache (no lock-ordering
+        # hazard with in-flight rebinds). Stale ids of evicted executors
+        # linger harmlessly — a metrics counter tolerates that.
+        self._owned_ids = set()
+        self._owned_lock = threading.Lock()
+
+        def _record(ex):
+            with self._owned_lock:
+                self._owned_ids.add(id(ex))
+
+        self.replicas = [
+            _Replica(symbol_json, params, self.example_shapes, ctx,
+                     cache_size, metrics=metrics, record_executor=_record)
+            for ctx in contexts
+        ]
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+
+    def __len__(self):
+        return len(self.replicas)
+
+    @property
+    def symbol_hash(self):
+        return self.replicas[0].base.symbol_hash
+
+    def owns_executor(self, executor):
+        """True iff ``executor`` was bound by one of this pool's replicas
+        (scopes the executor build-listener seam to this pool)."""
+        with self._owned_lock:
+            return id(executor) in self._owned_ids
+
+    def bucket_shapes(self, bucket):
+        return {k: (bucket,) + tuple(s[1:])
+                for k, s in self.example_shapes.items()}
+
+    def next_replica(self):
+        with self._rr_lock:
+            r = self.replicas[self._rr % len(self.replicas)]
+            self._rr += 1
+            return r
+
+    def run(self, inputs, replica=None):
+        """Dispatch one padded batch round-robin (or to ``replica``)."""
+        rep = replica if replica is not None else self.next_replica()
+        if self.metrics:
+            with self.metrics.span("pool.run", category="serving"):
+                return rep.run(inputs)
+        return rep.run(inputs)
+
+    def warmup(self, buckets):
+        """Compile every (replica, bucket) executable up front so traffic
+        never pays a jit pause. Returns the number of programs built."""
+        import numpy as _np
+        built = 0
+        for rep in self.replicas:
+            for b in buckets:
+                shapes = self.bucket_shapes(b)
+                dummy = {k: _np.zeros(s, dtype=_np.float32)
+                         for k, s in shapes.items()}
+                with rep.lock:
+                    pred = rep.predictor_for(shapes)
+                    pred.forward(**dummy)
+                    # realize the outputs: jit compiles on first execute
+                    for i in range(pred.num_outputs):
+                        pred.get_output(i)
+                built += 1
+        if self.metrics:
+            self.metrics.counter("warmup_programs").inc(built)
+        return built

@@ -1,0 +1,282 @@
+"""Serving front-ends: in-process ``ServingSession`` + stdlib HTTP server.
+
+``ServingSession`` is the composition root: a ``DynamicBatcher`` feeding
+an ``ExecutorPool`` through one dispatcher thread per replica, with a
+``MetricsRegistry`` observing every stage. The HTTP layer is a thin JSON
+veneer (stdlib ``ThreadingHTTPServer`` — zero new dependencies) over the
+same session:
+
+    POST /v1/predict   {"inputs": {"data": [[...]]}}   -> {"outputs": [...]}
+    GET  /v1/metrics   serving metrics JSON
+    GET  /healthz      liveness (200 while accepting)
+
+Backpressure contract: a full request queue answers 429 (shed, don't
+collapse), a per-request timeout answers 504, and shutdown drains — the
+queue closes, in-flight batches finish, THEN workers exit.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as _np
+
+from ..base import MXNetError
+from .batcher import BatcherClosed, DynamicBatcher, QueueFull
+from .metrics import MetricsRegistry
+from .pool import ExecutorPool
+
+__all__ = ["ServingSession", "ServingHTTPServer", "serve"]
+
+DEFAULT_BUCKETS = (1, 8, 32, 128)
+
+
+class ServingSession:
+    """Dynamic-batching inference service over one model.
+
+    Parameters
+    ----------
+    symbol_json : str or Symbol — the inference graph
+    params : dict or bytes — trained weights (``arg:``/``aux:`` convention)
+    example_shapes : dict name -> per-request shape WITH leading dim 1
+    buckets : allowed batch sizes (every one is warmed at startup)
+    max_delay_ms : batching deadline — the latency budget donated to
+        coalescing before a padded partial batch is flushed
+    max_queue : bounded queue depth; beyond it ``predict`` raises QueueFull
+    contexts : device contexts (default: one replica per local device)
+    warmup : compile all (replica, bucket) programs before accepting
+    """
+
+    def __init__(self, symbol_json, params, example_shapes,
+                 buckets=DEFAULT_BUCKETS, max_delay_ms=5.0, max_queue=256,
+                 contexts=None, cache_size=8, warmup=True,
+                 default_timeout=None):
+        self.metrics = MetricsRegistry()
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self.default_timeout = default_timeout
+        # the per-replica executor LRU must hold every bucket or warmup
+        # thrashes and evicted buckets re-compile mid-traffic
+        cache_size = max(cache_size, len(self.buckets))
+        self.pool = ExecutorPool(symbol_json, params, example_shapes,
+                                 contexts=contexts, cache_size=cache_size,
+                                 metrics=self.metrics)
+        self.batcher = DynamicBatcher(
+            list(example_shapes), buckets=self.buckets,
+            max_delay_ms=max_delay_ms, max_queue=max_queue,
+            metrics=self.metrics, example_shapes=example_shapes)
+        self.metrics.gauge("queue_depth", fn=lambda: self.batcher.depth)
+        self.metrics.gauge("replicas", fn=lambda: len(self.pool))
+        # executor-layer seam: count every traced-program construction by
+        # THIS session's executors (each costs an XLA compile on first
+        # dispatch); after warmup this counter must stay flat under
+        # traffic at warmed buckets. The listener holds the pool weakly
+        # and closes over the counter — never the session — so an
+        # un-close()d session is not pinned by the global seam, and
+        # builds from unrelated executors (another session, a training
+        # Module) are not attributed here.
+        import weakref
+        from .. import executor as _executor
+        _builds = self.metrics.counter("program_builds")
+        _pool = weakref.ref(self.pool)
+
+        def _on_build(kind, ex, _c=_builds, _p=_pool):
+            p = _p()
+            if p is not None and p.owns_executor(ex):
+                _c.inc()
+
+        self._build_listener = _executor.add_build_listener(_on_build)
+        if warmup:
+            with self.metrics.span("warmup"):
+                self.pool.warmup(self.buckets)
+        self._closed = False
+        self._workers = [
+            threading.Thread(target=self._dispatch_loop,
+                             args=(rep,), daemon=True,
+                             name="mxtpu-serving-%d" % i)
+            for i, rep in enumerate(self.pool.replicas)
+        ]
+        for w in self._workers:
+            w.start()
+
+    # ------------------------------------------------------------ workers
+    def _dispatch_loop(self, replica):
+        """One per replica: pull a batch, run it, answer its requests.
+        Keeping the replica pinned to its loop gives lock-free device
+        dispatch; the batcher is the only shared structure."""
+        while True:
+            batch = self.batcher.next_batch(timeout=0.25)
+            if batch is None:
+                if self.batcher._closed and self.batcher.depth == 0:
+                    return
+                continue
+            t0 = time.monotonic()
+            try:
+                with self.metrics.span("batch[%d]" % batch.bucket):
+                    outs = self.pool.run(batch.inputs, replica=replica)
+                batch.finish(outs)
+                self.metrics.counter("requests_completed").inc(
+                    len(batch.items))
+                self.metrics.histogram("batch_exec_ms").observe(
+                    (time.monotonic() - t0) * 1e3)
+                for it in batch.items:
+                    self.metrics.histogram("request_latency_ms").observe(
+                        (time.monotonic() - it.t_enqueue) * 1e3)
+            except Exception as exc:  # answer, don't kill the worker
+                batch.fail(exc)
+                self.metrics.counter("requests_failed").inc(
+                    len(batch.items))
+
+    # ------------------------------------------------------------ client
+    def predict(self, inputs, timeout=None):
+        """Synchronous single-request inference: dict of arrays (leading
+        dim = #examples, usually 1) -> list of numpy outputs. Raises
+        QueueFull under backpressure, TimeoutError past ``timeout``."""
+        if self._closed:
+            raise BatcherClosed("serving session is closed")
+        timeout = timeout if timeout is not None else self.default_timeout
+        self.metrics.counter("requests_received").inc()
+        item = self.batcher.submit(inputs, timeout=timeout)
+        return item.wait(timeout)
+
+    def predict_async(self, inputs, timeout=None):
+        """Enqueue and return the WorkItem future (``.wait(timeout)``)."""
+        if self._closed:
+            raise BatcherClosed("serving session is closed")
+        self.metrics.counter("requests_received").inc()
+        return self.batcher.submit(inputs, timeout=timeout)
+
+    def stats(self):
+        return self.metrics.to_dict()
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def close(self, drain=True):
+        """Graceful shutdown: refuse new work, flush the queue, join the
+        dispatchers. With ``drain=False`` pending requests are failed."""
+        if self._closed:
+            return
+        self._closed = True
+        from .. import executor as _executor
+        _executor.remove_build_listener(self._build_listener)
+        if not drain:
+            self.batcher.abort(BatcherClosed("serving session shut down"))
+        self.batcher.close()
+        for w in self._workers:
+            w.join(timeout=30)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+# ---------------------------------------------------------------- HTTP
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "mxtpu-serving/1.0"
+
+    def _json(self, code, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # quiet by default; metrics carry the signal
+        pass
+
+    def do_GET(self):
+        session = self.server.session
+        if self.path in ("/healthz", "/"):
+            if session.closed:
+                self._json(503, {"status": "draining"})
+            else:
+                self._json(200, {"status": "ok",
+                                 "replicas": len(session.pool),
+                                 "buckets": list(session.buckets)})
+        elif self.path in ("/v1/metrics", "/metrics"):
+            self._json(200, session.stats())
+        else:
+            self._json(404, {"error": "unknown path %s" % self.path})
+
+    def do_POST(self):
+        session = self.server.session
+        if self.path not in ("/v1/predict", "/predict"):
+            self._json(404, {"error": "unknown path %s" % self.path})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(payload, dict) or \
+                    not isinstance(payload.get("inputs"), dict):
+                raise ValueError("body must be {\"inputs\": {name: array}}")
+            raw = payload["inputs"]
+            inputs = {k: _np.asarray(v, dtype=_np.float32)
+                      for k, v in raw.items()}
+            timeout = payload.get("timeout_sec",
+                                  self.server.request_timeout)
+            if timeout is not None:
+                timeout = float(timeout)
+        except (ValueError, TypeError, KeyError, IndexError) as exc:
+            self._json(400, {"error": str(exc)})
+            return
+        try:
+            outs = session.predict(inputs, timeout=timeout)
+            self._json(200, {"outputs": [o.tolist() for o in outs]})
+        except QueueFull as exc:
+            self._json(429, {"error": str(exc)})
+        except TimeoutError as exc:
+            self._json(504, {"error": str(exc)})
+        except BatcherClosed as exc:
+            self._json(503, {"error": str(exc)})
+        except MXNetError as exc:
+            self._json(400, {"error": str(exc)})
+        except Exception as exc:  # backend failure (XLA error, OOM, ...)
+            # the client must get a JSON 500, never a reset socket
+            self._json(500, {"error": "%s: %s"
+                             % (type(exc).__name__, exc)})
+
+
+class ServingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer bound to a ServingSession. ``shutdown`` drains
+    the session before the socket closes."""
+
+    daemon_threads = True
+
+    def __init__(self, session, host="127.0.0.1", port=0,
+                 request_timeout=30.0):
+        super().__init__((host, port), _Handler)
+        self.session = session
+        self.request_timeout = request_timeout
+
+    @property
+    def endpoint(self):
+        return "http://%s:%d" % self.server_address[:2]
+
+    def shutdown(self):
+        self.session.close(drain=True)
+        super().shutdown()
+
+
+def serve(symbol_json, params, example_shapes, host="127.0.0.1", port=8080,
+          block=True, **session_kwargs):
+    """One-call entry point: build the session, bind the socket, serve.
+    With ``block=False`` returns the running server (serving on a daemon
+    thread); call ``server.shutdown()`` to drain and stop."""
+    session = ServingSession(symbol_json, params, example_shapes,
+                             **session_kwargs)
+    server = ServingHTTPServer(session, host=host, port=port)
+    if not block:
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        return server
+    try:
+        server.serve_forever()
+    finally:
+        server.shutdown()
+    return server

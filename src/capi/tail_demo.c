@@ -282,10 +282,25 @@ int main(void) {
   CHECK(MXInitPSEnv(1, env_keys, env_vals) == 0);
   NDArrayHandle none_h;
   CHECK(MXNDArrayCreateNone(&none_h) == 0);
+  /* functional Rtc: kernel source is jax Python (inputs in scope, assign
+   * every output); geometry args are accepted and ignored under XLA */
   RtcHandle rtc;
-  CHECK(MXRtcCreate((char *)"k", 0, 0, NULL, NULL, NULL, NULL,
-                    (char *)"__global__", &rtc) == -1);
-  CHECK(strstr(MXGetLastError(), "mx.rtc") != NULL);
+  char *rtc_in[] = {(char *)"x"};
+  char *rtc_out[] = {(char *)"y"};
+  mx_uint rshape[] = {3};
+  NDArrayHandle rtc_x, rtc_y;
+  CHECK(MXNDArrayCreate(rshape, 1, 1, 0, 0, 0, &rtc_x) == 0);
+  CHECK(MXNDArrayCreate(rshape, 1, 1, 0, 0, 0, &rtc_y) == 0);
+  float rvals_in[3] = {1.0f, -2.0f, 3.5f};
+  CHECK(MXNDArraySyncCopyFromCPU(rtc_x, rvals_in, sizeof(rvals_in)) == 0);
+  CHECK(MXRtcCreate((char *)"scale2", 1, 1, rtc_in, rtc_out, &rtc_x,
+                    &rtc_y, (char *)"y = x * 2.0", &rtc) == 0);
+  CHECK(MXRtcPush(rtc, 1, 1, &rtc_x, &rtc_y, 1, 1, 1, 1, 1, 1) == 0);
+  float rvals_out[3];
+  CHECK(MXNDArraySyncCopyToCPU(rtc_y, rvals_out, sizeof(rvals_out)) == 0);
+  for (int i = 0; i < 3; ++i)
+    CHECK(rvals_out[i] == rvals_in[i] * 2.0f);
+  CHECK(MXRtcFree(rtc) == 0);
   CHECK(MXNotifyShutdown() == 0);
 
   printf("TAIL OK (updater=%d monitor=%d)\n", g_updater_calls,

@@ -63,6 +63,7 @@ def test_export_runs_without_mxtpu(tmp_path):
         import numpy as np
         sys.modules['mxtpu'] = None  # poison: importing mxtpu must fail
         import jax
+        import jax.export  # explicit: plain `import jax` skips it on <0.5
         path, xpath = sys.argv[1], sys.argv[2]
         with open(path, 'rb') as f:
             assert f.read(8) == b'MXTPUAOT'

@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Benchmark: dynamic-batching server vs the one-at-a-time Predictor.
+
+Drives N concurrent clients (default 32) through both deployment surfaces
+over the same request stream:
+
+  baseline  — the pre-serving surface: ONE Predictor, batch-1 forwards,
+              requests serialized through a lock (the single-request
+              C-predict-API deployment model)
+  serving   — ServingSession: dynamic batcher -> bucketed executor pool
+
+Writes BENCH_serving.json with sustained throughput, p50/p99 latency,
+batch-fill ratio and executor-cache hit rate. Acceptance: serving >= 3x
+baseline throughput at 32 concurrent CPU clients.
+
+Usage: python tools/bench_serving.py [--model lenet] [--clients 32]
+       [--requests 512] [--out BENCH_serving.json]
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from mxtpu.models.serving_fixtures import get_fixture  # noqa: E402
+from mxtpu.predict import Predictor  # noqa: E402
+from mxtpu.serving import ServingSession  # noqa: E402
+
+
+def _percentile(samples, p):
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(round(p / 100.0 * (len(s) - 1))))]
+
+
+def _drive(n_clients, n_requests, ex_shape, make_request):
+    """n_clients threads issue n_requests total (payloads precomputed so
+    the timed region measures the serving stack, not request synthesis);
+    returns (wall_sec, latencies_ms)."""
+    per_client = max(1, n_requests // n_clients)
+    payloads = []
+    for i in range(n_clients):
+        rng = np.random.RandomState(i)
+        payloads.append([rng.rand(*ex_shape).astype(np.float32)
+                         for _ in range(per_client)])
+    all_lats = [None] * n_clients
+
+    def worker(idx):
+        lats = []
+        for x in payloads[idx]:
+            t0 = time.time()
+            make_request(x)
+            lats.append((time.time() - t0) * 1e3)
+        all_lats[idx] = lats
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_clients)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.time() - t0
+    lats = [l for ls in all_lats for l in ls]
+    return wall, lats, len(lats)  # actual issued count, not n_requests
+
+
+def _median(xs):
+    s = sorted(xs)
+    return s[len(s) // 2]
+
+
+def bench(model="lenet", n_clients=32, n_requests=512, max_delay_ms=5.0,
+          buckets=(1, 8, 32, 128), trials=3):
+    """Median-of-``trials`` throughput per side (thread scheduling and
+    lock-convoy luck make single closed-loop trials noisy)."""
+    sym_json, params, shapes = get_fixture(model)
+    ex_shape = tuple(shapes["data"])
+
+    # ---------------- baseline: single-request predictor, serialized
+    base_pred = Predictor(sym_json, dict(params),
+                          input_shapes={"data": ex_shape})
+    base_pred.forward(data=np.zeros(ex_shape, np.float32))  # warm the jit
+    base_pred.get_output(0)
+    base_lock = threading.Lock()
+
+    def base_request(x):
+        with base_lock:
+            base_pred.forward(data=x)
+            return base_pred.get_output(0)
+
+    base_walls, base_lats = [], []
+    for _ in range(trials):
+        wall, lats, issued = _drive(n_clients, n_requests, ex_shape,
+                                    base_request)
+        base_walls.append(wall)
+        base_lats.extend(lats)
+    base_wall = _median(base_walls)
+
+    # ---------------- serving: dynamic batching pipeline
+    sess = ServingSession(sym_json, params, shapes, buckets=buckets,
+                          max_delay_ms=max_delay_ms,
+                          max_queue=max(256, n_clients * 4))
+
+    def serve_request(x):
+        return sess.predict({"data": x}, timeout=120)
+
+    serve_walls, serve_lats = [], []
+    for _ in range(trials):
+        wall, lats, issued = _drive(n_clients, n_requests, ex_shape,
+                                    serve_request)
+        serve_walls.append(wall)
+        serve_lats.extend(lats)
+    serve_wall = _median(serve_walls)
+    stats = sess.stats()
+    sess.close()
+
+    result = {
+        "model": model,
+        "clients": n_clients,
+        "requests": issued,
+        "trials": trials,
+        "buckets": list(buckets),
+        "max_delay_ms": max_delay_ms,
+        "replicas": stats["replicas"],
+        "baseline": {
+            "throughput_rps": round(issued / base_wall, 2),
+            "p50_ms": round(_percentile(base_lats, 50), 3),
+            "p99_ms": round(_percentile(base_lats, 99), 3),
+        },
+        "serving": {
+            "throughput_rps": round(issued / serve_wall, 2),
+            "p50_ms": round(_percentile(serve_lats, 50), 3),
+            "p99_ms": round(_percentile(serve_lats, 99), 3),
+            "batch_fill_ratio": stats["batch_fill_ratio"],
+            "executor_cache_hit_rate": stats["executor_cache_hit_rate"],
+            "batches_formed": stats["batches_formed"],
+        },
+    }
+    result["speedup"] = round(
+        result["serving"]["throughput_rps"]
+        / result["baseline"]["throughput_rps"], 2)
+    return result
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="lenet",
+                    help="serving fixture: mlp | lenet | resnet")
+    ap.add_argument("--clients", type=int, default=32)
+    ap.add_argument("--requests", type=int, default=512)
+    ap.add_argument("--max-delay-ms", type=float, default=5.0)
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--out", default=None,
+                    help="write JSON here (default: print only)")
+    args = ap.parse_args(argv)
+    result = bench(model=args.model, n_clients=args.clients,
+                   n_requests=args.requests, max_delay_ms=args.max_delay_ms,
+                   trials=args.trials)
+    print(json.dumps(result, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        print("wrote %s" % args.out)
+    return 0 if result["speedup"] >= 3.0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
