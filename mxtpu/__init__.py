@@ -34,6 +34,7 @@ from . import symbol_doc
 from . import log
 from . import registry
 from . import libinfo
+from . import telemetry
 from .executor import Executor
 
 # subsystems imported lazily-but-eagerly; order matters (no cycles)

@@ -8,6 +8,8 @@ import math
 import sys
 import time
 
+from . import telemetry as _tel
+
 
 def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
     period = int(max(1, period))
@@ -45,24 +47,45 @@ def log_train_metric(period, auto_reset=False):
 
 
 class Speedometer:
-    """Samples/sec logger (role parity with the reference's batch-end
-    speed callback, python/mxnet/callback.py:120; re-implemented around a
-    rolling window timer rather than the reference's init/tic state
-    machine)."""
+    """Windowed samples/sec (role parity with the reference's batch-end
+    speed callback, python/mxnet/callback.py:120; rolling window timer
+    rather than the reference's init/tic state machine).
 
-    def __init__(self, batch_size, frequent=50, auto_reset=True):
+    Rewritten around the telemetry registry: every window emits
+    structured series — ``train_samples_per_sec`` (gauge),
+    ``train_window_samples_per_sec`` (histogram: the DISTRIBUTION of
+    window throughput, so a p50-vs-min gap exposes input stalls), and one
+    ``train_metric{metric=...}`` gauge per eval-metric pair — instead of
+    being a string-only sink. The classic log line is kept (``log=False``
+    silences it); dashboards read ``/metrics``, humans read the log."""
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True, log=True):
         self.batch_size = batch_size
         self.frequent = max(1, int(frequent))
         self.auto_reset = auto_reset
+        self.log = log
         self._window_start = None  # wall time at the start of the window
         self._prev_nbatch = -1
 
     def _emit(self, param, speed):
         metric = getattr(param, "eval_metric", None)
         pairs = metric.get_name_value() if metric is not None else []
-        extra = "".join("\t%s=%g" % (k, v) for k, v in pairs)
-        logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
-                     param.epoch, param.nbatch, speed, extra)
+        _tel.gauge("train_samples_per_sec",
+                   help="Speedometer window throughput").set(speed)
+        _tel.histogram(
+            "train_window_samples_per_sec",
+            bounds=(1, 10, 100, 1e3, 1e4, 1e5, 1e6, 1e7, float("inf")),
+            help="distribution of window throughput").observe(speed)
+        for k, v in pairs:
+            try:
+                _tel.gauge("train_metric", labels={"metric": k}).set(
+                    float(v))
+            except (TypeError, ValueError):
+                pass  # non-scalar custom metric: registry stays numeric
+        if self.log:
+            extra = "".join("\t%s=%g" % (k, v) for k, v in pairs)
+            logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
+                         param.epoch, param.nbatch, speed, extra)
         if pairs and self.auto_reset:
             metric.reset()
 

@@ -22,9 +22,12 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
+import time
 
 from . import _native
 from ._native import check_call
+from . import telemetry as _tel
+from .telemetry import tracing as _tracing
 
 
 class Var:
@@ -37,6 +40,24 @@ class Var:
         self._engine = engine
 
 
+# Engine telemetry series: registered ONCE at module scope, registry-
+# direct (immune to MXTPU_TELEMETRY=0 at import — the series must exist
+# for /metrics even in a process that started bare), and shared by every
+# engine instance. The gauges read the process SINGLETON (tests that
+# construct throwaway engines directly never capture them), so a dead
+# instance is neither pinned by a closure nor able to shadow the live
+# engine's queue depth.
+_M_DISPATCHED = _tel.registry().counter(
+    "engine_ops_dispatched", help="ops pushed into the engine")
+_M_COMPLETED = _tel.registry().counter(
+    "engine_ops_completed", help="op callbacks finished")
+_M_QUEUE_WAIT = _tel.registry().histogram(
+    "engine_queue_wait_ms", help="push -> dispatch latency")
+_M_BUSY = _tel.registry().counter(
+    "engine_worker_busy_ms", help="total ms spent inside op callbacks; "
+    "idle time = wall * workers - busy")
+
+
 class NaiveEngine:
     """Fully synchronous engine (parity: src/engine/naive_engine.cc:34)."""
 
@@ -47,7 +68,13 @@ class NaiveEngine:
         pass
 
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
-        fn()
+        _M_DISPATCHED.inc()
+        t0 = time.perf_counter()
+        with _tracing.span("engine.dispatch", category="engine"):
+            fn()
+        _M_BUSY.inc((time.perf_counter() - t0) * 1e3)
+        _M_QUEUE_WAIT.observe(0.0)
+        _M_COMPLETED.inc()
 
     def wait_for_var(self, var):
         pass
@@ -87,9 +114,19 @@ class ThreadedEngine:
     def _dispatch(self, ctx):
         token = int(ctx) if ctx is not None else 0
         with self._pending_lock:
-            fn = self._pending.pop(token, None)
-        if fn is not None:
-            fn()
+            entry = self._pending.pop(token, None)
+        if entry is not None:
+            fn, t_push, parent = entry
+            t0 = time.perf_counter()
+            _M_QUEUE_WAIT.observe((t0 - t_push) * 1e3)
+            # the pushing thread's span was captured at push time; running
+            # the callback as its child stitches the native-thread hop into
+            # one trace (engine push -> worker dispatch)
+            with _tracing.span("engine.dispatch", category="engine",
+                               parent=parent):
+                fn()
+            _M_BUSY.inc((time.perf_counter() - t0) * 1e3)
+            _M_COMPLETED.inc()
 
     def new_variable(self):
         h = ctypes.c_void_p()
@@ -102,10 +139,12 @@ class ThreadedEngine:
             var.handle = None
 
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        _M_DISPATCHED.inc()
         with self._pending_lock:
             self._next_token += 1
             token = self._next_token  # nonzero: ctx NULL maps to token 0
-            self._pending[token] = fn
+            self._pending[token] = (fn, time.perf_counter(),
+                                    _tracing.current_span())
         n_c, n_m = len(const_vars), len(mutable_vars)
         cv = (ctypes.c_void_p * max(n_c, 1))(
             *[v.handle for v in const_vars]) if n_c else None
@@ -137,6 +176,23 @@ class ThreadedEngine:
 
 _ENGINE = None
 _ENGINE_LOCK = threading.Lock()
+
+
+def _singleton_queue_depth():
+    e = _ENGINE
+    return len(e._pending) if isinstance(e, ThreadedEngine) else 0
+
+
+def _singleton_workers():
+    e = _ENGINE
+    return e.num_workers if e is not None else 0
+
+
+_tel.registry().gauge("engine_queue_depth", fn=_singleton_queue_depth,
+                      help="ops pushed but not yet dispatched to a worker")
+_tel.registry().gauge("engine_workers", fn=_singleton_workers,
+                      help="native scheduler worker threads "
+                      "(0 = NaiveEngine)")
 
 
 def get():

@@ -25,9 +25,22 @@ from .base import MXNetError
 from .context import Context, current_context
 from .ndarray import NDArray, zeros
 from . import random as _rnd
+from . import telemetry as _tel
+from .telemetry import tracing as _tracing
 
 __all__ = ["Executor", "add_build_listener", "remove_build_listener",
-           "program_build_count"]
+           "program_build_count", "record_program_build"]
+
+# standing series: registry-direct so they exist for /metrics even when
+# MXTPU_TELEMETRY=0 was set at import (the flag silences the helper-
+# mediated per-batch sites; these build/hit counters are too cheap and
+# too load-bearing for cache observability to disappear with it)
+_M_CACHE_HITS = _tel.registry().counter(
+    "executor_program_cache_hits",
+    help="per-executor program-table hits (no retrace, no compile)")
+_M_BUILDS_TOTAL = _tel.registry().counter(
+    "executor_program_builds_total",
+    help="traced-program constructions (each compiles on first dispatch)")
 
 # ---------------------------------------------------------------- cache hooks
 # Program-construction observability for the serving layer: every time an
@@ -60,11 +73,46 @@ def program_build_count():
 def _notify_build(kind, executor):
     with _BUILD_LOCK:  # concurrent replica builds must not lose counts
         _BUILD_COUNT[0] += 1
+    _M_BUILDS_TOTAL.inc()
+    _tel.registry().counter("executor_program_builds",
+                            labels={"kind": kind}).inc()
     for fn in list(_BUILD_LISTENERS):
         try:
             fn(kind, executor)
         except Exception:
             pass
+
+
+def record_program_build(kind, owner, fn):
+    """Public build-seam entry for program tables OUTSIDE Executor (the
+    fused train step): bump the build counters, notify the listeners,
+    and wrap ``fn`` for first-call compile timing — the exact sequence
+    ``_get_fn`` performs, so every traced-program construction in the
+    process reports through one seam."""
+    _notify_build(kind, owner)
+    return _time_first_call(kind, fn)
+
+
+def _time_first_call(kind, fn):
+    """Wrap a freshly built program so its FIRST invocation — the one
+    that pays jit tracing + XLA compilation — lands in the
+    ``executor_compile_ms{kind=...}`` histogram. Steady-state calls go
+    straight through (one attribute read of overhead)."""
+    import time as _time
+    state = {"first": True}
+
+    def wrapped(*args, **kwargs):
+        if state["first"]:
+            state["first"] = False
+            t0 = _time.perf_counter()
+            out = fn(*args, **kwargs)
+            _tel.histogram("executor_compile_ms",
+                           labels={"kind": kind}).observe(
+                (_time.perf_counter() - t0) * 1e3)
+            return out
+        return fn(*args, **kwargs)
+
+    return wrapped
 
 
 def _with_matmul_precision(fn):
@@ -297,6 +345,7 @@ class Executor:
     def _get_fn(self, kind):
         fn = self._fns.get(kind)
         if fn is not None:
+            _M_CACHE_HITS.inc()
             return fn
         _notify_build(kind, self)
         if kind == "fwd_eval":
@@ -382,7 +431,7 @@ class Executor:
             fn = jax.jit(va)
         else:
             raise MXNetError("unknown program kind %s" % kind)
-        fn = _with_matmul_precision(fn)
+        fn = _time_first_call(kind, _with_matmul_precision(fn))
         self._fns[kind] = fn
         return fn
 
@@ -420,11 +469,15 @@ class Executor:
         import time as _time
 
         def trace_hook(node, call):
-            t0 = _time.perf_counter() * 1e6
+            # wall-clock start (the dump's shared timebase — profiler
+            # scopes and telemetry spans use time.time too), monotonic
+            # duration (NTP-step safe)
+            t0_wall = _time.time() * 1e6
+            t0 = _time.perf_counter()
             outs = call()
             jax.block_until_ready(outs)
-            _prof.record_span(node.name or node.op.name,
-                              t0, _time.perf_counter() * 1e6,
+            _prof.record_span(node.name or node.op.name, t0_wall,
+                              t0_wall + (_time.perf_counter() - t0) * 1e6,
                               category=node.op.name)
             return outs
 
@@ -441,6 +494,13 @@ class Executor:
 
     # -------------------------------------------------- public API
     def forward(self, is_train=False, **kwargs):
+        # correlated span: nests under the caller's ambient span (a
+        # module fit step, a serving batch) and parents any engine /
+        # kvstore spans the program triggers
+        with _tracing.span("executor.forward", category="executor"):
+            return self._forward_impl(is_train, **kwargs)
+
+    def _forward_impl(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
             if k in self.arg_dict:
                 self.arg_dict[k]._data = v._data if isinstance(v, NDArray) \
@@ -490,6 +550,11 @@ class Executor:
         return self._wrap_outputs(outs)
 
     def backward(self, out_grads=None, is_train=True):
+        with _tracing.span("executor.backward", category="executor"):
+            return self._backward_impl(out_grads=out_grads,
+                                       is_train=is_train)
+
+    def _backward_impl(self, out_grads=None, is_train=True):
         if not self._grad_arg_names():
             return
         if out_grads is None:

@@ -11,12 +11,14 @@ import gzip
 import os
 import struct
 import threading
+import time as _time
 
 import numpy as _np
 
 from .base import MXNetError, Registry
 from . import ndarray as nd
 from .ndarray import NDArray
+from . import telemetry as _tel
 
 
 class DataDesc:
@@ -226,10 +228,18 @@ class PrefetchingIter(DataIter):
             e.set()
 
     def iter_next(self):
+        # time blocked on the producer threads: a healthy pipeline shows
+        # ~zero stall (the batch was ready before the consumer asked)
+        t0 = _time.perf_counter()
         for e in self.data_ready:
             e.wait()
+        _tel.histogram("io_prefetch_stall_ms",
+                       help="consumer wait for the prefetch thread"
+                       ).observe((_time.perf_counter() - t0) * 1e3)
         if self.next_batch[0] is None:
             return False
+        _tel.counter("io_batches", labels={"iter": "PrefetchingIter"},
+                     help="batches produced").inc()
         self.current_batch = DataBatch(
             sum([b.data for b in self.next_batch], []),
             sum([b.label for b in self.next_batch], []),
@@ -332,8 +342,15 @@ class NDArrayIter(DataIter):
 
     def next(self):
         if self.iter_next():
-            return DataBatch(data=self.getdata(), label=self.getlabel(),
-                             pad=self.getpad(), index=None)
+            t0 = _time.perf_counter()
+            batch = DataBatch(data=self.getdata(), label=self.getlabel(),
+                              pad=self.getpad(), index=None)
+            _tel.histogram("io_batch_assemble_ms",
+                           help="host-side slice+stage time per batch"
+                           ).observe((_time.perf_counter() - t0) * 1e3)
+            _tel.counter("io_batches", labels={"iter": "NDArrayIter"},
+                         help="batches produced").inc()
+            return batch
         raise StopIteration
 
     def _getdata(self, data_source):

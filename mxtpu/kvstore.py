@@ -28,12 +28,25 @@ from __future__ import annotations
 import pickle
 
 import jax
+import numpy as _np
 
 from .base import MXNetError
 from .ndarray import NDArray, zeros
 from . import optimizer as opt
+from . import telemetry as _tel
+from .telemetry import tracing as _tracing
 
 __all__ = ["KVStore", "create"]
+
+
+def _nbytes(arr):
+    """Payload size of an NDArray/array-like (shape x itemsize)."""
+    try:
+        shape = arr.shape
+        return int(_np.prod(shape)) * _np.dtype(arr.dtype).itemsize \
+            if shape else _np.dtype(arr.dtype).itemsize
+    except Exception:
+        return 0
 
 
 def _is_dist():
@@ -183,10 +196,20 @@ class KVStore:
         """Aggregate pushed values per key; run updater if set, else assign-sum
         (parity KVStoreLocal::PushImpl kvstore_local.h:149; dist path
         KVStoreDist::Push_ kvstore_dist.h:256)."""
+        with _tracing.span("kvstore.push", category="kvstore") as sp:
+            self._push_impl(key, value, priority)
+        _tel.histogram("kvstore_push_ms",
+                       help="per push() call latency").observe(
+            sp.duration_ms)
+
+    def _push_impl(self, key, value, priority):
+        bytes_pushed = _tel.counter("kvstore_push_bytes",
+                                    help="aggregated gradient bytes pushed")
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             vlist = v if isinstance(v, list) else [v]
             merged = self._local_merge(vlist)
+            bytes_pushed.inc(_nbytes(merged))
             if self._client is not None:
                 self._client.push(k, merged.asnumpy())
                 continue
@@ -205,8 +228,17 @@ class KVStore:
                 self._store[k]._data = merged._data
 
     def pull(self, key, out=None, priority=0):
+        with _tracing.span("kvstore.pull", category="kvstore") as sp:
+            self._pull_impl(key, out, priority)
+        _tel.histogram("kvstore_pull_ms",
+                       help="per pull() call latency").observe(
+            sp.duration_ms)
+
+    def _pull_impl(self, key, out, priority):
         if out is None:
             raise MXNetError("pull: out is required")
+        bytes_pulled = _tel.counter("kvstore_pull_bytes",
+                                    help="weight bytes pulled to devices")
         keys, outs = self._normalize(key, out)
         for k, o in zip(keys, outs):
             if self._client is not None:
@@ -216,11 +248,13 @@ class KVStore:
                 for dst in olist:
                     dst._data = jax.device_put(jnp.asarray(src_np),
                                                dst.context.jax_device)
+                    bytes_pulled.inc(_nbytes(dst))
                 continue
             src = self._store[k]
             olist = o if isinstance(o, list) else [o]
             for dst in olist:
                 dst._data = jax.device_put(src._data, dst.context.jax_device)
+                bytes_pulled.inc(_nbytes(dst))
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows (parity KVStore::PullRowSparse,

@@ -9,8 +9,10 @@ import time
 
 from .. import metric as _metric
 from .. import ndarray as nd
+from .. import telemetry as _tel
 from ..base import MXNetError
 from ..model import BatchEndParam
+from ..telemetry import tracing as _tracing
 
 
 def _check_input_names(symbol, names, typename, throw):
@@ -150,10 +152,23 @@ class BaseModule:
         if not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
 
+        # one pipeline for training and serving: fit emits into the same
+        # process-wide registry the serving /metrics endpoint scrapes
+        step_ms = _tel.histogram("fit_step_ms",
+                                 help="forward+backward+update wall time")
+        samples_total = _tel.counter("fit_samples",
+                                     help="training examples consumed")
+        sps_gauge = _tel.gauge("fit_samples_per_sec",
+                               help="epoch-level training throughput")
+        eval_ms = _tel.histogram("fit_eval_ms",
+                                 help="validation pass wall time")
+        epochs_done = _tel.counter("fit_epochs", help="epochs completed")
+
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
             nbatch = 0
+            epoch_samples = 0
             data_iter = iter(train_data)
             end_of_batch = False
             next_data_batch = next(data_iter)
@@ -161,8 +176,16 @@ class BaseModule:
                 data_batch = next_data_batch
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
+                # fit.step is the correlation root for everything one
+                # batch triggers (executor.forward -> engine dispatches,
+                # kvstore push/pull inside update)
+                with _tracing.span("fit.step", category="module") as sp:
+                    self.forward_backward(data_batch)
+                    self.update()
+                step_ms.observe(sp.duration_ms)
+                if data_batch.data:
+                    epoch_samples += data_batch.data[0].shape[0] - \
+                        (data_batch.pad or 0)
                 try:
                     next_data_batch = next(data_iter)
                     self.prepare(next_data_batch)
@@ -183,6 +206,10 @@ class BaseModule:
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             toc = time.time()
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            samples_total.inc(epoch_samples)
+            epochs_done.inc()
+            if toc > tic:
+                sps_gauge.set(epoch_samples / (toc - tic))
 
             arg_params_out, aux_params_out = self.get_params()
             self.set_params(arg_params_out, aux_params_out)
@@ -191,10 +218,12 @@ class BaseModule:
                     callback(epoch, self.symbol, arg_params_out, aux_params_out)
 
             if eval_data:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
+                with _tracing.span("fit.eval", category="module") as sp:
+                    res = self.score(eval_data, validation_metric,
+                                     score_end_callback=eval_end_callback,
+                                     batch_end_callback=eval_batch_end_callback,
+                                     epoch=epoch)
+                eval_ms.observe(sp.duration_ms)
                 for name, val in res:
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name,
                                      val)

@@ -405,7 +405,14 @@ class FusedTrainStep:
             for n, v in zip(names, arrs):
                 batch[n] = self._put(getattr(v, "_data", v), spec)
         if self._step_fn is None:
+            # route through the executor's build seam: program_build_count,
+            # the build listeners, the telemetry build counters and the
+            # first-call compile histogram all stay consistent with the
+            # Executor program-table path
+            from ..executor import record_program_build
             self._build()
+            self._step_fn = record_program_build("fused_step", self,
+                                                 self._step_fn)
         self.params, self.aux, self.opt_state, outs = self._step_fn(
             self.params, self.aux, self.opt_state, batch,
             self._put(lrs), self._put(wds), _rnd.next_key())

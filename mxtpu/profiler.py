@@ -23,6 +23,7 @@ import jax
 _state = {"mode": "symbolic", "filename": "profile.json", "running": False,
           "jax_trace": False, "aggregate_stats": False}
 _events = []
+_agg = {}  # name -> telemetry Histogram of span ms (aggregate_stats mode)
 _lock = threading.Lock()
 
 _OP_MODES = ("symbolic", "imperative", "operator", "all")
@@ -31,10 +32,19 @@ _OP_MODES = ("symbolic", "imperative", "operator", "all")
 def profiler_set_config(mode="symbolic", filename="profile.json",
                         aggregate_stats=False, **kwargs):
     """Parity MXSetProfilerConfig(kwargs): mode 'symbolic'|'imperative'|
-    'operator'|'api'|'all', output filename, optional aggregate stats."""
+    'operator'|'api'|'all', output filename, optional aggregate stats.
+
+    With ``aggregate_stats=True`` every span is ALSO folded, at record
+    time, into a per-name fixed-bucket histogram (mxtpu.telemetry) —
+    O(1) memory per layer, so ``dumps()`` keeps its per-layer table even
+    after the raw event list is dumped or truncated (the reference's
+    MXAggregateProfileStats contract)."""
     _state["mode"] = mode
     _state["filename"] = filename
     _state["aggregate_stats"] = bool(aggregate_stats)
+    if _state["aggregate_stats"]:
+        with _lock:
+            _agg.clear()  # fresh aggregation session
 
 
 def profiler_set_state(state="stop"):
@@ -85,18 +95,30 @@ def _thread_tid():
         return tid
 
 
-def record_span(name, begin_us, end_us, category="operator", tid=None):
+def record_span(name, begin_us, end_us, category="operator", tid=None,
+                args=None):
     """Record one op-level span (called by instrumented paths). ``tid``
-    defaults to a per-thread lane."""
+    defaults to a per-thread lane. ``args`` (e.g. telemetry trace/span
+    ids) ride on the B event — chrome://tracing shows them on click, so
+    correlated spans can be followed across thread lanes."""
     if not _state["running"]:
         return
     if tid is None:
         tid = _thread_tid()
+    begin = {"name": name, "cat": category, "ph": "B",
+             "ts": begin_us, "pid": 0, "tid": tid}
+    if args:
+        begin["args"] = dict(args)
     with _lock:
-        _events.append({"name": name, "cat": category, "ph": "B",
-                        "ts": begin_us, "pid": 0, "tid": tid})
+        _events.append(begin)
         _events.append({"name": name, "cat": category, "ph": "E",
                         "ts": end_us, "pid": 0, "tid": tid})
+        if _state["aggregate_stats"]:
+            h = _agg.get(name)
+            if h is None:
+                from .telemetry.metrics import Histogram
+                h = _agg[name] = Histogram(name)
+            h.observe((end_us - begin_us) / 1e3)
 
 
 class scope:
@@ -128,7 +150,28 @@ dump = dump_profile
 
 def dumps(reset=False):
     """Aggregate per-op statistics table as text (parity MXAggregateProfile
-    StatsToString: name, count, total/avg/min/max ms)."""
+    StatsToString: name, count, total/avg/min/max ms).
+
+    With ``aggregate_stats`` configured, the table is served from the
+    standing per-layer histograms — it survives ``dump_profile`` and event
+    truncation, and gains P50/P90/P99 columns. Otherwise it is recomputed
+    from the raw in-memory events (pre-existing behavior)."""
+    if _state["aggregate_stats"]:
+        with _lock:
+            hists = dict(_agg)
+            if reset:
+                _agg.clear()
+                _events.clear()
+        lines = ["%-40s %8s %12s %12s %12s %12s %12s %12s %12s" %
+                 ("Name", "Count", "Total(ms)", "Avg(ms)", "Min(ms)",
+                  "Max(ms)", "P50(ms)", "P90(ms)", "P99(ms)")]
+        for name in sorted(hists, key=lambda n: -hists[n].sum):
+            h = hists[name]
+            lines.append(
+                "%-40s %8d %12.3f %12.3f %12.3f %12.3f %12.3f %12.3f %12.3f"
+                % (name[:40], h.count, h.sum, h.mean, h.min, h.max,
+                   h.percentile(50), h.percentile(90), h.percentile(99)))
+        return "\n".join(lines)
     stats = {}
     with _lock:
         spans = {}
@@ -155,6 +198,14 @@ def dumps(reset=False):
     return "\n".join(lines)
 
 
+def aggregate_stats_snapshot():
+    """The standing per-layer histograms of aggregate_stats mode
+    (name -> telemetry Histogram); empty dict when not configured."""
+    with _lock:
+        return dict(_agg)
+
+
 def clear():
     with _lock:
         _events.clear()
+        _agg.clear()

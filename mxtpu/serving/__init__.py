@@ -10,9 +10,12 @@ Predictors become a high-throughput multi-replica service. Pieces:
   * ``server``   — in-process ``ServingSession`` + stdlib JSON-over-HTTP
                    front-end with backpressure and graceful drain
   * ``metrics``  — qps / batch-fill / queue-depth / latency-percentile /
-                   cache-hit observability, JSON + chrome://tracing
+                   cache-hit observability over ``mxtpu.telemetry``:
+                   Prometheus + JSON at ``/metrics``, correlated trace
+                   spans, chrome://tracing mirroring
 
-See docs/serving.md for architecture and tuning.
+See docs/serving.md for architecture and tuning; docs/observability.md
+for the framework-wide telemetry layer this plugs into.
 """
 from .batcher import (BatcherClosed, DynamicBatcher, QueueFull, WorkItem,
                       pad_rows, pick_bucket)

@@ -22,6 +22,7 @@ import time
 import numpy as _np
 
 from ..base import MXNetError
+from ..telemetry import current_span as _current_span
 
 __all__ = ["QueueFull", "BatcherClosed", "WorkItem", "Batch",
            "DynamicBatcher", "pad_rows", "pick_bucket"]
@@ -64,7 +65,7 @@ class WorkItem:
     step must never mass-expire the queue or stall the flush."""
 
     __slots__ = ("inputs", "n", "event", "outputs", "error",
-                 "t_enqueue", "expire_at")
+                 "t_enqueue", "expire_at", "span")
 
     def __init__(self, inputs, n, expire_at=None):
         self.inputs = inputs
@@ -74,6 +75,10 @@ class WorkItem:
         self.error = None
         self.t_enqueue = time.monotonic()
         self.expire_at = expire_at
+        # the submitting thread's ambient telemetry span: the dispatcher
+        # parents its batch span here, so one trace id follows a request
+        # across the queue hop (client thread -> dispatch thread)
+        self.span = _current_span()
 
     def finish(self, outputs):
         self.outputs = outputs

@@ -1,168 +1,95 @@
-"""Serving observability: counters, gauges, latency histograms.
+"""Serving observability — now a thin adapter over ``mxtpu.telemetry``.
 
-Role: the serving-layer analogue of the engine profiler — every number a
-production operator needs to tune a replica (qps, batch-fill ratio, queue
-depth, p50/p99 latency, executor-cache hit rate) lives in one registry,
-exported as JSON (``MetricsRegistry.to_dict`` → the HTTP ``/metrics``
-endpoint) and mirrored as chrome://tracing spans through the existing
-``mxtpu.profiler`` seam, so one trace shows device work AND serving
-decisions on the same timeline.
+Role: one instrumentation pipeline for the whole framework. The metric
+types and registry live in ``mxtpu.telemetry`` (shared with the engine,
+executor, Module.fit, kvstore and io instrumentation); this module keeps
+the serving-flavored surface on top:
+
+  * the legacy class names (``Counter``/``Gauge``/``Histogram``/
+    ``MetricsRegistry``) keep importing from ``mxtpu.serving``;
+  * ``MetricsRegistry.to_dict`` keeps its flat JSON shape — raw series
+    plus the derived operator numbers (qps, batch-fill ratio, executor
+    cache hit rate) and ``*_ms`` percentile keys — the stable contract
+    of the HTTP ``/v1/metrics`` endpoint;
+  * ``span`` opens a CORRELATED ``mxtpu.telemetry`` span (trace ids flow
+    request -> batch -> pool.run -> executor), still mirrored into the
+    chrome://tracing profiler dump;
+  * the registry renders as Prometheus text under the
+    ``mxtpu_serving_*`` namespace via the shared exposition layer, with
+    derived qps / hit-rate / latency-percentile gauges appended.
+
+Migration note (docs/observability.md): histograms are now fixed-bucket
+(O(1) memory) — percentiles are interpolated over ALL observations
+instead of a 4096-sample trailing window; code that reached into the
+old ``_ring`` internals must move to ``percentile()``/``snapshot()``.
 """
 from __future__ import annotations
 
-import threading
-import time
+from .. import telemetry as _tel
+from ..telemetry import Counter, Gauge, Histogram  # re-export (legacy API)
+from ..telemetry.metrics import MetricsRegistry as _BaseRegistry
 
-from .. import profiler as _prof
-
-
-class Counter:
-    """Monotonic counter (thread-safe)."""
-
-    def __init__(self, name):
-        self.name = name
-        self._v = 0
-        self._lock = threading.Lock()
-
-    def inc(self, n=1):
-        with self._lock:
-            self._v += n
-
-    @property
-    def value(self):
-        return self._v
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry"]
 
 
-class Gauge:
-    """Point-in-time value, either set explicitly or read via callback."""
-
-    def __init__(self, name, fn=None):
-        self.name = name
-        self._v = 0.0
-        self._fn = fn
-
-    def set(self, v):
-        self._v = v
-
-    @property
-    def value(self):
-        return self._fn() if self._fn is not None else self._v
-
-
-class Histogram:
-    """Latency histogram: fixed log-spaced buckets plus a bounded sample
-    ring for percentile estimates (p50/p99 from the last ``cap`` samples —
-    a serving window, not all-time, matching what an operator tunes on)."""
-
-    #: bucket upper bounds in milliseconds
-    DEFAULT_BOUNDS = (0.5, 1, 2, 5, 10, 20, 50, 100, 200, 500,
-                      1000, 2000, 5000, float("inf"))
-
-    def __init__(self, name, bounds=None, cap=4096):
-        self.name = name
-        self.bounds = tuple(bounds or self.DEFAULT_BOUNDS)
-        self.bucket_counts = [0] * len(self.bounds)
-        self.count = 0
-        self.sum = 0.0
-        self._ring = [0.0] * cap
-        self._ring_n = 0
-        self._lock = threading.Lock()
-
-    def observe(self, v):
-        with self._lock:
-            self.count += 1
-            self.sum += v
-            for i, b in enumerate(self.bounds):
-                if v <= b:
-                    self.bucket_counts[i] += 1
-                    break
-            self._ring[self._ring_n % len(self._ring)] = v
-            self._ring_n += 1
-
-    def percentile(self, p):
-        """p in [0, 100] over the sample window; 0.0 when empty."""
-        with self._lock:
-            n = min(self._ring_n, len(self._ring))
-            if n == 0:
-                return 0.0
-            samples = sorted(self._ring[:n])
-        idx = min(n - 1, max(0, int(round((p / 100.0) * (n - 1)))))
-        return samples[idx]
-
-    @property
-    def mean(self):
-        return self.sum / self.count if self.count else 0.0
-
-
-class MetricsRegistry:
-    """Named metrics + span emission for one serving session."""
+class MetricsRegistry(_BaseRegistry):
+    """Named metrics + correlated span emission for one serving session."""
 
     def __init__(self):
-        self._metrics = {}
-        self._lock = threading.Lock()
-        self._t0 = time.time()
-
-    def counter(self, name):
-        return self._get(name, Counter)
-
-    def gauge(self, name, fn=None):
-        g = self._get(name, Gauge)
-        if fn is not None:
-            g._fn = fn
-        return g
-
-    def histogram(self, name, bounds=None):
-        with self._lock:
-            m = self._metrics.get(name)
-            if m is None:
-                m = self._metrics[name] = Histogram(name, bounds=bounds)
-            return m
-
-    def _get(self, name, cls):
-        with self._lock:
-            m = self._metrics.get(name)
-            if m is None:
-                m = self._metrics[name] = cls(name)
-            return m
+        super().__init__(namespace="mxtpu_serving")
 
     def span(self, name, category="serving"):
-        """Trace-span context manager routed through mxtpu.profiler, so
-        serving events land in the same chrome://tracing dump as op spans
-        (enable with profiler.set_state('run'))."""
-        return _prof.scope(name, category=category)
+        """Correlated trace-span context manager: nests under the ambient
+        span (cross-thread parents via ``telemetry.current_span()``), is
+        mirrored into the chrome://tracing dump while the profiler runs
+        (``profiler.set_state('run')``), and lands in the process-wide
+        ``span_ms{span=...}`` histogram."""
+        return _tel.span(name, category=category)
 
-    @property
-    def uptime(self):
-        return time.time() - self._t0
+    # ---------------------------------------------------------- derived
+    def _derived(self):
+        reqs = self.counter("requests_completed").value
+        uptime = self.uptime
+        out = {"qps": round(reqs / uptime, 3) if uptime > 0 else 0.0}
+        padded = self.counter("batch_rows_padded").value
+        valid = self.counter("batch_rows_valid").value
+        total = padded + valid
+        out["batch_fill_ratio"] = round(valid / total, 4) if total else 0.0
+        hits = self.counter("executor_cache_hits").value
+        misses = self.counter("executor_cache_misses").value
+        probes = hits + misses
+        out["executor_cache_hit_rate"] = \
+            round(hits / probes, 4) if probes else 0.0
+        return out
+
+    def extra_series(self):
+        """Prometheus-side derived gauges: the operator numbers plus
+        p50/p90/p99 for every histogram (``<name>_p99`` series — the
+        acceptance surface a dashboard alerts on without running
+        histogram_quantile)."""
+        out = [(k, None, v) for k, v in self._derived().items()]
+        for m in self.series():
+            if isinstance(m, Histogram):
+                for p in (50, 90, 99):
+                    out.append(("%s_p%d" % (m.name, p), m.labels,
+                                round(m.percentile(p), 4)))
+        return out
 
     def to_dict(self):
-        """JSON-ready snapshot. Derived rates (qps, batch-fill, cache hit
-        rate) are computed here so the raw metrics stay single-writer."""
+        """JSON-ready snapshot (the ``/v1/metrics`` contract): raw series
+        flat, histograms as ``*_ms``-keyed percentile dicts, derived
+        rates computed here so the raw metrics stay single-writer."""
         out = {"uptime_sec": round(self.uptime, 3)}
-        with self._lock:
-            metrics = dict(self._metrics)
-        for name, m in sorted(metrics.items()):
-            if isinstance(m, Counter):
-                out[name] = m.value
-            elif isinstance(m, Gauge):
-                out[name] = m.value
-            elif isinstance(m, Histogram):
-                out[name] = {
+        for m in self.series():
+            if isinstance(m, Histogram):
+                out[m.name] = {
                     "count": m.count,
                     "mean_ms": round(m.mean, 3),
                     "p50_ms": round(m.percentile(50), 3),
                     "p90_ms": round(m.percentile(90), 3),
                     "p99_ms": round(m.percentile(99), 3),
                 }
-        reqs = out.get("requests_completed", 0)
-        out["qps"] = round(reqs / self.uptime, 3) if self.uptime > 0 else 0.0
-        padded = out.get("batch_rows_padded", 0)
-        valid = out.get("batch_rows_valid", 0)
-        total = padded + valid
-        out["batch_fill_ratio"] = round(valid / total, 4) if total else 0.0
-        hits = out.get("executor_cache_hits", 0)
-        misses = out.get("executor_cache_misses", 0)
-        probes = hits + misses
-        out["executor_cache_hit_rate"] = \
-            round(hits / probes, 4) if probes else 0.0
+            else:
+                out[m.name] = m.value
+        out.update(self._derived())
         return out

@@ -23,6 +23,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as _np
 
+from .. import telemetry as _tel
 from ..base import MXNetError
 from .batcher import BatcherClosed, DynamicBatcher, QueueFull
 from .metrics import MetricsRegistry
@@ -54,6 +55,10 @@ class ServingSession:
                  contexts=None, cache_size=8, warmup=True,
                  default_timeout=None):
         self.metrics = MetricsRegistry()
+        # materialize the engine singleton so its telemetry series exist
+        # before the first /metrics scrape (they read zero until traffic)
+        from .. import engine as _engine
+        _engine.get()
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self.default_timeout = default_timeout
         # the per-replica executor LRU must hold every bucket or warmup
@@ -113,7 +118,13 @@ class ServingSession:
                 continue
             t0 = time.monotonic()
             try:
-                with self.metrics.span("batch[%d]" % batch.bucket):
+                # parent the batch span on the first request's submitting
+                # span: the trace id crosses the queue hop, so a request
+                # trace shows submit -> batch -> pool.run -> executor
+                with _tel.span("batch[%d]" % batch.bucket,
+                               category="serving",
+                               parent=batch.items[0].span,
+                               tags={"n_valid": batch.n_valid}):
                     outs = self.pool.run(batch.inputs, replica=replica)
                 batch.finish(outs)
                 self.metrics.counter("requests_completed").inc(
@@ -137,8 +148,9 @@ class ServingSession:
             raise BatcherClosed("serving session is closed")
         timeout = timeout if timeout is not None else self.default_timeout
         self.metrics.counter("requests_received").inc()
-        item = self.batcher.submit(inputs, timeout=timeout)
-        return item.wait(timeout)
+        with self.metrics.span("serving.request"):
+            item = self.batcher.submit(inputs, timeout=timeout)
+            return item.wait(timeout)
 
     def predict_async(self, inputs, timeout=None):
         """Enqueue and return the WorkItem future (``.wait(timeout)``)."""
@@ -180,9 +192,12 @@ class _Handler(BaseHTTPRequestHandler):
     server_version = "mxtpu-serving/1.0"
 
     def _json(self, code, payload):
-        body = json.dumps(payload).encode()
+        self._text(code, json.dumps(payload), "application/json")
+
+    def _text(self, code, body, content_type):
+        body = body.encode() if isinstance(body, str) else body
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -192,15 +207,27 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         session = self.server.session
-        if self.path in ("/healthz", "/"):
+        path, _, query = self.path.partition("?")
+        if path in ("/healthz", "/"):
             if session.closed:
                 self._json(503, {"status": "draining"})
             else:
                 self._json(200, {"status": "ok",
                                  "replicas": len(session.pool),
                                  "buckets": list(session.buckets)})
-        elif self.path in ("/v1/metrics", "/metrics"):
+        elif path == "/v1/metrics":
+            # legacy flat-JSON contract: this session's serving stats
             self._json(200, session.stats())
+        elif path == "/metrics":
+            # the full pane: process-wide registry (engine, executor,
+            # fit, kvstore, io) + this session's serving registry.
+            # Prometheus text by default; ?format=json for the same data
+            regs = (_tel.registry(), session.metrics)
+            if "format=json" in query:
+                self._json(200, _tel.json_snapshot(*regs))
+            else:
+                self._text(200, _tel.prometheus_text(*regs),
+                           _tel.PROMETHEUS_CONTENT_TYPE)
         else:
             self._json(404, {"error": "unknown path %s" % self.path})
 

@@ -35,6 +35,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "tpu: cross-device consistency tests that need a real "
         "accelerator (run with MXTPU_TEST_TPU=1 pytest -m tpu)")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 `-m 'not slow'` gate "
+        "(long convergence runs and known-flaky-threshold gates)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -3,6 +3,7 @@ python/mxnet/monitor.py + its use in BaseModule.fit(monitor=) — the
 reference installs an output callback on every executor and prints a stat
 per tensor per monitored batch)."""
 import numpy as np
+import pytest
 
 import mxtpu as mx
 
@@ -67,6 +68,54 @@ def test_monitor_interval_and_pattern():
     for res in (seen[0], seen[2]):
         for _, name, _ in res:
             assert "fc2" in name, name
+
+
+def test_monitor_toc_sort_and_clean_deactivation():
+    """toc(sort=True) returns entries ordered by tensor name; toc always
+    leaves the monitor deactivated with an empty queue — including when
+    nothing matched, and when stat_func raises mid-collection."""
+    mod = _mlp_module()
+    mon = mx.monitor.Monitor(interval=1, pattern=".*", sort=True)
+    mod.install_monitor(mon)
+    db = _batch()
+    mon.tic()
+    mod.forward_backward(db)
+    mod.update()
+    res = mon.toc()
+    names = [k for _, k, _ in res]
+    assert names == sorted(names), names
+    assert not mon.activated and mon.queue == []
+
+    # nothing matched: toc still deactivates and returns []
+    empty = mx.monitor.Monitor(interval=1, pattern="no_such_tensor",
+                               sort=True)
+    empty.tic()
+    assert empty.activated
+    assert empty.toc() == []
+    assert not empty.activated and empty.queue == []
+
+    # a throwing stat_func must not wedge the monitor in activated state
+    # (pre-fix, toc left activated=True and the stale queue behind, so
+    # every later batch kept paying the per-op execution path)
+    def boom(arr):
+        raise RuntimeError("bad stat")
+
+    class FakeExe:
+        output_names = ["some_output"]
+        outputs = [object()]
+
+    angry = mx.monitor.Monitor(interval=1, pattern=".*", stat_func=boom,
+                               sort=True)
+    angry.exes.append(FakeExe())
+    angry.tic()
+    assert angry.activated
+    with pytest.raises(RuntimeError):
+        angry.toc()
+    assert not angry.activated and angry.queue == []
+    # and the next cycle works normally again
+    angry.stat_func = lambda x: 1.0
+    angry.tic()
+    assert angry.toc()
 
 
 def test_monitor_through_fit_loop():

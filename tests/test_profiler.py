@@ -61,6 +61,53 @@ def test_per_layer_spans_and_dump(tmp_path):
     assert "stage0_conv" in table and "Count" in table
 
 
+def test_aggregate_stats_mode():
+    """aggregate_stats=True folds spans into standing per-layer histograms
+    at record time: the dumps() table gains percentile columns and
+    SURVIVES raw-event truncation (MXAggregateProfileStats contract) —
+    with the flag off, the table is recomputed from raw events and dies
+    with them."""
+    profiler.clear()
+    profiler.set_config(mode="symbolic", filename="/tmp/unused_agg.json",
+                        aggregate_stats=True)
+    profiler.set_state("run")
+    try:
+        for _ in range(5):
+            with profiler.scope("agg_layer"):
+                pass
+    finally:
+        profiler.set_state("stop")
+    table = profiler.dumps()
+    assert "agg_layer" in table and "Count" in table
+    assert "P50(ms)" in table and "P99(ms)" in table
+    row = next(l for l in table.splitlines() if l.startswith("agg_layer"))
+    assert int(row.split()[1]) == 5
+    # the aggregation outlives the raw events (dump-and-truncate cycle)
+    with profiler._lock:
+        profiler._events.clear()
+    assert "agg_layer" in profiler.dumps()
+    # snapshot API exposes the standing histograms
+    snap = profiler.aggregate_stats_snapshot()
+    assert snap["agg_layer"].count == 5
+    # reset clears the aggregation too
+    profiler.dumps(reset=True)
+    assert "agg_layer" not in profiler.dumps()
+
+    # flag off: plain table, no percentile columns, computed from events
+    profiler.clear()
+    profiler.set_config(mode="symbolic", filename="/tmp/unused_agg.json",
+                        aggregate_stats=False)
+    profiler.set_state("run")
+    try:
+        with profiler.scope("raw_layer"):
+            pass
+    finally:
+        profiler.set_state("stop")
+    table = profiler.dumps()
+    assert "raw_layer" in table and "P99(ms)" not in table
+    profiler.clear()
+
+
 def test_profiler_off_keeps_fused_path():
     """With the profiler stopped, forward uses the fused program and
     records nothing."""

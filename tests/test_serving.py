@@ -279,6 +279,89 @@ def test_http_endpoint_roundtrip():
 
 
 # ------------------------------------------------------------- observability
+def test_metrics_endpoint_prometheus_text():
+    """GET /metrics returns parseable Prometheus text exposition carrying
+    engine, executor-cache and serving series (qps + latency p99 among
+    them), and ?format=json returns the same data as JSON."""
+    import re
+    sj, params, shapes = get_fixture("mlp")
+    sess = ServingSession(sj, params, shapes, buckets=(1, 4),
+                          max_delay_ms=2, contexts=[mx.cpu(0)])
+    server = ServingHTTPServer(sess, port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        base = server.endpoint
+        for i in range(3):
+            sess.predict({"data": _rand((1, 784), i)}, timeout=30)
+        req = urllib.request.urlopen(base + "/metrics", timeout=10)
+        assert req.headers["Content-Type"].startswith("text/plain")
+        text = req.read().decode()
+        # every non-comment line matches the Prometheus sample grammar
+        sample_re = re.compile(
+            r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [^ ]+$')
+        assert text.endswith("\n")
+        for line in text.splitlines():
+            if line:
+                assert line.startswith("#") or sample_re.match(line), line
+        # process-wide series: engine + executor-cache
+        assert "# TYPE mxtpu_engine_ops_dispatched counter" in text
+        assert "mxtpu_engine_queue_depth" in text
+        assert "mxtpu_executor_program_builds_total" in text
+        # serving series, including the derived operator numbers
+        assert "# TYPE mxtpu_serving_requests_completed counter" in text
+        assert "# TYPE mxtpu_serving_qps gauge" in text
+        assert "mxtpu_serving_request_latency_ms_p99" in text
+        assert "mxtpu_serving_request_latency_ms_bucket" in text
+        assert "mxtpu_serving_executor_cache_hits" in text
+        # histogram buckets are cumulative and end at +Inf == _count
+        lat = [l for l in text.splitlines()
+               if l.startswith("mxtpu_serving_request_latency_ms_bucket")]
+        counts = [int(l.rsplit(" ", 1)[1]) for l in lat]
+        assert counts == sorted(counts) and counts[-1] >= 3
+        count_line = next(l for l in text.splitlines() if
+                          l.startswith("mxtpu_serving_request_latency_ms_count"))
+        assert int(count_line.rsplit(" ", 1)[1]) == counts[-1]
+
+        # same data as JSON
+        with urllib.request.urlopen(base + "/metrics?format=json",
+                                    timeout=10) as r:
+            snap = json.loads(r.read())
+        assert snap["mxtpu_serving"]["requests_completed"] >= 3
+        assert "qps" in snap["mxtpu_serving"]
+        assert "engine_ops_dispatched" in snap["mxtpu"]
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_request_trace_spans_correlated():
+    """One request's trace id flows submit -> batch -> pool.run: with the
+    profiler running, the serving.request B event and the batch/pool.run
+    events share a trace_id in their args."""
+    from mxtpu import profiler
+    sj, params, shapes = get_fixture("mlp")
+    with ServingSession(sj, params, shapes, buckets=(1,),
+                        max_delay_ms=1, contexts=[mx.cpu(0)]) as sess:
+        profiler.clear()
+        profiler.set_config(mode="symbolic", filename="/tmp/unused_srv.json")
+        profiler.set_state("run")
+        try:
+            sess.predict({"data": _rand((1, 784), 0)}, timeout=30)
+        finally:
+            profiler.set_state("stop")
+        with profiler._lock:
+            events = [e for e in profiler._events if e.get("args")]
+        by_name = {}
+        for e in events:
+            by_name.setdefault(e["name"].split("[")[0], e["args"])
+        assert "serving.request" in by_name, sorted(by_name)
+        root = by_name["serving.request"]["trace_id"]
+        assert by_name["batch"]["trace_id"] == root
+        assert by_name["pool.run"]["trace_id"] == root
+        profiler.clear()
+
+
 def test_warmup_precompiles_no_builds_under_traffic():
     """After warmup, serving traffic at warmed buckets must not construct
     new executor programs (the executor.py cache-hook seam)."""

@@ -1,0 +1,302 @@
+"""mxtpu.telemetry: registry exactness under concurrency, fixed-bucket
+percentiles, Prometheus/JSON exposition, correlated tracing across the
+engine's thread hop, and the built-in fit/kvstore instrumentation."""
+import json
+import re
+import threading
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import telemetry as tel
+from mxtpu.telemetry.metrics import Histogram, MetricsRegistry
+
+
+# ------------------------------------------------------------- concurrency
+def test_concurrent_writers_exact_totals():
+    """N threads hammering shared counters and histograms: totals must be
+    EXACT — a lost increment means a lock is missing on the hot path."""
+    reg = MetricsRegistry(namespace="t")
+    n_threads, n_iter = 8, 2000
+    ctr = reg.counter("stress_total")
+    hist = reg.histogram("stress_ms")
+    lctr = [reg.counter("stress_labeled", labels={"worker": str(i)})
+            for i in range(n_threads)]
+    barrier = threading.Barrier(n_threads)
+
+    def worker(i):
+        barrier.wait()
+        for k in range(n_iter):
+            ctr.inc()
+            lctr[i].inc(2)
+            hist.observe(float(k % 50))
+            # dynamic lookup path must be exact too (registry lock)
+            reg.counter("stress_dynamic").inc()
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = n_threads * n_iter
+    assert ctr.value == total
+    assert reg.counter("stress_dynamic").value == total
+    assert hist.count == total
+    assert sum(hist.bucket_counts) == total
+    assert hist.sum == pytest.approx(n_threads * sum(k % 50
+                                                     for k in range(n_iter)))
+    for i in range(n_threads):
+        assert lctr[i].value == 2 * n_iter
+
+
+# ------------------------------------------------------------- histograms
+def test_histogram_fixed_bucket_percentiles():
+    h = Histogram("lat", bounds=(1, 2, 4, 8, 16, 32, float("inf")))
+    for v in range(1, 101):  # uniform 1..100
+        h.observe(float(v))
+    assert h.count == 100 and h.min == 1.0 and h.max == 100.0
+    # values past the last finite bound resolve to the observed max
+    assert h.percentile(99) == 100.0
+    # interior percentiles are bucket-accurate: p25 of uniform(1,100) = 25
+    # lands in the (16, 32] bucket
+    assert 16 <= h.percentile(25) <= 32
+    assert h.mean == pytest.approx(50.5)
+    # empty histogram is quiet
+    assert Histogram("e").percentile(50) == 0.0
+
+
+def test_histogram_appends_inf_bound():
+    h = Histogram("x", bounds=(1, 2))
+    h.observe(99.0)
+    assert h.bounds[-1] == float("inf")
+    assert h.count == 1 and sum(h.bucket_counts) == 1
+
+
+# ------------------------------------------------------------- exposition
+def test_prometheus_text_exposition_parses():
+    reg = MetricsRegistry(namespace="tp")
+    reg.counter("reqs", help='total "requests"').inc(5)
+    reg.gauge("depth").set(3)
+    h = reg.histogram("lat_ms", labels={"route": "predict"},
+                      bounds=(1, 10, float("inf")))
+    for v in (0.5, 5, 50):
+        h.observe(v)
+    text = tel.prometheus_text(reg)
+    lines = [l for l in text.splitlines() if l]
+    sample_re = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [^ ]+$')
+    for line in lines:
+        assert line.startswith("#") or sample_re.match(line), line
+    assert "# TYPE tp_reqs counter" in text
+    assert "tp_reqs 5" in text
+    assert "# TYPE tp_lat_ms histogram" in text
+    # cumulative buckets: le=1 -> 1, le=10 -> 2, le=+Inf -> 3 == _count
+    assert 'tp_lat_ms_bucket{le="1",route="predict"} 1' in text
+    assert 'tp_lat_ms_bucket{le="10",route="predict"} 2' in text
+    assert 'tp_lat_ms_bucket{le="+Inf",route="predict"} 3' in text
+    assert 'tp_lat_ms_count{route="predict"} 3' in text
+
+
+def test_json_snapshot_and_dump(tmp_path):
+    reg = MetricsRegistry(namespace="tj")
+    reg.counter("a").inc(7)
+    reg.histogram("h").observe(4.0)
+    snap = tel.json_snapshot(reg)
+    assert snap["tj"]["a"] == 7
+    assert snap["tj"]["h"]["count"] == 1
+    pj = tel.dump(str(tmp_path / "m.json"), reg, fmt="json")
+    assert json.load(open(pj))["tj"]["a"] == 7
+    pp = tel.dump(str(tmp_path / "m.prom"), reg, fmt="prometheus")
+    assert "tj_a 7" in open(pp).read()
+    with pytest.raises(ValueError):
+        tel.dump(str(tmp_path / "m.x"), reg, fmt="xml")
+
+
+# ------------------------------------------------------------- tracing
+def test_span_nesting_and_ids():
+    with tel.span("outer") as outer:
+        assert tel.current_span() is outer
+        assert outer.parent_id == 0 and outer.trace_id == outer.span_id
+        with tel.span("inner") as inner:
+            assert inner.parent_id == outer.span_id
+            assert inner.trace_id == outer.trace_id
+    assert tel.current_span() is None
+    assert tel.trace_id() == 0
+    assert inner.duration_ms >= 0.0
+
+
+def test_span_cross_thread_parenting():
+    """The serving/engine pattern: capture the submitting span, restore it
+    as parent on the worker thread -> one trace id."""
+    seen = {}
+
+    def worker(parent):
+        with tel.span("work", parent=parent) as s:
+            seen["trace"] = s.trace_id
+            seen["parent"] = s.parent_id
+
+    with tel.span("request") as req:
+        t = threading.Thread(target=worker, args=(tel.current_span(),))
+        t.start()
+        t.join()
+    assert seen["trace"] == req.trace_id
+    assert seen["parent"] == req.span_id
+
+
+def test_engine_push_flows_span_ids():
+    """engine push -> (native worker) dispatch carries the pushing span."""
+    eng = mx.engine.get()
+    seen = {}
+    with tel.span("step") as root:
+        eng.push(lambda: seen.setdefault("trace", tel.trace_id()))
+        eng.wait_for_all()
+    assert seen["trace"] == root.trace_id
+    reg = tel.registry()
+    assert reg.counter("engine_ops_completed").value >= 1
+    assert reg.histogram("engine_queue_wait_ms").count >= 1
+
+
+def test_spans_feed_registry_histogram():
+    before = tel.registry().histogram(
+        tel.SPAN_HISTOGRAM, labels={"span": "probe_span"}).count
+    with tel.span("probe_span"):
+        pass
+    after = tel.registry().histogram(
+        tel.SPAN_HISTOGRAM, labels={"span": "probe_span"}).count
+    assert after == before + 1
+
+
+def test_span_timebase_matches_profiler():
+    """Telemetry spans and profiler.scope spans share one wall-clock
+    timebase in the chrome://tracing dump (a perf_counter/time.time mix
+    would scatter one trace across decades)."""
+    from mxtpu import profiler
+    profiler.clear()
+    profiler.set_config(mode="symbolic", filename="/tmp/unused_tb.json")
+    profiler.set_state("run")
+    try:
+        with tel.span("tb_tel"):
+            pass
+        with profiler.scope("tb_prof"):
+            pass
+    finally:
+        profiler.set_state("stop")
+    with profiler._lock:
+        ts = {e["name"]: e["ts"] for e in profiler._events
+              if e["ph"] == "B"}
+    assert abs(ts["tb_tel"] - ts["tb_prof"]) < 60e6, ts  # same minute
+    profiler.clear()
+
+
+def test_engine_gauges_track_singleton():
+    """Throwaway engine constructions (tests build their own instances)
+    must not rebind the process gauges away from the live singleton."""
+    eng = mx.engine.get()
+    g = tel.registry().gauge("engine_workers")
+    expected = eng.num_workers
+    mx.engine.NaiveEngine()  # must not shadow the singleton's gauges
+    if type(eng).__name__ == "ThreadedEngine":
+        mx.engine.ThreadedEngine()
+    assert g.value == expected
+
+
+# ------------------------------------------------------------- disable
+def test_profiler_keeps_spans_when_telemetry_disabled():
+    """MXTPU_TELEMETRY=0 silences metrics, not an explicitly running
+    profiler session: trace spans keep landing in the dump."""
+    from mxtpu import profiler
+    profiler.clear()
+    profiler.set_config(mode="symbolic", filename="/tmp/unused_td.json")
+    profiler.set_state("run")
+    tel.set_enabled(False)
+    try:
+        with tel.span("disabled_but_profiled") as s:
+            pass
+        assert s.span_id != 0  # real span, not the null stand-in
+    finally:
+        tel.set_enabled(True)
+        profiler.set_state("stop")
+    with profiler._lock:
+        names = {e["name"] for e in profiler._events}
+    assert "disabled_but_profiled" in names
+    profiler.clear()
+
+
+def test_set_enabled_false_is_noop():
+    tel.set_enabled(False)
+    try:
+        assert not tel.enabled()
+        c = tel.counter("disabled_probe")
+        c.inc(100)
+        assert c.value == 0
+        with tel.span("disabled_span") as s:
+            assert s.span_id == 0
+    finally:
+        tel.set_enabled(True)
+    # the real series was never created
+    assert all(m.name != "disabled_probe" for m in tel.registry().series())
+
+
+# ------------------------------------------------- built-in instrumentation
+def _fit_once(batch_end_callback=None, epochs=1):
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype("float32")
+    y = rng.randint(0, 4, 64).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=16, label_name="softmax_label")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                              name="fct"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=epochs, batch_end_callback=batch_end_callback,
+            optimizer_params={"learning_rate": 0.1})
+    return mod
+
+
+def test_fit_emits_into_registry():
+    reg = tel.registry()
+    steps0 = reg.histogram("fit_step_ms").count
+    samples0 = reg.counter("fit_samples").value
+    io0 = reg.counter("io_batches", labels={"iter": "NDArrayIter"}).value
+    _fit_once(batch_end_callback=mx.callback.Speedometer(16, frequent=2,
+                                                         auto_reset=False))
+    assert reg.histogram("fit_step_ms").count >= steps0 + 4
+    assert reg.counter("fit_samples").value == samples0 + 64
+    assert reg.gauge("fit_samples_per_sec").value > 0
+    assert reg.counter("io_batches",
+                       labels={"iter": "NDArrayIter"}).value > io0
+    # the Speedometer rewrite emits structured series, not just log lines
+    assert reg.gauge("train_samples_per_sec").value > 0
+    assert reg.gauge("train_metric", labels={"metric": "accuracy"}
+                     ).value >= 0.0
+    # executor compile telemetry saw the program build
+    assert reg.counter("executor_program_builds_total").value >= 1
+
+
+def test_kvstore_push_pull_metrics():
+    reg = tel.registry()
+    pb0 = reg.counter("kvstore_push_bytes").value
+    lb0 = reg.counter("kvstore_pull_bytes").value
+    kv = mx.kv.create("local")
+    a = mx.nd.ones((4, 8))
+    kv.init("w", a)
+    kv.push("w", mx.nd.ones((4, 8)))
+    out = mx.nd.zeros((4, 8))
+    kv.pull("w", out=out)
+    assert reg.counter("kvstore_push_bytes").value == pb0 + 4 * 8 * 4
+    assert reg.counter("kvstore_pull_bytes").value == lb0 + 4 * 8 * 4
+    assert reg.histogram("kvstore_push_ms").count >= 1
+    assert reg.histogram("kvstore_pull_ms").count >= 1
+
+
+def test_prefetching_iter_stall_metric():
+    reg = tel.registry()
+    s0 = reg.histogram("io_prefetch_stall_ms").count
+    X = np.arange(32, dtype="float32").reshape(8, 4)
+    y = np.zeros(8, "float32")
+    base = mx.io.NDArrayIter(X, y, batch_size=4)
+    pf = mx.io.PrefetchingIter(base)
+    batches = list(pf)
+    assert len(batches) == 2
+    assert reg.histogram("io_prefetch_stall_ms").count > s0

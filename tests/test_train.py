@@ -15,6 +15,7 @@ def _separable(n=512, dim=20, seed=3):
 
 def test_mlp_converges():
     mx.random.seed(4)  # deterministic init regardless of suite order
+    np.random.seed(4)  # NDArrayIter shuffle draws from numpy's global RNG
     X, Y = _separable()
     data = mx.sym.Variable("data")
     net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
