@@ -69,7 +69,19 @@ class Speedometer:
 
     def _emit(self, param, speed):
         metric = getattr(param, "eval_metric", None)
-        pairs = metric.get_name_value() if metric is not None else []
+        # pipelined fit accumulates metrics ON DEVICE and syncs a host
+        # snapshot at the metric-sync cadence (aligned to `frequent`);
+        # consume that snapshot instead of forcing our own host sync —
+        # get_name_value() on an unsynced device-accumulated metric would
+        # read values that exclude the batches still in flight
+        accum = getattr(metric, "_device_accum", None) \
+            if metric is not None else None
+        if accum is not None and accum.last_snapshot is not None:
+            pairs = accum.last_snapshot
+        elif metric is not None:
+            pairs = metric.get_name_value()
+        else:
+            pairs = []
         _tel.gauge("train_samples_per_sec",
                    help="Speedometer window throughput").set(speed)
         _tel.histogram(

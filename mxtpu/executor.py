@@ -29,7 +29,23 @@ from . import telemetry as _tel
 from .telemetry import tracing as _tracing
 
 __all__ = ["Executor", "add_build_listener", "remove_build_listener",
-           "program_build_count", "record_program_build"]
+           "program_build_count", "record_program_build", "device_wait"]
+
+
+def device_wait(x):
+    """Block until ``x`` — a device array / NDArray, or a list of them —
+    has finished computing: the explicit engine-sync point of the
+    pipelined ``Module.fit`` loop (the WaitToRead analogue the bounded
+    in-flight window uses to pace dispatch). Returns the wall-clock
+    milliseconds spent blocked, so callers can report pacing honestly."""
+    import time as _time
+    t0 = _time.perf_counter()
+    if isinstance(x, (list, tuple)):
+        x = [getattr(a, "_data", a) for a in x]
+    else:
+        x = getattr(x, "_data", x)
+    jax.block_until_ready(x)
+    return (_time.perf_counter() - t0) * 1e3
 
 # standing series: registry-direct so they exist for /metrics even when
 # MXTPU_TELEMETRY=0 was set at import (the flag silences the helper-

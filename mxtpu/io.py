@@ -157,7 +157,14 @@ class ResizeIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """Background-thread double buffering (parity io.py PrefetchingIter /
-    src/io/iter_prefetcher.h)."""
+    src/io/iter_prefetcher.h).
+
+    Lifecycle: ``close()`` stops and JOINS the producer threads (it is
+    also the context-manager exit and what ``__del__`` falls back to);
+    a closed iterator raises on further use. Subclasses override
+    ``_stage(batch)`` to transform each fetched batch ON THE PRODUCER
+    THREAD — that is the seam :class:`DevicePrefetchIter` uses to issue
+    the device transfer of batch N+1 while the consumer runs step N."""
 
     def __init__(self, iters, rename_data=None, rename_label=None):
         super().__init__()
@@ -180,9 +187,13 @@ class PrefetchingIter(DataIter):
             while True:
                 self.data_taken[i].wait()
                 if not self.started:
+                    # unblock any consumer parked in iter_next()/reset();
+                    # next_batch stays None so they see end-of-data
+                    self.next_batch[i] = None
+                    self.data_ready[i].set()
                     break
                 try:
-                    self.next_batch[i] = self.iters[i].next()
+                    self.next_batch[i] = self._stage(self.iters[i].next())
                 except StopIteration:
                     self.next_batch[i] = None
                 self.data_taken[i].clear()
@@ -194,10 +205,44 @@ class PrefetchingIter(DataIter):
         for thread in self.prefetch_threads:
             thread.start()
 
-    def __del__(self):
+    def _stage(self, batch):
+        """Producer-thread hook applied to every fetched batch."""
+        return batch
+
+    def close(self, join=True):
+        """Stop the producer threads; with ``join=True`` (the default)
+        also wait for them to exit. Idempotent. The underlying iterators
+        are NOT closed (callers own them)."""
+        if not self.started:
+            return
         self.started = False
         for e in self.data_taken:
             e.set()
+        if not join:
+            return
+        # a producer mid-fetch clears data_taken AFTER we set it, then
+        # parks on wait() — keep re-setting until each thread exits
+        deadline = _time.monotonic() + 10.0
+        for thread in self.prefetch_threads:
+            while thread.is_alive() and _time.monotonic() < deadline:
+                for e in self.data_taken:
+                    e.set()
+                thread.join(timeout=0.05)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            # signal only: a GC-triggered join could stall the collecting
+            # thread behind a producer blocked in a slow underlying next()
+            self.close(join=False)
+        except Exception:
+            pass  # interpreter teardown: threads are daemons anyway
 
     @property
     def provide_data(self):
@@ -218,6 +263,8 @@ class PrefetchingIter(DataIter):
                     for r, i in zip(self.rename_label, self.iters)], [])
 
     def reset(self):
+        if not self.started:
+            raise MXNetError("PrefetchingIter is closed")
         for e in self.data_ready:
             e.wait()
         for i in self.iters:
@@ -228,6 +275,8 @@ class PrefetchingIter(DataIter):
             e.set()
 
     def iter_next(self):
+        if not self.started:
+            raise MXNetError("PrefetchingIter is closed")
         # time blocked on the producer threads: a healthy pipeline shows
         # ~zero stall (the batch was ready before the consumer asked)
         t0 = _time.perf_counter()
@@ -268,6 +317,42 @@ class PrefetchingIter(DataIter):
         return self.current_batch.pad
 
 
+class DevicePrefetchIter(PrefetchingIter):
+    """Prefetch + device-side input staging: the producer thread issues the
+    ``device_put`` of batch N+1 while the consumer runs step N, so by the
+    time ``fit`` touches the batch its host->device transfer is already in
+    flight (or done) and ``io_prefetch_stall_ms`` goes to ~0.
+
+    The reference's prefetcher (src/io/iter_prefetcher.h) only double-
+    buffers HOST memory; the lazy transfer-on-first-use this repo used
+    until now still serialized H2D behind the step dispatch. ``device``
+    defaults to the current context's jax device; pass the training
+    device explicitly for multi-device setups (the fused step re-commits
+    sharded inputs itself, so single staging device is the right target).
+    Arrays without a jax buffer (e.g. CSR sparse) pass through unstaged.
+    """
+
+    def __init__(self, iters, device=None, rename_data=None,
+                 rename_label=None):
+        if device is None:
+            from .context import current_context
+            device = current_context().jax_device
+        self._device = device
+        super().__init__(iters, rename_data=rename_data,
+                         rename_label=rename_label)
+
+    def _stage(self, batch):
+        if batch is None:
+            return None
+        import jax
+        for arrs in (batch.data or [], batch.label or []):
+            for a in arrs:
+                data = getattr(a, "_data", None)
+                if data is not None and isinstance(data, jax.Array):
+                    a._data = jax.device_put(data, self._device)
+        return batch
+
+
 def _init_data(data, allow_empty, default_name):
     if data is None:
         data = []
@@ -292,11 +377,16 @@ def _init_data(data, allow_empty, default_name):
 
 
 class NDArrayIter(DataIter):
-    """Iterate over in-memory arrays (parity io.py:516)."""
+    """Iterate over in-memory arrays (parity io.py:516).
+
+    ``num_workers > 0`` enables multi-worker host assembly: a thread pool
+    slices and stages up to ``num_workers`` upcoming batches ahead of the
+    cursor (the dmlc ThreadedIter fan-out role), so batch assembly
+    overlaps the training step instead of riding its critical path."""
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label"):
+                 label_name="softmax_label", num_workers=0):
         super().__init__(batch_size)
         self.data = _init_data(data, allow_empty=False, default_name=data_name)
         self.label = _init_data(label, allow_empty=True, default_name=label_name)
@@ -314,6 +404,20 @@ class NDArrayIter(DataIter):
         self.cursor = -batch_size
         self.batch_size = batch_size
         self.last_batch_handle = last_batch_handle
+        self._num_workers = int(num_workers)
+        self._pool = None
+        self._pending = {}
+        if self._num_workers > 0:
+            from concurrent.futures import ThreadPoolExecutor
+            from .context import current_context
+            # pool threads have an empty thread-local context stack, so
+            # they stage batches under the context active HERE (else a
+            # `with mx.tpu(0):` around construction would be ignored and
+            # every batch re-transferred on the step's critical path)
+            self._ctx = current_context()
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._num_workers,
+                thread_name_prefix="ndarrayiter")
 
     @property
     def provide_data(self):
@@ -326,9 +430,11 @@ class NDArrayIter(DataIter):
                 for k, v in self.label]
 
     def hard_reset(self):
+        self._drop_pending()
         self.cursor = -self.batch_size
 
     def reset(self):
+        self._drop_pending()
         if self.last_batch_handle == "roll_over" and \
                 self.cursor > self.num_data:
             self.cursor = -self.batch_size + (self.cursor % self.num_data) % \
@@ -336,30 +442,78 @@ class NDArrayIter(DataIter):
         else:
             self.cursor = -self.batch_size
 
+    def close(self):
+        """Shut down the assembly pool (no-op without ``num_workers``)."""
+        self._drop_pending()
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+            self._num_workers = 0
+
+    def _drop_pending(self):
+        for fut in self._pending.values():
+            fut.cancel()
+        self._pending.clear()
+
     def iter_next(self):
         self.cursor += self.batch_size
         return self.cursor < self.num_data
 
     def next(self):
         if self.iter_next():
-            t0 = _time.perf_counter()
-            batch = DataBatch(data=self.getdata(), label=self.getlabel(),
-                              pad=self.getpad(), index=None)
-            _tel.histogram("io_batch_assemble_ms",
-                           help="host-side slice+stage time per batch"
-                           ).observe((_time.perf_counter() - t0) * 1e3)
+            if self._pool is not None:
+                fut = self._pending.pop(self.cursor, None)
+                if fut is None:
+                    fut = self._pool.submit(self._assemble, self.cursor,
+                                            self._ctx)
+                # schedule the lookahead window before blocking on the
+                # current batch, so workers stay busy while we wait
+                for k in range(1, self._num_workers + 1):
+                    nc = self.cursor + k * self.batch_size
+                    if nc < self.num_data and nc not in self._pending:
+                        self._pending[nc] = self._pool.submit(
+                            self._assemble, nc, self._ctx)
+                t0 = _time.perf_counter()
+                batch = fut.result()
+                _tel.histogram("io_batch_wait_ms",
+                               help="consumer wait for a pooled batch "
+                               "(~0 when the lookahead keeps up)"
+                               ).observe((_time.perf_counter() - t0) * 1e3)
+            else:
+                batch = self._assemble(self.cursor)
             _tel.counter("io_batches", labels={"iter": "NDArrayIter"},
                          help="batches produced").inc()
             return batch
         raise StopIteration
 
-    def _getdata(self, data_source):
-        assert self.cursor < self.num_data, "DataIter needs reset."
-        if self.cursor + self.batch_size <= self.num_data:
-            sel = self.idx[self.cursor:self.cursor + self.batch_size]
+    def _assemble(self, cursor, ctx=None):
+        """Pure function of (cursor, idx): build one DataBatch. Safe to run
+        on pool threads — no iterator state is mutated. ``ctx`` (pool path
+        only) re-establishes the construction-time device context on the
+        worker thread; the consumer-thread path keeps the live ambient
+        context, exactly as before ``num_workers`` existed."""
+        if ctx is not None:
+            with ctx:
+                return self._assemble(cursor)
+        t0 = _time.perf_counter()
+        batch = DataBatch(data=self._getdata(self.data, cursor),
+                          label=self._getdata(self.label, cursor),
+                          pad=self._pad_at(cursor), index=None)
+        # timed HERE (on whichever thread assembles) so the series keeps
+        # meaning "slice+stage cost" under num_workers, not queue wait
+        _tel.histogram("io_batch_assemble_ms",
+                       help="host-side slice+stage time per batch"
+                       ).observe((_time.perf_counter() - t0) * 1e3)
+        return batch
+
+    def _getdata(self, data_source, cursor=None):
+        cursor = self.cursor if cursor is None else cursor
+        assert cursor < self.num_data, "DataIter needs reset."
+        if cursor + self.batch_size <= self.num_data:
+            sel = self.idx[cursor:cursor + self.batch_size]
             return [nd.array(x[1][sel]) for x in data_source]
-        pad = self.batch_size - self.num_data + self.cursor
-        sel = _np.concatenate([self.idx[self.cursor:], self.idx[:pad]])
+        pad = self.batch_size - self.num_data + cursor
+        sel = _np.concatenate([self.idx[cursor:], self.idx[:pad]])
         return [nd.array(x[1][sel]) for x in data_source]
 
     def getdata(self):
@@ -368,11 +522,14 @@ class NDArrayIter(DataIter):
     def getlabel(self):
         return self._getdata(self.label)
 
-    def getpad(self):
+    def _pad_at(self, cursor):
         if self.last_batch_handle == "pad" and \
-                self.cursor + self.batch_size > self.num_data:
-            return self.cursor + self.batch_size - self.num_data
+                cursor + self.batch_size > self.num_data:
+            return cursor + self.batch_size - self.num_data
         return 0
+
+    def getpad(self):
+        return self._pad_at(self.cursor)
 
 
 _ITER_REG = Registry("data iterator")

@@ -5,12 +5,15 @@ forward_backward :189, init_params :593, init_optimizer :958)."""
 from __future__ import annotations
 
 import logging
+import os
 import time
+from collections import deque
 
 from .. import metric as _metric
 from .. import ndarray as nd
 from .. import telemetry as _tel
 from ..base import MXNetError
+from ..executor import device_wait as _device_wait
 from ..model import BatchEndParam
 from ..telemetry import tracing as _tracing
 
@@ -131,17 +134,83 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None):
-        """Training loop (parity base_module.py:376-525)."""
+            monitor=None, max_in_flight=None, metric_sync=None,
+            device_metrics=None, device_prefetch=None):
+        """Training loop (parity base_module.py:376-525), pipelined.
+
+        The async-pipeline knobs (docs/training_pipeline.md):
+
+        * ``max_in_flight`` — keep up to K dispatched steps in flight and
+          only ``block_until_ready`` the oldest when the window is full
+          (env ``MXTPU_FIT_INFLIGHT``, default 2). Pacing is skipped when
+          the metric has no device kernels (the per-batch host sync of
+          the numpy path bounds the pipeline anyway).
+        * ``metric_sync`` — device->host metric sync cadence in batches.
+          ``None`` auto-derives it: the minimum Speedometer ``frequent``
+          among the batch callbacks; 1 when a non-Speedometer batch
+          callback might read live values; epoch-end only otherwise.
+        * ``device_metrics`` — accumulate eval metrics on device via
+          their jitted kernels (env ``MXTPU_FIT_DEVICE_METRICS``,
+          default on). Metrics without kernels fall back to numpy.
+        * ``device_prefetch`` — wrap ``train_data`` in a
+          :class:`~mxtpu.io.DevicePrefetchIter` so batch N+1's device
+          transfer is issued from the producer thread while step N runs
+          (env ``MXTPU_FIT_DEVICE_PREFETCH``, default off; the wrapper
+          is closed when fit returns).
+        """
         from ..initializer import Uniform
         assert num_epoch is not None, "please specify number of epochs"
         initializer = initializer or Uniform(0.01)
 
+        if max_in_flight is None:
+            max_in_flight = int(os.environ.get("MXTPU_FIT_INFLIGHT", "2"))
+        max_in_flight = max(1, int(max_in_flight))
+        if device_metrics is None:
+            device_metrics = os.environ.get(
+                "MXTPU_FIT_DEVICE_METRICS", "1") != "0"
+        if device_prefetch is None:
+            device_prefetch = os.environ.get(
+                "MXTPU_FIT_DEVICE_PREFETCH", "0") != "0"
+
+        owned_iter = None
+        if device_prefetch:
+            from .. import io as _io
+            if not isinstance(train_data, _io.DevicePrefetchIter):
+                device = None
+                ctxs = getattr(self, "_context", None)
+                if ctxs:
+                    try:
+                        device = ctxs[0].jax_device
+                    except Exception:
+                        device = None
+                train_data = owned_iter = _io.DevicePrefetchIter(
+                    train_data, device=device)
+
+        try:
+            self._fit_impl(
+                train_data, eval_data, eval_metric, epoch_end_callback,
+                batch_end_callback, kvstore, optimizer, optimizer_params,
+                eval_end_callback, eval_batch_end_callback, initializer,
+                arg_params, aux_params, allow_missing, force_rebind,
+                force_init, begin_epoch, num_epoch, validation_metric,
+                monitor, max_in_flight, metric_sync, device_metrics)
+        finally:
+            if owned_iter is not None:
+                owned_iter.close()
+
+    def _fit_impl(self, train_data, eval_data, eval_metric,
+                  epoch_end_callback, batch_end_callback, kvstore, optimizer,
+                  optimizer_params, eval_end_callback,
+                  eval_batch_end_callback, initializer, arg_params,
+                  aux_params, allow_missing, force_rebind, force_init,
+                  begin_epoch, num_epoch, validation_metric, monitor,
+                  max_in_flight, metric_sync, device_metrics):
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)
         if monitor is not None:
             self.install_monitor(monitor)
+            device_metrics = False  # monitor.toc reads per-batch host stats
         self.init_params(initializer=initializer, arg_params=arg_params,
                          aux_params=aux_params, allow_missing=allow_missing,
                          force_init=force_init)
@@ -152,10 +221,51 @@ class BaseModule:
         if not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
 
+        accum = _metric.DeviceMetricAccum.wrap(eval_metric) \
+            if device_metrics else None
+        # Speedometer (and anything else reading the metric between
+        # cadence syncs) consumes this snapshot instead of forcing a sync
+        eval_metric._device_accum = accum
+        callbacks = _as_list(batch_end_callback)
+        if metric_sync is None:
+            from .. import callback as _cb
+            freqs = [c.frequent for c in callbacks
+                     if isinstance(c, _cb.Speedometer)]
+            known = [c for c in callbacks
+                     if isinstance(c, (_cb.Speedometer, _cb.ProgressBar))]
+            if len(known) < len(callbacks):
+                metric_sync = 1   # unknown callbacks may read live values
+                if accum is not None:
+                    self.logger.info(
+                        "fit: non-Speedometer batch callback present — "
+                        "metric sync falls back to every batch (pass "
+                        "metric_sync= to restore the cadence)")
+            elif freqs:
+                # gcd, not min: every Speedometer window boundary must be
+                # a sync batch, or a meter with a non-multiple `frequent`
+                # would emit (and auto_reset against) stale snapshots
+                from math import gcd
+                from functools import reduce
+                metric_sync = reduce(gcd, freqs)
+            else:
+                metric_sync = 0   # no batch callbacks: epoch-end only
+        metric_sync = max(0, int(metric_sync))
+
         # one pipeline for training and serving: fit emits into the same
         # process-wide registry the serving /metrics endpoint scrapes
-        step_ms = _tel.histogram("fit_step_ms",
-                                 help="forward+backward+update wall time")
+        step_ms = _tel.histogram(
+            "fit_step_ms",
+            help="wall time per step: dispatch + pipeline pacing wait")
+        dispatch_ms = _tel.histogram(
+            "fit_dispatch_ms",
+            help="host time to issue one step (async dispatch, no device "
+                 "wait) — fit_step_ms minus this is pacing/back-pressure")
+        sync_wait_ms = _tel.histogram(
+            "fit_sync_wait_ms",
+            help="pacing: wall time blocked on the oldest in-flight step")
+        msync_ms = _tel.histogram(
+            "fit_metric_sync_ms",
+            help="device->host metric snapshot wall time (cadence sync)")
         samples_total = _tel.counter("fit_samples",
                                      help="training examples consumed")
         sps_gauge = _tel.gauge("fit_samples_per_sec",
@@ -164,70 +274,120 @@ class BaseModule:
                                  help="validation pass wall time")
         epochs_done = _tel.counter("fit_epochs", help="epochs completed")
 
-        for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            eval_metric.reset()
-            nbatch = 0
-            epoch_samples = 0
-            data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
-                if monitor is not None:
-                    monitor.tic()
-                # fit.step is the correlation root for everything one
-                # batch triggers (executor.forward -> engine dispatches,
-                # kvstore push/pull inside update)
-                with _tracing.span("fit.step", category="module") as sp:
-                    self.forward_backward(data_batch)
-                    self.update()
-                step_ms.observe(sp.duration_ms)
-                if data_batch.data:
-                    epoch_samples += data_batch.data[0].shape[0] - \
-                        (data_batch.pad or 0)
-                try:
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch)
-                except StopIteration:
-                    end_of_batch = True
-                self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                     eval_metric=eval_metric,
-                                                     locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
-                nbatch += 1
+        try:
+            for epoch in range(begin_epoch, num_epoch):
+                tic = time.time()
+                eval_metric.reset()
+                if accum is not None:
+                    accum.reset()
+                nbatch = 0
+                epoch_samples = 0
+                data_iter = iter(train_data)
+                end_of_batch = False
+                next_data_batch = next(data_iter)
+                inflight = deque()
+                while not end_of_batch:
+                    data_batch = next_data_batch
+                    if monitor is not None:
+                        monitor.tic()
+                    # fit.step is the correlation root for everything one
+                    # batch triggers (executor.forward -> engine dispatches,
+                    # kvstore push/pull inside update)
+                    with _tracing.span("fit.step", category="module") as sp:
+                        self.forward_backward(data_batch)
+                        self.update()
+                    dispatch_ms.observe(sp.duration_ms)
+                    view = self._device_step_view(data_batch) \
+                        if accum is not None else None
+                    if data_batch.data:
+                        epoch_samples += data_batch.data[0].shape[0] - \
+                            (data_batch.pad or 0)
+                    # fetch batch N+1 FIRST: its host assembly overlaps step
+                    # N's device execution (and, with DevicePrefetchIter, its
+                    # transfer is already in flight on the producer thread)
+                    try:
+                        next_data_batch = next(data_iter)
+                        self.prepare(next_data_batch)
+                    except StopIteration:
+                        end_of_batch = True
+                    pacing = 0.0
+                    if view is not None:
+                        labels, outs, token = view
+                        accum.update(labels, outs)
+                        if token is not None:
+                            inflight.append(token)
+                            # bounded in-flight window: block ONLY when more
+                            # than K steps are outstanding, and only on the
+                            # oldest — the device never idles waiting for the
+                            # host between steps
+                            while len(inflight) > max_in_flight:
+                                w = _device_wait(inflight.popleft())
+                                sync_wait_ms.observe(w)
+                                pacing += w
+                    else:
+                        self.update_metric(eval_metric, data_batch.label)
+                    step_ms.observe(sp.duration_ms + pacing)
+                    if monitor is not None:
+                        monitor.toc_print()
+                    if accum is not None and (
+                            end_of_batch or metric_sync == 1 or
+                            (metric_sync and nbatch and
+                             nbatch % metric_sync == 0)):
+                        if end_of_batch:
+                            inflight.clear()  # metric sync covers every step
+                        t0 = time.perf_counter()
+                        accum.sync()
+                        msync_ms.observe((time.perf_counter() - t0) * 1e3)
+                    if batch_end_callback is not None:
+                        batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                                         eval_metric=eval_metric,
+                                                         locals=locals())
+                        for callback in callbacks:
+                            callback(batch_end_params)
+                    nbatch += 1
 
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
-            samples_total.inc(epoch_samples)
-            epochs_done.inc()
-            if toc > tic:
-                sps_gauge.set(epoch_samples / (toc - tic))
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+                toc = time.time()
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+                samples_total.inc(epoch_samples)
+                epochs_done.inc()
+                if toc > tic:
+                    sps_gauge.set(epoch_samples / (toc - tic))
 
-            arg_params_out, aux_params_out = self.get_params()
-            self.set_params(arg_params_out, aux_params_out)
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params_out, aux_params_out)
+                # the reference round-trips every parameter through the host
+                # here each epoch; with device-resident weights (fused step)
+                # that transfer is pure waste unless a callback wants them —
+                # checkpoint callbacks still pull lazily via get_params
+                if epoch_end_callback is not None or \
+                        not self._params_device_resident():
+                    arg_params_out, aux_params_out = self.get_params()
+                    self.set_params(arg_params_out, aux_params_out)
+                if epoch_end_callback is not None:
+                    for callback in _as_list(epoch_end_callback):
+                        callback(epoch, self.symbol, arg_params_out, aux_params_out)
 
-            if eval_data:
-                with _tracing.span("fit.eval", category="module") as sp:
-                    res = self.score(eval_data, validation_metric,
-                                     score_end_callback=eval_end_callback,
-                                     batch_end_callback=eval_batch_end_callback,
-                                     epoch=epoch)
-                eval_ms.observe(sp.duration_ms)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name,
-                                     val)
-            train_data.reset()
+                if eval_data:
+                    if accum is not None:
+                        # validation updates the metric live (score() runs the
+                        # numpy path) — drop the training snapshot so an eval
+                        # Speedometer reads real values, not the stale cadence
+                        accum.last_snapshot = None
+                    with _tracing.span("fit.eval", category="module") as sp:
+                        res = self.score(eval_data, validation_metric,
+                                         score_end_callback=eval_end_callback,
+                                         batch_end_callback=eval_batch_end_callback,
+                                         epoch=epoch)
+                    eval_ms.observe(sp.duration_ms)
+                    for name, val in res:
+                        self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name,
+                                         val)
+                train_data.reset()
+        finally:
+            # post-fit reads (and the next fit) must see live values,
+            # not this run's last cadence snapshot
+            eval_metric._device_accum = None
+
 
     # ------------------------------------------------ symbol/params accessors
     @property
@@ -300,6 +460,18 @@ class BaseModule:
 
     def prepare(self, data_batch):
         pass
+
+    def _device_step_view(self, data_batch):
+        """(labels, outputs, pacing_token) of the last step as device
+        arrays, or None when this module can't expose them — the fit loop
+        then falls back to the per-batch numpy metric path."""
+        return None
+
+    def _params_device_resident(self):
+        """True when the live parameters already reside on device under
+        this module's control, making fit's per-epoch get_params/set_params
+        host round-trip a no-op worth skipping."""
+        return False
 
     # ------------------------------------------------ computation interface
     def forward(self, data_batch, is_train=None):

@@ -211,6 +211,16 @@ class BucketingModule(BaseModule):
         assert self.binded and self.params_initialized
         self._curr_module.update_metric(eval_metric, labels)
 
+    def _device_step_view(self, data_batch):
+        if self._curr_module is None or \
+                type(self).update_metric is not BucketingModule.update_metric:
+            return None  # subclass metric override must keep being called
+        return self._curr_module._device_step_view(data_batch)
+
+    def _params_device_resident(self):
+        return self._curr_module is not None and \
+            self._curr_module._params_device_resident()
+
     def install_monitor(self, mon):
         assert self.binded
         for mod in self._buckets.values():

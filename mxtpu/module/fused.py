@@ -270,6 +270,9 @@ class FusedTrainStep:
         self._step_fn = None
         self.state = state if state is not None else FusedState()
         self.outputs = None     # last step's outputs (device arrays)
+        self.last_labels = None  # last step's labels, already device-put —
+        # update_metric's device path reuses them instead of transferring
+        # the same host arrays a second time
 
     # shared-state views ------------------------------------------------
     @property
@@ -404,6 +407,7 @@ class FusedTrainStep:
                             (self.label_names, label_arrays)):
             for n, v in zip(names, arrs):
                 batch[n] = self._put(getattr(v, "_data", v), spec)
+        self.last_labels = [batch[n] for n in self.label_names if n in batch]
         if self._step_fn is None:
             # route through the executor's build seam: program_build_count,
             # the build listeners, the telemetry build counters and the

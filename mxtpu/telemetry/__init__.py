@@ -16,6 +16,13 @@ Hot-path call sites go through the module-level helpers (``counter()``,
 ``MXTPU_TELEMETRY=0`` — disabled, every helper is a cheap no-op so the
 bench harness can measure instrumentation overhead honestly.
 
+The pipelined ``Module.fit`` (docs/training_pipeline.md) splits its
+timing so async dispatch keeps the series honest: ``fit_dispatch_ms``
+is the host cost of ISSUING a step, ``fit_step_ms`` adds the bounded
+in-flight pacing wait (``fit_sync_wait_ms``), and ``fit_metric_sync_ms``
+is the cadence device->host metric snapshot — with a healthy pipeline
+``fit_step_ms ≈ fit_dispatch_ms`` and ``io_prefetch_stall_ms ≈ 0``.
+
 See docs/observability.md.
 """
 from __future__ import annotations

@@ -592,3 +592,31 @@ def check_speed(sym, location=None, ctx=None, N=20, grad_req="write",
         exe.backward()
     [o.asnumpy() for o in exe.outputs]
     return (_time.perf_counter() - t0) / N
+
+
+class FixedLatencyIter:
+    """DataIter wrapper adding a fixed per-batch fetch latency — models a
+    remote-storage/record-shard producer for pipeline tests and benches
+    (the regime ``io.DevicePrefetchIter`` exists to hide)."""
+
+    def __init__(self, inner, delay_s):
+        import time as _time_mod
+        self._time = _time_mod
+        self._inner = inner
+        self._delay = delay_s
+        self.batch_size = inner.batch_size
+        self.provide_data = inner.provide_data
+        self.provide_label = inner.provide_label
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        self._time.sleep(self._delay)
+        return self._inner.next()
+
+    def __next__(self):
+        return self.next()

@@ -1,0 +1,287 @@
+"""Asynchronous training pipeline (docs/training_pipeline.md): device-resident
+metric accumulation, bounded in-flight stepping, device-side input prefetch,
+and the PrefetchingIter lifecycle contract.
+
+Numerics model: the pipelined fit runs the SAME fused step program in the
+same order as the synchronous path — weights must match bit-for-bit, and
+integer-summed metrics (accuracy) must match exactly; float partial sums
+(cross-entropy) accumulate on device in f32 instead of host f64, and the
+elementwise math runs in XLA instead of numpy, so loss parity is asserted
+to float32 tolerance.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import metric as M
+from mxtpu import telemetry as tel
+from mxtpu.models import mlp as _mlp
+
+
+def _mnist_like(n=256, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 784).astype("float32")
+    y = rng.randint(0, 10, n).astype("float32")
+    return X, y
+
+
+def _fit_mlp(pipelined, num_epoch=2, seed=11, **fit_kwargs):
+    X, y = _mnist_like()
+    it = mx.io.NDArrayIter(X, y, batch_size=64, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp.get_symbol(10), context=mx.cpu())
+    metric = M.create(["acc", "ce"])
+    mx.random.seed(seed)
+    if pipelined:
+        kwargs = dict(device_metrics=True, max_in_flight=3,
+                      device_prefetch=True, metric_sync=2)
+    else:
+        kwargs = dict(device_metrics=False, max_in_flight=1,
+                      device_prefetch=False)
+    kwargs.update(fit_kwargs)
+    mod.fit(it, num_epoch=num_epoch, eval_metric=metric, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(), **kwargs)
+    weights = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    return dict(metric.get_name_value()), weights, mod
+
+
+def test_pipelined_fit_matches_sync_path():
+    """K-in-flight + device metrics + device prefetch must reproduce the
+    synchronous path: identical weights (same program, same order) and
+    identical end-of-epoch metric values."""
+    m_sync, w_sync, _ = _fit_mlp(pipelined=False)
+    m_pipe, w_pipe, mod = _fit_mlp(pipelined=True)
+    assert mod._fused is not None, "fused step was not armed"
+    for k in w_sync:
+        np.testing.assert_array_equal(
+            w_sync[k], w_pipe[k],
+            err_msg="weights diverged at %s: the pipeline changed the "
+                    "training math" % k)
+    # accuracy sums are integers: exact
+    assert m_sync["accuracy"] == m_pipe["accuracy"], (m_sync, m_pipe)
+    # cross-entropy partial sums accumulate in f32 on device
+    np.testing.assert_allclose(m_sync["cross-entropy"],
+                               m_pipe["cross-entropy"], rtol=1e-5)
+
+
+def test_device_metric_accum_matches_host_metrics():
+    rng = np.random.RandomState(0)
+    pred = rng.rand(32, 10).astype("f4")
+    pred /= pred.sum(1, keepdims=True)
+    lab = rng.randint(0, 10, 32).astype("f4")
+    for spec in ("acc", "ce", "mse", "mae", "rmse",
+                 ["acc", "ce"]):
+        host, dev = M.create(spec), M.create(spec)
+        accum = M.DeviceMetricAccum.wrap(dev)
+        assert accum is not None, spec
+        for _ in range(3):
+            host.update([mx.nd.array(lab)], [mx.nd.array(pred)])
+            accum.update([mx.nd.array(lab)], [mx.nd.array(pred)])
+        accum.sync()
+        for (hn, hv), (dn, dv) in zip(host.get_name_value(),
+                                      dev.get_name_value()):
+            assert hn == dn
+            np.testing.assert_allclose(hv, dv, rtol=1e-5, err_msg=str(spec))
+    topk_h, topk_d = M.TopKAccuracy(top_k=3), M.TopKAccuracy(top_k=3)
+    accum = M.DeviceMetricAccum.wrap(topk_d)
+    topk_h.update([mx.nd.array(lab)], [mx.nd.array(pred)])
+    accum.update([mx.nd.array(lab)], [mx.nd.array(pred)])
+    accum.sync()
+    assert topk_h.get()[1] == topk_d.get()[1]
+    # instance counts stay exact host ints
+    assert topk_d.num_inst == 32
+    # metrics without kernels refuse the wrap (numpy fallback stays)
+    assert M.DeviceMetricAccum.wrap(M.F1()) is None
+    assert M.DeviceMetricAccum.wrap(M.create(["acc", M.F1()])) is None
+
+
+def _phase_percentile(hist, before, after, p):
+    """Percentile over only the observations between two snapshots —
+    keeps the test from resetting the process-wide registry (which would
+    orphan the import-time standing engine/executor series)."""
+    n = after[0] - before[0]
+    assert n > 0
+    deltas = [a - b for a, b in zip(after[4], before[4])]
+    counts = [deltas[0]] + [deltas[i] - deltas[i - 1]
+                            for i in range(1, len(deltas))]
+    rank = (p / 100.0) * n
+    cum = 0
+    for i, c in enumerate(counts):
+        if c and cum + c >= rank:
+            lo = hist.bounds[i - 1] if i > 0 else 0.0
+            hi = hist.bounds[i]
+            return lo + (rank - cum) / c * (hi - lo) \
+                if hi != float("inf") else after[3]
+        cum += c
+    return after[3]
+
+
+def test_device_prefetch_hides_slow_producer():
+    """A producer slower than free but faster than the step must be fully
+    hidden: io_prefetch_stall_ms p90 ~ 0 (only the cold first batch ever
+    waits)."""
+    h = tel.registry().histogram("io_prefetch_stall_ms")
+    before = h.snapshot()
+    X = np.random.RandomState(0).rand(96, 8).astype("f4")
+    base = mx.io.NDArrayIter(X, np.zeros(96, "f4"), batch_size=4)
+    it = mx.io.DevicePrefetchIter(
+        mx.test_utils.FixedLatencyIter(base, 0.002))
+    n = 0
+    for batch in it:
+        time.sleep(0.008)        # the "training step" the producer hides in
+        n += 1
+    it.close()
+    assert n == 24
+    after = h.snapshot()
+    assert after[0] - before[0] == n + 1  # +1: the end-of-data probe waits
+    p90 = _phase_percentile(h, before, after, 90)
+    assert p90 < 2.0, \
+        "p90 stall %.3fms: prefetch failed to hide the producer" % p90
+
+
+def test_prefetching_iter_lifecycle():
+    """close() joins the producer threads; an exhausted iterator resets and
+    iterates again; a closed iterator raises instead of hanging."""
+    X = np.random.randn(16, 3).astype("f4")
+    base = mx.io.NDArrayIter(X, np.zeros(16, "f4"), batch_size=4)
+    it = mx.io.PrefetchingIter(base)
+    assert len(list(it)) == 4
+    it.reset()                   # regression: reset after exhaustion
+    assert len(list(it)) == 4
+    it.close()
+    it.close()                   # idempotent
+    assert not any(t.is_alive() for t in it.prefetch_threads)
+    with pytest.raises(mx.base.MXNetError):
+        it.reset()
+    with pytest.raises(mx.base.MXNetError):
+        it.next()
+    # context-manager form
+    with mx.io.PrefetchingIter(
+            mx.io.NDArrayIter(X, np.zeros(16, "f4"), batch_size=4)) as it2:
+        assert len(list(it2)) == 4
+    assert not any(t.is_alive() for t in it2.prefetch_threads)
+
+
+def test_ndarrayiter_multiworker_assembly_parity():
+    X = np.random.RandomState(3).randn(17, 5).astype("f4")
+    y = np.arange(17).astype("f4")
+    multi = mx.io.NDArrayIter(X, y, batch_size=5, num_workers=2)
+    single = mx.io.NDArrayIter(X, y, batch_size=5)
+    for _ in range(2):           # epoch 1 + reset + epoch 2
+        for bm, bs in zip(multi, single):
+            np.testing.assert_array_equal(bm.data[0].asnumpy(),
+                                          bs.data[0].asnumpy())
+            np.testing.assert_array_equal(bm.label[0].asnumpy(),
+                                          bs.label[0].asnumpy())
+            assert bm.pad == bs.pad
+        multi.reset()
+        single.reset()
+    multi.close()
+
+
+def test_fit_emits_dispatch_and_pacing_series():
+    reg = tel.registry()
+    d0 = reg.histogram("fit_dispatch_ms").count
+    s0 = reg.histogram("fit_step_ms").count
+    w0 = reg.histogram("fit_sync_wait_ms").count
+    m0 = reg.histogram("fit_metric_sync_ms").count
+    _, _, mod = _fit_mlp(pipelined=True, num_epoch=1)
+    assert mod._fused is not None
+    batches = 256 // 64
+    assert reg.histogram("fit_dispatch_ms").count == d0 + batches
+    assert reg.histogram("fit_step_ms").count == s0 + batches
+    # K=3 over 4 batches: window fills once -> at least one pacing wait
+    assert reg.histogram("fit_sync_wait_ms").count > w0
+    # cadence 2 over 4 batches + epoch end
+    assert reg.histogram("fit_metric_sync_ms").count >= m0 + 2
+
+
+def test_speedometer_consumes_cadence_snapshot():
+    """With a device accumulator attached, Speedometer must read the
+    cadence-synced snapshot, not force its own host sync."""
+    from mxtpu.model import BatchEndParam
+    m = M.create("acc")
+    accum = M.DeviceMetricAccum.wrap(m)
+    lab = np.array([0, 1, 1, 0], "f4")
+    pred = np.eye(2, dtype="f4")[[0, 1, 0, 0]]
+    accum.update([mx.nd.array(lab)], [mx.nd.array(pred)])
+    accum.sync()
+    m._device_accum = accum
+
+    def _boom():
+        raise AssertionError("Speedometer forced a host metric sync")
+    m.get_name_value = _boom
+
+    spd = mx.callback.Speedometer(batch_size=4, frequent=1,
+                                  auto_reset=False, log=False)
+    spd(BatchEndParam(epoch=0, nbatch=0, eval_metric=m, locals=None))
+    spd(BatchEndParam(epoch=0, nbatch=1, eval_metric=m, locals=None))
+    got = tel.registry().gauge("train_metric",
+                               labels={"metric": "accuracy"}).value
+    assert got == 0.75, got
+
+
+def test_every_batch_sync_covers_first_batch():
+    """Under the metric_sync=1 fallback (foreign batch callback), even the
+    nbatch=0 callback must see synced values — never a nan metric."""
+    X, y = _mnist_like(n=128)
+    it = mx.io.NDArrayIter(X, y, batch_size=64, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp.get_symbol(10), context=mx.cpu())
+    seen = []
+
+    def spy(param):  # non-Speedometer: forces per-batch sync
+        seen.append(dict(param.eval_metric.get_name_value()))
+
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05},
+            batch_end_callback=spy, device_metrics=True)
+    assert seen and not np.isnan(seen[0]["accuracy"]), seen[0]
+
+
+def test_multi_context_module_keeps_numpy_metric_path():
+    """Per-update-mean metrics (MSE/RMSE) are NOT merged-batch-equivalent
+    across executor slices — the classic multi-exec path must decline the
+    device view and keep the sliced numpy numerics."""
+    import os
+    X, y = _mnist_like(n=128)
+    os.environ["MXTPU_FUSED_MODULE"] = "0"
+    try:
+        it = mx.io.NDArrayIter(X, y, batch_size=64,
+                               label_name="softmax_label")
+        mod = mx.mod.Module(_mlp.get_symbol(10),
+                            context=[mx.cpu(0), mx.cpu(1)])
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params(mx.initializer.Xavier())
+        mod.init_optimizer(optimizer="sgd")
+        batch = next(iter(it))
+        mod.forward_backward(batch)
+        mod.update()
+        assert mod._device_step_view(batch) is None
+    finally:
+        os.environ.pop("MXTPU_FUSED_MODULE", None)
+
+
+def test_fit_skips_epoch_param_roundtrip_when_device_resident():
+    """With the fused step armed and no epoch_end_callback, fit must not
+    round-trip parameters through get_params/set_params each epoch; with a
+    callback, the params still flow to it."""
+    X, y = _mnist_like(n=128)
+    it = mx.io.NDArrayIter(X, y, batch_size=64, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp.get_symbol(10), context=mx.cpu())
+    calls = []
+    orig = mod.get_params
+    mod.get_params = lambda: (calls.append(1), orig())[1]
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05})
+    assert mod._fused is not None
+    assert not calls, "fit still round-trips params with device-resident " \
+        "weights (%d get_params calls)" % len(calls)
+
+    seen = []
+    mod2 = mx.mod.Module(_mlp.get_symbol(10), context=mx.cpu())
+    mod2.fit(it, num_epoch=1, optimizer="sgd",
+             optimizer_params={"learning_rate": 0.05},
+             epoch_end_callback=lambda e, s, a, x: seen.append(set(a)))
+    assert seen and "fc1_weight" in seen[0]
