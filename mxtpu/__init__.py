@@ -35,6 +35,7 @@ from . import log
 from . import registry
 from . import libinfo
 from . import telemetry
+from . import diagnostics
 from .executor import Executor
 
 # subsystems imported lazily-but-eagerly; order matters (no cycles)

@@ -17,7 +17,7 @@ import os
 import subprocess
 import threading
 
-from .base import MXNetError
+from .base import NativeError
 
 _LIB = None
 _LIB_LOCK = threading.Lock()
@@ -109,9 +109,9 @@ def get_lib():
 
 
 def check_call(ret):
-    """Raise MXNetError with the native message on nonzero return."""
+    """Raise NativeError with the native message on nonzero return."""
     if ret != 0:
-        raise MXNetError(get_lib().MXTPUGetLastError().decode("utf-8"))
+        raise NativeError(get_lib().MXTPUGetLastError().decode("utf-8"))
 
 
 def native_available():

@@ -11,7 +11,7 @@ import ast
 import logging
 import os
 
-__all__ = ["MXNetError", "MXTPUError", "Registry", "getenv", "string_types", "numeric_types"]
+__all__ = ["MXNetError", "MXTPUError", "NativeError", "Registry", "getenv", "string_types", "numeric_types"]
 
 string_types = (str,)
 numeric_types = (float, int)
@@ -23,6 +23,14 @@ class MXNetError(RuntimeError):
 
 # native name for the new framework; MXNetError kept as a compat alias
 MXTPUError = MXNetError
+
+
+class NativeError(MXNetError):
+    """A nonzero return from the native engine/runtime — a backend
+    failure, NOT a usage error. Kept as an MXNetError subclass so
+    existing ``except MXNetError`` callers still catch it, but
+    distinguishable where it matters (diagnostics postmortems capture
+    backend failures and stay silent on bad user input)."""
 
 
 def getenv(name, default):

@@ -1,0 +1,383 @@
+"""Device-memory accounting: a process-wide buffer ledger.
+
+The reference framework answers "how much memory will this graph take"
+statically (NNVM PlanMemory, src/pass/plan_memory.cc) because it owns
+every allocation. Here XLA owns the buffers, so the ledger answers the
+*runtime* form of the question instead: how many device bytes are live
+RIGHT NOW, which subsystem allocated them, and what was the peak — the
+numbers an OOM postmortem or a capacity plan actually needs.
+
+Two tracking modes feed one set of per-``(ctx, origin)`` totals:
+
+* **buffer tracking** (``track``): a ``weakref.finalize`` on the jax
+  buffer decrements the ledger the moment the buffer is garbage
+  collected — exact for allocation sites whose buffers live as long as
+  their Python wrapper (ndarray creation, executor binds, prefetch
+  staging). Double-wraps of one buffer dedup by buffer identity.
+* **slot accounting** (``slot``): an owner-scoped byte count for state
+  whose *buffers* churn every step while its *size* is shape-fixed (the
+  fused train step donates and replaces every parameter buffer per
+  step; per-buffer finalizers there would cost a registration per
+  parameter per step and still undercount between steps). The slot dies
+  with its owner.
+
+Origins are attributed by allocation *site* via a contextvar
+(``alloc_origin``): the serving pool wraps its predictor binds so every
+buffer a cached executor allocates lands under ``serving_pool`` even
+though the mechanics run through the same ``Executor``/``nd.zeros``
+code paths as training.
+
+``reconcile()`` is the drift check: it sums ``jax.live_arrays()`` (the
+runtime's own truth) against the ledger so untracked allocation paths
+show up as a number instead of silent undercounting.
+
+Everything except ``reconcile`` is stdlib-only and safe on any thread.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import threading
+import weakref
+from collections import deque
+
+from .. import telemetry as _tel
+
+__all__ = ["DeviceMemoryLedger", "ledger", "mem_enabled", "set_mem_enabled",
+           "alloc_origin", "current_origin", "DEFAULT_ORIGIN",
+           "device_label"]
+
+DEFAULT_ORIGIN = "ndarray"
+
+_ENABLED = os.environ.get("MXTPU_DIAG_MEM", "1") != "0"
+
+_origin = contextvars.ContextVar("mxtpu_alloc_origin", default=None)
+
+
+def mem_enabled():
+    """Whether the allocation seams feed the ledger."""
+    return _ENABLED
+
+
+def set_mem_enabled(flag):
+    """Runtime toggle for the allocation seams (the bench harness flips
+    this; ``MXTPU_DIAG_MEM=0`` sets the initial state). Buffers already
+    tracked keep their finalizers — disabling stops NEW registrations."""
+    global _ENABLED
+    _ENABLED = bool(flag)
+
+
+def current_origin():
+    """The allocation origin ambient on this thread (see alloc_origin)."""
+    o = _origin.get()
+    return o if o is not None else DEFAULT_ORIGIN
+
+
+@contextlib.contextmanager
+def alloc_origin(origin, override=False):
+    """Attribute allocations inside the block to ``origin``. The OUTERMOST
+    attribution wins by default (an executor bind inside a serving-pool
+    block stays ``serving_pool``); pass ``override=True`` to re-tag."""
+    if not override and _origin.get() is not None:
+        yield
+        return
+    token = _origin.set(origin)
+    try:
+        yield
+    finally:
+        _origin.reset(token)
+
+
+class _Slot:
+    """Owner-scoped byte count; freed when the owner is collected."""
+
+    __slots__ = ("_ledger", "_key", "_nbytes", "__weakref__")
+
+    def __init__(self, ledger_, key, nbytes):
+        self._ledger = ledger_
+        self._key = key
+        self._nbytes = 0
+        self.set(nbytes)
+
+    def set(self, nbytes):
+        # delta = nbytes - self._nbytes is a read-modify-write: it must
+        # happen under the ledger lock (ledger._slot_set) or two racing
+        # set() calls both apply their full delta and the totals drift
+        self._ledger._slot_set(self, int(nbytes))
+
+    def close(self):
+        self.set(0)
+
+    def _drain_close(self, apply):
+        """Called by the ledger's drain, lock already held."""
+        if self._nbytes:
+            apply(self._key, -self._nbytes)
+            self._nbytes = 0
+
+
+class DeviceMemoryLedger:
+    """Thread-safe live/peak device-byte totals per ``(ctx, origin)``.
+
+    ``alloc``/``free`` are the primitive pair (exact under concurrency —
+    the watchdog postmortem and the reconcile check both depend on the
+    totals never drifting from the sum of outstanding tokens);
+    ``track``/``slot`` build the automatic lifetimes on top.
+    """
+
+    def __init__(self, register_gauges=True):
+        self._lock = threading.Lock()
+        self._live = {}        # (ctx, origin) -> bytes
+        self._live_ctx = {}    # ctx -> bytes
+        self._peak_ctx = {}    # ctx -> bytes
+        self._tracked = {}     # id(buf) -> token  (dedup + finalizer target)
+        self._n_buffers = 0
+        self._register_gauges = register_gauges
+        self._gauged = set()
+        # finalizer side-channel: weakref.finalize callbacks run inside
+        # the garbage collector, which can fire on ANY allocation —
+        # including one made while this thread already holds self._lock.
+        # A finalizer that takes the lock would then self-deadlock, so
+        # finalizers only ever append here (deque.append is atomic) and
+        # the entries are drained under the lock at the next write/read.
+        self._deferred = deque()
+
+    # ------------------------------------------------------------ primitives
+    def _drain_locked(self, new_pairs):
+        """Apply parked finalizer releases; caller holds self._lock."""
+        while True:
+            try:
+                kind, payload = self._deferred.popleft()
+            except IndexError:
+                return
+            if kind == "buf":
+                token = self._tracked.pop(payload, None)
+                if token is not None:
+                    self._n_buffers -= 1
+                    key, nbytes = token
+                    self._apply(key, -nbytes, new_pairs)
+            else:  # slot
+                payload._drain_close(
+                    lambda k, d: self._apply(k, d, new_pairs))
+
+    def _apply(self, key, delta, new_pairs):
+        """Inner accounting; caller holds self._lock."""
+        ctx = key[0]
+        if key not in self._live and self._register_gauges:
+            new_pairs.append(key)
+        self._live[key] = self._live.get(key, 0) + delta
+        total = self._live_ctx.get(ctx, 0) + delta
+        self._live_ctx[ctx] = total
+        if total > self._peak_ctx.get(ctx, 0):
+            self._peak_ctx[ctx] = total
+    def _gauge_key(self, key):
+        """Register the telemetry gauges for a new (ctx, origin) pair —
+        registry-direct so the series exist under MXTPU_TELEMETRY=0
+        (standing-series convention, see telemetry.set_enabled)."""
+        ctx, origin = key
+        reg = _tel.registry()
+        reg.gauge("mem_live_bytes", labels={"ctx": ctx, "origin": origin},
+                  fn=lambda k=key: self._gauge_live(k),
+                  help="live device bytes the ledger attributes to "
+                       "(ctx, origin)")
+        if ctx not in {c for c, _ in self._gauged}:
+            reg.gauge("mem_peak_bytes", labels={"ctx": ctx},
+                      fn=lambda c=ctx: self._gauge_peak(c),
+                      help="high-water mark of ledger-tracked live bytes")
+        self._gauged.add(key)
+
+    def _gauge_live(self, key):
+        self._drain()   # a scrape must see finalized frees
+        return self._live.get(key, 0)
+
+    def _gauge_peak(self, ctx):
+        self._drain()
+        return self._peak_ctx.get(ctx, 0)
+
+    def _add(self, key, delta):
+        new_pairs = []
+        with self._lock:
+            if self._deferred:
+                self._drain_locked(new_pairs)
+            self._apply(key, delta, new_pairs)
+        for k in new_pairs:   # gauge registration outside the ledger lock
+            self._gauge_key(k)
+
+    def _slot_set(self, slot, nbytes):
+        """Atomic slot resize: the delta against the slot's current size
+        is computed and applied under the ledger lock, so concurrent
+        ``set()`` calls (two fits sharing a FusedState) serialize instead
+        of double-applying."""
+        new_pairs = []
+        with self._lock:
+            if self._deferred:
+                self._drain_locked(new_pairs)
+            delta = nbytes - slot._nbytes
+            if delta:
+                self._apply(slot._key, delta, new_pairs)
+                slot._nbytes = nbytes
+        for k in new_pairs:
+            self._gauge_key(k)
+
+    def alloc(self, nbytes, ctx="cpu(0)", origin=None):
+        """Record ``nbytes`` live; returns the token to ``free`` later."""
+        origin = origin or current_origin()
+        key = (str(ctx), origin)
+        nbytes = int(nbytes)
+        self._add(key, nbytes)
+        return (key, nbytes)
+
+    def free(self, token):
+        key, nbytes = token
+        self._add(key, -nbytes)
+
+    # ------------------------------------------------------------ lifetimes
+    def track(self, buf, origin=None, ctx=None):
+        """Tie ``buf.nbytes`` to the buffer's lifetime (weakref.finalize).
+        Re-tracking a live buffer is a no-op (first origin wins), so a
+        buffer wrapped by several NDArrays/executors counts once."""
+        bid = id(buf)
+        new_pairs = []
+        with self._lock:
+            # drain parked finalizer releases BEFORE the dedup check: a
+            # dead buffer's id can be reused by ``buf`` itself, and its
+            # stale _tracked entry would make this live buffer
+            # permanently invisible to the ledger
+            if self._deferred:
+                self._drain_locked(new_pairs)
+            already = bid in self._tracked
+        for k in new_pairs:
+            self._gauge_key(k)
+        if already:
+            return False
+        if ctx is None:
+            ctx = _ctx_of(buf)
+        token = self.alloc(getattr(buf, "nbytes", 0), ctx=ctx, origin=origin)
+        with self._lock:
+            if bid in self._tracked:   # lost a registration race: undo ours
+                dup = True
+            else:
+                self._tracked[bid] = token
+                self._n_buffers += 1
+                dup = False
+        if dup:
+            self.free(token)
+            return False
+        try:
+            # the finalizer must NOT touch the ledger lock (it runs
+            # inside gc, possibly while this thread holds it): park the
+            # release and let the next locked operation drain it
+            weakref.finalize(buf, self._deferred.append, ("buf", bid))
+        except TypeError:      # buffer type without weakref support
+            with self._lock:
+                self._tracked.pop(bid, None)
+                self._n_buffers -= 1
+            self.free(token)
+            return False
+        return True
+
+    def slot(self, owner, nbytes, origin, ctx="cpu(0)"):
+        """Owner-scoped byte count (see module docstring); returns the
+        slot so the owner can ``set()`` a new size. Freed when ``owner``
+        is collected (deferred, like buffer finalizers)."""
+        s = _Slot(self, (str(ctx), origin), nbytes)
+        weakref.finalize(owner, self._deferred.append, ("slot", s))
+        return s
+
+    def _drain(self):
+        """Fold parked finalizer releases into the totals now."""
+        new_pairs = []
+        with self._lock:
+            if self._deferred:
+                self._drain_locked(new_pairs)
+        for k in new_pairs:
+            self._gauge_key(k)
+
+    # ------------------------------------------------------------ reads
+    def live_bytes(self, origin=None, ctx=None):
+        self._drain()
+        with self._lock:
+            if origin is None and ctx is None:
+                return sum(self._live_ctx.values())
+            return sum(v for (c, o), v in self._live.items()
+                       if (origin is None or o == origin)
+                       and (ctx is None or c == str(ctx)))
+
+    def peak_bytes(self, ctx=None):
+        self._drain()
+        with self._lock:
+            if ctx is None:
+                return max(self._peak_ctx.values(), default=0)
+            return self._peak_ctx.get(str(ctx), 0)
+
+    @property
+    def tracked_buffers(self):
+        self._drain()
+        return self._n_buffers
+
+    def snapshot(self):
+        """JSON-ready view: per-(ctx, origin) live bytes, per-ctx totals
+        and peaks, tracked-buffer count."""
+        self._drain()
+        with self._lock:
+            by_origin = {"%s/%s" % k: v for k, v in sorted(self._live.items())
+                         if v}
+            return {
+                "live_bytes": by_origin,
+                "live_bytes_total": sum(self._live_ctx.values()),
+                "live_bytes_by_ctx": dict(sorted(self._live_ctx.items())),
+                "peak_bytes_by_ctx": dict(sorted(self._peak_ctx.items())),
+                "tracked_buffers": self._n_buffers,
+            }
+
+    def reconcile(self):
+        """Drift check against the runtime's own account: sum
+        ``jax.live_arrays()`` and compare with the ledger. A growing
+        ``drift_bytes`` means an allocation path escapes the seams."""
+        import jax
+        live = 0
+        count = 0
+        for a in jax.live_arrays():
+            try:
+                live += a.nbytes
+                count += 1
+            except Exception:
+                pass
+        ledger_bytes = self.live_bytes()
+        drift = live - ledger_bytes
+        _tel.registry().gauge(
+            "mem_drift_bytes",
+            help="jax.live_arrays() total minus ledger total at the last "
+                 "reconcile() — untracked allocations").set(drift)
+        return {"ledger_bytes": ledger_bytes, "live_bytes": live,
+                "live_arrays": count, "drift_bytes": drift}
+
+
+_LEDGER = DeviceMemoryLedger()
+
+_tel.registry().gauge("mem_tracked_buffers",
+                      fn=lambda: _LEDGER.tracked_buffers,
+                      help="device buffers with a live ledger finalizer")
+
+
+def ledger():
+    """The process-wide DeviceMemoryLedger."""
+    return _LEDGER
+
+
+def device_label(d):
+    """Ledger context label ('cpu(0)') for a jax.Device — same rendering
+    as ``str(Context)`` so both seams land on one series."""
+    try:
+        plat = "gpu" if d.platform in ("gpu", "cuda", "rocm") else d.platform
+        return "%s(%d)" % (plat, d.id)
+    except Exception:
+        return "unknown"
+
+
+def _ctx_of(buf):
+    """Context label from a jax buffer's committed device."""
+    try:
+        return device_label(next(iter(buf.devices())))
+    except Exception:
+        return "unknown"

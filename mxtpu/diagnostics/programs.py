@@ -1,0 +1,167 @@
+"""Per-program cost introspection: what every compiled program costs.
+
+TVM-style frameworks treat per-program cost models (flops, bytes moved)
+as first-class metadata — the substrate every later optimisation reads
+("Learning to Optimize Tensor Programs", PAPERS.md). mxtpu builds every
+device program through one seam (``executor._notify_build`` /
+``record_program_build``), so this registry captures XLA's own numbers
+at that seam: ``compiled.cost_analysis()`` (flops, bytes accessed) and
+``compiled.memory_analysis()`` (argument/output/temp bytes, generated
+code size) for every program kind in the process — executor forwards,
+the fused train step, metric accumulators, serving binds.
+
+The capture itself costs nothing extra at steady state: the build seam's
+first call lowers and compiles the program explicitly (the same work
+``jax.jit`` would do lazily), reads the analyses off the executable, and
+keeps the compiled object as the dispatch fast path. ``MXTPU_DIAG_COST=0``
+restores the plain lazy-jit path.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import deque
+
+from .. import telemetry as _tel
+
+__all__ = ["ProgramRecord", "record_program", "programs", "program_table",
+           "cost_enabled", "set_cost_enabled", "clear"]
+
+_ENABLED = os.environ.get("MXTPU_DIAG_COST", "1") != "0"
+
+#: retain at most this many program records (a long-lived serving
+#: process rebinding shapes must not grow without bound)
+MAX_RECORDS = int(os.environ.get("MXTPU_DIAG_COST_CAP", "1024"))
+
+_ids = itertools.count(1)
+_RECORDS = deque(maxlen=MAX_RECORDS)
+_LOCK = threading.Lock()
+
+
+def cost_enabled():
+    return _ENABLED
+
+
+def set_cost_enabled(flag):
+    """Runtime toggle; affects programs built AFTER the flip (capture
+    happens once, at first dispatch)."""
+    global _ENABLED
+    _ENABLED = bool(flag)
+
+
+def owner_name(owner):
+    """Normalize an owner to its display name. Callers that hold the
+    name in a long-lived closure (executor._instrument_program) call
+    this EARLY so the closure never pins the owner object itself."""
+    if isinstance(owner, str):
+        return owner
+    return type(owner).__name__ if owner is not None else ""
+
+
+class ProgramRecord:
+    """One compiled program's captured cost/memory metadata."""
+
+    __slots__ = ("id", "kind", "owner", "created", "compile_ms", "flops",
+                 "bytes_accessed", "argument_bytes", "output_bytes",
+                 "temp_bytes", "generated_code_bytes", "calls")
+
+    def __init__(self, kind, owner, compile_ms):
+        self.id = next(_ids)
+        self.kind = kind
+        self.owner = owner_name(owner)
+        self.created = time.time()
+        self.compile_ms = compile_ms
+        self.flops = 0.0
+        self.bytes_accessed = 0.0
+        self.argument_bytes = 0
+        self.output_bytes = 0
+        self.temp_bytes = 0
+        self.generated_code_bytes = 0
+        self.calls = 0
+
+    def to_dict(self):
+        return {
+            "id": self.id, "kind": self.kind, "owner": self.owner,
+            "created": round(self.created, 3),
+            "compile_ms": round(self.compile_ms, 3),
+            "flops": self.flops, "bytes_accessed": self.bytes_accessed,
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "temp_bytes": self.temp_bytes,
+            "generated_code_bytes": self.generated_code_bytes,
+            "calls": self.calls,
+        }
+
+
+def record_program(kind, owner, compiled, compile_ms):
+    """Capture a freshly compiled executable's analyses into the registry
+    (and the telemetry counters). Never raises — introspection must not
+    take down the program it is describing."""
+    rec = ProgramRecord(kind, owner, compile_ms)
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        rec.flops = float(cost.get("flops", 0.0))
+        rec.bytes_accessed = float(cost.get("bytes accessed", 0.0))
+    except Exception:
+        pass
+    try:
+        mem = compiled.memory_analysis()
+        rec.argument_bytes = int(mem.argument_size_in_bytes)
+        rec.output_bytes = int(mem.output_size_in_bytes)
+        rec.temp_bytes = int(mem.temp_size_in_bytes)
+        rec.generated_code_bytes = int(mem.generated_code_size_in_bytes)
+    except Exception:
+        pass
+    with _LOCK:
+        _RECORDS.append(rec)
+    reg = _tel.registry()
+    labels = {"kind": kind}
+    reg.counter("program_captured",
+                help="programs whose cost/memory analysis was captured",
+                labels=labels).inc()
+    reg.counter("program_flops", labels=labels,
+                help="total flops of captured programs (per execution, "
+                     "summed over builds)").inc(rec.flops)
+    reg.counter("program_bytes_accessed", labels=labels,
+                help="total bytes-accessed of captured programs").inc(
+        rec.bytes_accessed)
+    g = reg.gauge("program_temp_bytes_peak", labels=labels,
+                  help="largest XLA temp (scratch) allocation among "
+                       "captured programs of this kind")
+    if rec.temp_bytes > g.value:
+        g.set(rec.temp_bytes)
+    return rec
+
+
+def programs(kind=None):
+    """Snapshot of captured records (list of dicts, oldest first)."""
+    with _LOCK:
+        recs = list(_RECORDS)
+    return [r.to_dict() for r in recs if kind is None or r.kind == kind]
+
+
+def program_table(kind=None):
+    """Human-readable cost report, one row per captured program."""
+    rows = programs(kind)
+    header = ("id", "kind", "owner", "calls", "compile_ms", "mflops",
+              "mb_accessed", "arg_kb", "out_kb", "temp_kb")
+    lines = ["%4s %-12s %-16s %6s %10s %10s %11s %8s %8s %8s" % header]
+    for r in rows:
+        lines.append("%4d %-12s %-16s %6d %10.1f %10.2f %11.2f %8d %8d %8d"
+                     % (r["id"], r["kind"][:12], r["owner"][:16], r["calls"],
+                        r["compile_ms"], r["flops"] / 1e6,
+                        r["bytes_accessed"] / 1e6,
+                        r["argument_bytes"] // 1024,
+                        r["output_bytes"] // 1024,
+                        r["temp_bytes"] // 1024))
+    return "\n".join(lines)
+
+
+def clear():
+    """Drop captured records (tests)."""
+    with _LOCK:
+        _RECORDS.clear()

@@ -1,0 +1,215 @@
+"""Hang watchdog: detect no-progress intervals and dump a postmortem.
+
+A wedged training or serving session is worse than a crashed one — it
+holds its TPU reservation and says nothing. The watchdog is a daemon
+thread that samples two progress signals:
+
+* **engine**: the host-side dependency engine has queued work
+  (``queue depth > 0``) but its completion counter has not moved for
+  longer than ``engine_stall_s`` — a worker is stuck inside a callback
+  or the native scheduler lost a wakeup;
+* **device waits**: a thread has been blocked inside
+  ``executor.device_wait`` (the fit loop's pacing sync — the analogue of
+  WaitToRead) for longer than ``wait_stall_s`` — the device program
+  never completed, the classic sign of a collective waiting for a peer.
+
+On detection it emits ONE structured postmortem (flight-recorder ring,
+engine state, buffer ledger, program table — see
+``diagnostics.postmortem``) and re-arms only after progress resumes, so
+a wedge produces a dump, not a dump storm.
+
+Deadlines and cadence come from env vars (``MXTPU_WATCHDOG_INTERVAL_S``,
+``MXTPU_WATCHDOG_ENGINE_S``, ``MXTPU_WATCHDOG_WAIT_S``); tests inject a
+fake ``engine_probe`` and millisecond deadlines.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from .. import telemetry as _tel
+
+__all__ = ["Watchdog", "ensure_watchdog", "stop_watchdog", "wait_begin",
+           "wait_end", "active_waits"]
+
+# ------------------------------------------------------- device-wait registry
+_WAITS = {}  # thread id -> (t0, description); GIL-atomic dict ops
+
+
+def wait_begin(desc="device_wait"):
+    """Mark this thread as blocked on the device (executor.device_wait)."""
+    _WAITS[threading.get_ident()] = (time.monotonic(), desc)
+
+
+def wait_end():
+    _WAITS.pop(threading.get_ident(), None)
+
+
+def active_waits():
+    """[{thread, age_s, desc}] for every thread currently blocked."""
+    now = time.monotonic()
+    out = []
+    for tid, (t0, desc) in list(_WAITS.items()):
+        out.append({"thread": tid, "age_s": round(now - t0, 3),
+                    "desc": desc})
+    return out
+
+
+def _default_engine_probe():
+    """(queue_depth, ops_completed) from the live engine singleton."""
+    from .. import engine as _engine
+    e = _engine._ENGINE
+    depth = len(e._pending) if isinstance(e, _engine.ThreadedEngine) else 0
+    return depth, _engine._M_COMPLETED.value
+
+
+class Watchdog:
+    """Daemon sampling thread; see module docstring for the conditions."""
+
+    def __init__(self, interval=None, engine_stall_s=None, wait_stall_s=None,
+                 engine_probe=None, on_detect=None):
+        env = os.environ.get
+        self.interval = float(interval if interval is not None
+                              else env("MXTPU_WATCHDOG_INTERVAL_S", "1.0"))
+        self.engine_stall_s = float(
+            engine_stall_s if engine_stall_s is not None
+            else env("MXTPU_WATCHDOG_ENGINE_S", "30"))
+        self.wait_stall_s = float(
+            wait_stall_s if wait_stall_s is not None
+            else env("MXTPU_WATCHDOG_WAIT_S", "60"))
+        self._engine_probe = engine_probe or _default_engine_probe
+        self._on_detect = on_detect
+        self._stop = threading.Event()
+        self._thread = None
+        # one dump per wedge, PER DETECTOR: a persistent wait stall must
+        # not keep the engine detector disarmed (or vice versa)
+        self._armed_engine = True
+        self._armed_wait = True
+        self._last_completed = None
+        self._last_progress_t = time.monotonic()
+        self.detections = 0
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="mxtpu-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=self.interval + 1.0)
+        self._thread = None
+
+    @property
+    def running(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    # ------------------------------------------------------------ sampling
+    def check(self):
+        """One sampling pass; returns the detection reason or None.
+        Public so tests can drive it without the thread."""
+        now = time.monotonic()
+        try:
+            depth, completed = self._engine_probe()
+        except Exception:
+            depth, completed = 0, None
+        engine_reason = None
+        if completed != self._last_completed or depth == 0:
+            # progress (or nothing queued): reset the stall clock and
+            # re-arm THIS detector (a concurrent wait stall must not
+            # keep the engine detector disarmed, and vice versa)
+            self._last_completed = completed
+            self._last_progress_t = now
+            self._armed_engine = True
+        elif now - self._last_progress_t > self.engine_stall_s:
+            engine_reason = ("engine stalled: queue depth %d, no "
+                             "completions for %.1fs"
+                             % (depth, now - self._last_progress_t))
+        wait_reason = None
+        stalled = [w for w in active_waits()
+                   if w["age_s"] > self.wait_stall_s]
+        if not stalled:
+            self._armed_wait = True
+        else:
+            w = max(stalled, key=lambda x: x["age_s"])
+            wait_reason = ("device_wait stalled: thread %d blocked %.1fs "
+                           "in %s" % (w["thread"], w["age_s"], w["desc"]))
+        if engine_reason is not None and self._armed_engine:
+            self._armed_engine = False
+            return self._detect(engine_reason)
+        if wait_reason is not None and self._armed_wait:
+            self._armed_wait = False
+            return self._detect(wait_reason)
+        return None
+
+    def _detect(self, reason):
+        self.detections += 1
+        _tel.registry().counter(
+            "watchdog_detections",
+            help="no-progress intervals the watchdog flagged").inc()
+        self._fire(reason)
+        return reason
+
+    def _fire(self, reason):
+        if self._on_detect is not None:
+            try:
+                self._on_detect(reason)
+            except Exception:
+                pass
+        else:
+            from . import postmortem
+            postmortem("watchdog: %s" % reason, source="watchdog")
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.check()
+            except Exception:
+                pass  # the watchdog must outlive anything it watches
+
+
+_SINGLETON = None
+_SINGLETON_LOCK = threading.Lock()
+
+
+def _singleton_progress_age():
+    """Gauge callback reading the SINGLETON (throwaway test watchdogs
+    must not pin or shadow the live one — engine-gauge convention)."""
+    w = _SINGLETON
+    if w is None:
+        return 0.0
+    return round(time.monotonic() - w._last_progress_t, 3)
+
+
+_tel.registry().gauge(
+    "watchdog_last_progress_age_s", fn=_singleton_progress_age,
+    help="seconds since the watchdog last saw engine progress "
+         "(or an empty queue); 0 with no watchdog running")
+
+
+def ensure_watchdog():
+    """Start the process watchdog (idempotent). Called from ``Module.fit``
+    and ``ServingSession``; ``MXTPU_WATCHDOG=0`` disables it."""
+    global _SINGLETON
+    if os.environ.get("MXTPU_WATCHDOG", "1") == "0":
+        return None
+    with _SINGLETON_LOCK:
+        if _SINGLETON is None:
+            _SINGLETON = Watchdog()
+        _SINGLETON.start()
+        return _SINGLETON
+
+
+def stop_watchdog():
+    global _SINGLETON
+    with _SINGLETON_LOCK:
+        if _SINGLETON is not None:
+            _SINGLETON.stop()
+            _SINGLETON = None

@@ -27,6 +27,7 @@ import time
 from . import _native
 from ._native import check_call
 from . import telemetry as _tel
+from .diagnostics import flight as _flight
 from .telemetry import tracing as _tracing
 
 
@@ -69,6 +70,7 @@ class NaiveEngine:
 
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
         _M_DISPATCHED.inc()
+        _flight.record("engine", "push", "sync")
         t0 = time.perf_counter()
         with _tracing.span("engine.dispatch", category="engine"):
             fn()
@@ -145,6 +147,9 @@ class ThreadedEngine:
             token = self._next_token  # nonzero: ctx NULL maps to token 0
             self._pending[token] = (fn, time.perf_counter(),
                                     _tracing.current_span())
+        # flight-recorder breadcrumb: a postmortem's last "push" without a
+        # matching dispatch span is the op the wedged worker never ran
+        _flight.record("engine", "push", token)
         n_c, n_m = len(const_vars), len(mutable_vars)
         cv = (ctypes.c_void_p * max(n_c, 1))(
             *[v.handle for v in const_vars]) if n_c else None

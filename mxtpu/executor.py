@@ -26,6 +26,7 @@ from .context import Context, current_context
 from .ndarray import NDArray, zeros
 from . import random as _rnd
 from . import telemetry as _tel
+from . import diagnostics as _diag
 from .telemetry import tracing as _tracing
 
 __all__ = ["Executor", "add_build_listener", "remove_build_listener",
@@ -37,14 +38,22 @@ def device_wait(x):
     has finished computing: the explicit engine-sync point of the
     pipelined ``Module.fit`` loop (the WaitToRead analogue the bounded
     in-flight window uses to pace dispatch). Returns the wall-clock
-    milliseconds spent blocked, so callers can report pacing honestly."""
+    milliseconds spent blocked, so callers can report pacing honestly.
+
+    The wait registers itself with the diagnostics watchdog: a thread
+    stuck here past the deadline is the classic wedged-device signature
+    and triggers a postmortem dump."""
     import time as _time
     t0 = _time.perf_counter()
     if isinstance(x, (list, tuple)):
         x = [getattr(a, "_data", a) for a in x]
     else:
         x = getattr(x, "_data", x)
-    jax.block_until_ready(x)
+    _diag.wait_begin("device_wait")
+    try:
+        jax.block_until_ready(x)
+    finally:
+        _diag.wait_end()
     return (_time.perf_counter() - t0) * 1e3
 
 # standing series: registry-direct so they exist for /metrics even when
@@ -101,51 +110,157 @@ def _notify_build(kind, executor):
 
 def record_program_build(kind, owner, fn):
     """Public build-seam entry for program tables OUTSIDE Executor (the
-    fused train step): bump the build counters, notify the listeners,
-    and wrap ``fn`` for first-call compile timing — the exact sequence
-    ``_get_fn`` performs, so every traced-program construction in the
-    process reports through one seam."""
+    fused train step, metric accumulators): bump the build counters,
+    notify the listeners, and wrap ``fn`` for first-call compile timing
+    and cost capture — the exact sequence ``_get_fn`` performs, so every
+    traced-program construction in the process reports through one seam."""
     _notify_build(kind, owner)
-    return _time_first_call(kind, fn)
+    return _instrument_program(kind, fn, owner=owner)
 
 
-def _time_first_call(kind, fn):
-    """Wrap a freshly built program so its FIRST invocation — the one
-    that pays jit tracing + XLA compilation — lands in the
-    ``executor_compile_ms{kind=...}`` histogram. Steady-state calls go
-    straight through (one attribute read of overhead)."""
+_AOT_MISS = object()     # sentinel: "the AOT capture path produced nothing"
+_DEMOTE_MISSES = 8       # consecutive signature misses → demote to jit
+_DEMOTE_MISS_TOTAL = 64  # lifetime misses → demote even if hits interleave
+
+
+def _instrument_program(kind, fn, owner=None, matmul_env=False):
+    """Wrap a freshly built jit program with the build-seam diagnostics.
+
+    First invocation — the one that pays tracing + XLA compilation —
+    lands in ``executor_compile_ms{kind=...}``. When cost introspection
+    is on (``MXTPU_DIAG_COST``, default), that first call compiles the
+    program EXPLICITLY via the AOT path (``fn.lower(...).compile()`` —
+    the same work jit would do lazily, not an extra compile), captures
+    ``cost_analysis``/``memory_analysis`` into the diagnostics program
+    registry, and keeps the compiled executable as the dispatch fast
+    path. A later call with a different signature (dtype/shape/sharding
+    change) falls back to the jit function, which retraces per signature
+    exactly as before.
+
+    ``matmul_env`` preserves the ``MXTPU_MATMUL_PRECISION`` contract for
+    Executor programs: every call re-reads the env, and while it is set
+    both the AOT capture and any previously captured executable are
+    bypassed (flipping it retraces rather than returning stale
+    programs); a first call made while it is set defers the capture to
+    the first call after it clears."""
+    import os as _os
     import time as _time
-    state = {"first": True}
+    # keep only the owner's NAME: the wrapper outlives the owner in
+    # process-global caches (metric.py _ACCUM_FN_CACHE), and a closure
+    # ref would pin the accumulator's device arrays for the process life
+    owner = _diag.owner_name(owner)
+    # "first" is guarded by the lock: wrappers live in process-global
+    # caches (metric.py _ACCUM_FN_CACHE), so two fit threads can race the
+    # first invocation — unguarded, both would pay the XLA compile and
+    # register duplicate ProgramRecords. Losers block until the winner's
+    # executable is visible; the steady-state path never takes the lock.
+    state = {"first": True, "timed": False, "compiled": None, "rec": None,
+             "misses": 0, "miss_total": 0, "lock": _threading.Lock()}
 
-    def wrapped(*args, **kwargs):
-        if state["first"]:
-            state["first"] = False
-            t0 = _time.perf_counter()
-            out = fn(*args, **kwargs)
-            _tel.histogram("executor_compile_ms",
-                           labels={"kind": kind}).observe(
-                (_time.perf_counter() - t0) * 1e3)
-            return out
+    def _plain(args, kwargs):
+        if matmul_env:
+            prec = _os.environ.get("MXTPU_MATMUL_PRECISION")
+            if prec:
+                with jax.default_matmul_precision(prec):
+                    return fn(*args, **kwargs)
         return fn(*args, **kwargs)
 
-    return wrapped
-
-
-def _with_matmul_precision(fn):
-    """Honor ``MXTPU_MATMUL_PRECISION`` (default/high/highest) around an
-    executor program. TPU MXU matmuls default to bf16 passes over f32
-    inputs; 'highest' requests full f32 accumulation (3-pass) — the knob a
-    user needs when exact f32 parity matters more than throughput. Read at
-    call time; the precision context participates in jax's trace cache, so
-    flipping the env retraces rather than returning stale programs."""
-    import os
+    def _first_call(args, kwargs):
+        t0 = _time.perf_counter()
+        out = _AOT_MISS
+        if _diag.cost_enabled() and hasattr(fn, "lower"):
+            # only lower/compile/record may fall back to jit: a RUNTIME
+            # failure of the first execution must propagate — fused_step
+            # donates its params/opt_state, so re-running via _plain would
+            # see deleted arrays and mask the real error (e.g. an OOM)
+            exe = None
+            try:
+                exe = fn.lower(*args, **kwargs).compile()
+                state["rec"] = _diag.record_program(
+                    kind, owner, exe, (_time.perf_counter() - t0) * 1e3)
+            except Exception:
+                exe = None
+                state["compiled"] = None
+            if exe is not None:
+                state["compiled"] = exe
+                out = exe(*args, **kwargs)
+                rec = state["rec"]
+                if rec is not None:
+                    rec.calls += 1
+        if out is _AOT_MISS:
+            out = _plain(args, kwargs)
+        _tel.histogram("executor_compile_ms",
+                       labels={"kind": kind}).observe(
+            (_time.perf_counter() - t0) * 1e3)
+        return out
 
     def wrapped(*args, **kwargs):
-        prec = os.environ.get("MXTPU_MATMUL_PRECISION")
-        if not prec:
-            return fn(*args, **kwargs)
-        with jax.default_matmul_precision(prec):
-            return fn(*args, **kwargs)
+        # the env contract is per CALL: a precision set after the first
+        # call must still take effect, so it disables the AOT fast path
+        # for as long as it is set (jit retraces under the context)
+        prec_set = matmul_env and _os.environ.get("MXTPU_MATMUL_PRECISION")
+        if state["first"]:
+            if prec_set:
+                # don't consume the first-call slot under the precision
+                # env: capture is DEFERRED to the first call after it
+                # clears ("while it is set" contract) — consuming it here
+                # would leave the program table empty for process life.
+                # The literal first call still feeds executor_compile_ms
+                # (it pays jit's lazy compile), matching the pre-capture
+                # contract that first-call time is always observed
+                if not state["timed"]:
+                    state["timed"] = True   # benign race: extra observe
+                    t0 = _time.perf_counter()
+                    out = _plain(args, kwargs)
+                    _tel.histogram("executor_compile_ms",
+                                   labels={"kind": kind}).observe(
+                        (_time.perf_counter() - t0) * 1e3)
+                    return out
+                return _plain(args, kwargs)
+            with state["lock"]:
+                if state["first"]:
+                    try:
+                        return _first_call(args, kwargs)
+                    finally:
+                        state["first"] = False
+            # lost the first-call race: fall through — the winner's
+            # executable (if any) is visible once the lock is released
+        compiled = state["compiled"] if not prec_set else None
+        if compiled is not None:
+            rec = state["rec"]
+            if rec is not None:
+                rec.calls += 1
+            try:
+                out = compiled(*args, **kwargs)
+                state["misses"] = 0
+                return out
+            except (TypeError, ValueError):
+                # signature changed under us — dtype/shape (TypeError) or
+                # device/sharding (ValueError), both raised at argument
+                # binding, BEFORE any donation/execution: serve this call
+                # via jit (which retraces per signature and faithfully
+                # re-raises truly invalid arguments) but KEEP the
+                # executable — a partial final batch must not evict the
+                # steady-state signature's fast path and force jit to
+                # recompile it from scratch mid-run. CONSECUTIVE misses
+                # mean the workload's signature moved for good (a second
+                # fit at a new batch size reusing this process-cached
+                # wrapper); ALTERNATING signatures (bucketed training —
+                # hits reset the consecutive count so it never trips)
+                # are caught by the lifetime total instead. Either way
+                # demote to jit — it retraces once per signature and
+                # serves all of them from its own cache — rather than
+                # paying a failed binding + raised exception per call
+                state["misses"] += 1
+                state["miss_total"] += 1
+                if state["misses"] >= _DEMOTE_MISSES \
+                        or state["miss_total"] >= _DEMOTE_MISS_TOTAL:
+                    state["compiled"] = None
+                return _plain(args, kwargs)
+        rec = state["rec"]
+        if rec is not None:   # env-bypass dispatches still count
+            rec.calls += 1
+        return _plain(args, kwargs)
 
     return wrapped
 
@@ -341,6 +456,22 @@ class Executor:
         # it directly instead of recomputing the whole forward.
         self._heads_mode = False
         self._cached_vjp = None
+        self._out_slot = None
+        # memory ledger: account every bound buffer (args, grads, aux) at
+        # bind time. Buffer-identity dedup in the ledger means arrays
+        # shared with another executor (simple_bind shared_exec, serving
+        # rebinds sharing weights) count once; the origin is the ambient
+        # allocation site ('serving_pool' inside a pool bind, 'executor'
+        # otherwise — outermost attribution wins).
+        if _diag.mem_enabled():
+            led = _diag.ledger()
+            ctx_label = str(self._ctx)
+            with _diag.alloc_origin("executor"):
+                origin = _diag.current_origin()
+                for d in (self.arg_dict, self.grad_dict, self.aux_dict):
+                    for v in d.values():
+                        if v is not None and isinstance(v._data, jax.Array):
+                            led.track(v._data, origin=origin, ctx=ctx_label)
 
     def _as_dict(self, vals, names, what, allow_missing=False):
         if isinstance(vals, dict):
@@ -447,7 +578,7 @@ class Executor:
             fn = jax.jit(va)
         else:
             raise MXNetError("unknown program kind %s" % kind)
-        fn = _time_first_call(kind, _with_matmul_precision(fn))
+        fn = _instrument_program(kind, fn, owner=self, matmul_env=True)
         self._fns[kind] = fn
         return fn
 
@@ -463,6 +594,16 @@ class Executor:
 
     def _wrap_outputs(self, outs):
         self.outputs = [NDArray(o, self._ctx) for o in outs]
+        if _diag.mem_enabled():
+            # outputs churn every forward but their SIZE is bind-fixed:
+            # slot accounting (freed with the executor) instead of a
+            # finalizer per step
+            nbytes = sum(getattr(o, "nbytes", 0) for o in outs)
+            if self._out_slot is None:
+                self._out_slot = _diag.ledger().slot(
+                    self, nbytes, "executor_outputs", ctx=str(self._ctx))
+            else:
+                self._out_slot.set(nbytes)
         return self.outputs
 
     def _forward_profiled(self, is_train, raw_args, raw_aux, rng):
@@ -672,16 +813,17 @@ class Executor:
 
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
         """Rebind with new input shapes (cheap: jit retraces per shape)."""
-        new_args = {}
-        for n in self.arg_names:
-            if n in kwargs:
-                new_args[n] = zeros(kwargs[n], ctx=self._ctx,
-                                    dtype=self.arg_dict[n].dtype)
-            else:
-                new_args[n] = self.arg_dict[n]
-        new_grads = {n: zeros(new_args[n].shape, ctx=self._ctx,
-                              dtype=new_args[n].dtype)
-                     for n in self.grad_dict}
+        with _diag.alloc_origin("executor"):
+            new_args = {}
+            for n in self.arg_names:
+                if n in kwargs:
+                    new_args[n] = zeros(kwargs[n], ctx=self._ctx,
+                                        dtype=self.arg_dict[n].dtype)
+                else:
+                    new_args[n] = self.arg_dict[n]
+            new_grads = {n: zeros(new_args[n].shape, ctx=self._ctx,
+                                  dtype=new_args[n].dtype)
+                         for n in self.grad_dict}
         return Executor(self._symbol, self._ctx, new_args, args_grad=new_grads,
                         grad_req=self.grad_req, aux_states=self.aux_dict)
 
@@ -702,38 +844,44 @@ class Executor:
             k: v for k, v in type_dict.items() if k in arg_names})
         inferred = dict(zip(arg_names, arg_types or []))
         inferred_aux = dict(zip(aux_names, aux_types or []))
-        args = {}
-        for name, shape in zip(arg_names, arg_shapes):
-            # explicit type_dict wins; else the type inferred from the data
-            # dtypes (bf16 data => bf16 weights, reference InferType flow)
-            dt = type_dict.get(name) or inferred.get(name) or "float32"
-            if shared_exec is not None and name in shared_exec.arg_dict and \
-                    shared_exec.arg_dict[name].shape == tuple(shape):
-                args[name] = shared_exec.arg_dict[name]
-            else:
-                args[name] = zeros(shape, ctx=ctx, dtype=dt)
-        if isinstance(grad_req, str):
-            req_of = {n: grad_req for n in arg_names}
-        elif isinstance(grad_req, (list, tuple)):
-            req_of = dict(zip(arg_names, grad_req))
-        else:
-            req_of = {n: grad_req.get(n, "null") for n in arg_names}
-        args_grad = {}
-        for name in arg_names:
-            if req_of.get(name, "null") != "null":
-                if shared_exec is not None and name in shared_exec.grad_dict and \
-                        shared_exec.grad_dict[name].shape == args[name].shape:
-                    args_grad[name] = shared_exec.grad_dict[name]
+        # attribute the fresh buffers to 'executor' AT CREATION: track()
+        # is first-origin-wins, so tagging them later (Executor.__init__)
+        # would lose to the 'ndarray' default the zeros() seam applies
+        with _diag.alloc_origin("executor"):
+            args = {}
+            for name, shape in zip(arg_names, arg_shapes):
+                # explicit type_dict wins; else the type inferred from the
+                # data dtypes (bf16 data => bf16 weights, reference
+                # InferType flow)
+                dt = type_dict.get(name) or inferred.get(name) or "float32"
+                if shared_exec is not None and name in shared_exec.arg_dict \
+                        and shared_exec.arg_dict[name].shape == tuple(shape):
+                    args[name] = shared_exec.arg_dict[name]
                 else:
-                    args_grad[name] = zeros(args[name].shape, ctx=ctx,
-                                            dtype=args[name].dtype)
-        aux = {}
-        for name, shape in zip(aux_names, aux_shapes):
-            if shared_exec is not None and name in shared_exec.aux_dict and \
-                    shared_exec.aux_dict[name].shape == tuple(shape):
-                aux[name] = shared_exec.aux_dict[name]
+                    args[name] = zeros(shape, ctx=ctx, dtype=dt)
+            if isinstance(grad_req, str):
+                req_of = {n: grad_req for n in arg_names}
+            elif isinstance(grad_req, (list, tuple)):
+                req_of = dict(zip(arg_names, grad_req))
             else:
-                aux[name] = zeros(shape, ctx=ctx,
-                                  dtype=inferred_aux.get(name) or "float32")
-        return Executor(symbol, ctx, args, args_grad=args_grad, grad_req=req_of,
-                        aux_states=aux)
+                req_of = {n: grad_req.get(n, "null") for n in arg_names}
+            args_grad = {}
+            for name in arg_names:
+                if req_of.get(name, "null") != "null":
+                    if shared_exec is not None and \
+                            name in shared_exec.grad_dict and \
+                            shared_exec.grad_dict[name].shape == args[name].shape:
+                        args_grad[name] = shared_exec.grad_dict[name]
+                    else:
+                        args_grad[name] = zeros(args[name].shape, ctx=ctx,
+                                                dtype=args[name].dtype)
+            aux = {}
+            for name, shape in zip(aux_names, aux_shapes):
+                if shared_exec is not None and name in shared_exec.aux_dict \
+                        and shared_exec.aux_dict[name].shape == tuple(shape):
+                    aux[name] = shared_exec.aux_dict[name]
+                else:
+                    aux[name] = zeros(shape, ctx=ctx,
+                                      dtype=inferred_aux.get(name) or "float32")
+            return Executor(symbol, ctx, args, args_grad=args_grad,
+                            grad_req=req_of, aux_states=aux)

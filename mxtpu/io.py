@@ -16,6 +16,7 @@ import time as _time
 import numpy as _np
 
 from .base import MXNetError, Registry
+from . import diagnostics as _diag
 from . import ndarray as nd
 from .ndarray import NDArray
 from . import telemetry as _tel
@@ -345,11 +346,17 @@ class DevicePrefetchIter(PrefetchingIter):
         if batch is None:
             return None
         import jax
+        track = _diag.mem_enabled()
         for arrs in (batch.data or [], batch.label or []):
             for a in arrs:
                 data = getattr(a, "_data", None)
                 if data is not None and isinstance(data, jax.Array):
                     a._data = jax.device_put(data, self._device)
+                    if track:
+                        # staged transfer buffers show up in the ledger
+                        # under their own origin — the working set the
+                        # input pipeline holds ahead of the step
+                        _diag.ledger().track(a._data, origin="prefetch")
         return batch
 
 

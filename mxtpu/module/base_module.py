@@ -9,10 +9,11 @@ import os
 import time
 from collections import deque
 
+from .. import diagnostics as _diag
 from .. import metric as _metric
 from .. import ndarray as nd
 from .. import telemetry as _tel
-from ..base import MXNetError
+from ..base import MXNetError, NativeError
 from ..executor import device_wait as _device_wait
 from ..model import BatchEndParam
 from ..telemetry import tracing as _tracing
@@ -186,6 +187,10 @@ class BaseModule:
                 train_data = owned_iter = _io.DevicePrefetchIter(
                     train_data, device=device)
 
+        # arm the hang watchdog (MXTPU_WATCHDOG=0 opts out) + the SIGUSR2
+        # postmortem handler (only over SIG_DFL — a user's own USR2
+        # handler is never replaced; MXTPU_DIAG_SIGNAL=0 opts out)
+        _diag.on_session_start()
         try:
             self._fit_impl(
                 train_data, eval_data, eval_metric, epoch_end_callback,
@@ -194,6 +199,17 @@ class BaseModule:
                 arg_params, aux_params, allow_missing, force_rebind,
                 force_init, begin_epoch, num_epoch, validation_metric,
                 monitor, max_in_flight, metric_sync, device_metrics)
+        except Exception as exc:
+            # fatal training exception: capture the flight ring / ledger /
+            # engine state BEFORE the stack unwinds and the evidence GCs.
+            # Plain MXNetError is a usage error (bad shape/name at bind),
+            # not a backend failure — no forensics, match serving's
+            # filter. NativeError (nonzero native-engine return) IS a
+            # backend failure despite being an MXNetError subclass.
+            if not isinstance(exc, MXNetError) or isinstance(exc,
+                                                             NativeError):
+                _diag.postmortem("fit_exception", exc=exc, source="fit")
+            raise
         finally:
             if owned_iter is not None:
                 owned_iter.close()

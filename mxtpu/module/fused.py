@@ -20,12 +20,14 @@ one up to reduction order.
 from __future__ import annotations
 
 import math
+import threading
 
 import jax
 import jax.numpy as jnp
 import numpy as _np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import diagnostics as _diag
 from .. import random as _rnd
 from ..executor import _trace_graph
 from ..ops import optimizer_ops as _ops
@@ -182,6 +184,48 @@ class FusedState:
         self.opt_state = None  # name -> pytree for trainable params
         self.host_stale = False   # device params newer than host _arg_params
         self.exec_stale = False   # device params newer than executor arrays
+        self.mem_slot = None   # ctx -> ledger slot: params+aux+opt bytes
+        # (shared across bucket steps — one FusedState, one accounting
+        # entry per device the state is sharded/replicated onto)
+        self._mem_lock = threading.Lock()
+
+    def update_mem_slot(self, devices):
+        """(Re)account this state's device bytes in the memory ledger.
+        Slot accounting, not per-buffer finalizers: the donated step
+        replaces every buffer each iteration while the SIZE stays
+        shape-fixed, so the slots stay exact with zero per-step cost.
+        Bytes are attributed per device via ``addressable_shards`` — a
+        replicated leaf really holds a full copy on every device, a
+        batch-sharded opt state only its shard."""
+        if not _diag.mem_enabled():
+            return
+        by_ctx = {}
+        default = _diag.device_label(devices[0]) if devices else "unknown"
+        for leaf in jax.tree.leaves((self.params, self.aux,
+                                     self.opt_state)):
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards:
+                for sh in shards:
+                    ctx = _diag.device_label(sh.device)
+                    by_ctx[ctx] = by_ctx.get(ctx, 0) + sh.data.nbytes
+            elif getattr(leaf, "nbytes", 0):
+                by_ctx[default] = by_ctx.get(default, 0) + leaf.nbytes
+        # two fits sharing this state (bucket steps on threads) may
+        # re-account concurrently: serialize the check-then-insert or
+        # one ctx gets two slots and the bytes double-count
+        with self._mem_lock:
+            if self.mem_slot is None:
+                self.mem_slot = {}
+            for ctx, nbytes in by_ctx.items():
+                cur = self.mem_slot.get(ctx)
+                if cur is None:
+                    self.mem_slot[ctx] = _diag.ledger().slot(
+                        self, nbytes, "fused_step", ctx=ctx)
+                else:
+                    cur.set(nbytes)
+            for ctx, cur in self.mem_slot.items():
+                if ctx not in by_ctx:   # device dropped on a re-bind
+                    cur.set(0)
 
 
 class FusedTrainStep:
@@ -315,6 +359,7 @@ class FusedTrainStep:
                     for n, v in (aux_params or {}).items()}
         self.opt_state = {n: jax.tree.map(self._put, self._state_init(
             self.params[n])) for n in self.trainable}
+        self.state.update_mem_slot(self.devices)
 
     def adopt_state(self):
         """Joining an already-populated shared FusedState (a new bucket):
@@ -326,6 +371,7 @@ class FusedTrainStep:
             if n not in st.opt_state:
                 st.opt_state[n] = jax.tree.map(
                     self._put, self._state_init(st.params[n]))
+        st.update_mem_slot(self.devices)
 
     # ------------------------------------------------ the program
     def _build(self):

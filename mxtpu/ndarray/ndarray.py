@@ -24,7 +24,18 @@ from .. import autograd as _ag
 from .. import random as _rnd
 from ..base import MXNetError
 from ..context import Context, current_context
+from ..diagnostics import ledger_mod as _ledger_mod
 from ..ops.registry import get_op
+
+
+def _track_alloc(arr):
+    """Memory-ledger seam for the creation functions (array/zeros/ones/
+    full): ties the fresh device buffer's bytes to its lifetime, tagged
+    with the ambient allocation origin ('ndarray' by default). Reads the
+    module flag directly — one global load when diagnostics are off."""
+    if _ledger_mod._ENABLED and isinstance(arr._data, jax.Array):
+        _ledger_mod._LEDGER.track(arr._data, ctx=str(arr._ctx))
+    return arr
 
 __all__ = ["NDArray", "array", "invoke_op", "waitall", "zeros", "ones", "empty",
            "full", "arange", "concatenate", "save", "load", "imperative_invoke"]
@@ -122,7 +133,10 @@ class NDArray:
     def as_in_context(self, ctx):
         if ctx == self._ctx:
             return self
-        return NDArray(jax.device_put(self._data, ctx.jax_device), ctx)
+        # a cross-device copy is a real new allocation (per-device weight
+        # staging in the serving pool): account it like a creation
+        return _track_alloc(NDArray(jax.device_put(self._data,
+                                                   ctx.jax_device), ctx))
 
     def reshape(self, shape):
         if isinstance(shape, int):
@@ -483,7 +497,8 @@ def array(source_array, ctx=None, dtype=None):
         src = _np.asarray(source_array)
     dt = _coerce_dtype(dtype if explicit else src.dtype, explicit)
     ctx = ctx or current_context()
-    return NDArray(jax.device_put(jnp.asarray(src.astype(dt)), ctx.jax_device), ctx)
+    return _track_alloc(NDArray(jax.device_put(jnp.asarray(src.astype(dt)),
+                                               ctx.jax_device), ctx))
 
 
 def empty(shape, ctx=None, dtype="float32"):
@@ -494,24 +509,27 @@ def zeros(shape, ctx=None, dtype="float32", **kw):
     if isinstance(shape, int):
         shape = (shape,)
     ctx = ctx or current_context()
-    return NDArray(jax.device_put(jnp.zeros(shape, _np.dtype(dtype)),
-                                  ctx.jax_device), ctx)
+    return _track_alloc(NDArray(jax.device_put(jnp.zeros(shape,
+                                                         _np.dtype(dtype)),
+                                               ctx.jax_device), ctx))
 
 
 def ones(shape, ctx=None, dtype="float32", **kw):
     if isinstance(shape, int):
         shape = (shape,)
     ctx = ctx or current_context()
-    return NDArray(jax.device_put(jnp.ones(shape, _np.dtype(dtype)),
-                                  ctx.jax_device), ctx)
+    return _track_alloc(NDArray(jax.device_put(jnp.ones(shape,
+                                                        _np.dtype(dtype)),
+                                               ctx.jax_device), ctx))
 
 
 def full(shape, val, ctx=None, dtype="float32"):
     if isinstance(shape, int):
         shape = (shape,)
     ctx = ctx or current_context()
-    return NDArray(jax.device_put(jnp.full(shape, val, _np.dtype(dtype)),
-                                  ctx.jax_device), ctx)
+    return _track_alloc(NDArray(jax.device_put(jnp.full(shape, val,
+                                                        _np.dtype(dtype)),
+                                               ctx.jax_device), ctx))
 
 
 def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):

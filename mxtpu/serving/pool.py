@@ -15,6 +15,7 @@ import threading
 
 import jax
 
+from .. import diagnostics as _diag
 from ..base import MXNetError
 from ..context import Context
 from ..predict import Predictor
@@ -44,9 +45,13 @@ class _Replica:
         self.lock = threading.Lock()
         self.metrics = metrics
         self._record = record_executor or (lambda ex: None)
-        self.base = Predictor(symbol_json, params, ctx=ctx,
-                              input_shapes=example_shapes,
-                              max_cached_binds=cache_size)
+        # every buffer the replica's executors bind lands in the memory
+        # ledger under the pool's own origin (outermost attribution wins
+        # over the inner 'executor' tagging)
+        with _diag.alloc_origin("serving_pool"):
+            self.base = Predictor(symbol_json, params, ctx=ctx,
+                                  input_shapes=example_shapes,
+                                  max_cached_binds=cache_size)
         self._record(self.base._executor)
 
     def predictor_for(self, shapes):
@@ -56,7 +61,8 @@ class _Replica:
         cache = self.base._bind_cache
         hit = key in cache
         before = len(cache)
-        self.base.reshape(shapes)
+        with _diag.alloc_origin("serving_pool"):
+            self.base.reshape(shapes)
         self._record(self.base._executor)
         if self.metrics:
             self.metrics.counter(

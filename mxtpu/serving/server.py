@@ -23,8 +23,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as _np
 
+from .. import diagnostics as _diag
 from .. import telemetry as _tel
-from ..base import MXNetError
+from ..base import MXNetError, NativeError
 from .batcher import BatcherClosed, DynamicBatcher, QueueFull
 from .metrics import MetricsRegistry
 from .pool import ExecutorPool
@@ -59,6 +60,8 @@ class ServingSession:
         # before the first /metrics scrape (they read zero until traffic)
         from .. import engine as _engine
         _engine.get()
+        # hang watchdog + SIGUSR2 postmortem handler for the process
+        _diag.on_session_start()
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self.default_timeout = default_timeout
         # the per-replica executor LRU must hold every bucket or warmup
@@ -138,6 +141,13 @@ class ServingSession:
                 batch.fail(exc)
                 self.metrics.counter("requests_failed").inc(
                     len(batch.items))
+                if not isinstance(exc, MXNetError) \
+                        or isinstance(exc, NativeError):
+                    # backend failure (XLA error, OOM, nonzero native
+                    # return), not a bad request: capture the state that
+                    # produced it
+                    _diag.postmortem("serving_batch_exception", exc=exc,
+                                     source="serving")
 
     # ------------------------------------------------------------ client
     def predict(self, inputs, timeout=None):
@@ -228,6 +238,13 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._text(200, _tel.prometheus_text(*regs),
                            _tel.PROMETHEUS_CONTENT_TYPE)
+        elif path == "/debug/state":
+            # live debug snapshot: buffer ledger, program cost table,
+            # flight-recorder ring, engine state, active device waits —
+            # what a postmortem dumps, served on demand
+            state = _diag.debug_state()
+            state["serving"] = session.stats()
+            self._json(200, state)
         else:
             self._json(404, {"error": "unknown path %s" % self.path})
 

@@ -27,6 +27,17 @@ __all__ = ["Span", "span", "current_span", "trace_id"]
 _ids = itertools.count(1)  # itertools.count.__next__ is atomic (CPython)
 _current = contextvars.ContextVar("mxtpu_telemetry_span", default=None)
 
+# flight-recorder hook (mxtpu.diagnostics.flight): every span start/end
+# also lands in the lock-free event ring, so a postmortem shows what the
+# process was doing just before a wedge. One global read per span when
+# unset; set_flight_recorder is called by the diagnostics package.
+_flight = None
+
+
+def set_flight_recorder(rec):
+    global _flight
+    _flight = rec
+
 
 class Span:
     """One timed region. Use via the ``span()`` context manager."""
@@ -60,6 +71,9 @@ class Span:
         # not produce negative latencies).
         self.t0_us = time.time() * 1e6
         self._t0_perf = time.perf_counter()
+        f = _flight
+        if f is not None:
+            f.record("span_start", self.name, self.span_id)
         return self
 
     def __exit__(self, *exc):
@@ -68,6 +82,10 @@ class Span:
         if self._token is not None:
             _current.reset(self._token)
             self._token = None
+        f = _flight
+        if f is not None:
+            f.record("span_end", self.name,
+                     "%d %.3fms" % (self.span_id, self.duration_ms))
         self._emit()
         return False
 

@@ -7,22 +7,70 @@ import json
 from .base import MXNetError
 
 
-def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74, 1.)):
-    """Print layer-by-layer summary (parity visualization.py print_summary)."""
-    show_shape = False
-    shape_dict = {}
-    if shape is not None:
-        show_shape = True
-        interals = symbol.get_internals()
-        _, out_shapes, _ = interals.infer_shape_partial(**shape)
+_PARAM_SUFFIXES = ("weight", "bias", "gamma", "beta")
+_AUX_SUFFIXES = ("moving_mean", "moving_var")
+
+
+def _prod(shape):
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def print_summary(symbol, shape=None, line_length=120,
+                  positions=(.38, .54, .63, .72, 1.), dtype_bytes=4):
+    """Print layer-by-layer summary (parity visualization.py
+    print_summary), extended with a per-layer memory column.
+
+    With ``shape`` given, each row shows the layer's output shape
+    (batch dim stripped), its parameter count (from the inferred
+    argument shapes — weight/bias/gamma/beta inputs), and its memory
+    footprint in KB: parameter bytes (incl. aux moving stats) plus the
+    activation bytes of its outputs at the given batch size, assuming
+    ``dtype_bytes`` per element (4 = float32).
+
+    Output shapes are resolved per (node, output-index) from the
+    internals graph — NOT by name lookup — so multi-output layers and
+    grouped symbols (``sym.Group``) report the right shapes instead of
+    blanks or a colliding duplicate's."""
+    show_shape = shape is not None
+    node_out_shapes = {}   # node name -> {out idx -> full shape}
+    arg_shape_dict = {}
+    aux_shape_dict = {}
+    if show_shape:
+        internals = symbol.get_internals()
+        # one whole-graph inference pass feeds all three dicts (internals
+        # spans the same graph, so its arg/aux lists match symbol's)
+        arg_shapes, out_shapes, aux_shapes = \
+            internals.infer_shape_partial(**shape)
         if out_shapes is None:
             raise ValueError("Input shape is incomplete")
-        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+        for (node, idx), shp in zip(internals._outputs, out_shapes):
+            if shp is None:   # partial inference: un-inferable node
+                continue
+            node_out_shapes.setdefault(node.name, {})[idx] = tuple(shp)
+        arg_shape_dict = dict(zip(internals.list_arguments(),
+                                  arg_shapes or []))
+        aux_shape_dict = dict(zip(internals.list_auxiliary_states(),
+                                  aux_shapes or []))
     conf = json.loads(symbol.tojson())
     nodes = conf["nodes"]
     if positions[-1] <= 1:
         positions = [int(line_length * p) for p in positions]
-    to_display = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+    if len(positions) == 4:
+        # legacy 4-column tuple (pre-Mem-column callers): keep the
+        # caller's widths, splice in a Mem column the width of Param #,
+        # and rescale so the last column still ends at line_length
+        mem_w = max(positions[2] - positions[1], 8)
+        positions = [positions[0], positions[1], positions[2],
+                     positions[2] + mem_w, positions[3] + mem_w]
+        positions = [int(p * line_length / positions[-1])
+                     for p in positions]
+    elif len(positions) < 4:   # unusably short: fall back to defaults
+        positions = [int(line_length * p) for p in (.38, .54, .63, .72, 1.)]
+    to_display = ["Layer (type)", "Output Shape", "Param #", "Mem (KB)",
+                  "Previous Layer"]
 
     def print_row(fields, positions):
         line = ""
@@ -37,53 +85,63 @@ def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74,
     print("=" * line_length)
 
     total_params = [0]
+    total_bytes = [0]
 
-    def print_layer_summary(node, out_shape):
+    def _layer_params_bytes(node):
+        """(param count, param+aux bytes) from the node's null inputs."""
+        n_params = 0
+        n_bytes = 0
+        for item in node["inputs"]:
+            input_node = nodes[item[0]]
+            if input_node["op"] != "null":
+                continue
+            pname = input_node["name"]
+            if pname.endswith(_PARAM_SUFFIXES):
+                pshape = arg_shape_dict.get(pname)
+                if pshape:
+                    n_params += _prod(pshape)
+                    n_bytes += _prod(pshape) * dtype_bytes
+            elif pname.endswith(_AUX_SUFFIXES):
+                ashape = aux_shape_dict.get(pname)
+                if ashape:   # aux stats occupy memory but aren't "params"
+                    n_bytes += _prod(ashape) * dtype_bytes
+        return n_params, n_bytes
+
+    def print_layer_summary(node, out_shapes_of_node):
         op = node["op"]
         pre_node = []
-        if op != "null":
-            inputs = node["inputs"]
-            for item in inputs:
-                input_node = nodes[item[0]]
-                input_name = input_node["name"]
-                if input_node["op"] != "null" or item[0] in heads:
-                    pre_node.append(input_name)
-        cur_param = 0
-        if op != "null":
-            for item in node["inputs"]:
-                input_node = nodes[item[0]]
-                if input_node["op"] == "null" and \
-                        (input_node["name"].endswith("weight") or
-                         input_node["name"].endswith("bias") or
-                         input_node["name"].endswith("gamma") or
-                         input_node["name"].endswith("beta")):
-                    key = input_node["name"]
-                    if show_shape:
-                        for k, v in shape_dict.items():
-                            if k == key + "_output" or k == key:
-                                pass
+        for item in node["inputs"]:
+            input_node = nodes[item[0]]
+            if input_node["op"] != "null" or item[0] in heads:
+                pre_node.append(input_node["name"])
+        cur_param, cur_bytes = (0, 0)
+        if show_shape:
+            cur_param, cur_bytes = _layer_params_bytes(node)
+            for shp in out_shapes_of_node.values():
+                cur_bytes += _prod(shp) * dtype_bytes
+        # display convention (reference parity): batch dim stripped, one
+        # shape per visible output
+        disp = [s[1:] for _, s in sorted(out_shapes_of_node.items())]
+        out_disp = str(disp[0] if len(disp) == 1 else disp) if disp else "[]"
         first_connection = pre_node[0] if pre_node else ""
-        fields = [node["name"] + " (" + op + ")",
-                  str(out_shape), cur_param, first_connection]
-        print_row(fields, positions)
+        print_row([node["name"] + " (" + op + ")", out_disp, cur_param,
+                   "%.1f" % (cur_bytes / 1024.0) if show_shape else 0,
+                   first_connection], positions)
         for i in range(1, len(pre_node)):
-            fields = ["", "", "", pre_node[i]]
-            print_row(fields, positions)
+            print_row(["", "", "", "", pre_node[i]], positions)
         total_params[0] += cur_param
+        total_bytes[0] += cur_bytes
 
     heads = set(conf["arg_nodes"])
     for node in nodes:
-        out_shape = []
-        op = node["op"]
-        name = node["name"]
-        if op == "null":
+        if node["op"] == "null":
             continue
-        key = name + "_output"
-        if show_shape and key in shape_dict:
-            out_shape = shape_dict[key][1:]
-        print_layer_summary(node, out_shape)
+        print_layer_summary(node, node_out_shapes.get(node["name"], {}))
         print("_" * line_length)
     print("Total params: %s" % total_params[0])
+    if show_shape:
+        print("Total memory (params + activations): %.1f KB"
+              % (total_bytes[0] / 1024.0))
     print("_" * line_length)
 
 

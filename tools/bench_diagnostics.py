@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Benchmark: diagnostics (ledger + flight recorder) overhead on Module.fit.
+
+Same harness contract as tools/bench_telemetry.py: trains the mlp
+fixture on synthetic data with diagnostics enabled (buffer-ledger seams
++ flight-recorder ring, the per-event costs) vs disabled
+(``diagnostics.set_enabled(False)``), interleaved trials, MIN per side
+(scheduler noise is strictly additive, so min-vs-min isolates the
+code-path delta). Program-cost capture is a one-time build event and
+stays enabled on both sides.
+
+When the host's own noise floor exceeds the 2% target, the verdict
+comes from the deterministic microbench instead: the exact per-step
+diagnostics work is two tracked batch buffers (data + label finalizer
+registrations) plus four flight-ring writes (fit.step span start/end +
+slack), timed tight-loop.
+
+Writes BENCH_diagnostics.json. Acceptance: overhead < 2% of an mlp fit
+step.
+
+Usage: python tools/bench_diagnostics.py [--trials 12] [--batch-size 64]
+"""
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MXTPU_WATCHDOG", "0")  # no sampling thread jitter
+
+import mxtpu as mx  # noqa: E402
+from mxtpu import diagnostics as diag  # noqa: E402
+from mxtpu.diagnostics.flight import FlightRecorder  # noqa: E402
+from mxtpu.diagnostics.ledger import DeviceMemoryLedger  # noqa: E402
+from mxtpu.models import mlp as _mlp  # noqa: E402
+
+
+def _make_data(n, batch_size, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 784).astype(np.float32)
+    y = rng.randint(0, 10, n).astype(np.float32)
+    return mx.io.NDArrayIter(X, y, batch_size=batch_size,
+                             label_name="softmax_label")
+
+
+def _timed_epoch(mod, it, batches):
+    t0 = time.perf_counter()
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05})
+    return (time.perf_counter() - t0) * 1e3 / batches
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=12)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--examples", type=int, default=4096)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "..", "BENCH_diagnostics.json"))
+    args = ap.parse_args(argv)
+
+    logging.getLogger().setLevel(logging.WARNING)
+    it = _make_data(args.examples, args.batch_size)
+    batches = args.examples // args.batch_size
+
+    # one module, warmed once — both modes drive the identical compiled
+    # program; only the diagnostics seams differ per epoch
+    mod = mx.mod.Module(_mlp.get_symbol(10), context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05})
+
+    bare, instrumented = [], []
+    for trial in range(args.trials):
+        for enabled, sink in ((False, bare), (True, instrumented)):
+            diag.set_enabled(enabled)
+            try:
+                sink.append(_timed_epoch(mod, it, batches))
+            finally:
+                diag.set_enabled(True)
+            print("trial %d %s: %.3f ms/step"
+                  % (trial, "diagnostics" if enabled else "bare", sink[-1]))
+
+    bare_ms = min(bare)
+    inst_ms = min(instrumented)
+    overhead = (inst_ms - bare_ms) / bare_ms * 100.0
+    noise_pct = (sorted(bare)[len(bare) // 2] - bare_ms) / bare_ms * 100.0
+
+    # deterministic microbench: the exact per-event costs, tight-loop
+    import jax.numpy as jnp
+    rec = FlightRecorder(capacity=512)
+    n_micro = 50000
+    t0 = time.perf_counter()
+    for i in range(n_micro):
+        rec.record("span_start", "fit.step", i)
+    flight_us = (time.perf_counter() - t0) * 1e6 / n_micro
+
+    led = DeviceMemoryLedger(register_gauges=False)
+    bufs = [jnp.zeros((4,)) + i for i in range(2000)]
+    t0 = time.perf_counter()
+    for b in bufs:
+        # ctx passed explicitly, as the creation-function seam does —
+        # deriving it from buf.devices() is the expensive variant only
+        # the prefetch seam pays
+        led.track(b, origin="bench", ctx="cpu(0)")
+    track_us = (time.perf_counter() - t0) * 1e6 / len(bufs)
+
+    t0 = time.perf_counter()
+    for _ in range(n_micro):
+        led.free(led.alloc(64, ctx="cpu(0)", origin="bench2"))
+    allocfree_us = (time.perf_counter() - t0) * 1e6 / n_micro
+
+    # per fit step: 2 tracked batch buffers + ~4 ring writes
+    per_step_us = 2 * track_us + 4 * flight_us
+    micro_pct = per_step_us / 10.0 / bare_ms
+
+    if noise_pct <= 2.0:
+        ok, basis = overhead < 2.0, "wall_clock"
+    else:
+        ok, basis = micro_pct < 2.0, \
+            "microbench (wall-clock noise floor exceeds target)"
+
+    result = {
+        "model": "mlp",
+        "batch_size": args.batch_size,
+        "batches_per_epoch": batches,
+        "trials": args.trials,
+        "bare_step_ms": round(bare_ms, 4),
+        "diagnostics_step_ms": round(inst_ms, 4),
+        "overhead_pct": round(overhead, 3),
+        "host_noise_floor_pct": round(noise_pct, 3),
+        "flight_record_us": round(flight_us, 3),
+        "ledger_track_us": round(track_us, 3),
+        "ledger_alloc_free_us": round(allocfree_us, 3),
+        "diagnostics_cost_us_per_step": round(per_step_us, 3),
+        "diagnostics_cost_pct_of_step": round(micro_pct, 4),
+        "target_pct": 2.0,
+        "verdict_basis": basis,
+        "pass": ok,
+        "programs_captured": len(diag.programs()),
+        "ledger_tracked_buffers": diag.ledger().tracked_buffers,
+    }
+    out = os.path.abspath(args.out)
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result, indent=2))
+    print("wrote", out)
+    return 0 if result["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""CI check: every telemetry series mxtpu emits is documented.
+
+Scans ``mxtpu/`` for literal series names passed to
+``counter(...)`` / ``gauge(...)`` / ``histogram(...)`` call sites (both
+the module-level helpers and registry methods) and fails when any name
+is missing from the series inventory in ``docs/observability.md``.
+
+A new series without a doc entry is how dashboards rot: the emitting
+code outlives the engineer who knew what it meant. This check is wired
+into the test suite (tests/test_diagnostics.py) so it runs with tier-1.
+
+Dynamic names the regex cannot see (the non-first branch of a
+conditional expression, names built from constants) are declared in
+``EXTRA_EMITTED`` below — keep it short and commented. Derived
+exposition-only series (``*_p50/90/99``, serving ``qps`` etc.) are
+documented as patterns and listed in ``DERIVED_OK``.
+
+Usage: python tools/check_series_documented.py [--docs docs/observability.md]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+#: literal first-string-arg of counter/gauge/histogram calls
+_CALL_RE = re.compile(
+    r"\b(?:counter|gauge|histogram)\(\s*(?:name=)?\"([a-z][a-z0-9_]+)\"")
+
+#: emitted names the regex cannot extract from source
+EXTRA_EMITTED = [
+    "executor_cache_misses",   # else-branch of a conditional expression
+    "span_ms",                 # emitted via the SPAN_HISTOGRAM constant
+]
+
+#: names matched by _CALL_RE that are NOT series (or are doc'd as a
+#: pattern): derived exposition gauges and adapter-internal keys
+DERIVED_OK = {
+    "qps", "batch_fill_ratio", "executor_cache_hit_rate",
+}
+
+
+def emitted_series(pkg_dir):
+    names = set(EXTRA_EMITTED)
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn)) as f:
+                src = f.read()
+            names.update(_CALL_RE.findall(src))
+    return names - DERIVED_OK
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--docs", default=os.path.join(ROOT, "docs",
+                                                   "observability.md"))
+    ap.add_argument("--pkg", default=os.path.join(ROOT, "mxtpu"))
+    args = ap.parse_args(argv)
+    with open(args.docs) as f:
+        doc_text = f.read()
+    # exact backtick-quoted names from INVENTORY TABLE ROWS only: a raw
+    # substring test would let `fit_samples` ride on the
+    # `fit_samples_per_sec` row (prefix holes), and a prose mention is
+    # not an inventory entry — the table is the CI contract
+    doc_names = set()
+    for line in doc_text.splitlines():
+        if line.lstrip().startswith("|"):
+            doc_names.update(re.findall(r"`([a-z][a-z0-9_]+)`", line))
+    names = emitted_series(args.pkg)
+    missing = sorted(n for n in names if n not in doc_names)
+    if missing:
+        print("check_series_documented: %d emitted series missing from %s:"
+              % (len(missing), os.path.relpath(args.docs, ROOT)))
+        for n in missing:
+            print("  - %s" % n)
+        print("add them to the series inventory table (or, for derived/"
+              "non-series names, to DERIVED_OK in this tool).")
+        return 1
+    print("check_series_documented: %d series, all documented." % len(names))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""mxtpu_top: a live terminal view of a running mxtpu session.
+
+The ``nvidia-smi`` analogue for mxtpu: point it at any process serving
+the mxtpu HTTP endpoints (a ``mxtpu.serving`` server, or anything that
+exposes the same ``/metrics`` + ``/debug/state`` pair) and it renders,
+refreshing in place:
+
+  * device memory — live/peak bytes per (ctx, origin) from the buffer
+    ledger, plus the jax.live_arrays() drift;
+  * throughput — training steps/s, samples/s, serving qps, queue depth;
+  * programs — captured cost table (flops, bytes, temp) per build kind;
+  * health — engine queue/completions, watchdog progress age, last
+    postmortem count.
+
+Plain text by default (one frame with ``--once``, loop otherwise);
+``--curses`` uses the stdlib curses screen when stdout is a tty.
+Stdlib-only: urllib + json + optional curses.
+
+Usage:
+    python tools/mxtpu_top.py http://127.0.0.1:8080 [--interval 2]
+    python tools/mxtpu_top.py http://127.0.0.1:8080 --once
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import time
+import urllib.request
+
+_LABELED = re.compile(r"^(?P<name>[a-zA-Z0-9_]+)\{(?P<labels>.*)\}$")
+
+
+def _fetch_json(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _parse_series(flat):
+    """'name{k=v,...}' keyed dict -> {name: [(labels_dict, value)]}."""
+    out = {}
+    for key, value in flat.items():
+        m = _LABELED.match(key)
+        if m:
+            labels = dict(kv.split("=", 1)
+                          for kv in m.group("labels").split(",") if "=" in kv)
+            out.setdefault(m.group("name"), []).append((labels, value))
+        else:
+            out.setdefault(key, []).append(({}, value))
+    return out
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024.0 or unit == "TB":
+            return "%.1f%s" % (n, unit) if unit != "B" else "%d%s" % (n, unit)
+        n /= 1024.0
+
+
+def _scalar(series, name, default=0):
+    rows = series.get(name)
+    if not rows:
+        return default
+    v = rows[0][1]
+    return v.get("count", default) if isinstance(v, dict) else v
+
+
+def snapshot(endpoint):
+    """One polled frame's raw data: (metrics-json, debug-state)."""
+    metrics = _fetch_json(endpoint.rstrip("/") + "/metrics?format=json")
+    try:
+        state = _fetch_json(endpoint.rstrip("/") + "/debug/state")
+    except Exception:
+        state = {}
+    return metrics, state
+
+
+def render(metrics, state, width=100):
+    """Render one frame as a list of lines (shared by plain and curses)."""
+    proc = _parse_series(metrics.get("mxtpu", {}))
+    serving = _parse_series(metrics.get("mxtpu_serving", {}))
+    lines = []
+    bar = "=" * width
+    lines.append("mxtpu_top — %s" % time.strftime("%H:%M:%S"))
+    lines.append(bar)
+
+    # ---- health line
+    eng = state.get("engine", {})
+    lines.append(
+        "engine: %s  queue=%s  completed=%s | watchdog progress age: %ss | "
+        "postmortems: %d"
+        % (eng.get("type", "?"), eng.get("queue_depth", "?"),
+           eng.get("ops_completed", "?"),
+           _scalar(proc, "watchdog_last_progress_age_s"),
+           int(sum(v for _, v in proc.get("diag_postmortems", [])))))
+
+    # ---- throughput
+    qps = serving.get("qps", [({}, 0)])[0][1] if serving else 0
+    depth = serving.get("queue_depth", [({}, 0)])[0][1] if serving else 0
+    lines.append(
+        "throughput: train %.1f samples/s | serving %.2f qps, queue %s | "
+        "fit steps %d"
+        % (_scalar(proc, "fit_samples_per_sec"), qps, depth,
+           _scalar(proc, "fit_step_ms")))
+    lines.append(bar)
+
+    # ---- memory table
+    lines.append("%-12s %-16s %12s" % ("ctx", "origin", "live"))
+    mem_rows = sorted(proc.get("mem_live_bytes", []),
+                      key=lambda r: -r[1])
+    for labels, value in mem_rows:
+        if value:
+            lines.append("%-12s %-16s %12s"
+                         % (labels.get("ctx", "?"), labels.get("origin", "?"),
+                            _fmt_bytes(value)))
+    for labels, value in proc.get("mem_peak_bytes", []):
+        lines.append("%-12s %-16s %12s"
+                     % (labels.get("ctx", "?"), "(peak)", _fmt_bytes(value)))
+    rec = state.get("reconcile") or {}
+    if rec:
+        lines.append("ledger %s vs live_arrays %s (drift %s in %d arrays)"
+                     % (_fmt_bytes(rec.get("ledger_bytes", 0)),
+                        _fmt_bytes(rec.get("live_bytes", 0)),
+                        _fmt_bytes(rec.get("drift_bytes", 0)),
+                        rec.get("live_arrays", 0)))
+    lines.append(bar)
+
+    # ---- program cost summary, aggregated per kind
+    by_kind = {}
+    for p in state.get("programs", []):
+        agg = by_kind.setdefault(p["kind"], [0, 0.0, 0.0, 0, 0])
+        agg[0] += 1
+        agg[1] += p.get("flops", 0.0)
+        agg[2] += p.get("bytes_accessed", 0.0)
+        agg[3] = max(agg[3], p.get("temp_bytes", 0))
+        agg[4] += p.get("calls", 0)
+    lines.append("%-14s %5s %10s %12s %10s %8s"
+                 % ("program kind", "n", "mflops", "mb_accessed",
+                    "temp", "calls"))
+    for kind, (n, flops, byts, temp, calls) in sorted(by_kind.items()):
+        lines.append("%-14s %5d %10.2f %12.2f %10s %8d"
+                     % (kind, n, flops / 1e6, byts / 1e6,
+                        _fmt_bytes(temp), calls))
+    if not by_kind:
+        lines.append("(no captured programs — MXTPU_DIAG_COST=0?)")
+    return lines
+
+
+def _loop_plain(endpoint, interval, once):
+    while True:
+        ok = True
+        try:
+            metrics, state = snapshot(endpoint)
+            frame = "\n".join(render(metrics, state))
+        except Exception as exc:
+            frame = "mxtpu_top: %s unreachable: %s" % (endpoint, exc)
+            ok = False
+        print(frame, flush=True)
+        if once:
+            # scriptable liveness probe: nonzero when the session is gone
+            return 0 if ok else 1
+        print()
+        time.sleep(interval)
+
+
+def _loop_curses(endpoint, interval):
+    import curses
+
+    def run(scr):
+        curses.curs_set(0)
+        scr.nodelay(True)
+        while True:
+            try:
+                metrics, state = snapshot(endpoint)
+                lines = render(metrics, state)
+            except Exception as exc:
+                lines = ["mxtpu_top: %s unreachable: %s" % (endpoint, exc)]
+            scr.erase()
+            h, w = scr.getmaxyx()
+            for i, line in enumerate(lines[:h - 1]):
+                scr.addnstr(i, 0, line, w - 1)
+            scr.refresh()
+            t_end = time.time() + interval
+            while time.time() < t_end:
+                if scr.getch() in (ord("q"), 27):
+                    return
+                time.sleep(0.05)
+
+    curses.wrapper(run)
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("endpoint", help="http://host:port of an mxtpu server")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one plain-text frame and exit")
+    ap.add_argument("--curses", action="store_true",
+                    help="full-screen refresh (q to quit)")
+    args = ap.parse_args(argv)
+    if args.curses and not args.once and sys.stdout.isatty():
+        return _loop_curses(args.endpoint, args.interval)
+    return _loop_plain(args.endpoint, args.interval, args.once)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
