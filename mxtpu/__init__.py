@@ -37,6 +37,7 @@ from . import libinfo
 from . import telemetry
 from . import diagnostics
 from .executor import Executor
+from . import analysis
 
 # subsystems imported lazily-but-eagerly; order matters (no cycles)
 from . import initializer

@@ -1,0 +1,41 @@
+"""mxtpu.analysis — static graph verification + runtime numerics sanitizer.
+
+The framework's L5 layer is a graph IR; until this package, mxtpu only
+*ran* graphs — nothing statically checked them, and binding mistakes
+surfaced as late, low-context failures. Three parts:
+
+* **graph passes** (:mod:`~mxtpu.analysis.passes`): a registry of
+  :class:`GraphPass` verifiers driven by :func:`analyze`, returning
+  structured :class:`Finding`\\ s (severity, node, provenance, fix
+  hint). Surfaced as ``Symbol.lint()``, ``Module.check()`` and
+  ``python -m mxtpu.analysis model.json``.
+* **numerics sanitizer** (:mod:`~mxtpu.analysis.sanitizer`):
+  ``MXTPU_SANITIZE=nan|inf|all`` wraps every built program's outputs in
+  device-side NaN/Inf checks; a trip emits a diagnostics postmortem
+  (``source="sanitizer"``) and raises :class:`NumericsError`. Strictly
+  zero overhead when unset.
+* **codebase lint** (``tools/mxtpu_lint.py``): the CI-enforced AST lint
+  for implicit device→host syncs in hot-path modules, lock-order
+  inversions against the declared hierarchy, and unjoined threads.
+
+See docs/analysis.md for the pass catalog, the Finding schema, the
+sanitizer env vars and the declared lock hierarchy.
+"""
+from __future__ import annotations
+
+from .findings import ERROR, INFO, WARNING, SEVERITIES, Finding, Report
+from .passes import (GraphPass, PassContext, analyze, analyze_json,
+                     check_module, get_pass, list_passes, register_pass)
+from .sanitizer import NumericsError, disable as sanitizer_disable
+from .sanitizer import enable as sanitizer_enable
+from .sanitizer import mode as sanitizer_mode
+from .sanitizer import sanitize_tree
+from . import provenance
+
+__all__ = [
+    "Finding", "Report", "ERROR", "WARNING", "INFO", "SEVERITIES",
+    "GraphPass", "PassContext", "register_pass", "get_pass", "list_passes",
+    "analyze", "analyze_json", "check_module",
+    "NumericsError", "sanitizer_enable", "sanitizer_disable",
+    "sanitizer_mode", "sanitize_tree", "provenance",
+]

@@ -1,0 +1,148 @@
+"""Shape/dtype inference provenance: who broke which node, through what path.
+
+The symbol layer's ``_infer_graph`` answers "what are the shapes"; this
+module answers the question an engineer debugging a failed bind actually
+asks: *which* argument's missing/mismatched shape broke *which* node,
+and through what path. ``infer_walk`` drives ``_infer_graph`` in its
+events mode (ONE walker serves the real inference, the ``shape_infer``
+verifier pass, and the sharpened errors — they can never report
+different partial-shape states); the rest of the module turns the
+walker's output into provenance paths and messages. Imports of
+``mxtpu.symbol`` are function-level, so there is no import cycle with
+symbol.py's lazy imports of this module.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["infer_walk", "unknown_root_paths", "describe_insufficient",
+           "describe_unresolved_arg", "known_shape_summary"]
+
+
+def infer_walk(symbol, shape_hints=None, type_hints=None):
+    """Forward-propagate shapes/dtypes node by node, NEVER raising.
+
+    Returns ``(shapes, dtypes, events)`` where ``shapes``/``dtypes`` map
+    variable names and ``(id(node), out_idx)`` entries to their inferred
+    values (None/absent where unknown), and ``events`` is a list of
+    per-node failure records::
+
+        {"node": name, "op": op_name,
+         "missing_inputs": [input names with unknown shape],
+         "exception": str or None}
+
+    Delegates to ``symbol._infer_graph(events=...)`` — the same walk a
+    real ``infer_shape``/bind runs (same ``__shape__`` hint decoding,
+    same top-down ``infer_args`` parameter backfill), so whatever the
+    real bind would have inferred, this walk infers too.
+    """
+    from ..symbol.symbol import _infer_graph
+    events = []
+    type_hints = {k: _np.dtype(v) for k, v in (type_hints or {}).items()}
+    shapes, dtypes = _infer_graph(symbol, dict(shape_hints or {}),
+                                  type_hints, events=events)
+    return shapes, dtypes, events
+
+
+def unknown_root_paths(symbol, shapes, node):
+    """For each input of ``node`` whose shape is unknown, walk upstream to
+    the root variables that lack a shape hint. Returns a list of paths,
+    each a tuple of node names root→node (the provenance the error
+    message prints as ``data -> fc1 -> relu1 -> fc2``)."""
+    paths = []
+    seen = set()
+
+    def walk(n, idx, trail):
+        key = (id(n), idx)
+        if key in seen:
+            return
+        seen.add(key)
+        if shapes.get(key) is not None:
+            return
+        if n.is_variable:
+            paths.append(tuple(reversed(trail + [n.name])))
+            return
+        hit = False
+        for inode, iidx in n.inputs:
+            if shapes.get((id(inode), iidx)) is None:
+                hit = True
+                walk(inode, iidx, trail + [n.name])
+        if not hit:
+            # unknown output with fully-known inputs: the node itself
+            # failed inference — it IS the root
+            paths.append(tuple(reversed(trail + [n.name])))
+
+    for inode, idx in node.inputs:
+        if shapes.get((id(inode), idx)) is None:
+            walk(inode, idx, [node.name])
+    return paths
+
+
+def known_shape_summary(symbol, shapes, limit=12):
+    """The partially-inferred shape dict, rendered compactly: every
+    ARGUMENT whose shape resolved (the part of the puzzle that worked),
+    so the error shows what was inferred, not just what failed."""
+    known = []
+    unknown = []
+    for name in symbol.list_arguments():
+        s = shapes.get(name)
+        (known if s is not None else unknown).append((name, s))
+    parts = ["%s=%s" % (n, tuple(s)) for n, s in known[:limit]]
+    if len(known) > limit:
+        parts.append("... %d more" % (len(known) - limit))
+    return {"inferred": ", ".join(parts) if parts else "(none)",
+            "unknown_args": [n for n, _ in unknown]}
+
+
+def describe_insufficient(symbol, node, shapes, hints=None):
+    """The sharpened form of the old bare error
+    ``infer_shape: insufficient information at node '%s'``: names the
+    unknown inputs, the arg→node provenance path, and the partially-
+    inferred shape dict. With ``hints`` (the caller's original shape
+    hints), a FULL partial walk recomputes the shape dict — the caller's
+    in-progress ``shapes`` stops at the failing node, hiding hints for
+    arguments the walk never reached."""
+    if hints is not None:
+        shapes, _, _ = infer_walk(symbol, hints)
+    paths = unknown_root_paths(symbol, shapes, node)
+    roots = sorted({p[0] for p in paths})
+    summary = known_shape_summary(symbol, shapes)
+    lines = ["infer_shape: insufficient information at node '%s' (op %s)"
+             % (node.name, node.op.name if node.op else "null")]
+    if roots:
+        lines.append("  unresolved argument(s): %s — pass their shapes to "
+                     "infer_shape/bind" % ", ".join(roots))
+    for p in paths[:6]:
+        lines.append("  provenance: %s" % " -> ".join(p))
+    if len(paths) > 6:
+        lines.append("  ... %d more paths" % (len(paths) - 6))
+    lines.append("  inferred so far: %s" % summary["inferred"])
+    return "\n".join(lines)
+
+
+def describe_unresolved_arg(symbol, arg_name, shapes, hints=None):
+    """Sharpened form of ``cannot determine shape of argument '%s'``:
+    names the consumers that needed the argument and what WAS inferred."""
+    if hints is not None:
+        shapes, _, _ = infer_walk(symbol, hints)
+    consumers = []
+    for node in symbol._topo():
+        if node.is_variable:
+            continue
+        for inode, _ in node.inputs:
+            if inode.is_variable and inode.name == arg_name:
+                consumers.append(node.name)
+                break
+    summary = known_shape_summary(symbol, shapes)
+    lines = ["infer_shape: cannot determine shape of argument '%s'"
+             % arg_name]
+    if consumers:
+        lines.append("  consumed by: %s — none of them could back-infer it"
+                     % ", ".join(consumers[:8]))
+    else:
+        lines.append("  the argument is never consumed by an op (unused "
+                     "input?)")
+    lines.append("  inferred so far: %s" % summary["inferred"])
+    lines.append("  hint: pass %s=<shape> to infer_shape/simple_bind, or "
+                 "set shape= on the Variable" % arg_name)
+    return "\n".join(lines)

@@ -33,6 +33,15 @@ class NativeError(MXNetError):
     backend failures and stay silent on bad user input)."""
 
 
+class NumericsError(MXNetError):
+    """A NaN/Inf tripped the runtime numerics sanitizer
+    (``MXTPU_SANITIZE``, mxtpu/analysis/sanitizer.py). The sanitizer
+    emits its own structured postmortem (``source="sanitizer"``) BEFORE
+    raising, so the fit/serving exception filters treat this like any
+    MXNetError (no second dump) while the HTTP layer maps it to 500 —
+    a numerics failure is the server's fault, not the request's."""
+
+
 def getenv(name, default):
     """Typed env lookup (parity with dmlc::GetEnv). Type taken from ``default``."""
     val = os.environ.get(name)

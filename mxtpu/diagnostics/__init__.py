@@ -35,8 +35,8 @@ from . import ledger as ledger_mod  # module alias BEFORE the function
 # that need the module's flag/globals use ledger_mod
 from .ledger import (DeviceMemoryLedger, alloc_origin, current_origin,
                      device_label, ledger, mem_enabled, set_mem_enabled)
-from .programs import (ProgramRecord, cost_enabled, owner_name,
-                       program_table, programs, record_program,
+from .programs import (ProgramRecord, cost_enabled, latest_record,
+                       owner_name, program_table, programs, record_program,
                        set_cost_enabled)
 from .flight import (FlightRecorder, flight_enabled, record, recorder,
                      set_flight_enabled)
@@ -47,7 +47,7 @@ __all__ = [
     "DeviceMemoryLedger", "ledger", "alloc_origin", "current_origin",
     "device_label", "mem_enabled", "set_mem_enabled", "reconcile",
     "ProgramRecord", "programs", "program_table", "record_program",
-    "cost_enabled", "set_cost_enabled",
+    "latest_record", "cost_enabled", "set_cost_enabled",
     "FlightRecorder", "recorder", "record", "flight_enabled",
     "set_flight_enabled",
     "Watchdog", "ensure_watchdog", "stop_watchdog", "active_waits",

@@ -27,7 +27,7 @@ from collections import deque
 from .. import telemetry as _tel
 
 __all__ = ["ProgramRecord", "record_program", "programs", "program_table",
-           "cost_enabled", "set_cost_enabled", "clear"]
+           "latest_record", "cost_enabled", "set_cost_enabled", "clear"]
 
 _ENABLED = os.environ.get("MXTPU_DIAG_COST", "1") != "0"
 
@@ -65,7 +65,7 @@ class ProgramRecord:
 
     __slots__ = ("id", "kind", "owner", "created", "compile_ms", "flops",
                  "bytes_accessed", "argument_bytes", "output_bytes",
-                 "temp_bytes", "generated_code_bytes", "calls")
+                 "temp_bytes", "generated_code_bytes", "calls", "_exe")
 
     def __init__(self, kind, owner, compile_ms):
         self.id = next(_ids)
@@ -80,6 +80,19 @@ class ProgramRecord:
         self.temp_bytes = 0
         self.generated_code_bytes = 0
         self.calls = 0
+        self._exe = None  # weakref to the compiled executable (HLO source)
+
+    def hlo_text(self):
+        """The compiled program's HLO text, while the executable is still
+        alive (held weakly — the record must not pin device programs).
+        ``tools/hlo_analyze.py`` reads this instead of re-lowering."""
+        exe = self._exe() if self._exe is not None else None
+        if exe is None:
+            return None
+        try:
+            return exe.as_text()
+        except Exception:
+            return None
 
     def to_dict(self):
         return {
@@ -116,6 +129,11 @@ def record_program(kind, owner, compiled, compile_ms):
         rec.generated_code_bytes = int(mem.generated_code_size_in_bytes)
     except Exception:
         pass
+    try:
+        import weakref
+        rec._exe = weakref.ref(compiled)
+    except TypeError:
+        pass  # executable type without weakref support
     with _LOCK:
         _RECORDS.append(rec)
     reg = _tel.registry()
@@ -142,6 +160,17 @@ def programs(kind=None):
     with _LOCK:
         recs = list(_RECORDS)
     return [r.to_dict() for r in recs if kind is None or r.kind == kind]
+
+
+def latest_record(kind=None):
+    """The most recent live ProgramRecord (optionally of one kind) —
+    tooling reads its captured numbers and ``hlo_text()`` instead of
+    re-lowering the program (tools/hlo_analyze.py)."""
+    with _LOCK:
+        for r in reversed(_RECORDS):
+            if kind is None or r.kind == kind:
+                return r
+    return None
 
 
 def program_table(kind=None):

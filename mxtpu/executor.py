@@ -30,7 +30,23 @@ from . import diagnostics as _diag
 from .telemetry import tracing as _tracing
 
 __all__ = ["Executor", "add_build_listener", "remove_build_listener",
-           "program_build_count", "record_program_build", "device_wait"]
+           "program_build_count", "record_program_build", "device_wait",
+           "set_output_sanitizer"]
+
+# ------------------------------------------------------------- sanitizer seam
+# mxtpu.analysis.sanitizer installs fn(kind, out) here when MXTPU_SANITIZE
+# is armed; every instrumented program (all kinds: fwd_eval/fwd_bwd/
+# fused_step/metric_accum/...) routes its outputs through it. Unset, the
+# cost per call is ONE module-global read + None check — the zero-
+# overhead contract tools/bench_analysis.py pins down.
+_OUTPUT_SANITIZER = None
+
+
+def set_output_sanitizer(fn):
+    """Install ``fn(kind, out)`` called on every instrumented program's
+    outputs (the numerics sanitizer); ``None`` uninstalls."""
+    global _OUTPUT_SANITIZER
+    _OUTPUT_SANITIZER = fn
 
 
 def device_wait(x):
@@ -51,6 +67,7 @@ def device_wait(x):
         x = getattr(x, "_data", x)
     _diag.wait_begin("device_wait")
     try:
+        # mxtpu: allow-sync(device_wait IS the explicit pacing sync point)
         jax.block_until_ready(x)
     finally:
         _diag.wait_end()
@@ -194,7 +211,7 @@ def _instrument_program(kind, fn, owner=None, matmul_env=False):
             (_time.perf_counter() - t0) * 1e3)
         return out
 
-    def wrapped(*args, **kwargs):
+    def _dispatch(args, kwargs):
         # the env contract is per CALL: a precision set after the first
         # call must still take effect, so it disables the AOT fast path
         # for as long as it is set (jit retraces under the context)
@@ -261,6 +278,13 @@ def _instrument_program(kind, fn, owner=None, matmul_env=False):
         if rec is not None:   # env-bypass dispatches still count
             rec.calls += 1
         return _plain(args, kwargs)
+
+    def wrapped(*args, **kwargs):
+        out = _dispatch(args, kwargs)
+        san = _OUTPUT_SANITIZER
+        if san is not None:
+            san(kind, out)
+        return out
 
     return wrapped
 
@@ -632,6 +656,8 @@ class Executor:
             t0_wall = _time.time() * 1e6
             t0 = _time.perf_counter()
             outs = call()
+            # mxtpu: allow-sync(profiled mode: per-node timing needs a
+            # sync per op by design; fused program path stays async)
             jax.block_until_ready(outs)
             _prof.record_span(node.name or node.op.name, t0_wall,
                               t0_wall + (_time.perf_counter() - t0) * 1e6,
@@ -729,6 +755,8 @@ class Executor:
                 with _prof.scope("backward", category="backward"):
                     outs, _auxu, grads = self._get_fn("fwd_bwd")(
                         raw_args, raw_aux, rng)
+                    # mxtpu: allow-sync(profiled mode: the backward span
+                    # must cover the device work it times)
                     jax.block_until_ready(grads)
                 self._profiled_pending = False
             if grads is None:

@@ -613,6 +613,8 @@ class DeviceMetricAccum:
         refresh ``last_snapshot``. Returns the snapshot pairs."""
         if self._pending:
             import jax
+            # mxtpu: allow-sync(sync() IS the cadence sync point — the
+            # one intended host round-trip of the device metric path)
             vals = jax.device_get(self._sums)
             for child, v, n in zip(self.children, vals, self._counts):
                 child.sum_metric += float(v)

@@ -405,6 +405,16 @@ class BaseModule:
             eval_metric._device_accum = None
 
 
+    def check(self, passes=None):
+        """Run the mxtpu.analysis verifier passes with everything this
+        module knows — the bound data/label shapes, the provided
+        parameter names (unused-arg detection), and the live fused train
+        step (donation-safety audit). Returns a
+        :class:`~mxtpu.analysis.Report`; ``report.ok`` is False when
+        anything at warning severity or above fired."""
+        from ..analysis import check_module
+        return check_module(self, passes=passes)
+
     # ------------------------------------------------ symbol/params accessors
     @property
     def symbol(self):

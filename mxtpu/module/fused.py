@@ -29,6 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import diagnostics as _diag
 from .. import random as _rnd
+from ..base import NumericsError
 from ..executor import _trace_graph
 from ..ops import optimizer_ops as _ops
 
@@ -310,6 +311,8 @@ class FusedTrainStep:
         self._run = _trace_graph(symbol, is_train=True, remat_tags=tags)
         self._mesh = None
         if len(self.devices) > 1:
+            # mxtpu: allow-sync(np.array over device HANDLES for the mesh
+            # grid — no tensor data moves)
             self._mesh = Mesh(_np.array(self.devices), ("data",))
         self._step_fn = None
         self.state = state if state is not None else FusedState()
@@ -350,12 +353,24 @@ class FusedTrainStep:
             return mesh_put(self._mesh, v, spec)  # multi-host safe
         return jax.device_put(v, self.devices[0])
 
+    def _stage(self, v):
+        """Stage one value onto the device(s) WITHOUT aliasing the
+        caller's buffer. ``device_put`` of an array already committed to
+        the target device returns the SAME array — the step's donation
+        would then delete the caller's buffer out from under it (found
+        by the mxtpu.analysis donation audit: post-fit ``_arg_params``
+        held deleted buffers). Snapshot device-resident inputs first."""
+        data = getattr(v, "_data", v)
+        if isinstance(data, jax.Array):
+            data = jnp.copy(data)
+        return self._put(data)
+
     def load(self, arg_params, aux_params):
         """Stage host params onto the device(s), (re)creating opt state."""
         names = set(self.param_names)
-        self.params = {n: self._put(getattr(v, "_data", v))
+        self.params = {n: self._stage(v)
                        for n, v in arg_params.items() if n in names}
-        self.aux = {n: self._put(getattr(v, "_data", v))
+        self.aux = {n: self._stage(v)
                     for n, v in (aux_params or {}).items()}
         self.opt_state = {n: jax.tree.map(self._put, self._state_init(
             self.params[n])) for n in self.trainable}
@@ -463,9 +478,21 @@ class FusedTrainStep:
             self._build()
             self._step_fn = record_program_build("fused_step", self,
                                                  self._step_fn)
-        self.params, self.aux, self.opt_state, outs = self._step_fn(
-            self.params, self.aux, self.opt_state, batch,
-            self._put(lrs), self._put(wds), _rnd.next_key())
+        try:
+            self.params, self.aux, self.opt_state, outs = self._step_fn(
+                self.params, self.aux, self.opt_state, batch,
+                self._put(lrs), self._put(wds), _rnd.next_key())
+        except NumericsError as exc:
+            # the step already ran and DONATED the old state trees; the
+            # sanitizer raised before the unpack above could adopt the
+            # new ones. Adopt from the exception so the state holds the
+            # step's (NaN'd but readable) outputs instead of deleted
+            # buffers — a caller that catches and checkpoints must not
+            # hit "Array has been deleted".
+            res = getattr(exc, "outputs", None)
+            if isinstance(res, tuple) and len(res) == 4:
+                self.params, self.aux, self.opt_state, self.outputs = res
+            raise
         self.outputs = outs
         return outs
 

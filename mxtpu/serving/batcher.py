@@ -105,6 +105,8 @@ class Batch:
         self.n_valid = sum(it.n for it in items)
         self.inputs = {}
         for name in input_names:
+            # mxtpu: allow-sync(request arrays are host JSON payloads,
+            # never device buffers — this is assembly, not a transfer)
             rows = _np.concatenate([_np.asarray(it.inputs[name])
                                     for it in items], axis=0)
             self.inputs[name] = pad_rows(rows, bucket)
@@ -171,6 +173,7 @@ class DynamicBatcher:
         for name in self.input_names:
             if name not in inputs:
                 raise MXNetError("missing serving input '%s'" % name)
+            # mxtpu: allow-sync(door validation of host request arrays)
             a = _np.asarray(inputs[name])
             if a.ndim == 0:
                 raise MXNetError(

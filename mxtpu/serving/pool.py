@@ -75,12 +75,15 @@ class _Replica:
         return self.base
 
     def run(self, inputs):
-        """Forward one already-padded batch; returns list of np outputs."""
+        """Forward one already-padded batch; returns list of np outputs.
+        Outputs come back via ``get_outputs()`` — ONE bulk device->host
+        transfer instead of the per-output blocking loop the lint
+        flagged (N outputs used to cost N round trips per batch)."""
         shapes = {k: tuple(v.shape) for k, v in inputs.items()}
         with self.lock:
             pred = self.predictor_for(shapes)
             pred.forward(**inputs)
-            return [pred.get_output(i) for i in range(pred.num_outputs)]
+            return pred.get_outputs()
 
 
 class ExecutorPool:
@@ -163,8 +166,7 @@ class ExecutorPool:
                     pred = rep.predictor_for(shapes)
                     pred.forward(**dummy)
                     # realize the outputs: jit compiles on first execute
-                    for i in range(pred.num_outputs):
-                        pred.get_output(i)
+                    pred.get_outputs()
                 built += 1
         if self.metrics:
             self.metrics.counter("warmup_programs").inc(built)

@@ -25,7 +25,7 @@ import numpy as _np
 
 from .. import diagnostics as _diag
 from .. import telemetry as _tel
-from ..base import MXNetError, NativeError
+from ..base import MXNetError, NativeError, NumericsError
 from .batcher import BatcherClosed, DynamicBatcher, QueueFull
 from .metrics import MetricsRegistry
 from .pool import ExecutorPool
@@ -260,6 +260,7 @@ class _Handler(BaseHTTPRequestHandler):
                     not isinstance(payload.get("inputs"), dict):
                 raise ValueError("body must be {\"inputs\": {name: array}}")
             raw = payload["inputs"]
+            # mxtpu: allow-sync(JSON body decode — host data by nature)
             inputs = {k: _np.asarray(v, dtype=_np.float32)
                       for k, v in raw.items()}
             timeout = payload.get("timeout_sec",
@@ -278,6 +279,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(504, {"error": str(exc)})
         except BatcherClosed as exc:
             self._json(503, {"error": str(exc)})
+        except NumericsError as exc:
+            # the sanitizer tripped on the model's outputs: the server's
+            # numerics are at fault, not the request — 500, and the
+            # sanitizer already dumped its postmortem (source=sanitizer)
+            self._json(500, {"error": str(exc)})
         except MXNetError as exc:
             self._json(400, {"error": str(exc)})
         except Exception as exc:  # backend failure (XLA error, OOM, ...)

@@ -342,8 +342,13 @@ class Symbol:
         if not partial:
             for n, s in zip(arg_names, arg_shapes):
                 if s is None:
+                    # sharpened error: name the consumers that needed the
+                    # argument and what WAS inferred (analysis.provenance
+                    # is the same machinery the shape_infer pass runs)
+                    from ..analysis.provenance import describe_unresolved_arg
                     raise MXNetError(
-                        "infer_shape: cannot determine shape of argument '%s'" % n)
+                        describe_unresolved_arg(self, n, shapes,
+                                                hints=known))
         out_shapes = [shapes.get(_entry_key(e)) for e in self._outputs]
         aux_shapes = [shapes.get(n) for n in self.list_auxiliary_states()]
         return arg_shapes, out_shapes, aux_shapes
@@ -386,6 +391,20 @@ class Symbol:
 
     def grad(self, wrt):
         raise MXNetError("Symbol.grad: use bind + backward")
+
+    def lint(self, shapes=None, group2ctx=None, passes=None, **kwargs):
+        """Run the mxtpu.analysis verifier passes over this symbol and
+        return a :class:`~mxtpu.analysis.Report` of structured findings
+        (shape/dtype verification with provenance, dead code, name
+        collisions, ctx-group mismatches, NaN-prone numerics patterns).
+        Shape hints go in ``shapes={...}`` or as kwargs, exactly like
+        ``infer_shape``: ``sym.lint(data=(64, 784))``."""
+        from ..analysis import analyze
+        hints = dict(shapes or {})
+        hints.update({k: tuple(v) for k, v in kwargs.items()
+                      if v is not None})
+        return analyze(self, shapes=hints, group2ctx=group2ctx,
+                       passes=passes)
 
     # ------------------------------------------------ serialization
     def tojson(self):
@@ -440,8 +459,16 @@ def _entry_key(entry):
     return (id(node), idx)
 
 
-def _infer_graph(sym, shape_hints, type_hints, partial=False, types_only=False):
-    """Forward shape/dtype propagation using op.infer (jax.eval_shape)."""
+def _infer_graph(sym, shape_hints, type_hints, partial=False, types_only=False,
+                 events=None):
+    """Forward shape/dtype propagation using op.infer (jax.eval_shape).
+
+    With ``events`` (a list), the walk NEVER raises: per-node failures
+    are appended as ``{"node", "op", "missing_inputs", "exception"}``
+    records instead — the mode ``mxtpu.analysis.provenance.infer_walk``
+    drives, so the verifier pass and the real inference share ONE walker
+    and can never report different partial-shape states.
+    """
     shapes = {}
     dtypes = {}
     for node in sym._topo():
@@ -460,7 +487,11 @@ def _infer_graph(sym, shape_hints, type_hints, partial=False, types_only=False):
                 if dt is None and vdt is not None:
                     dt = _np.dtype(str(vdt))
             else:
-                dt = type_hints.get(node.name, _np.dtype("float32"))
+                dt = type_hints.get(node.name)
+                if dt is None:
+                    vdt = node._extra_attrs.get("__dtype__")
+                    dt = _np.dtype(str(vdt)) if vdt is not None \
+                        else _np.dtype("float32")
             # unknown shapes stay None; a consumer's infer_args may fill them
             shapes[node.name] = tuple(shp) if shp is not None else None
             shapes[(id(node), 0)] = shapes[node.name]
@@ -490,7 +521,14 @@ def _infer_graph(sym, shape_hints, type_hints, partial=False, types_only=False):
             for i in range(node.num_outputs()):
                 dtypes[(id(node), i)] = dt
             continue
-        attrs = node.parsed_attrs()
+        try:
+            attrs = node.parsed_attrs()
+        except Exception as exc:
+            if events is None:
+                raise
+            events.append({"node": node.name, "op": node.op.name,
+                           "missing_inputs": [], "exception": str(exc)})
+            continue
         in_shapes = []
         for inode, idx in node.inputs:
             key = (id(inode), idx)
@@ -507,19 +545,37 @@ def _infer_graph(sym, shape_hints, type_hints, partial=False, types_only=False):
                     dtypes.setdefault(inode.name, _np.dtype("float32"))
                     dtypes.setdefault((id(inode), 0), _np.dtype("float32"))
         in_avals = []
-        ok = True
+        missing = []
         for inode, idx in node.inputs:
             key = (id(inode), idx)
-            if key not in shapes or shapes[key] is None:
-                ok = False
-                break
-            in_avals.append((shapes[key], dtypes.get(key, _np.dtype("float32"))))
-        if not ok:
+            if shapes.get(key) is None:
+                missing.append(inode.name if inode.is_variable
+                               else "%s[%d]" % (inode.name, idx))
+            else:
+                in_avals.append((shapes[key],
+                                 dtypes.get(key, _np.dtype("float32"))))
+        if missing:
+            if events is not None:
+                events.append({"node": node.name, "op": node.op.name,
+                               "missing_inputs": missing,
+                               "exception": None})
+                continue
             if partial:
                 continue
-            raise MXNetError("infer_shape: insufficient information at node '%s'"
-                             % node.name)
-        out_avals = node.op.infer(attrs, in_avals)
+            # sharpened error: arg→node provenance path + the partially-
+            # inferred shape dict, via the verifier pass machinery
+            from ..analysis.provenance import describe_insufficient
+            raise MXNetError(describe_insufficient(sym, node, shapes,
+                                                   hints=shape_hints))
+        try:
+            out_avals = node.op.infer(attrs, in_avals)
+        except Exception as exc:
+            if events is None:
+                raise
+            events.append({"node": node.name, "op": node.op.name,
+                           "missing_inputs": [],
+                           "exception": " ".join(str(exc).split())[:300]})
+            continue
         for i, (s, d) in enumerate(out_avals):
             shapes[(id(node), i)] = s
             dtypes[(id(node), i)] = _np.dtype(d)

@@ -1,0 +1,469 @@
+"""mxtpu.analysis: graph-verifier pass suite (golden findings on crafted
+negative fixtures + clean healthy fixtures), the sharpened infer_shape
+errors, the donation-safety audit on a live module, the runtime numerics
+sanitizer through Module.fit and a serving request (postmortem with
+source=sanitizer), and the CI codebase lint (tools/mxtpu_lint.py —
+negative rule fixtures + the repo-lints-clean tier-1 gate)."""
+import json
+import logging
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+import mxtpu.symbol as S
+from mxtpu import analysis
+from mxtpu import diagnostics as diag
+from mxtpu import telemetry as tel
+from mxtpu.analysis import NumericsError
+from mxtpu.models import lenet, mlp
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _fit_mlp(nan_at=None, epochs=1, n=256, batch=64):
+    X = np.random.RandomState(0).rand(n, 784).astype(np.float32)
+    if nan_at is not None:
+        X[nan_at] = np.nan
+    y = np.zeros(n, np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(mlp.get_symbol(10), context=mx.cpu(),
+                        logger=logging.getLogger("quiet"))
+    mod.logger.setLevel(logging.ERROR)
+    mod.fit(it, num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05})
+    return mod, it
+
+
+# ----------------------------------------------------------------- framework
+def test_cli_reports_registered_passes():
+    """Acceptance: `python -m mxtpu.analysis` reports >=5 registered
+    passes (the pass catalog)."""
+    proc = subprocess.run([sys.executable, "-m", "mxtpu.analysis"],
+                          capture_output=True, text=True,
+                          env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    first = proc.stdout.splitlines()[0]
+    n = int(first.split(":")[1].split()[0])
+    assert n >= 5, proc.stdout
+
+
+def test_cli_analyzes_json_graph(tmp_path):
+    sym = mlp.get_symbol(10)
+    g = json.loads(sym.tojson())
+    g["nodes"].append({"op": "relu", "name": "orphan_relu",
+                       "inputs": [[0, 0, 0]]})
+    path = tmp_path / "model.json"
+    path.write_text(json.dumps(g))
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxtpu.analysis", str(path),
+         "--shape", "data=64,784", "--json"],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["counts"]["error"] == 0
+    assert any(f["pass"] == "dead_code" and f.get("node") == "orphan_relu"
+               for f in report["findings"]), report
+
+
+def test_list_passes_has_expected_suite():
+    names = [n for n, _ in analysis.list_passes()]
+    for want in ("shape_infer", "dead_code", "name_collision", "ctx_groups",
+                 "donation", "numerics"):
+        assert want in names
+    assert len(names) >= 5
+
+
+# --------------------------------------------------------------- shape_infer
+def test_shape_pass_missing_input_provenance():
+    r = mlp.get_symbol(10).lint()
+    errs = [f for f in r.by_pass("shape_infer")
+            if f.severity == analysis.ERROR]
+    assert errs, r.render()
+    first = errs[0]
+    assert "data" in first.provenance
+    assert "data" in (first.fix_hint or "")
+    assert "partial_shapes" in first.details
+
+
+def test_shape_pass_clean_on_healthy_fixtures():
+    assert mlp.get_symbol(10).lint(data=(64, 784)).ok
+    assert lenet.get_symbol(10).lint(data=(8, 1, 28, 28)).ok
+
+
+def test_shape_pass_reports_op_failure():
+    a = S.Variable("a", shape=(2, 3))
+    b = S.Variable("b", shape=(4, 5))
+    bad = S.broadcast_add(a, b)
+    r = bad.lint()
+    errs = r.by_pass("shape_infer")
+    assert errs and errs[0].severity == analysis.ERROR
+    assert "inference failed" in errs[0].message
+
+
+def test_sharpened_infer_shape_insufficient_error():
+    """Satellite: symbol.py:520's bare 'insufficient information' now
+    reports the arg->node provenance path and the partial shape dict."""
+    sym = mlp.get_symbol(10)
+    with pytest.raises(mx.MXNetError) as ei:
+        sym.infer_shape(fc3_bias=(10,))
+    msg = str(ei.value)
+    assert "insufficient information" in msg
+    assert "provenance" in msg and "data" in msg
+    assert "inferred so far" in msg
+    assert "fc3_bias=(10,)" in msg  # the partially-inferred dict
+
+
+def test_sharpened_unresolved_argument_error():
+    """Satellite: symbol.py:346's 'cannot determine shape' names the
+    consumers (or the unused-input case) and gives a hint."""
+    with pytest.raises(mx.MXNetError) as ei:
+        S.Variable("x").infer_shape()
+    msg = str(ei.value)
+    assert "cannot determine shape of argument 'x'" in msg
+    assert "hint" in msg
+
+
+# ----------------------------------------------------------------- dead code
+def test_dead_node_detection_in_json():
+    sym = mlp.get_symbol(10)
+    g = json.loads(sym.tojson())
+    clean = analysis.analyze_json(json.dumps(g), shapes={"data": (4, 784)})
+    assert not clean.by_pass("dead_code"), clean.render()
+    g["nodes"].append({"op": "relu", "name": "dead1",
+                       "inputs": [[0, 0, 0]]})
+    g["nodes"].append({"op": "null", "name": "dead_var", "inputs": []})
+    r = analysis.analyze_json(json.dumps(g), shapes={"data": (4, 784)})
+    found = {f.node: f.severity for f in r.by_pass("dead_code")}
+    assert found.get("dead1") == analysis.WARNING
+    assert found.get("dead_var") == analysis.INFO
+
+
+def test_binding_arg_mismatch():
+    sym = mlp.get_symbol(10)
+    r = analysis.analyze(
+        sym, shapes={"data": (4, 784)},
+        args={"data", "softmax_label", "fc1_weight", "fc1_bias",
+              "fc2_weight", "fc2_bias", "fc3_weight", "fc3_bias",
+              "stale_extra_weight"})
+    msgs = [f.message for f in r.by_pass("dead_code")]
+    assert any("stale_extra_weight" in m and "no such" in m for m in msgs)
+    assert not any("fc1_weight" in m for m in msgs)
+
+
+def test_unconsumed_multi_output_head():
+    data = S.Variable("data", shape=(4, 8))
+    split = S.SliceChannel(data, num_outputs=2, name="split")
+    r = split[0].lint(data=(4, 8))
+    infos = r.by_pass("dead_code")
+    assert infos and "output 1" in infos[0].message
+
+
+# ------------------------------------------------------------ name collision
+def test_name_collision_fires_and_healthy_clean():
+    a = S.Variable("w")
+    b = S.Variable("w")
+    r = (a + b).lint(w=(2, 2))
+    errs = r.by_pass("name_collision")
+    assert errs and errs[0].severity == analysis.ERROR
+    assert not mlp.get_symbol(10).lint(data=(4, 784)).by_pass(
+        "name_collision")
+
+
+# ---------------------------------------------------------------- ctx groups
+def test_ctx_group_mismatch():
+    with mx.AttrScope(ctx_group="stage1"):
+        x = S.FullyConnected(S.Variable("data"), num_hidden=4, name="fca")
+    r = x.lint(data=(2, 8), group2ctx={"stage2": mx.cpu(0)})
+    by_sev = {f.severity for f in r.by_pass("ctx_groups")}
+    assert analysis.WARNING in by_sev  # stage1 unmapped
+    assert analysis.INFO in by_sev     # stage2 unused
+    ok = x.lint(data=(2, 8), group2ctx={"stage1": mx.cpu(0)})
+    assert not ok.by_pass("ctx_groups"), ok.render()
+
+
+# ------------------------------------------------------------------ numerics
+def test_numerics_unclamped_exp_and_softmax():
+    x = S.Variable("x")
+    e = S.exp(x)
+    soft = e / S.sum(e)
+    r = soft.lint(x=(4, 8))
+    msgs = [f.message for f in r.by_pass("numerics")]
+    assert any("unclamped exp" in m for m in msgs)
+    assert any("hand-rolled softmax" in m for m in msgs)
+
+
+def test_numerics_eps_free_division_and_guard():
+    x = S.Variable("x")
+    r = (x / S.sum(x)).lint(x=(4,))
+    assert any("eps-free division" in f.message
+               for f in r.by_pass("numerics"))
+    guarded = (x / (S.sum(x) + 1e-6)).lint(x=(4,))
+    assert not guarded.by_pass("numerics"), guarded.render()
+
+
+def test_numerics_log_guard():
+    x = S.Variable("x")
+    r = S.log(x).lint(x=(4,))
+    assert any("unguarded log" in f.message for f in r.by_pass("numerics"))
+    ok = S.log(x + 1e-6).lint(x=(4,))
+    assert not ok.by_pass("numerics"), ok.render()
+    clamped = S.exp(S.clip(x, -10, 10)).lint(x=(4,))
+    assert not clamped.by_pass("numerics"), clamped.render()
+
+
+# ------------------------------------------------------------------ donation
+def test_module_check_clean_after_fit():
+    mod, _ = _fit_mlp()
+    r = mod.check()
+    assert not r.errors and not r.warnings, r.render()
+    assert "donation" in r.passes_run
+
+
+def test_donation_audit_flags_host_alias():
+    mod, _ = _fit_mlp()
+    mod._arg_params["fc1_weight"]._data = mod._fused.params["fc1_weight"]
+    r = mod.check()
+    errs = r.by_pass("donation")
+    assert errs, r.render()
+    assert any("aliases a buffer in the fused step's donation list"
+               in f.message and f.node == "fc1_weight" for f in errs)
+
+
+def test_donation_audit_flags_deleted_buffer():
+    mod, it = _fit_mlp()
+    stale = mod._fused.params["fc1_weight"]
+    it.reset()
+    batch = next(iter(it))
+    mod.forward_backward(batch)  # donates `stale`
+    mod._arg_params["fc1_weight"]._data = stale
+    r = mod.check()
+    assert any("already-deleted" in f.message
+               for f in r.by_pass("donation")), r.render()
+
+
+def test_fused_load_does_not_alias_host_params():
+    """Regression for the hazard the audit found: device_put of an
+    already-committed array returns the SAME buffer, so the fused step's
+    donation used to delete the module's host _arg_params. load() now
+    snapshots; the host params stay readable after a donated step."""
+    mod, _ = _fit_mlp()
+    for name, v in mod._arg_params.items():
+        arr = np.asarray(v._data)  # raises on a deleted buffer
+        assert np.isfinite(arr).all() or True  # readable is the contract
+
+
+# ----------------------------------------------------------------- sanitizer
+def test_sanitizer_fit_nan_postmortem():
+    """Acceptance: MXTPU_SANITIZE set => an injected NaN in a fit step
+    produces a structured postmortem with source=sanitizer."""
+    trips0 = tel.registry().counter("sanitizer_trips",
+                                    labels={"kind": "fused_step"}).value
+    fit_pm0 = tel.registry().counter("diag_postmortems",
+                                     labels={"source": "fit"}).value
+    analysis.sanitizer_enable("nan")
+    try:
+        with pytest.raises(NumericsError) as ei:
+            _fit_mlp(nan_at=(7, 3))
+        assert "fused_step" in str(ei.value)
+    finally:
+        analysis.sanitizer_disable()
+    pm = diag.last_postmortem()
+    assert pm is not None and pm["source"] == "sanitizer"
+    assert "flight" in pm and "ledger" in pm  # routed through debug_state
+    assert tel.registry().counter(
+        "sanitizer_trips", labels={"kind": "fused_step"}).value > trips0
+    # NumericsError is an MXNetError: fit must NOT double-dump
+    assert tel.registry().counter(
+        "diag_postmortems", labels={"source": "fit"}).value == fit_pm0
+
+
+def test_sanitizer_trip_does_not_orphan_fused_state():
+    """The fused step DONATES its old state; a sanitizer trip raised
+    before the step's unpack used to leave FusedState pointing at
+    deleted buffers. step() must adopt the returned (NaN'd but
+    readable) state from the exception — a caller that catches and
+    checkpoints must not hit 'Array has been deleted'."""
+    import jax
+    mod, _ = _fit_mlp()            # healthy fit builds mod._fused
+    fused = mod._fused
+    assert fused is not None
+    bad = [mx.nd.array(np.full((64, 784), np.nan, np.float32))]
+    lbl = [mx.nd.array(np.zeros(64, np.float32))]
+    analysis.sanitizer_enable("nan")
+    try:
+        with pytest.raises(NumericsError):
+            fused.step(bad, lbl)
+    finally:
+        analysis.sanitizer_disable()
+    # every state buffer must be LIVE (adopted from the exception)
+    for group in (fused.state.params, fused.state.aux,
+                  fused.state.opt_state):
+        for leaf in jax.tree.leaves(group or {}):
+            assert not leaf.is_deleted()
+    # a subsequent step still dispatches (state usable, not orphaned)
+    fused.step([mx.nd.array(np.random.rand(64, 784).astype(np.float32))],
+               lbl)
+    # and the donation audit agrees nothing is orphaned
+    rep = mod.check()
+    assert not [f for f in rep.by_pass("donation")
+                if f.severity == analysis.ERROR], rep.render()
+
+
+def test_sanitizer_env_coercion():
+    """MXTPU_SANITIZE=1 (the 0/1 convention of the sibling MXTPU_* vars)
+    must arm 'all', and an unrecognized value must not break import."""
+    for val, expect in (("1", "all"), ("true", "all"), ("nan", "nan"),
+                        ("bogus", "all"), ("0", "None"), ("off", "None")):
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import mxtpu.analysis as a; print(a.sanitizer_mode())"],
+            capture_output=True, text=True,
+            env={**os.environ, "MXTPU_SANITIZE": val,
+                 "JAX_PLATFORMS": "cpu"}, cwd=ROOT)
+        assert proc.returncode == 0, (val, proc.stderr)
+        assert proc.stdout.strip() == expect, (val, proc.stdout)
+
+
+def test_sanitizer_serving_request():
+    """A NaN produced while serving fails THAT request with
+    NumericsError, fires a source=sanitizer postmortem, and leaves the
+    worker alive for the next (healthy) request."""
+    analysis.sanitizer_enable("nan")
+    sess = mx.serving.ServingSession(
+        S.log(S.Variable("data")).tojson(), {}, {"data": (1, 4)},
+        buckets=(1,), warmup=False)
+    try:
+        with pytest.raises(NumericsError):
+            sess.predict({"data": -np.ones((1, 4), np.float32)}, timeout=30)
+        pm = diag.last_postmortem()
+        assert pm["source"] == "sanitizer"
+        out = sess.predict({"data": np.ones((1, 4), np.float32)},
+                           timeout=30)
+        assert np.allclose(out[0], 0.0)
+    finally:
+        sess.close()
+        analysis.sanitizer_disable()
+
+
+def test_sanitizer_modes_nan_vs_inf():
+    sym = S.exp(S.Variable("data"))
+    ex = sym.bind(mx.cpu(), {"data": mx.nd.array([[1000.0]])})
+    analysis.sanitizer_enable("nan")
+    try:
+        ex.forward()  # inf, not nan: mode 'nan' must stay silent
+        analysis.sanitizer_enable("inf")
+        ex2 = sym.bind(mx.cpu(), {"data": mx.nd.array([[2000.0]])})
+        with pytest.raises(NumericsError) as ei:
+            ex2.forward()
+        assert "Inf" in str(ei.value)
+    finally:
+        analysis.sanitizer_disable()
+
+
+def test_sanitizer_disabled_is_unhooked():
+    from mxtpu import executor as ex_mod
+    analysis.sanitizer_enable("all")
+    assert ex_mod._OUTPUT_SANITIZER is not None
+    analysis.sanitizer_disable()
+    assert ex_mod._OUTPUT_SANITIZER is None
+    sym = S.log(S.Variable("data"))
+    ex = sym.bind(mx.cpu(), {"data": mx.nd.array([[-1.0]])})
+    out = ex.forward()  # nan flows through unchecked — no raise
+    assert np.isnan(out[0].asnumpy()).all()
+
+
+# ------------------------------------------------------------- codebase lint
+def _lint_mod():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import mxtpu_lint
+    finally:
+        sys.path.pop(0)
+    return mxtpu_lint
+
+
+def test_codebase_lint():
+    """Tier-1 CI gate: tools/mxtpu_lint.py exits 0 on the repo (hot-path
+    sync pragmas present, lock hierarchy respected, threads managed)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "mxtpu_lint.py")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_lint_host_sync_rule_and_pragma():
+    lint = _lint_mod()
+    src = "def f(x):\n    return x.asnumpy()\n"
+    assert [f.rule for f in lint.lint_source(src, "mxtpu/engine.py")] \
+        == ["host-sync"]
+    # same code outside a declared hot path: silent
+    assert not lint.lint_source(src, "mxtpu/visualization.py")
+    ok = "def f(x):\n    # mxtpu: allow-sync(test)\n    return x.asnumpy()\n"
+    assert not lint.lint_source(ok, "mxtpu/engine.py")
+    scalar = "def f(x):\n    return float(x.sum())\n"
+    assert [f.rule for f in lint.lint_source(scalar, "mxtpu/executor.py")] \
+        == ["host-sync"]
+
+
+def test_lint_metric_scope_restriction():
+    lint = _lint_mod()
+    hot = ("class DeviceMetricAccum:\n"
+           "    def f(self, x):\n        return x.asnumpy()\n")
+    cold = ("class Accuracy:\n"
+            "    def f(self, x):\n        return x.asnumpy()\n")
+    assert lint.lint_source(hot, "mxtpu/metric.py")
+    assert not lint.lint_source(cold, "mxtpu/metric.py")
+
+
+def test_lint_lock_order_rule():
+    lint = _lint_mod()
+    bad = ("class DeviceMemoryLedger:\n"
+           "    def f(self):\n"
+           "        with self._lock:\n"
+           "            with _PM_LOCK:\n                pass\n")
+    founds = lint.lint_source(bad, "mxtpu/diagnostics/ledger.py")
+    assert [f.rule for f in founds] == ["lock-order"], founds
+    ok = ("class DeviceMemoryLedger:\n"
+          "    def f(self):\n"
+          "        with _PM_LOCK:\n"
+          "            with self._lock:\n                pass\n")
+    assert not lint.lint_source(ok, "mxtpu/diagnostics/ledger.py")
+
+
+def test_lint_thread_lifecycle_rule():
+    lint = _lint_mod()
+    bad = ("import threading\n"
+           "def f():\n    threading.Thread(target=f).start()\n")
+    assert [f.rule for f in lint.lint_source(bad, "mxtpu/foo.py")] \
+        == ["thread-lifecycle"]
+    daemon = ("import threading\n"
+              "def f():\n"
+              "    threading.Thread(target=f, daemon=True).start()\n")
+    assert not lint.lint_source(daemon, "mxtpu/foo.py")
+    joined = ("import threading\n"
+              "class W:\n"
+              "    def start(self):\n"
+              "        self.t = threading.Thread(target=self.run)\n"
+              "    def close(self):\n        self.t.join()\n")
+    assert not lint.lint_source(joined, "mxtpu/foo.py")
+    # regression: os.path.join / ", ".join are NOT thread joins — they
+    # used to suppress the rule for nearly every module in the repo
+    path_join = ("import os, threading\n"
+                 "P = os.path.join('a', 'b')\n"
+                 "S = ', '.join(['x'])\n"
+                 "def f():\n    threading.Thread(target=f).start()\n")
+    assert [f.rule for f in lint.lint_source(path_join, "mxtpu/foo.py")] \
+        == ["thread-lifecycle"]
+    # a join that appears BEFORE the ctor in the file still counts
+    join_first = ("import threading\n"
+                  "class W:\n"
+                  "    def close(self):\n        self.t.join()\n"
+                  "    def start(self):\n"
+                  "        self.t = threading.Thread(target=self.run)\n")
+    assert not lint.lint_source(join_first, "mxtpu/foo.py")

@@ -4,9 +4,18 @@ materialized bytes (fusion bodies excluded) and the largest single
 materializations. Compile-only (abstract inputs), so it never allocates on
 the device and can run alongside a benchmark.
 
-Usage: python tools/hlo_analyze.py [batch] [--fwd-only]
+The cost/memory numbers and the HLO text come from the diagnostics
+program registry (mxtpu.diagnostics.record_program — the same capture
+every live program gets at the executor build seam) instead of a second
+ad-hoc cost_analysis extraction; ``--from-dump`` skips compilation
+entirely and prints the program table of a postmortem / debug_state
+JSON dump from a live process.
+
+Usage: python tools/hlo_analyze.py [batch]
+       python tools/hlo_analyze.py --from-dump mxtpu_postmortem_*.json
 """
 import collections
+import json
 import os
 import re
 import sys
@@ -66,11 +75,39 @@ def analyze(txt, top=25):
         print('%9.0f MB %-12s [%s] %s' % (b / 1e6, opk, comp, l[:120]))
 
 
+def table_from_dump(path):
+    """Print the program-cost table of a diagnostics dump (postmortem or
+    debug_state JSON) — no jax, no compilation: the registry already
+    captured every program the process built."""
+    with open(path) as f:
+        dump = json.load(f)
+    rows = dump.get("programs") or []
+    print("%d captured programs from %s" % (len(rows), path))
+    hdr = ("id", "kind", "owner", "calls", "compile_ms", "mflops", "temp_kb")
+    print("%4s %-12s %-16s %6s %10s %10s %8s" % hdr)
+    for r in rows:
+        print("%4d %-12s %-16s %6d %10.1f %10.2f %8d"
+              % (r["id"], r["kind"][:12], r["owner"][:16], r["calls"],
+                 r["compile_ms"], r["flops"] / 1e6,
+                 r["temp_bytes"] // 1024))
+    return 0
+
+
 def main():
+    if "--from-dump" in sys.argv:
+        i = sys.argv.index("--from-dump")
+        if i + 1 >= len(sys.argv):
+            print("usage: python tools/hlo_analyze.py --from-dump "
+                  "<postmortem.json>", file=sys.stderr)
+            return 2
+        return table_from_dump(sys.argv[i + 1])
+    import time
+
     import jax
     import jax.numpy as jnp
     import numpy as np
     import mxtpu  # noqa: F401
+    from mxtpu import diagnostics as diag
     from mxtpu.models import resnet
     from mxtpu.parallel import make_mesh
     from mxtpu.parallel.dp import DataParallelTrainer
@@ -104,14 +141,22 @@ def main():
     trainer._opt_state = opt
     fn = trainer._build_step()
     print('lowering...', flush=True)
+    t0 = time.perf_counter()
     c = fn.lower(params, aux, opt, batch_in, rng, 1).compile()
-    ca = c.cost_analysis()
-    if isinstance(ca, list):
-        ca = ca[0]
-    print('cost: %.2f TFLOP, %.1f GB accessed' %
-          (ca.get('flops', 0) / 1e12, ca.get('bytes accessed', 0) / 1e9))
-    analyze(c.as_text())
+    # register through the diagnostics seam and READ the numbers back
+    # from the registry record — one cost-extraction implementation for
+    # live programs and this tool (no second as-hoc parse), and the HLO
+    # text comes off the record's weakly-held executable
+    diag.record_program('hlo_analyze', 'tools/hlo_analyze', c,
+                        (time.perf_counter() - t0) * 1e3)
+    rec = diag.latest_record('hlo_analyze')
+    print('cost: %.2f TFLOP, %.1f GB accessed (compile %.0f ms, '
+          'temp %.1f GB)' % (rec.flops / 1e12, rec.bytes_accessed / 1e9,
+                             rec.compile_ms, rec.temp_bytes / 1e9))
+    print(diag.program_table('hlo_analyze'))
+    analyze(rec.hlo_text() or c.as_text())
+    return 0
 
 
 if __name__ == '__main__':
-    main()
+    sys.exit(main())

@@ -1,0 +1,333 @@
+#!/usr/bin/env python
+"""CI lint: host-sync, lock-order and thread-lifecycle checks over mxtpu/.
+
+AST-based (in the style of ``tools/check_series_documented.py``), wired
+into the tier-1 suite as ``test_codebase_lint`` — nonzero exit on any
+finding. Three rules:
+
+**host-sync** — flags implicit device→host synchronization in DECLARED
+hot-path modules (``HOT_PATHS`` below: engine, executor, the fused train
+step, serving, the metric device path, io staging). A stray ``asnumpy``
+/ ``np.asarray`` / ``jax.device_get`` / ``block_until_ready`` /
+``float(x.sum())`` on the hot path stalls the async pipeline behind a
+host round trip — the exact regression class PR 3 removed. Intentional
+sync points carry an inline pragma::
+
+    # mxtpu: allow-sync(reason)
+
+on the flagged line or the line above it.
+
+**lock-order** — checks syntactically nested ``with <lock>:`` blocks
+against the DECLARED hierarchy (``LOCK_LEVELS``; docs/analysis.md):
+locks must be acquired left→right; acquiring an earlier-level lock while
+holding a later-level one is an inversion. The table names locks by
+(owning class, attribute) or (module, global); locks it cannot resolve
+are ignored rather than guessed.
+
+**thread-lifecycle** — flags ``threading.Thread(...)`` creations that
+neither set ``daemon=True`` nor live in a module that joins its threads
+(``.join(`` present): a non-daemon thread without a join/close lifecycle
+outlives its owner and hangs interpreter shutdown. Pragma::
+
+    # mxtpu: allow-thread(reason)
+
+Usage: python tools/mxtpu_lint.py [--pkg mxtpu] [--list-config]
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+# --------------------------------------------------------------- config
+#: hot-path modules (relative to the package root). None = the whole
+#: file; a set restricts the sync rule to those classes (metric.py's
+#: numpy fallback path is INTENTIONALLY host-bound; only its device
+#: path is hot).
+HOT_PATHS = {
+    "mxtpu/engine.py": None,
+    "mxtpu/executor.py": None,
+    "mxtpu/module/fused.py": None,
+    "mxtpu/serving/batcher.py": None,
+    "mxtpu/serving/pool.py": None,
+    "mxtpu/serving/server.py": None,
+    "mxtpu/serving/metrics.py": None,
+    "mxtpu/predict.py": None,
+    "mxtpu/metric.py": {"DeviceKernel", "DeviceMetricAccum"},
+    "mxtpu/io.py": {"PrefetchingIter", "DevicePrefetchIter"},
+}
+
+#: numpy module aliases whose ``asarray``/``array`` calls mean "pull to
+#: host" when fed device arrays
+_NUMPY_ALIASES = {"np", "_np", "numpy", "onp"}
+#: attribute calls that ARE a device->host sync
+_SYNC_ATTRS = {"asnumpy", "device_get", "block_until_ready"}
+#: float()/int() on a call chain ending in one of these is the classic
+#: scalar-pull idiom: float(arr.sum())
+_SCALAR_PULLS = {"sum", "mean", "item", "max", "min"}
+
+PRAGMA_SYNC = "mxtpu: allow-sync("
+PRAGMA_THREAD = "mxtpu: allow-thread("
+
+#: Declared lock hierarchy, outermost-first: a thread may acquire locks
+#: only left→right. Keys are (owning class, attr) for ``self.<attr>``
+#: locks and (module basename sans .py, global name) for module-level
+#: locks. Keep this table in sync with docs/analysis.md.
+LOCK_LEVELS = [
+    ("batcher", {("DynamicBatcher", "_lock"),
+                 ("DynamicBatcher", "_not_empty")}),
+    ("pool", {("ExecutorPool", "_rr_lock"), ("ExecutorPool", "_owned_lock"),
+              ("_Replica", "lock")}),
+    ("slot-state", {("FusedState", "_mem_lock")}),
+    ("postmortem", {("diagnostics", "_PM_LOCK")}),
+    ("ledger", {("DeviceMemoryLedger", "_lock")}),
+    ("programs", {("programs", "_LOCK")}),
+    ("telemetry-registry", {("MetricsRegistry", "_lock"),
+                            ("_DefaultRegistry", "_lock")}),
+    ("engine", {("ThreadedEngine", "_pending_lock"),
+                ("executor", "_BUILD_LOCK"), ("engine", "_ENGINE_LOCK")}),
+]
+
+_LOCK_RANK = {}
+for _rank, (_level, _keys) in enumerate(LOCK_LEVELS):
+    for _k in _keys:
+        _LOCK_RANK[_k] = (_rank, _level)
+
+#: module-global lock names that are UNIQUE across the table: a bare
+#: ``with _PM_LOCK:`` in any file can only mean the declared one (it was
+#: imported), so the name alone resolves it
+_UNIQUE_GLOBALS = {}
+for (_owner, _name), _rl in _LOCK_RANK.items():
+    _UNIQUE_GLOBALS[_name] = None if _name in _UNIQUE_GLOBALS else _rl
+_UNIQUE_GLOBALS = {n: rl for n, rl in _UNIQUE_GLOBALS.items()
+                   if rl is not None and n.isupper()}
+
+
+class LintFinding:
+    def __init__(self, rule, path, line, message):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.message = message
+
+    def __repr__(self):
+        return "%s:%d [%s] %s" % (self.path, self.line, self.rule,
+                                  self.message)
+
+
+def _has_pragma(lines, lineno, pragma):
+    """Pragma on the flagged line, or anywhere in the contiguous comment
+    block immediately above it (pragma reasons often wrap)."""
+    if 1 <= lineno <= len(lines) and pragma in lines[lineno - 1]:
+        return True
+    ln = lineno - 1
+    while 1 <= ln <= len(lines) and lines[ln - 1].lstrip().startswith("#"):
+        if pragma in lines[ln - 1]:
+            return True
+        ln -= 1
+    return False
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, relpath, src, hot_scopes="not-hot"):
+        self.relpath = relpath
+        self.lines = src.splitlines()
+        self.module = os.path.splitext(os.path.basename(relpath))[0]
+        if self.module == "__init__":  # diagnostics/__init__.py -> diagnostics
+            self.module = os.path.basename(os.path.dirname(relpath))
+        # "not-hot" = sync rule off; None = whole file hot; set = classes
+        self.hot_scopes = hot_scopes
+        self.module_joins = False       # set by visit_Call on a real join
+        self.thread_ctors = []          # pending (lineno); judged post-walk
+        self.class_stack = []
+        self.lock_stack = []
+        self.findings = []
+
+    # ------------------------------------------------------------ scope
+    def visit_ClassDef(self, node):
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _in_hot_scope(self):
+        if self.hot_scopes == "not-hot":
+            return False
+        if self.hot_scopes is None:
+            return True
+        return bool(set(self.class_stack) & self.hot_scopes)
+
+    # ------------------------------------------------------------- sync
+    def _sync_reason(self, call):
+        fn = call.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in _SYNC_ATTRS:
+                return "%s() blocks on a device->host transfer" % fn.attr
+            if fn.attr in ("asarray", "array") \
+                    and isinstance(fn.value, ast.Name) \
+                    and fn.value.id in _NUMPY_ALIASES:
+                return "%s.%s() materializes its input on the host" \
+                    % (fn.value.id, fn.attr)
+        elif isinstance(fn, ast.Name) and fn.id in ("float", "int") \
+                and call.args and isinstance(call.args[0], ast.Call) \
+                and isinstance(call.args[0].func, ast.Attribute) \
+                and call.args[0].func.attr in _SCALAR_PULLS:
+            return "%s(x.%s()) pulls a device scalar to the host" \
+                % (fn.id, call.args[0].func.attr)
+        return None
+
+    # ------------------------------------------------------------ locks
+    def _lock_key(self, expr):
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                    and self.class_stack:
+                return (self.class_stack[-1], expr.attr)
+            return None  # other-object locks: cannot resolve the class
+        if isinstance(expr, ast.Name):
+            return (self.module, expr.id)
+        return None
+
+    def visit_With(self, node):
+        ranks = []
+        for item in node.items:
+            key = self._lock_key(item.context_expr)
+            rank = _LOCK_RANK.get(key) if key else None
+            if rank is None and key is not None \
+                    and key[1] in _UNIQUE_GLOBALS:
+                rank = _UNIQUE_GLOBALS[key[1]]
+            if rank is not None:
+                held = self.lock_stack[-1] if self.lock_stack else None
+                if held is not None and rank[0] < held[0][0]:
+                    self.findings.append(LintFinding(
+                        "lock-order", self.relpath, node.lineno,
+                        "acquires '%s' (level %s) while holding '%s' "
+                        "(level %s): violates the declared hierarchy %s"
+                        % (key[1], rank[1], held[1][1], held[0][1],
+                           " -> ".join(lv for lv, _ in LOCK_LEVELS))))
+                ranks.append((rank, key))
+        for r in ranks:
+            self.lock_stack.append(r)
+        self.generic_visit(node)
+        for _ in ranks:
+            self.lock_stack.pop()
+
+    # ----------------------------------------------------------- threads
+    def _is_thread_ctor(self, call):
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "Thread" \
+                and isinstance(fn.value, ast.Name) \
+                and fn.value.id in ("threading", "_threading"):
+            return True
+        return isinstance(fn, ast.Name) and fn.id == "Thread"
+
+    def _is_thread_join(self, call):
+        """A ``<recv>.join(...)`` call that can plausibly be a thread
+        join: NOT a string-literal receiver (``", ".join``) and NOT a
+        path module (``os.path.join`` / ``posixpath.join``). A substring
+        scan here made the rule a no-op — every module path-joins."""
+        fn = call.func
+        if not isinstance(fn, ast.Attribute) or fn.attr != "join":
+            return False
+        recv = fn.value
+        if isinstance(recv, ast.Constant):
+            return False
+        if isinstance(recv, ast.Name) \
+                and recv.id in ("os", "_os", "posixpath", "ntpath",
+                                "path", "op", "osp"):
+            return False
+        if isinstance(recv, ast.Attribute) and recv.attr == "path":
+            return False
+        return True
+
+    def visit_Call(self, node):
+        if self._in_hot_scope():
+            reason = self._sync_reason(node)
+            if reason and not _has_pragma(self.lines, node.lineno,
+                                          PRAGMA_SYNC):
+                self.findings.append(LintFinding(
+                    "host-sync", self.relpath, node.lineno,
+                    "implicit host sync on a hot path: %s — move it off "
+                    "the per-step path or annotate '# %sreason)'"
+                    % (reason, PRAGMA_SYNC)))
+        if self._is_thread_join(node):
+            self.module_joins = True
+        if self._is_thread_ctor(node):
+            daemon = any(kw.arg == "daemon" and
+                         isinstance(kw.value, ast.Constant) and
+                         kw.value.value is True for kw in node.keywords)
+            if not daemon and not _has_pragma(self.lines, node.lineno,
+                                              PRAGMA_THREAD):
+                # pending: the joining call may appear later in the file
+                self.thread_ctors.append(node.lineno)
+        self.generic_visit(node)
+
+    def finalize(self):
+        """Post-walk: judge pending thread ctors now that every join in
+        the file has been seen."""
+        if not self.module_joins:
+            for lineno in self.thread_ctors:
+                self.findings.append(LintFinding(
+                    "thread-lifecycle", self.relpath, lineno,
+                    "thread created without daemon=True and the module "
+                    "never join()s: give it a join/close lifecycle or "
+                    "annotate '# %sreason)'" % PRAGMA_THREAD))
+        return self.findings
+
+
+def lint_source(src, relpath):
+    """Lint one file's source; returns a list of LintFindings."""
+    relpath = relpath.replace(os.sep, "/")
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as exc:
+        return [LintFinding("parse", relpath, exc.lineno or 0, str(exc))]
+    hot = HOT_PATHS.get(relpath, "not-hot")
+    linter = _Linter(relpath, src, hot_scopes=hot)
+    linter.visit(tree)
+    return linter.finalize()
+
+
+def lint_tree(pkg_dir):
+    findings = []
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, ROOT)
+            with open(path) as f:
+                findings.extend(lint_source(f.read(), rel))
+    return findings
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pkg", default=os.path.join(ROOT, "mxtpu"))
+    ap.add_argument("--list-config", action="store_true",
+                    help="print the hot-path modules and lock hierarchy")
+    args = ap.parse_args(argv)
+    if args.list_config:
+        print("hot-path modules (host-sync rule):")
+        for p, scopes in sorted(HOT_PATHS.items()):
+            print("  %s%s" % (p, "" if scopes is None
+                              else "  [classes: %s]"
+                              % ", ".join(sorted(scopes))))
+        print("lock hierarchy (acquire left->right):")
+        print("  " + " -> ".join(lv for lv, _ in LOCK_LEVELS))
+        return 0
+    findings = lint_tree(args.pkg)
+    if findings:
+        print("mxtpu_lint: %d finding(s):" % len(findings))
+        for f in findings:
+            print("  %r" % f)
+        return 1
+    print("mxtpu_lint: clean (%d hot-path modules, %d lock levels)"
+          % (len(HOT_PATHS), len(LOCK_LEVELS)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
