@@ -65,6 +65,7 @@ from . import visualization as viz
 from . import profiler
 from . import test_utils
 from . import parallel
+from . import sharding
 from . import operator
 from . import predict
 from . import serving

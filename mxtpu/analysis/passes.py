@@ -27,6 +27,10 @@ Registered passes (see each class docstring):
                        the donated (params, aux, opt_state) lists may be
                        read after donation; cross-checked against the
                        diagnostics ledger's slot model
+* ``sharding_consistency`` — SPMD plan audit: spec-override axis typos
+                       and rank mismatches, live state whose device
+                       sharding drifted from the plan, mesh-active-but-
+                       plan-declined, group2ctx/mesh placement overlap
 * ``numerics``       — NaN-prone patterns: unclamped exp, unguarded log,
                        hand-rolled softmax, eps-free division by a
                        reduction
@@ -461,6 +465,134 @@ class DonationSafetyPass(GraphPass):
                 "from the donated-buffer churn" % (slot_total, exp_total),
                 fix_hint="call state.update_mem_slot(devices) after any "
                          "re-staging that changes buffer sizes")]
+        return []
+
+
+# ------------------------------------------------------------------ sharding
+@register_pass
+class ShardingConsistencyPass(GraphPass):
+    """SPMD plan consistency: verify a live module against the active
+    :class:`~mxtpu.sharding.ShardingPlan` so plan bugs fail at
+    ``Module.check()`` instead of deep inside jit. Checks:
+
+    * **axis typos / rank mismatches** in user-supplied spec overrides
+      (a typo'd axis name silently prunes to replication — the sharding
+      the author asked for never happens, the SPMD analogue of the
+      silently-unplaced ctx group);
+    * **unsharded-param-on-mesh**: a staged parameter or optimizer-state
+      leaf whose LIVE device sharding disagrees with the plan's spec
+      (something re-staged state behind the plan's back — the jit's
+      in_shardings will reshard every step, or worse, a donated buffer
+      feeds back mis-sharded);
+    * **mesh-declined drift**: a mesh is active but the fused step runs
+      without a plan (batch indivisible, unsupported optimizer) — the
+      author thinks they are training 8-way;
+    * **two placement systems**: ``group2ctx`` model-parallel placement
+      combined with an active mesh plan.
+
+    Dim-level fallbacks the plan itself decided (non-dividing dims,
+    axes the mesh doesn't have) report at info severity — they are the
+    plan working as designed, kept visible for review.
+    """
+
+    name = "sharding_consistency"
+
+    _ISSUE_SEV = {"axis_typo": ERROR, "rank_mismatch": ERROR,
+                  # heuristics naming fsdp/tp on a data-only mesh, or a
+                  # heuristic matrix spec landing on a 1-D param, are the
+                  # NORMAL prune path — not findings
+                  "axis_absent": None, "rank_pruned": None,
+                  "replicated_fallback": INFO}
+
+    def run(self, ctx):
+        mod = ctx.module
+        if mod is None:
+            return []
+        from .. import sharding as _sharding
+        fused = getattr(mod, "_fused", None)
+        plan = getattr(fused, "_plan", None) if fused is not None else None
+        if plan is None:
+            mctx = _sharding.current()
+            if mctx is not None and len(mctx.devices) > 1 \
+                    and fused is not None:
+                return [self.finding(
+                    WARNING, "a %d-device mesh is active but the fused "
+                    "step runs WITHOUT a sharding plan — training is "
+                    "single-replica despite the mesh"
+                    % len(mctx.devices),
+                    fix_hint="check the init_optimizer log: the mesh is "
+                             "declined when the batch does not divide "
+                             "over the data axis or the optimizer has no "
+                             "fused rule")]
+            return []
+        out = []
+        for issue in plan.validate():
+            sev = self._ISSUE_SEV.get(issue["kind"], INFO)
+            if sev is None:
+                continue
+            out.append(self.finding(
+                sev, "sharding spec for '%s': %s (raw %s -> final %s)"
+                % (issue["name"], issue["message"], issue["raw"],
+                   issue["final"]),
+                node=issue["name"],
+                fix_hint="fix the override spec" if sev is ERROR else
+                         "expected plan pruning — replicate is the safe "
+                         "fallback"))
+        out.extend(self._live_state(fused, plan))
+        out.extend(self._placement_overlap(ctx, plan))
+        return out
+
+    def _live_state(self, fused, plan):
+        """Staged state vs plan spec (unsharded-param-on-mesh)."""
+        import jax
+        from jax.sharding import NamedSharding
+        out = []
+        st = fused.state
+
+        def check(name, tree, spec, group):
+            want = NamedSharding(plan.mesh, spec)
+            for leaf in jax.tree.leaves(tree):
+                try:
+                    ok = leaf.sharding.is_equivalent_to(want, leaf.ndim)
+                except Exception:
+                    continue
+                if not ok:
+                    out.append(self.finding(
+                        ERROR, "%s '%s' is staged with sharding %s but "
+                        "the plan says %s — something re-staged it "
+                        "behind the plan (every step pays a reshard, "
+                        "and the ledger's per-chip accounting is wrong)"
+                        % (group, name, leaf.sharding.spec, spec),
+                        node=name,
+                        fix_hint="stage through FusedTrainStep.load/"
+                                 "_restage_fused_params, which apply "
+                                 "the plan specs"))
+                    return
+
+        for name in fused.trainable:
+            if (st.opt_state or {}).get(name) is not None:
+                check(name, st.opt_state[name], plan.opt_spec(name),
+                      "optimizer state for")
+        for name, leaf in (st.params or {}).items():
+            check(name, leaf, plan.param_spec(name), "parameter")
+        return out
+
+    def _placement_overlap(self, ctx, plan):
+        # tags alone place nothing (the executor honors them only via a
+        # group2ctx map — see CtxGroupPass); only a PROVIDED mapping
+        # means a second placement system is actually live
+        tagged = [n.name for n in ctx.symbol._topo()
+                  if n._extra_attrs.get("__ctx_group__") is not None]
+        if tagged and ctx.group2ctx:
+            return [self.finding(
+                WARNING, "graph uses group2ctx placement (%d tagged "
+                "nodes) while an SPMD sharding plan is active: two "
+                "placement systems will fight over the same arrays"
+                % len(tagged),
+                node=tagged[0],
+                fix_hint="drop the ctx-group tags under a mesh, or "
+                         "train without mesh= for model-parallel "
+                         "group2ctx runs")]
         return []
 
 

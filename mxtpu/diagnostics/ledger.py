@@ -303,6 +303,24 @@ class DeviceMemoryLedger:
                        if (origin is None or o == origin)
                        and (ctx is None or c == str(ctx)))
 
+    def shard_bytes(self, origin=None):
+        """Per-device live bytes: {ctx: bytes}, optionally restricted to
+        one origin. The sharding view of the ledger — under SPMD a
+        replicated value counts its full size on EVERY device while a
+        mesh-sharded value counts only its local shard per device (the
+        ``fused_step`` slots attribute via ``addressable_shards``), so
+        this is where weight-update sharding's per-chip memory win is
+        read off."""
+        self._drain()
+        with self._lock:
+            if origin is None:
+                return dict(sorted(self._live_ctx.items()))
+            out = {}
+            for (c, o), v in self._live.items():
+                if o == origin and v:
+                    out[c] = out.get(c, 0) + v
+            return dict(sorted(out.items()))
+
     def peak_bytes(self, ctx=None):
         self._drain()
         with self._lock:

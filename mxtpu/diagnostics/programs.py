@@ -27,7 +27,8 @@ from collections import deque
 from .. import telemetry as _tel
 
 __all__ = ["ProgramRecord", "record_program", "programs", "program_table",
-           "latest_record", "cost_enabled", "set_cost_enabled", "clear"]
+           "latest_record", "cost_enabled", "set_cost_enabled", "clear",
+           "summarize_shardings"]
 
 _ENABLED = os.environ.get("MXTPU_DIAG_COST", "1") != "0"
 
@@ -65,7 +66,8 @@ class ProgramRecord:
 
     __slots__ = ("id", "kind", "owner", "created", "compile_ms", "flops",
                  "bytes_accessed", "argument_bytes", "output_bytes",
-                 "temp_bytes", "generated_code_bytes", "calls", "_exe")
+                 "temp_bytes", "generated_code_bytes", "calls",
+                 "n_devices", "sharded_args", "replicated_args", "_exe")
 
     def __init__(self, kind, owner, compile_ms):
         self.id = next(_ids)
@@ -80,6 +82,9 @@ class ProgramRecord:
         self.temp_bytes = 0
         self.generated_code_bytes = 0
         self.calls = 0
+        self.n_devices = 1       # devices the program's args span (SPMD)
+        self.sharded_args = 0    # arg leaves actually split over a mesh
+        self.replicated_args = 0
         self._exe = None  # weakref to the compiled executable (HLO source)
 
     def hlo_text(self):
@@ -105,7 +110,41 @@ class ProgramRecord:
             "temp_bytes": self.temp_bytes,
             "generated_code_bytes": self.generated_code_bytes,
             "calls": self.calls,
+            "n_devices": self.n_devices,
+            "sharded_args": self.sharded_args,
+            "replicated_args": self.replicated_args,
         }
+
+
+def summarize_shardings(rec, args):
+    """Annotate ``rec`` with the SPMD shape of a call's arguments: how
+    many devices the arg leaves span, and how many leaves are actually
+    split versus replicated. Computed from the live arrays at the build
+    seam (executor ``_first_call``) — robust across jax versions, unlike
+    ``Compiled.input_shardings`` introspection. Never raises."""
+    try:
+        import jax
+        devices = set()
+        sharded = replicated = 0
+        for leaf in jax.tree_util.tree_leaves(args):
+            if not isinstance(leaf, jax.Array):
+                continue
+            try:
+                devs = leaf.sharding.device_set
+            except Exception:
+                continue
+            devices |= devs
+            if len(devs) <= 1:
+                continue
+            if leaf.sharding.is_fully_replicated:
+                replicated += 1
+            else:
+                sharded += 1
+        rec.n_devices = max(1, len(devices))
+        rec.sharded_args = sharded
+        rec.replicated_args = replicated
+    except Exception:
+        pass
 
 
 def record_program(kind, owner, compiled, compile_ms):
@@ -177,16 +216,20 @@ def program_table(kind=None):
     """Human-readable cost report, one row per captured program."""
     rows = programs(kind)
     header = ("id", "kind", "owner", "calls", "compile_ms", "mflops",
-              "mb_accessed", "arg_kb", "out_kb", "temp_kb")
-    lines = ["%4s %-12s %-16s %6s %10s %10s %11s %8s %8s %8s" % header]
+              "mb_accessed", "arg_kb", "out_kb", "temp_kb", "devs")
+    lines = ["%4s %-12s %-16s %6s %10s %10s %11s %8s %8s %8s %9s" % header]
     for r in rows:
-        lines.append("%4d %-12s %-16s %6d %10.1f %10.2f %11.2f %8d %8d %8d"
+        devs = "%d" % r.get("n_devices", 1)
+        if r.get("sharded_args"):
+            devs += " (%ds)" % r["sharded_args"]
+        lines.append("%4d %-12s %-16s %6d %10.1f %10.2f %11.2f %8d %8d "
+                     "%8d %9s"
                      % (r["id"], r["kind"][:12], r["owner"][:16], r["calls"],
                         r["compile_ms"], r["flops"] / 1e6,
                         r["bytes_accessed"] / 1e6,
                         r["argument_bytes"] // 1024,
                         r["output_bytes"] // 1024,
-                        r["temp_bytes"] // 1024))
+                        r["temp_bytes"] // 1024, devs))
     return "\n".join(lines)
 
 

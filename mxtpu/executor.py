@@ -195,6 +195,10 @@ def _instrument_program(kind, fn, owner=None, matmul_env=False):
                 exe = fn.lower(*args, **kwargs).compile()
                 state["rec"] = _diag.record_program(
                     kind, owner, exe, (_time.perf_counter() - t0) * 1e3)
+                # SPMD shape of the program: devices spanned + how many
+                # arg leaves are mesh-split vs replicated (read off the
+                # live args — the one place both are in hand)
+                _diag.summarize_shardings(state["rec"], args)
             except Exception:
                 exe = None
                 state["compiled"] = None

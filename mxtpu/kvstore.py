@@ -26,6 +26,7 @@ communication backend'):
 from __future__ import annotations
 
 import pickle
+import threading as _threading
 
 import jax
 import numpy as _np
@@ -180,11 +181,94 @@ class KVStore:
                 self._client.barrier()
             self._store[k] = arr.copy()
 
+    # ------------------------------------------------ mesh veneer
+    # With an active SPMD mesh (mxtpu.sharding), 'local'/'device' stores
+    # become a thin veneer over the mesh path: push aggregation runs as
+    # ONE jitted all-reduce over the mesh (GSPMD collectives over ICI)
+    # and pull hands each device its addressable shard of the replicated
+    # result zero-copy. The host loop below stays as the fallback for
+    # value lists that don't line up with the mesh (different device
+    # set, single device, non-jax values). MXTPU_KVSTORE_MESH=0 opts out.
+
+    # jitted sum per mesh, keyed by the mesh's STABLE identity (axis
+    # layout + device ids, not id(mesh) — a leaked id would both re-jit
+    # per push and pin dead meshes); guarded by a class lock since
+    # pushes can race from several fit threads
+    _MESH_SUM_FNS = {}
+    _MESH_SUM_LOCK = _threading.Lock()
+
+    @staticmethod
+    def _mesh_key(mesh):
+        return (tuple(mesh.axis_names), tuple(mesh.devices.shape),
+                tuple(d.id for d in mesh.devices.flat))
+
+    def _mesh_align(self, vlist):
+        """Per-mesh-device arrays in mesh order when ``vlist`` covers
+        exactly the active mesh's devices; None otherwise."""
+        import os
+        if os.environ.get("MXTPU_KVSTORE_MESH", "1") == "0":
+            return None, None
+        from . import sharding as _sharding
+        mctx = _sharding.current()
+        if mctx is None:
+            return None, None
+        # the row-shard trick below (one (1,)+shape row per device under
+        # P(data)) is only shape-correct on a 1-D data mesh — on a
+        # data×tp mesh the expected shard holds n/n_data rows, so fall
+        # back to the host loop rather than hand jax mis-shaped shards
+        if mctx.mesh.axis_names != (mctx.layout.data_axis,):
+            return None, None
+        devices = mctx.devices
+        if len(vlist) != len(devices) or len(devices) < 2:
+            return None, None
+        by_dev = {}
+        for v in vlist:
+            data = getattr(v, "_data", None)
+            if not isinstance(data, jax.Array):
+                return None, None
+            devs = getattr(data, "devices", lambda: set())()
+            if len(devs) != 1:
+                return None, None
+            by_dev[next(iter(devs))] = data
+        if set(by_dev) != set(devices):
+            return None, None
+        return [by_dev[d] for d in devices], mctx
+
+    def _mesh_merge(self, ordered, mctx, ctx_out):
+        """All-reduce ``ordered`` (one committed array per mesh device,
+        mesh order) into a mesh-replicated NDArray: the per-device
+        buffers become row-shards of ONE global array and a jitted
+        sum-over-rows with replicated out_sharding lowers to the
+        collective — no host hop, no per-device copy loop."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = mctx.mesh
+        shape = tuple(ordered[0].shape)
+        rows = [a.reshape((1,) + shape) for a in ordered]
+        sharding = NamedSharding(mesh, P(mctx.layout.data_axis))
+        global_arr = jax.make_array_from_single_device_arrays(
+            (len(rows),) + shape, sharding, rows)
+        key = self._mesh_key(mesh)
+        with self._MESH_SUM_LOCK:
+            fn = self._MESH_SUM_FNS.get(key)
+            if fn is None:
+                fn = jax.jit(lambda x: x.sum(0),
+                             out_shardings=NamedSharding(mesh, P()))
+                self._MESH_SUM_FNS[key] = fn
+        _tel.counter("kvstore_mesh_allreduce",
+                     help="push aggregations lowered to mesh "
+                          "collectives instead of the host loop").inc()
+        return NDArray(fn(global_arr), ctx_out)
+
     def _local_merge(self, vlist):
-        """Reduce a per-device value list onto the first device (the
-        CommCPU/CommDevice tree-reduce role, comm.h:90/:462)."""
+        """Reduce a per-device value list (the CommCPU/CommDevice
+        tree-reduce role, comm.h:90/:462): one mesh collective when the
+        list lines up with the active mesh, else the host loop onto the
+        first device."""
         merged = vlist[0]
         if len(vlist) > 1:
+            ordered, mctx = self._mesh_align(vlist)
+            if ordered is not None:
+                return self._mesh_merge(ordered, mctx, vlist[0].context)
             dev = vlist[0].context.jax_device
             acc = vlist[0]._data
             for x in vlist[1:]:
@@ -223,6 +307,15 @@ class KVStore:
                 self._store[k] = merged.copy()
                 continue
             if self._updater is not None:
+                if getattr(merged._data, "sharding", None) is not None and \
+                        len(merged._data.devices()) > 1:
+                    # the updater runs the optimizer on the store's own
+                    # single-device array — hand it a single-device view
+                    # of the mesh-replicated aggregate (its local shard,
+                    # so this is a no-copy reinterpret)
+                    merged = NDArray(self._shard_for(
+                        merged._data, self._store[k].context.jax_device),
+                        self._store[k].context)
                 self._updater(self._key_int(k), merged, self._store[k])
             else:
                 self._store[k]._data = merged._data
@@ -253,7 +346,8 @@ class KVStore:
             src = self._store[k]
             olist = o if isinstance(o, list) else [o]
             for dst in olist:
-                dst._data = jax.device_put(src._data, dst.context.jax_device)
+                dst._data = self._shard_for(src._data,
+                                            dst.context.jax_device)
                 bytes_pulled.inc(_nbytes(dst))
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
@@ -288,6 +382,19 @@ class KVStore:
                     src = self._store[k]
                     dst._data = jax.device_put(src._data,
                                                dst.context.jax_device)
+
+    @staticmethod
+    def _shard_for(src, device):
+        """A single-device array of ``src`` on ``device``. When ``src``
+        is mesh-replicated and ``device`` holds one of its shards, the
+        shard IS the value — handed out zero-copy (the veneer's pull
+        path); otherwise a plain device_put transfer."""
+        if isinstance(src, jax.Array) and len(src.devices()) > 1:
+            for sh in src.addressable_shards:
+                if sh.device == device and \
+                        tuple(sh.data.shape) == tuple(src.shape):
+                    return sh.data
+        return jax.device_put(src, device)
 
     # ------------------------------------------------ updater / optimizer
     def set_updater(self, updater):

@@ -236,12 +236,25 @@ class FusedTrainStep:
     shards over it, params/aux replicate, and the gradient mean implied by
     vjp-under-GSPMD reproduces the kvstore sum + rescale_grad semantics.
 
+    ``plan``: a :class:`mxtpu.sharding.ShardingPlan` — the step then jits
+    under the plan's mesh with explicit in/out shardings: params/aux on
+    their plan specs (replicated for pure data parallel), the batch
+    sharded over ``data``, and the optimizer state on the plan's
+    **weight-update sharding** specs. Gradients entering the update are
+    constrained to the optimizer-state sharding, so GSPMD lowers the
+    gradient all-reduce to a reduce-scatter, runs the update on 1/n of
+    the rows per replica, and the replicated ``out_shardings`` on the
+    params force the weight all-gather — same numbers as the replicated
+    update (up to reduction order), 1/n optimizer memory and update
+    flops per chip.
+
     ``state``: pass an existing FusedState to share weights/opt-state with
     other steps (bucketing); omitted, a private store is created.
     """
 
     def __init__(self, symbol, devices, param_names, data_names, label_names,
-                 optimizer, fixed_param_names=(), logger=None, state=None):
+                 optimizer, fixed_param_names=(), logger=None, state=None,
+                 plan=None):
         self.symbol = symbol
         self.devices = list(devices)
         self.param_names = list(param_names)
@@ -310,7 +323,12 @@ class FusedTrainStep:
                         tags[id(n)] = "mxtpu_conv"
         self._run = _trace_graph(symbol, is_train=True, remat_tags=tags)
         self._mesh = None
-        if len(self.devices) > 1:
+        self._plan = None
+        if plan is not None and len(plan.mesh_ctx.devices) > 1:
+            self._plan = plan
+            self._mesh = plan.mesh
+            self.devices = plan.mesh_ctx.devices
+        elif len(self.devices) > 1:
             # mxtpu: allow-sync(np.array over device HANDLES for the mesh
             # grid — no tensor data moves)
             self._mesh = Mesh(_np.array(self.devices), ("data",))
@@ -353,7 +371,16 @@ class FusedTrainStep:
             return mesh_put(self._mesh, v, spec)  # multi-host safe
         return jax.device_put(v, self.devices[0])
 
-    def _stage(self, v):
+    def _param_spec(self, name):
+        """Plan spec for a parameter/aux value (replicated without one)."""
+        return self._plan.param_spec(name) if self._plan is not None else P()
+
+    def _opt_spec(self, name):
+        """Plan spec for a parameter's optimizer-state leaves — the
+        weight-update sharding assignment (replicated without a plan)."""
+        return self._plan.opt_spec(name) if self._plan is not None else P()
+
+    def _stage(self, v, spec=P()):
         """Stage one value onto the device(s) WITHOUT aliasing the
         caller's buffer. ``device_put`` of an array already committed to
         the target device returns the SAME array — the step's donation
@@ -363,17 +390,18 @@ class FusedTrainStep:
         data = getattr(v, "_data", v)
         if isinstance(data, jax.Array):
             data = jnp.copy(data)
-        return self._put(data)
+        return self._put(data, spec)
 
     def load(self, arg_params, aux_params):
         """Stage host params onto the device(s), (re)creating opt state."""
         names = set(self.param_names)
-        self.params = {n: self._stage(v)
+        self.params = {n: self._stage(v, self._param_spec(n))
                        for n, v in arg_params.items() if n in names}
-        self.aux = {n: self._stage(v)
+        self.aux = {n: self._stage(v, self._param_spec(n))
                     for n, v in (aux_params or {}).items()}
-        self.opt_state = {n: jax.tree.map(self._put, self._state_init(
-            self.params[n])) for n in self.trainable}
+        self.opt_state = {n: jax.tree.map(
+            lambda t, _s=self._opt_spec(n): self._put(t, _s),
+            self._state_init(self.params[n])) for n in self.trainable}
         self.state.update_mem_slot(self.devices)
 
     def adopt_state(self):
@@ -385,7 +413,8 @@ class FusedTrainStep:
         for n in self.trainable:
             if n not in st.opt_state:
                 st.opt_state[n] = jax.tree.map(
-                    self._put, self._state_init(st.params[n]))
+                    lambda t, _s=self._opt_spec(n): self._put(t, _s),
+                    self._state_init(st.params[n]))
         st.update_mem_slot(self.devices)
 
     # ------------------------------------------------ the program
@@ -395,6 +424,19 @@ class FusedTrainStep:
         apply_update = self._apply
 
         remat = self._remat
+        # weight-update sharding: constrain each gradient entering the
+        # optimizer to the opt-state sharding BEFORE the update — GSPMD
+        # then reduce-scatters the vjp gradient instead of all-reducing
+        # it, and the whole update chain below runs on 1/n rows per
+        # replica (the out_shardings on params force the all-gather of
+        # the fresh weights afterwards)
+        grad_shardings = None
+        if self._plan is not None:
+            grad_shardings = {}
+            for n in trainable:
+                spec = self._opt_spec(n)
+                if tuple(spec):
+                    grad_shardings[n] = NamedSharding(self._mesh, spec)
 
         def step(params, aux, opt_state, batch, lrs, wds, rng):
             fixed = {n: v for n, v in params.items() if n not in trainable}
@@ -427,7 +469,11 @@ class FusedTrainStep:
             new_params = dict(fixed)
             new_opt = {}
             for i, n in enumerate(trainable):
-                p2, s2 = apply_update(params[n], grads[n], opt_state[n],
+                g = grads[n]
+                if grad_shardings is not None and n in grad_shardings:
+                    g = jax.lax.with_sharding_constraint(g,
+                                                         grad_shardings[n])
+                p2, s2 = apply_update(params[n], g, opt_state[n],
                                       lrs[i], wds[i])
                 new_params[n] = p2.astype(params[n].dtype)
                 new_opt[n] = s2
@@ -435,7 +481,29 @@ class FusedTrainStep:
             new_aux.update(auxu)
             return new_params, new_aux, new_opt, outs
 
-        if self._mesh is not None:
+        if self._mesh is not None and self._plan is not None:
+            plan = self._plan
+            repl = NamedSharding(self._mesh, P())
+            p_sh = {n: NamedSharding(self._mesh, plan.param_spec(n))
+                    for n in self.params}
+            a_sh = {n: NamedSharding(self._mesh, plan.param_spec(n))
+                    for n in self.aux}
+            o_sh = {n: jax.tree.map(
+                lambda _, _s=plan.opt_spec(n):
+                NamedSharding(self._mesh, _s), self.opt_state[n])
+                for n in self.opt_state}
+            b_sh = {n: NamedSharding(self._mesh, plan.batch_spec(n))
+                    for n in self.data_names + self.label_names}
+            # out_shardings pin params/aux back to their (replicated)
+            # specs — with the update computed sharded, THIS is what
+            # makes GSPMD insert the weight all-gather — and keep the
+            # optimizer state sharded across steps; outputs propagate
+            self._step_fn = jax.jit(
+                step, in_shardings=(p_sh, a_sh, o_sh, b_sh, repl, repl,
+                                    repl),
+                out_shardings=(p_sh, a_sh, o_sh, None),
+                donate_argnums=(0, 1, 2))
+        elif self._mesh is not None:
             repl = NamedSharding(self._mesh, P())
             bshard = NamedSharding(self._mesh, P("data"))
             p_sh = {n: repl for n in self.params}
@@ -467,7 +535,9 @@ class FusedTrainStep:
         for names, arrs in ((self.data_names, data_arrays),
                             (self.label_names, label_arrays)):
             for n, v in zip(names, arrs):
-                batch[n] = self._put(getattr(v, "_data", v), spec)
+                nspec = self._plan.batch_spec(n) if self._plan is not None \
+                    else spec
+                batch[n] = self._put(getattr(v, "_data", v), nspec)
         self.last_labels = [batch[n] for n in self.label_names if n in batch]
         if self._step_fn is None:
             # route through the executor's build seam: program_build_count,
@@ -535,13 +605,16 @@ class FusedTrainStep:
 
     def import_opt_state(self, states):
         """Accept {index: state} keyed by the Updater's index scheme; for a
-        name with several device-copy indices the lowest present wins."""
+        name with several device-copy indices the lowest present wins.
+        Restored leaves are staged on the plan's weight-update sharding
+        spec (like load/adopt_state) — a replicated restore would make
+        every step reshard and void the per-chip memory split."""
         for i, n in enumerate(self.trainable):
             cands = [states[j] for j in sorted(states)
                      if self._idx2name.get(j) == n and states[j] is not None]
             if not cands:
                 continue
             self.opt_state[n] = jax.tree.map(
-                lambda t, s: self._put(jnp.asarray(
-                    getattr(s, "_data", s), t.dtype)),
+                lambda t, s, _spec=self._opt_spec(n): self._put(
+                    jnp.asarray(getattr(s, "_data", s), t.dtype), _spec),
                 self.opt_state[n], cands[0])

@@ -7,7 +7,12 @@ update fused into a single XLA program. Gradient synchronization is implicit:
 with params replicated and the batch sharded over the 'data' axis, GSPMD inserts
 the all-reduce over ICI (the KVStore Push+Pull ≡ allreduce equivalence of
 SURVEY.md §5). With shard_params=True, large weights are additionally sharded
-over the 'model' axis (tensor parallelism the reference never had)."""
+over the 'model' axis (tensor parallelism the reference never had).
+
+NOTE: this standalone trainer is the experimental surface. The production
+path is ``mxtpu.sharding`` + ``Module.fit(mesh=...)`` (docs/sharding.md),
+which runs the SAME weight-update-sharding recipe through the Module
+optimizer semantics, the diagnostics ledger, and the analysis passes."""
 from __future__ import annotations
 
 import jax

@@ -67,9 +67,34 @@ def make_mesh(shape=None, axis_names=None, devices=None):
 
 
 def current_mesh():
+    """The mesh ambient for parallel/ consumers. One truth with the SPMD
+    layer, most-explicit first:
+
+    1. an ACTIVE ``mxtpu.sharding`` scope (``Module.fit(mesh=...)`` /
+       ``sharding.use``) — the one-truth guarantee;
+    2. a mesh the user installed with :func:`make_mesh` — a multi-axis
+       ``(dp, sp)``/``(dp, stage)`` mesh for ring_attention/pipeline/moe
+       must NOT be shadowed by a 1-D env mesh those helpers can't use;
+    3. the ``MXTPU_MESH`` env fallback;
+    4. lazily, the 1-D ('data',) default over all devices (as before)."""
+    try:
+        from ..sharding import active_mesh
+        m = active_mesh()
+        if m is not None:
+            return m
+    except Exception:
+        pass
     global _current
-    if _current is None:
-        make_mesh()
+    if _current is not None:
+        return _current
+    try:
+        from ..sharding import from_env
+        ctx = from_env()
+        if ctx is not None:
+            return ctx.mesh
+    except Exception:
+        pass
+    make_mesh()
     return _current
 
 

@@ -1,0 +1,41 @@
+"""mxtpu.sharding — the SPMD mesh execution layer.
+
+The capability surface promises multi-device data parallelism; this
+package is where the devices become real. Three pieces:
+
+* **axis vocabulary + heuristics** (:mod:`~mxtpu.sharding.spec`):
+  :class:`SpecLayout` names the canonical ``data``/``fsdp``/``tp`` mesh
+  axes and :func:`parameter_spec_from_name` assigns a PartitionSpec to
+  any parameter from its name (embedding / projection / replicated-bias
+  rules, replicate-on-unknown fallback);
+* **mesh + plan** (:mod:`~mxtpu.sharding.plan`): :class:`MeshContext`
+  (built from ``Module.fit(mesh=...)``, ``MXTPU_MESH``, or a raw
+  ``jax.sharding.Mesh``) and :class:`ShardingPlan`, which fits the
+  heuristic specs to the live mesh and real shapes — including
+  **cross-replica weight-update sharding**: optimizer state and the
+  update computation shard over the ``data`` axis, so GSPMD replaces
+  the gradient all-reduce with reduce-scatter + sharded update +
+  weight all-gather and per-chip optimizer memory drops ~linearly with
+  the replica count;
+* **consumers**: ``FusedTrainStep`` jits under the plan's
+  in/out shardings with donated sharded state
+  (``module/fused.py``), the KVStore ``local``/``device`` types
+  delegate push/pull aggregation to mesh collectives when a mesh is
+  active (``kvstore.py``), and the ``sharding_consistency`` analysis
+  pass verifies a module against the active plan at ``Module.check()``.
+
+See docs/sharding.md for the mesh setup and semantics.
+"""
+from __future__ import annotations
+
+from .spec import SpecLayout, parameter_spec_from_name
+from .plan import (DISABLED, MeshContext, ShardingPlan, activate, active,
+                   active_mesh, current, deactivate, from_env, naive_spec,
+                   plan_for_module, resolve, use)
+
+__all__ = [
+    "SpecLayout", "parameter_spec_from_name",
+    "MeshContext", "ShardingPlan", "naive_spec", "plan_for_module",
+    "activate", "deactivate", "active", "active_mesh", "current", "use",
+    "resolve", "from_env", "DISABLED",
+]

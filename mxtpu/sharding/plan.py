@@ -1,0 +1,465 @@
+"""MeshContext + ShardingPlan: the SPMD execution layer's decision record.
+
+``MeshContext`` owns a ``jax.sharding.Mesh`` plus the axis vocabulary
+(:class:`~mxtpu.sharding.SpecLayout`) and the process-wide *active mesh*
+slot that ``Module.fit(mesh=...)`` / ``MXTPU_MESH`` arm and the KVStore
+veneer and ``_arm_fused`` consult.
+
+``ShardingPlan`` turns the name heuristics into concrete, mesh-legal
+specs for one module: every parameter, optimizer-state tree, aux state
+and input batch gets a PartitionSpec that (a) only names axes the mesh
+has, (b) only shards dims the axis size divides, and (c) applies
+cross-replica weight-update sharding to the optimizer state (state and
+update computation shard over ``data``; GSPMD turns the gradient
+all-reduce into reduce-scatter + sharded update + weight all-gather —
+per-chip optimizer memory and update flops drop ~linearly with replica
+count). Every pruning decision is kept on the plan so the
+``sharding_consistency`` analysis pass can explain *why* a param ended
+up replicated instead of silently diverging from the author's intent.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging
+import os
+import threading
+
+import numpy as _np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
+
+from ..base import MXNetError
+from .spec import SpecLayout, parameter_spec_from_name
+
+__all__ = ["MeshContext", "ShardingPlan", "activate", "deactivate",
+           "active", "active_mesh", "current", "use", "resolve",
+           "from_env", "plan_for_module", "naive_spec", "DISABLED"]
+
+log = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------------------- mesh
+class MeshContext:
+    """A device mesh plus the axis vocabulary used to shard over it."""
+
+    def __init__(self, mesh, layout=None):
+        if not isinstance(mesh, Mesh):
+            raise MXNetError("MeshContext needs a jax.sharding.Mesh, got %r"
+                             % (type(mesh).__name__,))
+        self.mesh = mesh
+        self.layout = layout or SpecLayout()
+
+    # ------------------------------------------------ introspection
+    @property
+    def devices(self):
+        """Flat device list in mesh order."""
+        return list(self.mesh.devices.flat)
+
+    @property
+    def axis_sizes(self):
+        """{axis_name: size} for every mesh axis."""
+        return dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+
+    @property
+    def n_data(self):
+        """Size of the data (replica) axis; 1 when the mesh has none."""
+        return self.axis_sizes.get(self.layout.data_axis, 1)
+
+    def sharding(self, spec=PS()):
+        return NamedSharding(self.mesh, spec)
+
+    def __repr__(self):
+        return "MeshContext(%s)" % ", ".join(
+            "%s:%d" % kv for kv in self.axis_sizes.items())
+
+    # ------------------------------------------------ construction
+    @classmethod
+    def create(cls, spec=None, devices=None, layout=None):
+        """Build a MeshContext from a loose description.
+
+        ``spec`` forms:
+
+        * ``None`` / ``"all"`` / ``"auto"`` / ``True`` — 1-D ``('data',)``
+          mesh over every local device;
+        * an int / ``"8"`` — 1-D ``('data',)`` over the first n devices;
+        * ``"4x2"`` — 2-D ``('data', 'tp')``;
+        * ``"data:4,tp:2"`` — named axes, any order;
+        * a ``jax.sharding.Mesh`` or existing MeshContext — wrapped/returned.
+        """
+        layout = layout or SpecLayout()
+        if isinstance(spec, MeshContext):
+            return spec
+        if isinstance(spec, Mesh):
+            return cls(spec, layout)
+        devices = list(devices) if devices is not None \
+            else list(jax.local_devices())
+        if spec is None or spec is True or (
+                isinstance(spec, str) and spec.lower() in ("all", "auto")):
+            shape, names = (len(devices),), (layout.data_axis,)
+        elif isinstance(spec, int) or (isinstance(spec, str)
+                                       and spec.isdigit()):
+            shape, names = (int(spec),), (layout.data_axis,)
+        elif isinstance(spec, str) and ":" in spec:
+            names, shape = [], []
+            for part in spec.split(","):
+                axis, _, size = part.partition(":")
+                names.append(axis.strip())
+                shape.append(int(size))
+            shape, names = tuple(shape), tuple(names)
+        elif isinstance(spec, str) and "x" in spec:
+            shape = tuple(int(s) for s in spec.split("x"))
+            default_names = (layout.data_axis, layout.tp_axis,
+                             layout.fsdp_axis)
+            if len(shape) > len(default_names):
+                raise MXNetError("mesh spec %r: use the named 'axis:n,...' "
+                                 "form for >%d axes" % (spec,
+                                                        len(default_names)))
+            names = default_names[:len(shape)]
+        else:
+            raise MXNetError("cannot parse mesh spec %r (use an int, "
+                             "'all', '4x2', 'data:4,tp:2', or a Mesh)"
+                             % (spec,))
+        n = int(_np.prod(shape))
+        if n > len(devices):
+            raise MXNetError("mesh spec %r needs %d devices, only %d "
+                             "available" % (spec, n, len(devices)))
+        arr = _np.asarray(devices[:n]).reshape(shape)
+        return cls(Mesh(arr, names), layout)
+
+
+# ----------------------------------------------------------- active mesh
+_active_lock = threading.Lock()
+# contextvar, not a module global: concurrent fits on different threads
+# must not see each other's mesh (thread B's _arm_fused reading thread
+# A's fit(mesh=...) would silently shard B's module), and interleaved
+# use() exits must each restore THEIR prior value
+_active = contextvars.ContextVar("mxtpu_active_mesh", default=None)
+
+
+def activate(mesh_ctx):
+    """Install ``mesh_ctx`` as the active mesh for this thread/context
+    (what ``_arm_fused`` and the KVStore veneer consult). Returns the
+    previous value so callers can restore it."""
+    prev = _active.get()
+    _active.set(mesh_ctx)
+    return prev
+
+
+def deactivate():
+    """Clear the active mesh."""
+    return activate(None)
+
+
+def active():
+    """The explicitly activated :class:`MeshContext`, or None (the
+    :data:`DISABLED` sentinel reads as None — use :func:`current` when
+    the env fallback should apply)."""
+    cur = _active.get()
+    return None if cur is DISABLED else cur
+
+
+def active_mesh():
+    """The active ``jax.sharding.Mesh``, or None."""
+    ctx = active()
+    return ctx.mesh if ctx is not None else None
+
+
+@contextlib.contextmanager
+def use(mesh_ctx):
+    """Scoped :func:`activate`; ``None`` is a no-op (so callers can
+    unconditionally wrap)."""
+    if mesh_ctx is None:
+        yield None
+        return
+    prev = activate(mesh_ctx)
+    try:
+        yield mesh_ctx
+    finally:
+        activate(prev)
+
+
+#: sentinel an explicit ``mesh=False`` activates: "no mesh, and do NOT
+#: fall back to MXTPU_MESH" (distinct from None = nothing decided)
+DISABLED = object()
+
+
+#: MXTPU_MESH parse cache: spec string -> MeshContext. Hot-path callers
+#: (the KVStore veneer consults current() per push) must get a STABLE
+#: MeshContext/Mesh identity per env value, not a fresh Mesh each call —
+#: downstream jit caches key on the mesh.
+_ENV_CACHE = {}
+
+
+def from_env():
+    """MeshContext described by ``MXTPU_MESH`` (e.g. ``8``, ``all``,
+    ``data:4,tp:2``), or None when unset/disabled. Parses are cached per
+    spec string, so repeated calls return the SAME MeshContext."""
+    spec = os.environ.get("MXTPU_MESH", "").strip()
+    if not spec or spec.lower() in ("0", "none", "off", "false"):
+        return None
+    ctx = _ENV_CACHE.get(spec)
+    if ctx is None:
+        with _active_lock:
+            ctx = _ENV_CACHE.get(spec)
+            if ctx is None:
+                ctx = _ENV_CACHE[spec] = MeshContext.create(spec)
+    return ctx
+
+
+def resolve(mesh=None):
+    """Normalize a ``Module.fit(mesh=...)`` argument: ``None`` defers to
+    ``MXTPU_MESH``; ``False``/``0``/``"none"`` explicitly disables (even
+    with the env set — resolves to the :data:`DISABLED` sentinel);
+    anything else goes through :meth:`MeshContext.create`."""
+    if mesh is None:
+        return from_env()
+    if mesh is False or (isinstance(mesh, (str, int))
+                         and str(mesh).lower() in ("0", "none", "off",
+                                                   "false")):
+        return DISABLED
+    return MeshContext.create(mesh)
+
+
+def current():
+    """The mesh the CURRENT scope should use: the active MeshContext,
+    else ``MXTPU_MESH`` — and None when a ``mesh=False`` scope explicitly
+    disabled sharding. The one lookup ``_arm_fused`` and the KVStore
+    veneer share."""
+    ctx = _active.get()
+    if ctx is DISABLED:
+        return None
+    if ctx is not None:
+        return ctx
+    return from_env()
+
+
+# ----------------------------------------------------------------- plan
+def naive_spec(shape, mesh_ctx, axis=None):
+    """SNIPPETS [3] naive batch-axis fallback: shard dim 0 over the data
+    axis when it divides, replicate otherwise — the spec that is always
+    legal for an arbitrary symbol's inputs."""
+    axis = axis or mesh_ctx.layout.data_axis
+    n = mesh_ctx.axis_sizes.get(axis, 1)
+    if n > 1 and shape and shape[0] % n == 0:
+        return PS(axis)
+    return PS()
+
+
+class ShardingPlan:
+    """Concrete, mesh-legal PartitionSpecs for one module's symbols.
+
+    ``param_shapes`` maps every parameter name to its shape;
+    ``trainable`` restricts weight-update sharding to names the
+    optimizer actually updates. ``overrides`` lets callers force a spec
+    per name (kept raw — the consistency pass reports axis typos and
+    rank mismatches instead of silently pruning them away).
+
+    Knobs: ``shard_update`` (default on, env ``MXTPU_SHARD_UPDATE``)
+    gates weight-update sharding; ``min_shard_elems`` (env
+    ``MXTPU_SHARD_MIN_ELEMS``, default 4096) keeps tiny states
+    replicated — below that size the all-gather bookkeeping outweighs
+    the bytes saved (the "+ replication overhead" term in the memory
+    model).
+    """
+
+    def __init__(self, mesh_ctx, param_shapes, data_names=(),
+                 label_names=(), trainable=None, aux_names=(),
+                 batch_shapes=None, overrides=None, shard_update=None,
+                 min_shard_elems=None):
+        self.mesh_ctx = mesh_ctx
+        self.layout = mesh_ctx.layout
+        self.param_shapes = {n: tuple(s) for n, s in param_shapes.items()}
+        self.data_names = list(data_names)
+        self.label_names = list(label_names)
+        self.trainable = set(trainable if trainable is not None
+                             else self.param_shapes)
+        self.aux_names = list(aux_names)
+        self.batch_shapes = {n: tuple(s)
+                             for n, s in (batch_shapes or {}).items()}
+        self.overrides = dict(overrides or {})
+        if shard_update is None:
+            shard_update = os.environ.get("MXTPU_SHARD_UPDATE", "1") != "0"
+        self.shard_update = bool(shard_update)
+        if min_shard_elems is None:
+            min_shard_elems = int(os.environ.get("MXTPU_SHARD_MIN_ELEMS",
+                                                 str(4096)))
+        self.min_shard_elems = int(min_shard_elems)
+        #: name -> (raw_spec, final_spec, [reasons]) — every decision,
+        #: kept for the sharding_consistency pass and describe()
+        self.decisions = {}
+        self._param_specs = {}
+        self._opt_specs = {}
+        for name, shape in self.param_shapes.items():
+            raw = self.overrides.get(name)
+            if raw is None:
+                raw = parameter_spec_from_name(name, self.layout)
+            final, reasons = self._fit(raw, shape)
+            self.decisions[name] = (raw, final, reasons)
+            self._param_specs[name] = final
+            self._opt_specs[name] = self._weight_update_spec(name, shape,
+                                                             final)
+
+    @property
+    def mesh(self):
+        return self.mesh_ctx.mesh
+
+    @property
+    def n_data(self):
+        return self.mesh_ctx.n_data
+
+    # ------------------------------------------------ spec fitting
+    def _fit(self, spec, shape):
+        """Prune ``spec`` against the live mesh and the real shape:
+        absent axes and non-dividing dims fall back to None (replicate
+        that dim). Returns (final_spec, [(kind, message)]) — the kind is
+        recorded HERE, at decision time, so validate()'s severity mapping
+        never depends on parsing the human-readable message."""
+        sizes = self.mesh_ctx.axis_sizes
+        reasons = []
+        entries = tuple(spec)
+        if len(entries) > len(shape):
+            reasons.append(("rank", "spec rank %d > param rank %d — extra "
+                            "dims dropped" % (len(entries), len(shape))))
+            entries = entries[:len(shape)]
+        fitted = []
+        for dim, entry in enumerate(entries):
+            if entry is None:
+                fitted.append(None)
+                continue
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            missing = [a for a in axes if a not in sizes]
+            if missing:
+                reasons.append(("axis", "axis %s not on the mesh (has: %s)"
+                                % ("/".join(missing),
+                                   ", ".join(sizes) or "none")))
+                axes = tuple(a for a in axes if a in sizes)
+            factor = int(_np.prod([sizes[a] for a in axes])) if axes else 1
+            if factor <= 1:
+                fitted.append(None)
+                continue
+            if shape[dim] % factor != 0:
+                reasons.append(("divisibility", "dim %d (size %d) not "
+                                "divisible by %s=%d — replicated"
+                                % (dim, shape[dim], "×".join(axes),
+                                   factor)))
+                fitted.append(None)
+                continue
+            fitted.append(axes if len(axes) > 1 else axes[0])
+        while fitted and fitted[-1] is None:
+            fitted.pop()
+        return PS(*fitted), reasons
+
+    def _weight_update_spec(self, name, shape, param_spec):
+        """Optimizer-state spec: the param spec plus data-axis row
+        sharding when legal (cross-replica weight-update sharding)."""
+        if name not in self.trainable or not self.shard_update:
+            return param_spec
+        data = self.layout.data_axis
+        n = self.mesh_ctx.axis_sizes.get(data, 1)
+        if n <= 1 or not shape:
+            return param_spec
+        if int(_np.prod(shape)) < self.min_shard_elems:
+            return param_spec
+        dim0 = tuple(param_spec)[0] if tuple(param_spec) else None
+        used = dim0 if isinstance(dim0, tuple) else \
+            ((dim0,) if dim0 else ())
+        if data in used:
+            return param_spec
+        factor = n * int(_np.prod(
+            [self.mesh_ctx.axis_sizes[a] for a in used])) if used else n
+        if shape[0] % factor != 0:
+            return param_spec
+        merged = (data,) + used
+        rest = tuple(param_spec)[1:]
+        return PS(merged if len(merged) > 1 else data, *rest)
+
+    # ------------------------------------------------ queries
+    def param_spec(self, name):
+        """Mesh-legal spec for a parameter (replicated when unknown)."""
+        return self._param_specs.get(name, PS())
+
+    def opt_spec(self, name):
+        """Mesh-legal spec for a parameter's optimizer-state leaves."""
+        return self._opt_specs.get(name, self.param_spec(name))
+
+    def batch_spec(self, name):
+        """Spec for an input batch array: data-axis row sharding with the
+        naive fallback when the shape is known and does not divide."""
+        shape = self.batch_shapes.get(name)
+        if shape is not None:
+            return naive_spec(shape, self.mesh_ctx)
+        return self.layout.activations()
+
+    def sharding(self, spec):
+        return NamedSharding(self.mesh, spec)
+
+    def sharded_opt_names(self):
+        """Names whose optimizer state actually shards over data."""
+        data = self.layout.data_axis
+        out = []
+        for name, spec in self._opt_specs.items():
+            entry = tuple(spec)[0] if tuple(spec) else None
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            if data in axes:
+                out.append(name)
+        return out
+
+    # ------------------------------------------------ introspection
+    def validate(self):
+        """Structured issues for the ``sharding_consistency`` pass:
+        [{"kind", "name", "message"}]. ``axis_typo`` and ``rank_mismatch``
+        are author errors; ``replicated_fallback`` records dims that
+        wanted sharding but could not get it."""
+        issues = []
+        for name, (raw, final, reasons) in sorted(self.decisions.items()):
+            overridden = name in self.overrides
+            for rkind, msg in reasons:
+                if rkind == "axis":
+                    # only an author-written override can be a TYPO; a
+                    # heuristic naming axes this mesh lacks is the
+                    # normal prune path — likewise for rank below
+                    kind = "axis_typo" if overridden else "axis_absent"
+                elif rkind == "rank":
+                    kind = "rank_mismatch" if overridden else "rank_pruned"
+                else:
+                    kind = "replicated_fallback"
+                issues.append({"kind": kind, "name": name,
+                               "raw": str(raw), "final": str(final),
+                               "message": msg})
+        return issues
+
+    def describe(self):
+        """JSON-ready summary (docs/debugging/bench provenance)."""
+        return {
+            "mesh": {k: v for k, v in self.mesh_ctx.axis_sizes.items()},
+            "shard_update": self.shard_update,
+            "min_shard_elems": self.min_shard_elems,
+            "params": {n: {"shape": list(self.param_shapes[n]),
+                           "spec": str(self._param_specs[n]),
+                           "opt_spec": str(self._opt_specs[n])}
+                       for n in sorted(self.param_shapes)},
+            "sharded_opt": sorted(self.sharded_opt_names()),
+        }
+
+
+def plan_for_module(module, mesh_ctx, overrides=None):
+    """Build the :class:`ShardingPlan` for a bound, param-initialized
+    Module: shapes from the host param dicts, trainable = params minus
+    ``fixed_param_names``, batch shapes from the bound data/label descs."""
+    arg_params = module._arg_params or {}
+    aux_params = module._aux_params or {}
+    fixed = set(getattr(module, "_fixed_param_names", ()) or ())
+    batch_shapes = {}
+    for d in (module._data_shapes or []) + (module._label_shapes or []):
+        batch_shapes[d.name] = tuple(d.shape)
+    return ShardingPlan(
+        mesh_ctx,
+        {n: v.shape for n, v in arg_params.items()},
+        data_names=list(module._data_names),
+        label_names=list(module._label_names),
+        trainable=[n for n in arg_params if n not in fixed],
+        aux_names=list(aux_params),
+        batch_shapes=batch_shapes,
+        overrides=overrides)

@@ -1,0 +1,517 @@
+"""mxtpu.sharding — the SPMD mesh execution layer (docs/sharding.md).
+
+Runs tier-1 on the forced 8-device CPU mesh (conftest). The contracts:
+
+* ``parameter_spec_from_name`` heuristics match the golden table for the
+  mlp/lenet/lstm fixture params (replicated-bias + unknown-fallback rows
+  included);
+* ``Module.fit(mesh=...)`` trains the mlp fixture to metric parity with
+  the single-device fused path: EXACT for integer-summed metrics,
+  <=1e-5 cross-entropy drift (batch sharding reorders the gradient
+  reduction, nothing else);
+* cross-replica weight-update sharding really shards: optimizer state
+  lives 1/n-per-chip (plus the replicated small-state overhead), and the
+  diagnostics ledger's ``shard_bytes`` view reports it — replicated
+  params at full size on EVERY device, sharded optimizer state only its
+  shard;
+* the ``sharding_consistency`` pass fails plan bugs at ``Module.check()``;
+* KVStore 'local'/'device' push/pull ride mesh collectives when a mesh
+  is active, bit-matching the legacy host merge loop.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import mxtpu as mx
+from mxtpu import metric as M
+from mxtpu import sharding as sh
+from mxtpu import sym
+from mxtpu.models import mlp as _mlp
+
+
+@pytest.fixture(autouse=True)
+def _clean_mesh():
+    """No test may leak an active mesh (or MXTPU_MESH) into the suite."""
+    yield
+    sh.deactivate()
+    os.environ.pop("MXTPU_MESH", None)
+
+
+# --------------------------------------------------------------- heuristics
+#: the golden table (satellite): fixture param name -> raw heuristic spec.
+#: Raw = before plan pruning; on the 1-D data mesh every fsdp/tp entry
+#: prunes to replication and only opt-state/batch specs use 'data'.
+_GOLDEN = {
+    # mlp fixture (models/mlp.py)
+    "fc1_weight": P("fsdp", "tp"),
+    "fc1_bias": P(),                        # replicated-bias rule
+    "fc2_weight": P("fsdp", "tp"),
+    "fc2_bias": P(),
+    "fc3_weight": P("fsdp", "tp"),
+    "fc3_bias": P(),
+    # lenet fixture (models/lenet.py)
+    "conv1_weight": P("fsdp", "tp"),
+    "conv1_bias": P(),
+    "conv2_weight": P("fsdp", "tp"),
+    "conv2_bias": P(),
+    # lstm LM fixture (examples/rnn/lstm_bucketing.py shape)
+    "embed_weight": P(("fsdp", "tp"), None),  # embedding rule
+    "lstm_l0_i2h_weight": P("fsdp", "tp"),    # projection rule
+    "lstm_l0_i2h_bias": P(),
+    "lstm_l0_h2h_weight": P("fsdp", "tp"),
+    "lstm_l0_h2h_bias": P(),
+    "pred_weight": P("fsdp", "tp"),
+    "pred_bias": P(),
+    # batch-norm stats replicate
+    "bn0_gamma": P(),
+    "bn0_beta": P(),
+    "bn0_moving_mean": P(),
+    "bn0_moving_var": P(),
+    # unknown-name fallback: replicate (sharding can break an unknown
+    # param, replication cannot)
+    "mystery_state": P(),
+    "rho": P(),
+    # out-projections are row-parallel (checked BEFORE the 'attn'
+    # input-projection key, which such names also contain)
+    "self_attn.o_proj.weight": P("fsdp", None),
+    "transformer_h0_attn_qkv_weight": P("fsdp", "tp"),
+}
+
+
+def _lstm_fixture_symbol():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(num_hidden=8, prefix="lstm_l0_"))
+    data = sym.Variable("data")
+    embed = sym.Embedding(data, input_dim=20, output_dim=8, name="embed")
+    outputs, _ = stack.unroll(4, inputs=embed, merge_outputs=True)
+    net = sym.Reshape(outputs, shape=(-1, 8))
+    net = sym.FullyConnected(net, num_hidden=20, name="pred")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_parameter_spec_golden_table():
+    for name, want in _GOLDEN.items():
+        got = sh.parameter_spec_from_name(name)
+        assert got == want, "%s: %s != golden %s" % (name, got, want)
+    # the table is honest: every non-synthetic row is a REAL fixture
+    # parameter name
+    from mxtpu.models import lenet as _lenet
+    real = set(_mlp.get_symbol(10).list_arguments()) \
+        | set(_lenet.get_symbol(10).list_arguments()) \
+        | set(_lstm_fixture_symbol().list_arguments())
+    synthetic = {"bn0_gamma", "bn0_beta", "bn0_moving_mean",
+                 "bn0_moving_var", "mystery_state", "rho",
+                 "self_attn.o_proj.weight",
+                 "transformer_h0_attn_qkv_weight"}
+    for name in set(_GOLDEN) - synthetic:
+        assert name in real, "golden row %s is not a fixture param" % name
+
+
+def test_mesh_context_forms():
+    n = len(jax.local_devices())
+    assert n >= 8, "conftest must force an 8-device CPU mesh"
+    assert sh.MeshContext.create("all").axis_sizes == {"data": n}
+    assert sh.MeshContext.create(8).axis_sizes == {"data": 8}
+    assert sh.MeshContext.create("4x2").axis_sizes == {"data": 4, "tp": 2}
+    assert sh.MeshContext.create("data:2,tp:4").axis_sizes == \
+        {"data": 2, "tp": 4}
+    raw = Mesh(np.asarray(jax.local_devices()[:4]), ("data",))
+    mc = sh.MeshContext.create(raw)
+    assert mc.mesh is raw and mc.n_data == 4
+    assert sh.MeshContext.create(mc) is mc
+    with pytest.raises(mx.MXNetError):
+        sh.MeshContext.create("definitely-not-a-mesh")
+    with pytest.raises(mx.MXNetError):
+        sh.MeshContext.create(10 ** 6)
+
+
+def test_plan_weight_update_specs():
+    mc = sh.MeshContext.create(8)
+    shapes = {"fc1_weight": (128, 784), "fc1_bias": (128,),
+              "fc2_weight": (64, 128), "fc2_bias": (64,),
+              "fc3_weight": (10, 64), "fc3_bias": (10,)}
+    plan = sh.ShardingPlan(mc, shapes, data_names=["data"],
+                           label_names=["softmax_label"],
+                           batch_shapes={"data": (64, 784),
+                                         "softmax_label": (64,)})
+    # params replicate on a data-only mesh (fsdp/tp prune away) ...
+    for name in shapes:
+        assert plan.param_spec(name) == P(), name
+    # ... but the big optimizer states shard over 'data' (weight-update
+    # sharding); dim0=10 doesn't divide by 8 and biases are under the
+    # min-size floor -> replicated ("+ replication overhead")
+    assert plan.opt_spec("fc1_weight") == P("data")
+    assert plan.opt_spec("fc2_weight") == P("data")
+    assert plan.opt_spec("fc3_weight") == P()
+    assert plan.opt_spec("fc1_bias") == P()
+    assert sorted(plan.sharded_opt_names()) == ["fc1_weight", "fc2_weight"]
+    # batch shards over data; the naive fallback replicates what can't
+    assert plan.batch_spec("data") == P("data")
+    assert sh.naive_spec((30, 16), mc) == P()      # 30 % 8 != 0
+    assert sh.naive_spec((64, 16), mc) == P("data")
+    # MXTPU_SHARD_UPDATE=0 keeps everything on the param specs
+    plan_off = sh.ShardingPlan(mc, shapes, shard_update=False)
+    assert plan_off.opt_spec("fc1_weight") == P()
+    assert plan_off.sharded_opt_names() == []
+
+
+def test_mesh_resolution_and_env(monkeypatch):
+    assert sh.resolve(None) is None                 # nothing decided
+    monkeypatch.setenv("MXTPU_MESH", "8")
+    assert sh.resolve(None).axis_sizes == {"data": 8}
+    assert sh.current().axis_sizes == {"data": 8}   # env fallback
+    assert sh.resolve(False) is sh.DISABLED         # explicit off wins
+    with sh.use(sh.DISABLED):
+        assert sh.current() is None                 # env suppressed
+    monkeypatch.setenv("MXTPU_MESH", "none")
+    assert sh.resolve(None) is None
+    mc = sh.MeshContext.create(4)
+    with sh.use(mc):
+        assert sh.active() is mc and sh.current() is mc
+    assert sh.active() is None
+
+
+# ------------------------------------------------------------------ training
+def _mnist_like(n=256, seed=7):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(n, 784).astype("float32"),
+            rng.randint(0, 10, n).astype("float32"))
+
+
+def _fit_mlp(mesh, num_epoch=2, seed=11, batch_size=64):
+    X, y = _mnist_like()
+    it = mx.io.NDArrayIter(X, y, batch_size=batch_size,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(_mlp.get_symbol(10), context=mx.cpu())
+    metric = M.create(["acc", "ce"])
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    mod.fit(it, num_epoch=num_epoch, eval_metric=metric, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(), mesh=mesh)
+    weights = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    return dict(metric.get_name_value()), weights, mod
+
+
+def test_fit_mesh_parity_mlp():
+    """THE acceptance gate: 8-way SPMD fit == single-device fused fit.
+
+    Integer-summed metrics exact; cross-entropy within 1e-5 (f32
+    reduction order is the only difference); optimizer state lives
+    sharded; per-chip optimizer bytes <= 1/8 of the total + the
+    replicated small-state overhead, as reported by the ledger."""
+    m_one, w_one, _ = _fit_mlp(mesh=False)
+    m_mesh, w_mesh, mod = _fit_mlp(mesh=8)
+
+    assert mod._fused is not None and mod._fused._plan is not None, \
+        "fit(mesh=8) did not arm the sharded fused step"
+    assert m_one["accuracy"] == m_mesh["accuracy"], (m_one, m_mesh)
+    np.testing.assert_allclose(m_one["cross-entropy"],
+                               m_mesh["cross-entropy"], rtol=1e-5)
+    for k in w_one:
+        np.testing.assert_allclose(
+            w_one[k], w_mesh[k], rtol=1e-4, atol=1e-5,
+            err_msg="weights diverged at %s" % k)
+
+    fused = mod._fused
+    # optimizer state genuinely sharded over the data axis
+    st = fused.opt_state["fc1_weight"]
+    leaf = jax.tree.leaves(st)[0]
+    assert leaf.sharding.spec == P("data"), leaf.sharding.spec
+    assert len(leaf.sharding.device_set) == 8
+
+    # per-chip optimizer memory: shard + replicated overhead
+    opt_total = sum(x.nbytes for x in jax.tree.leaves(fused.opt_state))
+    repl_overhead = sum(
+        x.nbytes for n in fused.trainable
+        for x in jax.tree.leaves(fused.opt_state[n])
+        if n not in fused._plan.sharded_opt_names())
+    per_dev = {}
+    for x in jax.tree.leaves(fused.opt_state):
+        for s in x.addressable_shards:
+            key = "cpu(%d)" % s.device.id
+            per_dev[key] = per_dev.get(key, 0) + s.data.nbytes
+    assert len(per_dev) == 8
+    for ctx, nbytes in per_dev.items():
+        assert nbytes <= opt_total // 8 + repl_overhead, \
+            (ctx, nbytes, opt_total, repl_overhead)
+
+    # the ledger agrees: fused_step bytes exist on every device and the
+    # totals match params(replicated everywhere) + aux + opt shard
+    led = mx.diagnostics.ledger()
+    view = led.shard_bytes(origin="fused_step")
+    params_bytes = sum(v.nbytes for v in fused.params.values())
+    aux_bytes = sum(v.nbytes for v in fused.aux.values())
+    for ctx, nbytes in per_dev.items():
+        assert view.get(ctx, 0) >= params_bytes + aux_bytes + nbytes, \
+            (ctx, view.get(ctx), params_bytes, nbytes)
+
+    # program table saw the SPMD program: 8 devices, sharded args
+    rec = mx.diagnostics.latest_record("fused_step")
+    if rec is not None and mx.diagnostics.cost_enabled():
+        assert rec.n_devices == 8
+        assert rec.sharded_args > 0
+
+    # and the module audits clean (donation + sharding_consistency)
+    with sh.use(fused._plan.mesh_ctx):
+        report = mod.check()
+    assert report.ok, report.to_dict()
+
+
+def test_shard_bytes_ledger_view():
+    """Satellite: the ledger's shard_bytes view proves the memory shape
+    of weight-update sharding — replicated params cost their FULL size
+    on every one of the 8 devices, sharded optimizer state only 1/8
+    (plus replicated small states)."""
+    _, _, mod = _fit_mlp(mesh=8, num_epoch=1)
+    fused = mod._fused
+    led = mx.diagnostics.ledger()
+    view = led.shard_bytes(origin="fused_step")
+    assert len([c for c in view if view[c]]) == 8, view
+
+    params_bytes = sum(v.nbytes for v in fused.params.values())
+    aux_bytes = sum(v.nbytes for v in fused.aux.values())
+    sharded = set(fused._plan.sharded_opt_names())
+    opt_sharded = sum(x.nbytes for n in sharded
+                      for x in jax.tree.leaves(fused.opt_state[n]))
+    opt_repl = sum(x.nbytes for n in fused.trainable if n not in sharded
+                   for x in jax.tree.leaves(fused.opt_state[n]))
+    expect = params_bytes + aux_bytes + opt_repl + opt_sharded // 8
+    for ctx, nbytes in view.items():
+        assert nbytes == expect, (ctx, nbytes, expect)
+    # sanity: the same state UNSHARDED would cost the full opt total per
+    # chip — the win is real and ~linear in the replica count
+    assert expect < params_bytes + aux_bytes + opt_repl + opt_sharded
+
+
+def test_consistency_pass_catches_plan_bugs():
+    """Satellite: sharding_consistency fails plan bugs at Module.check()
+    instead of inside jit."""
+    from mxtpu import analysis as an
+    _, _, mod = _fit_mlp(mesh=8, num_epoch=1)
+    fused = mod._fused
+    plan = fused._plan
+    with sh.use(plan.mesh_ctx):
+        assert mod.check().ok
+
+        # (a) axis-name typo in an override -> ERROR
+        typo = sh.ShardingPlan(
+            plan.mesh_ctx, plan.param_shapes,
+            data_names=plan.data_names, label_names=plan.label_names,
+            overrides={"fc1_weight": P("dtaa", None)})
+        fused._plan = typo
+        rep = mod.check(passes=["sharding_consistency"])
+        assert not rep.ok
+        assert any(f.severity == an.ERROR and "dtaa" in f.message
+                   for f in rep.findings), rep.to_dict()
+
+        # (b) spec rank > param rank -> ERROR
+        fused._plan = sh.ShardingPlan(
+            plan.mesh_ctx, plan.param_shapes,
+            overrides={"fc1_bias": P(None, None, "data")})
+        rep = mod.check(passes=["sharding_consistency"])
+        assert any("rank" in f.message for f in rep.errors), rep.to_dict()
+
+        # (c) unsharded-param-on-mesh: state re-staged replicated behind
+        # the plan's back -> ERROR
+        fused._plan = plan
+        good = fused.opt_state["fc1_weight"]
+        fused.opt_state["fc1_weight"] = jax.tree.map(
+            lambda t: fused._put(np.asarray(t), P()), good)
+        rep = mod.check(passes=["sharding_consistency"])
+        assert any("behind the plan" in f.message for f in rep.errors), \
+            rep.to_dict()
+        fused.opt_state["fc1_weight"] = good
+
+    # (d) mesh active but plan declined (indivisible batch) -> WARNING
+    mc = sh.MeshContext.create(8)
+    with sh.use(mc):
+        m, _, mod2 = _fit_mlp(mesh=None, num_epoch=1, batch_size=60)
+        assert mod2._fused is not None and mod2._fused._plan is None
+        rep = mod2.check(passes=["sharding_consistency"])
+        assert any("WITHOUT a sharding plan" in f.message
+                   for f in rep.findings), rep.to_dict()
+        assert not rep.ok
+
+
+def test_mxtpu_mesh_env_arms_the_plan(monkeypatch):
+    monkeypatch.setenv("MXTPU_MESH", "8")
+    _, _, mod = _fit_mlp(mesh=None, num_epoch=1)
+    assert mod._fused is not None and mod._fused._plan is not None
+    assert len(mod._fused.devices) == 8
+    # explicit mesh=False beats the env
+    _, _, mod2 = _fit_mlp(mesh=False, num_epoch=1)
+    assert mod2._fused is not None and mod2._fused._plan is None
+
+
+def test_kvstore_mesh_veneer_matches_host_loop():
+    """KVStore 'device' push/pull as a veneer over mesh collectives: the
+    aggregate bit-matches the legacy host merge, pull hands each device
+    its own shard zero-copy, and the collective counter moves."""
+    from mxtpu import telemetry as tel
+    rng = np.random.RandomState(3)
+    host_vals = [rng.randn(16, 5).astype("f4") for _ in range(8)]
+    expect = np.sum(host_vals, axis=0)
+
+    def push_pull(kv):
+        vals = [mx.nd.array(v, ctx=mx.Context("cpu", i))
+                for i, v in enumerate(host_vals)]
+        kv.init("w", mx.nd.zeros((16, 5)))
+        kv.push("w", vals)
+        outs = [mx.nd.zeros((16, 5), ctx=mx.Context("cpu", i))
+                for i in range(8)]
+        kv.pull("w", out=outs)
+        return outs
+
+    legacy = push_pull(mx.kv.create("device"))
+    before = tel.counter("kvstore_mesh_allreduce").value
+    with sh.use(sh.MeshContext.create("all")):
+        mesh_outs = push_pull(mx.kv.create("device"))
+    assert tel.counter("kvstore_mesh_allreduce").value == before + 1
+    for i, (a, b) in enumerate(zip(legacy, mesh_outs)):
+        np.testing.assert_allclose(a.asnumpy(), expect, rtol=1e-6)
+        np.testing.assert_allclose(b.asnumpy(), a.asnumpy(), rtol=1e-6,
+                                   err_msg="device %d" % i)
+        devs = b._data.devices()
+        assert len(devs) == 1 and next(iter(devs)).id == i
+
+
+def test_kvstore_veneer_declines_multi_axis_mesh():
+    """The row-shard all-reduce trick is only shape-correct on a 1-D
+    data mesh: under a data×tp mesh the veneer must FALL BACK to the
+    host merge loop (correct values, no collective) instead of handing
+    jax mis-shaped shards."""
+    from mxtpu import telemetry as tel
+    host_vals = [np.full((8, 3), i + 1.0, "f4") for i in range(8)]
+    before = tel.counter("kvstore_mesh_allreduce").value
+    with sh.use(sh.MeshContext.create("data:4,tp:2")):
+        kv = mx.kv.create("device")
+        vals = [mx.nd.array(v, ctx=mx.Context("cpu", i))
+                for i, v in enumerate(host_vals)]
+        kv.init("w", mx.nd.zeros((8, 3)))
+        kv.push("w", vals)
+        out = mx.nd.zeros((8, 3))
+        kv.pull("w", out=out)
+    assert tel.counter("kvstore_mesh_allreduce").value == before
+    np.testing.assert_allclose(out.asnumpy(), np.sum(host_vals, axis=0),
+                               rtol=1e-6)
+
+
+def test_env_mesh_context_is_cached():
+    """current()/from_env() must return a STABLE MeshContext per env
+    value — downstream jit caches key on the mesh object."""
+    os.environ["MXTPU_MESH"] = "8"
+    try:
+        assert sh.from_env() is sh.from_env()
+        assert sh.current().mesh is sh.current().mesh
+    finally:
+        del os.environ["MXTPU_MESH"]
+
+
+def test_placement_overlap_needs_group2ctx():
+    """ctx-group TAGS alone place nothing; the two-placement-systems
+    warning fires only when a group2ctx map is actually provided."""
+    from mxtpu.analysis.passes import PassContext, ShardingConsistencyPass
+    with mx.AttrScope(ctx_group="g1"):
+        a = sym.Variable("a")
+        net = sym.FullyConnected(a, num_hidden=4, name="fca")
+    with mx.AttrScope(ctx_group="g2"):
+        net = sym.FullyConnected(net, num_hidden=2, name="fcb")
+    p = ShardingConsistencyPass()
+    assert p._placement_overlap(PassContext(net), None) == []
+    fired = p._placement_overlap(
+        PassContext(net, group2ctx={"g1": mx.cpu(0)}), None)
+    assert fired and "two" in fired[0].message
+
+
+def test_active_mesh_is_per_thread():
+    """Concurrent fits must not see each other's mesh: the active slot
+    is a contextvar, so a sibling thread reads None while this thread's
+    scope is active."""
+    import threading
+    seen = {}
+    with sh.use(sh.MeshContext.create(8)):
+        t = threading.Thread(
+            target=lambda: seen.setdefault("peer", sh.active()))
+        t.start(); t.join()
+        assert sh.active() is not None
+    assert seen["peer"] is None
+
+
+def test_resolve_disable_vocabulary_matches_env():
+    """Every string from_env() treats as 'off' must also disable as a
+    fit(mesh=...) argument instead of raising."""
+    for tok in ("0", "none", "off", "false"):
+        assert sh.resolve(tok) is sh.DISABLED, tok
+    assert sh.resolve(False) is sh.DISABLED
+
+
+def test_heuristic_rank_prune_is_not_an_error():
+    """A 1-D param whose NAME matches a matrix heuristic (spec rank >
+    param rank, no override) is the normal prune path — info-free, and
+    Module.check must not error on it; the same mismatch in an explicit
+    override stays an error (test_consistency_pass_catches_plan_bugs)."""
+    plan = sh.ShardingPlan(sh.MeshContext.create(8),
+                           {"scale_weight": (7,)})
+    assert plan.param_spec("scale_weight") == P()
+    kinds = {i["kind"] for i in plan.validate()}
+    assert "rank_mismatch" not in kinds
+    assert "rank_pruned" in kinds
+
+
+def test_parallel_current_mesh_one_truth(monkeypatch):
+    """parallel/ consumers and the sharding layer resolve the SAME
+    ambient mesh, most-explicit first: active scope > make_mesh'd
+    module mesh > MXTPU_MESH > lazy default."""
+    import mxtpu.parallel.mesh as pmesh
+    mc = sh.MeshContext.create("data:4,tp:2")
+    with sh.use(mc):
+        assert pmesh.current_mesh() is mc.mesh   # active scope wins
+    # an explicit make_mesh (e.g. a (dp, sp) mesh for ring_attention)
+    # must NOT be shadowed by the env's 1-D mesh
+    monkeypatch.setenv("MXTPU_MESH", "4")
+    made = pmesh.make_mesh(shape=(4, 2), axis_names=("data", "seq"))
+    assert pmesh.current_mesh() is made
+    # with no explicit mesh anywhere, the env decides
+    monkeypatch.setattr(pmesh, "_current", None)
+    assert pmesh.current_mesh() is sh.from_env().mesh
+    monkeypatch.delenv("MXTPU_MESH")
+    monkeypatch.setattr(pmesh, "_current", None)
+    assert pmesh.current_mesh() is not None      # lazy default intact
+
+
+def test_opt_state_checkpoint_roundtrip_stays_sharded(tmp_path):
+    """Optimizer-state restore under a mesh re-stages on the plan's
+    weight-update sharding specs — a replicated restore would void the
+    per-chip memory split and trip the consistency pass."""
+    _, _, mod = _fit_mlp(mesh=8, num_epoch=1)
+    fused = mod._fused
+    path = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(path)
+    mod.load_optimizer_states(path)
+    leaf = jax.tree.leaves(fused.opt_state["fc1_weight"])[0]
+    assert leaf.sharding.spec == P("data"), leaf.sharding.spec
+    with sh.use(fused._plan.mesh_ctx):
+        assert mod.check().ok
+
+
+def test_kvstore_updater_path_survives_mesh():
+    """update_on_kvstore semantics under the veneer: the updater sees a
+    single-device view of the mesh aggregate and the stored weight stays
+    correct."""
+    opt = mx.optimizer.SGD(learning_rate=0.5, rescale_grad=1.0)
+    with sh.use(sh.MeshContext.create("all")):
+        kv = mx.kv.create("device")
+        kv.set_optimizer(opt)
+        kv.init("3", mx.nd.ones((4, 4)))
+        grads = [mx.nd.array(np.full((4, 4), 1.0, "f4"),
+                             ctx=mx.Context("cpu", i)) for i in range(8)]
+        kv.push("3", grads)
+        out = mx.nd.zeros((4, 4))
+        kv.pull("3", out=out)
+    # w = 1 - 0.5 * sum(grads) = 1 - 0.5 * 8
+    np.testing.assert_allclose(out.asnumpy(), 1.0 - 0.5 * 8.0, rtol=1e-6)

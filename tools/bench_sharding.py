@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Benchmark: SPMD mesh data-parallel scaling of the fused Module.fit step.
+
+Trains the mlp fixture 1-, 2-, 4- and 8-way (``Module.fit(mesh=n)`` on
+the forced multi-device CPU mesh) at a FIXED per-replica batch, so
+perfect data parallelism means flat step time while samples/step grows
+linearly. Per way-count, reports:
+
+  * warm steps/s and samples/s (min-over-trials, the PR 2 min-vs-min
+    convention — scheduler noise is strictly additive);
+  * per-chip optimizer-state bytes from the diagnostics ledger's
+    ``shard_bytes`` view and from the state arrays themselves — the
+    cross-replica weight-update sharding memory win, which is EXACT and
+    noise-free (the deterministic verdict on hosts where wall-clock
+    scaling is meaningless);
+  * the SPMD program shape from the diagnostics program registry
+    (devices spanned, sharded-vs-replicated arg leaves).
+
+CPU-host caveat, recorded in the JSON: the virtual 8-device CPU mesh
+multiplexes 2 physical cores, so n-way "scaling" wall-clock is
+structurally capped near 1x and may go BELOW 1x (n programs contending
+for 2 cores) — on real multi-chip hardware the batch shards across
+distinct chips. The memory accounting columns do not have this caveat;
+they measure the same thing a TPU pod would.
+
+Writes BENCH_sharding.json.
+Usage: python tools/bench_sharding.py [--trials 4] [--out ...]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+import mxtpu as mx  # noqa: E402
+from mxtpu import metric as M  # noqa: E402
+from mxtpu import sharding as sh  # noqa: E402
+from mxtpu.models import mlp as _mlp  # noqa: E402
+
+PER_REPLICA_BATCH = 64
+BATCHES_PER_EPOCH = 24
+
+
+def _fit_once(n_way, epochs=1, seed=11):
+    """One fit at n_way replicas; returns (mod, wall_s, n_samples)."""
+    batch = PER_REPLICA_BATCH * n_way
+    n = batch * BATCHES_PER_EPOCH
+    rng = np.random.RandomState(7)
+    X = rng.rand(n, 784).astype("float32")
+    y = rng.randint(0, 10, n).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=batch,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(_mlp.get_symbol(10), context=mx.cpu())
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    mesh = n_way if n_way > 1 else False
+    t0 = time.perf_counter()
+    mod.fit(it, num_epoch=epochs, eval_metric=M.create("acc"),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(), mesh=mesh,
+            device_metrics=True, metric_sync=0)
+    # drain the in-flight pipeline before stopping the clock
+    jax.block_until_ready(jax.tree_util.tree_leaves(mod._fused.params))
+    return mod, time.perf_counter() - t0, n * epochs
+
+
+def _opt_memory(mod):
+    """(total_bytes, per_chip_bytes{ctx}, ledger_view{ctx}) for the
+    optimizer state — exact, from shard metadata + the ledger."""
+    fused = mod._fused
+    total = sum(x.nbytes for x in jax.tree_util.tree_leaves(
+        fused.opt_state))
+    per_dev = {}
+    for x in jax.tree_util.tree_leaves(fused.opt_state):
+        for s in x.addressable_shards:
+            key = "cpu(%d)" % s.device.id
+            per_dev[key] = per_dev.get(key, 0) + s.data.nbytes
+    view = mx.diagnostics.ledger().shard_bytes(origin="fused_step")
+    return total, per_dev, view
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=4)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_sharding.json"))
+    args = ap.parse_args(argv)
+
+    ways = [1, 2, 4, 8]
+    results = {}
+    for n_way in ways:
+        _fit_once(n_way)                      # cold: compile
+        best = float("inf")
+        for _ in range(args.trials):
+            mod, wall, n_samples = _fit_once(n_way)
+            best = min(best, wall)
+        steps = BATCHES_PER_EPOCH
+        opt_total, per_dev, view = _opt_memory(mod)
+        rec = mx.diagnostics.latest_record("fused_step")
+        chip0 = per_dev.get("cpu(0)", opt_total)
+        results[str(n_way)] = {
+            "global_batch": PER_REPLICA_BATCH * n_way,
+            "warm_steps_per_sec": round(steps / best, 2),
+            "warm_samples_per_sec": round(n_samples / best, 1),
+            "opt_state_bytes_total": opt_total,
+            "opt_state_bytes_per_chip": chip0,
+            "opt_state_per_chip_frac": round(chip0 / opt_total, 4),
+            "ledger_fused_step_bytes_per_chip":
+                view.get("cpu(0)", 0),
+            "program_devices": getattr(rec, "n_devices", 1)
+                if rec else None,
+            "program_sharded_args": getattr(rec, "sharded_args", 0)
+                if rec else None,
+        }
+        print("%d-way: %.1f steps/s, opt/chip %d/%d (%.3f)" % (
+            n_way, results[str(n_way)]["warm_steps_per_sec"], chip0,
+            opt_total, chip0 / opt_total))
+
+    base = results["1"]["warm_samples_per_sec"]
+    out = {
+        "fixture": "mlp",
+        "per_replica_batch": PER_REPLICA_BATCH,
+        "batches_per_epoch": BATCHES_PER_EPOCH,
+        "trials": args.trials,
+        "ways": results,
+        "samples_per_sec_scaling_vs_1way": {
+            k: round(v["warm_samples_per_sec"] / base, 3)
+            for k, v in results.items()},
+        "opt_memory_verdict": {
+            "8way_per_chip_frac": results["8"]["opt_state_per_chip_frac"],
+            "target": "<= 1/8 + replicated small-state overhead",
+            "pass": results["8"]["opt_state_per_chip_frac"] < 0.25,
+        },
+        "caveat": "virtual 8-device CPU mesh on a shared-core host: "
+                  "wall-clock scaling is structurally capped (n programs "
+                  "contend for the same physical cores); the per-chip "
+                  "optimizer memory columns are exact and carry the "
+                  "verdict, per the bench_telemetry/bench_pipeline "
+                  "deterministic-microbench convention",
+        "n_physical_cores": os.cpu_count(),
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print("wrote", args.out)
+    return out
+
+
+if __name__ == "__main__":
+    main()
