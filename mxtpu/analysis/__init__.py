@@ -1,25 +1,39 @@
-"""mxtpu.analysis — static graph verification + runtime numerics sanitizer.
+"""mxtpu.analysis — graph verification, dataflow analyses, transform
+passes, runtime numerics sanitizer.
 
-The framework's L5 layer is a graph IR; until this package, mxtpu only
-*ran* graphs — nothing statically checked them, and binding mistakes
-surfaced as late, low-context failures. Three parts:
+The framework's L5 layer is a graph IR; this package both *checks* and
+— since the compile pipeline (:mod:`mxtpu.compile`) — *changes* it,
+under a static-analysis contract. Five parts:
 
 * **graph passes** (:mod:`~mxtpu.analysis.passes`): a registry of
   :class:`GraphPass` verifiers driven by :func:`analyze`, returning
   structured :class:`Finding`\\ s (severity, node, provenance, fix
   hint). Surfaced as ``Symbol.lint()``, ``Module.check()`` and
   ``python -m mxtpu.analysis model.json``.
+* **dataflow analyses** (:mod:`~mxtpu.analysis.dataflow`): lattice
+  walks over the Symbol DAG computing per-node fact tables that license
+  transforms — :func:`precision_flow` (bf16-safe / f32-island /
+  master-weight classification) and :func:`liveness` (last-use,
+  peak-live-bytes, ledger cross-check).
+* **transform passes** (:mod:`~mxtpu.analysis.rewrite`): registered
+  :class:`TransformPass` graph rewrites run by the compile pipeline;
+  each must be licensed by a dataflow fact and is re-proven by the
+  verifier suite before it may compile (a failing rewrite is rejected
+  with the offending Finding). First transform: the ``bf16``
+  mixed-precision rewrite with f32 master weights.
 * **numerics sanitizer** (:mod:`~mxtpu.analysis.sanitizer`):
   ``MXTPU_SANITIZE=nan|inf|all`` wraps every built program's outputs in
-  device-side NaN/Inf checks; a trip emits a diagnostics postmortem
-  (``source="sanitizer"``) and raises :class:`NumericsError`. Strictly
-  zero overhead when unset.
+  device-side NaN/Inf checks (bf16 leaves upcast before the check); a
+  trip emits a diagnostics postmortem (``source="sanitizer"``, naming
+  the precision mode) and raises :class:`NumericsError`. Strictly zero
+  overhead when unset.
 * **codebase lint** (``tools/mxtpu_lint.py``): the CI-enforced AST lint
   for implicit device→host syncs in hot-path modules, lock-order
-  inversions against the declared hierarchy, and unjoined threads.
+  inversions against the declared hierarchy, unjoined threads, and
+  silent f64 promotion.
 
-See docs/analysis.md for the pass catalog, the Finding schema, the
-sanitizer env vars and the declared lock hierarchy.
+See docs/analysis.md for the pass/analysis catalogs and the Finding
+schema; docs/compile.md for the transform contract and the pipeline.
 """
 from __future__ import annotations
 
@@ -31,6 +45,11 @@ from .sanitizer import enable as sanitizer_enable
 from .sanitizer import mode as sanitizer_mode
 from .sanitizer import sanitize_tree
 from . import provenance
+from . import dataflow
+from .dataflow import liveness, precision_flow
+from . import rewrite
+from .rewrite import (TransformPass, get_transform, list_transforms,
+                      register_transform)
 
 __all__ = [
     "Finding", "Report", "ERROR", "WARNING", "INFO", "SEVERITIES",
@@ -38,4 +57,7 @@ __all__ = [
     "analyze", "analyze_json", "check_module",
     "NumericsError", "sanitizer_enable", "sanitizer_disable",
     "sanitizer_mode", "sanitize_tree", "provenance",
+    "dataflow", "precision_flow", "liveness",
+    "rewrite", "TransformPass", "register_transform", "get_transform",
+    "list_transforms",
 ]

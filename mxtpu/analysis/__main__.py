@@ -38,21 +38,31 @@ def main(argv=None):
                     help="input shape hint (repeatable)")
     ap.add_argument("--passes", default=None,
                     help="comma-separated subset of passes to run")
+    ap.add_argument("--pipeline", default=None, metavar="NAMES",
+                    help="dry-run compile-pipeline transform passes "
+                         "(comma-separated registry names, e.g. bf16) "
+                         "and report what each did and why — per-node "
+                         "provenance, verifier re-run, rejections")
     ap.add_argument("--json", action="store_true",
                     help="emit the report as JSON instead of text")
     args = ap.parse_args(argv)
 
-    from . import analyze_json, list_passes, sanitizer_mode
+    from . import analyze_json, list_passes, list_transforms, sanitizer_mode
 
     if args.graph is None:
         passes = list_passes()
         print("mxtpu.analysis: %d registered passes" % len(passes))
         for name, doc in passes:
             print("  %-16s %s" % (name, doc))
+        transforms = list_transforms()
+        print("compile-pipeline transforms (--pipeline): %d registered"
+              % len(transforms))
+        for name, doc in transforms:
+            print("  %-16s %s" % (name, doc))
         print("sanitizer: MXTPU_SANITIZE=%s"
               % (sanitizer_mode() or "(unset; nan|inf|all)"))
         print("usage: python -m mxtpu.analysis model.json "
-              "[--shape data=1,3,32,32]")
+              "[--shape data=1,3,32,32] [--pipeline bf16]")
         return 0
 
     with open(args.graph) as f:
@@ -61,6 +71,11 @@ def main(argv=None):
         graph_json, shapes=dict(args.shape),
         passes=[p.strip() for p in args.passes.split(",")]
         if args.passes else None)
+    if args.pipeline:
+        from ..symbol import load_json
+        from ..symbol.symbol import _merge_pipeline_report
+        report = _merge_pipeline_report(report, load_json(graph_json),
+                                        dict(args.shape), args.pipeline)
     if args.json:
         print(json.dumps(report.to_dict(), indent=2))
     else:

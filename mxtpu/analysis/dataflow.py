@@ -1,0 +1,414 @@
+"""Dataflow-analysis engine over the Symbol IR: lattice walks that *license*
+graph transforms.
+
+PR 5's verifier passes answer yes/no questions about a graph; the
+transform passes (:mod:`~mxtpu.analysis.rewrite`) need richer facts —
+*which* nodes may compute in bf16, *when* is each intermediate dead.
+This module computes those facts the TVM way (PAPERS.md: "TVM: An
+Automated End-to-End Optimizing Compiler"): an analysis runs first and
+produces a per-node fact table; a rewrite may only do what the table
+licenses; the verifier suite re-proves the result afterwards
+(:func:`mxtpu.compile.pipeline.transform_graph`).
+
+Shapes and dtypes come from the ONE inference walker the whole framework
+shares — :func:`provenance.infer_walk` driving
+``symbol._infer_graph(events=)`` — so an analysis can never disagree
+with what a real bind would have inferred.
+
+Two concrete analyses:
+
+* :func:`precision_flow` — forward classification of every node as
+  **bf16-safe** (matmul-heavy compute + elementwise followers),
+  **f32-island** (dtype-sensitive: reductions, ``exp``/``log``/softmax,
+  loss heads, normalization statistics — the same pattern knowledge the
+  ``numerics`` verifier pass encodes), or — for parameter variables
+  feeding bf16 compute — **master-weight-required** (the value is cast
+  to bf16 at its use sites while the stored parameter, and the
+  optimizer state derived from it, stays f32).
+* :func:`liveness` — backward last-use analysis + a forward sweep that
+  tracks the live set per node and estimates **peak live bytes**; the
+  graph-level analogue of the diagnostics ledger's slot model, and
+  cross-checkable against it (:func:`liveness_ledger_check`).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .findings import INFO, WARNING, Finding
+from . import provenance as _prov
+
+__all__ = ["DataflowAnalysis", "run_analysis", "precision_flow",
+           "PrecisionPlan", "liveness", "LivenessInfo",
+           "liveness_ledger_check",
+           "BF16_SAFE", "F32_ISLAND", "MASTER_WEIGHT"]
+
+
+# ------------------------------------------------------------- generic walker
+class DataflowAnalysis:
+    """One lattice walk over the Symbol DAG.
+
+    Subclasses set ``direction`` ('forward' walks producers before
+    consumers, 'backward' the reverse) and implement
+    ``transfer(node, in_facts, ctx)`` returning the node's fact. The
+    driver (:func:`run_analysis`) hands each op node the facts of its
+    input *entries* (one per ``(producer, out_idx)`` edge) — for a DAG a
+    single pass in (reverse) topological order IS the fixpoint, so there
+    is no worklist iteration to get wrong.
+
+    ``ctx`` carries the shared inference state: ``ctx.shapes`` /
+    ``ctx.dtypes`` keyed exactly like ``_infer_graph``'s output
+    (variable names and ``(id(node), out_idx)`` pairs), plus
+    ``ctx.topo`` and ``ctx.index``.
+    """
+
+    name = None
+    direction = "forward"
+
+    def init_variable(self, node, ctx):
+        """Fact for a variable node (leaves of the forward walk)."""
+        return None
+
+    def transfer(self, node, in_facts, ctx):
+        raise NotImplementedError
+
+
+class _WalkContext:
+    def __init__(self, symbol, shapes, dtypes, topo):
+        self.symbol = symbol
+        self.shapes = shapes
+        self.dtypes = dtypes
+        self.topo = topo
+        self.index = {id(n): i for i, n in enumerate(topo)}
+
+
+def run_analysis(symbol, analysis, shapes=None, types=None):
+    """Drive ``analysis`` over ``symbol``; returns ``(facts, ctx)`` where
+    ``facts`` maps ``id(node)`` to the analysis' per-node fact.
+
+    The shape/dtype substrate is the single shared walker
+    (``provenance.infer_walk`` → ``_infer_graph(events=)``) — partially
+    known graphs degrade to None entries, they never raise."""
+    shp, dt, _events = _prov.infer_walk(symbol, shapes, types)
+    topo = symbol._topo()
+    ctx = _WalkContext(symbol, shp, dt, topo)
+    facts = {}
+    forward = analysis.direction == "forward"
+    consumers = None
+    if not forward:
+        # consumers map built ONCE: the per-node scan would be
+        # O(nodes² × fan-in) on large graphs
+        consumers = {}
+        for n in topo:
+            for s, _ in n.inputs:
+                consumers.setdefault(id(s), []).append(n)
+    order = topo if forward else list(reversed(topo))
+    for node in order:
+        if node.is_variable:
+            facts[id(node)] = analysis.init_variable(node, ctx)
+            continue
+        if forward:
+            in_facts = [(src, idx, facts.get(id(src)))
+                        for src, idx in node.inputs]
+        else:
+            # backward: "inputs" are the node's consumers (their facts
+            # are already computed — reverse topo order)
+            in_facts = [(n, 0, facts.get(id(n)))
+                        for n in consumers.get(id(node), ())]
+        facts[id(node)] = analysis.transfer(node, in_facts, ctx)
+    return facts, ctx
+
+
+# ---------------------------------------------------------- precision flow
+#: node classifications
+BF16_SAFE = "bf16"
+F32_ISLAND = "f32"
+MASTER_WEIGHT = "master"
+
+#: matmul/conv-heavy compute where bf16 inputs engage the TPU MXU — the
+#: nodes the rewrite exists for
+_BF16_COMPUTE = {"Convolution", "Deconvolution", "FullyConnected", "dot",
+                 "batch_dot", "Correlation"}
+
+#: dtype-sensitive ops that must stay f32 islands. Built from the same
+#: pattern knowledge the ``numerics`` verifier pass encodes (its
+#: reduction/division tables are imported, not re-declared) plus the
+#: op registry's own loss_like flag: softmax/exp/log overflow or lose
+#: mass in 8-bit-mantissa bf16, reductions accumulate rounding error
+#: linearly in the reduced extent, and normalization STATISTICS
+#: (mean/var of BatchNorm & friends) feed a rsqrt whose argument must
+#: not quantize.
+_F32_EXPLOG = {"exp", "expm1", "log", "log1p", "log2", "log10",
+               "log_softmax", "softmax", "Softmax", "SoftmaxActivation",
+               "softmax_cross_entropy", "erf", "gamma", "gammaln"}
+_F32_NORMS = {"BatchNorm", "BatchNorm_v1", "InstanceNorm", "LayerNorm",
+              "L2Normalization", "LRN", "norm"}
+_F32_MISC = {"sqrt", "rsqrt", "_power", "_power_scalar", "_rpower_scalar",
+             "_square_sum", "linalg_sumlogdiag", "_linalg_sumlogdiag"}
+
+
+def _sensitive_tables():
+    from .passes import _DIV_OPS, _REDUCTIONS
+    return _F32_EXPLOG | _F32_NORMS | _F32_MISC | _REDUCTIONS | _DIV_OPS
+
+
+class PrecisionPlan:
+    """Result of :func:`precision_flow`.
+
+    ``classes`` maps ``id(node)`` → BF16_SAFE / F32_ISLAND for op nodes;
+    ``var_class`` maps variable NAME → MASTER_WEIGHT (the variable feeds
+    bf16 compute: keep an f32 master copy, cast at use) or F32_ISLAND;
+    ``reasons`` maps ``id(node)`` → a short why-string the rewrite
+    carries into its per-node provenance."""
+
+    def __init__(self, symbol):
+        self.symbol = symbol
+        self.classes = {}
+        self.var_class = {}
+        self.reasons = {}
+
+    @property
+    def n_bf16(self):
+        return sum(1 for c in self.classes.values() if c == BF16_SAFE)
+
+    @property
+    def n_f32(self):
+        return sum(1 for c in self.classes.values() if c == F32_ISLAND)
+
+    @property
+    def n_master(self):
+        return sum(1 for c in self.var_class.values()
+                   if c == MASTER_WEIGHT)
+
+    def class_of(self, node):
+        if node.is_variable:
+            return self.var_class.get(node.name, F32_ISLAND)
+        return self.classes.get(id(node), F32_ISLAND)
+
+    def to_findings(self, pass_name="precision_flow"):
+        """Per-node classification as INFO findings (the ``--pipeline``
+        report surface; same Finding schema as the verifier passes)."""
+        out = []
+        for node in self.symbol._topo():
+            if node.is_variable:
+                cls = self.var_class.get(node.name)
+                if cls == MASTER_WEIGHT:
+                    out.append(Finding(
+                        pass_name, INFO,
+                        "parameter '%s': master-weight-required (feeds "
+                        "bf16 compute; stored f32, cast at use)"
+                        % node.name, node=node.name))
+                continue
+            cls = self.classes.get(id(node), F32_ISLAND)
+            out.append(Finding(
+                pass_name, INFO,
+                "node '%s' (op %s): %s — %s"
+                % (node.name, node.op.name,
+                   "bf16-safe" if cls == BF16_SAFE else "f32-island",
+                   self.reasons.get(id(node), "default")),
+                node=node.name))
+        return out
+
+    def summary(self):
+        return ("precision_flow: %d bf16-safe, %d f32-island node(s), "
+                "%d master-weight parameter(s)"
+                % (self.n_bf16, self.n_f32, self.n_master))
+
+
+class _PrecisionFlow(DataflowAnalysis):
+    """Forward walk: sensitivity seeds at the sensitive ops and follows
+    data edges; bf16 seeds at the matmul compute and follows through
+    insensitive elementwise/shape ops."""
+
+    name = "precision_flow"
+    direction = "forward"
+
+    def __init__(self):
+        self.sensitive = _sensitive_tables()
+        self.reasons = {}
+
+    def init_variable(self, node, ctx):
+        return None  # variables are neutral; classified in a second pass
+
+    def transfer(self, node, in_facts, ctx):
+        op = node.op.name
+        if op in self.sensitive or node.op.loss_like:
+            self.reasons[id(node)] = (
+                "loss head (gradient source must not quantize)"
+                if node.op.loss_like else
+                "dtype-sensitive op '%s' (reduction / exp-log / "
+                "normalization family)" % op)
+            return F32_ISLAND
+        # integer/bool outputs gain nothing and must not be cast
+        out_dt = ctx.dtypes.get((id(node), 0))
+        if out_dt is not None and not _np.issubdtype(out_dt, _np.floating):
+            self.reasons[id(node)] = "non-float output (%s)" % out_dt
+            return F32_ISLAND
+        if op in _BF16_COMPUTE:
+            self.reasons[id(node)] = \
+                "matmul-class compute (MXU-eligible in bf16)"
+            return BF16_SAFE
+        votes = [f for _, _, f in in_facts if f is not None]
+        if votes and all(f == BF16_SAFE for f in votes):
+            srcs = [s.name for s, _, f in in_facts if f == BF16_SAFE]
+            self.reasons[id(node)] = \
+                "follows bf16 producer(s) %s" % ", ".join(srcs[:3])
+            return BF16_SAFE
+        if any(f == F32_ISLAND for f in votes):
+            self.reasons[id(node)] = "an input is an f32 island"
+        else:
+            self.reasons[id(node)] = \
+                "fed only by variables (no bf16 producer to follow)"
+        return F32_ISLAND
+
+
+def precision_flow(symbol, shapes=None, types=None):
+    """Classify every node of ``symbol`` for the bf16 mixed-precision
+    rewrite; returns a :class:`PrecisionPlan`."""
+    ana = _PrecisionFlow()
+    facts, ctx = run_analysis(symbol, ana, shapes=shapes, types=types)
+    plan = PrecisionPlan(symbol)
+    plan.reasons = ana.reasons
+    for node in ctx.topo:
+        if node.is_variable:
+            continue
+        plan.classes[id(node)] = facts.get(id(node)) or F32_ISLAND
+    # variable classification: a parameter whose value is consumed by at
+    # least one bf16 node needs a master-weight discipline (f32 storage,
+    # bf16 cast at use — the fused step's optimizer state then derives
+    # from the f32 master, never the quantized copy)
+    aux = symbol._aux_node_set()
+    for node in ctx.topo:
+        if node.is_variable:
+            continue
+        if plan.classes.get(id(node)) != BF16_SAFE:
+            continue
+        for src, _idx in node.inputs:
+            if src.is_variable and id(src) not in aux:
+                plan.var_class[src.name] = MASTER_WEIGHT
+    for node in ctx.topo:
+        if node.is_variable and node.name not in plan.var_class:
+            plan.var_class[node.name] = F32_ISLAND
+    return plan
+
+
+# --------------------------------------------------------------- liveness
+class LivenessInfo:
+    """Result of :func:`liveness`.
+
+    ``last_use`` maps an entry ``(id(node), out_idx)`` to the topo index
+    of its final consumer (heads count as consumed at the end);
+    ``live_bytes[i]`` is the estimated bytes of all entries live after
+    executing topo node ``i``; ``peak_live_bytes``/``peak_node`` locate
+    the high-water mark. Bytes come from the shared inference walk —
+    entries whose shape did not resolve contribute 0 and flip
+    ``complete`` to False (the estimate is then a lower bound)."""
+
+    def __init__(self):
+        self.last_use = {}
+        self.entry_bytes = {}
+        self.live_bytes = []
+        self.peak_live_bytes = 0
+        self.peak_node = None
+        self.head_bytes = 0
+        self.complete = True
+
+    def live_set_at(self, i):
+        """Entries live after topo step ``i`` (ids, for tests)."""
+        return {e for e, last in self.last_use.items()
+                if self._born[e] <= i < last}
+
+    def to_findings(self, pass_name="liveness"):
+        return [Finding(
+            pass_name, INFO,
+            "peak live %.1f KB at node '%s'%s; graph outputs hold "
+            "%.1f KB" % (self.peak_live_bytes / 1024.0,
+                         self.peak_node or "?",
+                         "" if self.complete
+                         else " (lower bound: some shapes unresolved)",
+                         self.head_bytes / 1024.0),
+            node=self.peak_node)]
+
+
+def liveness(symbol, shapes=None, types=None):
+    """Backward last-use + forward live-set sweep; returns
+    :class:`LivenessInfo`. This is the analysis a future
+    rematerialization/scheduling transform is licensed by; today it
+    feeds the ``--pipeline`` report and cross-checks the diagnostics
+    ledger's executor-output slot model."""
+    shp, dt, _ev = _prov.infer_walk(symbol, shapes, types)
+    topo = symbol._topo()
+    index = {id(n): i for i, n in enumerate(topo)}
+    info = LivenessInfo()
+    n = len(topo)
+
+    def nbytes(entry):
+        s = shp.get(entry)
+        if s is None:
+            info.complete = False
+            return 0
+        d = dt.get(entry) or _np.dtype("float32")
+        total = int(_np.dtype(d).itemsize)
+        for dim in s:
+            total *= int(dim)
+        return total
+
+    born = {}
+    for i, node in enumerate(topo):
+        outs = 1 if node.is_variable else node.num_outputs()
+        for k in range(outs):
+            born[(id(node), k)] = i
+            info.entry_bytes[(id(node), k)] = nbytes((id(node), k))
+    info._born = born
+    # backward: last consumer per entry; heads live to the end
+    for i, node in enumerate(topo):
+        for src, idx in node.inputs:
+            e = (id(src), idx)
+            info.last_use[e] = max(info.last_use.get(e, -1), i)
+    for node, idx in symbol._outputs:
+        info.last_use[(id(node), idx)] = n
+        info.head_bytes += info.entry_bytes.get((id(node), idx), 0)
+    # entries never consumed die at birth
+    for e in born:
+        info.last_use.setdefault(e, born[e])
+    # forward sweep: running live-byte total, peak and its node
+    live = 0
+    expiring = {}
+    for e, last in info.last_use.items():
+        expiring.setdefault(last, []).append(e)
+    for i, node in enumerate(topo):
+        outs = 1 if node.is_variable else node.num_outputs()
+        for k in range(outs):
+            live += info.entry_bytes[(id(node), k)]
+        if live > info.peak_live_bytes:
+            info.peak_live_bytes = live
+            info.peak_node = node.name
+        for e in expiring.get(i, ()):
+            live -= info.entry_bytes[e]
+        info.live_bytes.append(live)
+    return info
+
+
+def liveness_ledger_check(executor):
+    """Cross-check the liveness estimate against the diagnostics
+    ledger's slot model for a live executor: the entries still live at
+    the end of the walk are exactly the graph outputs, and the ledger's
+    ``executor_outputs`` slot accounts those same buffers. Returns a
+    list of findings (empty = consistent). Degrades to [] when the
+    ledger is disabled or the executor has not run yet."""
+    from .. import diagnostics as _diag
+    slot = getattr(executor, "_out_slot", None)
+    if not _diag.mem_enabled() or slot is None:
+        return []
+    shapes = {n: tuple(v.shape) for n, v in executor.arg_dict.items()}
+    types = {n: v.dtype for n, v in executor.arg_dict.items()}
+    info = liveness(executor._symbol, shapes=shapes, types=types)
+    actual = slot._nbytes
+    if info.complete and info.head_bytes != actual:
+        return [Finding(
+            "liveness", WARNING,
+            "liveness says the graph outputs hold %d bytes but the "
+            "ledger's executor_outputs slot accounts %d — the estimate "
+            "and the slot model drifted" % (info.head_bytes, actual),
+            fix_hint="check dtype handling in liveness() vs the "
+                     "executor's _wrap_outputs slot accounting")]
+    return []

@@ -752,10 +752,12 @@ def analyze_json(json_str, **kwargs):
                    json_heads=data.get("heads"), **kwargs)
 
 
-def check_module(module, passes=None):
+def check_module(module, passes=None, pipeline=None):
     """``Module.check()``: analyze the module's symbol with everything
     the module knows — bound shapes, provided params, and the live fused
-    step for the donation audit."""
+    step for the donation audit. ``pipeline`` dry-runs compile-pipeline
+    transforms and merges their action/rejection findings (see
+    ``Symbol.lint``)."""
     sym = module.symbol
     if sym is None:
         raise MXNetError("Module.check: module has no symbol")
@@ -767,8 +769,11 @@ def check_module(module, passes=None):
     if getattr(module, "_arg_params", None) is not None:
         args = set(module._arg_params) \
             | set(getattr(module, "_data_names", ()) or ()) \
-            | set(getattr(module, "_label_names", ()) or ()) \
+            | set(getattr(module, "_label_names", ()) or ())\
             | set(getattr(module, "_state_names", ()) or ())
         aux = set(module._aux_params or {})
-    return analyze(sym, shapes=shapes, module=module, args=args, aux=aux,
-                   passes=passes)
+    report = analyze(sym, shapes=shapes, module=module, args=args, aux=aux,
+                     passes=passes)
+    from ..symbol.symbol import _merge_pipeline_report
+    return _merge_pipeline_report(report, sym, shapes, pipeline,
+                                  module=module)

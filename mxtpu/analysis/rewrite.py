@@ -1,0 +1,237 @@
+"""Transform passes over the Symbol IR: analysis-licensed graph rewrites.
+
+The verifier passes (:mod:`~mxtpu.analysis.passes`) *check* graphs; a
+:class:`TransformPass` *changes* one — and the discipline that makes the
+combination safe is enforced one level up, in
+:func:`mxtpu.compile.pipeline.transform_graph`: every rewrite must be
+licensed by a dataflow fact computed beforehand
+(:mod:`~mxtpu.analysis.dataflow`) and is re-proven by the full verifier
+suite afterwards; a transform whose output graph fails a verifier pass
+is REJECTED with the offending Finding and the build falls back to the
+unrewritten graph. A transform can therefore never ship a graph the
+checker would refuse.
+
+First registered transform: ``bf16`` — the mixed-precision rewrite.
+Matmul-class compute and its elementwise followers run in bf16 (Cast
+nodes inserted at the class boundaries the precision-flow analysis
+computed); dtype-sensitive islands (softmax/exp/log, reductions, loss
+heads, normalization statistics) stay f32; parameters keep f32 master
+storage and are cast at their use sites, so the fused step's optimizer
+update always reads f32 weights and f32 gradients (the vjp of a
+``convert_element_type`` casts the cotangent back up). Graph outputs are
+cast back to their original dtype, so callers — metrics, serving, the
+sanitizer — observe the same output contract as the f32 program.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .findings import INFO, Finding
+from . import dataflow as _df
+from . import provenance as _prov
+
+__all__ = ["TransformPass", "TransformContext", "register_transform",
+           "get_transform", "list_transforms", "Bf16MixedPrecisionPass",
+           "apply_precision_plan"]
+
+_TRANSFORMS = {}
+
+
+def register_transform(cls):
+    """Class decorator: register a TransformPass subclass under
+    ``cls.name`` (same shape as the verifier-pass registry)."""
+    inst = cls()
+    if not inst.name:
+        raise MXNetError("TransformPass must define a name")
+    _TRANSFORMS[inst.name] = inst
+    return cls
+
+
+def get_transform(name):
+    if name not in _TRANSFORMS:
+        raise MXNetError(
+            "transform pass '%s' is not registered (have: %s)"
+            % (name, ", ".join(sorted(_TRANSFORMS)) or "none"))
+    return _TRANSFORMS[name]
+
+
+def list_transforms():
+    """Registered transforms in registration order: [(name, doc)]."""
+    return [(name, t.describe()) for name, t in _TRANSFORMS.items()]
+
+
+class TransformContext:
+    """Everything a transform may read, plus where it records what it
+    did. ``actions`` collects INFO findings (per-node provenance — the
+    ``--pipeline`` report surface); a transform appends there and
+    returns the rewritten Symbol (or None for "no change")."""
+
+    def __init__(self, symbol, kind=None, shapes=None, types=None,
+                 module=None):
+        self.symbol = symbol
+        self.kind = kind
+        self.shapes = dict(shapes or {})
+        self.types = dict(types or {})
+        self.module = module
+        self.actions = []
+
+
+class TransformPass:
+    """Base class: subclass, set ``name``, implement ``run(tctx)``
+    returning a NEW Symbol (the input graph must not be mutated — the
+    pipeline needs the original for fallback) or None for no change."""
+
+    name = None
+
+    def describe(self):
+        return (self.__doc__ or "").strip().split("\n")[0]
+
+    def run(self, tctx):
+        raise NotImplementedError
+
+    def action(self, tctx, message, **kw):
+        f = Finding(self.name, INFO, message, **kw)
+        tctx.actions.append(f)
+        return f
+
+
+# ----------------------------------------------------------- bf16 rewrite
+def apply_precision_plan(symbol, plan, dtypes, actions=None,
+                         pass_name="bf16"):
+    """Clone ``symbol`` with Cast nodes realizing ``plan`` (a
+    :class:`~mxtpu.analysis.dataflow.PrecisionPlan`): every f32 value
+    entering a bf16-safe node is cast down, every bf16 value entering an
+    f32 island is cast back up, and heads keep their original dtype.
+    Variables are SHARED with the original graph (the rewrite adds no
+    arguments, so bind dicts/checkpoints are unchanged); op nodes are
+    cloned. Aux-slot inputs (BatchNorm moving stats) are never cast —
+    the executor's aux-update writeback requires the variable wired
+    directly."""
+    from ..ops.registry import get_op
+    from ..symbol.symbol import _Node, Symbol
+    cast_op = get_op("Cast")
+    f32 = _np.dtype("float32")
+    topo = symbol._topo()
+    mapping = {}
+    casts = {}
+    if actions is None:
+        actions = []
+
+    def rewritten_dtype(src, idx):
+        """What arrives on this edge AFTER the rewrite: 'bf16' when the
+        producer is a bf16-class op whose original f32 output now
+        computes in bf16; 'f32' for castable f32 values; 'other' for
+        non-f32 dtypes (ints, bools, already-bf16) the rewrite leaves
+        alone."""
+        dt = dtypes.get((id(src), idx))
+        if dt is not None and _np.dtype(dt) != f32:
+            return "other"
+        if not src.is_variable \
+                and plan.classes.get(id(src)) == _df.BF16_SAFE:
+            return "bf16"
+        # unknown dtype: treat as f32 only for op outputs (variables
+        # without hints default f32 in _infer_graph anyway)
+        return "f32"
+
+    def cast_of(entry_node, idx, to):
+        key = (id(entry_node), idx, to)
+        hit = casts.get(key)
+        if hit is not None:
+            return hit
+        base = entry_node.name if idx == 0 \
+            else "%s_o%d" % (entry_node.name, idx)
+        node = _Node(cast_op, "%s_%s_amp" % (base, to),
+                     {"dtype": "bfloat16" if to == "bf16" else "float32"},
+                     [(entry_node, idx)])
+        casts[key] = node
+        return node
+
+    for node in topo:
+        if node.is_variable:
+            mapping[id(node)] = node
+            continue
+        cls = plan.classes.get(id(node), _df.F32_ISLAND)
+        aux_slots = set()
+        if node.op.aux_names:
+            names = node.op.input_names(node.parsed_attrs(),
+                                        n=len(node.inputs))
+            aux_slots = {i for i, nm in enumerate(names)
+                         if nm in node.op.aux_names}
+        new_inputs = []
+        cast_in = []
+        for i, (src, idx) in enumerate(node.inputs):
+            nsrc = mapping[id(src)]
+            rdt = rewritten_dtype(src, idx)
+            if i in aux_slots:
+                new_inputs.append((nsrc, idx))
+            elif cls == _df.BF16_SAFE and rdt == "f32":
+                new_inputs.append((cast_of(nsrc, idx, "bf16"), 0))
+                cast_in.append(src.name)
+            elif cls == _df.F32_ISLAND and rdt == "bf16":
+                new_inputs.append((cast_of(nsrc, idx, "f32"), 0))
+                cast_in.append(src.name)
+            else:
+                new_inputs.append((nsrc, idx))
+        clone = _Node(node.op, node.name, dict(node.attrs), new_inputs)
+        clone._extra_attrs = dict(node._extra_attrs)
+        mapping[id(node)] = clone
+        if cls == _df.BF16_SAFE:
+            actions.append(Finding(
+                pass_name, INFO,
+                "node '%s' (op %s) computes in bf16%s — licensed by "
+                "precision_flow: %s"
+                % (node.name, node.op.name,
+                   "; cast-at-use: %s" % ", ".join(cast_in)
+                   if cast_in else "",
+                   plan.reasons.get(id(node), "bf16-safe")),
+                node=node.name,
+                provenance=tuple(cast_in)))
+        elif cast_in:
+            actions.append(Finding(
+                pass_name, INFO,
+                "node '%s' (op %s) stays an f32 island; bf16 inputs "
+                "cast back up: %s — %s"
+                % (node.name, node.op.name, ", ".join(cast_in),
+                   plan.reasons.get(id(node), "dtype-sensitive")),
+                node=node.name,
+                provenance=tuple(cast_in)))
+    heads = []
+    for node, idx in symbol._outputs:
+        nnode = mapping[id(node)]
+        if not node.is_variable and rewritten_dtype(node, idx) == "bf16":
+            actions.append(Finding(
+                pass_name, INFO,
+                "graph output '%s'[%d] cast back to f32 (output dtype "
+                "contract preserved for metrics/serving/sanitizer)"
+                % (node.name, idx), node=node.name))
+            heads.append((cast_of(nnode, idx, "f32"), 0))
+        else:
+            heads.append((nnode, idx))
+    return Symbol(heads)
+
+
+@register_transform
+class Bf16MixedPrecisionPass(TransformPass):
+    """bf16 mixed-precision rewrite: MXU-class compute and its
+    elementwise followers in bf16, f32 islands where precision-flow
+    demands, f32 master weights cast at use, outputs cast back."""
+
+    name = "bf16"
+
+    def run(self, tctx):
+        plan = _df.precision_flow(tctx.symbol, shapes=tctx.shapes,
+                                  types=tctx.types)
+        if plan.n_bf16 == 0:
+            self.action(tctx, "no bf16-safe nodes in this graph "
+                        "(%s) — rewrite skipped" % plan.summary())
+            return None
+        _shapes, dtypes, _ev = _prov.infer_walk(
+            tctx.symbol, tctx.shapes, tctx.types)
+        new_sym = apply_precision_plan(tctx.symbol, plan, dtypes,
+                                       actions=tctx.actions,
+                                       pass_name=self.name)
+        self.action(
+            tctx, "%s; %d master-weight parameter(s) stay f32 in the "
+            "fused state" % (plan.summary(), plan.n_master))
+        return new_sym

@@ -64,13 +64,22 @@ def disable():
 
 def _flag_fn(mode_, n_leaves):
     """Jitted reducer: list of float leaves -> uint8 flag per leaf, all
-    on device. Cached per (mode, leaf avals) by the caller."""
+    on device. Cached per (mode, leaf avals) by the caller.
+
+    Half-precision leaves (bf16/f16 — the mixed-precision rewrite's
+    program outputs and optimizer-state views) are upcast to f32 BEFORE
+    the finite check: the flag must classify the VALUE, and the upcast
+    is exact (every bf16/f16 value, including every NaN/Inf, maps to
+    the same f32 value), whereas reducing in 8-bit-mantissa arithmetic
+    is exactly the numerics class this sanitizer exists to catch."""
     import jax
     import jax.numpy as jnp
 
     def flags(leaves):
         out = []
         for leaf in leaves:
+            if leaf.dtype in (jnp.bfloat16, jnp.float16):
+                leaf = leaf.astype(jnp.float32)
             bad = jnp.zeros((), jnp.bool_)
             if mode_ in ("nan", "all"):
                 bad = bad | jnp.isnan(leaf).any()
@@ -82,10 +91,14 @@ def _flag_fn(mode_, n_leaves):
     return jax.jit(flags)
 
 
-def sanitize_tree(kind, out):
+def sanitize_tree(kind, out, precision=None):
     """Check every float leaf of ``out`` (any pytree) for NaN/Inf per the
     active mode; raise NumericsError naming the offending leaves. Public
-    so tests and custom runners can sanitize arbitrary pytrees."""
+    so tests and custom runners can sanitize arbitrary pytrees.
+
+    ``precision`` is the tripping PROGRAM's precision tag as stamped at
+    build time by the compile pipeline (e.g. ``mixed_bf16``); omitted,
+    a label is derived from the checked leaf dtypes."""
     mode_ = _MODE
     if mode_ is None:
         return
@@ -124,8 +137,20 @@ def sanitize_tree(kind, out):
     if len(bad) > 6:
         desc += ", ... %d more" % (len(bad) - 6)
     what = {"nan": "NaN", "inf": "Inf", "all": "NaN/Inf"}[mode_]
-    reason = "sanitizer: %s in outputs of program kind '%s' (%d/%d " \
-             "leaves): %s" % (what, kind, len(bad), len(checked), desc)
+    # the program's precision mode travels with the postmortem: a NaN in
+    # a bf16-rewritten step is triaged differently from one in a pure
+    # f32 program (overflow at bf16's ~3e38 ceiling vs a real div-by-0).
+    # The BUILD-TIME tag wins — a bf16-rewritten program's outputs are
+    # cast back to f32, so dtype scanning alone cannot see the rewrite,
+    # and the current global pipeline config may not be what built it
+    if not precision:
+        lows = sum(1 for _, leaf in checked
+                   if str(leaf.dtype) in ("bfloat16", "float16"))
+        precision = "f32" if not lows else \
+            ("bf16" if lows == len(checked) else "mixed")
+    reason = "sanitizer: %s in outputs of program kind '%s' " \
+             "(precision=%s, %d/%d leaves): %s" \
+             % (what, kind, precision, len(bad), len(checked), desc)
     # registry-direct: a numerics trip must count even with the helper-
     # mediated telemetry disabled
     _tel.registry().counter(
@@ -143,9 +168,9 @@ def sanitize_tree(kind, out):
     raise err
 
 
-def _check_outputs(kind, out):
-    """The executor output hook (installed by :func:`enable`)."""
-    sanitize_tree(kind, out)
+def _check_outputs(kind, out, precision=None):
+    """The build-seam output hook (installed by :func:`enable`)."""
+    sanitize_tree(kind, out, precision=precision)
 
 
 # env arming is tolerant where the explicit enable() API is strict: a

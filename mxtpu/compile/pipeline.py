@@ -1,0 +1,531 @@
+"""The compile pipeline: transform → verify → build → instrument.
+
+This module is the single program-build seam of the framework. It was
+carved out of ``executor.py`` (which had accreted the build listeners,
+the first-call AOT cost capture, and the compiled-executable dispatch /
+demotion logic across PRs 1–5) so that graph TRANSFORMS have a place to
+run before tracing, under a static-analysis contract:
+
+* a transform only does what a dataflow analysis licensed
+  (:mod:`mxtpu.analysis.dataflow`);
+* the FULL verifier suite re-runs on the transformed graph before it
+  may compile (:func:`mxtpu.analysis.analyze` — shape_infer, dead_code,
+  name_collision, ctx_groups, donation, sharding_consistency,
+  numerics);
+* a transform whose output fails a check it previously passed is
+  REJECTED with the offending :class:`~mxtpu.analysis.Finding`, and the
+  build falls back to the unrewritten graph. The optimizer can never
+  ship a graph the checker would refuse.
+
+The active pipeline is empty by default (zero behavior change);
+``MXTPU_PIPELINE=bf16`` or :func:`configure`/:func:`pipeline_scope`
+selects transforms by registry name (:mod:`mxtpu.analysis.rewrite`).
+"""
+from __future__ import annotations
+
+import contextlib
+import logging as _logging
+import os as _os
+import threading as _threading
+
+from .. import diagnostics as _diag
+from .. import telemetry as _tel
+
+__all__ = ["set_output_sanitizer", "add_build_listener",
+           "remove_build_listener", "program_build_count", "notify_build",
+           "record_program_build", "instrument_program",
+           "configure", "configured", "pipeline_scope",
+           "transform_graph", "PipelineReport"]
+
+_log = _logging.getLogger("mxtpu.compile")
+
+# ------------------------------------------------------------- sanitizer seam
+# mxtpu.analysis.sanitizer installs fn(kind, out) here when MXTPU_SANITIZE
+# is armed; every instrumented program (all kinds: fwd_eval/fwd_bwd/
+# fused_step/metric_accum/...) routes its outputs through it. Unset, the
+# cost per call is ONE module-global read + None check — the zero-
+# overhead contract tools/bench_analysis.py pins down.
+_OUTPUT_SANITIZER = None
+
+
+def set_output_sanitizer(fn):
+    """Install ``fn(kind, out)`` called on every instrumented program's
+    outputs (the numerics sanitizer); ``None`` uninstalls."""
+    global _OUTPUT_SANITIZER
+    _OUTPUT_SANITIZER = fn
+
+
+# ---------------------------------------------------------------- cache hooks
+# Program-construction observability for the serving layer: every time a
+# traced program is built (a cache miss in a per-kind program table —
+# the event that leads to an XLA compile on first dispatch), listeners
+# are notified with (kind, owner). mxtpu.serving counts these to surface
+# executor-cache efficiency; warmup correctness is asserted by the count
+# staying flat under traffic.
+_BUILD_LISTENERS = []
+_BUILD_COUNT = [0]
+_BUILD_LOCK = _threading.Lock()
+
+# standing series: registry-direct so they exist for /metrics even when
+# MXTPU_TELEMETRY=0 was set at import
+_M_BUILDS_TOTAL = _tel.registry().counter(
+    "executor_program_builds_total",
+    help="traced-program constructions (each compiles on first dispatch)")
+
+
+def add_build_listener(fn):
+    """Register ``fn(kind, owner)`` called on every program build."""
+    _BUILD_LISTENERS.append(fn)
+    return fn
+
+
+def remove_build_listener(fn):
+    if fn in _BUILD_LISTENERS:
+        _BUILD_LISTENERS.remove(fn)
+
+
+def program_build_count():
+    """Total traced-program constructions since import (monotonic)."""
+    return _BUILD_COUNT[0]
+
+
+def notify_build(kind, owner):
+    with _BUILD_LOCK:  # concurrent replica builds must not lose counts
+        _BUILD_COUNT[0] += 1
+    _M_BUILDS_TOTAL.inc()
+    _tel.registry().counter("executor_program_builds",
+                            labels={"kind": kind}).inc()
+    for fn in list(_BUILD_LISTENERS):
+        try:
+            fn(kind, owner)
+        except Exception:
+            pass
+
+
+def record_program_build(kind, owner, fn, precision=None):
+    """Public build-seam entry for program tables outside the Executor
+    (the fused train step, metric accumulators): bump the build
+    counters, notify the listeners, and wrap ``fn`` for first-call
+    compile timing and cost capture — the exact sequence the Executor's
+    ``_get_fn`` performs, so every traced-program construction in the
+    process reports through one seam. ``precision`` tags the program's
+    cost record (``program_table``'s prec column) when the compile
+    pipeline rewrote the graph."""
+    notify_build(kind, owner)
+    return instrument_program(kind, fn, owner=owner, precision=precision)
+
+
+_AOT_MISS = object()     # sentinel: "the AOT capture path produced nothing"
+_DEMOTE_MISSES = 8       # consecutive signature misses → demote to jit
+_DEMOTE_MISS_TOTAL = 64  # lifetime misses → demote even if hits interleave
+
+
+def instrument_program(kind, fn, owner=None, matmul_env=False,
+                       precision=None):
+    """Wrap a freshly built jit program with the build-seam diagnostics.
+
+    First invocation — the one that pays tracing + XLA compilation —
+    lands in ``executor_compile_ms{kind=...}``. When cost introspection
+    is on (``MXTPU_DIAG_COST``, default), that first call compiles the
+    program EXPLICITLY via the AOT path (``fn.lower(...).compile()`` —
+    the same work jit would do lazily, not an extra compile), captures
+    ``cost_analysis``/``memory_analysis`` into the diagnostics program
+    registry, and keeps the compiled executable as the dispatch fast
+    path. A later call with a different signature (dtype/shape/sharding
+    change) falls back to the jit function, which retraces per signature
+    exactly as before.
+
+    ``matmul_env`` preserves the ``MXTPU_MATMUL_PRECISION`` contract for
+    Executor programs: every call re-reads the env, and while it is set
+    both the AOT capture and any previously captured executable are
+    bypassed (flipping it retraces rather than returning stale
+    programs); a first call made while it is set defers the capture to
+    the first call after it clears.
+
+    ``precision`` stamps the program's cost record (e.g. "mixed_bf16"
+    after the pipeline's bf16 rewrite); without it, the record derives a
+    label from the captured argument dtypes."""
+    import time as _time
+    # keep only the owner's NAME: the wrapper outlives the owner in
+    # process-global caches (metric.py _ACCUM_FN_CACHE), and a closure
+    # ref would pin the accumulator's device arrays for the process life
+    owner = _diag.owner_name(owner)
+    # "first" is guarded by the lock: wrappers live in process-global
+    # caches (metric.py _ACCUM_FN_CACHE), so two fit threads can race the
+    # first invocation — unguarded, both would pay the XLA compile and
+    # register duplicate ProgramRecords. Losers block until the winner's
+    # executable is visible; the steady-state path never takes the lock.
+    state = {"first": True, "timed": False, "compiled": None, "rec": None,
+             "misses": 0, "miss_total": 0, "lock": _threading.Lock()}
+
+    def _plain(args, kwargs):
+        if matmul_env:
+            prec = _os.environ.get("MXTPU_MATMUL_PRECISION")
+            if prec:
+                import jax
+                with jax.default_matmul_precision(prec):
+                    return fn(*args, **kwargs)
+        return fn(*args, **kwargs)
+
+    def _first_call(args, kwargs):
+        t0 = _time.perf_counter()
+        out = _AOT_MISS
+        if _diag.cost_enabled() and hasattr(fn, "lower"):
+            # only lower/compile/record may fall back to jit: a RUNTIME
+            # failure of the first execution must propagate — fused_step
+            # donates its params/opt_state, so re-running via _plain would
+            # see deleted arrays and mask the real error (e.g. an OOM)
+            exe = None
+            try:
+                exe = fn.lower(*args, **kwargs).compile()
+                state["rec"] = _diag.record_program(
+                    kind, owner, exe, (_time.perf_counter() - t0) * 1e3)
+                # SPMD shape of the program: devices spanned + how many
+                # arg leaves are mesh-split vs replicated (read off the
+                # live args — the one place both are in hand)
+                _diag.summarize_shardings(state["rec"], args)
+                _diag.summarize_precision(state["rec"], args,
+                                          tag=precision)
+            except Exception:
+                exe = None
+                state["compiled"] = None
+            if exe is not None:
+                state["compiled"] = exe
+                out = exe(*args, **kwargs)
+                rec = state["rec"]
+                if rec is not None:
+                    rec.calls += 1
+        if out is _AOT_MISS:
+            out = _plain(args, kwargs)
+        _tel.histogram("executor_compile_ms",
+                       labels={"kind": kind}).observe(
+            (_time.perf_counter() - t0) * 1e3)
+        return out
+
+    def _dispatch(args, kwargs):
+        # the env contract is per CALL: a precision set after the first
+        # call must still take effect, so it disables the AOT fast path
+        # for as long as it is set (jit retraces under the context)
+        prec_set = matmul_env and _os.environ.get("MXTPU_MATMUL_PRECISION")
+        if state["first"]:
+            if prec_set:
+                # don't consume the first-call slot under the precision
+                # env: capture is DEFERRED to the first call after it
+                # clears ("while it is set" contract) — consuming it here
+                # would leave the program table empty for process life.
+                # The literal first call still feeds executor_compile_ms
+                # (it pays jit's lazy compile), matching the pre-capture
+                # contract that first-call time is always observed
+                if not state["timed"]:
+                    state["timed"] = True   # benign race: extra observe
+                    t0 = _time.perf_counter()
+                    out = _plain(args, kwargs)
+                    _tel.histogram("executor_compile_ms",
+                                   labels={"kind": kind}).observe(
+                        (_time.perf_counter() - t0) * 1e3)
+                    return out
+                return _plain(args, kwargs)
+            with state["lock"]:
+                if state["first"]:
+                    try:
+                        return _first_call(args, kwargs)
+                    finally:
+                        state["first"] = False
+            # lost the first-call race: fall through — the winner's
+            # executable (if any) is visible once the lock is released
+        compiled = state["compiled"] if not prec_set else None
+        if compiled is not None:
+            rec = state["rec"]
+            if rec is not None:
+                rec.calls += 1
+            try:
+                out = compiled(*args, **kwargs)
+                state["misses"] = 0
+                return out
+            except (TypeError, ValueError):
+                # signature changed under us — dtype/shape (TypeError) or
+                # device/sharding (ValueError), both raised at argument
+                # binding, BEFORE any donation/execution: serve this call
+                # via jit (which retraces per signature and faithfully
+                # re-raises truly invalid arguments) but KEEP the
+                # executable — a partial final batch must not evict the
+                # steady-state signature's fast path and force jit to
+                # recompile it from scratch mid-run. CONSECUTIVE misses
+                # mean the workload's signature moved for good (a second
+                # fit at a new batch size reusing this process-cached
+                # wrapper); ALTERNATING signatures (bucketed training —
+                # hits reset the consecutive count so it never trips)
+                # are caught by the lifetime total instead. Either way
+                # demote to jit — it retraces once per signature and
+                # serves all of them from its own cache — rather than
+                # paying a failed binding + raised exception per call
+                state["misses"] += 1
+                state["miss_total"] += 1
+                if state["misses"] >= _DEMOTE_MISSES \
+                        or state["miss_total"] >= _DEMOTE_MISS_TOTAL:
+                    state["compiled"] = None
+                return _plain(args, kwargs)
+        rec = state["rec"]
+        if rec is not None:   # env-bypass dispatches still count
+            rec.calls += 1
+        return _plain(args, kwargs)
+
+    def wrapped(*args, **kwargs):
+        out = _dispatch(args, kwargs)
+        san = _OUTPUT_SANITIZER
+        if san is not None:
+            # the hook gets THIS program's precision tag, not the
+            # current global pipeline config: a trip must be labeled
+            # with what the tripping program was actually built as
+            # (a rejected rewrite runs f32 even while bf16 is
+            # configured; a scope may have exited since the build)
+            san(kind, out, precision)
+        return out
+
+    return wrapped
+
+
+# ---------------------------------------------------------- pipeline config
+def _parse_env():
+    raw = _os.environ.get("MXTPU_PIPELINE", "").strip()
+    if raw.lower() in ("", "0", "none", "off", "false"):
+        return ()
+    return tuple(p.strip() for p in raw.split(",") if p.strip())
+
+
+_CONFIGURED = _parse_env()
+_CONFIG_LOCK = _threading.Lock()
+
+
+def configured():
+    """The active transform-pass names, in order (empty = no rewrites;
+    the seam then returns every graph unchanged)."""
+    return _CONFIGURED
+
+
+def configure(names=None):
+    """Set the process-wide pipeline. ``None`` re-reads
+    ``MXTPU_PIPELINE``; a sequence of registered transform names
+    activates them in order; ``()`` empties the pipeline. Affects
+    programs built AFTER the call — already-built executables keep the
+    graph they compiled."""
+    global _CONFIGURED
+    with _CONFIG_LOCK:
+        _CONFIGURED = _parse_env() if names is None \
+            else tuple(str(n) for n in names)
+    return _CONFIGURED
+
+
+@contextlib.contextmanager
+def pipeline_scope(names):
+    """Temporarily activate a pipeline (tests, experiments)::
+
+        with mxtpu.compile.pipeline_scope(["bf16"]):
+            mod.fit(...)
+    """
+    prev = _CONFIGURED
+    configure(names)
+    try:
+        yield
+    finally:
+        configure(prev)
+
+
+# ------------------------------------------------------------ transform gate
+class PipelineReport:
+    """What the pipeline did to one graph: per-transform actions
+    (INFO findings with per-node provenance), applied/rejected status,
+    and — for a rejection — the offending verifier Finding(s)."""
+
+    def __init__(self, kind=None, passes=()):
+        self.kind = kind
+        self.passes = tuple(passes)
+        self.entries = []      # {name, applied, rejected, actions,
+        #                         offending, error}
+        self.symbol_changed = False
+
+    def _add(self, name):
+        e = {"name": name, "applied": False, "rejected": False,
+             "actions": [], "offending": [], "error": None}
+        self.entries.append(e)
+        return e
+
+    @property
+    def applied(self):
+        return [e["name"] for e in self.entries if e["applied"]]
+
+    @property
+    def rejected(self):
+        return [e["name"] for e in self.entries if e["rejected"]]
+
+    @property
+    def precision(self):
+        """Precision tag for the diagnostics program record, or None
+        when no precision-changing transform applied."""
+        return "mixed_bf16" if "bf16" in self.applied else None
+
+    def findings(self):
+        """The report flattened to the Finding schema (merged into
+        ``Symbol.lint(pipeline=...)`` / ``Module.check`` reports and the
+        CLI's ``--pipeline`` output)."""
+        from ..analysis.findings import INFO, WARNING, Finding
+        out = []
+        for e in self.entries:
+            if e["error"] is not None:
+                out.append(Finding(
+                    "pipeline", WARNING,
+                    "transform '%s' crashed and was skipped: %s"
+                    % (e["name"], e["error"]),
+                    fix_hint="report this — a transform pass should "
+                             "degrade by returning None, not raise"))
+                continue
+            if e["rejected"]:
+                off = e["offending"][0] if e["offending"] else None
+                out.append(Finding(
+                    "pipeline", WARNING,
+                    "transform '%s' REJECTED: its output graph fails "
+                    "verifier pass '%s' (%s) — the build fell back to "
+                    "the unrewritten graph"
+                    % (e["name"], off.pass_name if off else "?",
+                       off.message if off else "unknown"),
+                    node=off.node if off else None,
+                    fix_hint="the rewrite is unsound for this graph; "
+                             "fix the transform or drop it from "
+                             "MXTPU_PIPELINE"))
+                out.extend(e["offending"])
+            else:
+                out.append(Finding(
+                    "pipeline", INFO,
+                    "transform '%s' %s (%d recorded action(s))"
+                    % (e["name"],
+                       "applied" if e["applied"] else "made no change",
+                       len(e["actions"]))))
+            out.extend(e["actions"])
+        return out
+
+    def to_dict(self):
+        return {"kind": self.kind, "passes": list(self.passes),
+                "applied": self.applied, "rejected": self.rejected,
+                "symbol_changed": self.symbol_changed,
+                "findings": [f.to_dict() for f in self.findings()]}
+
+    def render(self):
+        lines = ["compile pipeline (%s): %d transform(s); applied=%s "
+                 "rejected=%s"
+                 % (self.kind or "-", len(self.passes),
+                    ",".join(self.applied) or "-",
+                    ",".join(self.rejected) or "-")]
+        lines += [f.render() for f in self.findings()]
+        return "\n".join(lines)
+
+    __str__ = render
+
+
+def _verify(symbol, shapes, types, module):
+    from .. import analysis as _analysis
+    return _analysis.analyze(symbol, shapes=shapes, types=types,
+                             module=module)
+
+
+def _enrich_hints(symbol, shapes, types):
+    """Resolve every variable shape/dtype the ORIGINAL graph can infer
+    (including the ops' top-down ``infer_args`` parameter backfill) and
+    fold them into the caller's hints. A rewrite may interpose nodes —
+    e.g. a Cast between a weight and its FullyConnected — past which the
+    backfill cannot reach, so the transformed graph must be analyzed
+    and verified with the variables pinned to what the unrewritten
+    graph already proved about them."""
+    from ..analysis import provenance as _prov
+    shp, dt, _events = _prov.infer_walk(symbol, shapes, types)
+    out_s = dict(shapes or {})
+    out_t = dict(types or {})
+    for node in symbol._topo():
+        if not node.is_variable:
+            continue
+        s = shp.get(node.name)
+        if s is not None:
+            out_s.setdefault(node.name, tuple(s))
+        d = dt.get(node.name)
+        if d is not None:
+            out_t.setdefault(node.name, d)
+    return out_s, out_t
+
+
+def _fresh_errors(base, post):
+    """Error findings of ``post`` beyond what ``base`` already had, per
+    verifier pass. Counted per pass (not matched by message: node names
+    legitimately differ across a rewrite); a transform is charged only
+    with errors it ADDED, so a graph that already fails shape inference
+    for lack of hints does not spuriously reject every rewrite."""
+    from collections import Counter
+    budget = Counter(f.pass_name for f in base.errors)
+    fresh = []
+    seen = Counter()
+    for f in post.errors:
+        seen[f.pass_name] += 1
+        if seen[f.pass_name] > budget[f.pass_name]:
+            fresh.append(f)
+    return fresh
+
+
+def transform_graph(symbol, kind=None, shapes=None, types=None,
+                    module=None, passes=None):
+    """Run the active pipeline over ``symbol``; returns
+    ``(symbol', PipelineReport)``.
+
+    Each transform runs on the current graph; if it returns a new
+    Symbol, the FULL verifier suite re-runs on the result and the
+    rewrite is accepted only when it adds no error-severity findings —
+    otherwise it is rejected (offending Finding recorded, warning
+    logged) and the pipeline continues from the unrewritten graph.
+    ``passes`` overrides the configured list (the ``--pipeline`` report
+    surface); with an empty pipeline the input symbol is returned
+    untouched, cheaply.
+    """
+    names = tuple(passes) if passes is not None else configured()
+    report = PipelineReport(kind=kind, passes=names)
+    if not names:
+        return symbol, report
+    from ..analysis import rewrite as _rw
+    from ..base import MXNetError
+    shapes, types = _enrich_hints(symbol, shapes, types)
+    cur = symbol
+    base = None  # lazy: verifier baseline of `cur`
+    for name in names:
+        entry = report._add(name)
+        try:
+            tp = _rw.get_transform(name)
+        except MXNetError as exc:
+            entry["error"] = str(exc)
+            _log.warning("compile pipeline: %s", exc)
+            continue
+        tctx = _rw.TransformContext(cur, kind=kind, shapes=shapes,
+                                    types=types, module=module)
+        try:
+            new_sym = tp.run(tctx)
+        except Exception as exc:  # a broken transform must not kill builds
+            entry["error"] = "%s: %s" % (type(exc).__name__, exc)
+            _log.warning("compile pipeline: transform '%s' crashed: %s",
+                         name, exc)
+            continue
+        entry["actions"] = list(tctx.actions)
+        if new_sym is None or new_sym is cur:
+            continue
+        if base is None:
+            base = _verify(cur, shapes, types, module)
+        post = _verify(new_sym, shapes, types, module)
+        offending = _fresh_errors(base, post)
+        if offending:
+            entry["rejected"] = True
+            entry["offending"] = offending
+            _log.warning(
+                "compile pipeline: transform '%s' rejected for kind=%s — "
+                "verifier pass '%s' fails on its output (%s); falling "
+                "back to the unrewritten graph", name, kind,
+                offending[0].pass_name, offending[0].message)
+            continue
+        cur = new_sym
+        base = post  # the accepted graph is the next baseline
+        entry["applied"] = True
+    report.symbol_changed = cur is not symbol
+    return cur, report

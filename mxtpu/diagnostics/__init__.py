@@ -37,7 +37,8 @@ from .ledger import (DeviceMemoryLedger, alloc_origin, current_origin,
                      device_label, ledger, mem_enabled, set_mem_enabled)
 from .programs import (ProgramRecord, cost_enabled, latest_record,
                        owner_name, program_table, programs, record_program,
-                       set_cost_enabled, summarize_shardings)
+                       set_cost_enabled, summarize_precision,
+                       summarize_shardings)
 from .flight import (FlightRecorder, flight_enabled, record, recorder,
                      set_flight_enabled)
 from .watchdog import (Watchdog, active_waits, ensure_watchdog,
@@ -48,7 +49,7 @@ __all__ = [
     "device_label", "mem_enabled", "set_mem_enabled", "reconcile",
     "ProgramRecord", "programs", "program_table", "record_program",
     "latest_record", "cost_enabled", "set_cost_enabled",
-    "summarize_shardings",
+    "summarize_shardings", "summarize_precision",
     "FlightRecorder", "recorder", "record", "flight_enabled",
     "set_flight_enabled",
     "Watchdog", "ensure_watchdog", "stop_watchdog", "active_waits",

@@ -28,7 +28,7 @@ from .. import telemetry as _tel
 
 __all__ = ["ProgramRecord", "record_program", "programs", "program_table",
            "latest_record", "cost_enabled", "set_cost_enabled", "clear",
-           "summarize_shardings"]
+           "summarize_shardings", "summarize_precision"]
 
 _ENABLED = os.environ.get("MXTPU_DIAG_COST", "1") != "0"
 
@@ -67,7 +67,8 @@ class ProgramRecord:
     __slots__ = ("id", "kind", "owner", "created", "compile_ms", "flops",
                  "bytes_accessed", "argument_bytes", "output_bytes",
                  "temp_bytes", "generated_code_bytes", "calls",
-                 "n_devices", "sharded_args", "replicated_args", "_exe")
+                 "n_devices", "sharded_args", "replicated_args",
+                 "precision", "_exe")
 
     def __init__(self, kind, owner, compile_ms):
         self.id = next(_ids)
@@ -85,6 +86,10 @@ class ProgramRecord:
         self.n_devices = 1       # devices the program's args span (SPMD)
         self.sharded_args = 0    # arg leaves actually split over a mesh
         self.replicated_args = 0
+        # dtype/precision mode: "f32"/"bf16"/"mixed" derived from the
+        # captured argument dtypes, or the compile pipeline's explicit
+        # tag ("mixed_bf16") when a precision rewrite built the program
+        self.precision = "f32"
         self._exe = None  # weakref to the compiled executable (HLO source)
 
     def hlo_text(self):
@@ -113,6 +118,7 @@ class ProgramRecord:
             "n_devices": self.n_devices,
             "sharded_args": self.sharded_args,
             "replicated_args": self.replicated_args,
+            "precision": self.precision,
         }
 
 
@@ -143,6 +149,38 @@ def summarize_shardings(rec, args):
         rec.n_devices = max(1, len(devices))
         rec.sharded_args = sharded
         rec.replicated_args = replicated
+    except Exception:
+        pass
+
+
+def summarize_precision(rec, args, tag=None):
+    """Stamp ``rec.precision``: the compile pipeline's explicit ``tag``
+    wins (a bf16-rewritten program's ARGS are all f32 — master weights
+    — so dtype scanning alone cannot see the rewrite); otherwise the
+    label derives from the captured argument dtypes ("bf16" when every
+    float leaf is half-precision, "mixed" when both families appear,
+    else the dominant float family). Never raises."""
+    if tag:
+        rec.precision = str(tag)
+        return
+    try:
+        import jax
+        import jax.numpy as jnp
+        lo = hi = 0
+        for leaf in jax.tree_util.tree_leaves(args):
+            dt = getattr(leaf, "dtype", None)
+            if dt is None or not jnp.issubdtype(dt, jnp.inexact):
+                continue
+            if dt in (jnp.bfloat16, jnp.float16):
+                lo += 1
+            else:
+                hi += 1
+        if lo and hi:
+            rec.precision = "mixed"
+        elif lo:
+            rec.precision = "bf16"
+        elif hi:
+            rec.precision = "f32"
     except Exception:
         pass
 
@@ -216,20 +254,23 @@ def program_table(kind=None):
     """Human-readable cost report, one row per captured program."""
     rows = programs(kind)
     header = ("id", "kind", "owner", "calls", "compile_ms", "mflops",
-              "mb_accessed", "arg_kb", "out_kb", "temp_kb", "devs")
-    lines = ["%4s %-12s %-16s %6s %10s %10s %11s %8s %8s %8s %9s" % header]
+              "mb_accessed", "arg_kb", "out_kb", "temp_kb", "devs",
+              "prec")
+    lines = ["%4s %-12s %-16s %6s %10s %10s %11s %8s %8s %8s %9s %-10s"
+             % header]
     for r in rows:
         devs = "%d" % r.get("n_devices", 1)
         if r.get("sharded_args"):
             devs += " (%ds)" % r["sharded_args"]
         lines.append("%4d %-12s %-16s %6d %10.1f %10.2f %11.2f %8d %8d "
-                     "%8d %9s"
+                     "%8d %9s %-10s"
                      % (r["id"], r["kind"][:12], r["owner"][:16], r["calls"],
                         r["compile_ms"], r["flops"] / 1e6,
                         r["bytes_accessed"] / 1e6,
                         r["argument_bytes"] // 1024,
                         r["output_bytes"] // 1024,
-                        r["temp_bytes"] // 1024, devs))
+                        r["temp_bytes"] // 1024, devs,
+                        r.get("precision", "f32")[:10]))
     return "\n".join(lines)
 
 

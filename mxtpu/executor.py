@@ -15,8 +15,6 @@ include/mxnet/op_attr_types.h:44-59 (kWriteTo/kAddTo/kNullOp).
 """
 from __future__ import annotations
 
-import threading as _threading
-
 import jax
 import jax.numpy as jnp
 import numpy as _np
@@ -28,25 +26,21 @@ from . import random as _rnd
 from . import telemetry as _tel
 from . import diagnostics as _diag
 from .telemetry import tracing as _tracing
+from .compile import pipeline as _pipeline
+# compat re-exports: the program-build seam (listeners, first-call AOT
+# cost capture, dispatch/demotion instrumentation, the sanitizer hook)
+# moved to mxtpu/compile/pipeline.py so graph transforms have a place to
+# run before tracing; every name below keeps its historical home here.
+from .compile.pipeline import (_AOT_MISS, _DEMOTE_MISS_TOTAL,  # noqa: F401
+                               _DEMOTE_MISSES, add_build_listener,
+                               instrument_program as _instrument_program,
+                               notify_build as _notify_build,
+                               program_build_count, record_program_build,
+                               remove_build_listener, set_output_sanitizer)
 
 __all__ = ["Executor", "add_build_listener", "remove_build_listener",
            "program_build_count", "record_program_build", "device_wait",
            "set_output_sanitizer"]
-
-# ------------------------------------------------------------- sanitizer seam
-# mxtpu.analysis.sanitizer installs fn(kind, out) here when MXTPU_SANITIZE
-# is armed; every instrumented program (all kinds: fwd_eval/fwd_bwd/
-# fused_step/metric_accum/...) routes its outputs through it. Unset, the
-# cost per call is ONE module-global read + None check — the zero-
-# overhead contract tools/bench_analysis.py pins down.
-_OUTPUT_SANITIZER = None
-
-
-def set_output_sanitizer(fn):
-    """Install ``fn(kind, out)`` called on every instrumented program's
-    outputs (the numerics sanitizer); ``None`` uninstalls."""
-    global _OUTPUT_SANITIZER
-    _OUTPUT_SANITIZER = fn
 
 
 def device_wait(x):
@@ -80,217 +74,6 @@ def device_wait(x):
 _M_CACHE_HITS = _tel.registry().counter(
     "executor_program_cache_hits",
     help="per-executor program-table hits (no retrace, no compile)")
-_M_BUILDS_TOTAL = _tel.registry().counter(
-    "executor_program_builds_total",
-    help="traced-program constructions (each compiles on first dispatch)")
-
-# ---------------------------------------------------------------- cache hooks
-# Program-construction observability for the serving layer: every time an
-# Executor builds a traced program (a cache miss in its per-kind table —
-# the event that leads to an XLA compile on first dispatch), listeners are
-# notified with (kind, executor). mxtpu.serving counts these to surface
-# executor-cache efficiency; warmup correctness is asserted by the count
-# staying flat under traffic.
-_BUILD_LISTENERS = []
-_BUILD_COUNT = [0]
-_BUILD_LOCK = _threading.Lock()
-
-
-def add_build_listener(fn):
-    """Register ``fn(kind, executor)`` called on every program build."""
-    _BUILD_LISTENERS.append(fn)
-    return fn
-
-
-def remove_build_listener(fn):
-    if fn in _BUILD_LISTENERS:
-        _BUILD_LISTENERS.remove(fn)
-
-
-def program_build_count():
-    """Total traced-program constructions since import (monotonic)."""
-    return _BUILD_COUNT[0]
-
-
-def _notify_build(kind, executor):
-    with _BUILD_LOCK:  # concurrent replica builds must not lose counts
-        _BUILD_COUNT[0] += 1
-    _M_BUILDS_TOTAL.inc()
-    _tel.registry().counter("executor_program_builds",
-                            labels={"kind": kind}).inc()
-    for fn in list(_BUILD_LISTENERS):
-        try:
-            fn(kind, executor)
-        except Exception:
-            pass
-
-
-def record_program_build(kind, owner, fn):
-    """Public build-seam entry for program tables OUTSIDE Executor (the
-    fused train step, metric accumulators): bump the build counters,
-    notify the listeners, and wrap ``fn`` for first-call compile timing
-    and cost capture — the exact sequence ``_get_fn`` performs, so every
-    traced-program construction in the process reports through one seam."""
-    _notify_build(kind, owner)
-    return _instrument_program(kind, fn, owner=owner)
-
-
-_AOT_MISS = object()     # sentinel: "the AOT capture path produced nothing"
-_DEMOTE_MISSES = 8       # consecutive signature misses → demote to jit
-_DEMOTE_MISS_TOTAL = 64  # lifetime misses → demote even if hits interleave
-
-
-def _instrument_program(kind, fn, owner=None, matmul_env=False):
-    """Wrap a freshly built jit program with the build-seam diagnostics.
-
-    First invocation — the one that pays tracing + XLA compilation —
-    lands in ``executor_compile_ms{kind=...}``. When cost introspection
-    is on (``MXTPU_DIAG_COST``, default), that first call compiles the
-    program EXPLICITLY via the AOT path (``fn.lower(...).compile()`` —
-    the same work jit would do lazily, not an extra compile), captures
-    ``cost_analysis``/``memory_analysis`` into the diagnostics program
-    registry, and keeps the compiled executable as the dispatch fast
-    path. A later call with a different signature (dtype/shape/sharding
-    change) falls back to the jit function, which retraces per signature
-    exactly as before.
-
-    ``matmul_env`` preserves the ``MXTPU_MATMUL_PRECISION`` contract for
-    Executor programs: every call re-reads the env, and while it is set
-    both the AOT capture and any previously captured executable are
-    bypassed (flipping it retraces rather than returning stale
-    programs); a first call made while it is set defers the capture to
-    the first call after it clears."""
-    import os as _os
-    import time as _time
-    # keep only the owner's NAME: the wrapper outlives the owner in
-    # process-global caches (metric.py _ACCUM_FN_CACHE), and a closure
-    # ref would pin the accumulator's device arrays for the process life
-    owner = _diag.owner_name(owner)
-    # "first" is guarded by the lock: wrappers live in process-global
-    # caches (metric.py _ACCUM_FN_CACHE), so two fit threads can race the
-    # first invocation — unguarded, both would pay the XLA compile and
-    # register duplicate ProgramRecords. Losers block until the winner's
-    # executable is visible; the steady-state path never takes the lock.
-    state = {"first": True, "timed": False, "compiled": None, "rec": None,
-             "misses": 0, "miss_total": 0, "lock": _threading.Lock()}
-
-    def _plain(args, kwargs):
-        if matmul_env:
-            prec = _os.environ.get("MXTPU_MATMUL_PRECISION")
-            if prec:
-                with jax.default_matmul_precision(prec):
-                    return fn(*args, **kwargs)
-        return fn(*args, **kwargs)
-
-    def _first_call(args, kwargs):
-        t0 = _time.perf_counter()
-        out = _AOT_MISS
-        if _diag.cost_enabled() and hasattr(fn, "lower"):
-            # only lower/compile/record may fall back to jit: a RUNTIME
-            # failure of the first execution must propagate — fused_step
-            # donates its params/opt_state, so re-running via _plain would
-            # see deleted arrays and mask the real error (e.g. an OOM)
-            exe = None
-            try:
-                exe = fn.lower(*args, **kwargs).compile()
-                state["rec"] = _diag.record_program(
-                    kind, owner, exe, (_time.perf_counter() - t0) * 1e3)
-                # SPMD shape of the program: devices spanned + how many
-                # arg leaves are mesh-split vs replicated (read off the
-                # live args — the one place both are in hand)
-                _diag.summarize_shardings(state["rec"], args)
-            except Exception:
-                exe = None
-                state["compiled"] = None
-            if exe is not None:
-                state["compiled"] = exe
-                out = exe(*args, **kwargs)
-                rec = state["rec"]
-                if rec is not None:
-                    rec.calls += 1
-        if out is _AOT_MISS:
-            out = _plain(args, kwargs)
-        _tel.histogram("executor_compile_ms",
-                       labels={"kind": kind}).observe(
-            (_time.perf_counter() - t0) * 1e3)
-        return out
-
-    def _dispatch(args, kwargs):
-        # the env contract is per CALL: a precision set after the first
-        # call must still take effect, so it disables the AOT fast path
-        # for as long as it is set (jit retraces under the context)
-        prec_set = matmul_env and _os.environ.get("MXTPU_MATMUL_PRECISION")
-        if state["first"]:
-            if prec_set:
-                # don't consume the first-call slot under the precision
-                # env: capture is DEFERRED to the first call after it
-                # clears ("while it is set" contract) — consuming it here
-                # would leave the program table empty for process life.
-                # The literal first call still feeds executor_compile_ms
-                # (it pays jit's lazy compile), matching the pre-capture
-                # contract that first-call time is always observed
-                if not state["timed"]:
-                    state["timed"] = True   # benign race: extra observe
-                    t0 = _time.perf_counter()
-                    out = _plain(args, kwargs)
-                    _tel.histogram("executor_compile_ms",
-                                   labels={"kind": kind}).observe(
-                        (_time.perf_counter() - t0) * 1e3)
-                    return out
-                return _plain(args, kwargs)
-            with state["lock"]:
-                if state["first"]:
-                    try:
-                        return _first_call(args, kwargs)
-                    finally:
-                        state["first"] = False
-            # lost the first-call race: fall through — the winner's
-            # executable (if any) is visible once the lock is released
-        compiled = state["compiled"] if not prec_set else None
-        if compiled is not None:
-            rec = state["rec"]
-            if rec is not None:
-                rec.calls += 1
-            try:
-                out = compiled(*args, **kwargs)
-                state["misses"] = 0
-                return out
-            except (TypeError, ValueError):
-                # signature changed under us — dtype/shape (TypeError) or
-                # device/sharding (ValueError), both raised at argument
-                # binding, BEFORE any donation/execution: serve this call
-                # via jit (which retraces per signature and faithfully
-                # re-raises truly invalid arguments) but KEEP the
-                # executable — a partial final batch must not evict the
-                # steady-state signature's fast path and force jit to
-                # recompile it from scratch mid-run. CONSECUTIVE misses
-                # mean the workload's signature moved for good (a second
-                # fit at a new batch size reusing this process-cached
-                # wrapper); ALTERNATING signatures (bucketed training —
-                # hits reset the consecutive count so it never trips)
-                # are caught by the lifetime total instead. Either way
-                # demote to jit — it retraces once per signature and
-                # serves all of them from its own cache — rather than
-                # paying a failed binding + raised exception per call
-                state["misses"] += 1
-                state["miss_total"] += 1
-                if state["misses"] >= _DEMOTE_MISSES \
-                        or state["miss_total"] >= _DEMOTE_MISS_TOTAL:
-                    state["compiled"] = None
-                return _plain(args, kwargs)
-        rec = state["rec"]
-        if rec is not None:   # env-bypass dispatches still count
-            rec.calls += 1
-        return _plain(args, kwargs)
-
-    def wrapped(*args, **kwargs):
-        out = _dispatch(args, kwargs)
-        san = _OUTPUT_SANITIZER
-        if san is not None:
-            san(kind, out)
-        return out
-
-    return wrapped
 
 
 def _block_boundaries(symbol):
@@ -474,6 +257,12 @@ class Executor:
         self.outputs = []
         self._pending_grads = None
         self._fns = {}
+        self._fns_config = ()   # pipeline config the program table is for
+        # compile-pipeline state: the (possibly transformed) graph the
+        # traced programs are built from, cached per active pipeline
+        # config, and the report of what the transforms did/rejected
+        self._xform = None
+        self.pipeline_report = None
         self._monitor_callback = None
         # Adaptive heads-mode: callers that drive backward(out_grads)
         # (Module's unfused path with an external loss — the reference's
@@ -517,22 +306,63 @@ class Executor:
         return [n for n in self.arg_names
                 if self.grad_req.get(n, "null") != "null" and n in self.grad_dict]
 
+    def _program_symbol(self, names):
+        """The graph the traced programs compile: the bind symbol run
+        through the compile pipeline (mxtpu/compile/pipeline.py). With
+        the pipeline empty — the default — this IS ``self._symbol``,
+        cost one tuple compare per build. The transform result is cached
+        per pipeline config; every accepted rewrite was re-proven by the
+        verifier suite before landing here. ``names`` is the config the
+        CALLER resolved — resolved exactly once per build, so a
+        concurrent ``configure()`` cannot split the table's config stamp
+        from the graph the program was actually built against."""
+        if self._xform is not None and self._xform[0] == names:
+            return self._xform[1]
+        if not names:
+            sym = self._symbol
+            self.pipeline_report = None
+        else:
+            shapes = {n: tuple(v.shape)
+                      for d in (self.arg_dict, self.aux_dict)
+                      for n, v in d.items() if v is not None}
+            types = {n: v.dtype
+                     for d in (self.arg_dict, self.aux_dict)
+                     for n, v in d.items() if v is not None}
+            sym, self.pipeline_report = _pipeline.transform_graph(
+                self._symbol, kind="executor", shapes=shapes, types=types)
+        self._xform = (names, sym)
+        return sym
+
+    def _precision_tag(self):
+        rep = self.pipeline_report
+        return rep.precision if rep is not None else None
+
     def _get_fn(self, kind):
+        # the program table is valid for ONE pipeline config: flipping
+        # the pipeline mid-life must not serve a program built from the
+        # other graph, so a config change drops the table (programs
+        # rebuild lazily; flipping back rebuilds too — correctness over
+        # caching for a debugging-time toggle)
+        names = _pipeline.configured()
+        if getattr(self, "_fns_config", ()) != names:
+            self._fns = {}
+            self._fns_config = names
         fn = self._fns.get(kind)
         if fn is not None:
             _M_CACHE_HITS.inc()
             return fn
         _notify_build(kind, self)
+        symbol = self._program_symbol(names)
         if kind == "fwd_eval":
-            run = _trace_graph(self._symbol, is_train=False,
+            run = _trace_graph(symbol, is_train=False,
                                placements=self._placements)
             fn = jax.jit(lambda a, x, r: run(a, x, r))
         elif kind == "fwd_train":
-            run = _trace_graph(self._symbol, is_train=True,
+            run = _trace_graph(symbol, is_train=True,
                                placements=self._placements)
             fn = jax.jit(lambda a, x, r: run(a, x, r))
         elif kind == "fwd_bwd":
-            run = _trace_graph(self._symbol, is_train=True,
+            run = _trace_graph(symbol, is_train=True,
                                placements=self._placements)
             gnames = tuple(self._grad_arg_names())
 
@@ -554,7 +384,7 @@ class Executor:
 
             fn = jax.jit(fb)
         elif kind == "fwd_bwd_heads":
-            run = _trace_graph(self._symbol, is_train=True,
+            run = _trace_graph(symbol, is_train=True,
                                placements=self._placements)
             gnames = tuple(self._grad_arg_names())
 
@@ -579,7 +409,7 @@ class Executor:
             # is a registered pytree (its leaves are the saved residuals),
             # so it round-trips through jit; holding it keeps the
             # residuals alive on device until backward consumes them.
-            run = _trace_graph(self._symbol, is_train=True,
+            run = _trace_graph(symbol, is_train=True,
                                placements=self._placements)
             gnames = tuple(self._grad_arg_names())
 
@@ -606,7 +436,8 @@ class Executor:
             fn = jax.jit(va)
         else:
             raise MXNetError("unknown program kind %s" % kind)
-        fn = _instrument_program(kind, fn, owner=self, matmul_env=True)
+        fn = _instrument_program(kind, fn, owner=self, matmul_env=True,
+                                 precision=self._precision_tag())
         self._fns[kind] = fn
         return fn
 

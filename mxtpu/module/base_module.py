@@ -419,15 +419,21 @@ class BaseModule:
             eval_metric._device_accum = None
 
 
-    def check(self, passes=None):
+    def check(self, passes=None, pipeline=None):
         """Run the mxtpu.analysis verifier passes with everything this
         module knows — the bound data/label shapes, the provided
         parameter names (unused-arg detection), and the live fused train
         step (donation-safety audit). Returns a
         :class:`~mxtpu.analysis.Report`; ``report.ok`` is False when
-        anything at warning severity or above fired."""
+        anything at warning severity or above fired.
+
+        ``pipeline`` (a transform-name list, comma string, or True for
+        the configured pipeline) additionally dry-runs the compile
+        pipeline's transform passes and merges what each did — per-node
+        provenance, acceptance/rejection with the offending Finding —
+        into the report."""
         from ..analysis import check_module
-        return check_module(self, passes=passes)
+        return check_module(self, passes=passes, pipeline=pipeline)
 
     # ------------------------------------------------ symbol/params accessors
     @property

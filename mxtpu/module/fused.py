@@ -19,6 +19,7 @@ one up to reduction order.
 """
 from __future__ import annotations
 
+import logging
 import math
 import threading
 
@@ -30,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import diagnostics as _diag
 from .. import random as _rnd
 from ..base import NumericsError
+from ..compile import pipeline as _pipeline
 from ..executor import _trace_graph
 from ..ops import optimizer_ops as _ops
 
@@ -250,12 +252,47 @@ class FusedTrainStep:
 
     ``state``: pass an existing FusedState to share weights/opt-state with
     other steps (bucketing); omitted, a private store is created.
+
+    ``graph_shapes``/``graph_types``: inference hints (data/label/param
+    shapes) for the compile pipeline's analyses and its verifier re-run;
+    ``module`` feeds the module-scoped verifier passes (donation,
+    sharding_consistency) when a transform's output is re-proven.
     """
 
     def __init__(self, symbol, devices, param_names, data_names, label_names,
                  optimizer, fixed_param_names=(), logger=None, state=None,
-                 plan=None):
+                 plan=None, graph_shapes=None, graph_types=None,
+                 module=None):
         self.symbol = symbol
+        # the graph the step PROGRAM is built from: the bind symbol run
+        # through the compile pipeline (bf16 mixed-precision rewrite
+        # etc.); self.symbol stays the caller's unrewritten graph —
+        # checkpoints, list_arguments and Module.check all speak it.
+        # Every accepted rewrite was re-proven by the verifier suite
+        # (transform_graph rejects and falls back otherwise).
+        self._graph_symbol = symbol
+        self.pipeline_report = None
+        self._logger = logger
+        # the step resolves the pipeline ONCE, here: the traced program
+        # keeps this graph for its life. step() warns (once) if the
+        # global config drifts afterwards — re-arm via
+        # init_optimizer(force_init=True) to apply a new pipeline
+        self._pipeline_config = _pipeline.configured()
+        self._drift_warned = False
+        if _pipeline.configured():
+            self._graph_symbol, self.pipeline_report = \
+                _pipeline.transform_graph(
+                    symbol, kind="fused_step", shapes=graph_shapes,
+                    types=graph_types, module=module)
+            if logger is not None and self.pipeline_report.rejected:
+                logger.warning(
+                    "fused step: compile pipeline rejected transform(s) "
+                    "%s — training on the unrewritten graph",
+                    ",".join(self.pipeline_report.rejected))
+            elif logger is not None and self.pipeline_report.applied:
+                logger.info(
+                    "fused step: compile pipeline applied %s",
+                    ",".join(self.pipeline_report.applied))
         self.devices = list(devices)
         self.param_names = list(param_names)
         self.fixed = set(fixed_param_names or ())
@@ -314,14 +351,18 @@ class FusedTrainStep:
         tags = None
         if self._remat in ("block", "conv"):
             from ..executor import _block_boundaries
-            tags = {i: "mxtpu_boundary" for i in _block_boundaries(symbol)}
+            # remat tags key on node ids, so they must come from the
+            # SAME graph the step traces — the pipeline-transformed one
+            tags = {i: "mxtpu_boundary"
+                    for i in _block_boundaries(self._graph_symbol)}
             if self._remat == "conv":
-                for n in symbol._topo():
+                for n in self._graph_symbol._topo():
                     if (not n.is_variable
                             and n.op.name in ("Convolution", "FullyConnected")
                             and id(n) not in tags):
                         tags[id(n)] = "mxtpu_conv"
-        self._run = _trace_graph(symbol, is_train=True, remat_tags=tags)
+        self._run = _trace_graph(self._graph_symbol, is_train=True,
+                                 remat_tags=tags)
         self._mesh = None
         self._plan = None
         if plan is not None and len(plan.mesh_ctx.devices) > 1:
@@ -520,6 +561,20 @@ class FusedTrainStep:
     # ------------------------------------------------ per-step driver
     def step(self, data_arrays, label_arrays):
         """Run one fused step; returns the outputs (device arrays)."""
+        if _pipeline.configured() != self._pipeline_config \
+                and not self._drift_warned:
+            # the Executor rebuilds its (cheap, stateless) programs on a
+            # config flip; the fused step cannot — its state buffers are
+            # donated into the compiled program — so a silent flip would
+            # leave train on one graph and eval on another. Say so once.
+            self._drift_warned = True
+            (self._logger or logging).warning(
+                "fused step: compile pipeline config changed %s -> %s "
+                "after the step was built; the step keeps the graph it "
+                "compiled. Re-run init_optimizer(force_init=True) or "
+                "rebuild the module to apply the new pipeline",
+                list(self._pipeline_config),
+                list(_pipeline.configured()))
         opt = self.optimizer
         lrs = _np.empty(len(self.trainable), _np.float32)
         wds = _np.empty(len(self.trainable), _np.float32)
@@ -546,8 +601,10 @@ class FusedTrainStep:
             # Executor program-table path
             from ..executor import record_program_build
             self._build()
-            self._step_fn = record_program_build("fused_step", self,
-                                                 self._step_fn)
+            rep = self.pipeline_report
+            self._step_fn = record_program_build(
+                "fused_step", self, self._step_fn,
+                precision=rep.precision if rep is not None else None)
         try:
             self.params, self.aux, self.opt_state, outs = self._step_fn(
                 self.params, self.aux, self.opt_state, batch,

@@ -392,19 +392,27 @@ class Symbol:
     def grad(self, wrt):
         raise MXNetError("Symbol.grad: use bind + backward")
 
-    def lint(self, shapes=None, group2ctx=None, passes=None, **kwargs):
+    def lint(self, shapes=None, group2ctx=None, passes=None,
+             pipeline=None, **kwargs):
         """Run the mxtpu.analysis verifier passes over this symbol and
         return a :class:`~mxtpu.analysis.Report` of structured findings
         (shape/dtype verification with provenance, dead code, name
         collisions, ctx-group mismatches, NaN-prone numerics patterns).
         Shape hints go in ``shapes={...}`` or as kwargs, exactly like
-        ``infer_shape``: ``sym.lint(data=(64, 784))``."""
+        ``infer_shape``: ``sym.lint(data=(64, 784))``.
+
+        ``pipeline`` additionally dry-runs compile-pipeline transform
+        passes and merges their per-node action/rejection findings into
+        the report: a list of transform names, a comma string
+        (``pipeline="bf16"``), or ``True`` for the process-configured
+        pipeline. The symbol itself is never modified."""
         from ..analysis import analyze
         hints = dict(shapes or {})
         hints.update({k: tuple(v) for k, v in kwargs.items()
                       if v is not None})
-        return analyze(self, shapes=hints, group2ctx=group2ctx,
-                       passes=passes)
+        report = analyze(self, shapes=hints, group2ctx=group2ctx,
+                         passes=passes)
+        return _merge_pipeline_report(report, self, hints, pipeline)
 
     # ------------------------------------------------ serialization
     def tojson(self):
@@ -446,6 +454,32 @@ class Symbol:
             ins = ", ".join(n.name for n, _ in node.inputs)
             lines.append("%s %s(%s)" % (kind, node.name, ins))
         return "\n".join(lines)
+
+
+def _merge_pipeline_report(report, symbol, hints, pipeline, module=None):
+    """Dry-run compile-pipeline transforms and fold their findings into
+    ``report`` (the ``lint(pipeline=)`` / ``Module.check(pipeline=)`` /
+    CLI ``--pipeline`` surface). ``pipeline`` is a name list, a comma
+    string, or True for the process-configured pipeline."""
+    if not pipeline:
+        return report
+    from ..analysis import Report
+    from ..compile import pipeline as _pipe
+    if pipeline is True:
+        names = None  # transform_graph falls back to configured()
+        shown = list(_pipe.configured())
+    elif isinstance(pipeline, str):
+        names = [p.strip() for p in pipeline.split(",") if p.strip()]
+        shown = names
+    else:
+        names = [str(p) for p in pipeline]
+        shown = names
+    _sym2, prep = _pipe.transform_graph(symbol, kind="report",
+                                        shapes=hints, module=module,
+                                        passes=names)
+    return Report(list(report.findings) + prep.findings(),
+                  passes_run=list(report.passes_run)
+                  + ["pipeline:%s" % n for n in shown])
 
 
 def _output_names(node, n_vis):

@@ -367,11 +367,13 @@ def test_sanitizer_modes_nan_vs_inf():
 
 
 def test_sanitizer_disabled_is_unhooked():
-    from mxtpu import executor as ex_mod
+    # the hook seam lives in the compile pipeline since PR 7 (the
+    # executor re-exports set_output_sanitizer for compatibility)
+    from mxtpu.compile import pipeline as pipe_mod
     analysis.sanitizer_enable("all")
-    assert ex_mod._OUTPUT_SANITIZER is not None
+    assert pipe_mod._OUTPUT_SANITIZER is not None
     analysis.sanitizer_disable()
-    assert ex_mod._OUTPUT_SANITIZER is None
+    assert pipe_mod._OUTPUT_SANITIZER is None
     sym = S.log(S.Variable("data"))
     ex = sym.bind(mx.cpu(), {"data": mx.nd.array([[-1.0]])})
     out = ex.forward()  # nan flows through unchecked — no raise

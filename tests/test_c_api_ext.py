@@ -35,6 +35,9 @@ def _env():
     return dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP ops note, PR 7):
+# heaviest non-gate tests run in the slow tier (-m slow) so the
+# 870s dots-in-window metric keeps measuring the whole fast tier
 def test_c_ext_groups(tmp_path):
     """CachedOp + profiler + BindEX + Reshape + MXCustomOpRegister."""
     ok, log = _build()

@@ -1,0 +1,447 @@
+"""mxtpu.compile + mxtpu.analysis v2: the dataflow-analysis engine
+(precision-flow, liveness), the transform-pass pipeline seam carved out
+of executor.py, and the bf16 mixed-precision rewrite behind it.
+
+Acceptance gates:
+* parity — a bf16-rewritten mlp/lenet fit matches the f32 fit (integer
+  metrics exact-or-gated, ce within documented tolerance, master
+  weights stay f32);
+* safety — every transformed graph re-passes the full verifier suite
+  before compile, and a transform that violates a verifier pass is
+  REJECTED with the offending Finding and the build falls back to the
+  unrewritten graph;
+* seam — with the pipeline empty the executor build path is
+  byte-identical in behavior (existing dispatch/AOT/demotion tests in
+  test_diagnostics.py keep covering the instrumentation that moved).
+"""
+import logging
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+import mxtpu.symbol as S
+from mxtpu import analysis
+from mxtpu import diagnostics as diag
+from mxtpu.analysis import dataflow, rewrite
+from mxtpu.compile import pipeline
+from mxtpu.models import lenet, mlp
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _fit(symbol, names, n=256, dim=784, classes=10, batch=64, epochs=2,
+         seed=7, image=False):
+    rng = np.random.RandomState(0)
+    if image:
+        X = rng.rand(n, 1, 28, 28).astype(np.float32)
+    else:
+        X = rng.rand(n, dim).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, classes, n).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(symbol, context=mx.cpu(),
+                        logger=logging.getLogger("quiet"))
+    mod.logger.setLevel(logging.ERROR)
+    metric = mx.metric.create(["acc", "ce"])
+    with pipeline.pipeline_scope(names):
+        mx.random.seed(seed)
+        np.random.seed(seed)
+        mod.fit(it, num_epoch=epochs, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1},
+                eval_metric=metric)
+    args, _ = mod.get_params()
+    vals = dict(zip(*metric.get()))
+    return mod, {k: v.asnumpy() for k, v in args.items()}, vals
+
+
+# ------------------------------------------------------------ dataflow engine
+def test_precision_flow_classifies_mlp():
+    sym = mlp.get_symbol(10)
+    plan = dataflow.precision_flow(sym, shapes={"data": (64, 784)})
+    by_name = {n.name: plan.classes[id(n)] for n in sym._topo()
+               if not n.is_variable}
+    # matmul compute and its elementwise followers are bf16-safe
+    for node in ("fc1", "relu1", "fc2", "relu2", "fc3"):
+        assert by_name[node] == dataflow.BF16_SAFE, (node, by_name)
+    # the loss head is an f32 island
+    assert by_name["softmax"] == dataflow.F32_ISLAND
+    # every FC weight/bias demands a master copy
+    for p in ("fc1_weight", "fc1_bias", "fc2_weight", "fc3_weight"):
+        assert plan.var_class[p] == dataflow.MASTER_WEIGHT
+    # data feeds a bf16 node too (cast at use), label does not
+    assert plan.var_class["softmax_label"] == dataflow.F32_ISLAND
+
+
+def test_precision_flow_islands_norm_and_explog():
+    data = S.Variable("data")
+    conv = S.Convolution(data, kernel=(3, 3), num_filter=8, name="conv")
+    bn = S.BatchNorm(conv, name="bn")
+    act = S.Activation(bn, act_type="relu", name="act")
+    e = S.exp(act, name="e")
+    plan = dataflow.precision_flow(
+        S.Group([e]), shapes={"data": (2, 3, 8, 8)})
+    by_name = {n.name: plan.classes[id(n)] for n in e._topo()
+               if not n.is_variable}
+    assert by_name["conv"] == dataflow.BF16_SAFE
+    assert by_name["bn"] == dataflow.F32_ISLAND   # normalization stats
+    assert by_name["e"] == dataflow.F32_ISLAND    # exp overflows in bf16
+    # the relu between two islands follows its f32 producer
+    assert by_name["act"] == dataflow.F32_ISLAND
+
+
+def test_precision_flow_reasons_and_findings():
+    sym = mlp.get_symbol(10)
+    plan = dataflow.precision_flow(sym, shapes={"data": (64, 784)})
+    findings = plan.to_findings()
+    assert all(f.severity == analysis.INFO for f in findings)
+    fc1 = [f for f in findings if f.node == "fc1"]
+    assert fc1 and "bf16-safe" in fc1[0].message
+    assert "matmul" in fc1[0].message
+
+
+def test_liveness_last_use_and_peak():
+    sym = mlp.get_symbol(10)
+    info = dataflow.liveness(sym, shapes={"data": (64, 784)})
+    assert info.complete
+    # the head stays live to the end; its bytes are known exactly
+    assert info.head_bytes == 64 * 10 * 4
+    assert info.peak_live_bytes > 0
+    # fc1's activation (64x128 f32) must die before the walk ends:
+    # its last use is relu1, not the head
+    topo = sym._topo()
+    idx = {n.name: i for i, n in enumerate(topo)}
+    fc1 = [n for n in topo if n.name == "fc1"][0]
+    assert info.last_use[(id(fc1), 0)] == idx["relu1"]
+    assert info.last_use[(id(fc1), 0)] < len(topo)
+
+
+def test_liveness_cross_checks_executor_ledger():
+    """The live-set at the end of the walk is exactly the graph outputs,
+    and the ledger's executor_outputs slot accounts those same buffers —
+    the dataflow estimate and the runtime slot model must agree."""
+    sym = mlp.get_symbol(10)
+    ex = sym.simple_bind(ctx=mx.cpu(), data=(8, 784))
+    ex.forward(is_train=False,
+               data=mx.nd.array(np.zeros((8, 784), np.float32)))
+    findings = dataflow.liveness_ledger_check(ex)
+    assert findings == [], [f.render() for f in findings]
+
+
+# ------------------------------------------------------- pipeline seam/config
+def test_pipeline_empty_is_default_and_identity():
+    assert pipeline.configured() == ()
+    sym = mlp.get_symbol(10)
+    sym2, rep = pipeline.transform_graph(sym, kind="test")
+    assert sym2 is sym
+    assert not rep.symbol_changed and rep.entries == []
+
+
+def test_pipeline_scope_and_env_reset():
+    with pipeline.pipeline_scope(["bf16"]):
+        assert pipeline.configured() == ("bf16",)
+        with pipeline.pipeline_scope([]):
+            assert pipeline.configured() == ()
+    assert pipeline.configured() == ()
+
+
+def test_executor_program_builds_unchanged_with_empty_pipeline():
+    """Seam acceptance: the executor's build path routed through
+    mxtpu/compile/pipeline.py must not change observable build behavior
+    when the pipeline is empty."""
+    sym = mlp.get_symbol(10)
+    ex = sym.simple_bind(ctx=mx.cpu(), data=(8, 784))
+    before = mx.executor.program_build_count()
+    ex.forward(is_train=False,
+               data=mx.nd.array(np.zeros((8, 784), np.float32)))
+    assert mx.executor.program_build_count() == before + 1
+    ex.forward(is_train=False,
+               data=mx.nd.array(np.ones((8, 784), np.float32)))
+    assert mx.executor.program_build_count() == before + 1  # cache hit
+
+
+def test_transform_registry_lists_bf16():
+    names = [n for n, _ in rewrite.list_transforms()]
+    assert "bf16" in names
+    with pytest.raises(mx.MXNetError):
+        rewrite.get_transform("no_such_transform")
+
+
+# ------------------------------------------------------------- bf16 rewrite
+def test_bf16_rewrite_graph_structure():
+    sym = mlp.get_symbol(10)
+    sym2, rep = pipeline.transform_graph(
+        sym, kind="test", shapes={"data": (64, 784)}, passes=["bf16"])
+    assert rep.symbol_changed and rep.applied == ["bf16"]
+    # arguments/aux unchanged: checkpoints and bind dicts still fit
+    assert sym2.list_arguments() == sym.list_arguments()
+    assert sym2.list_outputs() == sym.list_outputs()
+    # output dtype contract preserved (head cast back to f32)
+    _, out_types, _ = sym2.infer_type(data="float32")
+    assert out_types == [np.dtype("float32")]
+    # weights are cast at use: a Cast node feeds each FullyConnected
+    dbg = sym2.debug_str()
+    assert "fc1_weight_bf16_amp" in dbg and "fc3_f32_amp" in dbg
+    # the transformed graph re-passes the verifier suite under the same
+    # (enriched) hints every bound consumer has: a Cast between weight
+    # and FC blocks the top-down infer_args backfill, so the pipeline
+    # pins variables to what the unrewritten graph proved about them
+    arg_shapes, _, _ = sym.infer_shape(data=(64, 784))
+    hints = dict(zip(sym.list_arguments(), arg_shapes))
+    assert not sym2.lint(shapes=hints).errors
+
+
+def test_bf16_rewrite_reports_per_node_provenance():
+    sym = mlp.get_symbol(10)
+    report = sym.lint(data=(64, 784), pipeline="bf16")
+    msgs = [f for f in report if f.pass_name == "bf16"]
+    assert msgs, report.render()
+    fc1 = [f for f in msgs if f.node == "fc1"]
+    assert fc1 and "computes in bf16" in fc1[0].message
+    assert "fc1_weight" in fc1[0].provenance
+    applied = [f for f in report if f.pass_name == "pipeline"]
+    assert applied and "applied" in applied[0].message
+
+
+def test_bf16_skips_graph_with_no_compute():
+    sym = S.exp(S.Variable("data"), name="e")
+    sym2, rep = pipeline.transform_graph(
+        sym, kind="test", shapes={"data": (4, 4)}, passes=["bf16"])
+    assert sym2 is sym and rep.applied == []
+    acts = rep.entries[0]["actions"]
+    assert any("rewrite skipped" in f.message for f in acts)
+
+
+# --------------------------------------------------------- rejection path
+class _BreakingPass(rewrite.TransformPass):
+    """Deliberately unsound transform: duplicates the head node under a
+    name that collides with an existing node — the name_collision
+    verifier must reject it."""
+
+    name = "_test_breaker"
+
+    def run(self, tctx):
+        from mxtpu.symbol.symbol import Symbol, _Node
+        head, idx = tctx.symbol._outputs[0]
+        clash = None
+        for n in tctx.symbol._topo():
+            if not n.is_variable and n is not head:
+                clash = n
+                break
+        dup = _Node(head.op, clash.name, dict(head.attrs),
+                    list(head.inputs))
+        self.action(tctx, "duplicated head under colliding name '%s'"
+                    % clash.name)
+        return Symbol([(dup, idx)])
+
+
+def test_rejected_rewrite_surfaces_finding_and_falls_back():
+    rewrite._TRANSFORMS.setdefault("_test_breaker", _BreakingPass())
+    try:
+        sym = mlp.get_symbol(10)
+        sym2, rep = pipeline.transform_graph(
+            sym, kind="test", shapes={"data": (64, 784)},
+            passes=["_test_breaker"])
+        # fallback: the unrewritten graph is returned
+        assert sym2 is sym
+        assert rep.rejected == ["_test_breaker"] and rep.applied == []
+        offending = rep.entries[0]["offending"]
+        assert offending, rep.render()
+        assert offending[0].pass_name == "name_collision"
+        assert offending[0].severity == analysis.ERROR
+        # the report surface shows the rejection with the Finding
+        fs = rep.findings()
+        assert any("REJECTED" in f.message and "name_collision"
+                   in f.message for f in fs)
+    finally:
+        rewrite._TRANSFORMS.pop("_test_breaker", None)
+
+
+def test_rejected_rewrite_fit_still_trains():
+    """End to end: a rejected transform must not break training — the
+    fused step silently builds from the unrewritten graph."""
+    rewrite._TRANSFORMS.setdefault("_test_breaker", _BreakingPass())
+    try:
+        mod, w, vals = _fit(mlp.get_symbol(10), ["_test_breaker"],
+                            epochs=1)
+        assert mod._fused is not None
+        assert mod._fused.pipeline_report.rejected == ["_test_breaker"]
+        assert mod._fused._graph_symbol is mod._fused.symbol
+        assert np.isfinite(vals["cross-entropy"])
+    finally:
+        rewrite._TRANSFORMS.pop("_test_breaker", None)
+
+
+def test_crashing_transform_is_skipped_not_fatal():
+    class _Crasher(rewrite.TransformPass):
+        name = "_test_crasher"
+
+        def run(self, tctx):
+            raise RuntimeError("boom")
+
+    rewrite._TRANSFORMS.setdefault("_test_crasher", _Crasher())
+    try:
+        sym = mlp.get_symbol(10)
+        sym2, rep = pipeline.transform_graph(
+            sym, kind="test", shapes={"data": (64, 784)},
+            passes=["_test_crasher", "bf16"])
+        assert rep.entries[0]["error"] is not None
+        assert rep.applied == ["bf16"] and rep.symbol_changed
+        assert sym2 is not sym
+    finally:
+        rewrite._TRANSFORMS.pop("_test_crasher", None)
+
+
+# ------------------------------------------------------------- parity gates
+@pytest.mark.parametrize("model,kw", [
+    ("mlp", {}),
+    ("lenet", {"image": True}),
+])
+def test_bf16_parity_gate(model, kw):
+    """THE acceptance gate: bf16-rewritten fit vs f32 fit on the same
+    data/seed. Integer-summed metrics (accuracy counts) exact or within
+    the documented gate; ce within tolerance; master weights f32 and
+    within the quantization-drift envelope."""
+    get = mlp.get_symbol if model == "mlp" else lenet.get_symbol
+    _, w32, v32 = _fit(get(10), [], **kw)
+    mod, wbf, vbf = _fit(get(10), ["bf16"], **kw)
+    # the fused step really built from the rewritten graph
+    assert mod._fused is not None
+    assert mod._fused.pipeline_report.applied == ["bf16"]
+    assert mod._fused._graph_symbol is not mod._fused.symbol
+    # master weights stay f32 on device
+    for name, leaf in mod._fused.params.items():
+        assert str(leaf.dtype) == "float32", (name, leaf.dtype)
+    for name, st in mod._fused.opt_state.items():
+        import jax
+        for leaf in jax.tree.leaves(st):
+            assert str(leaf.dtype) == "float32", (name, leaf.dtype)
+    # integer metric: accuracy over 256 samples — exact-or-gated at
+    # one reclassified sample per 128 (bf16 forward can flip an argmax
+    # that sits on a decision boundary)
+    assert abs(v32["accuracy"] - vbf["accuracy"]) <= 2 / 256.0, \
+        (v32, vbf)
+    # ce within documented tolerance (docs/compile.md): bf16 activations
+    # carry ~3 decimal digits; after softmax the loss agrees to ~1e-2
+    assert abs(v32["cross-entropy"] - vbf["cross-entropy"]) < 1e-2, \
+        (v32, vbf)
+    # weights drift only by accumulated quantized-gradient deltas
+    for k in w32:
+        assert np.max(np.abs(w32[k] - wbf[k])) < 5e-3, k
+
+
+def test_bf16_program_record_tagged():
+    diag.programs  # module import sanity
+    _fit(mlp.get_symbol(10), ["bf16"], epochs=1)
+    recs = diag.programs("fused_step")
+    assert recs, "fused_step program not captured"
+    assert recs[-1]["precision"] == "mixed_bf16"
+    table = diag.program_table("fused_step")
+    assert "prec" in table.splitlines()[0]
+    assert "mixed_bf16" in table
+
+
+def test_module_check_reports_pipeline():
+    X = np.zeros((64, 784), np.float32)
+    y = np.zeros(64, np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=64,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(mlp.get_symbol(10), context=mx.cpu(),
+                        logger=logging.getLogger("quiet"))
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    report = mod.check(pipeline="bf16")
+    assert any(f.pass_name == "bf16" for f in report)
+    assert any(f.pass_name == "pipeline" and "applied" in f.message
+               for f in report)
+
+
+# ------------------------------------------------------ sanitizer interplay
+def test_sanitizer_bf16_fused_step_trips_and_adopts_state():
+    """Satellite gate: a bf16-rewritten fused step under MXTPU_SANITIZE
+    still trips on injected NaN, the postmortem names the precision
+    mode, and the module's state holds readable (non-donated) buffers
+    afterwards."""
+    X = np.random.RandomState(0).rand(128, 784).astype(np.float32)
+    X[70] = np.nan
+    y = np.zeros(128, np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=64,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(mlp.get_symbol(10), context=mx.cpu(),
+                        logger=logging.getLogger("quiet"))
+    mod.logger.setLevel(logging.CRITICAL)
+    analysis.sanitizer_enable("nan")
+    try:
+        with pipeline.pipeline_scope(["bf16"]):
+            with pytest.raises(analysis.NumericsError) as ei:
+                mod.fit(it, num_epoch=1, optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.1})
+    finally:
+        analysis.sanitizer_disable()
+    assert "precision=" in str(ei.value)
+    assert "bf16" in str(ei.value)  # pipeline mode reported
+    pm = diag.last_postmortem()
+    assert pm is not None and pm["source"] == "sanitizer"
+    # donation recovery: the fused state was adopted from the failed
+    # step — every leaf is readable, none deleted
+    import jax
+    for leaf in jax.tree.leaves((mod._fused.params, mod._fused.aux,
+                                 mod._fused.opt_state)):
+        assert not leaf.is_deleted()
+
+
+def test_sanitizer_flag_reduce_upcasts_bf16():
+    """The flag-reduce must classify bf16 values correctly (upcast to
+    f32 before isnan/isinf) — a bf16 NaN trips, a large-but-finite bf16
+    value does not."""
+    import jax.numpy as jnp
+    analysis.sanitizer_enable("all")
+    try:
+        ok = jnp.asarray([3e38], jnp.bfloat16)  # finite in bf16
+        analysis.sanitize_tree("probe", [ok])   # must not raise
+        bad = jnp.asarray([np.nan], jnp.bfloat16)
+        with pytest.raises(analysis.NumericsError) as ei:
+            analysis.sanitize_tree("probe", [bad])
+        assert "precision=bf16" in str(ei.value)
+    finally:
+        analysis.sanitizer_disable()
+
+
+# ------------------------------------------------------------- codebase lint
+def test_f64_lint_rule_units():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        from mxtpu_lint import lint_source
+    finally:
+        sys.path.pop(0)
+    src = (
+        "import numpy as np\n"
+        "class Hot:\n"
+        "    def f(self):\n"
+        "        a = np.zeros(5)\n"                       # flagged
+        "        b = np.array([0.5])\n"                   # flagged (+sync)
+        "        c = np.float64(3)\n"                     # flagged
+        "        d = np.zeros(5, np.float32)\n"           # ok: positional
+        "        # mxtpu: allow-f64(test fixture)\n"
+        "        e = np.ones(9)\n"                        # pragma'd
+        "        f = np.asarray([1, 2])\n"                # ok: int literals
+        "        g = np.empty(3, dtype=np.float32)\n"     # ok: dtype kw
+    )
+    found = [f for f in lint_source(src, "mxtpu/executor.py")
+             if f.rule == "f64-promotion"]
+    assert [f.line for f in found] == [4, 5, 6], found
+    # not-hot modules are exempt
+    assert [f for f in lint_source(src, "mxtpu/unlisted.py")
+            if f.rule == "f64-promotion"] == []
+
+
+def test_moved_build_lock_still_in_declared_hierarchy():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        from mxtpu_lint import _LOCK_RANK, HOT_PATHS
+    finally:
+        sys.path.pop(0)
+    assert ("pipeline", "_BUILD_LOCK") in _LOCK_RANK
+    assert "mxtpu/compile/pipeline.py" in HOT_PATHS

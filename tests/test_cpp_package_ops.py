@@ -98,6 +98,9 @@ def _accuracy_of(stdout):
 
 @pytest.mark.parametrize("name,floor", [("alexnet", 0.9),
                                         ("googlenet", 0.9)])
+@pytest.mark.slow  # tier-1 time budget (ROADMAP ops note, PR 7):
+# heaviest non-gate tests run in the slow tier (-m slow) so the
+# 870s dots-in-window metric keeps measuring the whole fast tier
 def test_cpp_example_convnets(tmp_path, name, floor):
     """Reference cpp-package conv examples (alexnet.cpp, googlenet.cpp):
     the full topologies composed through the generated op surface train
@@ -109,6 +112,9 @@ def test_cpp_example_convnets(tmp_path, name, floor):
     assert acc > floor, "%s reached only %.3f" % (name, acc)
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP ops note, PR 7):
+# heaviest non-gate tests run in the slow tier (-m slow) so the
+# 870s dots-in-window metric keeps measuring the whole fast tier
 def test_cpp_example_char_rnn(tmp_path):
     """Reference charRNN.cpp: primitive-op LSTM LM unrolled with shared
     weights learns next-char prediction and greedy-samples text."""
@@ -121,6 +127,9 @@ def test_cpp_example_char_rnn(tmp_path):
     assert len(sample.split(" ", 1)[1]) >= 20, out
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP ops note, PR 7):
+# heaviest non-gate tests run in the slow tier (-m slow) so the
+# 870s dots-in-window metric keeps measuring the whole fast tier
 def test_cpp_example_feature_extract(tmp_path):
     """Reference feature_extract flow: internal layer bound via
     GetInternals, weights transferred by name, features discriminative."""

@@ -1,5 +1,6 @@
 """Gluon RNN tests (mirrors tests/python/unittest/test_gluon_rnn.py)."""
 import numpy as np
+import pytest
 
 import mxtpu as mx
 from mxtpu import autograd, gluon
@@ -97,6 +98,9 @@ def test_gluon_zoneout_cell():
     assert outs.shape == (2, 4, 6)
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP ops note, PR 7):
+# heaviest non-gate tests run in the slow tier (-m slow) so the
+# 870s dots-in-window metric keeps measuring the whole fast tier
 def test_rnn_cell_trains_in_net():
     """Tiny seq classifier with a gluon LSTM trains under Trainer."""
     rng = np.random.RandomState(0)

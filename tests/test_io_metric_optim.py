@@ -228,6 +228,9 @@ def test_map_metric():
     assert abs(val2 - 0.5) < 1e-6
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP ops note, PR 7):
+# heaviest non-gate tests run in the slow tier (-m slow) so the
+# 870s dots-in-window metric keeps measuring the whole fast tier
 def test_benchmark_score_smoke():
     """tools/benchmark_score.py (parity example/image-classification/
     benchmark_score.py): the zoo inference sweep runs and reports img/s."""

@@ -245,6 +245,9 @@ def test_moe_expert_parallel():
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP ops note, PR 7):
+# heaviest non-gate tests run in the slow tier (-m slow) so the
+# 870s dots-in-window metric keeps measuring the whole fast tier
 def test_moe_topk_expert_parallel():
     """Top-2 expert-parallel MoE on the 8-device mesh: outputs must equal a
     single-device dense emulation of the same routing, and the aux loss
@@ -316,6 +319,9 @@ def test_moe_topk_capacity_drops():
     np.testing.assert_allclose(out[-1], np.asarray(x)[-1], rtol=1e-6)
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP ops note, PR 7):
+# heaviest non-gate tests run in the slow tier (-m slow) so the
+# 870s dots-in-window metric keeps measuring the whole fast tier
 def test_ring_and_ulysses_attention_gradients():
     """Backward through the sequence-parallel attentions must match the
     exact-attention gradients (training path correctness, not just fwd)."""
@@ -391,6 +397,9 @@ def test_pipeline_parallel_gradients():
                                np.asarray(want_p["w"]), atol=2e-4)
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP ops note, PR 7):
+# heaviest non-gate tests run in the slow tier (-m slow) so the
+# 870s dots-in-window metric keeps measuring the whole fast tier
 def test_moe_topk_gradients():
     """Backward through the expert-parallel exchange must match the dense
     emulation's gradients wrt inputs, gate logits, and expert weights."""

@@ -25,6 +25,9 @@ def _have_perl_toolchain():
 
 @pytest.mark.skipif(not _have_perl_toolchain(),
                     reason="perl + ExtUtils::MakeMaker unavailable")
+@pytest.mark.slow  # tier-1 time budget (ROADMAP ops note, PR 7):
+# heaviest non-gate tests run in the slow tier (-m slow) so the
+# 870s dots-in-window metric keeps measuring the whole fast tier
 def test_perl_binding_trains_mlp(tmp_path):
     r = subprocess.run(["make", "-C", os.path.join(REPO, "src"), "capi"],
                        capture_output=True, text=True)
@@ -70,6 +73,9 @@ def test_perl_binding_trains_mlp(tmp_path):
 
 @pytest.mark.skipif(not _have_perl_toolchain(),
                     reason="perl + ExtUtils::MakeMaker unavailable")
+@pytest.mark.slow  # tier-1 time budget (ROADMAP ops note, PR 7):
+# heaviest non-gate tests run in the slow tier (-m slow) so the
+# 870s dots-in-window metric keeps measuring the whole fast tier
 def test_perl_full_op_surface(tmp_path):
     """The generated 288-op perl surface (AI::MXTPU::Ops/NDOps from
     perl-package/gen_perl_ops.py) composes and trains a model from pure

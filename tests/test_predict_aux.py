@@ -111,6 +111,9 @@ def test_sample_negative_binomial_rowwise():
     assert abs(m2[0] - 2.0) < 0.5 and abs(m2[1] - 10.0) < 2.5
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP ops note, PR 7):
+# heaviest non-gate tests run in the slow tier (-m slow) so the
+# 870s dots-in-window metric keeps measuring the whole fast tier
 def test_tensorboard_callback(tmp_path):
     from mxtpu.contrib.tensorboard import LogMetricsCallback
     from collections import namedtuple

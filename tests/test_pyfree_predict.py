@@ -21,6 +21,9 @@ def _tf_dir():
         return None
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP ops note, PR 7):
+# heaviest non-gate tests run in the slow tier (-m slow) so the
+# 870s dots-in-window metric keeps measuring the whole fast tier
 def test_c_binary_predicts_without_python(tmp_path):
     tfdir = _tf_dir()
     if tfdir is None or not os.path.exists(

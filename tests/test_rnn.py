@@ -177,6 +177,9 @@ def _lm_sym_gen(vocab=40, E=16, H=24):
     return sym_gen
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP ops note, PR 7):
+# heaviest non-gate tests run in the slow tier (-m slow) so the
+# 870s dots-in-window metric keeps measuring the whole fast tier
 def test_bucketing_lm_trains():
     """Tiny LSTM LM perplexity drops under training (test_bucketing.py)."""
     mx.random.seed(6)  # deterministic init regardless of suite order

@@ -1,6 +1,7 @@
 """Convergence tier (parity: tests/python/train/{test_mlp,test_conv}.py —
 small end-to-end runs asserting accuracy thresholds)."""
 import numpy as np
+import pytest
 
 import mxtpu as mx
 
@@ -59,6 +60,9 @@ def test_conv_converges():
     assert acc > 0.9, acc
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP ops note, PR 7):
+# heaviest non-gate tests run in the slow tier (-m slow) so the
+# 870s dots-in-window metric keeps measuring the whole fast tier
 def test_gluon_converges_and_resumes(tmp_path):
     from mxtpu import autograd, gluon
 

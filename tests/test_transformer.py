@@ -36,6 +36,9 @@ def test_shapes_and_params():
     assert "l0_q_weight" in names and "l1_ff2_bias" in names
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP ops note, PR 7):
+# heaviest non-gate tests run in the slow tier (-m slow) so the
+# 870s dots-in-window metric keeps measuring the whole fast tier
 def test_causality():
     """Changing token t must not affect logits at positions < t."""
     net = _lm(layers=1)
@@ -59,6 +62,9 @@ def test_causality():
     assert np.abs(base[10:] - pert[10:]).max() > 1e-4
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP ops note, PR 7):
+# heaviest non-gate tests run in the slow tier (-m slow) so the
+# 870s dots-in-window metric keeps measuring the whole fast tier
 def test_next_token_task_converges():
     net = _lm()
     mod = _bind(net)
@@ -75,6 +81,9 @@ def test_next_token_task_converges():
     assert nll < 1.0, "nll %.3f vs uniform %.3f" % (nll, math.log(50))
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP ops note, PR 7):
+# heaviest non-gate tests run in the slow tier (-m slow) so the
+# 870s dots-in-window metric keeps measuring the whole fast tier
 def test_data_parallel_mesh_training():
     """The same symbol trains through the fused GSPMD trainer over the
     8-device CPU mesh (batch sharded, params replicated)."""
@@ -106,6 +115,9 @@ def test_data_parallel_mesh_training():
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP ops note, PR 7):
+# heaviest non-gate tests run in the slow tier (-m slow) so the
+# 870s dots-in-window metric keeps measuring the whole fast tier
 def test_bucketing_shares_transformer_params():
     """BucketingModule over transformer symbols of different sequence
     lengths shares ONE parameter set (pos_emb sized by max_len, sliced
@@ -153,6 +165,9 @@ def test_bucketing_shares_transformer_params():
     assert arg_params["pos_emb"].shape == (1, max_len, 16)
 
 
+@pytest.mark.slow  # tier-1 time budget (ROADMAP ops note, PR 7):
+# heaviest non-gate tests run in the slow tier (-m slow) so the
+# 870s dots-in-window metric keeps measuring the whole fast tier
 def test_bf16_lm_trains():
     """dtype='bfloat16' variant (MXU-tiled matmuls, f32 softmax head):
     the LM still learns a deterministic-next-token stream — guards the

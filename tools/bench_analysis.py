@@ -6,7 +6,7 @@ Two numbers (BENCH_analysis.json):
 * **sanitizer-off** — the acceptance bar is "no measurable per-step
   overhead". The ONLY code this PR adds to an unsanitized dispatch is
   one extra wrapper frame reading a module global and testing it for
-  None (executor._OUTPUT_SANITIZER). Wall-clock cannot resolve
+  None (compile.pipeline._OUTPUT_SANITIZER). Wall-clock cannot resolve
   nanoseconds on a noisy shared host (PR-2 convention: noise floor
   >>2%), so the verdict comes from the deterministic microbench: the
   added layer is timed tight-loop against the identical call without
@@ -35,7 +35,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import mxtpu as mx  # noqa: E402
 from mxtpu import analysis  # noqa: E402
-from mxtpu import executor as ex_mod  # noqa: E402
+from mxtpu.compile import pipeline as pipe_mod  # noqa: E402
 from mxtpu.models import mlp as _mlp  # noqa: E402
 
 
@@ -62,7 +62,7 @@ def _hook_check_ns(iters=200_000):
 
     def with_hook():
         out = dispatch()
-        san = ex_mod._OUTPUT_SANITIZER
+        san = pipe_mod._OUTPUT_SANITIZER
         if san is not None:
             san("bench", out)
         return out

@@ -83,13 +83,16 @@ def table_from_dump(path):
         dump = json.load(f)
     rows = dump.get("programs") or []
     print("%d captured programs from %s" % (len(rows), path))
-    hdr = ("id", "kind", "owner", "calls", "compile_ms", "mflops", "temp_kb")
-    print("%4s %-12s %-16s %6s %10s %10s %8s" % hdr)
+    hdr = ("id", "kind", "owner", "calls", "compile_ms", "mflops",
+           "temp_kb", "prec")
+    print("%4s %-12s %-16s %6s %10s %10s %8s %-10s" % hdr)
     for r in rows:
-        print("%4d %-12s %-16s %6d %10.1f %10.2f %8d"
+        print("%4d %-12s %-16s %6d %10.1f %10.2f %8d %-10s"
               % (r["id"], r["kind"][:12], r["owner"][:16], r["calls"],
                  r["compile_ms"], r["flops"] / 1e6,
-                 r["temp_bytes"] // 1024))
+                 r["temp_bytes"] // 1024,
+                 # precision column is absent in pre-PR-7 dumps
+                 r.get("precision", "f32")[:10]))
     return 0
 
 

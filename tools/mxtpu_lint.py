@@ -31,6 +31,17 @@ outlives its owner and hangs interpreter shutdown. Pragma::
 
     # mxtpu: allow-thread(reason)
 
+**f64-promotion** — flags silent float64 promotion in the declared
+hot-path modules: ``np.float64`` (and ``dtype="float64"``) used
+directly, and numpy array constructors without an explicit dtype —
+``np.zeros(n)`` / ``np.empty(n)`` default to f64, and
+``np.array([0.5, ...])`` infers it from bare Python float literals.
+A host f64 array flowing into jitted code either silently truncates
+(x64 disabled — masking the intent) or retraces every program at
+double width (x64 enabled). Pragma::
+
+    # mxtpu: allow-f64(reason)
+
 Usage: python tools/mxtpu_lint.py [--pkg mxtpu] [--list-config]
 """
 from __future__ import annotations
@@ -50,6 +61,7 @@ ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 HOT_PATHS = {
     "mxtpu/engine.py": None,
     "mxtpu/executor.py": None,
+    "mxtpu/compile/pipeline.py": None,
     "mxtpu/module/fused.py": None,
     "mxtpu/serving/batcher.py": None,
     "mxtpu/serving/pool.py": None,
@@ -71,6 +83,19 @@ _SCALAR_PULLS = {"sum", "mean", "item", "max", "min"}
 
 PRAGMA_SYNC = "mxtpu: allow-sync("
 PRAGMA_THREAD = "mxtpu: allow-thread("
+PRAGMA_F64 = "mxtpu: allow-f64("
+
+#: numpy constructors whose DEFAULT dtype is float64 regardless of input
+_NP_F64_DEFAULT_CTORS = {"zeros", "ones", "empty", "linspace", "eye"}
+#: numpy constructors that INFER float64 from bare Python float literals
+#: (np.full infers from the FILL value, so it belongs here, not above:
+#: np.full(n, 1) is int64, only np.full(n, 1.0) is f64)
+_NP_VALUE_CTORS = {"array", "asarray", "ascontiguousarray", "full"}
+#: 1-based position of the dtype argument when passed positionally
+#: (linspace: start, stop, num, endpoint, retstep, DTYPE, axis)
+_NP_DTYPE_POS = {"zeros": 2, "ones": 2, "empty": 2, "full": 3,
+                 "linspace": 6, "eye": 4, "array": 2, "asarray": 2,
+                 "ascontiguousarray": 2}
 
 #: Declared lock hierarchy, outermost-first: a thread may acquire locks
 #: only left→right. Keys are (owning class, attr) for ``self.<attr>``
@@ -87,8 +112,10 @@ LOCK_LEVELS = [
     ("programs", {("programs", "_LOCK")}),
     ("telemetry-registry", {("MetricsRegistry", "_lock"),
                             ("_DefaultRegistry", "_lock")}),
+    # _BUILD_LOCK moved executor.py -> compile/pipeline.py in PR 7 (the
+    # compile-pipeline seam); same level, new owning module
     ("engine", {("ThreadedEngine", "_pending_lock"),
-                ("executor", "_BUILD_LOCK"), ("engine", "_ENGINE_LOCK")}),
+                ("pipeline", "_BUILD_LOCK"), ("engine", "_ENGINE_LOCK")}),
 ]
 
 _LOCK_RANK = {}
@@ -178,6 +205,49 @@ class _Linter(ast.NodeVisitor):
                 % (fn.id, call.args[0].func.attr)
         return None
 
+    # -------------------------------------------------------------- f64
+    def _f64_reason(self, call):
+        fn = call.func
+        if not (isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in _NUMPY_ALIASES):
+            return None
+        name = fn.attr
+        if name not in _NP_F64_DEFAULT_CTORS | _NP_VALUE_CTORS:
+            return None
+        for kw in call.keywords:
+            if kw.arg == "dtype":
+                if isinstance(kw.value, ast.Constant) \
+                        and str(kw.value.value) in ("float64", "f8", ">f8",
+                                                    "<f8", "double"):
+                    return "dtype=%r is an explicit f64" % kw.value.value
+                return None  # explicit dtype of any other kind is fine
+        if len(call.args) >= _NP_DTYPE_POS.get(name, 99):
+            return None  # dtype passed positionally
+        if name in _NP_F64_DEFAULT_CTORS:
+            return "%s.%s() without dtype= allocates float64" \
+                % (fn.value.id, name)
+        for a in call.args:   # value ctors: f64 only via float literals
+            for sub in ast.walk(a):
+                if isinstance(sub, ast.Constant) \
+                        and isinstance(sub.value, float):
+                    return ("%s.%s() infers float64 from a bare Python "
+                            "float literal" % (fn.value.id, name))
+        return None
+
+    def visit_Attribute(self, node):
+        if self._in_hot_scope() and node.attr == "float64" \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in _NUMPY_ALIASES \
+                and not _has_pragma(self.lines, node.lineno, PRAGMA_F64):
+            self.findings.append(LintFinding(
+                "f64-promotion", self.relpath, node.lineno,
+                "%s.float64 on a hot path: jitted code either truncates "
+                "it silently or retraces at double width — use an "
+                "explicit f32/target dtype or annotate '# %sreason)'"
+                % (node.value.id, PRAGMA_F64)))
+        self.generic_visit(node)
+
     # ------------------------------------------------------------ locks
     def _lock_key(self, expr):
         if isinstance(expr, ast.Attribute):
@@ -251,6 +321,14 @@ class _Linter(ast.NodeVisitor):
                     "implicit host sync on a hot path: %s — move it off "
                     "the per-step path or annotate '# %sreason)'"
                     % (reason, PRAGMA_SYNC)))
+            f64 = self._f64_reason(node)
+            if f64 and not _has_pragma(self.lines, node.lineno,
+                                       PRAGMA_F64):
+                self.findings.append(LintFinding(
+                    "f64-promotion", self.relpath, node.lineno,
+                    "silent f64 promotion on a hot path: %s — pass an "
+                    "explicit dtype or annotate '# %sreason)'"
+                    % (f64, PRAGMA_F64)))
         if self._is_thread_join(node):
             self.module_joins = True
         if self._is_thread_ctor(node):
