@@ -66,6 +66,7 @@ from . import profiler
 from . import test_utils
 from . import parallel
 from . import sharding
+from . import elastic
 from . import operator
 from . import predict
 from . import serving

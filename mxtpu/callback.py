@@ -12,18 +12,29 @@ from . import telemetry as _tel
 
 
 def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
+    """Epoch-end checkpoint callback over a live Module (parity
+    callback.py module_checkpoint). Routed through the async snapshot
+    writer: the callback reads the fused step's device state directly
+    (donation-safe jitted copy), so it never needs the host param dicts
+    — fit skips the per-epoch get_params/set_params round trip
+    (``_needs_host_params`` False) and ``_params_device_resident`` stays
+    true through a checkpointing fit."""
     period = int(max(1, period))
 
     def _callback(iter_no, sym=None, arg=None, aux=None):
         if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states,
+                                async_write=True)
+    _callback._needs_host_params = False
     return _callback
 
 
 def do_checkpoint(prefix, period=1):
     """Epoch-end checkpoint callback (parity callback.py:55). Writes go
-    through the native engine asynchronously so the next epoch starts
-    while the file lands; load_checkpoint/nd.waitall() drain them."""
+    through the elastic snapshot writer thread: the device-backed param
+    dicts are captured donation-safe without a host transfer, and the
+    next epoch starts while the file serializes/fsyncs in the
+    background; load_checkpoint/nd.waitall() drain pending writes."""
     from .model import save_checkpoint
     period = int(max(1, period))
 

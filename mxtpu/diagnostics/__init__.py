@@ -41,8 +41,8 @@ from .programs import (ProgramRecord, cost_enabled, latest_record,
                        summarize_shardings)
 from .flight import (FlightRecorder, flight_enabled, record, recorder,
                      set_flight_enabled)
-from .watchdog import (Watchdog, active_waits, ensure_watchdog,
-                       stop_watchdog, wait_begin, wait_end)
+from .watchdog import (Watchdog, active_waits, add_action, ensure_watchdog,
+                       remove_action, stop_watchdog, wait_begin, wait_end)
 
 __all__ = [
     "DeviceMemoryLedger", "ledger", "alloc_origin", "current_origin",
@@ -53,7 +53,7 @@ __all__ = [
     "FlightRecorder", "recorder", "record", "flight_enabled",
     "set_flight_enabled",
     "Watchdog", "ensure_watchdog", "stop_watchdog", "active_waits",
-    "wait_begin", "wait_end",
+    "wait_begin", "wait_end", "add_action", "remove_action",
     "debug_state", "postmortem", "last_postmortem", "dump_state",
     "install_signal_handler", "set_enabled",
 ]

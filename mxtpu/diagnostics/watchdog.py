@@ -31,7 +31,32 @@ import time
 from .. import telemetry as _tel
 
 __all__ = ["Watchdog", "ensure_watchdog", "stop_watchdog", "wait_begin",
-           "wait_end", "active_waits"]
+           "wait_end", "active_waits", "add_action", "remove_action"]
+
+# ------------------------------------------------------------- action hooks
+# Subscribers that ACT on a detection (elastic supervisor: checkpoint-
+# restore-retry) after the postmortem has been captured. Process-wide:
+# every Watchdog instance fires them, so a supervisor subscribed here
+# sees detections from the fit-armed singleton AND from test-driven
+# instances. GIL-atomic list ops; callbacks run on the watchdog thread
+# and must not block (set a flag, enqueue work).
+_ACTIONS = []
+
+
+def add_action(fn):
+    """Register ``fn(reason)`` to run after every watchdog detection
+    (after the postmortem). Returns ``fn`` so it can be used inline."""
+    if fn not in _ACTIONS:
+        _ACTIONS.append(fn)
+    return fn
+
+
+def remove_action(fn):
+    """Unregister a detection action (no-op when absent)."""
+    try:
+        _ACTIONS.remove(fn)
+    except ValueError:
+        pass
 
 # ------------------------------------------------------- device-wait registry
 _WAITS = {}  # thread id -> (t0, description); GIL-atomic dict ops
@@ -166,6 +191,14 @@ class Watchdog:
         else:
             from . import postmortem
             postmortem("watchdog: %s" % reason, source="watchdog")
+        # evidence first, action second: the registered actions (elastic
+        # supervisor restore-retry) run AFTER the postmortem capture, so
+        # a recovery that works still leaves the wedge forensics behind
+        for fn in list(_ACTIONS):
+            try:
+                fn(reason)
+            except Exception:
+                pass  # an action must never kill the watchdog
 
     def _loop(self):
         while not self._stop.wait(self.interval):

@@ -1,0 +1,668 @@
+"""Async snapshots: capture fused-step device state off the critical path.
+
+The capture half runs on the TRAINING thread and never blocks on the
+device or the disk: one jitted tree-copy makes donation-safe fresh
+buffers (``FusedTrainStep.export_device_state``), each leaf's
+device→host transfer is started asynchronously, and the job is handed to
+the :class:`SnapshotWriter` thread. The writer materializes the host
+bytes (the transfers have usually landed by then), serializes them in
+the ``nd.save`` binary format, ``fsync``\\ s, and **atomically renames**
+— so a crash at any point leaves either the previous generation or the
+new one, never a torn file that loads.
+
+Durability protocol (one *generation* = one consistent train state):
+
+1. ``<prefix>.g<GEN>.p<R>of<W>.elastic``  — per-process data file
+   (tmp + fsync + rename);
+2. ``<prefix>.g<GEN>.manifest.json``      — everything scalar plus the
+   per-array schema and per-shard index map (tmp + fsync + rename);
+3. ``<prefix>.latest``                    — pointer to the newest
+   complete generation, renamed into place LAST.
+
+``latest_manifest`` follows the pointer and *verifies* the generation
+(manifest parses, every data file exists at its recorded size); a torn
+or missing generation falls back to the newest older generation that
+verifies. Old generations are pruned after the pointer flip
+(``keep`` newest retained).
+
+Under an active mesh each process writes only its **addressable
+shards**, with the ``ShardingPlan`` spec of every sharded optimizer
+leaf recorded in the manifest — restore re-stages them onto the plan's
+weight-update sharding without ever gathering the global array.
+
+See docs/elastic.md for the manifest schema and consistency model.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+
+import numpy as _np
+
+from .. import telemetry as _tel
+
+log = logging.getLogger("mxtpu.elastic")
+
+FORMAT = "mxtpu-elastic-1"
+
+#: seconds since the last durable generation (process-wide); the age
+#: gauge below reads it. 0.0 = no snapshot yet this process.
+_LAST_DURABLE_T = 0.0
+
+
+def _snapshot_age():
+    if _LAST_DURABLE_T == 0.0:
+        return 0.0
+    return round(time.monotonic() - _LAST_DURABLE_T, 3)
+
+
+# registry-direct (exists under MXTPU_TELEMETRY=0, like the watchdog age)
+_tel.registry().gauge(
+    "elastic_snapshot_age_s", fn=_snapshot_age,
+    help="seconds since the last durable elastic snapshot generation "
+         "(0 before the first)")
+
+
+# --------------------------------------------------------------- file layer
+def _fsync_dir(path):
+    """Best-effort directory fsync so the rename itself is durable."""
+    try:
+        fd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                     os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass  # platform without dir fsync
+
+
+def _write_atomic(path, data_bytes):
+    """tmp + fsync + rename: the file either has the full content or the
+    previous one — never a prefix."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data_bytes)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(path)
+    return len(data_bytes)
+
+
+def _write_ndsave_atomic(path, host_arrays):
+    """Serialize a {key: numpy} dict in the nd.save binary format, fsync,
+    atomically rename. Returns the byte count."""
+    from .. import ndarray as nd
+    tmp = path + ".tmp"
+    nd.save(tmp, host_arrays)
+    with open(tmp, "rb+") as f:
+        f.flush()
+        os.fsync(f.fileno())
+        nbytes = f.seek(0, 2)
+    os.replace(tmp, path)
+    _fsync_dir(path)
+    return nbytes
+
+
+# --------------------------------------------------------------- the writer
+class SnapshotJob:
+    """One unit of writer work.
+
+    ``kind``:
+
+    * ``"generation"`` — a full elastic generation: data file + manifest
+      + pointer flip + prune (``prefix``/``generation`` set);
+    * ``"ndsave"``     — a bare nd-format file at ``data_path``
+      (async ``save_checkpoint`` params);
+    * ``"bytes"``      — ``assemble(host_arrays) -> bytes`` written
+      atomically at ``data_path`` (async optimizer ``.states``).
+
+    ``arrays`` values are donation-safe: jax arrays are fresh copies
+    whose host transfer was already started, numpy values were copied at
+    enqueue. ``coalescable`` periodic jobs queued behind an unstarted
+    older one replace it (latest-wins — the writer never falls behind by
+    more than one in-flight write).
+    """
+
+    def __init__(self, kind, arrays, prefix=None, generation=0,
+                 manifest=None, data_path=None, assemble=None,
+                 proc_index=0, proc_count=1, keep=2, coalescable=False,
+                 on_done=None, label="snapshot"):
+        self.kind = kind
+        self.arrays = arrays
+        self.prefix = prefix
+        self.generation = generation
+        self.manifest = manifest
+        self.data_path = data_path
+        self.assemble = assemble
+        self.proc_index = proc_index
+        self.proc_count = proc_count
+        self.keep = keep
+        self.coalescable = coalescable
+        self.on_done = on_done
+        self.label = label
+
+
+def data_basename(prefix, generation, proc_index=0, proc_count=1):
+    return "%s.g%06d.p%dof%d.elastic" % (os.path.basename(prefix),
+                                         generation, proc_index, proc_count)
+
+
+def manifest_path(prefix, generation):
+    return "%s.g%06d.manifest.json" % (prefix, generation)
+
+
+def pointer_path(prefix):
+    return "%s.latest" % prefix
+
+
+class SnapshotWriter:
+    """The background writer thread. One per process (``writer()``);
+    daemon so it can never hang interpreter shutdown, with an explicit
+    ``flush()``/``close()`` lifecycle for callers that need durability
+    (final preemption snapshot, ``wait_checkpoints``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue = []
+        self._busy = False
+        self._stop = False
+        self._thread = None
+        self.jobs_written = 0
+        self.last_error = None
+
+    # ------------------------------------------------------------ lifecycle
+    def _ensure_thread(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="mxtpu-elastic-writer")
+        self._thread.start()
+
+    def submit(self, job):
+        """Enqueue; never blocks the caller on IO."""
+        with self._cond:
+            if job.coalescable:
+                # replace an unstarted older periodic snapshot for the
+                # same prefix: a slow disk makes snapshots sparser, not
+                # the queue deeper
+                self._queue = [j for j in self._queue
+                               if not (j.coalescable
+                                       and j.prefix == job.prefix)]
+            self._queue.append(job)
+            self._ensure_thread()
+            self._cond.notify_all()
+        return job
+
+    def flush(self, timeout=None):
+        """Block until every submitted job is durable (or timeout).
+        Returns True when the queue fully drained."""
+        with self._cond:
+            return self._cond.wait_for(
+                lambda: not self._queue and not self._busy, timeout)
+
+    def close(self, timeout=10.0):
+        self.flush(timeout)
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+
+    # ------------------------------------------------------------ the loop
+    def _loop(self):
+        while True:
+            with self._cond:
+                while not self._queue and not self._stop:
+                    self._cond.wait()
+                if self._stop and not self._queue:
+                    return
+                job = self._queue.pop(0)
+                self._busy = True
+            try:
+                self._write(job)
+                self.jobs_written += 1
+            except Exception as exc:  # a bad disk must not kill training
+                self.last_error = exc
+                log.error("elastic snapshot write failed (%s): %r",
+                          job.label, exc)
+                _tel.counter("elastic_snapshot_errors",
+                             help="snapshot writer jobs that failed").inc()
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
+
+    def _write(self, job):
+        global _LAST_DURABLE_T
+        t0 = time.perf_counter()
+        # materialize on THIS thread: the capture already started the
+        # device->host copies, so these np.asarray calls mostly find the
+        # bytes landed; when they don't, it is the writer that waits,
+        # never the training loop
+        # mxtpu: allow-sync(writer thread: materializing the snapshot on
+        # host IS this thread's job — the training thread never blocks)
+        host = {k: _np.asarray(v) for k, v in job.arrays.items()}
+        nbytes = 0
+        if job.kind == "generation":
+            d = os.path.dirname(job.prefix)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            base = data_basename(job.prefix, job.generation,
+                                 job.proc_index, job.proc_count)
+            data_path = os.path.join(d or ".", base)
+            nbytes += _write_ndsave_atomic(data_path, host)
+            man = dict(job.manifest)
+            man["data_files"] = {base: {"bytes": os.path.getsize(data_path)}}
+            man_bytes = json.dumps(man, indent=1, default=str).encode()
+            nbytes += _write_atomic(manifest_path(job.prefix,
+                                                  job.generation), man_bytes)
+            ptr = {"format": FORMAT, "generation": job.generation,
+                   "manifest": os.path.basename(
+                       manifest_path(job.prefix, job.generation))}
+            nbytes += _write_atomic(pointer_path(job.prefix),
+                                    json.dumps(ptr).encode())
+            prune(job.prefix, keep=job.keep)
+            _LAST_DURABLE_T = time.monotonic()
+        elif job.kind == "ndsave":
+            nbytes += _write_ndsave_atomic(job.data_path, host)
+            if job.manifest is not None:
+                man = dict(job.manifest)
+                man.setdefault("data_file",
+                               os.path.basename(job.data_path))
+                man.setdefault("bytes", os.path.getsize(job.data_path))
+                nbytes += _write_atomic(
+                    job.data_path + ".manifest.json",
+                    json.dumps(man, indent=1, default=str).encode())
+        elif job.kind == "bytes":
+            nbytes += _write_atomic(job.data_path, job.assemble(host))
+        else:
+            raise ValueError("unknown snapshot job kind %r" % job.kind)
+        _tel.counter("elastic_snapshot_bytes",
+                     help="bytes written by the snapshot writer"
+                     ).inc(nbytes)
+        _tel.histogram("elastic_snapshot_write_ms",
+                       help="writer-thread serialize+fsync+rename time "
+                            "per job").observe((time.perf_counter() - t0)
+                                               * 1e3)
+        if job.on_done is not None:
+            try:
+                job.on_done(job)
+            except Exception:
+                pass
+
+
+_WRITER = None
+_WRITER_LOCK = threading.Lock()
+
+
+def writer():
+    """The process-wide snapshot writer (created on first use)."""
+    global _WRITER
+    with _WRITER_LOCK:
+        if _WRITER is None:
+            _WRITER = SnapshotWriter()
+        return _WRITER
+
+
+# ---------------------------------------------------------------- load side
+def _manifest_intact(man, dirname):
+    """Every data file the manifest names exists at its recorded size."""
+    files = man.get("data_files") or {}
+    if not files:
+        return False
+    for base, meta in files.items():
+        path = os.path.join(dirname, base)
+        try:
+            if os.path.getsize(path) != int(meta["bytes"]):
+                return False
+        except (OSError, KeyError, TypeError, ValueError):
+            return False
+    return True
+
+
+def _read_json(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def list_generations(prefix):
+    """Generation numbers with a manifest on disk, ascending."""
+    d = os.path.dirname(prefix) or "."
+    base = os.path.basename(prefix)
+    out = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return out
+    for n in names:
+        if n.startswith(base + ".g") and n.endswith(".manifest.json"):
+            try:
+                out.append(int(n[len(base) + 2:-len(".manifest.json")]))
+            except ValueError:
+                pass
+    return sorted(out)
+
+
+def latest_manifest(prefix, flush=True):
+    """The newest generation that VERIFIES (manifest parses, data files
+    present at recorded sizes), or None. Follows the ``.latest`` pointer
+    first; a torn/incomplete generation falls back to the newest older
+    one — the crash-window contract. ``flush`` drains the writer first so
+    an in-flight write is never half-read."""
+    if flush:
+        writer().flush()
+    d = os.path.dirname(prefix) or "."
+    candidates = []
+    ptr = _read_json(pointer_path(prefix))
+    if ptr and "generation" in ptr:
+        candidates.append(int(ptr["generation"]))
+    for g in reversed(list_generations(prefix)):
+        if g not in candidates:
+            candidates.append(g)
+    for gen in candidates:
+        man = _read_json(manifest_path(prefix, gen))
+        if man is not None and _manifest_intact(man, d):
+            man["_manifest_dir"] = d
+            man["_generation"] = gen
+            return man
+        if man is not None:
+            log.warning("elastic: generation %d of %s is torn/incomplete "
+                        "— falling back", gen, prefix)
+    return None
+
+
+def load_arrays(manifest):
+    """All arrays of a verified generation as {key: numpy} (this
+    process's data files)."""
+    from .. import ndarray as nd
+    d = manifest.get("_manifest_dir", ".")
+    out = {}
+    for base in (manifest.get("data_files") or {}):
+        loaded = nd.load(os.path.join(d, base))
+        for k, v in loaded.items():
+            # mxtpu: allow-sync(resume/load path, runs once before
+            # training starts — not on the per-step path)
+            out[k] = v.asnumpy()
+    return out
+
+
+def prune(prefix, keep=2):
+    """Drop all but the ``keep`` newest generations (manifest + data
+    files). Never touches the generation the pointer names."""
+    keep = max(1, int(keep))
+    gens = list_generations(prefix)
+    if len(gens) <= keep:
+        return
+    ptr = _read_json(pointer_path(prefix)) or {}
+    protected = ptr.get("generation")
+    d = os.path.dirname(prefix) or "."
+    base = os.path.basename(prefix)
+    for g in gens[:-keep]:
+        if g == protected:
+            continue
+        man = _read_json(manifest_path(prefix, g)) or {}
+        for fname in (man.get("data_files") or {}):
+            try:
+                os.remove(os.path.join(d, fname))
+            except OSError:
+                pass
+        # any stray data files of this generation (torn writes)
+        g_tag = "%s.g%06d." % (base, g)
+        try:
+            for n in os.listdir(d):
+                if n.startswith(g_tag) and n.endswith(".elastic"):
+                    os.remove(os.path.join(d, n))
+        except OSError:
+            pass
+        try:
+            os.remove(manifest_path(prefix, g))
+        except OSError:
+            pass
+
+
+# ------------------------------------------------------------- capture side
+_SAFE_COPY = None
+
+
+def safe_arrays(values):
+    """Donation-safe, mutation-safe capture of a {name: NDArray/array}
+    dict for an async write: device-backed values get ONE jitted
+    tree-copy (fresh buffers a later donated step cannot delete) with
+    their host transfer started; host numpy values are copied eagerly
+    (the updater mutates parameter arrays in place). Never blocks on a
+    device→host transfer."""
+    global _SAFE_COPY
+    import jax
+    import jax.numpy as jnp
+    raw = {k: getattr(v, "_data", v) for k, v in values.items()}
+    dev = {k: v for k, v in raw.items() if isinstance(v, jax.Array)}
+    # mxtpu: allow-sync(host-resident values only — the jax.Array leaves
+    # were filtered into `dev` above and take the jitted-copy path)
+    out = {k: _np.array(v) for k, v in raw.items() if k not in dev}
+    if dev:
+        if _SAFE_COPY is None:
+            _SAFE_COPY = jax.jit(
+                lambda t: jax.tree.map(jnp.copy, t))
+        copied = _SAFE_COPY(dev)
+        for k, v in copied.items():
+            try:
+                v.copy_to_host_async()
+            except Exception:
+                pass
+            out[k] = v
+    return out
+
+
+def async_save_ndarrays(path, values, manifest=None, on_done=None,
+                        label=None):
+    """Write ``values`` (a {name: NDArray/array} dict) at ``path`` in the
+    ``nd.save`` format on the writer thread — fsynced, atomically
+    renamed. ``manifest`` (optional dict) lands beside it as
+    ``<path>.manifest.json`` after the data file. The call returns as
+    soon as the donation-safe capture is enqueued."""
+    job = SnapshotJob("ndsave", safe_arrays(values),
+                      data_path=path, manifest=manifest,
+                      on_done=on_done,
+                      label=label or os.path.basename(path))
+    return writer().submit(job)
+
+
+def _index_json(index, shape):
+    """A shard's index (tuple of slices) as JSON: per dim [start, stop]
+    (full-extent dims normalize to [0, size])."""
+    out = []
+    for d, sl in enumerate(index):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(shape[d]) if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def collect_opt_arrays(fused, snap_opt=None):
+    """Flatten a fused step's optimizer state for serialization.
+
+    Returns ``(arrays, opt_manifest)``:
+
+    * replicated/single-device leaves land whole under
+      ``opt:<name>/<leaf-index>``;
+    * leaves sharded by the plan's weight-update sharding land as their
+      unique addressable shards ``opt:<name>/<i>#<piece>``, with the
+      spec and per-piece global index recorded in the manifest — this
+      process serializes ONLY bytes it already holds; nothing is
+      gathered.
+    """
+    import jax
+    from .. import sharding as _sharding
+    if snap_opt is None:
+        snap_opt = fused.opt_state
+    arrays = {}
+    entries = {}
+    for name in fused.trainable:
+        leaves = jax.tree.leaves(snap_opt[name])
+        spec = fused._opt_spec(name)
+        sharded = fused._mesh is not None and bool(tuple(spec))
+        entry = {"leaves": len(leaves),
+                 "spec": _sharding.spec_to_json(spec)}
+        shards = {}
+        for i, leaf in enumerate(leaves):
+            key = "opt:%s/%d" % (name, i)
+            if not sharded:
+                arrays[key] = leaf
+                continue
+            pieces = []
+            seen = set()
+            for sh in leaf.addressable_shards:
+                ij = _index_json(sh.index, leaf.shape)
+                tag = json.dumps(ij)
+                if tag in seen:
+                    continue  # replicas of the same shard: write once
+                seen.add(tag)
+                pkey = "%s#%d" % (key, len(pieces))
+                arrays[pkey] = sh.data
+                pieces.append({"key": pkey, "index": ij})
+            shards[str(i)] = {"global_shape": list(leaf.shape),
+                              "dtype": str(leaf.dtype),
+                              "pieces": pieces}
+        if shards:
+            entry["shards"] = shards
+        entries[name] = entry
+    return arrays, entries
+
+
+def _flatten_state_dict(state):
+    """Split an iterator checkpoint dict (possibly one level nested) into
+    (json-able scalars, numpy arrays) with '/'-joined keys."""
+    scalars, arrays = {}, {}
+
+    def walk(d, path):
+        for k, v in d.items():
+            p = ("%s/%s" % (path, k)) if path else str(k)
+            if isinstance(v, dict):
+                walk(v, p)
+            elif isinstance(v, (int, float, str, bool)) or v is None:
+                scalars[p] = v
+            else:
+                # mxtpu: allow-sync(iterator cursor state is host data —
+                # numpy index arrays and ints, never device arrays)
+                arrays[p] = _np.asarray(v)
+    walk(state, "")
+    return scalars, arrays
+
+
+def _unflatten_state_dict(scalars, arrays):
+    out = {}
+    for src in (scalars, arrays):
+        for key, v in src.items():
+            parts = key.split("/")
+            d = out
+            for p in parts[:-1]:
+                d = d.setdefault(p, {})
+            d[parts[-1]] = v
+    return out
+
+
+def capture_module(module, cursor, eval_metric=None, iter_state=None):
+    """Capture everything a bit-exact resume needs, WITHOUT blocking the
+    training thread on device→host transfers or IO.
+
+    Returns ``(arrays, manifest)`` ready for a ``generation`` writer job.
+    ``cursor`` is a dict with ``epoch``/``nbatch``/``global_step``/
+    ``epoch_boundary``. The caller must have synced any device metric
+    accumulator first (the cadence sync) so the host metric state is
+    complete through the cursor step.
+    """
+    import pickle
+
+    from .. import random as _rnd
+    from ..metric import EvalMetric, _flatten_metrics
+
+    arrays = {}
+    manifest = {"format": FORMAT, "version": 1,
+                "time": round(time.time(), 3), "cursor": dict(cursor)}
+    fused = getattr(module, "_fused", None)
+    if fused is not None:
+        snap_p, snap_a, snap_o = fused.export_device_state()
+        for n, v in snap_p.items():
+            arrays["arg:%s" % n] = v
+        for n, v in snap_a.items():
+            arrays["aux:%s" % n] = v
+        opt_arrays, opt_entries = collect_opt_arrays(fused, snap_o)
+        arrays.update(opt_arrays)
+        manifest["opt_format"] = "leaves"
+        manifest["opt_entries"] = opt_entries
+        if fused._plan is not None:
+            manifest["mesh"] = dict(fused._plan.mesh_ctx.axis_sizes)
+    else:
+        arg_params, aux_params = module.get_params()
+        for n, v in arg_params.items():
+            # host arrays are mutated in place by the updater: copy now.
+            # mxtpu: allow-sync(unfused cold path — params already live
+            # on the host; the fused branch above never transfers)
+            arrays["arg:%s" % n] = _np.array(v.asnumpy())
+        for n, v in (aux_params or {}).items():
+            # mxtpu: allow-sync(unfused cold path, see above)
+            arrays["aux:%s" % n] = _np.array(v.asnumpy())
+        updater = getattr(module, "_updater", None)
+        if updater is not None:
+            blob = updater.get_states()
+            arrays["blob:updater"] = _np.frombuffer(blob,
+                                                    dtype=_np.uint8).copy()
+            manifest["opt_format"] = "updater_blob"
+    manifest["params"] = sorted(n[4:] for n in arrays if n.startswith("arg:"))
+    manifest["aux"] = sorted(n[4:] for n in arrays if n.startswith("aux:"))
+
+    # --- RNG streams: the mxtpu key chain, numpy's global MT state (host
+    # paths: NDArrayIter shuffle), and python's `random` (bucketed iters)
+    arrays["rng:key"] = _rnd.get_state()
+    np_state = _np.random.get_state()
+    # mxtpu: allow-sync(numpy's own MT state vector — host data)
+    arrays["rng:numpy"] = _np.asarray(np_state[1], dtype=_np.uint32)
+    manifest["rng_numpy"] = {"algo": str(np_state[0]), "pos": int(np_state[2]),
+                             "has_gauss": int(np_state[3]),
+                             "cached_gaussian": float(np_state[4])}
+    import random as _pyrandom
+    arrays["rng:python"] = _np.frombuffer(
+        pickle.dumps(_pyrandom.getstate()), dtype=_np.uint8).copy()
+
+    # --- optimizer step counters (lr schedules, Adam bias correction)
+    opt = getattr(module, "_optimizer", None)
+    if opt is not None:
+        manifest["optimizer"] = {
+            "type": type(opt).__name__,
+            "num_update": int(opt.num_update),
+            "index_update_count": {str(k): int(v) for k, v in
+                                   opt._index_update_count.items()},
+        }
+
+    # --- metric accumulators (exact: integer counts + float sums)
+    if isinstance(eval_metric, EvalMetric):
+        manifest["metric"] = [
+            {"name": m.name, "sum_metric": float(m.sum_metric),
+             "num_inst": int(m.num_inst)}
+            for m in _flatten_metrics(eval_metric)]
+
+    # --- data-iterator position
+    if iter_state is not None:
+        scalars, it_arrays = _flatten_state_dict(iter_state)
+        manifest["iterator"] = {"supported": True, "scalars": scalars,
+                                "arrays": sorted(it_arrays)}
+        for k, v in it_arrays.items():
+            arrays["iter:%s" % k] = v
+    else:
+        manifest["iterator"] = {"supported": False}
+
+    from ..compile import pipeline as _pipeline
+    manifest["pipeline"] = list(_pipeline.configured())
+    manifest["process"] = {"index": 0, "count": 1}
+    return arrays, manifest

@@ -107,6 +107,23 @@ class DataIter:
     def getpad(self):
         pass
 
+    # -------------------------------------------------- elastic cursor
+    def checkpoint_state(self):
+        """Position state for exact fit-resume (docs/elastic.md), or None
+        when this iterator cannot expose one — the resume path then
+        falls back to replaying and discarding the first N batches of
+        the epoch (exact for any deterministic-per-epoch iterator, just
+        slower). The dict may hold ints/floats/strings and numpy arrays;
+        it must be everything needed to make the NEXT ``next()`` return
+        the same batch it would have returned in the original process."""
+        return None
+
+    def restore_state(self, state):
+        """Restore a :meth:`checkpoint_state` capture. Returns True when
+        the position was restored, False when unsupported (callers then
+        use the replay-and-discard fallback)."""
+        return False
+
 
 class ResizeIter(DataIter):
     """Resize the epoch length of another iterator (parity io.py ResizeIter)."""
@@ -154,6 +171,20 @@ class ResizeIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+    def checkpoint_state(self):
+        inner = self.data_iter.checkpoint_state()
+        if inner is None:
+            return None
+        return {"cur": self.cur, "inner": inner}
+
+    def restore_state(self, state):
+        if not isinstance(state, dict) or "inner" not in state:
+            return False
+        if not self.data_iter.restore_state(state["inner"]):
+            return False
+        self.cur = int(state["cur"])
+        return True
 
 
 class PrefetchingIter(DataIter):
@@ -209,6 +240,13 @@ class PrefetchingIter(DataIter):
     def _stage(self, batch):
         """Producer-thread hook applied to every fetched batch."""
         return batch
+
+    def checkpoint_state(self):
+        # the producer threads run AHEAD of the consumer by an
+        # unobservable amount (a batch may be mid-_stage right now), so
+        # the underlying cursor over-counts by 0..n_iter batches —
+        # decline, and let resume use the replay-and-discard fallback
+        return None
 
     def close(self, join=True):
         """Stop the producer threads; with ``join=True`` (the default)
@@ -537,6 +575,30 @@ class NDArrayIter(DataIter):
 
     def getpad(self):
         return self._pad_at(self.cursor)
+
+    def checkpoint_state(self):
+        """Exact position: the cursor plus the shuffle permutation (a
+        resumed process constructs a FRESH iterator whose ``shuffle``
+        drew a different ``idx`` — without restoring it, resume would
+        train on different batches than the original run). ``idx`` is
+        captured by REFERENCE: it never mutates after construction
+        (``reset`` does not reshuffle), and the elastic fit hook calls
+        this every step — a per-step permutation copy would scale with
+        the dataset, not the batch."""
+        return {"cursor": int(self.cursor), "idx": self.idx}
+
+    def restore_state(self, state):
+        if not isinstance(state, dict) or "cursor" not in state:
+            return False
+        idx = state.get("idx")
+        if idx is not None:
+            idx = _np.asarray(idx)
+            if idx.shape != self.idx.shape:
+                return False  # different dataset/epoch-length: replay
+            self.idx = idx.astype(self.idx.dtype, copy=False)
+        self._drop_pending()
+        self.cursor = int(state["cursor"])
+        return True
 
 
 _ITER_REG = Registry("data iterator")

@@ -5,6 +5,7 @@ _update_params_on_kvstore :105, _update_params, save_checkpoint :340,
 load_checkpoint :370, FeedForward legacy API)."""
 from __future__ import annotations
 
+import json
 import logging
 from collections import namedtuple
 
@@ -77,58 +78,65 @@ def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None,
             updater(index * num_device + k, g, w)
 
 
-_ckpt_vars = {}  # prefix -> engine Var serializing writes to that prefix
+def _checkpoint_manifest(save_dict, epoch):
+    """The versioned manifest written beside every legacy checkpoint:
+    enough schema (per-array shape/dtype, split arg/aux name lists) for
+    a loader to validate the file without parsing the binary, and a
+    format tag future readers can dispatch on."""
+    import time as _time
+    return {
+        "format": "mxtpu-checkpoint-1",
+        "version": 1,
+        "epoch": int(epoch),
+        "time": round(_time.time(), 3),
+        "params": sorted(k[4:] for k in save_dict if k.startswith("arg:")),
+        "aux": sorted(k[4:] for k in save_dict if k.startswith("aux:")),
+        "arrays": {k: {"shape": list(getattr(v, "shape", ())),
+                       "dtype": str(getattr(v, "dtype", "float32"))}
+                   for k, v in save_dict.items()},
+    }
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                     async_write=False):
-    """prefix-symbol.json + prefix-%04d.params (parity model.py:340).
+    """prefix-symbol.json + prefix-%04d.params (parity model.py:340),
+    plus a versioned ``.params.manifest.json`` beside the legacy files.
 
-    With ``async_write`` the params write is pushed onto the native engine
-    as a host task — training continues while the file lands (the
-    reference gets the same overlap from engine-scheduled ops). Device
-    values are snapshotted to host numpy eagerly so later optimizer steps
-    cannot corrupt the checkpoint; writes to one prefix serialize on one
-    engine variable and ``load_checkpoint``/``nd.waitall()`` drain them.
-    """
+    With ``async_write`` the params land via the elastic snapshot writer
+    (mxtpu/elastic/snapshot.py): device-backed values are captured with
+    ONE jitted donation-safe tree copy and their host transfer started
+    asynchronously, host arrays are copied eagerly (the updater mutates
+    them in place), and serialization + fsync + atomic rename happen on
+    the writer thread — training keeps dispatching while the file lands.
+    ``load_checkpoint``/``wait_checkpoints``/``nd.waitall()`` drain
+    pending writes."""
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
+    manifest = _checkpoint_manifest(save_dict, epoch)
     if not async_write:
+        from .elastic import snapshot as _snap
         nd.save(param_name, save_dict)
+        _snap._write_atomic(param_name + ".manifest.json",
+                            json.dumps(manifest, indent=1).encode())
         logging.info('Saved checkpoint to "%s"', param_name)
         return
-    import numpy as _np
 
-    from . import engine as _engine
+    from . import elastic as _elastic
 
-    snap = {k: _np.asarray(v.asnumpy()) for k, v in save_dict.items()}
-    eng = _engine.get()
-    var = _ckpt_vars.get(prefix)
-    if var is None:
-        var = _ckpt_vars[prefix] = eng.new_variable()
-
-    def _write(snap=snap, param_name=param_name):
-        nd.save(param_name, snap)
+    def _done(job):
         logging.info('Saved checkpoint to "%s"', param_name)
 
-    eng.push(_write, mutable_vars=[var])
+    _elastic.async_save_ndarrays(param_name, save_dict, manifest=manifest,
+                                 on_done=_done)
 
 
 def wait_checkpoints(prefix=None):
     """Block until pending async checkpoint writes are durable."""
-    from . import engine as _engine
-
-    eng = _engine.get()
-    if prefix is not None:
-        var = _ckpt_vars.get(prefix)
-        if var is not None:
-            eng.wait_for_var(var)
-        return
-    for var in _ckpt_vars.values():
-        eng.wait_for_var(var)
+    from . import elastic as _elastic
+    _elastic.writer().flush()
 
 
 def load_checkpoint(prefix, epoch):

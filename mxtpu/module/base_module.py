@@ -136,7 +136,8 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, max_in_flight=None, metric_sync=None,
-            device_metrics=None, device_prefetch=None, mesh=None):
+            device_metrics=None, device_prefetch=None, mesh=None,
+            elastic=None, resume=None):
         """Training loop (parity base_module.py:376-525), pipelined.
 
         ``mesh`` — SPMD mesh execution (docs/sharding.md): train
@@ -168,6 +169,22 @@ class BaseModule:
           transfer is issued from the producer thread while step N runs
           (env ``MXTPU_FIT_DEVICE_PREFETCH``, default off; the wrapper
           is closed when fit returns).
+
+        Elastic training (docs/elastic.md):
+
+        * ``elastic`` — arm async checkpointing: a prefix string, an
+          :class:`~mxtpu.elastic.ElasticConfig`, or a kwargs dict
+          (``None`` defers to the ``MXTPU_ELASTIC`` env prefix). Device
+          state is snapshotted off the critical path at the configured
+          step/epoch cadence — steps keep dispatching while the writer
+          thread lands the file.
+        * ``resume`` — restore before training: ``True`` resumes the
+          elastic prefix's newest durable generation (no-op when none
+          exists yet), or pass a prefix / manifest path explicitly. The
+          resumed fit is bit-exact on weights against an uninterrupted
+          run: step/epoch cursors, RNG streams, optimizer state (f32
+          masters under ``MXTPU_PIPELINE=bf16``), metric accumulators
+          and the data-iterator position are all restored.
         """
         from ..initializer import Uniform
         assert num_epoch is not None, "please specify number of epochs"
@@ -200,6 +217,23 @@ class BaseModule:
         from .. import sharding as _sharding
         mesh_ctx = _sharding.resolve(mesh)
 
+        from .. import elastic as _elastic
+        el_cfg = _elastic.ElasticConfig.resolve(elastic)
+        resume_state = None
+        if resume:
+            spec = resume
+            if resume is True:
+                if el_cfg is None:
+                    raise MXNetError(
+                        "fit(resume=True) needs elastic= (or MXTPU_ELASTIC)"
+                        " to name the checkpoint prefix")
+                spec = el_cfg.prefix
+            resume_state = _elastic.load_resume(spec)
+            if resume_state is None:
+                self.logger.info(
+                    "fit(resume): no durable generation at %r — starting "
+                    "fresh", spec)
+
         # arm the hang watchdog (MXTPU_WATCHDOG=0 opts out) + the SIGUSR2
         # postmortem handler (only over SIG_DFL — a user's own USR2
         # handler is never replaced; MXTPU_DIAG_SIGNAL=0 opts out)
@@ -212,7 +246,8 @@ class BaseModule:
                     eval_end_callback, eval_batch_end_callback, initializer,
                     arg_params, aux_params, allow_missing, force_rebind,
                     force_init, begin_epoch, num_epoch, validation_metric,
-                    monitor, max_in_flight, metric_sync, device_metrics)
+                    monitor, max_in_flight, metric_sync, device_metrics,
+                    el_cfg, resume_state)
         except Exception as exc:
             # fatal training exception: capture the flight ring / ledger /
             # engine state BEFORE the stack unwinds and the evidence GCs.
@@ -234,7 +269,8 @@ class BaseModule:
                   eval_batch_end_callback, initializer, arg_params,
                   aux_params, allow_missing, force_rebind, force_init,
                   begin_epoch, num_epoch, validation_metric, monitor,
-                  max_in_flight, metric_sync, device_metrics):
+                  max_in_flight, metric_sync, device_metrics,
+                  el_cfg=None, resume_state=None):
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)
@@ -250,6 +286,22 @@ class BaseModule:
             validation_metric = eval_metric
         if not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
+
+        # elastic resume: applied AFTER bind/init so set_params restages
+        # the fused device state and the restored RNG streams are not
+        # consumed by the (now overwritten) initializer draws
+        from .. import elastic as _elastic
+        el_session = None
+        restored_iter = False
+        if resume_state is not None:
+            restored_iter = _elastic.apply_resume(
+                self, resume_state, eval_metric=eval_metric,
+                train_data=train_data)
+            begin_epoch = max(begin_epoch, resume_state.begin_epoch)
+        if el_cfg is not None:
+            el_session = _elastic.ElasticSession(
+                self, el_cfg, logger=self.logger,
+                resume_state=resume_state)
 
         accum = _metric.DeviceMetricAccum.wrap(eval_metric) \
             if device_metrics else None
@@ -307,14 +359,39 @@ class BaseModule:
         try:
             for epoch in range(begin_epoch, num_epoch):
                 tic = time.time()
-                eval_metric.reset()
-                if accum is not None:
-                    accum.reset()
+                # a mid-epoch resume continues THIS epoch: the restored
+                # metric sums and iterator cursor must survive, so skip
+                # the epoch-top reset exactly once
+                resumed_here = (resume_state is not None
+                                and not resume_state.epoch_boundary
+                                and epoch == resume_state.epoch)
+                if not resumed_here:
+                    eval_metric.reset()
+                    if accum is not None:
+                        accum.reset()
                 nbatch = 0
+                skip_batches = 0
+                if resumed_here:
+                    nbatch = resume_state.start_nbatch
+                    if not restored_iter:
+                        # iterator without a native cursor: replay the
+                        # epoch head and discard (deterministic order,
+                        # no training, no RNG draws)
+                        skip_batches = nbatch
                 epoch_samples = 0
                 data_iter = iter(train_data)
+                for _ in range(skip_batches):
+                    try:
+                        next(data_iter)
+                    except StopIteration:
+                        break
                 end_of_batch = False
-                next_data_batch = next(data_iter)
+                try:
+                    next_data_batch = next(data_iter)
+                except StopIteration:
+                    # resumed exactly at the epoch's last batch
+                    next_data_batch = None
+                    end_of_batch = True
                 inflight = deque()
                 while not end_of_batch:
                     data_batch = next_data_batch
@@ -327,6 +404,11 @@ class BaseModule:
                         self.forward_backward(data_batch)
                         self.update()
                     dispatch_ms.observe(sp.duration_ms)
+                    if el_session is not None:
+                        # BEFORE the lookahead fetch below: the only
+                        # point where the iterator cursor still reads
+                        # "batches 0..nbatch consumed"
+                        el_session.pre_lookahead(train_data, epoch, nbatch)
                     view = self._device_step_view(data_batch) \
                         if accum is not None else None
                     if data_batch.data:
@@ -368,6 +450,12 @@ class BaseModule:
                         t0 = time.perf_counter()
                         accum.sync()
                         msync_ms.observe((time.perf_counter() - t0) * 1e3)
+                    if el_session is not None:
+                        # after the step's metrics accumulated, before
+                        # the callbacks: the cadence snapshot point, and
+                        # where supervisor interrupts (wedge/SIGTERM)
+                        # surface as exceptions
+                        el_session.on_step(eval_metric, accum, train_data)
                     if batch_end_callback is not None:
                         batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
                                                          eval_metric=eval_metric,
@@ -388,14 +476,22 @@ class BaseModule:
                 # the reference round-trips every parameter through the host
                 # here each epoch; with device-resident weights (fused step)
                 # that transfer is pure waste unless a callback wants them —
-                # checkpoint callbacks still pull lazily via get_params
-                if epoch_end_callback is not None or \
+                # elastic-aware checkpoint callbacks (_needs_host_params
+                # False: they snapshot the device state directly through
+                # the async writer) don't, so the round trip is skipped
+                # and _params_device_resident stays true through a
+                # checkpointing fit
+                epoch_cbs = _as_list(epoch_end_callback)
+                need_host = any(getattr(cb, "_needs_host_params", True)
+                                for cb in epoch_cbs)
+                arg_params_out = aux_params_out = None
+                if (epoch_cbs and need_host) or \
                         not self._params_device_resident():
                     arg_params_out, aux_params_out = self.get_params()
                     self.set_params(arg_params_out, aux_params_out)
-                if epoch_end_callback is not None:
-                    for callback in _as_list(epoch_end_callback):
-                        callback(epoch, self.symbol, arg_params_out, aux_params_out)
+                for callback in epoch_cbs:
+                    callback(epoch, self.symbol, arg_params_out,
+                             aux_params_out)
 
                 if eval_data:
                     if accum is not None:
@@ -413,6 +509,11 @@ class BaseModule:
                         self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name,
                                          val)
                 train_data.reset()
+                if el_session is not None:
+                    el_session.on_epoch(epoch, eval_metric, train_data)
+            if el_session is not None:
+                # fit returning implies its checkpoints are durable
+                _elastic.writer().flush()
         finally:
             # post-fit reads (and the next fit) must see live values,
             # not this run's last cadence snapshot

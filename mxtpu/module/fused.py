@@ -623,6 +623,49 @@ class FusedTrainStep:
         self.outputs = outs
         return outs
 
+    # ------------------------------------------------ elastic state seam
+    def export_device_state(self):
+        """Fresh device copies of (params, aux, opt_state) — the elastic
+        snapshot capture point (docs/elastic.md). ONE jitted tree-copy
+        program makes new buffers, so later donated steps cannot
+        invalidate the snapshot, and each leaf's device→host transfer is
+        kicked off asynchronously so the snapshot writer thread finds the
+        bytes (mostly) landed without the training thread ever blocking.
+        Under a plan the optimizer-state copies keep their weight-update
+        sharding — the caller serializes per-shard (no gather)."""
+        snap_p, snap_a, snap_o = _snapshot((self.params, self.aux,
+                                            self.opt_state))
+        for leaf in jax.tree.leaves((snap_p, snap_a, snap_o)):
+            try:
+                leaf.copy_to_host_async()
+            except Exception:
+                pass  # backend without async host copies: writer blocks
+        return snap_p, snap_a, snap_o
+
+    def stage_opt_leaves(self, name, leaves):
+        """Adopt restored optimizer-state leaves for ``name`` (checkpoint
+        resume). jax arrays the caller already laid out (e.g. reassembled
+        per-shard on the mesh) are adopted as-is; host values are staged
+        onto the plan's weight-update sharding spec — a replicated
+        restore would void the per-chip memory split. Leaf dtypes follow
+        the live state (f32 masters stay f32)."""
+        cur_leaves, treedef = jax.tree.flatten(self.opt_state[name])
+        if len(cur_leaves) != len(leaves):
+            raise ValueError(
+                "opt-state restore for %r: %d leaves saved, %d live"
+                % (name, len(leaves), len(cur_leaves)))
+        spec = self._opt_spec(name)
+        staged = []
+        for cur, new in zip(cur_leaves, leaves):
+            if isinstance(new, jax.Array) and new.shape == cur.shape \
+                    and new.dtype == cur.dtype \
+                    and getattr(new, "committed", False):
+                staged.append(new)
+                continue
+            staged.append(self._put(
+                jnp.asarray(getattr(new, "_data", new), cur.dtype), spec))
+        self.opt_state[name] = jax.tree.unflatten(treedef, staged)
+
     # ------------------------------------------------ sync back
     def export_params(self):
         """Return (arg_params, aux_params) as NDArray dicts.

@@ -474,8 +474,9 @@ imperative_invoke = invoke_op
 
 def waitall():
     """Block until all launched work completes (parity Engine::WaitForAll):
-    device work (XLA dispatch queue) AND host tasks scheduled on the native
-    engine (async checkpoint writes, prefetch side effects)."""
+    device work (XLA dispatch queue), host tasks scheduled on the native
+    engine (prefetch side effects), and pending async checkpoint writes
+    on the elastic snapshot writer."""
     (jnp.zeros(()) + 0).block_until_ready()
     try:
         jax.effects_barrier()
@@ -484,6 +485,10 @@ def waitall():
     from .. import engine as _engine
 
     _engine.get().wait_for_all()
+    from ..elastic import snapshot as _snap
+
+    if _snap._WRITER is not None:  # never instantiate just to drain
+        _snap._WRITER.flush()
 
 
 # ---------------------------------------------------------------- creation

@@ -37,6 +37,22 @@ def next_key():
     return sub
 
 
+def get_state():
+    """The current key-chain state as host numpy (elastic checkpointing:
+    restoring it with :func:`set_state` makes every later ``next_key``
+    reproduce the original chain exactly)."""
+    import numpy as _np
+    return _np.asarray(_key())
+
+
+def set_state(key_data):
+    """Restore a key chain captured by :func:`get_state` (accepts the raw
+    uint32 key data as numpy/jax array)."""
+    import jax.numpy as jnp
+    import numpy as _np
+    _state.key = jnp.asarray(_np.asarray(key_data, dtype=_np.uint32))
+
+
 # imperative sampling front-ends (mx.random.uniform etc.) are generated onto
 # mxtpu.ndarray and re-exported from mxtpu/__init__.py
 

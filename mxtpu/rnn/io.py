@@ -109,21 +109,59 @@ class BucketSentenceIter(DataIter):
                              range(0, len(buck) - batch_size + 1,
                                    batch_size)])
         self.curr_idx = 0
+        self._order = None  # per-bucket row permutations of the last reset
         self.reset()
 
     def reset(self):
         self.curr_idx = 0
         random.shuffle(self.idx)
-        for buck in self.data:
-            np.random.shuffle(buck)
+        # permutation-based shuffle (rather than shuffling the buckets in
+        # place): the (idx order, per-bucket permutation) pair fully
+        # determines the epoch's batch stream, so checkpoint_state can
+        # capture it and a resumed process reproduces the exact batches
+        self._order = [np.random.permutation(len(buck))
+                       for buck in self.data]
+        self._rebuild()
+
+    def _rebuild(self):
         self.nddata = []
         self.ndlabel = []
-        for buck in self.data:
+        for buck, order in zip(self.data, self._order):
+            buck = buck[order]
             label = np.empty_like(buck)
             label[:, :-1] = buck[:, 1:]
             label[:, -1] = self.invalid_label
             self.nddata.append(array(buck, dtype=self.dtype))
             self.ndlabel.append(array(label, dtype=self.dtype))
+
+    # ------------------------------------------------- elastic cursor
+    def checkpoint_state(self):
+        """Exact position for fit-resume: batch cursor, the shuffled
+        bucket-batch schedule, and the per-bucket row permutations."""
+        return {"curr_idx": int(self.curr_idx),
+                "idx_bucket": np.asarray([i for i, _ in self.idx],
+                                         dtype=np.int64),
+                "idx_offset": np.asarray([j for _, j in self.idx],
+                                         dtype=np.int64),
+                "order": {str(k): np.asarray(o)
+                          for k, o in enumerate(self._order)}}
+
+    def restore_state(self, state):
+        if not isinstance(state, dict) or "curr_idx" not in state:
+            return False
+        order = state.get("order") or {}
+        if len(order) != len(self.data):
+            return False
+        buckets = [int(b) for b in np.asarray(state["idx_bucket"])]
+        offsets = [int(j) for j in np.asarray(state["idx_offset"])]
+        if len(buckets) != len(self.idx):
+            return False
+        self.idx = list(zip(buckets, offsets))
+        self._order = [np.asarray(order[str(k)], dtype=np.int64)
+                       for k in range(len(self.data))]
+        self.curr_idx = int(state["curr_idx"])
+        self._rebuild()
+        return True
 
     def next(self):
         if self.curr_idx == len(self.idx):

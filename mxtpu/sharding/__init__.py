@@ -31,11 +31,12 @@ from __future__ import annotations
 from .spec import SpecLayout, parameter_spec_from_name
 from .plan import (DISABLED, MeshContext, ShardingPlan, activate, active,
                    active_mesh, current, deactivate, from_env, naive_spec,
-                   plan_for_module, resolve, use)
+                   plan_for_module, resolve, spec_from_json, spec_to_json,
+                   use)
 
 __all__ = [
     "SpecLayout", "parameter_spec_from_name",
     "MeshContext", "ShardingPlan", "naive_spec", "plan_for_module",
     "activate", "deactivate", "active", "active_mesh", "current", "use",
-    "resolve", "from_env", "DISABLED",
+    "resolve", "from_env", "DISABLED", "spec_to_json", "spec_from_json",
 ]

@@ -35,7 +35,23 @@ from .spec import SpecLayout, parameter_spec_from_name
 
 __all__ = ["MeshContext", "ShardingPlan", "activate", "deactivate",
            "active", "active_mesh", "current", "use", "resolve",
-           "from_env", "plan_for_module", "naive_spec", "DISABLED"]
+           "from_env", "plan_for_module", "naive_spec", "DISABLED",
+           "spec_to_json", "spec_from_json"]
+
+
+# ------------------------------------------------------- spec round-trip
+def spec_to_json(spec):
+    """A ``PartitionSpec`` as a JSON-able value (checkpoint manifests:
+    the elastic snapshot records every sharded leaf's spec so restore
+    can re-stage it without gathering). Entries: ``None`` | axis name |
+    list of axis names."""
+    return [list(e) if isinstance(e, tuple) else e for e in tuple(spec)]
+
+
+def spec_from_json(entries):
+    """Inverse of :func:`spec_to_json` (lists become axis tuples)."""
+    return PS(*[tuple(e) if isinstance(e, list) else e
+                for e in (entries or [])])
 
 log = logging.getLogger(__name__)
 

@@ -485,16 +485,41 @@ def test_parallel_current_mesh_one_truth(monkeypatch):
 
 
 def test_opt_state_checkpoint_roundtrip_stays_sharded(tmp_path):
-    """Optimizer-state restore under a mesh re-stages on the plan's
-    weight-update sharding specs — a replicated restore would void the
-    per-chip memory split and trip the consistency pass."""
+    """Optimizer-state save/restore under a mesh: the file is now a
+    sharded MANIFEST (specs + per-shard pieces, written without
+    gathering) instead of a pickle that serialized the per-process shard
+    view as if global, and restore re-stages on the plan's weight-update
+    sharding specs — a replicated restore would void the per-chip memory
+    split and trip the consistency pass."""
+    import json
     _, _, mod = _fit_mlp(mesh=8, num_epoch=1)
     fused = mod._fused
     path = str(tmp_path / "opt.states")
     mod.save_optimizer_states(path)
+    # the sharded manifest format, not a pickle
+    with open(path) as f:
+        man = json.load(f)
+    assert man["format"] == "mxtpu-opt-states-sharded-1"
+    entry = man["entries"]["fc1_weight"]
+    assert entry["spec"] == ["data"]
+    assert len(entry["shards"]["0"]["pieces"]) == 8
+    assert (tmp_path / "opt.states.data").exists()
+    before = {n: [np.asarray(x) for x in
+                  jax.tree.leaves(fused.opt_state[n])]
+              for n in fused.trainable}
     mod.load_optimizer_states(path)
+    # values survive exactly AND the PR-6 1/8 split survives
+    for n, leaves in before.items():
+        for want, got in zip(leaves, jax.tree.leaves(fused.opt_state[n])):
+            np.testing.assert_array_equal(want, np.asarray(got), err_msg=n)
     leaf = jax.tree.leaves(fused.opt_state["fc1_weight"])[0]
     assert leaf.sharding.spec == P("data"), leaf.sharding.spec
+    assert len(leaf.sharding.device_set) == 8
+    shard_bytes = {s.device.id: s.data.nbytes
+                   for s in leaf.addressable_shards}
+    assert len(shard_bytes) == 8
+    for nbytes in shard_bytes.values():
+        assert nbytes == leaf.nbytes // 8
     with sh.use(fused._plan.mesh_ctx):
         assert mod.check().ok
 

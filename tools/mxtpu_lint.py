@@ -70,6 +70,12 @@ HOT_PATHS = {
     "mxtpu/predict.py": None,
     "mxtpu/metric.py": {"DeviceKernel", "DeviceMetricAccum"},
     "mxtpu/io.py": {"PrefetchingIter", "DevicePrefetchIter"},
+    # the snapshot CAPTURE path runs on the training thread between
+    # steps: it must enqueue device-side copies, never materialize host
+    # bytes itself (the SnapshotWriter thread carries the one allowed
+    # sync, pragma'd at its materialization site)
+    "mxtpu/elastic/snapshot.py": None,
+    "mxtpu/elastic/state.py": {"ElasticSession"},
 }
 
 #: numpy module aliases whose ``asarray``/``array`` calls mean "pull to
@@ -107,6 +113,11 @@ LOCK_LEVELS = [
     ("pool", {("ExecutorPool", "_rr_lock"), ("ExecutorPool", "_owned_lock"),
               ("_Replica", "lock")}),
     ("slot-state", {("FusedState", "_mem_lock")}),
+    # elastic writer queue + supervisor flags: PR 8. Held only for queue
+    # and flag ops; telemetry emission happens outside, so they sit
+    # above the registry level. The writer's condition wraps its lock.
+    ("elastic", {("SnapshotWriter", "_cond"), ("SnapshotWriter", "_lock"),
+                 ("Supervisor", "_lock"), ("snapshot", "_WRITER_LOCK")}),
     ("postmortem", {("diagnostics", "_PM_LOCK")}),
     ("ledger", {("DeviceMemoryLedger", "_lock")}),
     ("programs", {("programs", "_LOCK")}),
