@@ -75,9 +75,21 @@ def main():
 
     stats = session.stats()
     print(json.dumps(stats, indent=2))
-    print("batch-fill %.2f | cache hit rate %.2f | p99 %.1f ms"
+    print("batch-fill %.2f | cache hit rate %.2f | p99 %.1f ms | "
+          "shed rate %.3f"
           % (stats["batch_fill_ratio"], stats["executor_cache_hit_rate"],
-             stats["request_latency_ms"]["p99_ms"]))
+             stats["request_latency_ms"]["p99_ms"], stats["shed_rate"]))
+
+    # zero-downtime hot-swap: new weights pre-warm in the process-wide
+    # cache while v0 serves, then the pool pointer flips atomically
+    print("hot-swapping to perturbed weights (version v1) ...")
+    new_params = {k: v + 0.05 for k, v in params.items()}
+    info = session.swap_model(sym_json, new_params, version_tag="v1")
+    with urllib.request.urlopen(server.endpoint + "/v1/version",
+                                timeout=10) as r:
+        print("active version:", json.loads(r.read()))
+    assert info["generation"] == 1
+
     server.shutdown()
     server.server_close()
     print("drained and stopped.")

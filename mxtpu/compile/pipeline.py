@@ -34,6 +34,7 @@ from .. import telemetry as _tel
 __all__ = ["set_output_sanitizer", "add_build_listener",
            "remove_build_listener", "program_build_count", "notify_build",
            "record_program_build", "instrument_program",
+           "prewarm_scope", "in_prewarm", "prewarm_build_count",
            "configure", "configured", "pipeline_scope",
            "transform_graph", "PipelineReport"]
 
@@ -89,10 +90,48 @@ def program_build_count():
     return _BUILD_COUNT[0]
 
 
+# ------------------------------------------------------------- pre-warm seam
+# Deploy-time compilation (serving warmup, WarmExecutableCache.prewarm,
+# a hot-swap's pre-flip warm) runs inside prewarm_scope() so the build
+# counters can tell a planned deploy compile from a mid-traffic cache
+# miss — the event continuous serving treats as a regression. Depth is
+# thread-local: warmup runs on the deploying thread while traffic keeps
+# building elsewhere.
+_PREWARM_TLS = _threading.local()
+
+_M_PREWARM_BUILDS = _tel.registry().counter(
+    "executor_prewarm_builds_total",
+    help="program builds inside a prewarm_scope (deploy-time compiles, "
+         "not mid-traffic cache misses)")
+
+
+@contextlib.contextmanager
+def prewarm_scope():
+    """Mark program builds on this thread as deploy-time pre-warm."""
+    depth = getattr(_PREWARM_TLS, "depth", 0)
+    _PREWARM_TLS.depth = depth + 1
+    try:
+        yield
+    finally:
+        _PREWARM_TLS.depth = depth
+
+
+def in_prewarm():
+    """True while the calling thread is inside a ``prewarm_scope``."""
+    return getattr(_PREWARM_TLS, "depth", 0) > 0
+
+
+def prewarm_build_count():
+    """Total builds that happened inside a prewarm_scope (monotonic)."""
+    return int(_M_PREWARM_BUILDS.value)
+
+
 def notify_build(kind, owner):
     with _BUILD_LOCK:  # concurrent replica builds must not lose counts
         _BUILD_COUNT[0] += 1
     _M_BUILDS_TOTAL.inc()
+    if in_prewarm():
+        _M_PREWARM_BUILDS.inc()
     _tel.registry().counter("executor_program_builds",
                             labels={"kind": kind}).inc()
     for fn in list(_BUILD_LISTENERS):

@@ -42,7 +42,8 @@ from .programs import (ProgramRecord, cost_enabled, latest_record,
 from .flight import (FlightRecorder, flight_enabled, record, recorder,
                      set_flight_enabled)
 from .watchdog import (Watchdog, active_waits, add_action, ensure_watchdog,
-                       remove_action, stop_watchdog, wait_begin, wait_end)
+                       progress_age_s, remove_action, stop_watchdog,
+                       wait_begin, wait_end)
 
 __all__ = [
     "DeviceMemoryLedger", "ledger", "alloc_origin", "current_origin",
@@ -54,6 +55,7 @@ __all__ = [
     "set_flight_enabled",
     "Watchdog", "ensure_watchdog", "stop_watchdog", "active_waits",
     "wait_begin", "wait_end", "add_action", "remove_action",
+    "progress_age_s",
     "debug_state", "postmortem", "last_postmortem", "dump_state",
     "install_signal_handler", "set_enabled",
 ]

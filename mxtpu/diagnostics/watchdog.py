@@ -31,7 +31,8 @@ import time
 from .. import telemetry as _tel
 
 __all__ = ["Watchdog", "ensure_watchdog", "stop_watchdog", "wait_begin",
-           "wait_end", "active_waits", "add_action", "remove_action"]
+           "wait_end", "active_waits", "add_action", "remove_action",
+           "progress_age_s"]
 
 # ------------------------------------------------------------- action hooks
 # Subscribers that ACT on a detection (elastic supervisor: checkpoint-
@@ -225,6 +226,13 @@ _tel.registry().gauge(
     "watchdog_last_progress_age_s", fn=_singleton_progress_age,
     help="seconds since the watchdog last saw engine progress "
          "(or an empty queue); 0 with no watchdog running")
+
+
+def progress_age_s():
+    """Seconds since the process watchdog last saw progress — the
+    cheap health signal admission control reads (0.0 with no watchdog
+    running: absence of evidence must not shed traffic)."""
+    return _singleton_progress_age()
 
 
 def ensure_watchdog():

@@ -33,14 +33,17 @@ from .compile import pipeline as _pipeline
 # run before tracing; every name below keeps its historical home here.
 from .compile.pipeline import (_AOT_MISS, _DEMOTE_MISS_TOTAL,  # noqa: F401
                                _DEMOTE_MISSES, add_build_listener,
-                               instrument_program as _instrument_program,
+                               in_prewarm, instrument_program
+                               as _instrument_program,
                                notify_build as _notify_build,
+                               prewarm_build_count, prewarm_scope,
                                program_build_count, record_program_build,
                                remove_build_listener, set_output_sanitizer)
 
 __all__ = ["Executor", "add_build_listener", "remove_build_listener",
            "program_build_count", "record_program_build", "device_wait",
-           "set_output_sanitizer"]
+           "set_output_sanitizer", "prewarm_scope", "in_prewarm",
+           "prewarm_build_count"]
 
 
 def device_wait(x):

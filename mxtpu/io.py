@@ -316,6 +316,17 @@ class PrefetchingIter(DataIter):
     def iter_next(self):
         if not self.started:
             raise MXNetError("PrefetchingIter is closed")
+        # deterministic stall accounting FIRST: whether the batch was
+        # already staged when the consumer arrived is a scheduling fact,
+        # not a wall-clock measurement — tests assert on it because the
+        # elapsed-time percentiles below collapse under host contention
+        # (the ROADMAP ops-note flake)
+        staged = all(e.is_set() for e in self.data_ready)
+        _tel.counter("io_prefetch_ready",
+                     labels={"state": "hit" if staged else "wait"},
+                     help="consumer arrivals that found the next batch "
+                          "already staged (hit) vs had to block (wait)"
+                     ).inc()
         # time blocked on the producer threads: a healthy pipeline shows
         # ~zero stall (the batch was ready before the consumer asked)
         t0 = _time.perf_counter()

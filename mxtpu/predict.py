@@ -96,9 +96,17 @@ class Predictor:
                 self._symbol.tojson().encode()).hexdigest()[:16]
         return self._symbol_hash
 
-    def _shape_key(self):
+    @staticmethod
+    def shape_key(input_shapes):
+        """The bind-cache key for an input-shape dict — THE one format
+        (serving's pool consults ``_bind_cache`` with keys it builds
+        itself; a second copy of this tuple layout would silently stop
+        matching if the key ever grew a component)."""
         return tuple(sorted((k, tuple(v))
-                            for k, v in self._input_shapes.items()))
+                            for k, v in input_shapes.items()))
+
+    def _shape_key(self):
+        return self.shape_key(self._input_shapes)
 
     def _bind(self):
         key = self._shape_key()

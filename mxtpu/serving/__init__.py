@@ -1,33 +1,48 @@
-"""mxtpu.serving — dynamic-batching inference runtime.
+"""mxtpu.serving — continuous-batching inference runtime.
 
 The deployment layer above the single-request predict API: compiled
-Predictors become a high-throughput multi-replica service. Pieces:
+Predictors become a high-throughput multi-replica service that holds
+p99 under open-loop load. Pieces:
 
-  * ``batcher``  — thread-safe queue coalescing requests into shape
-                   buckets under a latency deadline
-  * ``pool``     — per-device Predictor replicas with an LRU cache of
-                   compiled executables keyed (symbol hash, shape, dtype)
-  * ``server``   — in-process ``ServingSession`` + stdlib JSON-over-HTTP
-                   front-end with backpressure and graceful drain
-  * ``metrics``  — qps / batch-fill / queue-depth / latency-percentile /
-                   cache-hit observability over ``mxtpu.telemetry``:
-                   Prometheus + JSON at ``/metrics``, correlated trace
-                   spans, chrome://tracing mirroring
+  * ``batcher``   — thread-safe queue coalescing requests into shape
+                    buckets; ``ContinuousBatcher`` adds the refill
+                    watermark for slot-driven K-in-flight dispatch
+  * ``pool``      — per-device Predictor replicas over a process-wide
+                    ``WarmExecutableCache`` (symbol hash x version x
+                    ctx), pre-warmable at deploy from a bucket manifest
+  * ``admission`` — signal-driven admission control: shed with 429 off
+                    queue-wait estimates (PR-4 cost-registry rows),
+                    watchdog age and memory-ledger headroom
+  * ``server``    — in-process ``ServingSession`` (continuous or burst
+                    dispatch, versioned hot-swap with graceful drain) +
+                    stdlib JSON-over-HTTP front-end
+  * ``metrics``   — qps / shed-rate / batch-fill / in-flight depth /
+                    refill latency / latency-percentile observability
+                    over ``mxtpu.telemetry``
 
 See docs/serving.md for architecture and tuning; docs/observability.md
-for the framework-wide telemetry layer this plugs into.
+for the framework-wide telemetry layer this plugs into;
+``tools/loadgen_serving.py`` for the open-loop (Poisson) load generator
+behind ``BENCH_serving_v2.json``.
 """
-from .batcher import (BatcherClosed, DynamicBatcher, QueueFull, WorkItem,
-                      pad_rows, pick_bucket)
+from .admission import (ACCEPTING, DEGRADED, SHEDDING, AdmissionPolicy,
+                        AdmissionShed, AdmissionSignals, Decision,
+                        SignalAdmissionPolicy, derive_knobs)
+from .batcher import (BatcherClosed, ContinuousBatcher, DynamicBatcher,
+                      QueueFull, WorkItem, pad_rows, pick_bucket)
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
-from .pool import ExecutorPool, default_contexts
+from .pool import (ExecutorPool, WarmExecutableCache, default_contexts,
+                   prewarm, warm_cache)
 from .server import (DEFAULT_BUCKETS, ServingHTTPServer, ServingSession,
                      serve)
 
 __all__ = [
-    "BatcherClosed", "DynamicBatcher", "QueueFull", "WorkItem",
-    "pad_rows", "pick_bucket",
+    "ACCEPTING", "DEGRADED", "SHEDDING", "AdmissionPolicy", "AdmissionShed",
+    "AdmissionSignals", "Decision", "SignalAdmissionPolicy", "derive_knobs",
+    "BatcherClosed", "ContinuousBatcher", "DynamicBatcher", "QueueFull",
+    "WorkItem", "pad_rows", "pick_bucket",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "ExecutorPool", "default_contexts",
+    "ExecutorPool", "WarmExecutableCache", "default_contexts", "prewarm",
+    "warm_cache",
     "DEFAULT_BUCKETS", "ServingHTTPServer", "ServingSession", "serve",
 ]

@@ -1,0 +1,202 @@
+"""Signal-driven admission control: shed load BEFORE the device wedges.
+
+A bounded queue (PR 1's ``QueueFull``) is a position-based limit: it
+says nothing about how long the queue will take to drain. Under
+open-loop overload the queue sits at its bound while every admitted
+request waits the full drain time — p99 explodes long before anything
+is rejected, and a slow device turns the bound into a standing latency
+wall. Admission control inverts that: each request is judged against
+what the framework already measures —
+
+  * **queue-wait estimate** — pending rows x the measured per-batch
+    cost (the PR-4 cost-registry / warmup-measured rows, refined by the
+    live ``batch_exec_ms`` histogram) over the replica count: the time
+    a request admitted NOW would wait before its batch dispatches;
+  * **watchdog age** — seconds since the diagnostics watchdog saw
+    progress, plus the oldest active device wait: a wedging device
+    sheds new work instead of queueing it behind the wedge;
+  * **memory-ledger headroom** — live device bytes vs the configured
+    budget: admission stops before the allocator does;
+  * **queue occupancy** — shed a breath before ``QueueFull`` would, so
+    the reject is a policy decision with a reason, not a full buffer.
+
+The policy is pluggable (``ServingSession(admission=...)``): anything
+with ``decide(signals) -> Decision``. A shed surfaces as
+:class:`AdmissionShed` (HTTP 429 — the same backpressure status as
+``QueueFull``, distinguished by the ``requests_shed{reason=...}``
+series and the ``admission`` block of ``/debug/state``).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["AdmissionShed", "AdmissionSignals", "Decision",
+           "AdmissionPolicy", "SignalAdmissionPolicy", "derive_knobs",
+           "ACCEPTING", "DEGRADED", "SHEDDING", "STATE_NAMES"]
+
+#: admission_state gauge values (exported, dashboard-stable)
+ACCEPTING, DEGRADED, SHEDDING = 0, 1, 2
+STATE_NAMES = {ACCEPTING: "accepting", DEGRADED: "degraded",
+               SHEDDING: "shedding"}
+
+
+class AdmissionShed(MXNetError):
+    """Request shed by the admission policy — HTTP 429 (retryable)."""
+
+
+class AdmissionSignals:
+    """One point-in-time snapshot of the signals a policy judges.
+
+    Built by ``ServingSession._signals()`` from structures the server
+    already maintains — constructing one takes no locks and performs no
+    device work (admission runs on every request's submit path).
+    ``mem_headroom_frac`` is None when no memory budget is configured:
+    a missing signal must read as healthy, never as evidence.
+    """
+
+    __slots__ = ("queue_depth", "queue_limit", "pending_rows",
+                 "inflight_depth", "inflight_limit", "replicas",
+                 "est_batch_ms", "est_queue_wait_ms", "watchdog_age_s",
+                 "mem_headroom_frac")
+
+    def __init__(self, queue_depth=0, queue_limit=1, pending_rows=0,
+                 inflight_depth=0, inflight_limit=1, replicas=1,
+                 est_batch_ms=0.0, est_queue_wait_ms=0.0,
+                 watchdog_age_s=0.0, mem_headroom_frac=None):
+        self.queue_depth = queue_depth
+        self.queue_limit = queue_limit
+        self.pending_rows = pending_rows
+        self.inflight_depth = inflight_depth
+        self.inflight_limit = inflight_limit
+        self.replicas = replicas
+        self.est_batch_ms = est_batch_ms
+        self.est_queue_wait_ms = est_queue_wait_ms
+        self.watchdog_age_s = watchdog_age_s
+        self.mem_headroom_frac = mem_headroom_frac
+
+    def to_dict(self):
+        return {k: getattr(self, k) for k in self.__slots__}
+
+
+class Decision:
+    """What the policy decided for one request."""
+
+    __slots__ = ("admit", "state", "reason")
+
+    def __init__(self, admit, state=ACCEPTING, reason="ok"):
+        self.admit = admit
+        self.state = state
+        self.reason = reason
+
+    def __repr__(self):
+        return "Decision(admit=%s, state=%s, reason=%r)" % (
+            self.admit, STATE_NAMES.get(self.state, self.state), self.reason)
+
+
+class AdmissionPolicy:
+    """Base policy: admit everything (the PR-1 behavior — the bounded
+    queue alone provides backpressure)."""
+
+    def decide(self, signals):
+        return Decision(True, ACCEPTING, "admit-all")
+
+
+class SignalAdmissionPolicy(AdmissionPolicy):
+    """Threshold policy over :class:`AdmissionSignals`.
+
+    Sheds when any of the following holds (first match names the
+    reason):
+
+    * ``watchdog`` — no watchdog/device progress for
+      ``watchdog_shed_s`` (default 10s): the device is wedging; queued
+      work behind a wedge only deepens the postmortem;
+    * ``memory`` — ledger headroom below ``min_mem_headroom`` (default
+      3% of budget; skipped when no budget is configured);
+    * ``queue`` — queue occupancy at/above ``queue_frac_shed`` (default
+      95%) of the bound: shed with a reason before ``QueueFull`` sheds
+      without one;
+    * ``latency`` — estimated queue wait above ``queue_wait_budget_ms``:
+      the request would blow its latency budget while still in the
+      queue, so a fast 429 (client retries elsewhere) beats a slow 504.
+
+    Between ``degrade_frac`` (default 0.5) and 1.0 of the latency
+    budget the policy still admits but reports ``DEGRADED`` — the
+    dashboard-visible early warning. The policy is stateless: every
+    decision is a pure function of the snapshot, so concurrent
+    submitters need no lock and tests need no teardown.
+    """
+
+    def __init__(self, queue_wait_budget_ms=1000.0, watchdog_shed_s=10.0,
+                 min_mem_headroom=0.03, queue_frac_shed=0.95,
+                 degrade_frac=0.5):
+        self.queue_wait_budget_ms = float(queue_wait_budget_ms)
+        self.watchdog_shed_s = float(watchdog_shed_s)
+        self.min_mem_headroom = float(min_mem_headroom)
+        self.queue_frac_shed = float(queue_frac_shed)
+        self.degrade_frac = float(degrade_frac)
+
+    def decide(self, s):
+        if s.watchdog_age_s > self.watchdog_shed_s:
+            return Decision(False, SHEDDING,
+                            "watchdog: no progress for %.1fs"
+                            % s.watchdog_age_s)
+        if s.mem_headroom_frac is not None \
+                and s.mem_headroom_frac < self.min_mem_headroom:
+            return Decision(False, SHEDDING,
+                            "memory: ledger headroom %.1f%% below floor"
+                            % (s.mem_headroom_frac * 100.0))
+        if s.queue_limit and \
+                s.queue_depth >= self.queue_frac_shed * s.queue_limit:
+            return Decision(False, SHEDDING,
+                            "queue: depth %d at %.0f%% of bound %d"
+                            % (s.queue_depth,
+                               100.0 * s.queue_depth / s.queue_limit,
+                               s.queue_limit))
+        if s.est_queue_wait_ms > self.queue_wait_budget_ms:
+            return Decision(False, SHEDDING,
+                            "latency: est queue wait %.1fms over budget "
+                            "%.1fms" % (s.est_queue_wait_ms,
+                                        self.queue_wait_budget_ms))
+        if s.est_queue_wait_ms > self.degrade_frac \
+                * self.queue_wait_budget_ms:
+            return Decision(True, DEGRADED,
+                            "est queue wait %.1fms past %.0f%% of budget"
+                            % (s.est_queue_wait_ms,
+                               100.0 * self.degrade_frac))
+        return Decision(True, ACCEPTING, "ok")
+
+
+def derive_knobs(bucket_costs, buckets, marginal_tolerance=1.25):
+    """Pick continuous-batching knobs from measured per-bucket cost rows.
+
+    ``bucket_costs`` maps bucket size -> a dict with ``exec_ms`` (the
+    warmup-measured steady-state batch time) and optionally ``flops``
+    (the PR-4 cost-registry row). The refill watermark is the smallest
+    bucket whose per-row cost is within ``marginal_tolerance`` of the
+    best bucket's: dispatching at that fill sacrifices <25% per-row
+    efficiency versus waiting for a full batch, and waiting any longer
+    buys less than the device idle time it costs. Falls back to the
+    structural quarter-of-largest default when no rows were measured
+    (``MXTPU_DIAG_COST=0`` and warmup skipped).
+
+    Returns ``{"refill_watermark", "est_batch_ms", "basis"}``.
+    """
+    buckets = tuple(sorted(set(int(b) for b in buckets)))
+    rows = {int(b): c for b, c in (bucket_costs or {}).items()
+            if c and c.get("exec_ms", 0) > 0 and int(b) in buckets}
+    if not rows:
+        return {"refill_watermark": None, "est_batch_ms": None,
+                "basis": "default"}
+
+    def per_row(b):
+        # exec_ms/row captures the amortization of fixed dispatch +
+        # memory-movement cost that flops (linear in rows) cannot see
+        return rows[b]["exec_ms"] / b
+    best = min(per_row(b) for b in rows)
+    watermark = next((b for b in sorted(rows)
+                      if per_row(b) <= marginal_tolerance * best),
+                     buckets[-1])
+    largest_cost = rows.get(buckets[-1]) or rows[max(rows)]
+    return {"refill_watermark": watermark,
+            "est_batch_ms": largest_cost["exec_ms"],
+            "basis": "cost-registry"}

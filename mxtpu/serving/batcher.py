@@ -25,7 +25,7 @@ from ..base import MXNetError
 from ..telemetry import current_span as _current_span
 
 __all__ = ["QueueFull", "BatcherClosed", "WorkItem", "Batch",
-           "DynamicBatcher", "pad_rows", "pick_bucket"]
+           "DynamicBatcher", "ContinuousBatcher", "pad_rows", "pick_bucket"]
 
 
 class QueueFull(MXNetError):
@@ -102,6 +102,10 @@ class Batch:
     def __init__(self, items, bucket, input_names):
         self.items = items
         self.bucket = bucket
+        # why the batcher released this batch (full/watermark/deadline/
+        # drain) — stamped per batch because N dispatcher threads share
+        # one batcher, so a shared "last reason" field would race
+        self.flush_reason = None
         self.n_valid = sum(it.n for it in items)
         self.inputs = {}
         for name in input_names:
@@ -163,6 +167,7 @@ class DynamicBatcher:
         self._not_empty = threading.Condition(self._lock)
         self._closed = False
         self._metrics = metrics
+        self._last_flush_reason = None
 
     # ---------------------------------------------------------- producer
     def submit(self, inputs, timeout=None):
@@ -214,6 +219,11 @@ class DynamicBatcher:
     def depth(self):
         return len(self._items)
 
+    @property
+    def pending_rows(self):
+        """Examples waiting in the queue (admission-control signal)."""
+        return self._pending_rows
+
     # ---------------------------------------------------------- consumer
     def _reap_expired(self, now):
         """Fail timed-out items in place (caller holds the lock)."""
@@ -247,23 +257,39 @@ class DynamicBatcher:
         assemble fails ITS items and the wait resumes — a poisoned
         request must never kill the consumer thread."""
         deadline = time.monotonic() + timeout if timeout is not None else None
+        return self._next(deadline)
+
+    def _next(self, deadline, ready_rows=None, use_linger=True):
+        """Shared wait/assemble/fail loop behind ``next_batch`` and the
+        continuous batcher's ``next_fill`` (one copy of the
+        poisoned-batch handling, two flush policies)."""
         while True:
-            got = self._form_batch(deadline)
+            got = self._form_batch(deadline, ready_rows=ready_rows,
+                                   use_linger=use_linger)
             if got is None:
                 return None
-            take, rows = got
+            take, rows, reason = got
             try:
-                return self._assemble(take, rows)
+                batch = self._assemble(take, rows)
+                batch.flush_reason = reason
+                return batch
             except Exception as exc:
                 for it in take:
                     it.fail(MXNetError("batch assembly failed: %r" % exc))
                 if self._metrics:
                     self._metrics.counter("requests_failed").inc(len(take))
 
-    def _form_batch(self, deadline):
+    def _form_batch(self, deadline, ready_rows=None, use_linger=True):
         """Wait for and dequeue a batch-worth of items; None on idle
-        timeout or drain-complete."""
-        take, rows = None, 0
+        timeout or drain-complete, else ``(items, rows, reason)`` where
+        ``reason`` says why the flush fired (full/watermark/deadline/
+        drain). ``ready_rows`` lowers the immediate-flush threshold
+        below the largest bucket (the continuous batcher's refill
+        watermark); ``use_linger=False`` flushes at exactly
+        ``max_delay`` (a hungry device slot must not linger for an
+        arrival wave)."""
+        take, rows, reason = None, 0, None
+        target = self.buckets[-1]
         with self._lock:
             while take is None:
                 now = time.monotonic()
@@ -271,14 +297,21 @@ class DynamicBatcher:
                 if self._items:
                     age = now - self._items[0].t_enqueue
                     since_arrival = now - self._last_enqueue
-                    full = self._pending_rows >= self.buckets[-1]
+                    full = self._pending_rows >= target
+                    ready = ready_rows is not None \
+                        and self._pending_rows >= ready_rows
                     due = age >= self.max_delay and \
-                        (since_arrival >= self.linger or
+                        (not use_linger or since_arrival >= self.linger or
                          age >= 2 * self.max_delay)
-                    if full or due or self._closed:
+                    if full or ready or due or self._closed:
                         take, rows = self._take_locked()
                         if not take:
                             take = None
+                            continue
+                        reason = ("full" if full else
+                                  "watermark" if ready else
+                                  "deadline" if due else "drain")
+                        self._last_flush_reason = reason
                         continue
                     if age < self.max_delay:
                         wait = self.max_delay - age
@@ -296,7 +329,7 @@ class DynamicBatcher:
                         return None
                     wait = remaining if wait is None else min(wait, remaining)
                 self._not_empty.wait(wait)
-        return take, rows
+        return take, rows, reason
 
     def _assemble(self, take, rows):
         # the numpy concatenate/pad is the expensive part; the items are
@@ -324,3 +357,57 @@ class DynamicBatcher:
         with self._lock:
             self._closed = True
             self._not_empty.notify_all()
+
+
+class ContinuousBatcher(DynamicBatcher):
+    """DynamicBatcher for slot-driven (continuous-batching) consumers.
+
+    The burst batcher optimizes FILL: it holds the queue until the
+    largest bucket fills or a deadline expires, because its consumer
+    blocks on the device between dispatches — each flush is expensive.
+    The continuous dispatcher keeps K device batches in flight, so the
+    moment a slot frees, dispatching *something* beats waiting: device
+    idle time is pure loss, padding is merely cheap. ``next_fill``
+    therefore releases a batch as soon as pending rows reach the
+    **refill watermark** (no deadline wait), and when the deadline does
+    fire it skips the arrival-quiescence linger — a hungry slot never
+    waits for a wave to quiesce. With ``hungry=False`` (every slot
+    occupied) it behaves exactly like the burst batcher: there is no
+    point forming work the device cannot take.
+
+    The watermark is the fill-vs-latency knob: raise it toward the
+    largest bucket when per-row cost dominates (big models — prefer
+    full batches), drop it toward 1 when dispatch overhead dominates
+    (the device should never starve). ``serving.admission.derive_knobs``
+    picks it from the measured per-bucket cost registry rows.
+    """
+
+    def __init__(self, input_names, refill_watermark=None, **kwargs):
+        super().__init__(input_names, **kwargs)
+        if refill_watermark is None:
+            # a quarter of the largest bucket: enough rows that the
+            # dispatch isn't overhead-bound, small enough that a freed
+            # slot refills within one arrival burst
+            refill_watermark = self.buckets[-1] // 4
+        self.refill_watermark = max(1, min(int(refill_watermark),
+                                           self.buckets[-1]))
+
+    def next_fill(self, timeout=None, hungry=True):
+        """Like ``next_batch`` but for a consumer with a free device
+        slot: flush at the refill watermark, never linger. ``timeout=0``
+        polls without blocking (the dispatcher has in-flight work to
+        retire and must not park). Returns None on timeout or
+        drain-complete; ``last_flush_reason`` says why the batch was
+        released (full/watermark/deadline/drain)."""
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        return self._next(deadline,
+                          ready_rows=self.refill_watermark if hungry
+                          else None,
+                          use_linger=not hungry)
+
+    @property
+    def last_flush_reason(self):
+        """Most recent flush reason — single-consumer convenience (tests,
+        REPL). Multi-worker consumers must read ``batch.flush_reason``,
+        which is stamped per batch and cannot race."""
+        return self._last_flush_reason

@@ -47,6 +47,12 @@ class MetricsRegistry(_BaseRegistry):
         return _tel.span(name, category=category)
 
     # ---------------------------------------------------------- derived
+    def _sum_counters(self, name):
+        """Sum a counter across its label values (requests_shed carries
+        a ``reason`` label; the flat contract wants the total)."""
+        return sum(m.value for m in self.series()
+                   if isinstance(m, Counter) and m.name == name)
+
     def _derived(self):
         reqs = self.counter("requests_completed").value
         uptime = self.uptime
@@ -60,6 +66,9 @@ class MetricsRegistry(_BaseRegistry):
         probes = hits + misses
         out["executor_cache_hit_rate"] = \
             round(hits / probes, 4) if probes else 0.0
+        received = self.counter("requests_received").value
+        shed = self._sum_counters("requests_shed")
+        out["shed_rate"] = round(shed / received, 4) if received else 0.0
         return out
 
     def extra_series(self):
@@ -78,11 +87,17 @@ class MetricsRegistry(_BaseRegistry):
     def to_dict(self):
         """JSON-ready snapshot (the ``/v1/metrics`` contract): raw series
         flat, histograms as ``*_ms``-keyed percentile dicts, derived
-        rates computed here so the raw metrics stay single-writer."""
+        rates computed here so the raw metrics stay single-writer.
+        Labeled series key as ``name{k=v}`` (base-registry convention —
+        two ``requests_shed`` reasons must not clobber one key)."""
         out = {"uptime_sec": round(self.uptime, 3)}
         for m in self.series():
+            key = m.name
+            if m.labels:
+                key += "{%s}" % ",".join(
+                    "%s=%s" % kv for kv in sorted(m.labels.items()))
             if isinstance(m, Histogram):
-                out[m.name] = {
+                out[key] = {
                     "count": m.count,
                     "mean_ms": round(m.mean, 3),
                     "p50_ms": round(m.percentile(50), 3),
@@ -90,6 +105,6 @@ class MetricsRegistry(_BaseRegistry):
                     "p99_ms": round(m.percentile(99), 3),
                 }
             else:
-                out[m.name] = m.value
+                out[key] = m.value
         out.update(self._derived())
         return out

@@ -1,24 +1,46 @@
 """Serving front-ends: in-process ``ServingSession`` + stdlib HTTP server.
 
-``ServingSession`` is the composition root: a ``DynamicBatcher`` feeding
-an ``ExecutorPool`` through one dispatcher thread per replica, with a
-``MetricsRegistry`` observing every stage. The HTTP layer is a thin JSON
-veneer (stdlib ``ThreadingHTTPServer`` — zero new dependencies) over the
-same session:
+``ServingSession`` is the composition root: a batcher feeding an
+``ExecutorPool`` through one dispatcher thread per replica, with a
+``MetricsRegistry`` observing every stage. Two dispatch modes:
 
-    POST /v1/predict   {"inputs": {"data": [[...]]}}   -> {"outputs": [...]}
-    GET  /v1/metrics   serving metrics JSON
-    GET  /healthz      liveness (200 while accepting)
+* ``continuous`` (default) — the dispatcher keeps up to K device
+  batches in flight per replica and REFILLS a freed slot from the
+  queue at the refill watermark (``ContinuousBatcher``): the dispatch
+  of batch N+1 overlaps the device execution of batch N and the
+  device→host materialization of batch N-1, so the device never idles
+  between bursts. Signal-driven admission control
+  (``serving.admission``) sheds with 429 before the queue-wait blows
+  the latency budget or the device wedges. Versioned hot-swap
+  (``swap_model``) pre-warms the incoming model in the process-wide
+  warm cache, then flips the pool pointer atomically — in-flight
+  batches on the old version drain to completion, zero requests fail.
+* ``burst`` — the PR-1 loop (dispatch, block, respond, repeat), kept as
+  the benchmark baseline and for single-tenant batch jobs where
+  device idle between bursts is irrelevant.
 
-Backpressure contract: a full request queue answers 429 (shed, don't
-collapse), a per-request timeout answers 504, and shutdown drains — the
-queue closes, in-flight batches finish, THEN workers exit.
+The HTTP layer is a thin JSON veneer (stdlib ``ThreadingHTTPServer`` —
+zero new dependencies) over the same session:
+
+    POST /v1/predict     {"inputs": {"data": [[...]]}}  -> {"outputs": [...]}
+    GET  /v1/metrics     serving metrics JSON
+    GET  /v1/version     active model version / generation / symbol hash
+    POST /v1/admin/swap  {"symbol_file", "params_file", "version_tag"}
+    GET  /healthz        liveness (200 while accepting)
+
+Overload taxonomy: **429** = shed (admission policy or full queue —
+back off and retry), **504** = the request out-waited its own deadline
+in the queue, **503** = the session is draining (shutdown) — the only
+window a healthy deploy ever serves it; a hot-swap flip is atomic and
+serves no errors at all. Shutdown drains: the queue closes, in-flight
+batches finish and answer, THEN workers exit.
 """
 from __future__ import annotations
 
 import json
 import threading
 import time
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as _np
@@ -26,17 +48,32 @@ import numpy as _np
 from .. import diagnostics as _diag
 from .. import telemetry as _tel
 from ..base import MXNetError, NativeError, NumericsError
-from .batcher import BatcherClosed, DynamicBatcher, QueueFull
+from .admission import (ACCEPTING, AdmissionShed, AdmissionSignals,
+                        SignalAdmissionPolicy, STATE_NAMES, derive_knobs)
+from .batcher import (BatcherClosed, ContinuousBatcher, DynamicBatcher,
+                      QueueFull)
 from .metrics import MetricsRegistry
-from .pool import ExecutorPool
+from .pool import ExecutorPool, warm_cache
 
 __all__ = ["ServingSession", "ServingHTTPServer", "serve"]
 
 DEFAULT_BUCKETS = (1, 8, 32, 128)
 
 
+class _InFlight:
+    """One dispatched-but-unretired batch in a worker's slot window."""
+
+    __slots__ = ("batch", "handles", "rep", "t_dispatch")
+
+    def __init__(self, batch, handles, rep, t_dispatch):
+        self.batch = batch
+        self.handles = handles
+        self.rep = rep
+        self.t_dispatch = t_dispatch
+
+
 class ServingSession:
-    """Dynamic-batching inference service over one model.
+    """Batching inference service over one (hot-swappable) model.
 
     Parameters
     ----------
@@ -49,12 +86,35 @@ class ServingSession:
     max_queue : bounded queue depth; beyond it ``predict`` raises QueueFull
     contexts : device contexts (default: one replica per local device)
     warmup : compile all (replica, bucket) programs before accepting
+    mode : "continuous" (K-in-flight refilled dispatch, default) or
+        "burst" (the PR-1 blocking loop)
+    max_in_flight : device batches each dispatcher keeps in flight
+        (continuous mode; default ``MXTPU_SERVING_INFLIGHT`` or 2)
+    refill_watermark : pending rows that trigger an immediate refill of
+        a freed slot; "auto" derives it from the warmup-measured
+        per-bucket cost rows (``admission.derive_knobs``)
+    admission : an ``AdmissionPolicy``, None (bounded queue only), or
+        "auto" — SignalAdmissionPolicy in continuous mode, None in burst
+    version_tag : names this weight set in the process-wide warm cache
+        (hot-swap versions MUST use distinct tags)
+    mem_budget_bytes : device-memory budget for the admission headroom
+        signal (default ``MXTPU_SERVING_MEM_BUDGET``; unset = signal off)
+    queue_wait_budget_ms : admission latency budget (default: half the
+        ``default_timeout`` if set, else 1000ms)
     """
 
     def __init__(self, symbol_json, params, example_shapes,
                  buckets=DEFAULT_BUCKETS, max_delay_ms=5.0, max_queue=256,
                  contexts=None, cache_size=8, warmup=True,
-                 default_timeout=None):
+                 default_timeout=None, mode="continuous", max_in_flight=None,
+                 refill_watermark="auto", admission="auto",
+                 version_tag="v0", mem_budget_bytes=None,
+                 queue_wait_budget_ms=None):
+        import os
+        if mode not in ("continuous", "burst"):
+            raise MXNetError("serving mode must be 'continuous' or "
+                             "'burst', got %r" % (mode,))
+        self.mode = mode
         self.metrics = MetricsRegistry()
         # materialize the engine singleton so its telemetry series exist
         # before the first /metrics scrape (they read zero until traffic)
@@ -64,22 +124,30 @@ class ServingSession:
         _diag.on_session_start()
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self.default_timeout = default_timeout
+        self.max_in_flight = int(
+            max_in_flight if max_in_flight is not None
+            else os.environ.get("MXTPU_SERVING_INFLIGHT", "2"))
+        self.version_tag = version_tag
+        self._generation = 0
+        self._swap_seq = 0  # monotonic default-tag allocator (swap_model)
+        self._mem_budget = mem_budget_bytes if mem_budget_bytes is not None \
+            else float(os.environ.get("MXTPU_SERVING_MEM_BUDGET", "0")) or None
         # the per-replica executor LRU must hold every bucket or warmup
         # thrashes and evicted buckets re-compile mid-traffic
-        cache_size = max(cache_size, len(self.buckets))
-        self.pool = ExecutorPool(symbol_json, params, example_shapes,
-                                 contexts=contexts, cache_size=cache_size,
-                                 metrics=self.metrics)
-        self.batcher = DynamicBatcher(
-            list(example_shapes), buckets=self.buckets,
-            max_delay_ms=max_delay_ms, max_queue=max_queue,
-            metrics=self.metrics, example_shapes=example_shapes)
-        self.metrics.gauge("queue_depth", fn=lambda: self.batcher.depth)
-        self.metrics.gauge("replicas", fn=lambda: len(self.pool))
+        self._cache_size = max(cache_size, len(self.buckets))
+        self._pool = ExecutorPool(symbol_json, params, example_shapes,
+                                  contexts=contexts,
+                                  cache_size=self._cache_size,
+                                  metrics=self.metrics,
+                                  version_tag=version_tag)
+        # resolved device list: a hot-swapped pool must recreate replicas
+        # on exactly these devices (worker threads are pinned by index)
+        self._contexts = [r.ctx for r in self._pool.replicas]
         # executor-layer seam: count every traced-program construction by
         # THIS session's executors (each costs an XLA compile on first
-        # dispatch); after warmup this counter must stay flat under
-        # traffic at warmed buckets. The listener holds the pool weakly
+        # dispatch); installed BEFORE warmup so the deploy compiles are
+        # attributed, after which the counter must stay flat under
+        # traffic at warmed buckets. The listener holds the pools weakly
         # and closes over the counter — never the session — so an
         # un-close()d session is not pinned by the global seam, and
         # builds from unrelated executors (another session, a training
@@ -87,32 +155,318 @@ class ServingSession:
         import weakref
         from .. import executor as _executor
         _builds = self.metrics.counter("program_builds")
-        _pool = weakref.ref(self.pool)
+        self._pool_ref = [weakref.ref(self._pool)]
 
-        def _on_build(kind, ex, _c=_builds, _p=_pool):
-            p = _p()
-            if p is not None and p.owns_executor(ex):
-                _c.inc()
+        def _on_build(kind, ex, _c=_builds, _refs=self._pool_ref):
+            for r in _refs:
+                p = r()
+                if p is not None and p.owns_executor(ex):
+                    _c.inc()
+                    return
 
         self._build_listener = _executor.add_build_listener(_on_build)
         if warmup:
             with self.metrics.span("warmup"):
-                self.pool.warmup(self.buckets)
+                self._pool.warmup(self.buckets)
+        # knob derivation from the measured cost rows (ISSUE: knobs come
+        # from the registry, not hand-picking): refill watermark + the
+        # admission policy's service-time prior both read bucket_costs
+        knobs = derive_knobs(self._pool.bucket_costs(), self.buckets)
+        if refill_watermark == "auto":
+            refill_watermark = knobs["refill_watermark"]
+        if mode == "continuous":
+            self.batcher = ContinuousBatcher(
+                list(example_shapes), buckets=self.buckets,
+                max_delay_ms=max_delay_ms, max_queue=max_queue,
+                metrics=self.metrics, example_shapes=example_shapes,
+                refill_watermark=refill_watermark)
+        else:
+            self.batcher = DynamicBatcher(
+                list(example_shapes), buckets=self.buckets,
+                max_delay_ms=max_delay_ms, max_queue=max_queue,
+                metrics=self.metrics, example_shapes=example_shapes)
+        if queue_wait_budget_ms is None:
+            queue_wait_budget_ms = 500.0 * default_timeout \
+                if default_timeout else 1000.0
+        if admission == "auto":
+            admission = SignalAdmissionPolicy(
+                queue_wait_budget_ms=queue_wait_budget_ms) \
+                if mode == "continuous" else None
+        if admission is not None and not hasattr(admission, "decide"):
+            raise MXNetError("admission must be an AdmissionPolicy "
+                             "(got %r)" % (admission,))
+        self._admission = admission
+        self._admission_state = ACCEPTING
+        self._sheds_by_reason = {}
+        self._last_shed_reason = None
+        self._swap_lock = threading.Lock()
+        self._inflight_n = [0] * len(self._pool.replicas)
+        self._last_retire_t = [None] * len(self._pool.replicas)
+        self.metrics.gauge("queue_depth", fn=lambda: self.batcher.depth)
+        self.metrics.gauge("replicas", fn=lambda: len(self._pool))
+        self.metrics.gauge("inflight_depth",
+                           fn=lambda: sum(self._inflight_n))
+        self.metrics.gauge("admission_state",
+                           fn=lambda: self._admission_state)
         self._closed = False
+        loop = self._continuous_loop if mode == "continuous" \
+            else self._burst_loop
         self._workers = [
-            threading.Thread(target=self._dispatch_loop,
-                             args=(rep,), daemon=True,
+            threading.Thread(target=loop, args=(i,), daemon=True,
                              name="mxtpu-serving-%d" % i)
-            for i, rep in enumerate(self.pool.replicas)
+            for i in range(len(self._pool.replicas))
         ]
         for w in self._workers:
             w.start()
 
+    # ------------------------------------------------------------- pool
+    @property
+    def pool(self):
+        """The ACTIVE pool (hot-swap flips this pointer atomically)."""
+        return self._pool
+
+    # ---------------------------------------------------------- hot-swap
+    def swap_model(self, symbol_json, params, version_tag=None,
+                   warmup=True):
+        """Zero-downtime model rollout: build + pre-warm the incoming
+        version while the old one serves, then flip atomically.
+
+        The new pool compiles every (replica, bucket) executable through
+        the process-wide warm cache BEFORE the flip (a rollback to a
+        tag the cache still holds adopts instantly — zero compiles).
+        The flip itself is one pointer swap under ``_swap_lock``:
+        batches dispatched before it complete on the old version,
+        batches formed after it run the new one; no request ever fails
+        and no 503 is served. The old pool drains naturally as its
+        in-flight batches retire. Distinct weights MUST get distinct
+        ``version_tag``s (default: ``v<generation+1>``)."""
+        if self._closed:
+            raise BatcherClosed("serving session is closed")
+        if version_tag is None:
+            # allocated under the swap lock: two concurrent default-tag
+            # swaps must not register different weights under one tag
+            # (the warm cache's distinct-weights/distinct-tags contract)
+            with self._swap_lock:
+                self._swap_seq += 1
+                version_tag = "v%d" % self._swap_seq
+        new_pool = ExecutorPool(symbol_json, params, self.example_shapes,
+                                contexts=self._contexts,
+                                cache_size=self._cache_size,
+                                metrics=self.metrics,
+                                version_tag=version_tag)
+        if len(new_pool) != len(self._pool):
+            raise MXNetError(
+                "swap_model: replica count changed (%d -> %d); workers "
+                "are pinned per replica" % (len(self._pool), len(new_pool)))
+        if warmup:
+            with self.metrics.span("swap_warmup"):
+                new_pool.warmup(self.buckets)
+        import weakref
+        with self._swap_lock:
+            old_pool = self._pool
+            self._pool = new_pool
+            self._generation += 1
+            self.version_tag = version_tag
+            # the build listener must keep attributing the OLD pool's
+            # tail (in-flight retires) AND the new pool's programs
+            self._pool_ref.insert(0, weakref.ref(new_pool))
+            del self._pool_ref[2:]
+        self.metrics.counter("model_swaps").inc()
+        del old_pool  # drains via worker in-flight refs, then GC
+        return self.version_info()
+
+    def version_info(self):
+        return {"version": self.version_tag,
+                "generation": self._generation,
+                "symbol_hash": self._pool.symbol_hash,
+                "mode": self.mode,
+                "swaps": int(self.metrics.counter("model_swaps").value)}
+
+    @property
+    def example_shapes(self):
+        return self._pool.example_shapes
+
+    # --------------------------------------------------------- admission
+    def _est_batch_ms(self):
+        """Per-batch service-time estimate: the live ``batch_service_ms``
+        distribution once traffic has produced one, the warmup-measured
+        cost-registry rows before that (deploy-time prior). Service time
+        is the MARGINAL retire-to-retire cost, not ``batch_exec_ms``
+        (dispatch→retire): with K batches in flight the latter runs ~K×
+        the true per-batch cost — budgeting with it would shed at a
+        fraction of the configured latency budget."""
+        h = self.metrics.histogram("batch_service_ms")
+        if h.count >= 8:
+            return h.mean
+        costs = self._pool.bucket_costs()
+        if costs:
+            return max(c.get("exec_ms", 0.0) for c in costs.values()) or 1.0
+        return 1.0
+
+    def _signals(self):
+        """Point-in-time :class:`AdmissionSignals` — lock-free reads of
+        structures the hot path already maintains."""
+        est = self._est_batch_ms()
+        pending = self.batcher.pending_rows
+        largest = self.buckets[-1]
+        inflight = sum(self._inflight_n)
+        n_rep = max(1, len(self._pool.replicas))
+        batches_ahead = (pending + largest - 1) // largest + inflight
+        age = _diag.progress_age_s()
+        for w in _diag.active_waits():
+            # a device wait (serving collect, fit pacing) older than the
+            # watchdog's engine progress is the sharper wedge signal
+            age = max(age, w["age_s"])
+        mem = None
+        if self._mem_budget:
+            mem = max(0.0, 1.0 - _diag.ledger().live_bytes()
+                      / self._mem_budget)
+        return AdmissionSignals(
+            queue_depth=self.batcher.depth,
+            queue_limit=self.batcher.max_queue,
+            pending_rows=pending,
+            inflight_depth=inflight,
+            inflight_limit=self.max_in_flight * n_rep,
+            replicas=n_rep,
+            est_batch_ms=est,
+            est_queue_wait_ms=est * batches_ahead / n_rep,
+            watchdog_age_s=age,
+            mem_headroom_frac=mem)
+
+    def _admit(self):
+        pol = self._admission
+        if pol is None:
+            return
+        decision = pol.decide(self._signals())
+        self._admission_state = decision.state
+        if not decision.admit:
+            reason_key = decision.reason.split(":")[0]
+            self.metrics.counter("requests_shed",
+                                 labels={"reason": reason_key}).inc()
+            self._sheds_by_reason[reason_key] = \
+                self._sheds_by_reason.get(reason_key, 0) + 1
+            self._last_shed_reason = decision.reason
+            raise AdmissionShed("admission control: %s" % decision.reason)
+
+    def admission_snapshot(self):
+        """The ``/debug/state`` admission block: current state, shed
+        tallies by reason, and the live signal values."""
+        return {"state": STATE_NAMES.get(self._admission_state,
+                                         self._admission_state),
+                "policy": type(self._admission).__name__
+                if self._admission is not None else None,
+                "sheds_by_reason": dict(self._sheds_by_reason),
+                "last_shed_reason": self._last_shed_reason,
+                "signals": self._signals().to_dict()}
+
     # ------------------------------------------------------------ workers
-    def _dispatch_loop(self, replica):
-        """One per replica: pull a batch, run it, answer its requests.
-        Keeping the replica pinned to its loop gives lock-free device
-        dispatch; the batcher is the only shared structure."""
+    def _fail_batch(self, batch, exc):
+        """Answer a batch's requests with ``exc``; never kill the worker.
+        Backend failures (XLA error, OOM, nonzero native return) capture
+        a postmortem; usage errors and sanitizer trips (which dump their
+        own, source=sanitizer) stay quiet."""
+        batch.fail(exc)
+        self.metrics.counter("requests_failed").inc(len(batch.items))
+        if not isinstance(exc, MXNetError) or isinstance(exc, NativeError):
+            _diag.postmortem("serving_batch_exception", exc=exc,
+                             source="serving")
+
+    def _retire(self, inf, idx):
+        """Materialize one in-flight batch's outputs (the single bulk
+        device→host transfer) and answer its requests."""
+        batch = inf.batch
+        try:
+            outs = inf.rep.collect(inf.handles)
+            batch.finish(outs)
+            now = time.monotonic()
+            self.metrics.counter("requests_completed").inc(len(batch.items))
+            self.metrics.histogram("batch_exec_ms").observe(
+                (now - inf.t_dispatch) * 1e3)
+            # marginal service time: since the PREVIOUS retire if this
+            # batch overlapped it on device, since its own dispatch
+            # otherwise — the admission estimate's rate basis (the raw
+            # dispatch→retire span above includes pipeline wait)
+            prev = self._last_retire_t[idx]
+            base = prev if prev is not None and prev > inf.t_dispatch \
+                else inf.t_dispatch
+            self.metrics.histogram("batch_service_ms").observe(
+                (now - base) * 1e3)
+            self._last_retire_t[idx] = now
+            for it in batch.items:
+                self.metrics.histogram("request_latency_ms").observe(
+                    (now - it.t_enqueue) * 1e3)
+        except Exception as exc:
+            self._fail_batch(batch, exc)
+
+    def _continuous_loop(self, idx):
+        """One per replica slot-window: keep up to K batches in flight,
+        refill a freed slot from the queue within one dispatch cycle.
+        The only blocking host sync is the retire of the OLDEST batch —
+        by then the device is already executing the newer ones, so
+        device idle between bursts collapses to the refill latency."""
+        inflight = deque()
+        k = max(1, self.max_in_flight)
+        t_slot_free = None    # a retire freed a slot at this time
+        t_device_idle = None  # nothing in flight since this time
+        while True:
+            if len(inflight) >= k:
+                self._retire(inflight.popleft(), idx)
+                self._inflight_n[idx] = len(inflight)
+                t_slot_free = time.monotonic()
+                if not inflight:
+                    t_device_idle = t_slot_free
+                continue
+            # with work in flight, poll the queue (timeout=0): sitting
+            # in a wait would delay the retire of completed batches
+            batch = self.batcher.next_fill(
+                timeout=0.0 if inflight else 0.25, hungry=True)
+            if batch is None:
+                if inflight:
+                    self._retire(inflight.popleft(), idx)
+                    self._inflight_n[idx] = len(inflight)
+                    t_slot_free = time.monotonic()
+                    if not inflight:
+                        t_device_idle = t_slot_free
+                    continue
+                if self.batcher._closed and self.batcher.depth == 0:
+                    return
+                continue
+            now = time.monotonic()
+            if t_slot_free is not None:
+                self.metrics.histogram("refill_latency_ms").observe(
+                    (now - t_slot_free) * 1e3)
+                t_slot_free = None
+            if t_device_idle is not None:
+                self.metrics.histogram("dispatch_idle_gap_ms").observe(
+                    (now - t_device_idle) * 1e3)
+                t_device_idle = None
+            if batch.flush_reason == "watermark":
+                self.metrics.counter("batches_refilled").inc()
+            pool = self._pool  # volatile read: hot-swap flips this
+            rep = pool.replicas[idx % len(pool.replicas)]
+            try:
+                # parent the batch span on the first request's submitting
+                # span: the trace id crosses the queue hop, so a request
+                # trace shows submit -> batch -> pool.dispatch -> executor
+                with _tel.span("batch[%d]" % batch.bucket,
+                               category="serving",
+                               parent=batch.items[0].span,
+                               tags={"n_valid": batch.n_valid}):
+                    with self.metrics.span("pool.dispatch"):
+                        handles = rep.dispatch(batch.inputs)
+            except Exception as exc:
+                self._fail_batch(batch, exc)
+                continue
+            inflight.append(_InFlight(batch, handles, rep, now))
+            self._inflight_n[idx] = len(inflight)
+
+    def _burst_loop(self, idx):
+        """The PR-1 loop: pull a batch, run it to completion, answer its
+        requests. The device idles from the end of each batch until the
+        next dispatch (response slicing + queue wait) — the gap the
+        continuous mode exists to close; ``dispatch_idle_gap_ms`` makes
+        that cost visible in both modes."""
+        t_idle = None
         while True:
             batch = self.batcher.next_batch(timeout=0.25)
             if batch is None:
@@ -120,44 +474,45 @@ class ServingSession:
                     return
                 continue
             t0 = time.monotonic()
+            if t_idle is not None:
+                self.metrics.histogram("dispatch_idle_gap_ms").observe(
+                    (t0 - t_idle) * 1e3)
+            pool = self._pool
+            replica = pool.replicas[idx % len(pool.replicas)]
             try:
-                # parent the batch span on the first request's submitting
-                # span: the trace id crosses the queue hop, so a request
-                # trace shows submit -> batch -> pool.run -> executor
                 with _tel.span("batch[%d]" % batch.bucket,
                                category="serving",
                                parent=batch.items[0].span,
                                tags={"n_valid": batch.n_valid}):
-                    outs = self.pool.run(batch.inputs, replica=replica)
+                    outs = pool.run(batch.inputs, replica=replica)
                 batch.finish(outs)
                 self.metrics.counter("requests_completed").inc(
                     len(batch.items))
+                done = time.monotonic()
                 self.metrics.histogram("batch_exec_ms").observe(
-                    (time.monotonic() - t0) * 1e3)
+                    (done - t0) * 1e3)
+                # burst runs one batch at a time: the marginal service
+                # time IS the dispatch→answer span
+                self.metrics.histogram("batch_service_ms").observe(
+                    (done - t0) * 1e3)
                 for it in batch.items:
                     self.metrics.histogram("request_latency_ms").observe(
-                        (time.monotonic() - it.t_enqueue) * 1e3)
+                        (done - it.t_enqueue) * 1e3)
             except Exception as exc:  # answer, don't kill the worker
-                batch.fail(exc)
-                self.metrics.counter("requests_failed").inc(
-                    len(batch.items))
-                if not isinstance(exc, MXNetError) \
-                        or isinstance(exc, NativeError):
-                    # backend failure (XLA error, OOM, nonzero native
-                    # return), not a bad request: capture the state that
-                    # produced it
-                    _diag.postmortem("serving_batch_exception", exc=exc,
-                                     source="serving")
+                self._fail_batch(batch, exc)
+            t_idle = time.monotonic()
 
     # ------------------------------------------------------------ client
     def predict(self, inputs, timeout=None):
         """Synchronous single-request inference: dict of arrays (leading
         dim = #examples, usually 1) -> list of numpy outputs. Raises
-        QueueFull under backpressure, TimeoutError past ``timeout``."""
+        AdmissionShed/QueueFull under backpressure (HTTP 429),
+        TimeoutError past ``timeout`` (504)."""
         if self._closed:
             raise BatcherClosed("serving session is closed")
         timeout = timeout if timeout is not None else self.default_timeout
         self.metrics.counter("requests_received").inc()
+        self._admit()
         with self.metrics.span("serving.request"):
             item = self.batcher.submit(inputs, timeout=timeout)
             return item.wait(timeout)
@@ -167,6 +522,7 @@ class ServingSession:
         if self._closed:
             raise BatcherClosed("serving session is closed")
         self.metrics.counter("requests_received").inc()
+        self._admit()
         return self.batcher.submit(inputs, timeout=timeout)
 
     def stats(self):
@@ -177,8 +533,9 @@ class ServingSession:
         return self._closed
 
     def close(self, drain=True):
-        """Graceful shutdown: refuse new work, flush the queue, join the
-        dispatchers. With ``drain=False`` pending requests are failed."""
+        """Graceful shutdown: refuse new work, flush the queue, retire
+        every in-flight batch, join the dispatchers. With
+        ``drain=False`` pending requests are failed instead."""
         if self._closed:
             return
         self._closed = True
@@ -199,7 +556,7 @@ class ServingSession:
 
 # ---------------------------------------------------------------- HTTP
 class _Handler(BaseHTTPRequestHandler):
-    server_version = "mxtpu-serving/1.0"
+    server_version = "mxtpu-serving/2.0"
 
     def _json(self, code, payload):
         self._text(code, json.dumps(payload), "application/json")
@@ -224,7 +581,13 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._json(200, {"status": "ok",
                                  "replicas": len(session.pool),
-                                 "buckets": list(session.buckets)})
+                                 "buckets": list(session.buckets),
+                                 "mode": session.mode,
+                                 "version": session.version_tag,
+                                 "admission": STATE_NAMES.get(
+                                     session._admission_state, "?")})
+        elif path == "/v1/version":
+            self._json(200, session.version_info())
         elif path == "/v1/metrics":
             # legacy flat-JSON contract: this session's serving stats
             self._json(200, session.stats())
@@ -241,15 +604,22 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/debug/state":
             # live debug snapshot: buffer ledger, program cost table,
             # flight-recorder ring, engine state, active device waits —
-            # what a postmortem dumps, served on demand
+            # what a postmortem dumps, served on demand; plus the serving
+            # panels mxtpu_top renders (admission, version, warm cache)
             state = _diag.debug_state()
             state["serving"] = session.stats()
+            state["serving_admission"] = session.admission_snapshot()
+            state["serving_version"] = session.version_info()
+            state["serving_warm_cache"] = warm_cache().manifest()
             self._json(200, state)
         else:
             self._json(404, {"error": "unknown path %s" % self.path})
 
     def do_POST(self):
         session = self.server.session
+        if self.path in ("/v1/admin/swap",):
+            self._do_swap(session)
+            return
         if self.path not in ("/v1/predict", "/predict"):
             self._json(404, {"error": "unknown path %s" % self.path})
             return
@@ -273,6 +643,10 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             outs = session.predict(inputs, timeout=timeout)
             self._json(200, {"outputs": [o.tolist() for o in outs]})
+        except AdmissionShed as exc:
+            # policy shed: same backpressure status as a full queue, but
+            # the body names the signal so clients/dashboards can tell
+            self._json(429, {"error": str(exc), "shed": True})
         except QueueFull as exc:
             self._json(429, {"error": str(exc)})
         except TimeoutError as exc:
@@ -291,6 +665,52 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(500, {"error": "%s: %s"
                              % (type(exc).__name__, exc)})
 
+    def _do_swap(self, session):
+        """POST /v1/admin/swap {"symbol_file", "params_file",
+        "version_tag"?}: hot-swap from checkpoint files on the server's
+        filesystem (the rollout surface; in-process callers use
+        ``session.swap_model`` directly).
+
+        Control-plane gating: predict is the open data plane, but a
+        model mutation that opens server-side file paths must not be —
+        the endpoint answers 403 unless the server was given an admin
+        token (``admin_token=`` / ``MXTPU_SERVING_ADMIN_TOKEN``) and the
+        request carries it in ``X-Admin-Token``."""
+        import hmac
+        from .. import ndarray as _nd
+        token = self.server.admin_token
+        if not token:
+            self._json(403, {"error": "admin API disabled: pass "
+                             "admin_token= to ServingHTTPServer or set "
+                             "MXTPU_SERVING_ADMIN_TOKEN"})
+            return
+        sent = self.headers.get("X-Admin-Token", "")
+        if not hmac.compare_digest(sent, token):
+            self._json(403, {"error": "admin token mismatch"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            symbol_file = payload["symbol_file"]
+            params_file = payload["params_file"]
+            tag = payload.get("version_tag")
+            with open(symbol_file) as f:
+                symbol_json = f.read()
+            params = _nd.load(params_file)
+        except (KeyError, ValueError, TypeError, OSError) as exc:
+            self._json(400, {"error": "swap request: %s" % exc})
+            return
+        try:
+            info = session.swap_model(symbol_json, params, version_tag=tag)
+            self._json(200, info)
+        except BatcherClosed as exc:
+            self._json(503, {"error": str(exc)})
+        except MXNetError as exc:
+            self._json(400, {"error": str(exc)})
+        except Exception as exc:
+            self._json(500, {"error": "%s: %s"
+                             % (type(exc).__name__, exc)})
+
 
 class ServingHTTPServer(ThreadingHTTPServer):
     """ThreadingHTTPServer bound to a ServingSession. ``shutdown`` drains
@@ -299,10 +719,14 @@ class ServingHTTPServer(ThreadingHTTPServer):
     daemon_threads = True
 
     def __init__(self, session, host="127.0.0.1", port=0,
-                 request_timeout=30.0):
+                 request_timeout=30.0, admin_token=None):
+        import os
         super().__init__((host, port), _Handler)
         self.session = session
         self.request_timeout = request_timeout
+        # gates POST /v1/admin/swap; None (and no env) disables it
+        self.admin_token = admin_token if admin_token is not None \
+            else os.environ.get("MXTPU_SERVING_ADMIN_TOKEN") or None
 
     @property
     def endpoint(self):

@@ -9,8 +9,6 @@ integer-summed metrics (accuracy) must match exactly; float partial sums
 elementwise math runs in XLA instead of numpy, so loss parity is asserted
 to float32 tolerance.
 """
-import time
-
 import numpy as np
 import pytest
 
@@ -97,48 +95,43 @@ def test_device_metric_accum_matches_host_metrics():
     assert M.DeviceMetricAccum.wrap(M.create(["acc", M.F1()])) is None
 
 
-def _phase_percentile(hist, before, after, p):
-    """Percentile over only the observations between two snapshots —
-    keeps the test from resetting the process-wide registry (which would
-    orphan the import-time standing engine/executor series)."""
-    n = after[0] - before[0]
-    assert n > 0
-    deltas = [a - b for a, b in zip(after[4], before[4])]
-    counts = [deltas[0]] + [deltas[i] - deltas[i - 1]
-                            for i in range(1, len(deltas))]
-    rank = (p / 100.0) * n
-    cum = 0
-    for i, c in enumerate(counts):
-        if c and cum + c >= rank:
-            lo = hist.bounds[i - 1] if i > 0 else 0.0
-            hi = hist.bounds[i]
-            return lo + (rank - cum) / c * (hi - lo) \
-                if hi != float("inf") else after[3]
-        cum += c
-    return after[3]
-
-
 def test_device_prefetch_hides_slow_producer():
     """A producer slower than free but faster than the step must be fully
-    hidden: io_prefetch_stall_ms p90 ~ 0 (only the cold first batch ever
-    waits)."""
-    h = tel.registry().histogram("io_prefetch_stall_ms")
-    before = h.snapshot()
+    hidden. Deterministic stall accounting: instead of sleeping wall-clock
+    and asserting an elapsed-time percentile (which fails under host
+    contention — the old flake), the consumer WAITS on the producer's
+    ``data_ready`` event before each ``next()``, making 'the step outlasts
+    the fetch' a scheduling invariant. Every arrival must then find its
+    batch already staged: ``io_prefetch_ready{state=hit}`` counts all
+    n+1 arrivals (+1: the end-of-data probe) and ``state=wait`` none."""
+    reg = tel.registry()
+    hit0 = reg.counter("io_prefetch_ready", labels={"state": "hit"}).value
+    wait0 = reg.counter("io_prefetch_ready", labels={"state": "wait"}).value
     X = np.random.RandomState(0).rand(96, 8).astype("f4")
     base = mx.io.NDArrayIter(X, np.zeros(96, "f4"), batch_size=4)
     it = mx.io.DevicePrefetchIter(
         mx.test_utils.FixedLatencyIter(base, 0.002))
     n = 0
-    for batch in it:
-        time.sleep(0.008)        # the "training step" the producer hides in
+    while True:
+        # the "training step": by construction it ends only after the
+        # producer staged the next batch — no timing assumption at all
+        for e in it.data_ready:
+            e.wait()
+        try:
+            it.next()
+        except StopIteration:
+            break
         n += 1
     it.close()
     assert n == 24
-    after = h.snapshot()
-    assert after[0] - before[0] == n + 1  # +1: the end-of-data probe waits
-    p90 = _phase_percentile(h, before, after, 90)
-    assert p90 < 2.0, \
-        "p90 stall %.3fms: prefetch failed to hide the producer" % p90
+    hits = reg.counter("io_prefetch_ready",
+                       labels={"state": "hit"}).value - hit0
+    waits = reg.counter("io_prefetch_ready",
+                        labels={"state": "wait"}).value - wait0
+    assert hits + waits == n + 1  # +1: the end-of-data probe
+    assert waits == 0, \
+        "%d consumer arrivals blocked on the producer: prefetch failed " \
+        "to hide the fetch latency" % waits
 
 
 def test_prefetching_iter_lifecycle():

@@ -1,20 +1,34 @@
 #!/usr/bin/env python
-"""Benchmark: dynamic-batching server vs the one-at-a-time Predictor.
+"""Benchmark v2: serving under OPEN-LOOP load — p99 at fixed offered rate.
 
-Drives N concurrent clients (default 32) through both deployment surfaces
-over the same request stream:
+v1 (PR 1, results in BENCH_serving.json) drove closed-loop clients and
+reported throughput; a closed loop lets an overloaded server pace its
+own clients, hiding exactly the failure mode production traffic
+exposes. v2 uses the Poisson open-loop generator
+(``tools/loadgen_serving.py``) and asks the two questions the ISSUE
+poses:
 
-  baseline  — the pre-serving surface: ONE Predictor, batch-1 forwards,
-              requests serialized through a lock (the single-request
-              C-predict-API deployment model)
-  serving   — ServingSession: dynamic batcher -> bucketed executor pool
+1. **fixed offered load** (a sweep at 0.5/0.85/1.3/2.0x the probed
+   sustainable rate): what p99 and within-SLO goodput does each stack
+   hold? Deterministic basis per the PR-2 noise-floor convention:
+   ``dispatch_idle_gap_ms`` (the device-idle gaps between dispatches —
+   the structural cost continuous batching removes) and the batch-fill
+   ratio are recorded alongside the (noisy on a shared CPU host)
+   wall-clock percentiles.
+2. **2x saturation** (the acceptance point): does the admission policy
+   shed with 429 while the watchdog stays silent and the queue stays
+   bounded — where the PR-1 configuration (burst, no admission,
+   effectively unbounded queue) lets the queue grow without limit and
+   every admitted request's latency diverge?
 
-Writes BENCH_serving.json with sustained throughput, p50/p99 latency,
-batch-fill ratio and executor-cache hit rate. Acceptance: serving >= 3x
-baseline throughput at 32 concurrent CPU clients.
+Writes BENCH_serving_v2.json. Acceptance (judged at the 2x point; the
+sub-saturation points assert parity — the CPU backend dispatches
+synchronously in the worker thread, PR-3's caveat, so wall-clock deltas
+there are noise): continuous p99 < burst p99, goodput strictly better,
+sheds > 0, watchdog detections == 0, queue peak <= 256 < burst's.
 
-Usage: python tools/bench_serving.py [--model lenet] [--clients 32]
-       [--requests 512] [--out BENCH_serving.json]
+Usage: python tools/bench_serving.py [--model resnet] [--duration 6]
+       [--out BENCH_serving_v2.json]
 """
 import argparse
 import json
@@ -29,147 +43,253 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+from mxtpu import telemetry as tel  # noqa: E402
 from mxtpu.models.serving_fixtures import get_fixture  # noqa: E402
-from mxtpu.predict import Predictor  # noqa: E402
 from mxtpu.serving import ServingSession  # noqa: E402
+from loadgen_serving import run_open_loop  # noqa: E402
+
+BUCKETS = (1, 4)
 
 
-def _percentile(samples, p):
-    s = sorted(samples)
-    return s[min(len(s) - 1, int(round(p / 100.0 * (len(s) - 1))))]
+def _payload_ring(ex_shape, n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"data": rng.rand(*ex_shape).astype(np.float32)}
+            for _ in range(n)]
 
 
-def _drive(n_clients, n_requests, ex_shape, make_request):
-    """n_clients threads issue n_requests total (payloads precomputed so
-    the timed region measures the serving stack, not request synthesis);
-    returns (wall_sec, latencies_ms)."""
-    per_client = max(1, n_requests // n_clients)
-    payloads = []
-    for i in range(n_clients):
-        rng = np.random.RandomState(i)
-        payloads.append([rng.rand(*ex_shape).astype(np.float32)
-                         for _ in range(per_client)])
-    all_lats = [None] * n_clients
+def _probe_saturation(sym_json, params, shapes, probe_s=2.5):
+    """The burst server's sustainable open-loop rate, found by ramping.
 
-    def worker(idx):
-        lats = []
-        for x in payloads[idx]:
-            t0 = time.time()
-            make_request(x)
-            lats.append((time.time() - t0) * 1e3)
-        all_lats[idx] = lats
+    Starts from the device-capacity estimate the PR-4 cost-registry rows
+    give (largest bucket / measured exec time — the deterministic lower
+    anchor; closed-loop probes under-estimate capacity because their
+    concurrency caps the batch size, the trap v1 fell into) and ramps
+    offered load until the server stops keeping up (completed < 90% of
+    offered, or the queue ends the probe deeper than it started). The
+    last sustained rate is what "saturation" means end-to-end: device
+    AND intake AND response path. Returns (rows/sec, cost rows)."""
+    sess = ServingSession(sym_json, params, shapes, buckets=BUCKETS,
+                          max_delay_ms=3, max_queue=100_000, mode="burst",
+                          admission=None, version_tag="probe")
+    costs = sess.pool.bucket_costs()
+    largest = max(costs)
+    device_est = len(sess.pool) * largest / (costs[largest]["exec_ms"] / 1e3)
+    ring = _payload_ring(tuple(shapes["data"]))
+    # a sustained rate keeps latency near the service floor; a rate the
+    # server cannot hold builds queue DURING the probe and p99 diverges
+    # (completion counts cannot judge this: the collector drains the
+    # backlog after the arrival schedule ends, so everything "completes")
+    p99_ok_ms = max(100.0, 30.0 * costs[largest]["exec_ms"])
+    rate = max(10.0, 0.3 * device_est)
+    sustained = rate
+    try:
+        while True:
+            res = run_open_loop(sess.predict_async,
+                                lambda i: ring[i % len(ring)],
+                                offered_rps=rate, duration_s=probe_s,
+                                timeout_s=30.0, seed=7)
+            # drain before the next probe so runs don't contaminate
+            deadline = time.monotonic() + 30
+            while sess.batcher.depth > 0 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            ok = res.completed >= 0.9 * res.sent \
+                and res.percentile(99) <= p99_ok_ms
+            if not ok or rate > 4 * device_est:
+                break
+            sustained = rate
+            rate *= 1.4
+    finally:
+        sess.close(drain=False)
+    return sustained, {str(b): c for b, c in costs.items()}
 
-    threads = [threading.Thread(target=worker, args=(i,))
-               for i in range(n_clients)]
-    t0 = time.time()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.time() - t0
-    lats = [l for ls in all_lats for l in ls]
-    return wall, lats, len(lats)  # actual issued count, not n_requests
+
+class _QueueWatch:
+    """Samples queue depth during a run: peak + final (the unbounded-
+    growth evidence for the overload phase)."""
+
+    def __init__(self, sess, interval=0.02):
+        self._sess = sess
+        self._interval = interval
+        self.peak = 0
+        self.final = 0
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while not self._stop.wait(self._interval):
+            d = self._sess.batcher.depth
+            self.peak = max(self.peak, d)
+        self.final = self._sess.batcher.depth
+
+    def __enter__(self):
+        self._t.start()
+        return self
+
+    def __exit__(self, *a):
+        self._stop.set()
+        self._t.join(timeout=5)
 
 
-def _median(xs):
-    s = sorted(xs)
-    return s[len(s) // 2]
+def _session_basis(sess, wall_s):
+    """The deterministic side of the verdict, read off the session's own
+    series: device-idle gaps between dispatches, fill, refill stats."""
+    s = sess.stats()
+    gaps = s.get("dispatch_idle_gap_ms", {"count": 0, "mean_ms": 0.0})
+    idle_ms = gaps["count"] * gaps["mean_ms"]
+    return {
+        "batch_fill_ratio": s["batch_fill_ratio"],
+        "batches_formed": s["batches_formed"],
+        "dispatch_idle_gaps": gaps["count"],
+        "dispatch_idle_gap_mean_ms": gaps["mean_ms"],
+        "device_idle_frac_est": round(min(1.0, idle_ms / (wall_s * 1e3)), 4)
+        if wall_s else 0.0,
+        "refill_latency_p50_ms":
+            s.get("refill_latency_ms", {}).get("p50_ms", None),
+        "batches_refilled": s.get("batches_refilled", 0),
+        "executor_cache_hit_rate": s["executor_cache_hit_rate"],
+    }
 
 
-def bench(model="lenet", n_clients=32, n_requests=512, max_delay_ms=5.0,
-          buckets=(1, 8, 32, 128), trials=3):
-    """Median-of-``trials`` throughput per side (thread scheduling and
-    lock-convoy luck make single closed-loop trials noisy)."""
+SLO_MS = 1000.0  # goodput = completions answered within this budget
+
+
+def _run_point(config, sym_json, params, shapes, ex_shape, rps, duration,
+               seed):
+    """One (config, offered-rate) point of the latency curve."""
+    mode, max_queue, admission = config
+    sess = ServingSession(sym_json, params, shapes, buckets=BUCKETS,
+                          max_delay_ms=3, max_queue=max_queue, mode=mode,
+                          admission=admission,
+                          version_tag="bench-%s-%d" % (mode, seed))
+    ring = _payload_ring(ex_shape)
+    wd0 = tel.registry().counter("watchdog_detections").value
+    with _QueueWatch(sess) as qw:
+        res = run_open_loop(sess.predict_async, lambda i: ring[i % 64],
+                            offered_rps=rps, duration_s=duration,
+                            timeout_s=30.0, seed=seed)
+    wd_fired = tel.registry().counter("watchdog_detections").value - wd0
+    out = res.to_dict()
+    goodput = sum(1 for latency in res.latencies_ms if latency <= SLO_MS)
+    out["goodput_rps"] = round(goodput / duration, 2)
+    out["basis"] = _session_basis(sess, duration)
+    out["queue_depth_peak"] = qw.peak
+    out["queue_depth_final"] = qw.final
+    out["watchdog_detections"] = int(wd_fired)
+    out["mode"] = mode
+    out["admission"] = type(sess._admission).__name__ \
+        if sess._admission is not None else None
+    sess.close(drain=False)
+    return out
+
+
+#: the two postures under comparison: (mode, max_queue, admission)
+PR1_CONFIG = ("burst", 1_000_000, None)   # PR-1: blocking loop, no shed
+V2_CONFIG = ("continuous", 256, "auto")   # this PR: K-in-flight + signals
+
+#: offered-load sweep as multiples of the probed sustainable rate
+SWEEP = (0.5, 0.85, 1.3, 2.0)
+
+
+def bench(model="resnet", duration=6.0, seed=42):
     sym_json, params, shapes = get_fixture(model)
     ex_shape = tuple(shapes["data"])
+    saturation, cost_rows = _probe_saturation(sym_json, params, shapes)
 
-    # ---------------- baseline: single-request predictor, serialized
-    base_pred = Predictor(sym_json, dict(params),
-                          input_shapes={"data": ex_shape})
-    base_pred.forward(data=np.zeros(ex_shape, np.float32))  # warm the jit
-    base_pred.get_output(0)
-    base_lock = threading.Lock()
+    curve = {}
+    for mult in SWEEP:
+        rps = max(10.0, mult * saturation)
+        key = "%.2fx" % mult
+        curve[key] = {
+            "offered_rps": round(rps, 2),
+            "pr1_burst": _run_point(PR1_CONFIG, sym_json, params, shapes,
+                                    ex_shape, rps, duration, seed),
+            "continuous_admission": _run_point(
+                V2_CONFIG, sym_json, params, shapes, ex_shape, rps,
+                duration, seed),
+        }
 
-    def base_request(x):
-        with base_lock:
-            base_pred.forward(data=x)
-            return base_pred.get_output(0)
-
-    base_walls, base_lats = [], []
-    for _ in range(trials):
-        wall, lats, issued = _drive(n_clients, n_requests, ex_shape,
-                                    base_request)
-        base_walls.append(wall)
-        base_lats.extend(lats)
-    base_wall = _median(base_walls)
-
-    # ---------------- serving: dynamic batching pipeline
-    sess = ServingSession(sym_json, params, shapes, buckets=buckets,
-                          max_delay_ms=max_delay_ms,
-                          max_queue=max(256, n_clients * 4))
-
-    def serve_request(x):
-        return sess.predict({"data": x}, timeout=120)
-
-    serve_walls, serve_lats = [], []
-    for _ in range(trials):
-        wall, lats, issued = _drive(n_clients, n_requests, ex_shape,
-                                    serve_request)
-        serve_walls.append(wall)
-        serve_lats.extend(lats)
-    serve_wall = _median(serve_walls)
-    stats = sess.stats()
-    sess.close()
-
-    result = {
-        "model": model,
-        "clients": n_clients,
-        "requests": issued,
-        "trials": trials,
-        "buckets": list(buckets),
-        "max_delay_ms": max_delay_ms,
-        "replicas": stats["replicas"],
-        "baseline": {
-            "throughput_rps": round(issued / base_wall, 2),
-            "p50_ms": round(_percentile(base_lats, 50), 3),
-            "p99_ms": round(_percentile(base_lats, 99), 3),
-        },
-        "serving": {
-            "throughput_rps": round(issued / serve_wall, 2),
-            "p50_ms": round(_percentile(serve_lats, 50), 3),
-            "p99_ms": round(_percentile(serve_lats, 99), 3),
-            "batch_fill_ratio": stats["batch_fill_ratio"],
-            "executor_cache_hit_rate": stats["executor_cache_hit_rate"],
-            "batches_formed": stats["batches_formed"],
-        },
+    sub = [curve["%.2fx" % m] for m in SWEEP if m < 1.0]
+    # acceptance is judged at the ISSUE's named overload point (2x
+    # saturation); the 1.3x point is recorded curve data only — the
+    # probed knee carries run-to-run host noise, so a point this close
+    # to it can land on either side for the PR-1 server and flap
+    deep = curve["%.2fx" % SWEEP[-1]]
+    dc, db = deep["continuous_admission"], deep["pr1_burst"]
+    acceptance = {
+        # below saturation both modes sit at the service-time floor; the
+        # CPU backend dispatches synchronously in the worker thread
+        # (PR-3's documented limitation), so wall-clock deltas there are
+        # noise — require parity, not a win
+        "sub_saturation_p99_parity": all(
+            p["continuous_admission"]["p99_ms"]
+            <= 2.0 * p["pr1_burst"]["p99_ms"] for p in sub),
+        "sub_saturation_no_shed": all(
+            p["continuous_admission"]["shed_429"] == 0 for p in sub),
+        "sub_saturation_throughput_parity": all(
+            p["continuous_admission"]["completed"]
+            >= 0.98 * p["pr1_burst"]["completed"] for p in sub),
+        # at 2x saturation the PR-1 queue grows without bound and every
+        # admitted request's latency diverges; the v2 stack must hold
+        # p99 AND deliver more within-SLO answers
+        "overload_p99_improves": dc["p99_ms"] < db["p99_ms"],
+        "overload_goodput_improves":
+            dc["goodput_rps"] > db["goodput_rps"],
+        "overload_sheds_429": dc["shed_429"] > 0,
+        "overload_watchdog_silent": dc["watchdog_detections"] == 0,
+        "overload_queue_bounded":
+            dc["queue_depth_peak"] <= 256 < db["queue_depth_peak"],
+        # deterministic basis at saturation (queue never empty, so every
+        # idle gap is dispatch discipline, not arrival starvation)
+        "idle_gap_basis_improves":
+            dc["basis"]["device_idle_frac_est"]
+            <= db["basis"]["device_idle_frac_est"],
     }
-    result["speedup"] = round(
-        result["serving"]["throughput_rps"]
-        / result["baseline"]["throughput_rps"], 2)
-    return result
+    return {
+        "model": model,
+        "buckets": list(BUCKETS),
+        "slo_ms": SLO_MS,
+        "saturation_probe_rps": round(saturation, 2),
+        "saturation_basis_cost_rows": cost_rows,
+        "curve": curve,
+        "acceptance": acceptance,
+        "pass": all(acceptance.values()),
+        "basis_note": (
+            "Headline: p99 + within-SLO goodput at FIXED offered load "
+            "across the sweep (multiples of the probed sustainable "
+            "rate). PR-2 noise-floor convention: wall-clock percentiles "
+            "on a shared 1-2 core CPU host carry scheduler noise, and "
+            "the CPU backend dispatches synchronously in the worker "
+            "thread (PR-3 caveat), so sub-saturation points assert "
+            "parity and the verdict rests on the saturated points plus "
+            "the deterministic basis recorded per run: "
+            "dispatch_idle_gap_ms (device-idle between dispatches, the "
+            "structural cost the continuous dispatcher removes), "
+            "batch_fill_ratio, queue-depth peak, shed/watchdog counts. "
+            "The arrival schedule is deterministic per seed (seeded "
+            "exponential gaps); pacing_slip_max_ms records host-induced "
+            "generator slip."),
+    }
 
 
 def main(argv=None):
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--model", default="lenet",
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--model", default="resnet",
                     help="serving fixture: mlp | lenet | resnet")
-    ap.add_argument("--clients", type=int, default=32)
-    ap.add_argument("--requests", type=int, default=512)
-    ap.add_argument("--max-delay-ms", type=float, default=5.0)
-    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--duration", type=float, default=6.0,
+                    help="seconds per (config, rate) sweep point")
+    ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--out", default=None,
                     help="write JSON here (default: print only)")
     args = ap.parse_args(argv)
-    result = bench(model=args.model, n_clients=args.clients,
-                   n_requests=args.requests, max_delay_ms=args.max_delay_ms,
-                   trials=args.trials)
+    result = bench(model=args.model, duration=args.duration,
+                   seed=args.seed)
     print(json.dumps(result, indent=2))
     if args.out:
         with open(args.out, "w") as f:
             json.dump(result, f, indent=2)
             f.write("\n")
         print("wrote %s" % args.out)
-    return 0 if result["speedup"] >= 3.0 else 1
+    return 0 if result["pass"] else 1
 
 
 if __name__ == "__main__":

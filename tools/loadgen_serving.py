@@ -1,0 +1,311 @@
+#!/usr/bin/env python
+"""Open-loop (Poisson-arrival) load generator for mxtpu.serving.
+
+Closed-loop clients (tools/bench_serving.py v1, and most naive load
+tests) wait for each response before sending the next request — the
+offered load adapts to the server, so an overloaded server just slows
+its clients down and the measured "throughput" looks fine while real
+users would be timing out. Open-loop load is what "millions of users"
+actually apply: arrivals come from the world on a schedule the server
+cannot slow down. This generator draws exponential inter-arrival gaps
+(a Poisson process) at a FIXED offered rate from a seeded RNG — the
+arrival schedule is deterministic per seed — fires each request at its
+scheduled time whether or not earlier ones completed, and reports the
+latency distribution of completions plus the shed/timeout taxonomy.
+
+The headline a serving stack should publish is "p99 latency at offered
+load X", not "throughput with N looping clients" — this tool exists so
+BENCH_serving_v2.json can say exactly that.
+
+Usage (HTTP):
+    python tools/loadgen_serving.py http://127.0.0.1:8080 \
+        --rps 200 --duration 10 --shape 1,784
+
+In-process (the bench imports ``run_open_loop`` and passes a
+``ServingSession.predict_async``-shaped callable).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["OpenLoopResult", "run_open_loop", "http_submit"]
+
+
+class OpenLoopResult:
+    """Outcome tally of one open-loop run."""
+
+    def __init__(self, offered_rps, duration_s, seed):
+        self.offered_rps = offered_rps
+        self.duration_s = duration_s
+        self.seed = seed
+        self.sent = 0
+        self.completed = 0
+        self.shed = 0          # 429: admission policy or full queue
+        self.timed_out = 0     # 504 / client-side deadline
+        self.errors = 0        # anything else
+        self.abandoned = 0     # still pending when collection gave up
+        self.latencies_ms = []
+        self.behind_ms_max = 0.0  # worst pacing slip of the generator
+
+    def percentile(self, p):
+        if not self.latencies_ms:
+            return 0.0
+        s = sorted(self.latencies_ms)
+        return s[min(len(s) - 1, int(round(p / 100.0 * (len(s) - 1))))]
+
+    def to_dict(self):
+        return {
+            "offered_rps": self.offered_rps,
+            "duration_s": self.duration_s,
+            "seed": self.seed,
+            "sent": self.sent,
+            "completed": self.completed,
+            "shed_429": self.shed,
+            "timed_out_504": self.timed_out,
+            "errors": self.errors,
+            "abandoned": self.abandoned,
+            "completed_rps": round(self.completed / self.duration_s, 2)
+            if self.duration_s else 0.0,
+            "shed_rate": round(self.shed / self.sent, 4) if self.sent else 0.0,
+            "p50_ms": round(self.percentile(50), 3),
+            "p90_ms": round(self.percentile(90), 3),
+            "p99_ms": round(self.percentile(99), 3),
+            "max_ms": round(self.percentile(100), 3),
+            "pacing_slip_max_ms": round(self.behind_ms_max, 3),
+        }
+
+
+def run_open_loop(submit, make_payload, offered_rps, duration_s,
+                  timeout_s=30.0, seed=0, classify=None, waiters=16):
+    """Drive ``submit(payload)`` at ``offered_rps`` with Poisson arrivals.
+
+    ``submit`` must be non-blocking-ish and return a future-like object
+    with ``.wait(timeout)`` (``ServingSession.predict_async``), OR raise
+    immediately (admission shed / queue full). ``make_payload(i)``
+    supplies the i-th request body (pre-generate anything expensive).
+    ``classify(exc) -> "shed"|"timeout"|"error"`` maps exceptions; the
+    default understands mxtpu.serving's taxonomy. A pool of ``waiters``
+    threads collects completions so a slow response never stalls the
+    arrival schedule. Returns :class:`OpenLoopResult`.
+    """
+    if classify is None:
+        def classify(exc):
+            name = type(exc).__name__
+            if name in ("AdmissionShed", "QueueFull"):
+                return "shed"
+            if isinstance(exc, TimeoutError):
+                return "timeout"
+            return "error"
+
+    res = OpenLoopResult(offered_rps, duration_s, seed)
+    lock = threading.Lock()
+    pending = []                 # (future, t_submit)
+    pending_cv = threading.Condition(lock)
+    done_sending = [False]
+    finalized = [False]          # set under `lock`: res is being returned
+
+    def waiter():
+        while True:
+            with pending_cv:
+                if finalized[0]:
+                    return
+                while not pending and not done_sending[0]:
+                    pending_cv.wait(0.1)
+                if not pending:
+                    if done_sending[0]:
+                        return
+                    continue
+                fut, t0 = pending.pop(0)
+            try:
+                fut.wait(timeout_s)
+                lat = (time.monotonic() - t0) * 1e3
+                with lock:
+                    if finalized[0]:
+                        return
+                    res.completed += 1
+                    res.latencies_ms.append(lat)
+            except Exception as exc:
+                kind = classify(exc)
+                with lock:
+                    if finalized[0]:
+                        return
+                    if kind == "timeout":
+                        res.timed_out += 1
+                    elif kind == "shed":
+                        res.shed += 1
+                    else:
+                        res.errors += 1
+
+    threads = [threading.Thread(target=waiter, daemon=True,
+                                name="loadgen-waiter-%d" % i)
+               for i in range(waiters)]
+    for t in threads:
+        t.start()
+
+    rng = np.random.RandomState(seed)
+    t_start = time.monotonic()
+    t_next = t_start
+    i = 0
+    while True:
+        now = time.monotonic()
+        if now - t_start >= duration_s:
+            break
+        if now < t_next:
+            time.sleep(min(t_next - now, 0.05))
+            continue
+        # the generator itself slipping behind schedule would silently
+        # turn open-loop into closed-loop — record the worst slip so the
+        # bench can reject a run where the HOST, not the server, paced
+        res.behind_ms_max = max(res.behind_ms_max, (now - t_next) * 1e3)
+        payload = make_payload(i)
+        res.sent += 1
+        t0 = time.monotonic()
+        try:
+            fut = submit(payload)
+        except Exception as exc:
+            kind = classify(exc)
+            with lock:
+                if kind == "shed":
+                    res.shed += 1
+                elif kind == "timeout":
+                    res.timed_out += 1
+                else:
+                    res.errors += 1
+        else:
+            with pending_cv:
+                pending.append((fut, t0))
+                pending_cv.notify()
+        i += 1
+        t_next += float(rng.exponential(1.0 / offered_rps))
+    done_sending[0] = True
+    with pending_cv:
+        pending_cv.notify_all()
+    for t in threads:
+        t.join(timeout=timeout_s + 5)
+    # a backlog deeper than the waiters can drain within the bounded
+    # join leaves threads alive — freeze the result so stragglers can't
+    # mutate it after return (sorting a list being appended to is a
+    # crash), and account the remainder honestly as `abandoned`
+    with pending_cv:
+        finalized[0] = True
+        res.abandoned = len(pending) + sum(1 for t in threads
+                                           if t.is_alive())
+        pending_cv.notify_all()
+    return res
+
+
+class _HTTPResult:
+    """Future-like handle for one pooled HTTP request."""
+
+    __slots__ = ("_result", "_exc", "_done")
+
+    def __init__(self):
+        self._result = None
+        self._exc = None
+        self._done = threading.Event()
+
+    def wait(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("no response in %.1fs" % (timeout or 0))
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+class _HTTPClientPool:
+    """A persistent worker pool issuing the HTTP requests — maps
+    429/504 back onto the in-process taxonomy. One thread per request
+    would let an overloaded run accumulate thousands of live threads
+    (and pay a thread spawn on the pacing thread itself); instead
+    ``concurrency`` workers drain an unbounded submit queue, so the
+    generator never blocks and true in-flight HTTP concurrency is
+    capped. Size ``concurrency`` above offered_rps x expected latency
+    or the client-side queue, not the server, will pace the run."""
+
+    def __init__(self, endpoint, timeout_s=30.0, concurrency=64):
+        import queue
+        self._endpoint = endpoint
+        self._timeout = timeout_s
+        self._q = queue.Queue()
+        self._threads = [threading.Thread(target=self._worker, daemon=True,
+                                          name="loadgen-http-%d" % i)
+                         for i in range(concurrency)]
+        for t in self._threads:
+            t.start()
+
+    def _worker(self):
+        import urllib.error
+        import urllib.request
+        while True:
+            payload, fut = self._q.get()
+            req = urllib.request.Request(
+                self._endpoint + "/v1/predict",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=self._timeout) as r:
+                    fut._result = json.loads(r.read())
+            except urllib.error.HTTPError as exc:
+                if exc.code == 429:
+                    from mxtpu.serving import AdmissionShed
+                    fut._exc = AdmissionShed(str(exc))
+                elif exc.code == 504:
+                    fut._exc = TimeoutError(str(exc))
+                else:
+                    fut._exc = exc
+            except Exception as exc:
+                fut._exc = exc
+            finally:
+                fut._done.set()
+
+    def submit(self, payload):
+        fut = _HTTPResult()
+        self._q.put((payload, fut))
+        return fut
+
+
+def http_submit(endpoint, timeout_s=30.0, concurrency=64):
+    """A ``submit`` callable for :func:`run_open_loop` over HTTP."""
+    return _HTTPClientPool(endpoint, timeout_s=timeout_s,
+                           concurrency=concurrency).submit
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("endpoint", help="http://host:port of a serving server")
+    ap.add_argument("--rps", type=float, default=100.0,
+                    help="offered load (Poisson arrival rate)")
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--shape", default="1,784",
+                    help="request shape, comma-separated (leading dim = "
+                         "examples per request)")
+    ap.add_argument("--input", default="data", help="model input name")
+    ap.add_argument("--timeout", type=float, default=30.0)
+    ap.add_argument("--concurrency", type=int, default=64,
+                    help="HTTP client-pool size (cap on in-flight "
+                         "requests; size above rps x expected latency)")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    shape = tuple(int(x) for x in args.shape.split(","))
+    rng = np.random.RandomState(args.seed)
+    # pre-generate a payload ring: synthesis must not pace the generator
+    ring = [{"inputs": {args.input:
+                        rng.rand(*shape).astype(np.float32).tolist()}}
+            for _ in range(64)]
+    res = run_open_loop(http_submit(args.endpoint, args.timeout,
+                                    concurrency=args.concurrency),
+                        lambda i: ring[i % len(ring)],
+                        offered_rps=args.rps, duration_s=args.duration,
+                        timeout_s=args.timeout, seed=args.seed)
+    print(json.dumps(res.to_dict(), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

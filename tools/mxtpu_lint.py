@@ -67,6 +67,9 @@ HOT_PATHS = {
     "mxtpu/serving/pool.py": None,
     "mxtpu/serving/server.py": None,
     "mxtpu/serving/metrics.py": None,
+    # admission runs on EVERY request's submit path: a host sync in a
+    # signal read would serialize the whole intake behind the device
+    "mxtpu/serving/admission.py": None,
     "mxtpu/predict.py": None,
     "mxtpu/metric.py": {"DeviceKernel", "DeviceMetricAccum"},
     "mxtpu/io.py": {"PrefetchingIter", "DevicePrefetchIter"},
@@ -109,7 +112,15 @@ _NP_DTYPE_POS = {"zeros": 2, "ones": 2, "empty": 2, "full": 3,
 #: locks. Keep this table in sync with docs/analysis.md.
 LOCK_LEVELS = [
     ("batcher", {("DynamicBatcher", "_lock"),
-                 ("DynamicBatcher", "_not_empty")}),
+                 ("DynamicBatcher", "_not_empty"),
+                 ("ContinuousBatcher", "_lock"),
+                 ("ContinuousBatcher", "_not_empty")}),
+    # continuous-serving control plane (PR 10): the hot-swap flip and
+    # the warm-cache map. Held only for pointer/dict ops — never while
+    # dispatching, so they sit between the batcher and the replica
+    # dispatch locks.
+    ("serving-swap", {("ServingSession", "_swap_lock"),
+                      ("WarmExecutableCache", "_lock")}),
     ("pool", {("ExecutorPool", "_rr_lock"), ("ExecutorPool", "_owned_lock"),
               ("_Replica", "lock")}),
     ("slot-state", {("FusedState", "_mem_lock")}),

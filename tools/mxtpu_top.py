@@ -106,6 +106,33 @@ def render(metrics, state, width=100):
            _scalar(proc, "fit_step_ms")))
     lines.append(bar)
 
+    # ---- serving admission panel (continuous batching, PR 10)
+    adm = state.get("serving_admission") or {}
+    if serving or adm:
+        shed_rate = serving.get("shed_rate", [({}, 0)])[0][1] \
+            if serving else 0
+        fill = serving.get("batch_fill_ratio", [({}, 0)])[0][1] \
+            if serving else 0
+        inflight = serving.get("inflight_depth", [({}, 0)])[0][1] \
+            if serving else 0
+        sheds = adm.get("sheds_by_reason") or {}
+        sig = adm.get("signals") or {}
+        ver = state.get("serving_version") or {}
+        lines.append(
+            "admission: %-9s shed_rate %.4f%s | fill %.3f | "
+            "in-flight %s | est wait %.1fms"
+            % (adm.get("state", "?"), shed_rate,
+               (" (%s)" % ",".join("%s=%d" % kv
+                                   for kv in sorted(sheds.items())))
+               if sheds else "",
+               fill, inflight, sig.get("est_queue_wait_ms", 0.0)))
+        lines.append(
+            "model: %s gen %s hash %s | swaps %d | warm versions %d"
+            % (ver.get("version", "?"), ver.get("generation", "?"),
+               ver.get("symbol_hash", "?"), ver.get("swaps", 0),
+               len(state.get("serving_warm_cache") or [])))
+        lines.append(bar)
+
     # ---- memory table
     lines.append("%-12s %-16s %12s" % ("ctx", "origin", "live"))
     mem_rows = sorted(proc.get("mem_live_bytes", []),
