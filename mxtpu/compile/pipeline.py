@@ -35,7 +35,8 @@ __all__ = ["set_output_sanitizer", "add_build_listener",
            "remove_build_listener", "program_build_count", "notify_build",
            "record_program_build", "instrument_program",
            "prewarm_scope", "in_prewarm", "prewarm_build_count",
-           "configure", "configured", "pipeline_scope",
+           "configure", "configured", "refresh_from_knobs",
+           "pipeline_scope",
            "transform_graph", "PipelineReport"]
 
 _log = _logging.getLogger("mxtpu.compile")
@@ -326,7 +327,16 @@ def instrument_program(kind, fn, owner=None, matmul_env=False,
 
 # ---------------------------------------------------------- pipeline config
 def _parse_env():
-    raw = _os.environ.get("MXTPU_PIPELINE", "").strip()
+    # precision/transform mode is a declared knob (mxtpu.tune): a set
+    # MXTPU_PIPELINE env always wins — including set-but-empty, which
+    # means "explicitly off" and must override a TunedConfig artifact —
+    # otherwise the active artifact's `compile.pipeline` value applies,
+    # and the default stays the empty pipeline (zero behavior change)
+    raw = _os.environ.get("MXTPU_PIPELINE")
+    if raw is None:
+        from ..tune import registry as _knobs
+        raw = _knobs.resolve("compile.pipeline") or ""
+    raw = raw.strip()
     if raw.lower() in ("", "0", "none", "off", "false"):
         return ()
     return tuple(p.strip() for p in raw.split(",") if p.strip())
@@ -334,6 +344,9 @@ def _parse_env():
 
 _CONFIGURED = _parse_env()
 _CONFIG_LOCK = _threading.Lock()
+# True once configure(names) pinned an explicit pass list — an artifact
+# installed later (refresh_from_knobs) must not clobber it
+_CONFIG_EXPLICIT = False
 
 
 def configured():
@@ -344,14 +357,28 @@ def configured():
 
 def configure(names=None):
     """Set the process-wide pipeline. ``None`` re-reads
-    ``MXTPU_PIPELINE``; a sequence of registered transform names
-    activates them in order; ``()`` empties the pipeline. Affects
-    programs built AFTER the call — already-built executables keep the
-    graph they compiled."""
-    global _CONFIGURED
+    ``MXTPU_PIPELINE`` (and the active TunedConfig artifact's
+    ``compile.pipeline`` knob); a sequence of registered transform
+    names activates them in order; ``()`` empties the pipeline.
+    Affects programs built AFTER the call — already-built executables
+    keep the graph they compiled."""
+    global _CONFIGURED, _CONFIG_EXPLICIT
     with _CONFIG_LOCK:
         _CONFIGURED = _parse_env() if names is None \
             else tuple(str(n) for n in names)
+        _CONFIG_EXPLICIT = names is not None
+    return _CONFIGURED
+
+
+def refresh_from_knobs():
+    """Re-resolve the pipeline from env + artifact. The module snapshots
+    its config at import; :func:`mxtpu.tune.use` calls this so an
+    artifact installed AFTER import still applies its
+    ``compile.pipeline`` value — unless an explicit ``configure(names)``
+    pinned the pipeline, which (like an explicit argument everywhere
+    else in the knob precedence) always wins."""
+    if not _CONFIG_EXPLICIT:
+        configure(None)
     return _CONFIGURED
 
 
@@ -362,12 +389,16 @@ def pipeline_scope(names):
         with mxtpu.compile.pipeline_scope(["bf16"]):
             mod.fit(...)
     """
-    prev = _CONFIGURED
+    global _CONFIGURED, _CONFIG_EXPLICIT
+    prev, prev_explicit = _CONFIGURED, _CONFIG_EXPLICIT
     configure(names)
     try:
         yield
     finally:
-        configure(prev)
+        # restore VALUE AND PROVENANCE: a scope over an env/artifact-
+        # derived config must leave it refreshable, not pinned
+        with _CONFIG_LOCK:
+            _CONFIGURED, _CONFIG_EXPLICIT = prev, prev_explicit
 
 
 # ------------------------------------------------------------ transform gate

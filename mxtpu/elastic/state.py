@@ -44,21 +44,25 @@ class ElasticConfig:
     * ``sync``          — block until each snapshot is durable (tests /
       tiny models; default False = fully async);
     * ``supervisor``    — a :class:`~mxtpu.elastic.Supervisor` to poll
-      for wedge/preemption interrupts between steps.
+      for wedge/preemption interrupts between steps;
+    * ``tuned``         — a :class:`~mxtpu.tune.TunedConfig` (or path)
+      the cadence knobs pull their defaults from, with the usual
+      ``default < artifact < env < explicit argument`` precedence
+      (``None`` = the process-active artifact, ``False`` = ignore it).
     """
 
     def __init__(self, prefix, every_n_steps=None, epoch_period=None,
-                 keep=None, sync=False, supervisor=None):
-        env = os.environ.get
+                 keep=None, sync=False, supervisor=None, tuned=None):
+        from .. import tune as _tune
+        tuned = _tune.artifact(tuned)
         self.prefix = str(prefix)
-        self.every_n_steps = int(
-            every_n_steps if every_n_steps is not None
-            else env("MXTPU_ELASTIC_EVERY_STEPS", "0"))
-        self.epoch_period = int(
-            epoch_period if epoch_period is not None
-            else env("MXTPU_ELASTIC_EPOCH_PERIOD", "1"))
-        self.keep = int(keep if keep is not None
-                        else env("MXTPU_ELASTIC_KEEP", "2"))
+        self.every_n_steps = _tune.resolve_int(
+            "elastic.every_n_steps", explicit=every_n_steps,
+            artifact=tuned)
+        self.epoch_period = _tune.resolve_int(
+            "elastic.epoch_period", explicit=epoch_period, artifact=tuned)
+        self.keep = _tune.resolve_int("elastic.keep", explicit=keep,
+                                      artifact=tuned)
         self.sync = bool(sync)
         self.supervisor = supervisor
 

@@ -5,7 +5,6 @@ forward_backward :189, init_params :593, init_optimizer :958)."""
 from __future__ import annotations
 
 import logging
-import os
 import time
 from collections import deque
 
@@ -137,7 +136,7 @@ class BaseModule:
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, max_in_flight=None, metric_sync=None,
             device_metrics=None, device_prefetch=None, mesh=None,
-            elastic=None, resume=None):
+            elastic=None, resume=None, tuned=None):
         """Training loop (parity base_module.py:376-525), pipelined.
 
         ``mesh`` — SPMD mesh execution (docs/sharding.md): train
@@ -185,20 +184,48 @@ class BaseModule:
           run: step/epoch cursors, RNG streams, optimizer state (f32
           masters under ``MXTPU_PIPELINE=bf16``), metric accumulators
           and the data-iterator position are all restored.
+
+        Autotuning (docs/tune.md):
+
+        * ``tuned`` — a :class:`~mxtpu.tune.TunedConfig` artifact (or a
+          path) the pipeline knobs above pull their defaults from, with
+          precedence ``default < artifact < env < explicit argument``;
+          ``None`` defers to the process-active artifact
+          (:func:`mxtpu.tune.use` / ``MXTPU_TUNED``), ``False`` ignores
+          it. A stale artifact (knob-registry mismatch) is rejected.
         """
         from ..initializer import Uniform
+        from .. import tune as _tune
         assert num_epoch is not None, "please specify number of epochs"
         initializer = initializer or Uniform(0.01)
 
-        if max_in_flight is None:
-            max_in_flight = int(os.environ.get("MXTPU_FIT_INFLIGHT", "2"))
-        max_in_flight = max(1, int(max_in_flight))
-        if device_metrics is None:
-            device_metrics = os.environ.get(
-                "MXTPU_FIT_DEVICE_METRICS", "1") != "0"
-        if device_prefetch is None:
-            device_prefetch = os.environ.get(
-                "MXTPU_FIT_DEVICE_PREFETCH", "0") != "0"
+        # one resolution point for every pipeline knob (the hand-picked
+        # constants moved into the registry catalog; resolution order is
+        # default < artifact < env < this call's explicit arguments)
+        tuned = _tune.artifact(tuned)
+        max_in_flight = _tune.resolve_int(
+            "fit.max_in_flight", explicit=max_in_flight, artifact=tuned,
+            floor=1)
+        # metric_sync is special: an explicit arg or env wins outright,
+        # but an ARTIFACT cadence cannot simply preempt the auto-derive
+        # — the search could not see this fit's callbacks, and every
+        # Speedometer window boundary must stay a sync batch. The
+        # artifact value rides along as a preference the derivation
+        # reconciles (gcd) with the callback contract below.
+        metric_sync = _tune.resolve(
+            "fit.metric_sync", explicit=metric_sync, artifact=False)
+        tuned_metric_sync = _tune.resolve("fit.metric_sync",
+                                          artifact=tuned) \
+            if metric_sync is None else None
+        device_metrics = _tune.resolve(
+            "fit.device_metrics", explicit=device_metrics, artifact=tuned)
+        device_prefetch = _tune.resolve(
+            "fit.device_prefetch", explicit=device_prefetch,
+            artifact=tuned)
+        self._fit_knobs = {"fit.max_in_flight": max_in_flight,
+                           "fit.metric_sync": metric_sync,
+                           "fit.device_metrics": device_metrics,
+                           "fit.device_prefetch": device_prefetch}
 
         owned_iter = None
         if device_prefetch:
@@ -247,7 +274,7 @@ class BaseModule:
                     arg_params, aux_params, allow_missing, force_rebind,
                     force_init, begin_epoch, num_epoch, validation_metric,
                     monitor, max_in_flight, metric_sync, device_metrics,
-                    el_cfg, resume_state)
+                    el_cfg, resume_state, tuned_metric_sync)
         except Exception as exc:
             # fatal training exception: capture the flight ring / ledger /
             # engine state BEFORE the stack unwinds and the evidence GCs.
@@ -270,7 +297,7 @@ class BaseModule:
                   aux_params, allow_missing, force_rebind, force_init,
                   begin_epoch, num_epoch, validation_metric, monitor,
                   max_in_flight, metric_sync, device_metrics,
-                  el_cfg=None, resume_state=None):
+                  el_cfg=None, resume_state=None, tuned_metric_sync=None):
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)
@@ -329,9 +356,26 @@ class BaseModule:
                 from math import gcd
                 from functools import reduce
                 metric_sync = reduce(gcd, freqs)
+                if tuned_metric_sync:
+                    # the artifact's searched cadence, reconciled: gcd
+                    # keeps every meter boundary a sync batch (never
+                    # sparser than the callbacks allow)
+                    metric_sync = gcd(metric_sync,
+                                      int(tuned_metric_sync))
+            elif tuned_metric_sync is not None:
+                metric_sync = int(tuned_metric_sync)  # no callbacks to
+                # protect: the searched cadence applies as-is
             else:
                 metric_sync = 0   # no batch callbacks: epoch-end only
         metric_sync = max(0, int(metric_sync))
+        if hasattr(self, "_fit_knobs"):
+            self._fit_knobs["fit.metric_sync"] = metric_sync
+        # the live in-flight window: the online-refinement controller
+        # (mxtpu.tune.online) may nudge it within the certified safe
+        # range while the fit runs — the loop reads the holder per step
+        from ..tune import online as _online
+        inflight_limit = _online.attach_fit(
+            {"v": max(1, int(max_in_flight))})
 
         # one pipeline for training and serving: fit emits into the same
         # process-wide registry the serving /metrics endpoint scrapes
@@ -432,7 +476,8 @@ class BaseModule:
                             # than K steps are outstanding, and only on the
                             # oldest — the device never idles waiting for the
                             # host between steps
-                            while len(inflight) > max_in_flight:
+                            while len(inflight) > \
+                                    max(1, int(inflight_limit["v"])):
                                 w = _device_wait(inflight.popleft())
                                 sync_wait_ms.observe(w)
                                 pacing += w
@@ -518,6 +563,7 @@ class BaseModule:
             # post-fit reads (and the next fit) must see live values,
             # not this run's last cadence snapshot
             eval_metric._device_accum = None
+            _online.release(inflight_limit)
 
 
     def check(self, passes=None, pipeline=None):

@@ -339,15 +339,23 @@ class FusedTrainStep:
         #   all/1  — whole-forward jax.checkpoint (the memory-mirroring
         #            analogue, MXNET_BACKWARD_DO_MIRROR)
         import os
-        self._remat = os.environ.get("MXTPU_REMAT", "none").lower()
+        from ..tune import registry as _knobs
+        # a SET MXTPU_REMAT always wins — including set-but-empty,
+        # which keeps its historical "explicitly off" meaning and must
+        # override a TunedConfig artifact (same special case as
+        # MXTPU_PIPELINE in compile.pipeline._parse_env)
+        raw = os.environ.get("MXTPU_REMAT")
+        if raw is None:
+            raw = _knobs.resolve("fit.remat")
+        self._remat = str(raw or "none").lower()
         if self._remat in ("0", "none", "", "false"):
             self._remat = "none"
         elif self._remat in ("1", "all", "true"):
             self._remat = "all"
         elif self._remat not in ("block", "conv"):
             raise ValueError(
-                "MXTPU_REMAT=%r not recognized (use none/block/conv/all)"
-                % os.environ["MXTPU_REMAT"])
+                "fit.remat / MXTPU_REMAT = %r not recognized (use "
+                "none/block/conv/all)" % self._remat)
         tags = None
         if self._remat in ("block", "conv"):
             from ..executor import _block_boundaries

@@ -32,6 +32,7 @@ from ..base import MXNetError
 
 __all__ = ["AdmissionShed", "AdmissionSignals", "Decision",
            "AdmissionPolicy", "SignalAdmissionPolicy", "derive_knobs",
+           "mix_service_model",
            "ACCEPTING", "DEGRADED", "SHEDDING", "STATE_NAMES"]
 
 #: admission_state gauge values (exported, dashboard-stable)
@@ -164,6 +165,53 @@ class SignalAdmissionPolicy(AdmissionPolicy):
                             % (s.est_queue_wait_ms,
                                100.0 * self.degrade_frac))
         return Decision(True, ACCEPTING, "ok")
+
+
+def mix_service_model(live_rows, bucket_costs, buckets, min_count=8):
+    """Learn the live per-bucket service mix for the queue-wait estimate.
+
+    The original estimate assumed every queued batch would be shaped
+    like the LARGEST bucket (rows ÷ largest bucket, priced at the
+    largest bucket's cost row). Under a small-bucket-heavy mix that
+    model is wrong twice at once: the queue actually drains in MORE,
+    CHEAPER batches — and because the per-batch price was the largest
+    bucket's, the estimate over-stated the wait and admission
+    over-shed (ROADMAP item 1's named acceptance).
+
+    ``live_rows`` maps bucket -> ``(count, mean_service_ms)`` read off
+    the per-bucket ``batch_service_ms{bucket=...}`` histograms the
+    dispatcher stamps at retire time. With at least ``min_count`` total
+    observations, the estimate is the MIX-WEIGHTED expectation: a
+    batch ahead of you costs the traffic-weighted mean service time and
+    carries the traffic-weighted mean row count. Before live traffic
+    the warmup cost-registry rows price the largest bucket (the
+    deploy-time prior — conservative by design: shedding a breath early
+    on a cold server beats admitting into an unknown).
+
+    Returns ``{"est_batch_ms", "est_rows_per_batch", "basis"}`` with
+    ``basis`` one of ``live-mix`` / ``cost-rows`` / ``default``.
+    """
+    buckets = tuple(sorted(set(int(b) for b in buckets))) or (1,)
+    rows = {int(b): (int(n), float(m))
+            for b, (n, m) in (live_rows or {}).items()
+            if n > 0 and m > 0}
+    total = sum(n for n, _ in rows.values())
+    if total >= min_count:
+        est_ms = sum(n * m for n, m in rows.values()) / total
+        est_rows = sum(b * n for b, (n, _) in rows.items()) / total
+        return {"est_batch_ms": est_ms,
+                "est_rows_per_batch": max(1.0, est_rows),
+                "basis": "live-mix"}
+    costs = {int(b): c for b, c in (bucket_costs or {}).items()
+             if c and c.get("exec_ms", 0) > 0}
+    if costs:
+        largest = max(costs)
+        return {"est_batch_ms": float(costs[largest]["exec_ms"]),
+                "est_rows_per_batch": float(buckets[-1]),
+                "basis": "cost-rows"}
+    return {"est_batch_ms": 1.0,
+            "est_rows_per_batch": float(buckets[-1]),
+            "basis": "default"}
 
 
 def derive_knobs(bucket_costs, buckets, marginal_tolerance=1.25):

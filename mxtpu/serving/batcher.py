@@ -378,8 +378,12 @@ class ContinuousBatcher(DynamicBatcher):
     The watermark is the fill-vs-latency knob: raise it toward the
     largest bucket when per-row cost dominates (big models — prefer
     full batches), drop it toward 1 when dispatch overhead dominates
-    (the device should never starve). ``serving.admission.derive_knobs``
-    picks it from the measured per-bucket cost registry rows.
+    (the device should never starve). It is a declared tunable
+    (``serving.refill_watermark``, docs/tune.md): a ``TunedConfig``
+    artifact or env can pin it, ``serving.admission.derive_knobs``
+    picks it from the measured per-bucket cost registry rows otherwise,
+    and the online controller may nudge the live value within its
+    certified safe range (``next_fill`` re-reads it per call).
     """
 
     def __init__(self, input_names, refill_watermark=None, **kwargs):

@@ -23,7 +23,6 @@ PR-4 cost-registry row (flops/bytes) to it; the admission policy and
 from __future__ import annotations
 
 import hashlib
-import os
 import threading
 import time
 from collections import OrderedDict
@@ -94,9 +93,25 @@ class WarmExecutableCache:
     def __init__(self, max_versions=None):
         self._lock = threading.Lock()
         self._versions = OrderedDict()  # (hash, tag) -> entry dict
-        self.max_versions = int(
-            max_versions if max_versions is not None
-            else os.environ.get("MXTPU_SERVING_WARM_VERSIONS", "4"))
+        self._max_versions = int(max_versions) \
+            if max_versions is not None else None
+
+    @property
+    def max_versions(self):
+        """The retention cap. Resolved LIVE through the knob registry
+        when not pinned at construction: the singleton cache is built at
+        import, and a TunedConfig installed later (``mx.tune.use``)
+        must still apply its ``serving.warm_versions`` — eviction is a
+        deploy-time path, so the per-register resolve costs nothing
+        that matters."""
+        if self._max_versions is not None:
+            return self._max_versions
+        from ..tune import registry as _knobs
+        return _knobs.resolve_int("serving.warm_versions")
+
+    @max_versions.setter
+    def max_versions(self, v):
+        self._max_versions = int(v)
 
     def adopt(self, sym_hash, tag, ctx, token):
         """The cached predictor for (model, version, ctx), or None.

@@ -38,6 +38,7 @@ batches finish and answer, THEN workers exit.
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
 from collections import deque
@@ -49,7 +50,8 @@ from .. import diagnostics as _diag
 from .. import telemetry as _tel
 from ..base import MXNetError, NativeError, NumericsError
 from .admission import (ACCEPTING, AdmissionShed, AdmissionSignals,
-                        SignalAdmissionPolicy, STATE_NAMES, derive_knobs)
+                        SignalAdmissionPolicy, STATE_NAMES, derive_knobs,
+                        mix_service_model)
 from .batcher import (BatcherClosed, ContinuousBatcher, DynamicBatcher,
                       QueueFull)
 from .metrics import MetricsRegistry
@@ -101,16 +103,21 @@ class ServingSession:
         signal (default ``MXTPU_SERVING_MEM_BUDGET``; unset = signal off)
     queue_wait_budget_ms : admission latency budget (default: half the
         ``default_timeout`` if set, else 1000ms)
+    tuned : a :class:`~mxtpu.tune.TunedConfig` artifact (or path) the
+        serving knobs above pull their defaults from, with precedence
+        ``default < artifact < env < explicit argument``; ``None``
+        defers to the process-active artifact (``mxtpu.tune.use`` /
+        ``MXTPU_TUNED``), ``False`` ignores it
     """
 
     def __init__(self, symbol_json, params, example_shapes,
-                 buckets=DEFAULT_BUCKETS, max_delay_ms=5.0, max_queue=256,
+                 buckets=DEFAULT_BUCKETS, max_delay_ms=None, max_queue=None,
                  contexts=None, cache_size=8, warmup=True,
                  default_timeout=None, mode="continuous", max_in_flight=None,
                  refill_watermark="auto", admission="auto",
                  version_tag="v0", mem_budget_bytes=None,
-                 queue_wait_budget_ms=None):
-        import os
+                 queue_wait_budget_ms=None, tuned=None):
+        from .. import tune as _tune
         if mode not in ("continuous", "burst"):
             raise MXNetError("serving mode must be 'continuous' or "
                              "'burst', got %r" % (mode,))
@@ -124,14 +131,24 @@ class ServingSession:
         _diag.on_session_start()
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self.default_timeout = default_timeout
-        self.max_in_flight = int(
-            max_in_flight if max_in_flight is not None
-            else os.environ.get("MXTPU_SERVING_INFLIGHT", "2"))
+        # every hand-picked constant resolves through the knob registry
+        # (docs/tune.md): default < TunedConfig artifact < env < the
+        # explicit constructor arguments above
+        tuned = _tune.artifact(tuned)
+        self._tuned = tuned
+        self.max_in_flight = _tune.resolve_int(
+            "serving.max_in_flight", explicit=max_in_flight,
+            artifact=tuned, floor=1)
+        max_queue = _tune.resolve_int("serving.max_queue",
+                                      explicit=max_queue, artifact=tuned)
+        max_delay_ms = _tune.resolve("serving.max_delay_ms",
+                                     explicit=max_delay_ms, artifact=tuned)
         self.version_tag = version_tag
         self._generation = 0
         self._swap_seq = 0  # monotonic default-tag allocator (swap_model)
-        self._mem_budget = mem_budget_bytes if mem_budget_bytes is not None \
-            else float(os.environ.get("MXTPU_SERVING_MEM_BUDGET", "0")) or None
+        self._mem_budget = _tune.resolve(
+            "serving.mem_budget_bytes", explicit=mem_budget_bytes,
+            artifact=tuned) or None
         # the per-replica executor LRU must hold every bucket or warmup
         # thrashes and evicted buckets re-compile mid-traffic
         self._cache_size = max(cache_size, len(self.buckets))
@@ -173,7 +190,12 @@ class ServingSession:
         # admission policy's service-time prior both read bucket_costs
         knobs = derive_knobs(self._pool.bucket_costs(), self.buckets)
         if refill_watermark == "auto":
-            refill_watermark = knobs["refill_watermark"]
+            # artifact/env value wins; otherwise fall through to the
+            # cost-registry derivation (and its structural default)
+            refill_watermark = _tune.resolve("serving.refill_watermark",
+                                             artifact=tuned)
+            if refill_watermark is None:
+                refill_watermark = knobs["refill_watermark"]
         if mode == "continuous":
             self.batcher = ContinuousBatcher(
                 list(example_shapes), buckets=self.buckets,
@@ -185,12 +207,23 @@ class ServingSession:
                 list(example_shapes), buckets=self.buckets,
                 max_delay_ms=max_delay_ms, max_queue=max_queue,
                 metrics=self.metrics, example_shapes=example_shapes)
+        queue_wait_budget_ms = _tune.resolve(
+            "serving.queue_wait_budget_ms", explicit=queue_wait_budget_ms,
+            artifact=tuned)
         if queue_wait_budget_ms is None:
             queue_wait_budget_ms = 500.0 * default_timeout \
                 if default_timeout else 1000.0
         if admission == "auto":
             admission = SignalAdmissionPolicy(
-                queue_wait_budget_ms=queue_wait_budget_ms) \
+                queue_wait_budget_ms=queue_wait_budget_ms,
+                watchdog_shed_s=_tune.resolve("serving.watchdog_shed_s",
+                                              artifact=tuned),
+                min_mem_headroom=_tune.resolve("serving.min_mem_headroom",
+                                               artifact=tuned),
+                queue_frac_shed=_tune.resolve("serving.queue_frac_shed",
+                                              artifact=tuned),
+                degrade_frac=_tune.resolve("serving.degrade_frac",
+                                           artifact=tuned)) \
                 if mode == "continuous" else None
         if admission is not None and not hasattr(admission, "decide"):
             raise MXNetError("admission must be an AdmissionPolicy "
@@ -202,6 +235,11 @@ class ServingSession:
         self._swap_lock = threading.Lock()
         self._inflight_n = [0] * len(self._pool.replicas)
         self._last_retire_t = [None] * len(self._pool.replicas)
+        # per-WORKER per-bucket (count, sum_ms) service aggregates:
+        # single writer each (its dispatcher thread), so the admission
+        # reader merges them lock-free — the hot path must not scan the
+        # metrics registry per request
+        self._bucket_service = [{} for _ in self._pool.replicas]
         self.metrics.gauge("queue_depth", fn=lambda: self.batcher.depth)
         self.metrics.gauge("replicas", fn=lambda: len(self._pool))
         self.metrics.gauge("inflight_depth",
@@ -267,6 +305,14 @@ class ServingSession:
             self._pool = new_pool
             self._generation += 1
             self.version_tag = version_tag
+            # the new model has a new service profile: the mix-aware
+            # admission estimate must re-learn from ITS batches, not
+            # price them with the old model's lifetime history (the
+            # cost-row prior of the new pool covers the relearn window;
+            # old-pool in-flight tails retiring after the flip land in
+            # the fresh dicts — a few rows of contamination, gone
+            # within the first decay window)
+            self._bucket_service = [{} for _ in new_pool.replicas]
             # the build listener must keep attributing the OLD pool's
             # tail (in-flight retires) AND the new pool's programs
             self._pool_ref.insert(0, weakref.ref(new_pool))
@@ -287,31 +333,65 @@ class ServingSession:
         return self._pool.example_shapes
 
     # --------------------------------------------------------- admission
+    #: per-bucket observations before the aggregate halves: bounds how
+    #: long a stale service profile can dominate the admission estimate
+    #: (a traffic-mix or model change re-converges within ~one window)
+    _SERVICE_WINDOW = 2048
+
+    def _record_service(self, idx, bucket, service_ms):
+        """Record one retired batch's marginal service time: into worker
+        ``idx``'s per-bucket aggregate (the admission estimate's
+        lock-free read) and the ``batch_service_ms`` telemetry series —
+        unlabeled for the overall distribution, ``bucket=``-labeled for
+        the dashboard view of the same per-bucket facts."""
+        d = self._bucket_service[idx]
+        n, s = d.get(bucket, (0, 0.0))
+        if n >= self._SERVICE_WINDOW:
+            # exponential forgetting: halve the weight of history so
+            # the mean tracks drift instead of averaging over the
+            # process lifetime
+            n, s = n // 2, s / 2.0
+        d[bucket] = (n + 1, s + service_ms)   # atomic slot replace
+        self.metrics.histogram("batch_service_ms").observe(service_ms)
+        self.metrics.histogram(
+            "batch_service_ms",
+            labels={"bucket": str(bucket)}).observe(service_ms)
+
+    def _service_model(self):
+        """The queue-drain model admission budgets with: mix-weighted
+        per-batch service time AND rows-per-batch learned from the live
+        per-bucket service aggregates (single-writer per worker, merged
+        here without locks — this runs on every request's admit path),
+        falling back to the warmup cost-registry rows before traffic
+        (:func:`~mxtpu.serving.admission.mix_service_model`). Service
+        time is the MARGINAL retire-to-retire cost, not
+        ``batch_exec_ms`` (dispatch→retire): with K batches in flight
+        the latter runs ~K× the true per-batch cost — budgeting with it
+        would shed at a fraction of the configured latency budget."""
+        merged = {}
+        for d in self._bucket_service:
+            for b, (n, s) in list(d.items()):
+                pn, ps = merged.get(b, (0, 0.0))
+                merged[b] = (pn + n, ps + s)
+        live = {b: (n, s / n) for b, (n, s) in merged.items() if n}
+        return mix_service_model(live, self._pool.bucket_costs(),
+                                 self.buckets)
+
     def _est_batch_ms(self):
-        """Per-batch service-time estimate: the live ``batch_service_ms``
-        distribution once traffic has produced one, the warmup-measured
-        cost-registry rows before that (deploy-time prior). Service time
-        is the MARGINAL retire-to-retire cost, not ``batch_exec_ms``
-        (dispatch→retire): with K batches in flight the latter runs ~K×
-        the true per-batch cost — budgeting with it would shed at a
-        fraction of the configured latency budget."""
-        h = self.metrics.histogram("batch_service_ms")
-        if h.count >= 8:
-            return h.mean
-        costs = self._pool.bucket_costs()
-        if costs:
-            return max(c.get("exec_ms", 0.0) for c in costs.values()) or 1.0
-        return 1.0
+        """Per-batch service-time estimate (the ``_service_model``'s
+        headline number; kept as the stable introspection surface)."""
+        return self._service_model()["est_batch_ms"]
 
     def _signals(self):
         """Point-in-time :class:`AdmissionSignals` — lock-free reads of
         structures the hot path already maintains."""
-        est = self._est_batch_ms()
+        model = self._service_model()
+        est = model["est_batch_ms"]
         pending = self.batcher.pending_rows
-        largest = self.buckets[-1]
+        rows_per_batch = max(1.0, model["est_rows_per_batch"])
         inflight = sum(self._inflight_n)
         n_rep = max(1, len(self._pool.replicas))
-        batches_ahead = (pending + largest - 1) // largest + inflight
+        batches_ahead = math.ceil(pending / rows_per_batch) + inflight
         age = _diag.progress_age_s()
         for w in _diag.active_waits():
             # a device wait (serving collect, fit pacing) older than the
@@ -357,6 +437,7 @@ class ServingSession:
                 if self._admission is not None else None,
                 "sheds_by_reason": dict(self._sheds_by_reason),
                 "last_shed_reason": self._last_shed_reason,
+                "service_model": self._service_model(),
                 "signals": self._signals().to_dict()}
 
     # ------------------------------------------------------------ workers
@@ -389,8 +470,7 @@ class ServingSession:
             prev = self._last_retire_t[idx]
             base = prev if prev is not None and prev > inf.t_dispatch \
                 else inf.t_dispatch
-            self.metrics.histogram("batch_service_ms").observe(
-                (now - base) * 1e3)
+            self._record_service(idx, batch.bucket, (now - base) * 1e3)
             self._last_retire_t[idx] = now
             for it in batch.items:
                 self.metrics.histogram("request_latency_ms").observe(
@@ -405,10 +485,13 @@ class ServingSession:
         by then the device is already executing the newer ones, so
         device idle between bursts collapses to the refill latency."""
         inflight = deque()
-        k = max(1, self.max_in_flight)
         t_slot_free = None    # a retire freed a slot at this time
         t_device_idle = None  # nothing in flight since this time
         while True:
+            # the window depth is re-read every cycle: the online
+            # refinement controller (mxtpu.tune.online) nudges
+            # ``max_in_flight`` within its certified safe range live
+            k = max(1, self.max_in_flight)
             if len(inflight) >= k:
                 self._retire(inflight.popleft(), idx)
                 self._inflight_n[idx] = len(inflight)
@@ -493,8 +576,7 @@ class ServingSession:
                     (done - t0) * 1e3)
                 # burst runs one batch at a time: the marginal service
                 # time IS the dispatch→answer span
-                self.metrics.histogram("batch_service_ms").observe(
-                    (done - t0) * 1e3)
+                self._record_service(idx, batch.bucket, (done - t0) * 1e3)
                 for it in batch.items:
                     self.metrics.histogram("request_latency_ms").observe(
                         (done - it.t_enqueue) * 1e3)

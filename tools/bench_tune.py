@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Benchmark: the autotuned ``TunedConfig`` vs every hand-picked default.
+
+Runs the offline search (``mxtpu.tune``) on the bench fixtures, then
+measures the SAME probe workloads under (a) the hand-picked defaults
+the knob registry catalogs and (b) the searched winner, and asks the
+ISSUE's acceptance question: does the autotuned config beat the
+defaults on the deterministic basis?
+
+Deterministic CPU basis per the PR-2 noise-floor convention:
+
+* **sync points** — fit pacing waits + cadence metric syncs, read as
+  EXACT counter deltas off the telemetry registry (scheduling facts,
+  not timings);
+* **predicted step / request cost** — the cost model's arithmetic over
+  the measured cost-registry rows (replayable from the recorded basis);
+* **overlap / idle-gap counts** — serving batches formed, watermark
+  refills, and dispatch idle gaps (counts are near-deterministic; the
+  wall-clock means ride along with the shared-CPU-host caveat, as
+  every bench since PR 2 records).
+
+Writes BENCH_tune.json; exits nonzero when the autotuned config fails
+to beat the defaults (the regression the ISSUE gates on).
+
+Usage: python tools/bench_tune.py [--out BENCH_tune.json] [--steps 24]
+       [--fixture mlp] [--save-artifact tuned.json]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from mxtpu import tune  # noqa: E402
+from mxtpu.tune import cost as tune_cost  # noqa: E402
+from mxtpu.tune import searcher  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_tune.json"))
+    ap.add_argument("--steps", type=int, default=24,
+                    help="fit probe length (sync-point basis)")
+    ap.add_argument("--fixture", default="mlp")
+    ap.add_argument("--buckets", default="1,8")
+    ap.add_argument("--save-artifact", default=None,
+                    help="also save the searched TunedConfig here")
+    args = ap.parse_args()
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+
+    t0 = time.time()
+    cfg = searcher.search(fixture=args.fixture, buckets=buckets,
+                          top_k=2, probe=True, probe_steps=args.steps,
+                          out=args.save_artifact)
+    defaults = searcher.default_candidates()
+    tuned_vals = dict(defaults)
+    tuned_vals.update(cfg.values)
+
+    # ---- fit: sync points under defaults vs tuned (exact counts)
+    fit_default = searcher.probe_fit(defaults, steps=args.steps)
+    fit_tuned = searcher.probe_fit(tuned_vals, steps=args.steps)
+
+    # ---- serving: batch formation / refill / idle gaps
+    srv_default = searcher.probe_serving(defaults, fixture=args.fixture,
+                                         buckets=buckets)
+    srv_tuned = searcher.probe_serving(tuned_vals, fixture=args.fixture,
+                                       buckets=buckets)
+
+    # ---- predicted costs, replayed from the artifact's recorded basis
+    basis = cfg.basis["cost_model"]
+    model = tune_cost.CostModel(bucket_costs=basis["bucket_costs"],
+                                fit_basis=basis["fit_basis"])
+    pred = {}
+    for label, vals in (("default", defaults), ("tuned", tuned_vals)):
+        pred[label] = {
+            "step_ms": round(model.predict_step_ms(
+                vals["fit.max_in_flight"], vals["fit.metric_sync"],
+                vals["fit.device_prefetch"]), 6),
+            "request_ms": round(model.predict_request_ms(
+                vals["serving.refill_watermark"] or max(buckets) // 4 or 1,
+                vals["serving.max_in_flight"], buckets=buckets), 6),
+            "sync_points_predicted": model.predict_sync_points(
+                vals["fit.max_in_flight"], vals["fit.metric_sync"],
+                steps=args.steps),
+        }
+
+    acceptance = {
+        "fewer_sync_points":
+            fit_tuned["sync_points"] < fit_default["sync_points"],
+        "lower_predicted_step_cost":
+            pred["tuned"]["step_ms"] < pred["default"]["step_ms"],
+        "lower_predicted_request_cost":
+            pred["tuned"]["request_ms"] < pred["default"]["request_ms"],
+        "no_more_batches_formed":
+            srv_tuned["batches_formed"] <= srv_default["batches_formed"],
+    }
+    out = {
+        "bench": "tune",
+        "fixture": args.fixture,
+        "buckets": list(buckets),
+        "probe_steps": args.steps,
+        "registry_version": tune.registry_version(),
+        "tuned_values": cfg.values,
+        "default_values": defaults,
+        "basis": {
+            "service_line": basis["service_line"],
+            "fit_basis": basis["fit_basis"],
+            "bucket_costs": basis["bucket_costs"],
+            "note": "deterministic basis: exact sync-point counter "
+                    "deltas + cost-model predictions replayable from "
+                    "these rows (PR-2 convention); wall-clock fields "
+                    "are evidence only — shared CPU host, no "
+                    "accelerator (real-TPU re-measurement queued per "
+                    "ROADMAP: bench.py --tuned <artifact>)",
+        },
+        "fit": {"default": fit_default, "tuned": fit_tuned},
+        "serving": {"default": srv_default, "tuned": srv_tuned},
+        "predicted": pred,
+        "acceptance": acceptance,
+        "autotuned_beats_default": all(acceptance.values()),
+        "wall_s": round(time.time() - t0, 1),
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({"bench": "tune",
+                      "autotuned_beats_default":
+                      out["autotuned_beats_default"],
+                      "sync_points": [fit_default["sync_points"],
+                                      fit_tuned["sync_points"]],
+                      "predicted_step_ms": [pred["default"]["step_ms"],
+                                            pred["tuned"]["step_ms"]],
+                      "out": args.out}))
+    return 0 if out["autotuned_beats_default"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
