@@ -36,6 +36,7 @@ from . import registry
 from . import libinfo
 from . import telemetry
 from . import diagnostics
+from . import faults
 from . import tune
 from .executor import Executor
 from . import analysis

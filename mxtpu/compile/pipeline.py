@@ -139,6 +139,8 @@ def notify_build(kind, owner):
         try:
             fn(kind, owner)
         except Exception:
+            # mxtpu: allow-swallow(observer contract: a broken build
+            # LISTENER must not fail the build it observes)
             pass
 
 

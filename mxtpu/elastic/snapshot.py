@@ -43,6 +43,8 @@ import time
 import numpy as _np
 
 from .. import telemetry as _tel
+from ..faults import RetryPolicy, env_attempts
+from ..faults import injection as _faults
 
 log = logging.getLogger("mxtpu.elastic")
 
@@ -88,6 +90,8 @@ def _write_atomic(path, data_bytes):
         f.write(data_bytes)
         f.flush()
         os.fsync(f.fileno())
+    # between the tmp write and its rename: firing here IS a torn write
+    _faults.point("elastic.snapshot.fsync_rename")
     os.replace(tmp, path)
     _fsync_dir(path)
     return len(data_bytes)
@@ -103,6 +107,8 @@ def _write_ndsave_atomic(path, host_arrays):
         f.flush()
         os.fsync(f.fileno())
         nbytes = f.seek(0, 2)
+    # between the tmp write and its rename: firing here IS a torn write
+    _faults.point("elastic.snapshot.fsync_rename")
     os.replace(tmp, path)
     _fsync_dir(path)
     return nbytes
@@ -166,7 +172,7 @@ class SnapshotWriter:
     ``flush()``/``close()`` lifecycle for callers that need durability
     (final preemption snapshot, ``wait_checkpoints``)."""
 
-    def __init__(self):
+    def __init__(self, retry=None):
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue = []
@@ -174,7 +180,43 @@ class SnapshotWriter:
         self._stop = False
         self._thread = None
         self.jobs_written = 0
+        self.jobs_failed = 0
         self.last_error = None
+        self._job = None  # the job under _write (for the recover hook)
+        # IO failures retry through the ONE shared policy: ENOSPC frees
+        # space first (prune to keep-1) and retries immediately; other
+        # IO errors back off bounded; exhaustion degrades (the failure
+        # is counted and training continues) — it never raises into fit
+        # MXTPU_ELASTIC_WRITE_RETRIES counts retries AFTER the first
+        # attempt (the MXTPU_ELASTIC_RETRIES convention: N=0 is one
+        # attempt, never a crash); tolerant parses — a robustness knob
+        # must not itself crash the writer
+        try:
+            backoff = float(os.environ.get(
+                "MXTPU_ELASTIC_WRITE_BACKOFF_S", "0.1"))
+        except ValueError:
+            backoff = 0.1
+        self._retry = retry if retry is not None else RetryPolicy(
+            "elastic.snapshot.write",
+            max_attempts=env_attempts("MXTPU_ELASTIC_WRITE_RETRIES", 3),
+            backoff_s=backoff, backoff_cap_s=5.0, retryable=OSError,
+            recover=self._recover_write, logger=log)
+
+    def _recover_write(self, exc, attempt):
+        """Between write attempts: a disk-full generation write frees
+        space by pruning down to keep-1 old generations (never the one
+        the pointer names), then retries immediately — trading history
+        depth for the NEW state, which is the one a preemption needs."""
+        import errno as _errno
+        job = self._job
+        if getattr(exc, "errno", None) == _errno.ENOSPC \
+                and job is not None and job.kind == "generation":
+            log.warning("elastic: ENOSPC writing g%06d — pruning to "
+                        "keep=%d and retrying", job.generation,
+                        max(1, job.keep - 1))
+            prune(job.prefix, keep=max(1, job.keep - 1))
+            return True
+        return False
 
     # ------------------------------------------------------------ lifecycle
     def _ensure_thread(self):
@@ -202,10 +244,26 @@ class SnapshotWriter:
 
     def flush(self, timeout=None):
         """Block until every submitted job is durable (or timeout).
-        Returns True when the queue fully drained."""
+        Returns True when the queue fully drained.
+
+        Liveness under writer death: while jobs are queued the thread
+        is re-ensured on every wait slice, not just once — a thread
+        killed mid-job still reads ``is_alive()`` during its unwind, so
+        a single up-front check can race the death and leave the queue
+        ownerless forever (found by the injected-kill chaos test)."""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
         with self._cond:
-            return self._cond.wait_for(
-                lambda: not self._queue and not self._busy, timeout)
+            while self._queue or self._busy:
+                if self._queue and not self._stop:
+                    self._ensure_thread()
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(0.1 if remaining is None
+                                else min(0.1, remaining))
+            return True
 
     def close(self, timeout=10.0):
         self.flush(timeout)
@@ -227,21 +285,50 @@ class SnapshotWriter:
                 job = self._queue.pop(0)
                 self._busy = True
             try:
-                self._write(job)
+                self._job = job
+                self._retry.call(self._write, job)
                 self.jobs_written += 1
             except Exception as exc:  # a bad disk must not kill training
+                # retries exhausted (or non-IO failure): DEGRADE — count
+                # it, mark the generation failed, keep training. The
+                # pointer never flipped, so resume falls back to the
+                # last good generation; checkpointing got sparser, fit
+                # never died.
                 self.last_error = exc
+                self.jobs_failed += 1
                 log.error("elastic snapshot write failed (%s): %r",
                           job.label, exc)
                 _tel.counter("elastic_snapshot_errors",
                              help="snapshot writer jobs that failed").inc()
+                if job.kind == "generation":
+                    _tel.counter(
+                        "elastic_write_failures",
+                        help="snapshot GENERATIONS abandoned after write "
+                             "retries exhausted (training continued; "
+                             "resume falls back to the last good "
+                             "generation)").inc()
+            except BaseException:
+                # thread death (injected kill, teardown): count the lost
+                # job, then die for real — submit()/flush() respawn the
+                # thread for the jobs still queued
+                self.jobs_failed += 1
+                if job.kind == "generation":
+                    _tel.counter(
+                        "elastic_write_failures",
+                        help="snapshot GENERATIONS abandoned after write "
+                             "retries exhausted (training continued; "
+                             "resume falls back to the last good "
+                             "generation)").inc()
+                raise
             finally:
+                self._job = None
                 with self._cond:
                     self._busy = False
                     self._cond.notify_all()
 
     def _write(self, job):
         global _LAST_DURABLE_T
+        _faults.point("elastic.snapshot.write")
         t0 = time.perf_counter()
         # materialize on THIS thread: the capture already started the
         # device->host copies, so these np.asarray calls mostly find the
@@ -296,6 +383,8 @@ class SnapshotWriter:
             try:
                 job.on_done(job)
             except Exception:
+                # mxtpu: allow-swallow(caller's completion hook — its
+                # failure must not mark a DURABLE write as failed)
                 pass
 
 
@@ -459,6 +548,9 @@ def safe_arrays(values):
             try:
                 v.copy_to_host_async()
             except Exception:
+                # mxtpu: allow-swallow(async D2H start is an
+                # optimization; a backend without it just makes the
+                # WRITER thread block at materialization)
                 pass
             out[k] = v
     return out

@@ -25,11 +25,11 @@ import logging
 import os
 import signal as _signal
 import threading
-import time
 
 from .. import diagnostics as _diag
 from .. import telemetry as _tel
 from ..base import MXNetError
+from ..faults import RetryPolicy
 
 log = logging.getLogger("mxtpu.elastic")
 
@@ -65,7 +65,7 @@ class Supervisor:
     """
 
     def __init__(self, retries=None, backoff_s=None, backoff_cap_s=60.0,
-                 logger=None):
+                 logger=None, sleep=None, clock=None):
         env = os.environ.get
         self.retries = int(retries if retries is not None
                            else env("MXTPU_ELASTIC_RETRIES", "3"))
@@ -73,6 +73,8 @@ class Supervisor:
                                else env("MXTPU_ELASTIC_BACKOFF_S", "1.0"))
         self.backoff_cap_s = float(backoff_cap_s)
         self.logger = logger or log
+        self._sleep = sleep          # injectable (tests: no real backoff)
+        self._clock = clock
         self._lock = threading.Lock()
         self._wedge_reason = None
         self._preempted = threading.Event()
@@ -155,40 +157,58 @@ class Supervisor:
         self._preempted.clear()
 
     # -------------------------------------------------------------- run
+    def retry_policy(self):
+        """This supervisor's knobs as a :class:`~mxtpu.faults.RetryPolicy`
+        (the ONE shared retry implementation — docs/faults.md): only
+        :class:`WedgeAbort` is retryable, jitter off so the backoff
+        schedule stays the documented exact exponential."""
+        return RetryPolicy(
+            "elastic.supervisor", max_attempts=self.retries + 1,
+            backoff_s=self.backoff_s, backoff_cap_s=self.backoff_cap_s,
+            jitter_frac=0.0, retryable=WedgeAbort,
+            recover=self._on_wedge_retry, sleep=self._sleep,
+            clock=self._clock, logger=self.logger)
+
+    def _on_wedge_retry(self, exc, attempt):
+        """Policy recover hook: bookkeeping per restore-retry. Returns
+        False — the wedge needs the backoff, nothing was 'recovered'."""
+        self.retries_done = attempt
+        _tel.counter("elastic_retries",
+                     help="wedge-triggered restore-retry attempts").inc()
+        return False
+
     def run(self, fit_fn):
         """Drive ``fit_fn(resume)`` to completion through wedges.
 
         ``fit_fn`` is called with ``resume=False`` on the first attempt
         and ``resume=True`` on retries (``Module.fit`` then restores the
         newest durable generation of its elastic prefix — or starts
-        fresh when none exists yet). :class:`Preempted` is never
-        retried; it propagates after the final snapshot is durable."""
+        fresh when none exists yet), bounded and backed off by
+        :meth:`retry_policy`. :class:`Preempted` is never retried (it is
+        not a :class:`WedgeAbort`); it propagates after the final
+        snapshot is durable."""
         self.attach()
         self.install_sigterm()
-        attempt = 0
+        state = {"attempt": 0}
+
+        def one_attempt():
+            self.clear_wedge()
+            resume = state["attempt"] > 0
+            state["attempt"] += 1
+            return fit_fn(resume)
+
         try:
-            while True:
-                self.clear_wedge()
-                try:
-                    return fit_fn(attempt > 0)
-                except WedgeAbort as exc:
-                    attempt += 1
-                    self.retries_done = attempt
-                    _tel.counter(
-                        "elastic_retries",
-                        help="wedge-triggered restore-retry attempts"
-                        ).inc()
-                    if attempt > self.retries:
-                        self.logger.error(
-                            "elastic supervisor: giving up after %d "
-                            "retries (%s)", self.retries, exc)
-                        raise
-                    delay = min(self.backoff_s * (2.0 ** (attempt - 1)),
-                                self.backoff_cap_s)
-                    self.logger.warning(
-                        "elastic supervisor: retry %d/%d in %.1fs (%s)",
-                        attempt, self.retries, delay, exc)
-                    time.sleep(delay)
+            return self.retry_policy().call(one_attempt)
+        except WedgeAbort as exc:
+            # exhaustion: keep the historical counter/field semantics
+            # (the give-up attempt counts too), then propagate
+            self.retries_done = state["attempt"]
+            _tel.counter("elastic_retries",
+                         help="wedge-triggered restore-retry attempts"
+                         ).inc()
+            self.logger.error("elastic supervisor: giving up after %d "
+                              "retries (%s)", self.retries, exc)
+            raise
         finally:
             self.detach()
             self.uninstall_sigterm()

@@ -28,6 +28,7 @@ from . import _native
 from ._native import check_call
 from . import telemetry as _tel
 from .diagnostics import flight as _flight
+from .faults import injection as _faults
 from .telemetry import tracing as _tracing
 
 
@@ -69,6 +70,7 @@ class NaiveEngine:
         pass
 
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        _faults.point("engine.dispatch")
         _M_DISPATCHED.inc()
         _flight.record("engine", "push", "sync")
         t0 = time.perf_counter()
@@ -141,6 +143,9 @@ class ThreadedEngine:
             var.handle = None
 
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        # before the pending-table insert: a raised fault must not leave
+        # an orphaned token the native scheduler will never dispatch
+        _faults.point("engine.dispatch")
         _M_DISPATCHED.inc()
         with self._pending_lock:
             self._next_token += 1

@@ -25,6 +25,7 @@ from .ndarray import NDArray, zeros
 from . import random as _rnd
 from . import telemetry as _tel
 from . import diagnostics as _diag
+from .faults import injection as _faults
 from .telemetry import tracing as _tracing
 from .compile import pipeline as _pipeline
 # compat re-exports: the program-build seam (listeners, first-call AOT
@@ -64,6 +65,9 @@ def device_wait(x):
         x = getattr(x, "_data", x)
     _diag.wait_begin("device_wait")
     try:
+        # INSIDE the registered wait on purpose: an injected latency
+        # here looks to the watchdog exactly like a wedged device
+        _faults.point("executor.device_wait")
         # mxtpu: allow-sync(device_wait IS the explicit pacing sync point)
         jax.block_until_ready(x)
     finally:

@@ -1,0 +1,35 @@
+"""mxtpu.faults — seeded fault injection + the shared retry/backoff
+primitive.
+
+Two halves of one robustness story:
+
+* :mod:`~mxtpu.faults.injection` — a process-wide, seeded,
+  deterministic fault-injection registry with declared points at the
+  existing seams (snapshot writer, serving replicas, prefetch
+  producers, KVStore transport, device waits, engine dispatch). Armed
+  via ``MXTPU_FAULTS`` or :func:`scope`; free (one module-global
+  ``None`` check) when off.
+* :mod:`~mxtpu.faults.retry` — :class:`RetryPolicy`, the ONE
+  bounded-attempts/exponential-backoff/deterministic-jitter
+  implementation every failure domain retries through (the elastic
+  supervisor, the snapshot writer's IO path, KVStore push/pull).
+
+Together they turn every robustness claim into something a chaos gate
+demonstrates under injected failure (tests/test_faults.py): resume
+stays bit-exact under disk faults, serving answers-or-sheds every
+request through replica death, a crashing prefetch producer surfaces
+at the consumer. See docs/faults.md.
+"""
+from __future__ import annotations
+
+from .injection import (POINTS, FaultInjected, FaultKill, FaultSchedule,
+                        FaultSpec, InjectedIOError, active, configure,
+                        parse_schedule, point, reset, scope)
+from .retry import RetryPolicy, TRANSIENT_EXCEPTIONS, env_attempts
+
+__all__ = [
+    "POINTS", "FaultInjected", "InjectedIOError", "FaultKill",
+    "FaultSpec", "FaultSchedule", "point", "configure", "scope",
+    "active", "reset", "parse_schedule",
+    "RetryPolicy", "TRANSIENT_EXCEPTIONS", "env_attempts",
+]

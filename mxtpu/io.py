@@ -17,6 +17,7 @@ import numpy as _np
 
 from .base import MXNetError, Registry
 from . import diagnostics as _diag
+from .faults import injection as _faults
 from . import ndarray as nd
 from .ndarray import NDArray
 from . import telemetry as _tel
@@ -214,6 +215,10 @@ class PrefetchingIter(DataIter):
         self.started = True
         self.current_batch = [None] * self.n_iter
         self.next_batch = [None] * self.n_iter
+        # a producer that CRASHES (any non-StopIteration exception) must
+        # surface its original error at the consumer, not hang it: the
+        # exception is parked here and re-raised from iter_next()/next()
+        self.producer_error = [None] * self.n_iter
 
         def prefetch_func(self, i):
             while True:
@@ -225,9 +230,19 @@ class PrefetchingIter(DataIter):
                     self.data_ready[i].set()
                     break
                 try:
+                    _faults.point("io.prefetch.produce")
                     self.next_batch[i] = self._stage(self.iters[i].next())
                 except StopIteration:
                     self.next_batch[i] = None
+                except BaseException as exc:  # crash, incl. injected kill
+                    # park the ORIGINAL exception, signal readiness so a
+                    # blocked consumer wakes, and exit this thread — the
+                    # consumer re-raises at its next iter_next()
+                    self.producer_error[i] = exc
+                    self.next_batch[i] = None
+                    self.data_taken[i].clear()
+                    self.data_ready[i].set()
+                    break
                 self.data_taken[i].clear()
                 self.data_ready[i].set()
 
@@ -281,7 +296,9 @@ class PrefetchingIter(DataIter):
             # thread behind a producer blocked in a slow underlying next()
             self.close(join=False)
         except Exception:
-            pass  # interpreter teardown: threads are daemons anyway
+            # mxtpu: allow-swallow(GC finalizer: threads are daemons and
+            # a raising __del__ only prints noise at teardown)
+            pass
 
     @property
     def provide_data(self):
@@ -301,11 +318,21 @@ class PrefetchingIter(DataIter):
                      for x in i.provide_label]
                     for r, i in zip(self.rename_label, self.iters)], [])
 
+    def _raise_producer_error(self):
+        """Re-raise a crashed producer's ORIGINAL exception on the
+        consumer thread. The iterator is poisoned from then on (its
+        producer thread is gone): every further use re-raises, which is
+        the honest contract — a half-dead pipeline must not half-work."""
+        for exc in self.producer_error:
+            if exc is not None:
+                raise exc
+
     def reset(self):
         if not self.started:
             raise MXNetError("PrefetchingIter is closed")
         for e in self.data_ready:
             e.wait()
+        self._raise_producer_error()
         for i in self.iters:
             i.reset()
         for e in self.data_ready:
@@ -335,6 +362,10 @@ class PrefetchingIter(DataIter):
         _tel.histogram("io_prefetch_stall_ms",
                        help="consumer wait for the prefetch thread"
                        ).observe((_time.perf_counter() - t0) * 1e3)
+        # a dead producer sets data_ready before exiting, so the waits
+        # above return promptly and the crash surfaces HERE — within one
+        # batch of where it happened, as the original exception
+        self._raise_producer_error()
         if self.next_batch[0] is None:
             return False
         _tel.counter("io_batches", labels={"iter": "PrefetchingIter"},

@@ -31,7 +31,15 @@ import threading as _threading
 import jax
 import numpy as _np
 
+import os as _os
+
 from .base import MXNetError
+# private aliases: mxtpu.kvstore is a directly-documented module, and a
+# bare RetryPolicy import would duplicate its class doc onto the
+# generated kvstore API page
+from .faults import RetryPolicy as _RetryPolicy
+from .faults import env_attempts as _env_attempts
+from .faults import injection as _faults
 from .ndarray import NDArray, zeros
 from . import optimizer as opt
 from . import telemetry as _tel
@@ -66,6 +74,24 @@ class KVStore:
         self._barrier_count = 0
         self._client = None
         self._env = None
+        # transient transport errors (socket resets, IO hiccups — and
+        # the injected faults that model them) retry through the shared
+        # policy instead of killing the training step; the per-KEY
+        # transport head is retried, so an already-applied key is never
+        # re-pushed. MXTPU_KVSTORE_RETRIES counts retries AFTER the
+        # first attempt (the MXTPU_ELASTIC_RETRIES convention).
+        attempts = _env_attempts("MXTPU_KVSTORE_RETRIES", 2)
+        try:
+            backoff = float(_os.environ.get("MXTPU_KVSTORE_BACKOFF_S",
+                                            "0.05"))
+        except ValueError:
+            backoff = 0.05
+        self._push_retry = _RetryPolicy(
+            "kvstore.push", max_attempts=attempts, backoff_s=backoff,
+            backoff_cap_s=1.0)
+        self._pull_retry = _RetryPolicy(
+            "kvstore.pull", max_attempts=attempts, backoff_s=backoff,
+            backoff_cap_s=1.0)
         if kind.startswith("dist"):
             # covers the mxtpu-first import order (the import-time call in
             # mxtpu/__init__.py only sees clusters initialized earlier)
@@ -294,31 +320,45 @@ class KVStore:
             vlist = v if isinstance(v, list) else [v]
             merged = self._local_merge(vlist)
             bytes_pushed.inc(_nbytes(merged))
+            # ONLY the transport head is retried: the ps-client push is
+            # an at-least-once wire op. The collective (every host must
+            # issue it exactly once or peers hang) and the updater's
+            # in-place mutation of the store run OUTSIDE the retry —
+            # re-running either after a partial success would desync
+            # or double-apply.
             if self._client is not None:
-                self._client.push(k, merged.asnumpy())
+                self._push_retry.call(self._push_transport, k, merged)
                 continue
-            if self._kind.startswith("dist") and _is_dist():
-                # real multi-host path: all-reduce over DCN/ICI replaces the
-                # worker->server hop entirely
-                from jax.experimental import multihost_utils as mhu
-                gathered = mhu.process_allgather(merged._data)
-                merged = NDArray(gathered.sum(axis=0), merged.context)
-            if k not in self._store:
-                self._store[k] = merged.copy()
-                continue
-            if self._updater is not None:
-                if getattr(merged._data, "sharding", None) is not None and \
-                        len(merged._data.devices()) > 1:
-                    # the updater runs the optimizer on the store's own
-                    # single-device array — hand it a single-device view
-                    # of the mesh-replicated aggregate (its local shard,
-                    # so this is a no-copy reinterpret)
-                    merged = NDArray(self._shard_for(
-                        merged._data, self._store[k].context.jax_device),
-                        self._store[k].context)
-                self._updater(self._key_int(k), merged, self._store[k])
-            else:
-                self._store[k]._data = merged._data
+            self._push_retry.call(_faults.point, "kvstore.push")
+            self._apply_push(k, merged)
+
+    def _push_transport(self, k, merged):
+        _faults.point("kvstore.push")
+        self._client.push(k, merged.asnumpy())
+
+    def _apply_push(self, k, merged):
+        if self._kind.startswith("dist") and _is_dist():
+            # real multi-host path: all-reduce over DCN/ICI replaces the
+            # worker->server hop entirely
+            from jax.experimental import multihost_utils as mhu
+            gathered = mhu.process_allgather(merged._data)
+            merged = NDArray(gathered.sum(axis=0), merged.context)
+        if k not in self._store:
+            self._store[k] = merged.copy()
+            return
+        if self._updater is not None:
+            if getattr(merged._data, "sharding", None) is not None and \
+                    len(merged._data.devices()) > 1:
+                # the updater runs the optimizer on the store's own
+                # single-device array — hand it a single-device view
+                # of the mesh-replicated aggregate (its local shard,
+                # so this is a no-copy reinterpret)
+                merged = NDArray(self._shard_for(
+                    merged._data, self._store[k].context.jax_device),
+                    self._store[k].context)
+            self._updater(self._key_int(k), merged, self._store[k])
+        else:
+            self._store[k]._data = merged._data
 
     def pull(self, key, out=None, priority=0):
         with _tracing.span("kvstore.pull", category="kvstore") as sp:
@@ -334,21 +374,28 @@ class KVStore:
                                     help="weight bytes pulled to devices")
         keys, outs = self._normalize(key, out)
         for k, o in zip(keys, outs):
+            # same split as push: retry the transport read, distribute
+            # the result to the outs exactly once
             if self._client is not None:
                 import jax.numpy as jnp
-                src_np = self._client.pull(k)
+                src_np = self._pull_retry.call(self._pull_transport, k)
                 olist = o if isinstance(o, list) else [o]
                 for dst in olist:
                     dst._data = jax.device_put(jnp.asarray(src_np),
                                                dst.context.jax_device)
                     bytes_pulled.inc(_nbytes(dst))
                 continue
+            self._pull_retry.call(_faults.point, "kvstore.pull")
             src = self._store[k]
             olist = o if isinstance(o, list) else [o]
             for dst in olist:
                 dst._data = self._shard_for(src._data,
                                             dst.context.jax_device)
                 bytes_pulled.inc(_nbytes(dst))
+
+    def _pull_transport(self, k):
+        _faults.point("kvstore.pull")
+        return self._client.pull(k)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows (parity KVStore::PullRowSparse,

@@ -647,7 +647,10 @@ class FusedTrainStep:
             try:
                 leaf.copy_to_host_async()
             except Exception:
-                pass  # backend without async host copies: writer blocks
+                # mxtpu: allow-swallow(async D2H start is an
+                # optimization: a backend without it makes the writer
+                # block at materialization, nothing is lost)
+                pass
         return snap_p, snap_a, snap_o
 
     def stage_opt_leaves(self, name, leaves):

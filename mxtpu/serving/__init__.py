@@ -33,8 +33,8 @@ from .batcher import (BatcherClosed, ContinuousBatcher, DynamicBatcher,
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 from .pool import (ExecutorPool, WarmExecutableCache, default_contexts,
                    prewarm, warm_cache)
-from .server import (DEFAULT_BUCKETS, ServingHTTPServer, ServingSession,
-                     serve)
+from .server import (DEFAULT_BUCKETS, ReplicaCrash, ServingHTTPServer,
+                     ServingSession, serve)
 
 __all__ = [
     "ACCEPTING", "DEGRADED", "SHEDDING", "AdmissionPolicy", "AdmissionShed",
@@ -44,5 +44,6 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "ExecutorPool", "WarmExecutableCache", "default_contexts", "prewarm",
     "warm_cache",
-    "DEFAULT_BUCKETS", "ServingHTTPServer", "ServingSession", "serve",
+    "DEFAULT_BUCKETS", "ReplicaCrash", "ServingHTTPServer",
+    "ServingSession", "serve",
 ]

@@ -32,6 +32,7 @@ import jax
 from .. import diagnostics as _diag
 from ..base import MXNetError
 from ..context import Context
+from ..faults import injection as _faults
 from ..predict import Predictor
 
 __all__ = ["ExecutorPool", "WarmExecutableCache", "warm_cache", "prewarm",
@@ -272,6 +273,7 @@ class _Replica:
         The lock covers only bind + issue, so the expensive
         device->host materialization of a PREVIOUS batch never blocks
         the next dispatch — the continuous-batching hot path."""
+        _faults.point("serving.replica.dispatch")
         shapes = {k: tuple(v.shape) for k, v in inputs.items()}
         with self.lock:
             pred = self.predictor_for(shapes)
@@ -284,6 +286,7 @@ class _Replica:
         wait table so a wedged device shows up in postmortems."""
         _diag.wait_begin("serving_collect")
         try:
+            _faults.point("serving.replica.collect")
             # mxtpu: allow-sync(response materialization — the single
             # bulk transfer at the end of the request path, deliberately
             # outside the dispatch lock)
@@ -318,6 +321,13 @@ class ExecutorPool:
         contexts = contexts or default_contexts()
         self.metrics = metrics
         self.version_tag = version_tag
+        # kept for replica REBUILD (quarantine/respawn): a fresh
+        # predictor needs the graph and the weights the pool was built
+        # from (the weights are pinned by the live predictors anyway)
+        self._symbol_json = symbol_json if isinstance(symbol_json, str) \
+            else symbol_json.tojson()
+        self._params = params
+        self._cache_size = cache_size
         self._shared = warm_cache() if shared_cache is None else shared_cache
         # executor ownership registry for the build-listener seam: ids are
         # recorded under this dedicated lock at bind time, so membership
@@ -331,6 +341,7 @@ class ExecutorPool:
             with self._owned_lock:
                 self._owned_ids.add(id(ex))
 
+        self._record_executor = _record
         self.replicas = [
             _Replica(symbol_json, params, self.example_shapes, ctx,
                      cache_size, metrics=metrics, record_executor=_record,
@@ -378,6 +389,27 @@ class ExecutorPool:
             self._rr += 1
             return r
 
+    def rebuild_replica(self, idx):
+        """Replace replica ``idx`` with a FRESH predictor (quarantine
+        recovery): built without warm-cache adoption — a replica that
+        just died may have left its cached predictor's bind state
+        poisoned, so the cache entry is replaced, never trusted. The
+        fresh predictor is then registered OVER the cached one, so
+        future adopters (hot-swap rollback, new sessions) get the
+        rebuilt replica too. The list-slot assignment is atomic under
+        the GIL; dispatchers read ``replicas[idx]`` per batch."""
+        old = self.replicas[idx]
+        rep = _Replica(self._symbol_json, self._params,
+                       self.example_shapes, old.ctx, self._cache_size,
+                       metrics=self.metrics,
+                       record_executor=self._record_executor,
+                       version_tag=self.version_tag, shared_cache=None)
+        token, pin = params_token(self._params)
+        self._shared.register(rep.sym_hash, self.version_tag, old.ctx,
+                              token, rep.base, pin=pin)
+        self.replicas[idx] = rep
+        return rep
+
     def run(self, inputs, replica=None):
         """Dispatch one padded batch round-robin (or to ``replica``)."""
         rep = replica if replica is not None else self.next_replica()
@@ -394,48 +426,57 @@ class ExecutorPool:
         deploy-time, not mid-traffic misses. Buckets a replica adopted
         warm are skipped (their cost rows rode in with the cache entry).
         Returns the number of programs built."""
-        import numpy as _np
         from ..compile import pipeline as _pipeline
         built = 0
         with _pipeline.prewarm_scope():
             for rep in self.replicas:
-                for b in buckets:
-                    shapes = self.bucket_shapes(b)
-                    key = Predictor.shape_key(shapes)
-                    if rep.adopted and key in rep.base._bind_cache:
-                        # adopted warm: compiled AND executed by its
-                        # builder (a fresh replica's construction bind
-                        # is only traced lazily — it still needs the
-                        # first-call compile below)
-                        continue
-                    dummy = {k: _np.zeros(s, dtype=_np.float32)
-                             for k, s in shapes.items()}
-                    with rep.lock:
-                        pred = rep.predictor_for(shapes)
-                        # first call pays trace + XLA compile...
-                        pred.forward(**dummy)
-                        pred.get_outputs()
-                        # ...second call is the steady-state batch time
-                        # the admission policy budgets with
-                        t0 = time.perf_counter()
-                        pred.forward(**dummy)
-                        pred.get_outputs()
-                        exec_ms = (time.perf_counter() - t0) * 1e3
-                    if b not in self._bucket_costs:
-                        rec = _diag.latest_record("fwd_eval")
-                        cost = {"exec_ms": round(exec_ms, 3),
-                                "flops": rec.flops if rec else 0.0,
-                                "bytes_accessed":
-                                    rec.bytes_accessed if rec else 0.0,
-                                "compile_ms":
-                                    rec.compile_ms if rec else 0.0}
-                        self._bucket_costs[b] = cost
-                        if self._shared is not None:
-                            self._shared.record_cost(
-                                rep.sym_hash, rep.version_tag, b, cost)
-                    built += 1
+                built += self._warmup_replica(rep, buckets)
         if self.metrics:
             self.metrics.counter("warmup_programs").inc(built)
+        return built
+
+    def _warmup_replica(self, rep, buckets):
+        """Warm ONE replica's bucket executables (warmup's inner loop;
+        also the quarantine-respawn path, which rebuilds and re-warms a
+        single replica off the hot path). Caller wraps in
+        ``prewarm_scope`` when the builds should count as deploy-time."""
+        import numpy as _np
+        built = 0
+        for b in buckets:
+            shapes = self.bucket_shapes(b)
+            key = Predictor.shape_key(shapes)
+            if rep.adopted and key in rep.base._bind_cache:
+                # adopted warm: compiled AND executed by its
+                # builder (a fresh replica's construction bind
+                # is only traced lazily — it still needs the
+                # first-call compile below)
+                continue
+            dummy = {k: _np.zeros(s, dtype=_np.float32)
+                     for k, s in shapes.items()}
+            with rep.lock:
+                pred = rep.predictor_for(shapes)
+                # first call pays trace + XLA compile...
+                pred.forward(**dummy)
+                pred.get_outputs()
+                # ...second call is the steady-state batch time
+                # the admission policy budgets with
+                t0 = time.perf_counter()
+                pred.forward(**dummy)
+                pred.get_outputs()
+                exec_ms = (time.perf_counter() - t0) * 1e3
+            if b not in self._bucket_costs:
+                rec = _diag.latest_record("fwd_eval")
+                cost = {"exec_ms": round(exec_ms, 3),
+                        "flops": rec.flops if rec else 0.0,
+                        "bytes_accessed":
+                            rec.bytes_accessed if rec else 0.0,
+                        "compile_ms":
+                            rec.compile_ms if rec else 0.0}
+                self._bucket_costs[b] = cost
+                if self._shared is not None:
+                    self._shared.record_cost(
+                        rep.sym_hash, rep.version_tag, b, cost)
+            built += 1
         return built
 
 

@@ -38,7 +38,9 @@ batches finish and answer, THEN workers exit.
 from __future__ import annotations
 
 import json
+import logging
 import math
+import os
 import threading
 import time
 from collections import deque
@@ -49,6 +51,7 @@ import numpy as _np
 from .. import diagnostics as _diag
 from .. import telemetry as _tel
 from ..base import MXNetError, NativeError, NumericsError
+from ..faults import RetryPolicy, env_attempts
 from .admission import (ACCEPTING, AdmissionShed, AdmissionSignals,
                         SignalAdmissionPolicy, STATE_NAMES, derive_knobs,
                         mix_service_model)
@@ -57,9 +60,18 @@ from .batcher import (BatcherClosed, ContinuousBatcher, DynamicBatcher,
 from .metrics import MetricsRegistry
 from .pool import ExecutorPool, warm_cache
 
-__all__ = ["ServingSession", "ServingHTTPServer", "serve"]
+__all__ = ["ServingSession", "ServingHTTPServer", "serve", "ReplicaCrash"]
+
+log = logging.getLogger("mxtpu.serving")
 
 DEFAULT_BUCKETS = (1, 8, 32, 128)
+
+
+class ReplicaCrash(Exception):
+    """A replica worker died with the batch's fate attached. A plain
+    ``Exception`` (NOT MXNetError): the HTTP layer maps it to 500 and
+    the forensics filter captures a postmortem — a dead replica is an
+    infrastructure failure, never a client error."""
 
 
 class _InFlight:
@@ -240,22 +252,21 @@ class ServingSession:
         # reader merges them lock-free — the hot path must not scan the
         # metrics registry per request
         self._bucket_service = [{} for _ in self._pool.replicas]
+        # graceful degradation: a worker that dies on an unexpected
+        # exception quarantines its replica (capacity shrinks HONESTLY:
+        # /healthz + admission see it) and is respawned off the hot path
+        self._quarantined = [False] * len(self._pool.replicas)
         self.metrics.gauge("queue_depth", fn=lambda: self.batcher.depth)
         self.metrics.gauge("replicas", fn=lambda: len(self._pool))
+        self.metrics.gauge("replicas_healthy",
+                           fn=lambda: self.healthy_replicas())
         self.metrics.gauge("inflight_depth",
                            fn=lambda: sum(self._inflight_n))
         self.metrics.gauge("admission_state",
                            fn=lambda: self._admission_state)
         self._closed = False
-        loop = self._continuous_loop if mode == "continuous" \
-            else self._burst_loop
-        self._workers = [
-            threading.Thread(target=loop, args=(i,), daemon=True,
-                             name="mxtpu-serving-%d" % i)
-            for i in range(len(self._pool.replicas))
-        ]
-        for w in self._workers:
-            w.start()
+        self._workers = [self._spawn_worker(i)
+                         for i in range(len(self._pool.replicas))]
 
     # ------------------------------------------------------------- pool
     @property
@@ -390,7 +401,12 @@ class ServingSession:
         pending = self.batcher.pending_rows
         rows_per_batch = max(1.0, model["est_rows_per_batch"])
         inflight = sum(self._inflight_n)
-        n_rep = max(1, len(self._pool.replicas))
+        # HEALTHY replicas, not configured ones: a quarantined replica
+        # serves nothing, so the queue drains slower and the in-flight
+        # ceiling is lower — est-wait must say so or admission admits
+        # into a wait it cannot honor (degraded capacity stays honest)
+        healthy = self.healthy_replicas()
+        n_rep = max(1, healthy)
         batches_ahead = math.ceil(pending / rows_per_batch) + inflight
         age = _diag.progress_age_s()
         for w in _diag.active_waits():
@@ -406,8 +422,8 @@ class ServingSession:
             queue_limit=self.batcher.max_queue,
             pending_rows=pending,
             inflight_depth=inflight,
-            inflight_limit=self.max_in_flight * n_rep,
-            replicas=n_rep,
+            inflight_limit=self.max_in_flight * healthy,
+            replicas=healthy,
             est_batch_ms=est,
             est_queue_wait_ms=est * batches_ahead / n_rep,
             watchdog_age_s=age,
@@ -441,6 +457,110 @@ class ServingSession:
                 "signals": self._signals().to_dict()}
 
     # ------------------------------------------------------------ workers
+    def _spawn_worker(self, idx):
+        t = threading.Thread(target=self._worker_main, args=(idx,),
+                             daemon=True, name="mxtpu-serving-%d" % idx)
+        t.start()
+        return t
+
+    def healthy_replicas(self):
+        """Replica slots with a live (non-quarantined) worker."""
+        return sum(1 for q in self._quarantined if not q)
+
+    def _worker_main(self, idx):
+        """The worker's outermost frame: a loop that exits normally is
+        a drain; ANYTHING else (including a ``BaseException`` like an
+        injected kill) is a worker death and takes the quarantine/
+        respawn path instead of silently shrinking capacity."""
+        inflight = deque()
+        loop = self._continuous_loop if self.mode == "continuous" \
+            else self._burst_loop
+        try:
+            loop(idx, inflight)
+        except BaseException as exc:
+            # shutdown unwinding is not a death — but its waiters must
+            # still be answered, never left to hit their own timeouts
+            self._on_worker_death(idx, inflight, exc,
+                                  respawn=not self._closed)
+
+    def _on_worker_death(self, idx, inflight, exc, respawn=True):
+        """Quarantine replica ``idx``: answer every in-flight waiter
+        with 500 (a dead worker must NEVER leave a waiter hung),
+        shrink the advertised capacity, and start the off-hot-path
+        rebuild+respawn. Runs on the dying worker thread.
+        ``respawn=False`` (session closing) only answers the waiters."""
+        crash = ReplicaCrash("serving replica %d died: %s: %s"
+                             % (idx, type(exc).__name__, exc))
+        while inflight:
+            self._fail_batch(inflight.popleft().batch, crash)
+        self._inflight_n[idx] = 0
+        if not respawn:
+            return
+        self._quarantined[idx] = True
+        self.metrics.counter(
+            "replica_quarantined").inc()
+        _diag.record("serving", "replica_quarantined", idx)
+        log.error("serving: worker %d died (%s: %s) — replica "
+                  "quarantined, capacity %d/%d, respawning",
+                  idx, type(exc).__name__, exc,
+                  self.healthy_replicas(), len(self._pool.replicas))
+        threading.Thread(target=self._respawn_replica, args=(idx,),
+                         daemon=True,
+                         name="mxtpu-serving-respawn-%d" % idx).start()
+
+    def _respawn_replica(self, idx):
+        """Rebuild the dead replica's predictor (fresh — its cached
+        state is not trusted), re-warm its buckets so the revived
+        worker never compiles mid-traffic, clear the quarantine, and
+        start a new worker thread. All off the hot path; bounded by
+        the shared RetryPolicy. A rebuild that exhausts its retries
+        leaves the replica quarantined — capacity stays honest."""
+        from ..compile import pipeline as _pipeline
+
+        def rebuild():
+            pool = self._pool
+            rep = pool.rebuild_replica(idx % len(pool.replicas))
+            with _pipeline.prewarm_scope():
+                pool._warmup_replica(rep, self.buckets)
+
+        try:
+            # constructed INSIDE the guarded region: a bad env value
+            # must land in the failed-outcome path below, not kill the
+            # respawn thread above its own failure handling
+            # (MXTPU_SERVING_RESPAWN_RETRIES = retries after the first
+            # attempt; tolerant parse via env_attempts)
+            policy = RetryPolicy(
+                "serving.respawn",
+                max_attempts=env_attempts(
+                    "MXTPU_SERVING_RESPAWN_RETRIES", 1),
+                backoff_s=0.2, backoff_cap_s=5.0, retryable=Exception,
+                logger=log)
+            policy.call(rebuild)
+        except BaseException as rebuild_exc:
+            # BaseException on purpose: a kill-mode fault (FaultKill)
+            # firing inside the re-warm must land in the SAME failed
+            # outcome — a respawn thread dying silently would leave the
+            # replica quarantined with no counter and no log, the exact
+            # silent capacity shrink this path exists to eliminate
+            self.metrics.counter("replica_respawned",
+                                 labels={"outcome": "failed"}).inc()
+            log.error("serving: replica %d rebuild failed (%r) — "
+                      "staying quarantined at capacity %d/%d", idx,
+                      rebuild_exc, self.healthy_replicas(),
+                      len(self._pool.replicas))
+            return
+        if self._closed:
+            return
+        self._last_retire_t[idx] = None
+        self._quarantined[idx] = False
+        self._workers[idx] = self._spawn_worker(idx)
+        self.metrics.counter("replica_respawned",
+                             labels={"outcome": "ok"}).inc()
+        _diag.record("serving", "replica_respawned", idx)
+        log.warning("serving: replica %d respawned — capacity %d/%d",
+                    idx, self.healthy_replicas(),
+                    len(self._pool.replicas))
+
     def _fail_batch(self, batch, exc):
         """Answer a batch's requests with ``exc``; never kill the worker.
         Backend failures (XLA error, OOM, nonzero native return) capture
@@ -454,7 +574,10 @@ class ServingSession:
 
     def _retire(self, inf, idx):
         """Materialize one in-flight batch's outputs (the single bulk
-        device→host transfer) and answer its requests."""
+        device→host transfer) and answer its requests. The batch is
+        already out of the worker's in-flight window, so even a
+        ``BaseException`` (kill at the collect seam) must answer its
+        waiters before unwinding the thread."""
         batch = inf.batch
         try:
             outs = inf.rep.collect(inf.handles)
@@ -477,14 +600,20 @@ class ServingSession:
                     (now - it.t_enqueue) * 1e3)
         except Exception as exc:
             self._fail_batch(batch, exc)
+        except BaseException as exc:
+            self._fail_batch(batch, ReplicaCrash(
+                "serving replica died retiring a batch: %s: %s"
+                % (type(exc).__name__, exc)))
+            raise
 
-    def _continuous_loop(self, idx):
+    def _continuous_loop(self, idx, inflight):
         """One per replica slot-window: keep up to K batches in flight,
         refill a freed slot from the queue within one dispatch cycle.
         The only blocking host sync is the retire of the OLDEST batch —
         by then the device is already executing the newer ones, so
-        device idle between bursts collapses to the refill latency."""
-        inflight = deque()
+        device idle between bursts collapses to the refill latency.
+        ``inflight`` is owned by ``_worker_main`` so a worker death can
+        fail the window's waiters instead of stranding them."""
         t_slot_free = None    # a retire freed a slot at this time
         t_device_idle = None  # nothing in flight since this time
         while True:
@@ -540,15 +669,27 @@ class ServingSession:
             except Exception as exc:
                 self._fail_batch(batch, exc)
                 continue
+            except BaseException as exc:
+                # worker death mid-dispatch (injected kill, real crash
+                # unwinding): this batch is not yet in the in-flight
+                # window _worker_main rescues — answer its waiters
+                # before the thread dies
+                self._fail_batch(batch, ReplicaCrash(
+                    "serving replica %d died dispatching: %s: %s"
+                    % (idx, type(exc).__name__, exc)))
+                raise
             inflight.append(_InFlight(batch, handles, rep, now))
             self._inflight_n[idx] = len(inflight)
 
-    def _burst_loop(self, idx):
+    def _burst_loop(self, idx, inflight):
         """The PR-1 loop: pull a batch, run it to completion, answer its
         requests. The device idles from the end of each batch until the
         next dispatch (response slicing + queue wait) — the gap the
         continuous mode exists to close; ``dispatch_idle_gap_ms`` makes
-        that cost visible in both modes."""
+        that cost visible in both modes. ``inflight`` stays empty (one
+        batch at a time, failed in-line) — the parameter keeps the
+        worker-main contract uniform across modes."""
+        del inflight
         t_idle = None
         while True:
             batch = self.batcher.next_batch(timeout=0.25)
@@ -582,6 +723,12 @@ class ServingSession:
                         (done - it.t_enqueue) * 1e3)
             except Exception as exc:  # answer, don't kill the worker
                 self._fail_batch(batch, exc)
+            except BaseException as exc:
+                # worker death: answer before the thread unwinds
+                self._fail_batch(batch, ReplicaCrash(
+                    "serving replica %d died mid-batch: %s: %s"
+                    % (idx, type(exc).__name__, exc)))
+                raise
             t_idle = time.monotonic()
 
     # ------------------------------------------------------------ client
@@ -661,8 +808,13 @@ class _Handler(BaseHTTPRequestHandler):
             if session.closed:
                 self._json(503, {"status": "draining"})
             else:
-                self._json(200, {"status": "ok",
-                                 "replicas": len(session.pool),
+                healthy = session.healthy_replicas()
+                total = len(session.pool)
+                self._json(200, {"status": "degraded" if healthy < total
+                                 else "ok",
+                                 "replicas": total,
+                                 "healthy_replicas": healthy,
+                                 "degraded": healthy < total,
                                  "buckets": list(session.buckets),
                                  "mode": session.mode,
                                  "version": session.version_tag,

@@ -469,3 +469,42 @@ def test_lint_thread_lifecycle_rule():
                   "    def start(self):\n"
                   "        self.t = threading.Thread(target=self.run)\n")
     assert not lint.lint_source(join_first, "mxtpu/foo.py")
+
+
+def test_lint_swallowed_exception_rule():
+    lint = _lint_mod()
+    # except: pass and except Exception: pass on a hot path are findings
+    bare = "def f():\n    try:\n        g()\n    except:\n        pass\n"
+    assert [f.rule for f in lint.lint_source(bare, "mxtpu/engine.py")] \
+        == ["swallowed-exception"]
+    broad = ("def f():\n    try:\n        g()\n"
+             "    except Exception:\n        pass\n")
+    assert [f.rule for f in lint.lint_source(broad, "mxtpu/engine.py")] \
+        == ["swallowed-exception"]
+    # log-and-continue without counter/re-raise is still a swallow
+    logcont = ("def f():\n    for i in x:\n        try:\n            g()\n"
+               "        except Exception:\n"
+               "            log.warning('oops')\n            continue\n")
+    assert [f.rule for f in lint.lint_source(logcont, "mxtpu/engine.py")] \
+        == ["swallowed-exception"]
+    # NOT findings: narrow catch, re-raise, counter, real fallback work
+    narrow = ("def f():\n    try:\n        g()\n"
+              "    except OSError:\n        pass\n")
+    assert not lint.lint_source(narrow, "mxtpu/engine.py")
+    reraise = ("def f():\n    try:\n        g()\n"
+               "    except Exception:\n        log.error('x')\n"
+               "        raise\n")
+    assert not lint.lint_source(reraise, "mxtpu/engine.py")
+    counted = ("def f():\n    try:\n        g()\n"
+               "    except Exception:\n"
+               "        _tel.counter('errs').inc()\n")
+    assert not lint.lint_source(counted, "mxtpu/engine.py")
+    fallback = ("def f():\n    try:\n        return g()\n"
+                "    except Exception:\n        return None\n")
+    assert not lint.lint_source(fallback, "mxtpu/engine.py")
+    # pragma'd (on the body line) and cold-path code are silent
+    pragma = ("def f():\n    try:\n        g()\n"
+              "    except Exception:\n"
+              "        pass  # mxtpu: allow-swallow(test)\n")
+    assert not lint.lint_source(pragma, "mxtpu/engine.py")
+    assert not lint.lint_source(bare, "mxtpu/visualization.py")

@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Benchmark: mxtpu.faults — guard overhead and degradation behavior.
+
+Three numbers (BENCH_faults.json), each on a deterministic basis per
+the PR-2 convention (the 2-core host's wall-clock noise floor is far
+above anything the guard could cost):
+
+* **faults-off guard overhead** — the acceptance bar is < 0.5% of an
+  mlp fit step. The off-path cost of ``faults.point`` is one function
+  call + module-global read + None test; the microbench times it
+  tight-loop, and the per-step cost is ``ns/call × crossings/step``
+  where crossings/step is COUNTED exactly (a p=0 no-op schedule armed
+  over one fit epoch records every evaluation).
+* **serving recovery** — requests-to-full-capacity after an injected
+  replica kill: how many requests the session answers/fails before the
+  quarantine/respawn cycle restores every replica (deterministic count;
+  wall-clock recovery ms recorded as context, caveated).
+* **elastic degraded mode** — a fit whose EVERY generation write fails
+  (injected EIO, retries exhausted) must lose ZERO steps: checkpointing
+  degrades, fit never dies. steps-lost is an exact counter delta.
+
+Usage: python tools/bench_faults.py [--out BENCH_faults.json]
+"""
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import mxtpu as mx  # noqa: E402
+from mxtpu import faults  # noqa: E402
+from mxtpu.elastic import snapshot as esnap  # noqa: E402
+from mxtpu.faults import RetryPolicy  # noqa: E402
+from mxtpu.models import mlp as _mlp  # noqa: E402
+
+logging.getLogger("mxtpu").setLevel(logging.CRITICAL)
+
+BATCH = 64
+N = 2048  # 32 batches/epoch
+
+
+def _make_iter(seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(N, 784).astype(np.float32)
+    y = rng.randint(0, 10, N).astype(np.float32)
+    return mx.io.NDArrayIter(X, y, batch_size=BATCH,
+                             label_name="softmax_label")
+
+
+def _fit_epoch(mod=None, **kwargs):
+    mod = mod or mx.mod.Module(_mlp.get_symbol(10), context=mx.cpu())
+    t0 = time.perf_counter()
+    mod.fit(_make_iter(), num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05}, **kwargs)
+    return mod, (time.perf_counter() - t0) * 1e3 / (N // BATCH)
+
+
+def guard_ns_per_call(iters=300_000):
+    """Tight-loop ns/call of the EXACT off-path: faults disarmed."""
+    faults.reset()
+    point = faults.point
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        point("engine.dispatch")
+    return (time.perf_counter() - t0) / iters * 1e9
+
+
+def crossings_per_step():
+    """Exact count of guard crossings one fit step makes: a p=0 no-op
+    schedule is armed (draws the RNG, never fires) and every point's
+    evaluation counter is read back after one epoch."""
+    specs = [faults.FaultSpec(name, kind="raise", p=0.0)
+             for name in faults.POINTS]
+    sched = faults.FaultSchedule(specs)
+    faults.configure(sched)
+    try:
+        _fit_epoch()
+    finally:
+        faults.reset()
+    per_point = {s.point: s.evaluations for s in sched.specs
+                 if s.evaluations}
+    return sum(per_point.values()) / (N // BATCH), per_point
+
+
+def bench_guard():
+    ns = guard_ns_per_call()
+    crossings, per_point = crossings_per_step()
+    _, step_ms = _fit_epoch()          # warm-ish step basis
+    _, step_ms2 = _fit_epoch()
+    step_ms = min(step_ms, step_ms2)
+    overhead_us = ns * crossings / 1e3
+    pct = overhead_us / (step_ms * 1e3) * 100.0
+    return {
+        "guard_ns_per_call": round(ns, 1),
+        "crossings_per_step": round(crossings, 2),
+        "crossings_by_point": per_point,
+        "mlp_step_ms": round(step_ms, 4),
+        "off_overhead_us_per_step": round(overhead_us, 3),
+        "off_overhead_pct_of_step": round(pct, 5),
+        "target_pct": 0.5,
+        "pass": pct < 0.5,
+        "basis": "microbench ns/call x exactly-counted crossings/step "
+                 "(wall-clock cannot resolve this under host noise)",
+    }
+
+
+def bench_serving_recovery():
+    from mxtpu.models.serving_fixtures import get_fixture
+    from mxtpu.serving import ServingSession
+    sym, params, shapes = get_fixture("mlp")
+    out = {}
+    with ServingSession(sym, params, shapes, buckets=(1, 4),
+                        max_delay_ms=2, contexts=[mx.cpu(0)]) as sess:
+        x = np.zeros((1, 784), np.float32)
+        sess.predict({"data": x})
+        full = len(sess.pool)
+        # one serial stream with the kill injected at a KNOWN request:
+        # after the first failure, the number of further requests until
+        # the stream answers again IS requests-to-full-capacity (serial
+        # issue, so a success means a live worker took the queue)
+        outcomes = []
+        t_kill = None
+        t_recovered = None
+        with faults.scope("serving.replica.dispatch:kind=kill,after=4"):
+            for i in range(60):
+                try:
+                    sess.predict({"data": x}, timeout=2)
+                    outcomes.append("ok")
+                    if t_kill is not None and t_recovered is None:
+                        t_recovered = time.perf_counter()
+                except Exception:
+                    outcomes.append("err")
+                    if t_kill is None:
+                        t_kill = time.perf_counter()
+        first_err = outcomes.index("err") if "err" in outcomes else None
+        after = outcomes[first_err:] if first_err is not None else []
+        recovery = after.index("ok") if "ok" in after else None
+        out["requests_total"] = len(outcomes)
+        out["kill_at_request"] = first_err
+        out["requests_failed"] = outcomes.count("err")
+        out["requests_to_full_capacity"] = recovery
+        out["recovery_wall_ms"] = round(
+            (t_recovered - t_kill) * 1e3, 1) \
+            if t_kill and t_recovered else None
+        deadline = time.time() + 30
+        while sess.healthy_replicas() < full and time.time() < deadline:
+            time.sleep(0.05)
+        out["quarantined"] = int(
+            sess.metrics.counter("replica_quarantined").value)
+        out["respawned_ok"] = int(sess.metrics.counter(
+            "replica_respawned", labels={"outcome": "ok"}).value)
+        out["recovered"] = sess.healthy_replicas() == full
+        out["wall_clock_caveat"] = (
+            "recovery_wall_ms includes an XLA re-compile on the 2-core "
+            "CPU host and is NOT a stable basis; the deterministic "
+            "facts are requests_to_full_capacity, quarantined, "
+            "respawned_ok, recovered")
+    return out
+
+
+def bench_elastic_degraded(tmpdir):
+    w = esnap.writer()
+    old_retry = w._retry
+    w._retry = RetryPolicy("elastic.snapshot.write", max_attempts=3,
+                           backoff_s=0.0, retryable=OSError,
+                           recover=w._recover_write,
+                           sleep=lambda s: None)
+    reg = mx.telemetry.registry()
+    prefix = os.path.join(tmpdir, "ck")
+    steps = [0]
+
+    def count_steps(param):
+        steps[0] += 1
+
+    f0 = reg.counter("elastic_write_failures").value
+    try:
+        with faults.scope("elastic.snapshot.write:errno=EIO"):
+            _fit_epoch(elastic=mx.elastic.ElasticConfig(
+                prefix, every_n_steps=1, epoch_period=0, sync=True),
+                batch_end_callback=count_steps)
+    finally:
+        w.flush()
+        w._retry = old_retry
+    failures = reg.counter("elastic_write_failures").value - f0
+    expected = N // BATCH
+    return {
+        "expected_steps": expected,
+        "completed_steps": steps[0],
+        "steps_lost_to_write_failure": expected - steps[0],
+        "generations_failed": int(failures),
+        "pass": steps[0] == expected and failures == expected,
+        "basis": "exact counter deltas: every generation write fails "
+                 "(injected EIO, retries exhausted) and the fit still "
+                 "completes every step",
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "..", "BENCH_faults.json"))
+    args = ap.parse_args(argv)
+    import tempfile
+    result = {"guard": bench_guard(),
+              "serving_recovery": bench_serving_recovery()}
+    with tempfile.TemporaryDirectory() as td:
+        result["elastic_degraded"] = bench_elastic_degraded(td)
+    result["pass"] = bool(result["guard"]["pass"]
+                          and result["serving_recovery"]["recovered"]
+                          and result["elastic_degraded"]["pass"])
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result, indent=2))
+    return 0 if result["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

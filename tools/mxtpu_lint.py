@@ -31,6 +31,17 @@ outlives its owner and hangs interpreter shutdown. Pragma::
 
     # mxtpu: allow-thread(reason)
 
+**swallowed-exception** — flags BROAD exception handlers (bare
+``except:``, ``except Exception:``, ``except BaseException:``) in the
+declared hot-path modules whose body neither re-raises, counts, nor
+does real work: ``pass``-only, or log-and-continue. A silently
+swallowed failure on a hot path is how capacity shrinks without a
+trace — the exact regression class mxtpu/faults exists to prove out.
+Handlers that count a telemetry series, re-raise, or take a real
+fallback action are fine; deliberate best-effort swallows carry::
+
+    # mxtpu: allow-swallow(reason)
+
 **f64-promotion** — flags silent float64 promotion in the declared
 hot-path modules: ``np.float64`` (and ``dtype="float64"``) used
 directly, and numpy array constructors without an explicit dtype —
@@ -79,6 +90,10 @@ HOT_PATHS = {
     # sync, pragma'd at its materialization site)
     "mxtpu/elastic/snapshot.py": None,
     "mxtpu/elastic/state.py": {"ElasticSession"},
+    # the injection guard and the retry loop run inside every other hot
+    # path — they are policed by every rule, including their own
+    "mxtpu/faults/injection.py": None,
+    "mxtpu/faults/retry.py": None,
 }
 
 #: numpy module aliases whose ``asarray``/``array`` calls mean "pull to
@@ -93,6 +108,14 @@ _SCALAR_PULLS = {"sum", "mean", "item", "max", "min"}
 PRAGMA_SYNC = "mxtpu: allow-sync("
 PRAGMA_THREAD = "mxtpu: allow-thread("
 PRAGMA_F64 = "mxtpu: allow-f64("
+PRAGMA_SWALLOW = "mxtpu: allow-swallow("
+
+#: exception names a handler may catch BROADLY without the swallow rule
+#: applying only when trivially handled (see _swallows)
+_BROAD_EXC_NAMES = {"Exception", "BaseException"}
+#: method names whose bare Expr call counts as "just logging"
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "log"}
 
 #: numpy constructors whose DEFAULT dtype is float64 regardless of input
 _NP_F64_DEFAULT_CTORS = {"zeros", "ones", "empty", "linspace", "eye"}
@@ -268,6 +291,59 @@ class _Linter(ast.NodeVisitor):
                 "it silently or retraces at double width — use an "
                 "explicit f32/target dtype or annotate '# %sreason)'"
                 % (node.value.id, PRAGMA_F64)))
+        self.generic_visit(node)
+
+    # ------------------------------------------------- swallowed except
+    @staticmethod
+    def _exc_name(expr):
+        if isinstance(expr, ast.Name):
+            return expr.id
+        if isinstance(expr, ast.Attribute):
+            return expr.attr
+        return None
+
+    def _is_broad(self, handler):
+        """Bare except, Exception, BaseException — alone or in a tuple."""
+        t = handler.type
+        if t is None:
+            return True
+        if isinstance(t, ast.Tuple):
+            return any(self._exc_name(e) in _BROAD_EXC_NAMES
+                       for e in t.elts)
+        return self._exc_name(t) in _BROAD_EXC_NAMES
+
+    @staticmethod
+    def _swallows(body):
+        """True when the handler does nothing observable: every
+        statement is ``pass``, ``continue``, or a bare logging call —
+        no re-raise, no counter, no fallback assignment/return."""
+        for stmt in body:
+            if isinstance(stmt, (ast.Pass, ast.Continue)):
+                continue
+            if isinstance(stmt, ast.Expr) \
+                    and isinstance(stmt.value, ast.Call) \
+                    and isinstance(stmt.value.func, ast.Attribute) \
+                    and stmt.value.func.attr in _LOG_METHODS:
+                continue
+            return False
+        return True
+
+    def visit_ExceptHandler(self, node):
+        if self._in_hot_scope() and self._is_broad(node) \
+                and self._swallows(node.body):
+            # pragma anywhere in the handler's span (the except line, a
+            # comment above it, or beside the pass/log line inside)
+            end = getattr(node, "end_lineno", node.lineno)
+            span = "\n".join(self.lines[node.lineno - 1:end])
+            if PRAGMA_SWALLOW not in span \
+                    and not _has_pragma(self.lines, node.lineno,
+                                        PRAGMA_SWALLOW):
+                self.findings.append(LintFinding(
+                    "swallowed-exception", self.relpath, node.lineno,
+                    "broad except on a hot path swallows the failure "
+                    "(pass/log-and-continue, no counter, no re-raise): "
+                    "count it, re-raise it, or annotate '# %sreason)'"
+                    % PRAGMA_SWALLOW))
         self.generic_visit(node)
 
     # ------------------------------------------------------------ locks
