@@ -40,6 +40,10 @@ from . import faults
 from . import tune
 from .executor import Executor
 from . import analysis
+# analysis/__init__ is deliberately light (lazy pass web); the
+# sanitizer's MXTPU_SANITIZE env arming lives at ITS import, so import
+# it explicitly here to preserve the arm-at-process-start contract
+from .analysis import sanitizer as _sanitizer  # noqa: F401
 
 # subsystems imported lazily-but-eagerly; order matters (no cycles)
 from . import initializer

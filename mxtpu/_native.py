@@ -20,6 +20,8 @@ import threading
 from .base import NativeError
 
 _LIB = None
+# mxtpu: allow-raw-lock(library-loader bootstrap: taken once before
+# any subsystem exists; leaf by construction)
 _LIB_LOCK = threading.Lock()
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),

@@ -1,9 +1,9 @@
 """mxtpu.analysis — graph verification, dataflow analyses, transform
-passes, runtime numerics sanitizer.
+passes, runtime numerics sanitizer, runtime concurrency witness.
 
 The framework's L5 layer is a graph IR; this package both *checks* and
 — since the compile pipeline (:mod:`mxtpu.compile`) — *changes* it,
-under a static-analysis contract. Five parts:
+under a static-analysis contract. Six parts:
 
 * **graph passes** (:mod:`~mxtpu.analysis.passes`): a registry of
   :class:`GraphPass` verifiers driven by :func:`analyze`, returning
@@ -27,29 +27,33 @@ under a static-analysis contract. Five parts:
   trip emits a diagnostics postmortem (``source="sanitizer"``, naming
   the precision mode) and raises :class:`NumericsError`. Strictly zero
   overhead when unset.
+* **concurrency witness** (:mod:`~mxtpu.analysis.concurrency` over the
+  single-source :mod:`~mxtpu.analysis.declarations`): tracked-lock
+  factory + runtime lock-order witness checking the SAME declared
+  hierarchy the AST lint checks — plus blocking-under-lock detection
+  and the seeded schedule fuzzer over the declared yield points.
+  Strictly one global ``None`` check per acquisition when disarmed.
 * **codebase lint** (``tools/mxtpu_lint.py``): the CI-enforced AST lint
   for implicit device→host syncs in hot-path modules, lock-order
-  inversions against the declared hierarchy, unjoined threads, and
-  silent f64 promotion.
+  inversions against the declared hierarchy, unjoined threads, raw
+  (untracked) lock creations, and silent f64 promotion.
 
-See docs/analysis.md for the pass/analysis catalogs and the Finding
-schema; docs/compile.md for the transform contract and the pipeline.
+Import contract: this ``__init__`` is LIGHT — ``findings``,
+``declarations`` and ``concurrency`` (all stdlib-only) load eagerly so
+the lowest layers (telemetry, engine, faults) can create tracked locks
+at their own import time; the graph/dataflow/rewrite web loads lazily
+on first attribute access (PEP 562). ``mxtpu/__init__`` imports the
+sanitizer explicitly to preserve ``MXTPU_SANITIZE`` env arming.
+
+See docs/analysis.md for the pass/analysis catalogs, the Finding
+schema, and the concurrency-witness contract; docs/compile.md for the
+transform contract and the pipeline.
 """
 from __future__ import annotations
 
 from .findings import ERROR, INFO, WARNING, SEVERITIES, Finding, Report
-from .passes import (GraphPass, PassContext, analyze, analyze_json,
-                     check_module, get_pass, list_passes, register_pass)
-from .sanitizer import NumericsError, disable as sanitizer_disable
-from .sanitizer import enable as sanitizer_enable
-from .sanitizer import mode as sanitizer_mode
-from .sanitizer import sanitize_tree
-from . import provenance
-from . import dataflow
-from .dataflow import liveness, precision_flow
-from . import rewrite
-from .rewrite import (TransformPass, get_transform, list_transforms,
-                      register_transform)
+from . import declarations
+from . import concurrency
 
 __all__ = [
     "Finding", "Report", "ERROR", "WARNING", "INFO", "SEVERITIES",
@@ -59,5 +63,53 @@ __all__ = [
     "sanitizer_mode", "sanitize_tree", "provenance",
     "dataflow", "precision_flow", "liveness",
     "rewrite", "TransformPass", "register_transform", "get_transform",
-    "list_transforms",
+    "list_transforms", "declarations", "concurrency",
 ]
+
+#: lazily-imported submodules (PEP 562): resolving any of them (or a
+#: symbol below) imports the heavy graph/symbol web on first use only
+_LAZY_MODULES = ("passes", "sanitizer", "provenance", "dataflow",
+                 "rewrite")
+
+#: public name -> (submodule, attribute)
+_LAZY_ATTRS = {
+    "GraphPass": ("passes", "GraphPass"),
+    "PassContext": ("passes", "PassContext"),
+    "register_pass": ("passes", "register_pass"),
+    "get_pass": ("passes", "get_pass"),
+    "list_passes": ("passes", "list_passes"),
+    "analyze": ("passes", "analyze"),
+    "analyze_json": ("passes", "analyze_json"),
+    "check_module": ("passes", "check_module"),
+    "NumericsError": ("sanitizer", "NumericsError"),
+    "sanitizer_enable": ("sanitizer", "enable"),
+    "sanitizer_disable": ("sanitizer", "disable"),
+    "sanitizer_mode": ("sanitizer", "mode"),
+    "sanitize_tree": ("sanitizer", "sanitize_tree"),
+    "precision_flow": ("dataflow", "precision_flow"),
+    "liveness": ("dataflow", "liveness"),
+    "TransformPass": ("rewrite", "TransformPass"),
+    "register_transform": ("rewrite", "register_transform"),
+    "get_transform": ("rewrite", "get_transform"),
+    "list_transforms": ("rewrite", "list_transforms"),
+}
+
+
+def __getattr__(name):
+    import importlib
+    if name in _LAZY_MODULES:
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
+    target = _LAZY_ATTRS.get(name)
+    if target is not None:
+        mod = importlib.import_module("." + target[0], __name__)
+        val = getattr(mod, target[1])
+        globals()[name] = val
+        return val
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))
+
+
+def __dir__():
+    return sorted(set(__all__) | set(globals()) | set(_LAZY_MODULES))

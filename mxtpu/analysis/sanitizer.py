@@ -24,6 +24,7 @@ import threading as _threading
 from .. import diagnostics as _diag
 from .. import telemetry as _tel
 from ..base import MXNetError, NumericsError
+from . import concurrency as _conc
 
 __all__ = ["NumericsError", "enable", "disable", "mode", "sanitize_tree"]
 
@@ -31,7 +32,7 @@ _VALID = ("nan", "inf", "all")
 
 _MODE = None
 _CHECKERS = {}
-_LOCK = _threading.Lock()
+_LOCK = _conc.lock("sanitizer", "_LOCK")
 
 
 def mode():

@@ -13,6 +13,8 @@ import threading
 
 import numpy as _np
 
+# mxtpu: allow-raw-lock(bootstrap handle table below every
+# subsystem; leaf by construction — nothing is acquired under it)
 _lock = threading.Lock()
 _handles = {}
 _next = [1]

@@ -30,6 +30,7 @@ import threading as _threading
 
 from .. import diagnostics as _diag
 from .. import telemetry as _tel
+from ..analysis import concurrency as _conc
 
 __all__ = ["set_output_sanitizer", "add_build_listener",
            "remove_build_listener", "program_build_count", "notify_build",
@@ -66,7 +67,7 @@ def set_output_sanitizer(fn):
 # staying flat under traffic.
 _BUILD_LISTENERS = []
 _BUILD_COUNT = [0]
-_BUILD_LOCK = _threading.Lock()
+_BUILD_LOCK = _conc.lock("pipeline", "_BUILD_LOCK")
 
 # standing series: registry-direct so they exist for /metrics even when
 # MXTPU_TELEMETRY=0 was set at import
@@ -198,7 +199,10 @@ def instrument_program(kind, fn, owner=None, matmul_env=False,
     # register duplicate ProgramRecords. Losers block until the winner's
     # executable is visible; the steady-state path never takes the lock.
     state = {"first": True, "timed": False, "compiled": None, "rec": None,
-             "misses": 0, "miss_total": 0, "lock": _threading.Lock()}
+             "misses": 0, "miss_total": 0,
+             # held across lower+compile+record on the first call: a
+             # declared hierarchy member ("program-build" level)
+             "lock": _conc.lock("pipeline", "_first_call_lock")}
 
     def _plain(args, kwargs):
         if matmul_env:
@@ -345,7 +349,7 @@ def _parse_env():
 
 
 _CONFIGURED = _parse_env()
-_CONFIG_LOCK = _threading.Lock()
+_CONFIG_LOCK = _conc.lock("pipeline", "_CONFIG_LOCK")
 # True once configure(names) pinned an explicit pass list — an artifact
 # installed later (refresh_from_knobs) must not clobber it
 _CONFIG_EXPLICIT = False

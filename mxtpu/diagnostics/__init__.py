@@ -30,6 +30,7 @@ import threading as _threading
 import time as _time
 
 from .. import telemetry as _tel
+from ..analysis import concurrency as _conc
 from . import ledger as ledger_mod  # module alias BEFORE the function
 # import below shadows the package attribute 'ledger' — hot call sites
 # that need the module's flag/globals use ledger_mod
@@ -68,7 +69,7 @@ _LAST_CAPTURE_T = 0.0   # separate clock: throttles full state CAPTURE
                         # for per-event sources, not just file writes
 _DUMP_MIN_INTERVAL_S = float(_os.environ.get("MXTPU_DIAG_DUMP_MIN_S", "5"))
 _CAPTURE_THROTTLED_SOURCES = ("serving",)
-_PM_LOCK = _threading.Lock()
+_PM_LOCK = _conc.lock("diagnostics", "_PM_LOCK")
 
 
 def set_enabled(flag):
@@ -116,6 +117,8 @@ def debug_state(flight_limit=256):
         "flight": rec.snapshot(limit=flight_limit) if rec is not None else [],
         "engine": _engine_state(),
         "waits": active_waits(),
+        # armed flag + observed lock graph summary (armed witness only)
+        "concurrency": _conc.state(),
     }
     try:
         state["reconcile"] = reconcile()

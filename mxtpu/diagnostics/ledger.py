@@ -43,6 +43,7 @@ import weakref
 from collections import deque
 
 from .. import telemetry as _tel
+from ..analysis import concurrency as _conc
 
 __all__ = ["DeviceMemoryLedger", "ledger", "mem_enabled", "set_mem_enabled",
            "alloc_origin", "current_origin", "DEFAULT_ORIGIN",
@@ -126,7 +127,7 @@ class DeviceMemoryLedger:
     """
 
     def __init__(self, register_gauges=True):
-        self._lock = threading.Lock()
+        self._lock = _conc.lock("DeviceMemoryLedger", "_lock")
         self._live = {}        # (ctx, origin) -> bytes
         self._live_ctx = {}    # ctx -> bytes
         self._peak_ctx = {}    # ctx -> bytes

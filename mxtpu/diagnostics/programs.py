@@ -25,6 +25,7 @@ import time
 from collections import deque
 
 from .. import telemetry as _tel
+from ..analysis import concurrency as _conc
 
 __all__ = ["ProgramRecord", "record_program", "programs", "program_table",
            "latest_record", "cost_enabled", "set_cost_enabled", "clear",
@@ -38,7 +39,7 @@ MAX_RECORDS = int(os.environ.get("MXTPU_DIAG_COST_CAP", "1024"))
 
 _ids = itertools.count(1)
 _RECORDS = deque(maxlen=MAX_RECORDS)
-_LOCK = threading.Lock()
+_LOCK = _conc.lock("programs", "_LOCK")
 
 
 def cost_enabled():

@@ -29,6 +29,7 @@ import threading
 import time
 
 from .. import telemetry as _tel
+from ..analysis import concurrency as _conc
 
 __all__ = ["Watchdog", "ensure_watchdog", "stop_watchdog", "wait_begin",
            "wait_end", "active_waits", "add_action", "remove_action",
@@ -64,7 +65,14 @@ _WAITS = {}  # thread id -> (t0, description); GIL-atomic dict ops
 
 
 def wait_begin(desc="device_wait"):
-    """Mark this thread as blocked on the device (executor.device_wait)."""
+    """Mark this thread as blocked on the device (executor.device_wait).
+
+    Doubles as the concurrency witness's blocking-under-lock seam: a
+    registered device wait entered while holding any tracked hierarchy
+    lock is exactly the hazard class the witness exists to catch — the
+    wedge a watchdog postmortem would later attribute to the device
+    when the real fault is the lock held across the wait."""
+    _conc.blocking(desc)
     _WAITS[threading.get_ident()] = (time.monotonic(), desc)
 
 
@@ -210,7 +218,7 @@ class Watchdog:
 
 
 _SINGLETON = None
-_SINGLETON_LOCK = threading.Lock()
+_SINGLETON_LOCK = _conc.lock("watchdog", "_SINGLETON_LOCK")
 
 
 def _singleton_progress_age():

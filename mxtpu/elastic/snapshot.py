@@ -43,6 +43,7 @@ import time
 import numpy as _np
 
 from .. import telemetry as _tel
+from ..analysis import concurrency as _conc
 from ..faults import RetryPolicy, env_attempts
 from ..faults import injection as _faults
 
@@ -173,8 +174,8 @@ class SnapshotWriter:
     (final preemption snapshot, ``wait_checkpoints``)."""
 
     def __init__(self, retry=None):
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
+        self._lock = _conc.lock("SnapshotWriter", "_lock")
+        self._cond = _conc.condition(self._lock)
         self._queue = []
         self._busy = False
         self._stop = False
@@ -389,7 +390,7 @@ class SnapshotWriter:
 
 
 _WRITER = None
-_WRITER_LOCK = threading.Lock()
+_WRITER_LOCK = _conc.lock("snapshot", "_WRITER_LOCK")
 
 
 def writer():

@@ -28,6 +28,7 @@ import threading
 
 from .. import diagnostics as _diag
 from .. import telemetry as _tel
+from ..analysis import concurrency as _conc
 from ..base import MXNetError
 from ..faults import RetryPolicy
 
@@ -75,7 +76,7 @@ class Supervisor:
         self.logger = logger or log
         self._sleep = sleep          # injectable (tests: no real backoff)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = _conc.lock("Supervisor", "_lock")
         self._wedge_reason = None
         self._preempted = threading.Event()
         self._attached = False

@@ -26,6 +26,7 @@ import time
 
 from . import _native
 from ._native import check_call
+from .analysis import concurrency as _conc
 from . import telemetry as _tel
 from .diagnostics import flight as _flight
 from .faults import injection as _faults
@@ -106,7 +107,7 @@ class ThreadedEngine:
         # closures are kept in a table keyed by the ctx token, so no ctypes
         # thunk is ever freed while a native thread may still be inside it.
         self._pending = {}
-        self._pending_lock = threading.Lock()
+        self._pending_lock = _conc.lock("ThreadedEngine", "_pending_lock")
         self._next_token = 0
         self._dispatch_cb = _native.ASYNC_FN(self._dispatch)
         # Drain before interpreter teardown: the native worker threads call
@@ -185,7 +186,7 @@ class ThreadedEngine:
 
 
 _ENGINE = None
-_ENGINE_LOCK = threading.Lock()
+_ENGINE_LOCK = _conc.lock("engine", "_ENGINE_LOCK")
 
 
 def _singleton_queue_depth():

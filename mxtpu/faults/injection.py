@@ -59,6 +59,7 @@ import threading
 import time
 
 from .. import telemetry as _tel
+from ..analysis import concurrency as _conc
 from ..base import MXNetError
 
 __all__ = ["POINTS", "FaultInjected", "InjectedIOError", "FaultKill",
@@ -214,7 +215,7 @@ class FaultSchedule:
     """A set of armed :class:`FaultSpec`\\ s, indexed by point."""
 
     def __init__(self, specs):
-        self._lock = threading.Lock()
+        self._lock = _conc.lock("FaultSchedule", "_lock")
         self._by_point = {}
         for s in specs:
             self._by_point.setdefault(s.point, []).append(s)
@@ -260,6 +261,11 @@ def _fire(spec):
     log.warning("fault injected: %s kind=%s (firing %d)", spec.point,
                 spec.kind, spec.fired)
     if spec.kind == "latency":
+        # declared blocking seam: an injected (or fuzzed) latency that
+        # fires while the crossing thread holds a hierarchy lock is a
+        # blocking-under-lock finding — the schedule fuzzer exists to
+        # surface exactly that
+        _conc.blocking("sleep", "fault latency at %s" % spec.point)
         time.sleep(spec.latency_ms / 1e3)
         return
     raise spec.build_exception()
@@ -270,7 +276,7 @@ def _fire(spec):
 #: on hot paths — one module-global read + None test (the PR-5
 #: sanitizer zero-overhead convention, pinned by tools/bench_faults.py).
 _ACTIVE = None
-_CONF_LOCK = threading.Lock()
+_CONF_LOCK = _conc.lock("injection", "_CONF_LOCK")
 
 
 def point(name):

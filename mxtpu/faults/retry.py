@@ -29,6 +29,7 @@ import time
 import zlib
 
 from .. import telemetry as _tel
+from ..analysis import concurrency as _conc
 from ..base import MXNetError
 
 __all__ = ["RetryPolicy", "TRANSIENT_EXCEPTIONS", "env_attempts"]
@@ -169,6 +170,10 @@ class RetryPolicy:
                     "recovered, retrying now" if handled
                     else "retrying in %.3fs" % delay)
                 if delay > 0:
+                    # declared blocking seam: a retry backoff sleeping
+                    # while the caller holds a hierarchy lock stalls
+                    # every thread behind that lock for the backoff
+                    _conc.blocking("sleep", "retry backoff %s" % self.op)
                     self._sleep(delay)
 
     def wrap(self, fn):

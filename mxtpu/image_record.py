@@ -26,6 +26,7 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as _np
 
 from . import _native
+from .analysis import concurrency as _conc
 from . import io as _io
 from . import ndarray as nd
 from . import recordio as rio
@@ -40,7 +41,7 @@ class _NativePrefetcher:
     def __init__(self, produce, buffer_size):
         self._produce = produce  # () -> object or None at EOF
         self._store = {}
-        self._lock = threading.Lock()
+        self._lock = _conc.lock("_NativePrefetcher", "_lock")
         self._ticket = 0
         self._error = None
         lib = _native.get_lib()

@@ -33,6 +33,7 @@ import numpy as _np
 
 import os as _os
 
+from .analysis import concurrency as _conc
 from .base import MXNetError
 # private aliases: mxtpu.kvstore is a directly-documented module, and a
 # bare RetryPolicy import would duplicate its class doc onto the
@@ -221,7 +222,7 @@ class KVStore:
     # per push and pin dead meshes); guarded by a class lock since
     # pushes can race from several fit threads
     _MESH_SUM_FNS = {}
-    _MESH_SUM_LOCK = _threading.Lock()
+    _MESH_SUM_LOCK = _conc.lock("KVStore", "_MESH_SUM_LOCK")
 
     @staticmethod
     def _mesh_key(mesh):

@@ -37,6 +37,7 @@ import threading
 
 import numpy as _np
 
+from .analysis import concurrency as _conc
 from .base import MXNetError
 
 _HDR = struct.Struct("<Q")
@@ -101,7 +102,7 @@ class KVServer:
         self.versions = {}       # key -> completed update rounds
         self.merge = {}          # key -> [accumulated, n_contributions]
         self.updater = None      # None => merged value is assigned/summed
-        self.cv = threading.Condition()
+        self.cv = _conc.condition(owner="KVServer", attr="cv")
         self.barrier_counts = {}
         self.init_ranks = {}     # key -> lowest rank that initialized it
         self.heartbeats = {}     # rank -> monotonic time of last heartbeat
@@ -350,7 +351,7 @@ class KVClient:
                     raise MXNetError(
                         "cannot reach kvstore server at %s:%s" % (uri, port))
                 time.sleep(0.3)
-        self._lock = threading.Lock()
+        self._lock = _conc.lock("KVClient", "_lock")
         self._barrier_id = 0
         self._push_counts = {}
         self._hb_stop = None
@@ -358,6 +359,11 @@ class KVClient:
 
     def _rpc(self, *msg):
         with self._lock:
+            # declared blocking seam: the socket round trip under
+            # KVClient._lock is ALLOWED_BLOCKING by declaration (the
+            # lock's job is serializing rpcs), so the witness records
+            # nothing here — but would for any OTHER lock held
+            _conc.blocking("http", "kvstore-rpc")
             _send_msg(self._sock, msg)
             resp = _recv_msg(self._sock)
         if resp[0] != "OK":

@@ -190,7 +190,8 @@ class FusedState:
         self.mem_slot = None   # ctx -> ledger slot: params+aux+opt bytes
         # (shared across bucket steps — one FusedState, one accounting
         # entry per device the state is sharded/replicated onto)
-        self._mem_lock = threading.Lock()
+        from ..analysis import concurrency as _conc
+        self._mem_lock = _conc.lock("FusedState", "_mem_lock")
 
     def update_mem_slot(self, devices):
         """(Re)account this state's device bytes in the memory ledger.

@@ -18,6 +18,7 @@ from collections import OrderedDict as _OrderedDict
 
 import numpy as _np
 
+from .analysis import concurrency as _conc
 from .base import MXNetError
 from . import ndarray as nd
 from . import symbol as sym_mod
@@ -217,6 +218,10 @@ class Predictor:
         ``tools/mxtpu_lint.py``); ``jax.device_get`` gathers the whole
         list in a single transfer."""
         import jax
+        # declared blocking seam for the concurrency witness: a bulk
+        # device→host transfer while holding a hierarchy lock stalls
+        # every thread behind that lock for the device round trip
+        _conc.blocking("device_get", "predictor.get_outputs")
         # mxtpu: allow-sync(response materialization — single bulk
         # transfer at the end of the request path)
         return jax.device_get([o._data for o in self._executor.outputs])

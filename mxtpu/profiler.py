@@ -20,11 +20,13 @@ import time
 
 import jax
 
+from .analysis import concurrency as _conc
+
 _state = {"mode": "symbolic", "filename": "profile.json", "running": False,
           "jax_trace": False, "aggregate_stats": False}
 _events = []
 _agg = {}  # name -> telemetry Histogram of span ms (aggregate_stats mode)
-_lock = threading.Lock()
+_lock = _conc.lock("profiler", "_lock")
 
 _OP_MODES = ("symbolic", "imperative", "operator", "all")
 

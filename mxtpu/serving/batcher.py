@@ -21,6 +21,7 @@ import time
 
 import numpy as _np
 
+from ..analysis import concurrency as _conc
 from ..base import MXNetError
 from ..telemetry import current_span as _current_span
 
@@ -163,8 +164,11 @@ class DynamicBatcher:
         self._items = []
         self._pending_rows = 0
         self._last_enqueue = 0.0
-        self._lock = threading.Lock()
-        self._not_empty = threading.Condition(self._lock)
+        # tagged with the CONCRETE class (DynamicBatcher /
+        # ContinuousBatcher) — both are declared at the batcher level;
+        # the condition shares the lock, so it witnesses under one key
+        self._lock = _conc.lock(type(self).__name__, "_lock")
+        self._not_empty = _conc.condition(self._lock)
         self._closed = False
         self._metrics = metrics
         self._last_flush_reason = None

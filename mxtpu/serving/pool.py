@@ -30,6 +30,7 @@ from collections import OrderedDict
 import jax
 
 from .. import diagnostics as _diag
+from ..analysis import concurrency as _conc
 from ..base import MXNetError
 from ..context import Context
 from ..faults import injection as _faults
@@ -92,7 +93,7 @@ class WarmExecutableCache:
     """
 
     def __init__(self, max_versions=None):
-        self._lock = threading.Lock()
+        self._lock = _conc.lock("WarmExecutableCache", "_lock")
         self._versions = OrderedDict()  # (hash, tag) -> entry dict
         self._max_versions = int(max_versions) \
             if max_versions is not None else None
@@ -243,7 +244,7 @@ class _Replica:
                                       token, base, pin=pin)
         self.base = base
         if getattr(base, "_serving_lock", None) is None:
-            base._serving_lock = threading.Lock()
+            base._serving_lock = _conc.lock("_Replica", "lock")
         self.lock = base._serving_lock
         self._record(self.base._executor)
 
@@ -335,7 +336,7 @@ class ExecutorPool:
         # hazard with in-flight rebinds). Stale ids of evicted executors
         # linger harmlessly — a metrics counter tolerates that.
         self._owned_ids = set()
-        self._owned_lock = threading.Lock()
+        self._owned_lock = _conc.lock("ExecutorPool", "_owned_lock")
 
         def _record(ex):
             with self._owned_lock:
@@ -352,7 +353,7 @@ class ExecutorPool:
         self._bucket_costs = self._shared.costs_for(
             self.symbol_hash, version_tag) if self._shared else {}
         self._rr = 0
-        self._rr_lock = threading.Lock()
+        self._rr_lock = _conc.lock("ExecutorPool", "_rr_lock")
 
     def __len__(self):
         return len(self.replicas)

@@ -50,6 +50,7 @@ import numpy as _np
 
 from .. import diagnostics as _diag
 from .. import telemetry as _tel
+from ..analysis import concurrency as _conc
 from ..base import MXNetError, NativeError, NumericsError
 from ..faults import RetryPolicy, env_attempts
 from .admission import (ACCEPTING, AdmissionShed, AdmissionSignals,
@@ -244,7 +245,7 @@ class ServingSession:
         self._admission_state = ACCEPTING
         self._sheds_by_reason = {}
         self._last_shed_reason = None
-        self._swap_lock = threading.Lock()
+        self._swap_lock = _conc.lock("ServingSession", "_swap_lock")
         self._inflight_n = [0] * len(self._pool.replicas)
         self._last_retire_t = [None] * len(self._pool.replicas)
         # per-WORKER per-bucket (count, sum_ms) service aggregates:

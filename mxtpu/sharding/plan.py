@@ -30,6 +30,7 @@ import numpy as _np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
+from ..analysis import concurrency as _conc
 from ..base import MXNetError
 from .spec import SpecLayout, parameter_spec_from_name
 
@@ -146,7 +147,7 @@ class MeshContext:
 
 
 # ----------------------------------------------------------- active mesh
-_active_lock = threading.Lock()
+_active_lock = _conc.lock("plan", "_active_lock")
 # contextvar, not a module global: concurrent fits on different threads
 # must not see each other's mesh (thread B's _arm_fused reading thread
 # A's fit(mesh=...) would silently shard B's module), and interleaved

@@ -31,6 +31,7 @@ import logging
 import os
 import threading
 
+from ..analysis import concurrency as _conc
 from . import registry as _registry
 
 __all__ = ["TunedConfig", "use", "active", "artifact", "SCHEMA"]
@@ -187,7 +188,7 @@ class TunedConfig:
 # ----------------------------------------------------------- active artifact
 _ACTIVE = [None]        # the process-active artifact (or None)
 _ENV_CHECKED = [False]  # MXTPU_TUNED consulted at most once
-_LOCK = threading.Lock()
+_LOCK = _conc.lock("config", "_LOCK")
 
 
 def _refresh_import_time_consumers():

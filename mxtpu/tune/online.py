@@ -32,6 +32,7 @@ import threading
 import time
 from collections import OrderedDict
 
+from ..analysis import concurrency as _conc
 from . import registry as _registry
 
 __all__ = ["OnlineController", "attach_fit", "release", "current"]
@@ -94,7 +95,7 @@ class OnlineController:
         self.artifact = artifact
         self._bound = OrderedDict()    # name -> _Bound
         self._last = {}                # signal-name -> last cumulative val
-        self._lock = threading.Lock()
+        self._lock = _conc.lock("OnlineController", "_lock")
         self._session = None
         self._thread = None
         self._stop = threading.Event()

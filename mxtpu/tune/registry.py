@@ -41,6 +41,8 @@ import os
 import threading
 from collections import OrderedDict
 
+from ..analysis import concurrency as _conc
+
 __all__ = ["Knob", "declare", "get_knob", "knobs", "subsystems",
            "registry_version", "resolve", "resolve_int", "catalog_rows",
            "catalog_table"]
@@ -144,7 +146,7 @@ class Knob:
 
 
 _KNOBS = OrderedDict()
-_LOCK = threading.Lock()
+_LOCK = _conc.lock("registry", "_LOCK")
 
 
 def declare(*args, **kwargs):

@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Benchmark: mxtpu.analysis.concurrency — tracked-lock guard overhead.
+
+Numbers (BENCH_concurrency.json), each on a deterministic basis per the
+PR-2 convention (the 2-core host's wall-clock noise floor is far above
+anything the guard could cost):
+
+* **disarmed guard overhead** — the acceptance bar is < 0.5% of an mlp
+  fit step. The disarmed cost of a tracked lock is one module-global
+  read + ``None`` test + one Python call layer over the raw primitive;
+  the microbench times the ``with lock:`` round trip tight-loop for
+  raw vs tracked, and the per-step cost is ``delta_ns × acquisitions/
+  step`` where acquisitions/step is COUNTED exactly (the armed witness
+  counts every tracked acquisition over one fit epoch — the PR-12
+  exact-crossing basis).
+* **armed overhead** — ns per uncontended tracked acquisition with the
+  witness armed (TLS held-stack + one bookkeeping dict update),
+  recorded honestly: arming is a diagnosis/CI mode, priced accordingly.
+* **blocking guard** — disarmed ns/call of ``concurrency.blocking``
+  (the seams in device_wait / collect / retry sleep).
+
+Usage: python tools/bench_concurrency.py [--out BENCH_concurrency.json]
+"""
+import argparse
+import json
+import logging
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import mxtpu as mx  # noqa: E402
+from mxtpu.analysis import concurrency as conc  # noqa: E402
+from mxtpu.models import mlp as _mlp  # noqa: E402
+
+logging.getLogger("mxtpu").setLevel(logging.CRITICAL)
+
+BATCH = 64
+N = 2048  # 32 batches/epoch
+
+
+def _fit_epoch():
+    rng = np.random.RandomState(0)
+    X = rng.rand(N, 784).astype(np.float32)
+    y = rng.randint(0, 10, N).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=BATCH,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(_mlp.get_symbol(10), context=mx.cpu())
+    t0 = time.perf_counter()
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05})
+    return (time.perf_counter() - t0) * 1e3 / (N // BATCH)
+
+
+def _ns_per_with(lock, iters):
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        with lock:
+            pass
+    return (time.perf_counter() - t0) / iters * 1e9
+
+
+def bench_guard(iters=200_000):
+    conc.disarm()
+    raw = threading.Lock()
+    tracked = conc.lock("DynamicBatcher", "_lock")
+    # interleave to be fair to cache/jit warmup: min of 3 rounds each
+    raw_ns = min(_ns_per_with(raw, iters) for _ in range(3))
+    tracked_ns = min(_ns_per_with(tracked, iters) for _ in range(3))
+    delta_ns = max(0.0, tracked_ns - raw_ns)
+
+    # armed per-acquisition cost (uncontended), honestly priced
+    w = conc.arm()
+    armed_ns = min(_ns_per_with(tracked, iters // 4) for _ in range(3))
+    conc.disarm()
+
+    # blocking-guard disarmed cost
+    blocking = conc.blocking
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        blocking("device_wait")
+    blocking_ns = (time.perf_counter() - t0) / iters * 1e9
+
+    # exact acquisitions/step: the armed witness counts every tracked
+    # acquisition over one epoch
+    w = conc.arm()
+    _fit_epoch()
+    per_key = dict(sorted(w.acq_count.items(), key=lambda kv: -kv[1]))
+    acq_per_step = w.acquisitions / (N // BATCH)
+    conc.disarm()
+
+    step_ms = min(_fit_epoch(), _fit_epoch())
+    off_overhead_us = delta_ns * acq_per_step / 1e3
+    pct = off_overhead_us / (step_ms * 1e3) * 100.0
+    armed_overhead_us = (armed_ns - raw_ns) * acq_per_step / 1e3
+    return {
+        "raw_with_ns": round(raw_ns, 1),
+        "tracked_disarmed_with_ns": round(tracked_ns, 1),
+        "disarmed_delta_ns": round(delta_ns, 1),
+        "tracked_armed_with_ns": round(armed_ns, 1),
+        "blocking_guard_disarmed_ns": round(blocking_ns, 1),
+        "acquisitions_per_step": round(acq_per_step, 2),
+        "acquisitions_by_lock": {"%s.%s" % k: v
+                                 for k, v in per_key.items()},
+        "mlp_step_ms": round(step_ms, 4),
+        "off_overhead_us_per_step": round(off_overhead_us, 3),
+        "off_overhead_pct_of_step": round(pct, 5),
+        "armed_overhead_us_per_step": round(armed_overhead_us, 3),
+        "armed_overhead_pct_of_step": round(
+            armed_overhead_us / (step_ms * 1e3) * 100.0, 4),
+        "target_pct": 0.5,
+        "pass": pct < 0.5,
+        "basis": "microbench delta-ns per `with lock:` (tracked "
+                 "disarmed vs raw) x exactly-counted acquisitions/step "
+                 "(armed witness count over one epoch); wall-clock "
+                 "cannot resolve this under host noise",
+    }
+
+
+def bench_witness_fidelity():
+    """Deterministic sanity block: the armed witness over the serving
+    fixture sees the hierarchy web and stays clean (the bench must not
+    certify a guard whose armed mode is broken)."""
+    from mxtpu.models.serving_fixtures import get_fixture
+    from mxtpu.serving import ServingSession
+    sym, params, shapes = get_fixture("mlp")
+    with conc.scope() as w:
+        with ServingSession(sym, params, shapes, buckets=(1, 4),
+                            max_delay_ms=2,
+                            contexts=[mx.cpu(0)]) as sess:
+            x = np.zeros((1, 784), np.float32)
+            for _ in range(8):
+                sess.predict({"data": x})
+        st = w.state()
+    return {"acquisitions": st["acquisitions"],
+            "tracked_keys": st["tracked_keys"],
+            "edges": st["edges"],
+            "violations": st["violations"],
+            "blocking_under_lock": st["blocking_under_lock"],
+            "acyclic": st["acyclic"],
+            "pass": st["violations"] == 0 and st["acyclic"]}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "..", "BENCH_concurrency.json"))
+    args = ap.parse_args(argv)
+    result = {"guard": bench_guard(),
+              "witness_fidelity": bench_witness_fidelity()}
+    result["pass"] = bool(result["guard"]["pass"]
+                          and result["witness_fidelity"]["pass"])
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result, indent=2))
+    return 0 if result["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

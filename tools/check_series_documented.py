@@ -35,6 +35,10 @@ _CALL_RE = re.compile(
 EXTRA_EMITTED = [
     "executor_cache_misses",   # else-branch of a conditional expression
     "span_ms",                 # emitted via the SPAN_HISTOGRAM constant
+    # concurrency-witness counters emitted through a (name, labels,
+    # help) tuple (analysis/concurrency.py _record_finding)
+    "lock_order_violations",
+    "lock_blocking_under_lock",
 ]
 
 #: names matched by _CALL_RE that are NOT series (or are doc'd as a

@@ -31,6 +31,16 @@ outlives its owner and hangs interpreter shutdown. Pragma::
 
     # mxtpu: allow-thread(reason)
 
+**unregistered-lock** — flags ``threading.Lock()`` / ``RLock()`` /
+``Condition()`` creations ANYWHERE in ``mxtpu/``: every lock must be
+created through the tracked factory
+(``mxtpu.analysis.concurrency.lock/rlock/condition``) so the runtime
+lock-order witness can see it, or carry::
+
+    # mxtpu: allow-raw-lock(reason)
+
+(leaf primitives too hot to wrap, and the witness's own internals).
+
 **swallowed-exception** — flags BROAD exception handlers (bare
 ``except:``, ``except Exception:``, ``except BaseException:``) in the
 declared hot-path modules whose body neither re-raises, counts, nor
@@ -65,36 +75,29 @@ import sys
 ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 # --------------------------------------------------------------- config
+# The declaration layer is SINGLE-SOURCE: mxtpu/analysis/declarations.py
+# holds LOCK_LEVELS and HOT_PATHS, consumed by this AST lint AND the
+# runtime witness (mxtpu.analysis.concurrency), so static and dynamic
+# checking can never drift. Loaded by file path — the lint must run
+# without importing (and jax-initializing) the mxtpu package.
+
+
+def _load_declarations():
+    import importlib.util
+    path = os.path.join(ROOT, "mxtpu", "analysis", "declarations.py")
+    spec = importlib.util.spec_from_file_location(
+        "_mxtpu_lint_declarations", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_DECL = _load_declarations()
+
 #: hot-path modules (relative to the package root). None = the whole
-#: file; a set restricts the sync rule to those classes (metric.py's
-#: numpy fallback path is INTENTIONALLY host-bound; only its device
-#: path is hot).
-HOT_PATHS = {
-    "mxtpu/engine.py": None,
-    "mxtpu/executor.py": None,
-    "mxtpu/compile/pipeline.py": None,
-    "mxtpu/module/fused.py": None,
-    "mxtpu/serving/batcher.py": None,
-    "mxtpu/serving/pool.py": None,
-    "mxtpu/serving/server.py": None,
-    "mxtpu/serving/metrics.py": None,
-    # admission runs on EVERY request's submit path: a host sync in a
-    # signal read would serialize the whole intake behind the device
-    "mxtpu/serving/admission.py": None,
-    "mxtpu/predict.py": None,
-    "mxtpu/metric.py": {"DeviceKernel", "DeviceMetricAccum"},
-    "mxtpu/io.py": {"PrefetchingIter", "DevicePrefetchIter"},
-    # the snapshot CAPTURE path runs on the training thread between
-    # steps: it must enqueue device-side copies, never materialize host
-    # bytes itself (the SnapshotWriter thread carries the one allowed
-    # sync, pragma'd at its materialization site)
-    "mxtpu/elastic/snapshot.py": None,
-    "mxtpu/elastic/state.py": {"ElasticSession"},
-    # the injection guard and the retry loop run inside every other hot
-    # path — they are policed by every rule, including their own
-    "mxtpu/faults/injection.py": None,
-    "mxtpu/faults/retry.py": None,
-}
+#: file; a set restricts the sync rule to those classes. Declared in
+#: mxtpu/analysis/declarations.py.
+HOT_PATHS = _DECL.HOT_PATHS
 
 #: numpy module aliases whose ``asarray``/``array`` calls mean "pull to
 #: host" when fed device arrays
@@ -109,6 +112,10 @@ PRAGMA_SYNC = "mxtpu: allow-sync("
 PRAGMA_THREAD = "mxtpu: allow-thread("
 PRAGMA_F64 = "mxtpu: allow-f64("
 PRAGMA_SWALLOW = "mxtpu: allow-swallow("
+PRAGMA_RAW_LOCK = "mxtpu: allow-raw-lock("
+
+#: threading constructors the unregistered-lock rule polices
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
 
 #: exception names a handler may catch BROADLY without the swallow rule
 #: applying only when trivially handled (see _swallows)
@@ -132,36 +139,9 @@ _NP_DTYPE_POS = {"zeros": 2, "ones": 2, "empty": 2, "full": 3,
 #: Declared lock hierarchy, outermost-first: a thread may acquire locks
 #: only left→right. Keys are (owning class, attr) for ``self.<attr>``
 #: locks and (module basename sans .py, global name) for module-level
-#: locks. Keep this table in sync with docs/analysis.md.
-LOCK_LEVELS = [
-    ("batcher", {("DynamicBatcher", "_lock"),
-                 ("DynamicBatcher", "_not_empty"),
-                 ("ContinuousBatcher", "_lock"),
-                 ("ContinuousBatcher", "_not_empty")}),
-    # continuous-serving control plane (PR 10): the hot-swap flip and
-    # the warm-cache map. Held only for pointer/dict ops — never while
-    # dispatching, so they sit between the batcher and the replica
-    # dispatch locks.
-    ("serving-swap", {("ServingSession", "_swap_lock"),
-                      ("WarmExecutableCache", "_lock")}),
-    ("pool", {("ExecutorPool", "_rr_lock"), ("ExecutorPool", "_owned_lock"),
-              ("_Replica", "lock")}),
-    ("slot-state", {("FusedState", "_mem_lock")}),
-    # elastic writer queue + supervisor flags: PR 8. Held only for queue
-    # and flag ops; telemetry emission happens outside, so they sit
-    # above the registry level. The writer's condition wraps its lock.
-    ("elastic", {("SnapshotWriter", "_cond"), ("SnapshotWriter", "_lock"),
-                 ("Supervisor", "_lock"), ("snapshot", "_WRITER_LOCK")}),
-    ("postmortem", {("diagnostics", "_PM_LOCK")}),
-    ("ledger", {("DeviceMemoryLedger", "_lock")}),
-    ("programs", {("programs", "_LOCK")}),
-    ("telemetry-registry", {("MetricsRegistry", "_lock"),
-                            ("_DefaultRegistry", "_lock")}),
-    # _BUILD_LOCK moved executor.py -> compile/pipeline.py in PR 7 (the
-    # compile-pipeline seam); same level, new owning module
-    ("engine", {("ThreadedEngine", "_pending_lock"),
-                ("pipeline", "_BUILD_LOCK"), ("engine", "_ENGINE_LOCK")}),
-]
+#: locks. Declared in mxtpu/analysis/declarations.py (single source
+#: with the runtime witness); keep docs/analysis.md's prose in sync.
+LOCK_LEVELS = _DECL.LOCK_LEVELS
 
 _LOCK_RANK = {}
 for _rank, (_level, _keys) in enumerate(LOCK_LEVELS):
@@ -214,6 +194,11 @@ class _Linter(ast.NodeVisitor):
         self.hot_scopes = hot_scopes
         self.module_joins = False       # set by visit_Call on a real join
         self.thread_ctors = []          # pending (lineno); judged post-walk
+        # aliases resolved per file by the import visitors below, so the
+        # unregistered-lock rule survives `import threading as _t` and
+        # `from threading import Lock`
+        self.threading_aliases = {"threading", "_threading"}
+        self.bare_lock_ctors = set()    # names bound by from-imports
         self.class_stack = []
         self.lock_stack = []
         self.findings = []
@@ -409,7 +394,47 @@ class _Linter(ast.NodeVisitor):
             return False
         return True
 
+    def visit_Import(self, node):
+        for alias in node.names:
+            if alias.name == "threading":
+                self.threading_aliases.add(alias.asname or "threading")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        if node.module == "threading":
+            for alias in node.names:
+                if alias.name in _LOCK_CTORS:
+                    self.bare_lock_ctors.add(alias.asname or alias.name)
+        self.generic_visit(node)
+
+    def _is_raw_lock_ctor(self, call):
+        """``threading.Lock()`` / ``RLock()`` / ``Condition()`` through
+        any import form this file declares (``import threading as _t``,
+        ``from threading import Lock``) — lock creations the tracked
+        factory cannot see. Factory-made locks never match: the factory
+        calls are ``concurrency.lock(...)`` on an attribute of the
+        analysis package, not a threading constructor."""
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _LOCK_CTORS \
+                and isinstance(fn.value, ast.Name) \
+                and fn.value.id in self.threading_aliases:
+            return fn.attr
+        if isinstance(fn, ast.Name) and fn.id in self.bare_lock_ctors:
+            return fn.id
+        return None
+
     def visit_Call(self, node):
+        ctor = self._is_raw_lock_ctor(node)
+        if ctor and not _has_pragma(self.lines, node.lineno,
+                                    PRAGMA_RAW_LOCK):
+            self.findings.append(LintFinding(
+                "unregistered-lock", self.relpath, node.lineno,
+                "raw threading.%s() — invisible to the runtime lock-"
+                "order witness: create it via mxtpu.analysis."
+                "concurrency.%s (declared in LOCK_LEVELS) or annotate "
+                "'# %sreason)'"
+                % (ctor, ctor.lower() if ctor != "RLock" else "rlock",
+                   PRAGMA_RAW_LOCK)))
         if self._in_hot_scope():
             reason = self._sync_reason(node)
             if reason and not _has_pragma(self.lines, node.lineno,
