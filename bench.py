@@ -361,6 +361,77 @@ def _parse_tuned_arg():
     return os.environ.get("BENCH_TUNED") or None
 
 
+def _bench_pipeline_catalog(batch, iters, has_accel):
+    """Full-transform-catalog companion entry (ISSUE 14): the same fused
+    ResNet-50 step built under the complete compile pipeline
+    (bf16,fuse_opt,layout,remat_reuse). QUEUED for the real-TPU
+    re-measurement — on a CPU-only host it degrades to a note, because
+    XLA:CPU widens bf16 and the layout/remat effects are recorded
+    deterministically in BENCH_transforms.json instead."""
+    catalog = "bf16,fuse_opt,layout,remat_reuse"
+    if not has_accel:
+        return {"pipeline_catalog": {
+            "skipped": "no accelerator: CPU wall-clock says nothing "
+                       "about TPU layout/precision behavior; the "
+                       "deterministic basis lives in "
+                       "BENCH_transforms.json",
+            "pipeline": catalog}}
+    import jax
+    import jax.numpy as jnp
+
+    import mxtpu as mx
+    from mxtpu.compile import pipeline as _pipe
+    from mxtpu.models import resnet
+
+    sym = resnet.get_symbol(num_classes=1000, num_layers=50,
+                            image_shape=(3, 224, 224))
+    ctx = mx.tpu(0)
+    pdata = [mx.io.DataDesc("data", (batch, 3, 224, 224),
+                            dtype="bfloat16")]
+    plabel = [mx.io.DataDesc("softmax_label", (batch,),
+                             dtype="float32")]
+    rng = np.random.RandomState(0)
+    dev = ctx.jax_device
+    data = jax.device_put(
+        jnp.asarray(rng.rand(batch, 3, 224, 224).astype("float32"),
+                    dtype=jnp.bfloat16), dev)
+    label = jax.device_put(
+        jnp.asarray(rng.randint(0, 1000, (batch,)).astype("float32")),
+        dev)
+    batch_obj = mx.io.DataBatch(
+        data=[mx.nd.NDArray(data)], label=[mx.nd.NDArray(label)],
+        pad=0, index=None, provide_data=pdata, provide_label=plabel)
+    opt_params = {"learning_rate": 0.1, "momentum": 0.9,
+                  "rescale_grad": 1.0 / batch}
+    with _pipe.pipeline_scope(catalog.split(",")):
+        mod = mx.mod.Module(sym, context=ctx)
+        mod.bind(data_shapes=pdata, label_shapes=plabel)
+        mod.init_params(mx.initializer.Xavier(
+            rnd_type="gaussian", factor_type="in", magnitude=2.0))
+        mod.init_optimizer(optimizer="sgd", optimizer_params=opt_params)
+        warm = _DeviceBatchIter(batch_obj, 3, pdata, plabel)
+        mod.fit(warm, num_epoch=1, eval_metric=_null_metric(),
+                optimizer="sgd", optimizer_params=opt_params,
+                force_init=False, begin_epoch=0)
+        np.asarray(jax.tree_util.tree_leaves(mod._fused.params)[0])[:1]
+        timed = _DeviceBatchIter(batch_obj, iters, pdata, plabel)
+        t0 = time.perf_counter()
+        mod.fit(timed, num_epoch=1, eval_metric=_null_metric(),
+                optimizer="sgd", optimizer_params=opt_params,
+                force_init=False, begin_epoch=0)
+        np.asarray(jax.tree_util.tree_leaves(mod._fused.params)[0])[:1]
+        dt = time.perf_counter() - t0
+    rep = mod._fused.pipeline_report
+    per_chip = batch * iters / dt
+    return {"pipeline_catalog": {
+        "pipeline": catalog,
+        "applied": list(rep.applied) if rep else [],
+        "rejected": list(rep.rejected) if rep else [],
+        "img_per_sec_per_chip": round(per_chip, 2),
+        "mfu": round(per_chip * FLOPS_PER_IMG / (PEAK_TFLOPS * 1e12),
+                     4)}}
+
+
 def main():
     tuned_path = _parse_tuned_arg()
     status = _wait_for_backend()
@@ -541,6 +612,15 @@ def main():
             if remaining_dp:
                 _signal.alarm(max(int(remaining_dp -
                                       (time.monotonic() - t_dp)), 30))
+    if os.environ.get("BENCH_PIPELINE", "1") != "0":
+        # full-transform-catalog companion entry (ISSUE 14): queued for
+        # the real-TPU re-measurement; same degrade-to-note contract as
+        # recordio/dp — it never sinks the headline measurement
+        try:
+            out.update(_bench_pipeline_catalog(batch, max(8, iters // 4),
+                                               has_accel))
+        except Exception as e:  # noqa: BLE001
+            out["pipeline_catalog_error"] = str(e)[:200]
     print(json.dumps(out))
 
 

@@ -13,14 +13,17 @@ under a static-analysis contract. Six parts:
 * **dataflow analyses** (:mod:`~mxtpu.analysis.dataflow`): lattice
   walks over the Symbol DAG computing per-node fact tables that license
   transforms — :func:`precision_flow` (bf16-safe / f32-island /
-  master-weight classification) and :func:`liveness` (last-use,
-  peak-live-bytes, ledger cross-check).
+  master-weight classification), :func:`liveness` (last-use,
+  peak-live-bytes, ledger cross-check), :func:`conv_layout` (NHWC run
+  discovery + cost decision), :func:`remat_reuse_plan` (recompute-
+  cheap residuals + aliasing pairs), :func:`update_fusion_plan`
+  (dtype/shape parameter classes).
 * **transform passes** (:mod:`~mxtpu.analysis.rewrite`): registered
   :class:`TransformPass` graph rewrites run by the compile pipeline;
   each must be licensed by a dataflow fact and is re-proven by the
   verifier suite before it may compile (a failing rewrite is rejected
-  with the offending Finding). First transform: the ``bf16``
-  mixed-precision rewrite with f32 master weights.
+  with the offending Finding). The catalog — ``layout``, ``bf16``,
+  ``fuse_opt``, ``remat_reuse`` — composes in that canonical order.
 * **numerics sanitizer** (:mod:`~mxtpu.analysis.sanitizer`):
   ``MXTPU_SANITIZE=nan|inf|all`` wraps every built program's outputs in
   device-side NaN/Inf checks (bf16 leaves upcast before the check); a
@@ -61,7 +64,8 @@ __all__ = [
     "analyze", "analyze_json", "check_module",
     "NumericsError", "sanitizer_enable", "sanitizer_disable",
     "sanitizer_mode", "sanitize_tree", "provenance",
-    "dataflow", "precision_flow", "liveness",
+    "dataflow", "precision_flow", "liveness", "conv_layout",
+    "remat_reuse_plan", "update_fusion_plan",
     "rewrite", "TransformPass", "register_transform", "get_transform",
     "list_transforms", "declarations", "concurrency",
 ]
@@ -88,6 +92,9 @@ _LAZY_ATTRS = {
     "sanitize_tree": ("sanitizer", "sanitize_tree"),
     "precision_flow": ("dataflow", "precision_flow"),
     "liveness": ("dataflow", "liveness"),
+    "conv_layout": ("dataflow", "conv_layout"),
+    "remat_reuse_plan": ("dataflow", "remat_reuse_plan"),
+    "update_fusion_plan": ("dataflow", "update_fusion_plan"),
     "TransformPass": ("rewrite", "TransformPass"),
     "register_transform": ("rewrite", "register_transform"),
     "get_transform": ("rewrite", "get_transform"),

@@ -15,7 +15,7 @@ shares — :func:`provenance.infer_walk` driving
 ``symbol._infer_graph(events=)`` — so an analysis can never disagree
 with what a real bind would have inferred.
 
-Two concrete analyses:
+Concrete analyses:
 
 * :func:`precision_flow` — forward classification of every node as
   **bf16-safe** (matmul-heavy compute + elementwise followers),
@@ -29,6 +29,17 @@ Two concrete analyses:
   tracks the live set per node and estimates **peak live bytes**; the
   graph-level analogue of the diagnostics ledger's slot model, and
   cross-checkable against it (:func:`liveness_ledger_check`).
+* :func:`conv_layout` — run discovery over conv/pool/BN stacks for the
+  ``layout`` transform: which maximal regions could compute NHWC, and
+  whether the modeled interior savings beat the boundary conversions
+  (the TVM layout-transform cost decision, made per graph).
+* :func:`remat_reuse_plan` — spends :func:`liveness`: which residual
+  entries are cheap enough (recompute-flops per byte) to re-derive in
+  backward instead of holding, and which dead entries alias a later
+  same-shape/dtype allocation (buffer-reuse hints).
+* :func:`update_fusion_plan` — groups trainable parameters into
+  dtype/shape classes so the fused train step can collapse per-parameter
+  optimizer-update chains into one batched region per class.
 """
 from __future__ import annotations
 
@@ -40,6 +51,9 @@ from . import provenance as _prov
 __all__ = ["DataflowAnalysis", "run_analysis", "precision_flow",
            "PrecisionPlan", "liveness", "LivenessInfo",
            "liveness_ledger_check",
+           "conv_layout", "LayoutPlan",
+           "remat_reuse_plan", "RematReusePlan", "recompute_flops",
+           "update_fusion_plan", "UpdateFusionPlan",
            "BF16_SAFE", "F32_ISLAND", "MASTER_WEIGHT"]
 
 
@@ -339,6 +353,10 @@ def liveness(symbol, shapes=None, types=None):
     topo = symbol._topo()
     index = {id(n): i for i, n in enumerate(topo)}
     info = LivenessInfo()
+    # stash the walk maps so consumers that need shapes on top of
+    # liveness (remat_reuse_plan runs on every pipeline build) don't
+    # pay a second full-graph inference walk
+    info._shp, info._dt = shp, dt
     n = len(topo)
 
     def nbytes(entry):
@@ -386,6 +404,535 @@ def liveness(symbol, shapes=None, types=None):
             live -= info.entry_bytes[e]
         info.live_bytes.append(live)
     return info
+
+
+# ------------------------------------------------------------- conv layout
+#: windowed spatial ops the NHWC retarget pays off for: the modeled
+#: native-layout wrap (input+output transpose per op when fed NCHW) is
+#: what the rewrite saves on the run interior
+_LAYOUT_CORE = {"Convolution", "Pooling"}
+#: layout-aware ops the rewrite retargets via an axis attribute (no wrap
+#: benefit of their own; they ride the run)
+_LAYOUT_AWARE = {"BatchNorm", "BatchNorm_v1"}
+#: shape-polymorphic elementwise ops that compute identically in either
+#: layout as long as every tensor input shares it (no channel-indexed
+#: broadcast: broadcast_* / per-channel prelu are deliberately absent)
+_LAYOUT_FLEX = {"Activation", "Dropout", "Cast", "negative", "_copy",
+                "relu", "sigmoid", "tanh", "abs",
+                "_plus", "elemwise_add", "_minus", "elemwise_sub",
+                "_mul", "elemwise_mul", "_div", "elemwise_div",
+                "_maximum", "_minimum",
+                "_plus_scalar", "_minus_scalar", "_rminus_scalar",
+                "_mul_scalar", "_div_scalar", "_rdiv_scalar",
+                "_maximum_scalar", "_minimum_scalar", "clip"}
+
+
+class LayoutPlan:
+    """Result of :func:`conv_layout`.
+
+    ``runs`` is a list of dicts, one per discovered conv/pool region:
+    ``nodes`` (member ids), ``core`` (conv/pool member names),
+    ``benefit_bytes`` (modeled native-layout wrap movement the interior
+    saves), ``boundary_bytes`` (movement of the converts the rewrite
+    would interpose at the region boundary), ``applied`` (benefit beats
+    boundary AND every boundary shape resolved), plus informational
+    ``entry_edges`` (``(consumer id, slot)`` pairs) / ``exit_entries``
+    (``(producer id, out_idx, bytes)``) recording which boundary edges
+    the cost model charged — the rewrite derives the actual convert
+    sites from membership + ``data_slots``, these lists are for
+    reports/tests. ``node_run`` maps member ``id(node)`` → run index;
+    ``data_slots`` maps member id → the input slots that carry the
+    feature map (the only edges converted)."""
+
+    def __init__(self, symbol):
+        self.symbol = symbol
+        self.runs = []
+        self.node_run = {}
+        self.data_slots = {}
+        self._shp = None   # inference-walk shapes, stashed by conv_layout
+
+    @property
+    def n_applied(self):
+        return sum(1 for r in self.runs if r["applied"])
+
+    def applied_members(self):
+        """id(node) → run dict, for members of APPLIED runs only."""
+        out = {}
+        for r in self.runs:
+            if r["applied"]:
+                for nid in r["nodes"]:
+                    out[nid] = r
+        return out
+
+    def summary(self):
+        return ("conv_layout: %d run(s), %d applied; benefit %d KB vs "
+                "boundary %d KB over applied runs"
+                % (len(self.runs), self.n_applied,
+                   sum(r["benefit_bytes"] for r in self.runs
+                       if r["applied"]) // 1024,
+                   sum(r["boundary_bytes"] for r in self.runs
+                       if r["applied"]) // 1024))
+
+    def to_findings(self, pass_name="conv_layout"):
+        out = []
+        for i, r in enumerate(self.runs):
+            out.append(Finding(
+                pass_name, INFO,
+                "run %d (%d node(s), core: %s): interior wrap savings "
+                "%.1f KB vs boundary converts %.1f KB — %s"
+                % (i, len(r["nodes"]), ", ".join(r["core"]),
+                   r["benefit_bytes"] / 1024.0,
+                   r["boundary_bytes"] / 1024.0,
+                   "NHWC applied" if r["applied"] else
+                   "kept NCHW (%s)" % r["reason"]),
+                node=r["core"][0] if r["core"] else None))
+        return out
+
+
+def _shape_bytes(shape, dtype):
+    if shape is None:
+        return 0
+    total = int(_np.dtype(dtype or _np.dtype("float32")).itemsize)
+    for d in shape:
+        total *= int(d)
+    return total
+
+
+def conv_layout(symbol, shapes=None, types=None):
+    """Discover maximal conv/pool/BN regions that could compute NHWC and
+    decide, per region, whether the modeled interior savings beat the
+    boundary conversions (TVM's layout-transform rewrite, decided per
+    graph). Returns a :class:`LayoutPlan` the ``layout`` transform is
+    licensed by.
+
+    Cost model (deterministic, platform-independent): a windowed spatial
+    op fed its non-native layout pays an input and an output transpose
+    in the backend (movement ``2*(in+out)`` bytes, read+write); ops
+    inside a common-layout region pay only the region-boundary converts
+    (``2*bytes`` per converted edge). A region applies when the summed
+    interior wrap movement strictly beats the boundary movement."""
+    shp, dt, _ev = _prov.infer_walk(symbol, shapes, types)
+    topo = symbol._topo()
+    plan = LayoutPlan(symbol)
+    # stash the walk so apply_layout_plan (always run right after, on
+    # every pipeline build) doesn't pay a second full-graph inference
+    plan._shp = shp
+
+    def eshape(node, idx=0):
+        return shp.get((id(node), idx))
+
+    def ebytes(node, idx=0):
+        return _shape_bytes(shp.get((id(node), idx)),
+                            dt.get((id(node), idx)))
+
+    def rank4(node, idx=0):
+        s = eshape(node, idx)
+        return s is not None and len(s) == 4
+
+    # -------------------------------------------------- eligibility
+    kind = {}
+    for node in topo:
+        if node.is_variable:
+            continue
+        op = node.op.name
+        try:
+            a = node.parsed_attrs()
+        except Exception:
+            # mxtpu: allow-swallow(a node whose attrs do not parse is
+            # simply ineligible for the layout run — the verifier's
+            # shape_infer pass owns reporting the real error)
+            continue
+        if op in ("Convolution", "Convolution_v1"):
+            if (len(tuple(a.kernel)) == 2 and int(a.num_group) == 1
+                    and (a.get("layout") in (None, "NCHW"))
+                    and rank4(node) and node.inputs
+                    and rank4(*node.inputs[0])):
+                kind[id(node)] = "core"
+                plan.data_slots[id(node)] = (0,)
+        elif op in ("Pooling", "Pooling_v1"):
+            if ((a.get("layout") in (None, "NCHW"))
+                    and rank4(node) and node.inputs
+                    and rank4(*node.inputs[0])):
+                kind[id(node)] = "core"
+                plan.data_slots[id(node)] = (0,)
+        elif op in _LAYOUT_AWARE:
+            if (int(a.get("axis", 1)) == 1 and not a.output_mean_var
+                    and rank4(node) and node.inputs
+                    and rank4(*node.inputs[0])):
+                kind[id(node)] = "aware"
+                plan.data_slots[id(node)] = (0,)
+        elif op in _LAYOUT_FLEX:
+            out_s = eshape(node)
+            if out_s is None or len(out_s) != 4:
+                continue
+            ok = all(eshape(s, i) == out_s for s, i in node.inputs)
+            if ok:
+                kind[id(node)] = "flex"
+                plan.data_slots[id(node)] = tuple(
+                    range(len(node.inputs)))
+
+    # -------------------------------------------------- union runs
+    parent = {nid: nid for nid in kind}
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for node in topo:
+        if id(node) not in kind:
+            continue
+        for slot in plan.data_slots[id(node)]:
+            src, _idx = node.inputs[slot]
+            if id(src) in kind:
+                ra, rb = find(id(node)), find(id(src))
+                if ra != rb:
+                    parent[ra] = rb
+    comps = {}
+    node_of = {id(n): n for n in topo}
+    for nid in kind:
+        comps.setdefault(find(nid), set()).add(nid)
+
+    # consumers per entry, for exit detection
+    consumers = {}
+    for n in topo:
+        for i, (s, idx) in enumerate(n.inputs):
+            consumers.setdefault((id(s), idx), []).append((n, i))
+    head_entries = {(id(n), i) for n, i in symbol._outputs}
+
+    order = {id(n): i for i, n in enumerate(topo)}
+    for members in sorted(comps.values(),
+                          key=lambda ms: min(order[m] for m in ms)):
+        members = sorted(members, key=order.get)
+        core = [node_of[nid].name for nid in members
+                if kind[nid] == "core"]
+        if not core:
+            continue
+        mset = set(members)
+        entry_edges = []     # (consumer id, slot) — informational
+        entry_cost_seen = set()
+        exit_entries = []    # (producer id, out_idx, bytes)
+        benefit = 0
+        boundary = 0
+        complete = True
+        for nid in members:
+            node = node_of[nid]
+            if kind[nid] == "core":
+                b_in = ebytes(*node.inputs[0])
+                b_out = ebytes(node)
+                if not b_in or not b_out:
+                    complete = False
+                benefit += 2 * (b_in + b_out)
+            for slot in plan.data_slots[nid]:
+                src, idx = node.inputs[slot]
+                if id(src) in mset:
+                    continue
+                entry_edges.append((nid, slot))
+                if (id(src), idx) not in entry_cost_seen:
+                    entry_cost_seen.add((id(src), idx))
+                    b = _shape_bytes(shp.get((id(src), idx)),
+                                     dt.get((id(src), idx)))
+                    if not b:
+                        complete = False
+                    boundary += 2 * b
+            outs = node.num_outputs()
+            for k in range(outs):
+                if not rank4(node, k):
+                    continue   # per-channel outputs are layout-free
+                escapes = (id(node), k) in head_entries or any(
+                    id(c) not in mset
+                    for c, _ in consumers.get((id(node), k), ()))
+                if escapes:
+                    b = ebytes(node, k)
+                    if not b:
+                        complete = False
+                    exit_entries.append((nid, k, b))
+                    boundary += 2 * b
+        applied = complete and benefit > boundary
+        reason = ("boundary cost >= interior savings" if complete
+                  else "unresolved boundary shape")
+        run = {"nodes": mset, "core": core,
+               "benefit_bytes": benefit, "boundary_bytes": boundary,
+               "entry_edges": entry_edges, "exit_entries": exit_entries,
+               "applied": applied, "reason": None if applied else reason}
+        for nid in members:
+            plan.node_run[nid] = len(plan.runs)
+        plan.runs.append(run)
+    return plan
+
+
+# ------------------------------------------------------- recompute / remat
+def _prod(xs):
+    p = 1
+    for x in xs:
+        p *= int(x)
+    return p
+
+
+def recompute_flops(node, shp):
+    """Static flop estimate for recomputing ``node``'s visible outputs
+    (backward-remat cost ranking — relative order matters, absolute
+    truth does not). Returns None when the shapes did not resolve."""
+    out_s = shp.get((id(node), 0))
+    if out_s is None or node.is_variable:
+        return None
+    n = _prod(out_s)
+    op = node.op.name
+    try:
+        a = node.parsed_attrs()
+    except Exception:
+        # mxtpu: allow-swallow(an unparseable node simply has no flop
+        # estimate — the analysis degrades to "not a remat candidate",
+        # exactly like an unresolved shape)
+        return None
+    if op in ("Convolution", "Convolution_v1", "Deconvolution"):
+        in_s = shp.get((id(node.inputs[0][0]), node.inputs[0][1]))
+        if in_s is None or len(in_s) < 3:
+            return None
+        cin = in_s[3] if a.get("layout") == "NHWC" else in_s[1]
+        return 2.0 * n * _prod(a.kernel) * cin / max(int(a.num_group), 1)
+    if op == "FullyConnected":
+        in_s = shp.get((id(node.inputs[0][0]), node.inputs[0][1]))
+        if in_s is None:
+            return None
+        k = in_s[-1] if not a.get("flatten", True) else _prod(in_s[1:])
+        return 2.0 * n * k
+    if op in ("dot", "batch_dot"):
+        in_s = shp.get((id(node.inputs[0][0]), node.inputs[0][1]))
+        return 2.0 * n * (in_s[-1] if in_s else 1)
+    if op in ("Pooling", "Pooling_v1"):
+        kernel = tuple(a.kernel) if a.kernel else ()
+        return float(n) * (_prod(kernel) if kernel else 1)
+    if op in _F32_NORMS | {"softmax", "Softmax", "log_softmax",
+                           "SoftmaxActivation", "LayerNorm"}:
+        return 8.0 * n
+    if op in _F32_EXPLOG | _F32_MISC:
+        return 4.0 * n
+    # elementwise / shape ops: about one flop (or less) per element
+    return float(n)
+
+
+class RematReusePlan:
+    """Result of :func:`remat_reuse_plan`.
+
+    ``remat`` — node ids whose visible outputs the backward should
+    RECOMPUTE instead of holding as residuals (recompute-flops per byte
+    at or under ``threshold``); ``reuse_pairs`` — ``(dead, newborn)``
+    entry pairs where the dead entry's storage can serve the newborn
+    same-shape/dtype allocation (buffer-reuse/aliasing hints);
+    ``residual_peak_before/after`` — peak live bytes of the liveness
+    walk under the training-residency model (op entries persist to the
+    end of the forward as backward residuals; remat-annotated entries
+    die at their forward last use instead)."""
+
+    def __init__(self, symbol, threshold):
+        self.symbol = symbol
+        self.threshold = float(threshold)
+        self.remat = set()          # node ids
+        self.remat_names = []
+        self.remat_bytes = 0
+        self.remat_flops = 0.0
+        self.reuse_pairs = []       # (dead_name, newborn_name, bytes)
+        self.reuse_bytes = 0
+        self.residual_peak_before = 0
+        self.residual_peak_after = 0
+        self.complete = True
+
+    @property
+    def peak_cut_pct(self):
+        if not self.residual_peak_before:
+            return 0.0
+        return round(100.0 * (self.residual_peak_before
+                              - self.residual_peak_after)
+                     / self.residual_peak_before, 2)
+
+    def summary(self):
+        return ("remat_reuse: %d node(s) annotated for recompute "
+                "(%.1f KB residuals dropped for %.0f flop/byte <= %.2f), "
+                "%d reuse pair(s) (%.1f KB); residual peak %.1f -> %.1f "
+                "KB (-%.1f%%)"
+                % (len(self.remat), self.remat_bytes / 1024.0,
+                   self.remat_flops / max(self.remat_bytes, 1),
+                   self.threshold, len(self.reuse_pairs),
+                   self.reuse_bytes / 1024.0,
+                   self.residual_peak_before / 1024.0,
+                   self.residual_peak_after / 1024.0,
+                   self.peak_cut_pct))
+
+
+def remat_reuse_plan(symbol, shapes=None, types=None, threshold=4.0):
+    """Spend the liveness analysis: rank every op node's residual by
+    recompute-flops per byte and annotate the cheap ones for backward
+    recompute; pair dead entries with later same-shape/dtype births as
+    buffer-reuse hints. Returns a :class:`RematReusePlan` the
+    ``remat_reuse`` transform is licensed by."""
+    info = liveness(symbol, shapes=shapes, types=types)
+    shp, dt = info._shp, info._dt   # liveness already ran the walk
+    topo = symbol._topo()
+    n = len(topo)
+    plan = RematReusePlan(symbol, threshold)
+    plan.complete = info.complete
+    head_nodes = {id(node) for node, _ in symbol._outputs}
+
+    vis_entries = {}   # id(node) -> [(entry, bytes)] visible outputs
+    for node in topo:
+        if node.is_variable:
+            continue
+        n_vis = node.op.n_out(node.parsed_attrs())
+        vis_entries[id(node)] = [
+            ((id(node), k), info.entry_bytes.get((id(node), k), 0))
+            for k in range(n_vis)]
+
+    # ---- remat candidates: cheap-to-recompute residuals
+    for node in topo:
+        if node.is_variable or id(node) in head_nodes:
+            continue
+        ebs = vis_entries[id(node)]
+        total = sum(b for _, b in ebs)
+        if total <= 0:
+            continue
+        fl = recompute_flops(node, shp)
+        if fl is None:
+            continue
+        if fl / total <= plan.threshold:
+            plan.remat.add(id(node))
+            plan.remat_names.append(node.name)
+            plan.remat_bytes += total
+            plan.remat_flops += fl
+
+    # ---- residual-model peak: op entries persist to end-of-forward
+    # (they are backward's residuals) unless remat-annotated
+    node_by_id = {id(t): t for t in topo}
+
+    def residual_peak(remat):
+        live = 0
+        peak = 0
+        expiring = {}
+        for e, last in info.last_use.items():
+            nid = e[0]
+            node = node_by_id.get(nid)
+            horizon = last
+            if node is not None and not node.is_variable \
+                    and nid not in remat:
+                horizon = n
+            expiring.setdefault(horizon, []).append(e)
+        for i, node in enumerate(topo):
+            outs = 1 if node.is_variable else node.num_outputs()
+            for k in range(outs):
+                live += info.entry_bytes.get((id(node), k), 0)
+            if live > peak:
+                peak = live
+            for e in expiring.get(i, ()):
+                live -= info.entry_bytes.get(e, 0)
+        return peak
+
+    plan.residual_peak_before = residual_peak(set())
+    plan.residual_peak_after = residual_peak(plan.remat)
+
+    # ---- buffer-reuse hints: dead entry -> later same-shape/dtype birth
+    born = info._born
+    pool = {}   # (shape, dtype) -> [(death_index, entry)]
+    names = {}
+    for node in topo:
+        outs = 1 if node.is_variable else node.num_outputs()
+        for k in range(outs):
+            names[(id(node), k)] = node.name if k == 0 \
+                else "%s[%d]" % (node.name, k)
+    for i, node in enumerate(topo):
+        if node.is_variable:
+            continue
+        for e, b in vis_entries[id(node)]:
+            if b <= 0:
+                continue
+            key = (shp.get(e), str(dt.get(e)))
+            # claim an already-dead same-class buffer for this birth
+            cands = pool.get(key)
+            claimed = None
+            if cands:
+                for j, (death, dead_e) in enumerate(cands):
+                    if death < born[e]:
+                        claimed = cands.pop(j)
+                        break
+            if claimed is not None:
+                plan.reuse_pairs.append(
+                    (names[claimed[1]], names[e], b))
+                plan.reuse_bytes += b
+            last = info.last_use.get(e, born[e])
+            if last < n:   # heads never die; they can't donate
+                pool.setdefault(key, []).append((last, e))
+    return plan
+
+
+# -------------------------------------------------- optimizer update fusion
+class UpdateFusionPlan:
+    """Result of :func:`update_fusion_plan`: trainable parameters grouped
+    into (dtype, shape) classes with at least two members — the classes
+    whose per-parameter optimizer-update chains the fused train step can
+    collapse into one batched region each."""
+
+    def __init__(self, symbol):
+        self.symbol = symbol
+        self.classes = {}    # "f32:128x128" -> [param names]
+        self.n_params = 0
+
+    @property
+    def n_fused(self):
+        return sum(len(v) for v in self.classes.values())
+
+    def summary(self):
+        return ("update_fusion: %d of %d parameter(s) in %d batched "
+                "class(es): %s"
+                % (self.n_fused, self.n_params, len(self.classes),
+                   "; ".join("%s×%d" % (k, len(v))
+                             for k, v in self.classes.items()) or "-"))
+
+
+def class_key(shape, dtype):
+    """Canonical dtype/shape class label (the ``__update_class__``
+    annotation value): e.g. ``"float32:128x64"``."""
+    return "%s:%s" % (_np.dtype(dtype or "float32").name,
+                      "x".join(str(int(d)) for d in shape))
+
+
+def update_fusion_plan(symbol, shapes=None, types=None, trainable=None,
+                       max_member_bytes=32768):
+    """Group parameter variables by (dtype, shape) class; classes with
+    ≥2 members are batchable by the fused step's optimizer update.
+    ``trainable`` (names) restricts the grouping; without it every
+    non-aux variable with a resolved shape is considered — consumers
+    intersect with their own trainable set before acting.
+
+    ``max_member_bytes`` bounds the class to SMALL parameters (biases,
+    BN scales, per-channel vectors): their per-parameter update chains
+    are launch-overhead-bound — each is a tiny kernel whose fixed cost
+    dominates — so batching k of them into one region is a pure win,
+    while the stack/unstack a batched region needs is real data
+    movement that a bandwidth-bound weight-matrix chain would only pay
+    for (measured: stacking the 128×128 weight class GREW bytes-accessed
+    44% on the host AOT row). The threshold is a declared knob
+    (``compile.fuse_opt_max_kb``) so the PR-11 search can move it."""
+    shp, dt, _ev = _prov.infer_walk(symbol, shapes, types)
+    aux = symbol._aux_node_set()
+    plan = UpdateFusionPlan(symbol)
+    tset = set(trainable) if trainable is not None else None
+    groups = {}
+    for node in symbol._topo():
+        if not node.is_variable or id(node) in aux:
+            continue
+        if tset is not None and node.name not in tset:
+            continue
+        s = shp.get(node.name)
+        if s is None or not len(s):
+            continue
+        plan.n_params += 1
+        if max_member_bytes is not None \
+                and _shape_bytes(s, dt.get(node.name)) > max_member_bytes:
+            continue
+        groups.setdefault(class_key(s, dt.get(node.name)),
+                          []).append(node.name)
+    plan.classes = {k: v for k, v in groups.items() if len(v) >= 2}
+    return plan
 
 
 def liveness_ledger_check(executor):

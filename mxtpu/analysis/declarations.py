@@ -194,4 +194,9 @@ HOT_PATHS = {
     # the tracked-lock layer wraps every hierarchy acquisition — same
     # policing logic as the faults guard
     "mxtpu/analysis/concurrency.py": None,
+    # the transform catalog + its licensing analyses run inside every
+    # program build (the compile-pipeline seam is already hot-listed);
+    # a host sync or f64 promotion here lands in every bind/fit
+    "mxtpu/analysis/rewrite.py": None,
+    "mxtpu/analysis/dataflow.py": None,
 }

@@ -11,16 +11,34 @@ is REJECTED with the offending Finding and the build falls back to the
 unrewritten graph. A transform can therefore never ship a graph the
 checker would refuse.
 
-First registered transform: ``bf16`` — the mixed-precision rewrite.
-Matmul-class compute and its elementwise followers run in bf16 (Cast
-nodes inserted at the class boundaries the precision-flow analysis
-computed); dtype-sensitive islands (softmax/exp/log, reductions, loss
-heads, normalization statistics) stay f32; parameters keep f32 master
-storage and are cast at their use sites, so the fused step's optimizer
-update always reads f32 weights and f32 gradients (the vjp of a
-``convert_element_type`` casts the cotangent back up). Graph outputs are
-cast back to their original dtype, so callers — metrics, serving, the
-sanitizer — observe the same output contract as the f32 program.
+The registered catalog (canonical composition order —
+:data:`CANONICAL_ORDER` — is how the pipeline sequences them however
+the operator lists them):
+
+* ``layout`` — data-layout selection for conv stacks: the
+  :func:`~mxtpu.analysis.dataflow.conv_layout` analysis finds maximal
+  conv/pool/BN regions and the rewrite retargets a region to NHWC
+  (conv/pool ``layout`` attr, BatchNorm ``axis``) with transpose nodes
+  interposed at the region boundary — only where the modeled interior
+  savings beat the boundary conversions (TVM's layout-transform
+  rewrite, decided per graph). Weights keep their OIHW storage.
+* ``bf16`` — the mixed-precision rewrite. Matmul-class compute and its
+  elementwise followers run in bf16 (Cast nodes inserted at the class
+  boundaries the precision-flow analysis computed); dtype-sensitive
+  islands stay f32; parameters keep f32 master storage and are cast at
+  their use sites; graph outputs are cast back to their original dtype.
+* ``fuse_opt`` — optimizer-update fusion: the
+  :func:`~mxtpu.analysis.dataflow.update_fusion_plan` analysis groups
+  trainable parameters into dtype/shape classes and the rewrite stamps
+  ``__update_class__`` on each groupable parameter; the fused train
+  step collapses every annotated class's per-parameter
+  grad→update→assign chains into ONE batched update region.
+* ``remat_reuse`` — spends the liveness analysis: stamps ``__remat__``
+  on nodes whose residuals are cheap to recompute
+  (:func:`~mxtpu.analysis.dataflow.remat_reuse_plan`), which the fused
+  step turns into a jax.checkpoint drop-these-names policy, and
+  records buffer-reuse (aliasing) hints for dead-before-birth
+  same-shape/dtype entry pairs.
 """
 from __future__ import annotations
 
@@ -33,7 +51,16 @@ from . import provenance as _prov
 
 __all__ = ["TransformPass", "TransformContext", "register_transform",
            "get_transform", "list_transforms", "Bf16MixedPrecisionPass",
-           "apply_precision_plan"]
+           "ConvLayoutPass", "OptimizerUpdateFusionPass",
+           "RematReusePass", "apply_precision_plan", "apply_layout_plan",
+           "CANONICAL_ORDER"]
+
+#: The canonical composition order. ``layout`` must see the conv runs
+#: before bf16's Casts could split them; ``bf16`` classifies the
+#: layout-retargeted graph (transposes follow their producers);
+#: ``fuse_opt`` and ``remat_reuse`` only annotate, but ``remat_reuse``
+#: runs last so its liveness walk sees the final node set.
+CANONICAL_ORDER = ("layout", "bf16", "fuse_opt", "remat_reuse")
 
 _TRANSFORMS = {}
 
@@ -235,3 +262,234 @@ class Bf16MixedPrecisionPass(TransformPass):
             tctx, "%s; %d master-weight parameter(s) stay f32 in the "
             "fused state" % (plan.summary(), plan.n_master))
         return new_sym
+
+
+# ------------------------------------------------------ annotation clones
+def _annotate_clone(symbol, node_extra=None, var_extra=None):
+    """Clone ``symbol`` with extra attrs stamped on selected nodes.
+    ``node_extra``/``var_extra`` map ``id(original node)`` → attr dict.
+    Un-annotated variables stay SHARED with the original graph (same
+    contract as the bf16 rewrite: no new arguments, bind dicts and
+    checkpoints unchanged); annotated variables and all op nodes are
+    cloned, so the original graph — the pipeline's fallback — is never
+    mutated."""
+    from ..symbol.symbol import Symbol, _Node
+    node_extra = node_extra or {}
+    var_extra = var_extra or {}
+    mapping = {}
+    for node in symbol._topo():
+        if node.is_variable:
+            extra = var_extra.get(id(node))
+            if extra:
+                clone = _Node(None, node.name, {}, [])
+                clone._extra_attrs = dict(node._extra_attrs)
+                clone._extra_attrs.update(extra)
+                mapping[id(node)] = clone
+            else:
+                mapping[id(node)] = node
+            continue
+        new_inputs = [(mapping[id(s)], i) for s, i in node.inputs]
+        clone = _Node(node.op, node.name, dict(node.attrs), new_inputs)
+        clone._extra_attrs = dict(node._extra_attrs)
+        extra = node_extra.get(id(node))
+        if extra:
+            clone._extra_attrs.update(extra)
+        mapping[id(node)] = clone
+    return Symbol([(mapping[id(n)], i) for n, i in symbol._outputs])
+
+
+# --------------------------------------------------------- layout rewrite
+def apply_layout_plan(symbol, plan, shapes=None, types=None):
+    """Clone ``symbol`` realizing ``plan`` (a
+    :class:`~mxtpu.analysis.dataflow.LayoutPlan`): every member of an
+    APPLIED run is retargeted to channels-last (conv/pool ``layout``
+    attr, BatchNorm ``axis=3``) and transpose nodes are interposed at
+    exactly the run-boundary edges the plan costed. Parameters are
+    untouched — conv weights keep OIHW storage and per-channel vectors
+    are layout-free — so the rewrite adds no arguments and changes no
+    parameter shapes."""
+    from ..ops.registry import get_op
+    from ..symbol.symbol import Symbol, _Node
+    t_op = get_op("transpose")
+    members = plan.applied_members()
+    # conv_layout stashed its inference walk on the plan — reuse it
+    # (the rewrite runs right after the analysis on every pipeline
+    # build; a second full-graph walk here doubled the pass cost)
+    shp = plan._shp if getattr(plan, "_shp", None) is not None \
+        else _prov.infer_walk(symbol, shapes, types)[0]
+    mapping = {}
+    converts = {}
+
+    def convert(entry_new, orig, idx, to):
+        key = (id(orig), idx, to)
+        hit = converts.get(key)
+        if hit is not None:
+            return hit
+        base = orig.name if idx == 0 else "%s_o%d" % (orig.name, idx)
+        axes = (0, 2, 3, 1) if to == "nhwc" else (0, 3, 1, 2)
+        node = _Node(t_op, "%s_%s" % (base, to), {"axes": axes},
+                     [(entry_new, idx)])
+        converts[key] = node
+        return node
+
+    def produces_nhwc(src, idx):
+        if src.is_variable or id(src) not in members:
+            return False
+        s = shp.get((id(src), idx))
+        return s is not None and len(s) == 4
+
+    for node in symbol._topo():
+        if node.is_variable:
+            mapping[id(node)] = node
+            continue
+        member = id(node) in members
+        data_slots = set(plan.data_slots.get(id(node), ())) \
+            if member else ()
+        new_inputs = []
+        for i, (src, idx) in enumerate(node.inputs):
+            nsrc = mapping[id(src)]
+            if member and i in data_slots and not produces_nhwc(src, idx):
+                new_inputs.append((convert(nsrc, src, idx, "nhwc"), 0))
+            elif not (member and i in data_slots) \
+                    and produces_nhwc(src, idx):
+                new_inputs.append((convert(nsrc, src, idx, "nchw"), 0))
+            else:
+                new_inputs.append((nsrc, idx))
+        attrs = dict(node.attrs)
+        if member:
+            op = node.op.name
+            if op in ("Convolution", "Convolution_v1",
+                      "Pooling", "Pooling_v1"):
+                attrs["layout"] = "NHWC"
+            elif op in ("BatchNorm", "BatchNorm_v1"):
+                attrs["axis"] = 3
+        clone = _Node(node.op, node.name, attrs, new_inputs)
+        clone._extra_attrs = dict(node._extra_attrs)
+        mapping[id(node)] = clone
+    heads = []
+    for node, idx in symbol._outputs:
+        nnode = mapping[id(node)]
+        if produces_nhwc(node, idx):
+            heads.append((convert(nnode, node, idx, "nchw"), 0))
+        else:
+            heads.append((nnode, idx))
+    return Symbol(heads)
+
+
+@register_transform
+class ConvLayoutPass(TransformPass):
+    """Data-layout selection for conv stacks: retarget conv/pool/BN runs
+    to NHWC with boundary transposes, only where the conv_layout cost
+    model says the interior savings beat the conversions."""
+
+    name = "layout"
+
+    def run(self, tctx):
+        plan = _df.conv_layout(tctx.symbol, shapes=tctx.shapes,
+                               types=tctx.types)
+        tctx.actions.extend(plan.to_findings(pass_name=self.name))
+        if plan.n_applied == 0:
+            self.action(tctx, "%s — rewrite skipped" % plan.summary())
+            return None
+        new_sym = apply_layout_plan(tctx.symbol, plan,
+                                    shapes=tctx.shapes, types=tctx.types)
+        self.action(tctx, plan.summary())
+        return new_sym
+
+
+# ------------------------------------------------- optimizer-update fusion
+@register_transform
+class OptimizerUpdateFusionPass(TransformPass):
+    """Optimizer-update fusion: stamp ``__update_class__`` on trainable
+    parameters groupable by dtype/shape so the fused train step lowers
+    one batched update region per class instead of a chain per
+    parameter."""
+
+    name = "fuse_opt"
+
+    def run(self, tctx):
+        from ..tune import registry as _knobs
+        trainable = None
+        mod = tctx.module
+        if mod is not None:
+            params = getattr(mod, "_param_names", None)
+            fixed = set(getattr(mod, "_fixed_param_names", ()) or ())
+            if params:
+                trainable = [p for p in params if p not in fixed]
+        max_bytes = _knobs.resolve("compile.fuse_opt_max_kb") * 1024.0
+        plan = _df.update_fusion_plan(tctx.symbol, shapes=tctx.shapes,
+                                      types=tctx.types,
+                                      trainable=trainable,
+                                      max_member_bytes=max_bytes)
+        if not plan.classes:
+            self.action(tctx, "%s — no class with two or more same-"
+                        "shape/dtype parameters; rewrite skipped"
+                        % plan.summary())
+            return None
+        grouped = {}
+        for key, names in plan.classes.items():
+            for nm in names:
+                grouped[nm] = key
+        var_extra = {}
+        for node in tctx.symbol._topo():
+            if node.is_variable and node.name in grouped:
+                var_extra[id(node)] = {
+                    "__update_class__": grouped[node.name]}
+        for key, names in plan.classes.items():
+            self.action(
+                tctx, "parameters %s fuse into one batched %s optimizer-"
+                "update region — licensed by update_fusion (uniform "
+                "dtype/shape class)" % (", ".join(names), key),
+                provenance=tuple(names))
+        self.action(tctx, plan.summary())
+        return _annotate_clone(tctx.symbol, var_extra=var_extra)
+
+
+# --------------------------------------------------------- remat + reuse
+@register_transform
+class RematReusePass(TransformPass):
+    """Liveness-driven rematerialization + buffer-reuse hints: annotate
+    cheap-to-recompute residuals with ``__remat__`` (the fused step
+    drops them from the saved set) and record dead-entry→new-allocation
+    aliasing pairs."""
+
+    name = "remat_reuse"
+
+    def run(self, tctx):
+        from ..tune import registry as _knobs
+        threshold = _knobs.resolve("compile.remat_threshold")
+        plan = _df.remat_reuse_plan(tctx.symbol, shapes=tctx.shapes,
+                                    types=tctx.types,
+                                    threshold=threshold)
+        if not plan.remat and not plan.reuse_pairs:
+            self.action(tctx, "%s — nothing annotated; rewrite skipped"
+                        % plan.summary())
+            return None
+        node_extra = {nid: {"__remat__": "1"} for nid in plan.remat}
+        # reuse hints stamp the REBORN entry's producer with its donor —
+        # the annotation surface tools and the ledger cross-check read
+        reborn = {}
+        for dead, new, nbytes in plan.reuse_pairs:
+            if "[" not in new:   # secondary outputs stay hint-only
+                reborn[new] = dead
+        for node in tctx.symbol._topo():
+            if not node.is_variable and node.name in reborn:
+                node_extra.setdefault(id(node), {})["__reuse__"] = \
+                    reborn[node.name]
+        for nm in plan.remat_names:
+            self.action(
+                tctx, "node '%s' residual recomputed in backward "
+                "(recompute-flops/byte under %.2f at the residual peak) "
+                "— licensed by remat_reuse over the liveness walk" %
+                (nm, plan.threshold), node=nm)
+        for dead, new, nbytes in plan.reuse_pairs:
+            self.action(
+                tctx, "entry '%s' dies before '%s' is born (same "
+                "shape/dtype, %.1f KB) — buffer-reuse/aliasing hint"
+                % (dead, new, nbytes / 1024.0), node=new,
+                provenance=(dead,))
+        self.action(tctx, plan.summary())
+        from .. import telemetry as _tel
+        _tel.gauge("transform_remat_bytes").set(plan.remat_bytes)
+        _tel.gauge("transform_reuse_bytes").set(plan.reuse_bytes)
+        return _annotate_clone(tctx.symbol, node_extra=node_extra)

@@ -37,7 +37,7 @@ __all__ = ["set_output_sanitizer", "add_build_listener",
            "record_program_build", "instrument_program",
            "prewarm_scope", "in_prewarm", "prewarm_build_count",
            "configure", "configured", "refresh_from_knobs",
-           "pipeline_scope",
+           "pipeline_scope", "canonical_order",
            "transform_graph", "PipelineReport"]
 
 _log = _logging.getLogger("mxtpu.compile")
@@ -145,17 +145,18 @@ def notify_build(kind, owner):
             pass
 
 
-def record_program_build(kind, owner, fn, precision=None):
+def record_program_build(kind, owner, fn, precision=None, transforms=None):
     """Public build-seam entry for program tables outside the Executor
     (the fused train step, metric accumulators): bump the build
     counters, notify the listeners, and wrap ``fn`` for first-call
     compile timing and cost capture — the exact sequence the Executor's
     ``_get_fn`` performs, so every traced-program construction in the
-    process reports through one seam. ``precision`` tags the program's
-    cost record (``program_table``'s prec column) when the compile
-    pipeline rewrote the graph."""
+    process reports through one seam. ``precision``/``transforms`` tag
+    the program's cost record (``program_table``'s prec/xforms columns)
+    when the compile pipeline rewrote the graph."""
     notify_build(kind, owner)
-    return instrument_program(kind, fn, owner=owner, precision=precision)
+    return instrument_program(kind, fn, owner=owner, precision=precision,
+                              transforms=transforms)
 
 
 _AOT_MISS = object()     # sentinel: "the AOT capture path produced nothing"
@@ -164,7 +165,7 @@ _DEMOTE_MISS_TOTAL = 64  # lifetime misses → demote even if hits interleave
 
 
 def instrument_program(kind, fn, owner=None, matmul_env=False,
-                       precision=None):
+                       precision=None, transforms=None):
     """Wrap a freshly built jit program with the build-seam diagnostics.
 
     First invocation — the one that pays tracing + XLA compilation —
@@ -187,7 +188,9 @@ def instrument_program(kind, fn, owner=None, matmul_env=False,
 
     ``precision`` stamps the program's cost record (e.g. "mixed_bf16"
     after the pipeline's bf16 rewrite); without it, the record derives a
-    label from the captured argument dtypes."""
+    label from the captured argument dtypes. ``transforms`` stamps the
+    record with the applied transform-pass names (the per-transform
+    ProgramRecord tag — a rejected pass never appears)."""
     import time as _time
     # keep only the owner's NAME: the wrapper outlives the owner in
     # process-global caches (metric.py _ACCUM_FN_CACHE), and a closure
@@ -225,7 +228,8 @@ def instrument_program(kind, fn, owner=None, matmul_env=False,
             try:
                 exe = fn.lower(*args, **kwargs).compile()
                 state["rec"] = _diag.record_program(
-                    kind, owner, exe, (_time.perf_counter() - t0) * 1e3)
+                    kind, owner, exe, (_time.perf_counter() - t0) * 1e3,
+                    transforms=transforms)
                 # SPMD shape of the program: devices spanned + how many
                 # arg leaves are mesh-split vs replicated (read off the
                 # live args — the one place both are in hand)
@@ -408,6 +412,23 @@ def pipeline_scope(names):
 
 
 # ------------------------------------------------------------ transform gate
+def canonical_order(names):
+    """Sequence the CATALOG transforms among themselves into the
+    canonical composition order (:data:`mxtpu.analysis.rewrite.
+    CANONICAL_ORDER` — layout before bf16 before the annotation passes)
+    regardless of how the operator listed them. Non-catalog names
+    (tests, experiments) keep their exact slots, so an experimental
+    pass's position stays the operator's choice."""
+    from ..analysis.rewrite import CANONICAL_ORDER
+    rank = {n: i for i, n in enumerate(CANONICAL_ORDER)}
+    names = list(names)
+    slots = [i for i, n in enumerate(names) if n in rank]
+    ordered = sorted((names[i] for i in slots), key=rank.get)
+    for i, n in zip(slots, ordered):
+        names[i] = n
+    return tuple(names)
+
+
 class PipelineReport:
     """What the pipeline did to one graph: per-transform actions
     (INFO findings with per-node provenance), applied/rejected status,
@@ -439,6 +460,14 @@ class PipelineReport:
         """Precision tag for the diagnostics program record, or None
         when no precision-changing transform applied."""
         return "mixed_bf16" if "bf16" in self.applied else None
+
+    @property
+    def transforms(self):
+        """Applied pass names, as the diagnostics ProgramRecord tag —
+        what the program that compiled from this graph was built WITH
+        (a rejected pass is deliberately absent: the program never saw
+        its rewrite)."""
+        return tuple(self.applied)
 
     def findings(self):
         """The report flattened to the Finding schema (merged into
@@ -559,6 +588,7 @@ def transform_graph(symbol, kind=None, shapes=None, types=None,
     untouched, cheaply.
     """
     names = tuple(passes) if passes is not None else configured()
+    names = canonical_order(names)
     report = PipelineReport(kind=kind, passes=names)
     if not names:
         return symbol, report
@@ -594,6 +624,7 @@ def transform_graph(symbol, kind=None, shapes=None, types=None,
         if offending:
             entry["rejected"] = True
             entry["offending"] = offending
+            _tel.counter("transform_rejected", labels={"pass": name}).inc()
             _log.warning(
                 "compile pipeline: transform '%s' rejected for kind=%s — "
                 "verifier pass '%s' fails on its output (%s); falling "
@@ -603,5 +634,6 @@ def transform_graph(symbol, kind=None, shapes=None, types=None,
         cur = new_sym
         base = post  # the accepted graph is the next baseline
         entry["applied"] = True
+        _tel.counter("transform_applied", labels={"pass": name}).inc()
     report.symbol_changed = cur is not symbol
     return cur, report

@@ -69,7 +69,7 @@ class ProgramRecord:
                  "bytes_accessed", "argument_bytes", "output_bytes",
                  "temp_bytes", "generated_code_bytes", "calls",
                  "n_devices", "sharded_args", "replicated_args",
-                 "precision", "_exe")
+                 "precision", "transforms", "_exe")
 
     def __init__(self, kind, owner, compile_ms):
         self.id = next(_ids)
@@ -91,6 +91,9 @@ class ProgramRecord:
         # captured argument dtypes, or the compile pipeline's explicit
         # tag ("mixed_bf16") when a precision rewrite built the program
         self.precision = "f32"
+        # compile-pipeline passes that were APPLIED to the graph this
+        # program compiled from (rejected passes never appear)
+        self.transforms = ()
         self._exe = None  # weakref to the compiled executable (HLO source)
 
     def hlo_text(self):
@@ -120,6 +123,7 @@ class ProgramRecord:
             "sharded_args": self.sharded_args,
             "replicated_args": self.replicated_args,
             "precision": self.precision,
+            "transforms": list(self.transforms),
         }
 
 
@@ -186,11 +190,14 @@ def summarize_precision(rec, args, tag=None):
         pass
 
 
-def record_program(kind, owner, compiled, compile_ms):
+def record_program(kind, owner, compiled, compile_ms, transforms=None):
     """Capture a freshly compiled executable's analyses into the registry
     (and the telemetry counters). Never raises — introspection must not
-    take down the program it is describing."""
+    take down the program it is describing. ``transforms`` stamps the
+    applied compile-pipeline pass names on the record."""
     rec = ProgramRecord(kind, owner, compile_ms)
+    if transforms:
+        rec.transforms = tuple(transforms)
     try:
         cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):
@@ -256,22 +263,23 @@ def program_table(kind=None):
     rows = programs(kind)
     header = ("id", "kind", "owner", "calls", "compile_ms", "mflops",
               "mb_accessed", "arg_kb", "out_kb", "temp_kb", "devs",
-              "prec")
-    lines = ["%4s %-12s %-16s %6s %10s %10s %11s %8s %8s %8s %9s %-10s"
+              "prec", "xforms")
+    lines = ["%4s %-12s %-16s %6s %10s %10s %11s %8s %8s %8s %9s %-10s %s"
              % header]
     for r in rows:
         devs = "%d" % r.get("n_devices", 1)
         if r.get("sharded_args"):
             devs += " (%ds)" % r["sharded_args"]
         lines.append("%4d %-12s %-16s %6d %10.1f %10.2f %11.2f %8d %8d "
-                     "%8d %9s %-10s"
+                     "%8d %9s %-10s %s"
                      % (r["id"], r["kind"][:12], r["owner"][:16], r["calls"],
                         r["compile_ms"], r["flops"] / 1e6,
                         r["bytes_accessed"] / 1e6,
                         r["argument_bytes"] // 1024,
                         r["output_bytes"] // 1024,
                         r["temp_bytes"] // 1024, devs,
-                        r.get("precision", "f32")[:10]))
+                        r.get("precision", "f32")[:10],
+                        ",".join(r.get("transforms", ())) or "-"))
     return "\n".join(lines)
 
 

@@ -344,6 +344,10 @@ class Executor:
         rep = self.pipeline_report
         return rep.precision if rep is not None else None
 
+    def _transform_tags(self):
+        rep = self.pipeline_report
+        return rep.transforms if rep is not None else None
+
     def _get_fn(self, kind):
         # the program table is valid for ONE pipeline config: flipping
         # the pipeline mid-life must not serve a program built from the
@@ -444,7 +448,8 @@ class Executor:
         else:
             raise MXNetError("unknown program kind %s" % kind)
         fn = _instrument_program(kind, fn, owner=self, matmul_env=True,
-                                 precision=self._precision_tag())
+                                 precision=self._precision_tag(),
+                                 transforms=self._transform_tags())
         self._fns[kind] = fn
         return fn
 

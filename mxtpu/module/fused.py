@@ -339,6 +339,14 @@ class FusedTrainStep:
         #            normalize, relu) from the saved conv outputs.
         #   all/1  — whole-forward jax.checkpoint (the memory-mirroring
         #            analogue, MXNET_BACKWARD_DO_MIRROR)
+        #   auto   — defer to the compile pipeline's remat_reuse pass:
+        #            drop exactly the __remat__-annotated residuals the
+        #            liveness/recompute-cost analysis licensed. The
+        #            UNSET default behaves like auto (the pass must have
+        #            effect when the operator only listed it in
+        #            MXTPU_PIPELINE); an explicitly SET none/0 pins
+        #            "no rematerialization" and suppresses the
+        #            annotations, like block/conv/all pin their policy.
         import os
         from ..tune import registry as _knobs
         # a SET MXTPU_REMAT always wins — including set-but-empty,
@@ -346,17 +354,25 @@ class FusedTrainStep:
         # override a TunedConfig artifact (same special case as
         # MXTPU_PIPELINE in compile.pipeline._parse_env)
         raw = os.environ.get("MXTPU_REMAT")
+        env_set = raw is not None
         if raw is None:
             raw = _knobs.resolve("fit.remat")
         self._remat = str(raw or "none").lower()
+        self._remat_pinned_off = False
         if self._remat in ("0", "none", "", "false"):
             self._remat = "none"
+            # the operator explicitly pinned "no remat" via the env —
+            # that wins over the remat_reuse pass's annotations too
+            self._remat_pinned_off = env_set
         elif self._remat in ("1", "all", "true"):
             self._remat = "all"
+        elif self._remat == "auto":
+            pass   # defer to the remat_reuse pass's annotations (none
+            # applied = keep-all, same as the default)
         elif self._remat not in ("block", "conv"):
             raise ValueError(
                 "fit.remat / MXTPU_REMAT = %r not recognized (use "
-                "none/block/conv/all)" % self._remat)
+                "none/auto/block/conv/all)" % self._remat)
         tags = None
         if self._remat in ("block", "conv"):
             from ..executor import _block_boundaries
@@ -370,8 +386,27 @@ class FusedTrainStep:
                             and n.op.name in ("Convolution", "FullyConnected")
                             and id(n) not in tags):
                         tags[id(n)] = "mxtpu_conv"
+        elif self._remat in ("none", "auto") \
+                and not self._remat_pinned_off:
+            # the remat_reuse transform pass annotated the graph: drop
+            # exactly the tagged residuals (policy saves everything
+            # else), the analysis-driven inverse of block/conv's
+            # save-only allowlists. An EXPLICIT mode wins over the
+            # annotations — block/conv/all pin their policy, an
+            # env-set none/0 pins "no remat at all".
+            ann = {id(n): "mxtpu_remat"
+                   for n in self._graph_symbol._topo()
+                   if not n.is_variable
+                   and n._extra_attrs.get("__remat__")}
+            if ann:
+                tags = ann
+                self._remat = "annotated"
         self._run = _trace_graph(self._graph_symbol, is_train=True,
                                  remat_tags=tags)
+        # optimizer-update fusion (the fuse_opt transform): trainable
+        # parameters the pass annotated with a shared __update_class__
+        # collapse into ONE batched update region per class in _build
+        self._update_groups = self._derive_update_groups()
         self._mesh = None
         self._plan = None
         if plan is not None and len(plan.mesh_ctx.devices) > 1:
@@ -467,11 +502,72 @@ class FusedTrainStep:
                     self._state_init(st.params[n]))
         st.update_mem_slot(self.devices)
 
+    def _derive_update_groups(self):
+        """(class key, member names) pairs from the fuse_opt pass's
+        ``__update_class__`` annotations on the (transformed) graph,
+        intersected with THIS step's trainables — an annotated variable
+        that is fixed here, or a class left with one member, batches
+        nothing."""
+        groups = {}
+        for n in self._graph_symbol._topo():
+            if n.is_variable:
+                key = n._extra_attrs.get("__update_class__")
+                if key:
+                    groups.setdefault(key, []).append(n.name)
+        tidx = {n: i for i, n in enumerate(self.trainable)}
+        out = []
+        for key in sorted(groups):
+            names = sorted((nm for nm in groups[key] if nm in tidx),
+                           key=tidx.get)
+            if len(names) >= 2:
+                out.append((key, names))
+        return out
+
+    def _validated_update_groups(self):
+        """Re-prove each annotated class against the LIVE state before
+        the program traces it; an unsound group falls back to the
+        per-parameter update chains with a logged warning (the same
+        degrade-not-break contract as the pipeline's verifier gate)."""
+        out = []
+        for key, names in self._update_groups:
+            why = None
+            if any(n not in (self.params or {}) for n in names):
+                why = "member missing from the staged params"
+            elif len({(self.params[n].shape, str(self.params[n].dtype))
+                      for n in names}) != 1:
+                why = "members diverge in live shape/dtype"
+            elif len({jax.tree.structure(self.opt_state[n])
+                      for n in names}) != 1:
+                why = "members diverge in optimizer-state structure"
+            elif self._plan is not None and any(
+                    tuple(self._opt_spec(n)) or tuple(self._param_spec(n))
+                    for n in names):
+                # sharded update state: the reduce-scatter/all-gather
+                # choreography is per-parameter — batching would change
+                # the sharding story, so the plan path keeps the chains
+                why = "weight-update sharding active for a member"
+            if why is not None:
+                (self._logger or logging).warning(
+                    "fused step: update-fusion class %s NOT batched "
+                    "(%s); per-parameter update chains retained",
+                    key, why)
+                continue
+            out.append(tuple(names))
+        return out
+
     # ------------------------------------------------ the program
     def _build(self):
         run = self._run
         trainable = tuple(self.trainable)
         apply_update = self._apply
+        update_groups = self._validated_update_groups()
+        grouped_names = {n for g in update_groups for n in g}
+        tindex = {n: i for i, n in enumerate(trainable)}
+        if update_groups and self._logger is not None:
+            self._logger.info(
+                "fused step: %d batched optimizer-update region(s) "
+                "cover %d of %d parameter(s)", len(update_groups),
+                len(grouped_names), len(trainable))
 
         remat = self._remat
         # weight-update sharding: constrain each gradient entering the
@@ -511,6 +607,17 @@ class FusedTrainStep:
                 f = jax.checkpoint(
                     f, policy=jax.checkpoint_policies.save_only_these_names(
                         "mxtpu_boundary", "mxtpu_conv"))
+            elif remat == "annotated":
+                # remat_reuse annotations: recompute ONLY the tagged
+                # residuals; everything else stays saveable (the
+                # inverse of the save-only allowlists above). NB:
+                # save_anything_except_these_names, NOT
+                # save_any_names_but_these — the latter saves ONLY
+                # named values and would remat the entire forward
+                f = jax.checkpoint(
+                    f,
+                    policy=jax.checkpoint_policies
+                    .save_anything_except_these_names("mxtpu_remat"))
             train_p = {n: params[n] for n in trainable}
             (outs, auxu), vjp = jax.vjp(f, train_p)
             cts = ([jnp.ones_like(o) for o in outs],
@@ -518,7 +625,28 @@ class FusedTrainStep:
             (grads,) = vjp(cts)
             new_params = dict(fixed)
             new_opt = {}
+            # batched update regions (fuse_opt): every annotated
+            # dtype/shape class runs its grad→update→assign chain ONCE
+            # over stacked members — per-parameter lr/wd enter as a
+            # leading-axis column, so the arithmetic is identical to
+            # the per-parameter chains below, element for element
+            for names in update_groups:
+                p_stk = jnp.stack([params[n] for n in names])
+                g_stk = jnp.stack([grads[n] for n in names])
+                s_stk = jax.tree.map(lambda *ls: jnp.stack(ls),
+                                     *[opt_state[n] for n in names])
+                col = (len(names),) + (1,) * (p_stk.ndim - 1)
+                lr_col = jnp.reshape(
+                    jnp.stack([lrs[tindex[n]] for n in names]), col)
+                wd_col = jnp.reshape(
+                    jnp.stack([wds[tindex[n]] for n in names]), col)
+                p2, s2 = apply_update(p_stk, g_stk, s_stk, lr_col, wd_col)
+                for j, n in enumerate(names):
+                    new_params[n] = p2[j].astype(params[n].dtype)
+                    new_opt[n] = jax.tree.map(lambda t, _j=j: t[_j], s2)
             for i, n in enumerate(trainable):
+                if n in grouped_names:
+                    continue
                 g = grads[n]
                 if grad_shardings is not None and n in grad_shardings:
                     g = jax.lax.with_sharding_constraint(g,
@@ -613,7 +741,8 @@ class FusedTrainStep:
             rep = self.pipeline_report
             self._step_fn = record_program_build(
                 "fused_step", self, self._step_fn,
-                precision=rep.precision if rep is not None else None)
+                precision=rep.precision if rep is not None else None,
+                transforms=rep.transforms if rep is not None else None)
         try:
             self.params, self.aux, self.opt_state, outs = self._step_fn(
                 self.params, self.aux, self.opt_state, batch,

@@ -59,15 +59,24 @@ def _convolution(a, data, weight, bias=None):
     stride = _tup(a.stride, nd, 1)
     dilate = _tup(a.dilate, nd, 1)
     pad = _tup(a.pad, nd, 0)
+    dnums = _CONV_DNUMS[nd]
+    channels_last = nd == 2 and a.get("layout") == "NHWC"
+    if channels_last:
+        # channels-last activations (the compile pipeline's `layout`
+        # transform): the WEIGHT keeps its OIHW storage — only the
+        # activation layout moves, so bind dicts/checkpoints are
+        # untouched and the rewrite never transposes parameters
+        dnums = ("NHWC", "OIHW", "NHWC")
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad],
         rhs_dilation=dilate,
-        dimension_numbers=_CONV_DNUMS[nd],
+        dimension_numbers=dnums,
         feature_group_count=int(a.num_group),
         preferred_element_type=None)
     if bias is not None:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        out = out + (bias if channels_last
+                     else bias.reshape((1, -1) + (1,) * nd))
     return out
 
 
@@ -148,18 +157,26 @@ def _pool_pads(in_shape, kernel, stride, pad, convention):
 
 def _pooling(a, data):
     nd = data.ndim - 2
+    channels_last = nd == 2 and a.get("layout") == "NHWC"
+    spatial = data.shape[1:3] if channels_last else data.shape[2:]
     if a.global_pool:
-        kernel = data.shape[2:]
+        kernel = spatial
         stride = (1,) * nd
         pad = (0,) * nd
     else:
         kernel = _tup(a.kernel, nd, 1)
         stride = _tup(a.stride, nd, 1)
         pad = _tup(a.pad, nd, 0)
-    pads = [(0, 0), (0, 0)] + _pool_pads(data.shape[2:], kernel, stride, pad,
-                                         a.pooling_convention)
-    dims = (1, 1) + tuple(kernel)
-    strides = (1, 1) + tuple(stride)
+    sp_pads = _pool_pads(spatial, kernel, stride, pad,
+                         a.pooling_convention)
+    if channels_last:
+        pads = [(0, 0)] + sp_pads + [(0, 0)]
+        dims = (1,) + tuple(kernel) + (1,)
+        strides = (1,) + tuple(stride) + (1,)
+    else:
+        pads = [(0, 0), (0, 0)] + sp_pads
+        dims = (1, 1) + tuple(kernel)
+        strides = (1, 1) + tuple(stride)
     if a.pool_type == "max":
         # scalar init keeps XLA's reduce-window-max pattern (autodiff-able)
         return lax.reduce_window(data, -jnp.inf, lax.max, dims, strides, pads)
@@ -176,7 +193,7 @@ def _pooling(a, data):
 register("Pooling", _pooling,
          attrs={"kernel": (), "pool_type": "max", "global_pool": False,
                 "stride": (), "pad": (), "pooling_convention": "valid",
-                "cudnn_off": False},
+                "cudnn_off": False, "layout": None},
          aliases=("Pooling_v1",))
 
 # ---------------------------------------------------------------- BatchNorm
@@ -683,7 +700,7 @@ _get_op("FullyConnected").infer_args = _fc_infer
 
 def _conv_infer(a, shapes):
     data = shapes[0]
-    c = data[1]
+    c = data[-1] if a.get("layout") == "NHWC" else data[1]
     w = (int(a.num_filter), c // int(a.num_group)) + tuple(a.kernel)
     out = [data, w]
     if not a.no_bias:

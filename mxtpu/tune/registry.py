@@ -287,7 +287,11 @@ declare("fit.batch_size", "int_or_none", None, env="MXTPU_FIT_BATCH_SIZE",
              "caller's iterator")
 declare("fit.remat", "str", "none", env="MXTPU_REMAT",
         help="selective rematerialization policy of the fused step: "
-             "none/block/conv/all (memory-capacity lever; docs/perf.md)")
+             "none/auto/block/conv/all (memory-capacity lever; "
+             "docs/perf.md). Unset or auto honor the remat_reuse "
+             "pass's per-node annotations; an env-SET none/0 pins no-"
+             "remat and suppresses them, like block/conv/all pin "
+             "their explicit policy")
 
 # --- serving (ServingSession / batcher / admission, docs/serving.md)
 declare("serving.max_in_flight", "int", 2, env="MXTPU_SERVING_INFLIGHT",
@@ -343,7 +347,26 @@ declare("elastic.keep", "int", 2, env="MXTPU_ELASTIC_KEEP",
         help="checkpoint generations retained")
 
 # --- compile (the pipeline seam, docs/compile.md)
+# candidates are pipeline COMPOSITIONS, not single passes: tune.search
+# explores which subset of the transform catalog pays on a workload
+# instead of an operator hand-picking the pass list (the sequencing
+# itself is canonical — compile.pipeline normalizes the order)
 declare("compile.pipeline", "str", "", env="MXTPU_PIPELINE",
-        candidates=("", "bf16"),
+        candidates=("", "bf16", "fuse_opt", "layout", "remat_reuse",
+                    "bf16,fuse_opt", "bf16,fuse_opt,remat_reuse",
+                    "bf16,fuse_opt,layout,remat_reuse"),
         help="transform-pass list the compile pipeline runs (comma-"
              "separated registry names; empty = no rewrites)")
+declare("compile.fuse_opt_max_kb", "float", 32.0,
+        env="MXTPU_FUSE_OPT_MAX_KB",
+        candidates=(8.0, 32.0, 128.0, 1024.0), safe_range=(1.0, 4096.0),
+        help="fuse_opt class bound: only parameters at or under this "
+             "many KB batch into a shared update region (small-param "
+             "chains are launch-bound; big weight chains are bandwidth-"
+             "bound and the stack would cost real movement)")
+declare("compile.remat_threshold", "float", 4.0,
+        env="MXTPU_REMAT_THRESHOLD",
+        candidates=(1.0, 2.0, 4.0, 8.0, 16.0), safe_range=(0.25, 64.0),
+        help="remat_reuse annotation bar: a node's residual is "
+             "recomputed in backward when its recompute-flops per saved "
+             "byte is at or below this ratio")

@@ -40,22 +40,28 @@ def test_train_mnist_gate(tmp_path, network, epochs):
     assert acc > 0.95, "%s reached only %.3f" % (network, acc)
 
 
-@pytest.mark.slow  # known red on tier-1: under jax 0.4.37 numerics this
-# config converges to ppl ratio ~0.849 vs the 0.8 gate (verified failing
-# at the clean pre-serving HEAD, CHANGES.md PR 1); quarantined to the slow
-# tier until the gate is recalibrated against current-jax convergence
 def test_lstm_bucketing_gate():
     """BucketingModule LSTM LM through examples/rnn/lstm_bucketing.py:
     validation perplexity must fall clearly below its starting point
-    (synthetic next-token corpus; random baseline ppl ~58)."""
+    (synthetic next-token corpus; random baseline ppl ~58).
+
+    Gate re-derived 2026-08-04 (un-quarantining the PR-2 red): under
+    jax 0.4.37 this config's loss plateaus for ~6 epochs before the
+    phase transition — the old 6-epoch budget measured the plateau, not
+    convergence (ratio stalled at 0.85-0.88). At 10 epochs the seeded
+    trajectory breaks through decisively (ratios vs epoch-1:
+    [1.0, .99, 1.01, 1.04, .99, .99, .72, .67, .62, .65]), so the 0.8
+    bar is kept AS-IS and only the training budget moved to where
+    current-jax convergence actually happens. Divergence still fails
+    this gate: lr sweeps at 0.05/0.1 blow up past ratio 1.3."""
     _example("rnn", "lstm_bucketing.py")
     import mxtpu as mx
     import lstm_bucketing
     mx.random.seed(7)  # deterministic init regardless of suite order
     np.random.seed(7)  # NDArrayIter shuffle draws from numpy's global RNG
     ppl = lstm_bucketing.main([
-        "--num-epochs", "6", "--num-hidden", "64", "--num-embed", "32"])
-    assert len(ppl) == 6
+        "--num-epochs", "10", "--num-hidden", "64", "--num-embed", "32"])
+    assert len(ppl) == 10
     assert min(ppl[2:]) < ppl[0] * 0.8, \
         "perplexity did not fall: %s" % (ppl,)
 

@@ -1,0 +1,470 @@
+"""Compiler round 2: the transform CATALOG through the gated pipeline
+seam — optimizer-update fusion (``fuse_opt``), conv layout selection
+(``layout``), and liveness-driven remat/buffer-reuse (``remat_reuse``),
+composed with the PR-7 ``bf16`` pass.
+
+Acceptance gates (ISSUE 14):
+* each transform shows a per-model win on its deterministic basis
+  (fuse_opt: fewer update chains / bit-exact parity; layout: modeled
+  byte-movement cut after boundary-conversion cost; remat_reuse:
+  residual-peak-bytes cut from the liveness walk);
+* the composed bf16+fuse_opt+layout+remat_reuse pipeline passes the
+  PR-7 parity-gate convention on the mlp/lenet fixtures;
+* composition order is canonical regardless of operator spelling, and
+  every pass is individually rejectable-with-fallback — the remaining
+  passes still apply and training completes.
+"""
+import logging
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+import mxtpu.symbol as S
+from mxtpu import analysis
+from mxtpu import diagnostics as diag
+from mxtpu import telemetry as tel
+from mxtpu.analysis import dataflow, rewrite
+from mxtpu.compile import pipeline
+from mxtpu.models import lenet, mlp
+
+
+def _fit(symbol, names, n=256, batch=64, epochs=2, image=False, seed=7):
+    rng = np.random.RandomState(0)
+    X = rng.rand(n, 1, 28, 28).astype(np.float32) if image \
+        else rng.rand(n, 784).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, n).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(symbol, context=mx.cpu(),
+                        logger=logging.getLogger("quiet"))
+    mod.logger.setLevel(logging.ERROR)
+    metric = mx.metric.create(["acc", "ce"])
+    with pipeline.pipeline_scope(names):
+        mx.random.seed(seed)
+        np.random.seed(seed)
+        mod.fit(it, num_epoch=epochs, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1},
+                eval_metric=metric)
+    args, _ = mod.get_params()
+    return mod, {k: v.asnumpy() for k, v in args.items()}, \
+        dict(zip(*metric.get()))
+
+
+def _deep_mlp(classes=10, width=128, depth=4):
+    """Equal-width FC stack: the fixture whose weights/biases form real
+    dtype/shape classes for the update-fusion pass (mlp/lenet have none
+    — every layer is a different shape)."""
+    x = S.Variable("data")
+    for i in range(depth):
+        x = S.FullyConnected(x, num_hidden=width, name="dfc%d" % i)
+        x = S.Activation(x, act_type="relu", name="drelu%d" % i)
+    x = S.FullyConnected(x, num_hidden=classes, name="dout")
+    return S.SoftmaxOutput(x, name="softmax")
+
+
+def _lenet_hints(batch=64):
+    sym = lenet.get_symbol(10)
+    arg_shapes, _, _ = sym.infer_shape(data=(batch, 1, 28, 28),
+                                       softmax_label=(batch,))
+    return sym, dict(zip(sym.list_arguments(), arg_shapes))
+
+
+# ------------------------------------------------------------ the catalog
+def test_catalog_registers_all_passes():
+    names = [n for n, _ in rewrite.list_transforms()]
+    for want in ("bf16", "layout", "fuse_opt", "remat_reuse"):
+        assert want in names, names
+
+
+def test_canonical_order_normalizes_operator_spelling():
+    # the ISSUE's spelling — and any other — sequences canonically
+    assert pipeline.canonical_order(
+        ["bf16", "fuse_opt", "layout", "remat_reuse"]) == \
+        ("layout", "bf16", "fuse_opt", "remat_reuse")
+    assert pipeline.canonical_order(
+        ["remat_reuse", "layout"]) == ("layout", "remat_reuse")
+    # non-catalog names keep their exact slots (test/experimental passes)
+    assert pipeline.canonical_order(
+        ["_probe", "remat_reuse", "bf16"]) == \
+        ("_probe", "bf16", "remat_reuse")
+
+
+def test_transform_graph_reports_canonical_passes():
+    sym, hints = _lenet_hints()
+    _sym2, rep = pipeline.transform_graph(
+        sym, kind="test", shapes=hints,
+        passes=["remat_reuse", "bf16", "layout"])
+    assert rep.passes == ("layout", "bf16", "remat_reuse")
+
+
+# ------------------------------------------------------------ conv layout
+def test_conv_layout_analysis_finds_lenet_run():
+    sym, hints = _lenet_hints()
+    plan = dataflow.conv_layout(sym, shapes=hints)
+    assert len(plan.runs) == 1
+    run = plan.runs[0]
+    assert run["applied"], plan.summary()
+    # two convs + two poolings (pooling auto-names carry a global
+    # counter, so match by prefix rather than exact index)
+    assert {n for n in run["core"] if not n.startswith("pooling")} == \
+        {"conv1", "conv2"}
+    assert sum(n.startswith("pooling") for n in run["core"]) == 2
+    # the deterministic decision basis: interior wrap savings beat the
+    # boundary converts (the ISSUE's "net byte-movement cut")
+    assert run["benefit_bytes"] > run["boundary_bytes"] > 0
+
+
+def test_conv_layout_rejects_when_boundary_dominates():
+    """A lone conv saves nothing: entry+exit converts equal the modeled
+    wrap the backend would pay — the cost model must keep NCHW."""
+    data = S.Variable("data")
+    conv = S.Convolution(data, kernel=(3, 3), num_filter=8, name="c")
+    plan = dataflow.conv_layout(S.Group([conv]),
+                                shapes={"data": (4, 3, 16, 16)})
+    assert len(plan.runs) == 1
+    assert not plan.runs[0]["applied"]
+    sym2, rep = pipeline.transform_graph(
+        conv, kind="test", shapes={"data": (4, 3, 16, 16)},
+        passes=["layout"])
+    assert sym2 is conv and rep.applied == []
+
+
+def test_layout_rewrite_structure_and_forward_parity():
+    sym, hints = _lenet_hints(batch=8)
+    sym2, rep = pipeline.transform_graph(sym, kind="test", shapes=hints,
+                                         passes=["layout"])
+    assert rep.applied == ["layout"] and rep.symbol_changed
+    # arguments/aux unchanged — weights keep OIHW storage, bind dicts
+    # and checkpoints still fit
+    assert sym2.list_arguments() == sym.list_arguments()
+    assert sym2.list_outputs() == sym.list_outputs()
+    dbg = sym2.debug_str()
+    assert "data_nhwc" in dbg          # run-entry convert
+    assert "_nchw" in dbg              # run-exit convert
+    # interior edges carry NO converts: exactly one each way
+    assert dbg.count("_nhwc(") == 1 and dbg.count("_nchw(") == 1
+    # conv/pool retargeted, and the transformed graph re-proves
+    attrs = sym2.attr_dict()
+    assert attrs["conv1"]["layout"] == "NHWC"
+    pools = [k for k in attrs if k.startswith("pooling")
+             and not k.endswith(("_nhwc", "_nchw"))]
+    assert pools and all(attrs[p]["layout"] == "NHWC" for p in pools)
+    assert not sym2.lint(shapes=hints).errors
+    # forward parity: same params through both graphs, same outputs
+    ex = sym.simple_bind(ctx=mx.cpu(), data=(8, 1, 28, 28),
+                         grad_req="null")
+    for name, arr in ex.arg_dict.items():
+        if name != "data":
+            arr[:] = np.random.RandomState(hash(name) % 997).rand(
+                *arr.shape).astype(np.float32) * 0.1
+    ex2 = sym2.bind(mx.cpu(), dict(ex.arg_dict), grad_req="null")
+    x = np.random.RandomState(3).rand(8, 1, 28, 28).astype(np.float32)
+    o1 = ex.forward(is_train=False, data=mx.nd.array(x))[0].asnumpy()
+    o2 = ex2.forward(is_train=False, data=mx.nd.array(x))[0].asnumpy()
+    np.testing.assert_allclose(o1, o2, atol=1e-5)
+
+
+def test_layout_parity_gate_fit():
+    """Training parity through the NHWC rewrite alone: same data/seed,
+    f32 arithmetic both sides — metrics match to float tolerance and
+    weights stay within the reduction-order envelope."""
+    _, w0, v0 = _fit(lenet.get_symbol(10), [], epochs=1, image=True)
+    mod, w1, v1 = _fit(lenet.get_symbol(10), ["layout"], epochs=1,
+                       image=True)
+    assert mod._fused.pipeline_report.applied == ["layout"]
+    assert v0["accuracy"] == v1["accuracy"]
+    assert abs(v0["cross-entropy"] - v1["cross-entropy"]) < 1e-3
+    for k in w0:
+        assert np.max(np.abs(w0[k] - w1[k])) < 1e-3, k
+
+
+# ------------------------------------------------------ update fusion
+def test_update_fusion_plan_groups_by_class():
+    sym = _deep_mlp()
+    shapes, _, _ = sym.infer_shape(data=(64, 784), softmax_label=(64,))
+    hints = dict(zip(sym.list_arguments(), shapes))
+    trainable = [n for n in sym.list_arguments()
+                 if n not in ("data", "softmax_label")]
+    # default bound (compile.fuse_opt_max_kb=32): only the SMALL
+    # launch-bound class batches — the 64 KB weight matrices stay on
+    # their bandwidth-bound per-parameter chains
+    plan = dataflow.update_fusion_plan(sym, shapes=hints,
+                                       trainable=trainable)
+    assert set(plan.classes) == {"float32:128"}
+    # raising the bound admits the weight-matrix class too
+    plan = dataflow.update_fusion_plan(sym, shapes=hints,
+                                       trainable=trainable,
+                                       max_member_bytes=None)
+    assert set(plan.classes) == {"float32:128", "float32:128x128"}
+    assert plan.classes["float32:128x128"] == \
+        ["dfc1_weight", "dfc2_weight", "dfc3_weight"]
+    # mlp has no two same-shape trainables: the pass must skip, not force
+    msym = mlp.get_symbol(10)
+    ms, _, _ = msym.infer_shape(data=(64, 784), softmax_label=(64,))
+    mplan = dataflow.update_fusion_plan(
+        msym, shapes=dict(zip(msym.list_arguments(), ms)),
+        trainable=[n for n in msym.list_arguments()
+                   if n not in ("data", "softmax_label")])
+    assert mplan.classes == {}
+
+
+def test_fuse_opt_parity_is_bit_exact(monkeypatch):
+    """THE fuse_opt gate: the batched update region computes the same
+    elementwise arithmetic as the per-parameter chains — weights after
+    a fit are IDENTICAL, while the step really batched both classes
+    (the knob raised so the weight-matrix class batches too and the
+    stacked arithmetic is covered for matrices, not just vectors)."""
+    monkeypatch.setenv("MXTPU_FUSE_OPT_MAX_KB", "1024")
+    _, w0, v0 = _fit(_deep_mlp(), [], epochs=1)
+    mod, w1, v1 = _fit(_deep_mlp(), ["fuse_opt"], epochs=1)
+    rep = mod._fused.pipeline_report
+    assert rep.applied == ["fuse_opt"]
+    assert [k for k, _ in mod._fused._update_groups] == \
+        ["float32:128", "float32:128x128"]
+    assert len(mod._fused._validated_update_groups()) == 2
+    for k in w0:
+        assert np.array_equal(w0[k], w1[k]), k
+    assert v0 == v1
+
+
+def test_fuse_opt_momentum_and_adam_parity():
+    """The batched region must hold for stateful rules too (momentum
+    buffers / Adam moments stack along the class axis)."""
+    for opt, params in (("sgd", {"learning_rate": 0.05,
+                                 "momentum": 0.9}),
+                        ("adam", {"learning_rate": 0.01})):
+        results = []
+        for names in ([], ["fuse_opt"]):
+            rng = np.random.RandomState(0)
+            X = rng.rand(128, 784).astype(np.float32)
+            y = np.random.RandomState(1).randint(0, 10, 128).astype(
+                np.float32)
+            it = mx.io.NDArrayIter(X, y, batch_size=64,
+                                   label_name="softmax_label")
+            mod = mx.mod.Module(_deep_mlp(), context=mx.cpu(),
+                                logger=logging.getLogger("quiet"))
+            mod.logger.setLevel(logging.ERROR)
+            with pipeline.pipeline_scope(names):
+                mx.random.seed(7)
+                np.random.seed(7)
+                mod.fit(it, num_epoch=1, optimizer=opt,
+                        optimizer_params=params)
+            args, _ = mod.get_params()
+            results.append({k: v.asnumpy() for k, v in args.items()})
+        for k in results[0]:
+            assert np.array_equal(results[0][k], results[1][k]), \
+                (opt, k)
+
+
+def test_fuse_opt_invalid_group_falls_back_per_parameter():
+    """An unsound annotation (two different-shape parameters claiming
+    one class) must be re-proven away at build time: the step logs,
+    keeps the per-parameter chains, and training completes."""
+    sym = mlp.get_symbol(10)
+    var_extra = {}
+    for node in sym._topo():
+        if node.is_variable and node.name in ("fc1_weight", "fc2_weight"):
+            var_extra[id(node)] = {"__update_class__": "bogus:class"}
+    bad = rewrite._annotate_clone(sym, var_extra=var_extra)
+    mod, w, vals = _fit(bad, [], epochs=1)
+    assert mod._fused._update_groups == \
+        [("bogus:class", ["fc1_weight", "fc2_weight"])]
+    assert mod._fused._validated_update_groups() == []
+    assert np.isfinite(vals["cross-entropy"])
+
+
+# ------------------------------------------------------ remat + reuse
+def test_remat_plan_threshold_and_peak_cut():
+    sym, hints = _lenet_hints()
+    plan = dataflow.remat_reuse_plan(sym, shapes=hints, threshold=4.0)
+    # cheap elementwise/pool residuals annotated, conv/FC kept
+    # (activation/pooling auto-names carry a global counter)
+    assert any(n.startswith("activation") for n in plan.remat_names)
+    assert any(n.startswith("pooling") for n in plan.remat_names)
+    assert "conv1" not in plan.remat_names
+    assert "fc1" not in plan.remat_names
+    # the deterministic basis: residual-peak bytes fall
+    assert plan.residual_peak_after < plan.residual_peak_before
+    assert plan.peak_cut_pct > 10.0
+    # threshold 0 annotates nothing
+    empty = dataflow.remat_reuse_plan(sym, shapes=hints, threshold=0.0)
+    assert empty.remat == set()
+
+
+def test_remat_reuse_pairs_on_repeated_blocks():
+    """Same-shape activations of consecutive blocks: block N's entry
+    dies before block N+2's is born — the plan must pair them."""
+    sym = _deep_mlp(depth=6)
+    shapes, _, _ = sym.infer_shape(data=(64, 784), softmax_label=(64,))
+    hints = dict(zip(sym.list_arguments(), shapes))
+    plan = dataflow.remat_reuse_plan(sym, shapes=hints, threshold=4.0)
+    assert plan.reuse_pairs, plan.summary()
+    assert plan.reuse_bytes > 0
+    dead, new, nbytes = plan.reuse_pairs[0]
+    assert dead != new and nbytes == 64 * 128 * 4
+
+
+def test_remat_reuse_fit_applies_annotations_and_parity():
+    _, w0, v0 = _fit(lenet.get_symbol(10), [], epochs=1, image=True)
+    mod, w1, v1 = _fit(lenet.get_symbol(10), ["remat_reuse"], epochs=1,
+                       image=True)
+    rep = mod._fused.pipeline_report
+    assert rep.applied == ["remat_reuse"]
+    # the step really runs the drop-these-names checkpoint policy
+    assert mod._fused._remat == "annotated"
+    tagged = [n.name for n in mod._fused._graph_symbol._topo()
+              if not n.is_variable and n._extra_attrs.get("__remat__")]
+    assert tagged, "no __remat__ annotations on the step graph"
+    # recompute is arithmetic-identical: metrics and weights match
+    assert v0["accuracy"] == v1["accuracy"]
+    assert abs(v0["cross-entropy"] - v1["cross-entropy"]) < 1e-5
+    for k in w0:
+        assert np.max(np.abs(w0[k] - w1[k])) < 1e-5, k
+    # telemetry gauges carry the modeled bytes
+    assert tel.registry().gauge("transform_remat_bytes").value > 0
+
+
+def test_explicit_remat_mode_wins_over_annotations(monkeypatch):
+    """An operator-pinned fit.remat=block must override the pass's
+    annotations (explicit beats derived, like every knob)."""
+    monkeypatch.setenv("MXTPU_REMAT", "block")
+    mod, _, vals = _fit(lenet.get_symbol(10), ["remat_reuse"], epochs=1,
+                        image=True)
+    assert mod._fused._remat == "block"
+    assert np.isfinite(vals["cross-entropy"])
+
+
+def test_env_set_none_suppresses_annotations(monkeypatch):
+    """MXTPU_REMAT=none (explicitly SET) pins no-remat: the pass's
+    annotations stay on the graph but the step must NOT build the
+    checkpoint policy — the operator's escape hatch from an
+    annotation-driven slowdown without editing the pipeline list."""
+    monkeypatch.setenv("MXTPU_REMAT", "none")
+    mod, _, vals = _fit(lenet.get_symbol(10), ["remat_reuse"], epochs=1,
+                        image=True)
+    assert mod._fused._remat == "none"   # not "annotated"
+    tagged = [n.name for n in mod._fused._graph_symbol._topo()
+              if not n.is_variable and n._extra_attrs.get("__remat__")]
+    assert tagged, "pass should still annotate; only the step ignores it"
+    assert np.isfinite(vals["cross-entropy"])
+
+
+# --------------------------------------------------- composed pipeline
+@pytest.mark.parametrize("model,kw", [
+    ("mlp", {}),
+    ("lenet", {"image": True}),
+])
+def test_full_catalog_parity_gate(model, kw):
+    """THE composed acceptance gate (PR-7 convention): the full
+    bf16+fuse_opt+layout+remat_reuse pipeline vs the plain f32 fit on
+    the same data/seed — integer metrics exact-or-gated at 2/256, ce
+    within 1e-2, weights within the bf16 quantization envelope."""
+    get = mlp.get_symbol if model == "mlp" else lenet.get_symbol
+    _, w0, v0 = _fit(get(10), [], **kw)
+    mod, w1, v1 = _fit(get(10),
+                       ["bf16", "fuse_opt", "layout", "remat_reuse"],
+                       **kw)
+    rep = mod._fused.pipeline_report
+    assert rep.passes == ("layout", "bf16", "fuse_opt", "remat_reuse")
+    assert rep.rejected == []
+    assert "bf16" in rep.applied and "remat_reuse" in rep.applied
+    if model == "lenet":
+        assert "layout" in rep.applied   # mlp has no conv run
+    assert abs(v0["accuracy"] - v1["accuracy"]) <= 2 / 256.0, (v0, v1)
+    assert abs(v0["cross-entropy"] - v1["cross-entropy"]) < 1e-2, \
+        (v0, v1)
+    for k in w0:
+        assert np.max(np.abs(w0[k] - w1[k])) < 5e-3, k
+    # per-transform ProgramRecord tags on the AOT row
+    recs = diag.programs("fused_step")
+    assert recs and recs[-1]["precision"] == "mixed_bf16"
+    assert "remat_reuse" in recs[-1]["transforms"]
+    table = diag.program_table("fused_step")
+    assert "xforms" in table.splitlines()[0]
+
+
+def test_transform_counters_emitted():
+    before_a = tel.registry().counter("transform_applied",
+                                      labels={"pass": "bf16"}).value
+    sym, hints = _lenet_hints()
+    pipeline.transform_graph(sym, kind="test", shapes=hints,
+                             passes=["bf16"])
+    after_a = tel.registry().counter("transform_applied",
+                                     labels={"pass": "bf16"}).value
+    assert after_a == before_a + 1
+
+
+# ------------------------------------------------------ rejection chain
+class _BreakingPass(rewrite.TransformPass):
+    """Unsound transform: duplicates the head under a colliding name —
+    the name_collision verifier must reject it."""
+
+    name = "_test_breaker"
+
+    def run(self, tctx):
+        from mxtpu.symbol.symbol import Symbol, _Node
+        head, idx = tctx.symbol._outputs[0]
+        clash = next(n for n in tctx.symbol._topo()
+                     if not n.is_variable and n is not head)
+        dup = _Node(head.op, clash.name, dict(head.attrs),
+                    list(head.inputs))
+        self.action(tctx, "duplicated head under colliding name")
+        return Symbol([(dup, idx)])
+
+
+def test_per_pass_rejection_rest_of_catalog_still_applies():
+    """One rejected pass must not poison the composition: the passes
+    around it still apply, and the fused fit trains to completion on
+    the partially transformed graph."""
+    rewrite._TRANSFORMS.setdefault("_test_breaker", _BreakingPass())
+    try:
+        before_r = tel.registry().counter(
+            "transform_rejected", labels={"pass": "_test_breaker"}).value
+        mod, w, vals = _fit(
+            lenet.get_symbol(10),
+            ["layout", "_test_breaker", "bf16", "remat_reuse"],
+            epochs=1, image=True)
+        rep = mod._fused.pipeline_report
+        assert rep.rejected == ["_test_breaker"]
+        assert rep.applied == ["layout", "bf16", "remat_reuse"]
+        off = [e for e in rep.entries
+               if e["name"] == "_test_breaker"][0]["offending"]
+        assert off and off[0].pass_name == "name_collision"
+        assert off[0].severity == analysis.ERROR
+        assert np.isfinite(vals["cross-entropy"])
+        after_r = tel.registry().counter(
+            "transform_rejected", labels={"pass": "_test_breaker"}).value
+        assert after_r == before_r + 1
+    finally:
+        rewrite._TRANSFORMS.pop("_test_breaker", None)
+
+
+@pytest.mark.parametrize("broken", ["layout", "fuse_opt", "remat_reuse"])
+def test_each_new_pass_individually_rejectable(broken, monkeypatch):
+    """Force each catalog pass to emit an unsound graph and prove the
+    gate rejects exactly it, falls back, and the rest still apply."""
+    orig = rewrite._TRANSFORMS[broken]
+
+    def bad_run(tctx, _orig=orig):
+        out = type(orig).run(_orig, tctx)
+        if out is None:
+            # make the pass "apply" unsoundly even where it would skip
+            out = tctx.symbol
+        from mxtpu.symbol.symbol import Symbol, _Node
+        head, idx = out._outputs[0]
+        clash = next(n for n in out._topo()
+                     if not n.is_variable and n is not head)
+        dup = _Node(head.op, clash.name, dict(head.attrs),
+                    list(head.inputs))
+        return Symbol([(dup, idx)])
+
+    monkeypatch.setattr(orig, "run", bad_run)
+    sym, hints = _lenet_hints()
+    sym2, rep = pipeline.transform_graph(
+        sym, kind="test", shapes=hints,
+        passes=["layout", "bf16", "fuse_opt", "remat_reuse"])
+    assert rep.rejected == [broken]
+    assert broken not in rep.applied
+    assert "bf16" in rep.applied
+    assert rep.symbol_changed     # the rest of the catalog still landed

@@ -1,0 +1,299 @@
+#!/usr/bin/env python
+"""Benchmark: what the transform catalog buys, per pass, per model.
+
+The verdict basis is DETERMINISTIC (the BENCH_precision two-view
+convention — real TPU unreachable since round 2):
+
+* ``fuse_opt`` — program-structure view: per-parameter optimizer-update
+  chains before vs batched-region count after (the launch-amortization
+  lever, multi-tensor-apply style); host AOT cost rows recorded
+  honestly alongside. CAVEAT: XLA:CPU lowers a region's unstack as one
+  slice kernel PER MEMBER instead of one multi-output fusion, so the
+  host entry-kernel count does not drop with the chain count — the
+  region structure is the TPU-relevant number, and parity is bit-exact
+  (asserted, recorded).
+* ``layout`` — modeled byte-movement view from the conv_layout cost
+  model (interior native-layout wrap saved minus boundary converts
+  added) plus the host cost-registry bytes-accessed delta, which on
+  this host genuinely falls (XLA:CPU pays NCHW wraps around windowed
+  ops that the NHWC graph no longer needs).
+* ``remat_reuse`` — liveness-walk view: residual-peak bytes before vs
+  after annotation (op entries persist to end-of-forward as backward
+  residuals unless annotated) plus buffer-reuse pair bytes; host rows
+  recorded with the caveat that recompute RAISES flops/bytes by design
+  (memory-for-compute is the trade) and XLA:CPU's scheduler only
+  partially honors the drop policy in temp bytes.
+
+Also records the composed-pipeline parity deltas the test gate enforces
+(tests/test_transforms.py::test_full_catalog_parity_gate) so the JSON
+is a self-contained record.
+
+Usage: python tools/bench_transforms.py [--out BENCH_transforms.json]
+"""
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import mxtpu as mx  # noqa: E402
+import mxtpu.symbol as S  # noqa: E402
+from mxtpu import diagnostics as diag  # noqa: E402
+from mxtpu.analysis import dataflow  # noqa: E402
+from mxtpu.compile import pipeline  # noqa: E402
+from mxtpu.models import lenet, resnet  # noqa: E402
+
+FULL_CATALOG = ["bf16", "fuse_opt", "layout", "remat_reuse"]
+
+
+def deep_mlp(classes=10, width=128, depth=4):
+    """Equal-width FC stack — the fixture whose parameters form real
+    dtype/shape classes for fuse_opt (mlp/lenet have none)."""
+    x = S.Variable("data")
+    for i in range(depth):
+        x = S.FullyConnected(x, num_hidden=width, name="dfc%d" % i)
+        x = S.Activation(x, act_type="relu", name="drelu%d" % i)
+    x = S.FullyConnected(x, num_hidden=classes, name="dout")
+    return S.SoftmaxOutput(x, name="softmax")
+
+
+MODELS = {
+    "deep_mlp": (deep_mlp, (784,)),
+    "lenet": (lambda: lenet.get_symbol(10), (1, 28, 28)),
+    "resnet20": (lambda: resnet.get_symbol(
+        num_classes=10, num_layers=20, image_shape=(3, 28, 28)),
+        (3, 28, 28)),
+}
+
+
+def _fit(model, names, epochs=1, batch=32):
+    get, shape = MODELS[model]
+    rng = np.random.RandomState(0)
+    X = rng.rand(2 * batch, *shape).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, 2 * batch).astype(
+        np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(get(), context=mx.cpu(),
+                        logger=logging.getLogger("quiet"))
+    mod.logger.setLevel(logging.ERROR)
+    metric = mx.metric.create(["acc", "ce"])
+    with pipeline.pipeline_scope(names):
+        mx.random.seed(11)
+        np.random.seed(11)
+        t0 = time.perf_counter()
+        mod.fit(it, num_epoch=epochs, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1,
+                                  "momentum": 0.9},
+                eval_metric=metric)
+        wall = time.perf_counter() - t0
+    rec = diag.programs("fused_step")[-1]
+    vals = dict(zip(*metric.get()))
+    weights = {k: np.asarray(v) for k, v in mod._fused.params.items()}
+    return mod, rec, vals, weights, wall
+
+
+def _row(rec):
+    return {"flops": rec["flops"], "bytes_accessed": rec["bytes_accessed"],
+            "temp_bytes": rec["temp_bytes"]}
+
+
+def _hints(model, batch=32):
+    get, shape = MODELS[model]
+    sym = get()
+    arg_shapes, _, _ = sym.infer_shape(data=(batch,) + shape,
+                                       softmax_label=(batch,))
+    return sym, dict(zip(sym.list_arguments(), arg_shapes))
+
+
+def bench_fuse_opt(model):
+    mod0, r0, _, w0, _ = _fit(model, [])
+    mod1, r1, _, w1, _ = _fit(model, ["fuse_opt"])
+    groups = mod1._fused._validated_update_groups()
+    n_train = len(mod1._fused.trainable)
+    grouped = sum(len(g) for g in groups)
+    exact = all(np.array_equal(w0[k], w1[k]) for k in w0)
+    assert exact, "fuse_opt parity must be bit-exact"
+    return {
+        "update_chains_before": n_train,
+        "update_chains_after": n_train - grouped + len(groups),
+        "batched_regions": len(groups),
+        "params_batched": grouped,
+        "parity": "bit-exact (asserted: every weight identical after "
+                  "one epoch, sgd+momentum)",
+        "host_row_f32": _row(r0),
+        "host_row_fuse_opt": _row(r1),
+        "bytes_accessed_delta_pct": round(
+            100.0 * (r1["bytes_accessed"] - r0["bytes_accessed"])
+            / max(r0["bytes_accessed"], 1.0), 2),
+    }
+
+
+def bench_layout(model):
+    sym, hints = _hints(model)
+    plan = dataflow.conv_layout(sym, shapes=hints)
+    applied = [r for r in plan.runs if r["applied"]]
+    modeled = {
+        "runs_found": len(plan.runs),
+        "runs_applied": len(applied),
+        "interior_wrap_bytes_saved": sum(r["benefit_bytes"]
+                                         for r in applied),
+        "boundary_convert_bytes_added": sum(r["boundary_bytes"]
+                                            for r in applied),
+    }
+    modeled["net_byte_movement_cut"] = (
+        modeled["interior_wrap_bytes_saved"]
+        - modeled["boundary_convert_bytes_added"])
+    if not applied:
+        return {"modeled": modeled, "note": "no run pays on this model"}
+    _, r0, v0, _, _ = _fit(model, [])
+    _, r1, v1, _, _ = _fit(model, ["layout"])
+    return {
+        "modeled": modeled,
+        "host_row_f32": _row(r0),
+        "host_row_layout": _row(r1),
+        "bytes_accessed_delta_pct": round(
+            100.0 * (r0["bytes_accessed"] - r1["bytes_accessed"])
+            / max(r0["bytes_accessed"], 1.0), 2),
+        "flops_delta_pct": round(
+            100.0 * (r0["flops"] - r1["flops"])
+            / max(r0["flops"], 1.0), 2),
+        "ce_delta": round(abs(v0["cross-entropy"]
+                              - v1["cross-entropy"]), 6),
+    }
+
+
+def bench_remat(model):
+    sym, hints = _hints(model)
+    from mxtpu.tune import registry as knobs
+    plan = dataflow.remat_reuse_plan(
+        sym, shapes=hints, threshold=knobs.resolve(
+            "compile.remat_threshold"))
+    modeled = {
+        "residual_peak_bytes_before": plan.residual_peak_before,
+        "residual_peak_bytes_after": plan.residual_peak_after,
+        "peak_cut_pct": plan.peak_cut_pct,
+        "nodes_annotated": len(plan.remat),
+        "residual_bytes_dropped": plan.remat_bytes,
+        "reuse_pairs": len(plan.reuse_pairs),
+        "reuse_bytes": plan.reuse_bytes,
+    }
+    if not plan.remat:
+        return {"modeled": modeled, "note": "nothing annotated"}
+    _, r0, v0, _, _ = _fit(model, [])
+    mod1, r1, v1, _, _ = _fit(model, ["remat_reuse"])
+    assert mod1._fused._remat == "annotated"
+    return {
+        "modeled": modeled,
+        "host_row_f32": _row(r0),
+        "host_row_remat": _row(r1),
+        "temp_bytes_delta_pct": round(
+            100.0 * (r0["temp_bytes"] - r1["temp_bytes"])
+            / max(r0["temp_bytes"], 1.0), 2),
+        "recompute_flops_added_pct": round(
+            100.0 * (r1["flops"] - r0["flops"])
+            / max(r0["flops"], 1.0), 2),
+        "ce_delta": round(abs(v0["cross-entropy"]
+                              - v1["cross-entropy"]), 6),
+    }
+
+
+def bench_composed(model):
+    _, r0, v0, w0, wall0 = _fit(model, [])
+    mod1, r1, v1, w1, wall1 = _fit(model, FULL_CATALOG)
+    rep = mod1._fused.pipeline_report
+    return {
+        "pipeline": ",".join(rep.passes),
+        "applied": list(rep.applied),
+        "rejected": list(rep.rejected),
+        "record_precision": r1["precision"],
+        "record_transforms": r1["transforms"],
+        "acc_delta": round(abs(v0["accuracy"] - v1["accuracy"]), 6),
+        "ce_delta": round(abs(v0["cross-entropy"]
+                              - v1["cross-entropy"]), 6),
+        "max_weight_delta": round(max(
+            float(np.max(np.abs(w0[k] - w1[k]))) for k in w0), 6),
+        "wall_s_f32": round(wall0, 3),
+        "wall_s_catalog": round(wall1, 3),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "..", "BENCH_transforms.json"))
+    args = ap.parse_args()
+    results = {}
+    for model in MODELS:
+        entry = {}
+        entry["fuse_opt"] = bench_fuse_opt(model)
+        entry["layout"] = bench_layout(model)
+        entry["remat_reuse"] = bench_remat(model)
+        entry["composed"] = bench_composed(model)
+        results[model] = entry
+        fo, ly, rr = entry["fuse_opt"], entry["layout"], \
+            entry["remat_reuse"]
+        print("%s: fuse_opt chains %d->%d; layout net modeled cut "
+              "%.1f KB (host bytes %+.1f%%); remat peak cut %.1f%%; "
+              "composed applied=%s"
+              % (model, fo["update_chains_before"],
+                 fo["update_chains_after"],
+                 ly["modeled"]["net_byte_movement_cut"] / 1024.0,
+                 ly.get("bytes_accessed_delta_pct", 0.0),
+                 rr["modeled"]["peak_cut_pct"],
+                 ",".join(entry["composed"]["applied"])))
+    payload = {
+        "bench": "transform catalog through the gated pipeline seam "
+                 "(fuse_opt, layout, remat_reuse; composed with bf16)",
+        "basis": "deterministic two-view (BENCH_precision convention): "
+                 "(1) platform-independent program/graph-structure "
+                 "views — update-chain count, conv_layout modeled "
+                 "byte movement, liveness-walk residual-peak bytes; "
+                 "(2) host XLA cost_analysis/memory_analysis rows for "
+                 "the fused_step AOT program, same data, same seeds",
+        "host_cost_caveat": {
+            "fuse_opt": "XLA:CPU lowers the batched region's unstack "
+                        "as one slice kernel per member (no multi-"
+                        "output fusion), so the host kernel count does "
+                        "not drop with the chain count; parity is "
+                        "bit-exact and the class bound (compile."
+                        "fuse_opt_max_kb) keeps the stack bytes "
+                        "overhead under 1%",
+            "layout": "host bytes-accessed genuinely falls (XLA:CPU "
+                      "pays NCHW wraps the NHWC graph avoids) — "
+                      "direction agrees with the model; magnitude is "
+                      "backend-specific",
+            "remat_reuse": "recompute RAISES host flops/bytes by "
+                           "design (memory-for-compute trade); the "
+                           "residual-peak cut from the liveness walk "
+                           "is the verdict basis, host temp_bytes "
+                           "only partially reflects the policy on CPU",
+        },
+        "wall_clock_caveat": "2-core CPU host, >45% noise floor (PR-2 "
+                             "convention) — wall-clock recorded but "
+                             "NOT a verdict basis",
+        "parity_gate": "tests/test_transforms.py::"
+                       "test_full_catalog_parity_gate (PR-7 "
+                       "convention: acc exact-or-gated 2/256, "
+                       "ce < 1e-2)",
+        "tpu_queue": "bench.py pipeline_catalog entry runs the full "
+                     "catalog on the fused ResNet-50 step when an "
+                     "accelerator is reachable (skipped note on CPU)",
+        "models": results,
+    }
+    out = os.path.abspath(args.out)
+    with open(out, "w") as f:
+        json.dump(payload, f, indent=1)
+    print("wrote", out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
