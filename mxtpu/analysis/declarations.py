@@ -51,6 +51,14 @@ LOCK_LEVELS = [
     # dispatch locks.
     ("serving-swap", {("ServingSession", "_swap_lock"),
                       ("WarmExecutableCache", "_lock")}),
+    # stateful decode serving: the session's queue/active bookkeeping
+    # (condition shares the lock — see the alias note above) sits below
+    # serving-swap (a decode hot-swap builds pools, never the reverse)
+    # and above the replica dispatch locks the step loop acquires
+    ("decode", {("DecodeSession", "_lock"), ("DecodeSession", "_work")}),
+    # the slot arena's free-list lock: taken under the session lock at
+    # admit/evict, never holds anything itself except telemetry
+    ("decode-arena", {("SequenceSlotArena", "_lock")}),
     ("pool", {("ExecutorPool", "_rr_lock"), ("ExecutorPool", "_owned_lock"),
               ("_Replica", "lock")}),
     ("slot-state", {("FusedState", "_mem_lock")}),
@@ -178,6 +186,11 @@ HOT_PATHS = {
     # admission runs on EVERY request's submit path: a host sync in a
     # signal read would serialize the whole intake behind the device
     "mxtpu/serving/admission.py": None,
+    # the decode step loop runs per generated token and the arena's
+    # gather/scatter per device step: a stray host sync or f64 ctor
+    # here lands in every token of every sequence
+    "mxtpu/serving/decode/session.py": None,
+    "mxtpu/serving/decode/arena.py": None,
     "mxtpu/predict.py": None,
     "mxtpu/metric.py": {"DeviceKernel", "DeviceMetricAccum"},
     "mxtpu/io.py": {"PrefetchingIter", "DevicePrefetchIter"},

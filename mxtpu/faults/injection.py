@@ -86,6 +86,13 @@ POINTS = {
     "serving.replica.collect":
         "_Replica.collect, before the bulk device→host transfer — "
         "retire-path failure",
+    "serving.decode.step":
+        "DecodeSession step loop, before a bucket step-program "
+        "dispatches — failing or dying decode worker mid-sequence",
+    "serving.decode.evict":
+        "DecodeSession._evict, before a finished/expired sequence's "
+        "slot bookkeeping — failure while retiring a sequence (the "
+        "slot must still return to the free list)",
     "io.prefetch.produce":
         "PrefetchingIter producer thread, before the underlying "
         "iterator's next() — crashing data pipeline",

@@ -71,6 +71,34 @@ class BaseRNNCell(object):
     def _gate_names(self):
         return ("",)
 
+    def state_spec(self, batch_size, dtype="float32"):
+        """Concrete per-state array specs for this cell (stack) at
+        ``batch_size``: a list of ``{"name", "shape", "dtype"}`` dicts,
+        one per ``state_info`` entry, with the reference's batch-dim
+        wildcard (0) resolved to ``batch_size``. The decode slot arena
+        (:mod:`mxtpu.serving.decode`) sizes its device-resident state
+        store from this — state shapes WITHOUT running a warmup batch."""
+        specs = []
+        for i, info in enumerate(self.state_info):
+            if info is None or "shape" not in info:
+                raise MXNetError(
+                    "%s.state_spec: state %d has no declared shape"
+                    % (type(self).__name__, i))
+            shape = tuple(int(batch_size) if s == 0 else int(s)
+                          for s in info["shape"])
+            specs.append({"name": "%sstate_%d" % (self._prefix, i),
+                          "shape": shape, "dtype": dtype})
+        return specs
+
+    def begin_state_arrays(self, batch_size, dtype="float32"):
+        """Concrete zero-state numpy arrays for ``batch_size`` — the
+        initial recurrent state as data rather than Symbols, shaped by
+        :meth:`state_spec`. A fresh decode sequence starts from exactly
+        these (all-zero) values."""
+        import numpy as _np
+        return [_np.zeros(s["shape"], dtype=s["dtype"])
+                for s in self.state_spec(batch_size, dtype=dtype)]
+
     def begin_state(self, func=symbol.zeros, **kwargs):
         assert not self._modified, \
             "After applying modifier cells the base cell cannot be called directly."

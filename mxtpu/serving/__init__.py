@@ -19,6 +19,11 @@ p99 under open-loop load. Pieces:
   * ``metrics``   — qps / shed-rate / batch-fill / in-flight depth /
                     refill latency / latency-percentile observability
                     over ``mxtpu.telemetry``
+  * ``decode``    — stateful autoregressive decode serving: device-
+                    resident per-sequence state (``SequenceSlotArena``)
+                    riding step-granularity continuous batching
+                    (``DecodeSession``, ``POST /v1/generate``) with
+                    length-aware admission — docs/decode.md
 
 See docs/serving.md for architecture and tuning; docs/observability.md
 for the framework-wide telemetry layer this plugs into;
@@ -27,7 +32,8 @@ behind ``BENCH_serving_v2.json``.
 """
 from .admission import (ACCEPTING, DEGRADED, SHEDDING, AdmissionPolicy,
                         AdmissionShed, AdmissionSignals, Decision,
-                        SignalAdmissionPolicy, derive_knobs)
+                        DecodeAdmissionPolicy, SignalAdmissionPolicy,
+                        derive_knobs)
 from .batcher import (BatcherClosed, ContinuousBatcher, DynamicBatcher,
                       QueueFull, WorkItem, pad_rows, pick_bucket)
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
@@ -35,10 +41,13 @@ from .pool import (ExecutorPool, WarmExecutableCache, default_contexts,
                    prewarm, warm_cache)
 from .server import (DEFAULT_BUCKETS, ReplicaCrash, ServingHTTPServer,
                      ServingSession, serve)
+from .decode import (DecodeResult, DecodeSession, DecodeWorkerCrash,
+                     SequenceSlotArena, serve_decode)
 
 __all__ = [
     "ACCEPTING", "DEGRADED", "SHEDDING", "AdmissionPolicy", "AdmissionShed",
-    "AdmissionSignals", "Decision", "SignalAdmissionPolicy", "derive_knobs",
+    "AdmissionSignals", "Decision", "DecodeAdmissionPolicy",
+    "SignalAdmissionPolicy", "derive_knobs",
     "BatcherClosed", "ContinuousBatcher", "DynamicBatcher", "QueueFull",
     "WorkItem", "pad_rows", "pick_bucket",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -46,4 +55,6 @@ __all__ = [
     "warm_cache",
     "DEFAULT_BUCKETS", "ReplicaCrash", "ServingHTTPServer",
     "ServingSession", "serve",
+    "DecodeSession", "DecodeResult", "DecodeWorkerCrash",
+    "SequenceSlotArena", "serve_decode",
 ]

@@ -31,7 +31,8 @@ from __future__ import annotations
 from ..base import MXNetError
 
 __all__ = ["AdmissionShed", "AdmissionSignals", "Decision",
-           "AdmissionPolicy", "SignalAdmissionPolicy", "derive_knobs",
+           "AdmissionPolicy", "SignalAdmissionPolicy",
+           "DecodeAdmissionPolicy", "derive_knobs",
            "mix_service_model",
            "ACCEPTING", "DEGRADED", "SHEDDING", "STATE_NAMES"]
 
@@ -58,12 +59,15 @@ class AdmissionSignals:
     __slots__ = ("queue_depth", "queue_limit", "pending_rows",
                  "inflight_depth", "inflight_limit", "replicas",
                  "est_batch_ms", "est_queue_wait_ms", "watchdog_age_s",
-                 "mem_headroom_frac")
+                 "mem_headroom_frac", "slot_capacity", "slots_free",
+                 "est_join_wait_ms", "est_tokens_ahead")
 
     def __init__(self, queue_depth=0, queue_limit=1, pending_rows=0,
                  inflight_depth=0, inflight_limit=1, replicas=1,
                  est_batch_ms=0.0, est_queue_wait_ms=0.0,
-                 watchdog_age_s=0.0, mem_headroom_frac=None):
+                 watchdog_age_s=0.0, mem_headroom_frac=None,
+                 slot_capacity=0, slots_free=0, est_join_wait_ms=None,
+                 est_tokens_ahead=0):
         self.queue_depth = queue_depth
         self.queue_limit = queue_limit
         self.pending_rows = pending_rows
@@ -74,6 +78,15 @@ class AdmissionSignals:
         self.est_queue_wait_ms = est_queue_wait_ms
         self.watchdog_age_s = watchdog_age_s
         self.mem_headroom_frac = mem_headroom_frac
+        # decode (stateful sequence serving) signals — zero/None for the
+        # stateless predict path, which must keep behaving identically:
+        # slot occupancy of the sequence arena plus the LENGTH-AWARE
+        # est-completion model (per-step cost row × expected remaining
+        # tokens of the sequences ahead — docs/decode.md)
+        self.slot_capacity = slot_capacity
+        self.slots_free = slots_free
+        self.est_join_wait_ms = est_join_wait_ms
+        self.est_tokens_ahead = est_tokens_ahead
 
     def to_dict(self):
         return {k: getattr(self, k) for k in self.__slots__}
@@ -164,6 +177,75 @@ class SignalAdmissionPolicy(AdmissionPolicy):
                             "est queue wait %.1fms past %.0f%% of budget"
                             % (s.est_queue_wait_ms,
                                100.0 * self.degrade_frac))
+        return Decision(True, ACCEPTING, "ok")
+
+
+class DecodeAdmissionPolicy(AdmissionPolicy):
+    """Length-aware admission for stateful decode serving.
+
+    A decode request does not cost one batch: it occupies a sequence
+    slot for its WHOLE remaining length (prompt + generated tokens), so
+    position-based queue limits misprice it in both directions — a full
+    arena of nearly-finished sequences can absorb a deep queue, while a
+    full arena of fresh long sequences cannot absorb anything. The
+    policy therefore prices a request's *end-to-end* admission: the
+    per-step cost row (refined by the live step histogram) times the
+    expected tokens until the slot it needs frees
+    (``est_join_wait_ms`` / ``est_tokens_ahead``, computed by
+    ``DecodeSession._signals`` from the exact remaining-token counts of
+    the in-flight sequences — not from timing).
+
+    Sheds (first match names the reason):
+
+    * ``watchdog`` — no device progress for ``watchdog_shed_s``;
+    * ``slots`` — the arena is full, more than ``join_watermark``
+      requests are already queued for slots, AND the est-completion
+      model says the join wait blows ``join_wait_budget_ms``. Short
+      in-flight mixes keep small remaining-token counts, so the same
+      queue depth still admits behind them (the PR-11 mix-aware
+      pattern, per-sequence);
+    * ``queue`` — absolute queue occupancy backstop, as in
+      :class:`SignalAdmissionPolicy`.
+
+    Between ``degrade_frac`` and 1.0 of the join budget the policy
+    admits but reports DEGRADED. Stateless like its sibling: every
+    decision is a pure function of the snapshot.
+    """
+
+    def __init__(self, join_wait_budget_ms=1000.0, join_watermark=4,
+                 watchdog_shed_s=10.0, queue_frac_shed=0.95,
+                 degrade_frac=0.5):
+        self.join_wait_budget_ms = float(join_wait_budget_ms)
+        self.join_watermark = int(join_watermark)
+        self.watchdog_shed_s = float(watchdog_shed_s)
+        self.queue_frac_shed = float(queue_frac_shed)
+        self.degrade_frac = float(degrade_frac)
+
+    def decide(self, s):
+        if s.watchdog_age_s > self.watchdog_shed_s:
+            return Decision(False, SHEDDING,
+                            "watchdog: no progress for %.1fs"
+                            % s.watchdog_age_s)
+        join_wait = s.est_join_wait_ms or 0.0
+        if s.slot_capacity and s.slots_free == 0 \
+                and s.queue_depth >= self.join_watermark \
+                and join_wait > self.join_wait_budget_ms:
+            return Decision(False, SHEDDING,
+                            "slots: arena full, est join wait %.1fms "
+                            "(%d tokens ahead) over budget %.1fms"
+                            % (join_wait, s.est_tokens_ahead,
+                               self.join_wait_budget_ms))
+        if s.queue_limit and \
+                s.queue_depth >= self.queue_frac_shed * s.queue_limit:
+            return Decision(False, SHEDDING,
+                            "queue: depth %d at %.0f%% of bound %d"
+                            % (s.queue_depth,
+                               100.0 * s.queue_depth / s.queue_limit,
+                               s.queue_limit))
+        if join_wait > self.degrade_frac * self.join_wait_budget_ms:
+            return Decision(True, DEGRADED,
+                            "est join wait %.1fms past %.0f%% of budget"
+                            % (join_wait, 100.0 * self.degrade_frac))
         return Decision(True, ACCEPTING, "ok")
 
 

@@ -1,0 +1,36 @@
+"""mxtpu.serving.decode — stateful autoregressive decode serving.
+
+The LLM-serving scenario class on top of the continuous-batching stack:
+per-request recurrent state lives ON DEVICE in a fixed-capacity
+:class:`SequenceSlotArena` and rides across batch iterations while
+requests join and leave the in-flight batch *between steps* — no drain
+barriers, no idle device steps while admittable work waits. Pieces:
+
+  * ``arena``   — device-resident per-sequence state store: free-slot
+                  allocation, jitted per-bucket gather/scatter
+                  (``decode_state`` programs), ledger-accounted under
+                  the ``decode_state`` origin
+  * ``session`` — the step-granularity worker loop: one jitted
+                  ``(tokens, state) -> (logits, state)`` bucket program
+                  per step (served through ``ExecutorPool`` + the
+                  process warm cache, so it gets AOT cost rows, prewarm
+                  and ``MXTPU_PIPELINE=bf16`` for free), EOS/budget/
+                  deadline retirement, versioned ``swap_model`` with
+                  in-flight sequences pinned to their admission-time
+                  version, and length-aware admission (per-step cost
+                  row × expected remaining tokens)
+  * ``model``   — single-step graph builders for the repo's LSTM LM
+                  (training checkpoint names load unchanged)
+
+HTTP: ``POST /v1/generate`` on the shared serving server
+(``ServingHTTPServer(..., decode=session)`` or :func:`serve_decode`).
+See docs/decode.md.
+"""
+from .arena import SequenceSlotArena
+from .model import lm_decode_fixture, lm_step_symbol
+from .session import (DecodeResult, DecodeSession, DecodeWorkerCrash,
+                      serve_decode)
+
+__all__ = ["SequenceSlotArena", "DecodeSession", "DecodeResult",
+           "DecodeWorkerCrash", "serve_decode", "lm_step_symbol",
+           "lm_decode_fixture"]

@@ -1,0 +1,215 @@
+"""SequenceSlotArena: fixed-capacity device-resident sequence state.
+
+Autoregressive decode carries per-request recurrent state (RNN
+hidden/cell stacks) across continuous-batch iterations. Round-tripping
+that state through the host every step would cost two transfers per
+token per sequence; the arena instead keeps ONE device array per state
+leaf, shaped ``(capacity,) + per_sequence_shape``, and moves only slot
+*indices* across the host boundary:
+
+* ``allocate``/``release`` manage a host-side free list of slot ids —
+  a sequence owns one slot from admission to eviction;
+* ``gather(slots, fresh)`` pulls the active rows into a
+  ``(bucket, ...)`` batch for the step program. Freshly admitted
+  sequences are zeroed IN the gathered batch (the ``fresh`` mask):
+  the arena never needs a separate per-join reset dispatch, so a join
+  costs nothing beyond the step it rides;
+* ``scatter(slots, new_states)`` writes the step's updated state back.
+  Padding rows carry the out-of-bounds index ``capacity`` and are
+  DROPPED by the scatter, so a padded batch can never corrupt a live
+  slot; the old arena buffers are donated, so the update is in-place
+  on device.
+
+Gather/scatter are jitted per bucket size through the compile seam
+(``record_program_build``, kind ``decode_state``), so they appear in
+the diagnostics program table with AOT cost rows like any other
+program. Every arena buffer is accounted in the device-memory ledger
+under the ``decode_state`` origin — ``/debug/state`` and ``mxtpu_top``
+show exactly what sequence state costs, and the chaos tests assert it
+returns to baseline when the arena closes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ... import diagnostics as _diag
+from ...analysis import concurrency as _conc
+from ...base import MXNetError
+from ...compile import pipeline as _pipeline
+
+__all__ = ["SequenceSlotArena"]
+
+
+class SequenceSlotArena:
+    """Device-resident per-sequence state store with slot allocation.
+
+    Parameters
+    ----------
+    capacity : int — maximum concurrently in-flight sequences
+    state_specs : list of ``{"name", "shape", "dtype"}`` dicts (the
+        :meth:`~mxtpu.rnn.BaseRNNCell.state_spec` format at batch 1,
+        or any per-sequence trailing shape with a leading dim of 1)
+    ctx : Context the state lives on (default: current context)
+    dtype : overrides every spec's dtype when given (the bf16-pipeline
+        deployments may keep state in the pipeline dtype)
+    """
+
+    def __init__(self, capacity, state_specs, ctx=None, dtype=None):
+        from ...context import current_context
+        if capacity < 1:
+            raise MXNetError("SequenceSlotArena needs capacity >= 1")
+        if not state_specs:
+            raise MXNetError("SequenceSlotArena needs at least one "
+                             "state spec")
+        self.capacity = int(capacity)
+        self._ctx = ctx or current_context()
+        self.specs = []
+        for s in state_specs:
+            shape = tuple(int(d) for d in s["shape"])
+            if len(shape) < 1:
+                raise MXNetError("state spec %r needs a leading "
+                                 "(batch) dim" % (s,))
+            self.specs.append({"name": s["name"],
+                               "shape": shape[1:],
+                               "dtype": str(dtype or s.get("dtype",
+                                                           "float32"))})
+        dev = self._ctx.jax_device
+        with _diag.alloc_origin("decode_state"):
+            self._arrays = [
+                jax.device_put(jnp.zeros((self.capacity,) + s["shape"],
+                                         dtype=s["dtype"]), dev)
+                for s in self.specs
+            ]
+        nbytes = sum(a.nbytes for a in self._arrays)
+        # slot accounting: scatter donates and replaces the buffers every
+        # step, but their bind-fixed sizes make the ledger entry exact at
+        # zero per-step cost (the executor_outputs convention)
+        self._mem_slot = _diag.ledger().slot(self, nbytes, "decode_state",
+                                             ctx=str(self._ctx))
+        self._free = list(range(self.capacity - 1, -1, -1))
+        self._lock = _conc.lock("SequenceSlotArena", "_lock")
+        # per-bucket jitted gather/scatter, built lazily through the
+        # compile seam so each shows up as a `decode_state` program
+        self._gather_fns = {}
+        self._scatter_fns = {}
+        self._closed = False
+
+    # ---------------------------------------------------------- slots
+    @property
+    def free_slots(self):
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def occupancy(self):
+        """Occupied-slot fraction (the ``decode_slot_occupancy`` gauge)."""
+        with self._lock:
+            return 1.0 - len(self._free) / self.capacity
+
+    def allocate(self):
+        """Claim a free slot id, or None when the arena is full. The
+        slot's state rows are NOT cleared here — the first gather of a
+        fresh sequence zeroes them via the ``fresh`` mask, so admission
+        stays a pure host-side bookkeeping operation."""
+        with self._lock:
+            if self._closed or not self._free:
+                return None
+            return self._free.pop()
+
+    def release(self, slot):
+        """Return ``slot`` to the free list (sequence finished/evicted).
+        The next allocation may reuse it on the very next step."""
+        slot = int(slot)
+        if not 0 <= slot < self.capacity:
+            raise MXNetError("release: slot %d out of range [0, %d)"
+                             % (slot, self.capacity))
+        with self._lock:
+            if slot in self._free:
+                raise MXNetError("release: slot %d is already free" % slot)
+            self._free.append(slot)
+
+    # ------------------------------------------------------- device ops
+    def _bucket_fns(self, bucket):
+        fns = self._gather_fns.get(bucket)
+        if fns is not None:
+            return fns, self._scatter_fns[bucket]
+
+        def _gather(arrays, idx, fresh):
+            out = []
+            for a in arrays:
+                g = jnp.take(a, idx, axis=0, mode="clip")
+                mask = fresh.reshape((-1,) + (1,) * (g.ndim - 1))
+                # fresh rows start from the exact zero begin-state via
+                # select, NOT multiply-by-zero: a previous occupant that
+                # diverged may have scattered NaN/Inf into the slot, and
+                # 0*NaN == NaN would poison every later occupant. Pad
+                # rows gather a clipped slot but are zeroed the same way
+                out.append(jnp.where(mask > 0,
+                                     jnp.zeros((), dtype=g.dtype), g))
+            return out
+
+        def _scatter(arrays, idx, new):
+            # mode="drop": pad rows carry idx == capacity (out of
+            # bounds) and their writes vanish — a padded batch cannot
+            # corrupt a live slot. Old buffers are donated: the arena
+            # updates in place on device.
+            return [a.at[idx].set(n.astype(a.dtype), mode="drop")
+                    for a, n in zip(arrays, new)]
+
+        owner = "decode_arena[b=%d]" % bucket
+        gfn = _pipeline.record_program_build(
+            "decode_state", owner, jax.jit(_gather))
+        sfn = _pipeline.record_program_build(
+            "decode_state", owner, jax.jit(_scatter, donate_argnums=0))
+        self._gather_fns[bucket] = gfn
+        self._scatter_fns[bucket] = sfn
+        return gfn, sfn
+
+    def gather(self, slots, fresh):
+        """Pull the state rows for ``slots`` (int array, pad rows may
+        carry any in-range id) into ``(bucket, ...)`` device arrays,
+        zeroing rows flagged in ``fresh`` (float 0/1 mask — freshly
+        admitted sequences AND pad rows). No host transfer: the result
+        feeds the step program directly."""
+        # mxtpu: allow-sync(slot ids/masks are host-born ints, never
+        # device data — index normalization, not a transfer)
+        idx = _np.asarray(slots, dtype=_np.int32)
+        # mxtpu: allow-sync(see above — host-born 0/1 mask)
+        mask = _np.asarray(fresh, dtype=_np.float32)
+        gfn, _ = self._bucket_fns(len(idx))
+        return gfn(self._arrays, idx, mask)
+
+    def scatter(self, slots, new_states):
+        """Write the step program's updated state rows back into the
+        arena at ``slots``; rows whose index is ``capacity`` (padding)
+        are dropped. Donates the previous buffers — single-consumer by
+        contract (the session's one step loop)."""
+        # mxtpu: allow-sync(host-born slot ids — index normalization)
+        idx = _np.asarray(slots, dtype=_np.int32)
+        _, sfn = self._bucket_fns(len(idx))
+        self._arrays = sfn(self._arrays, idx, list(new_states))
+
+    def state_bytes(self):
+        """Ledger-visible device bytes of the arena (``decode_state``)."""
+        return sum(a.nbytes for a in self._arrays) \
+            if self._arrays else 0
+
+    def close(self):
+        """Release the device buffers and zero the ledger entry. The
+        chaos gate asserts ``decode_state`` returns to its pre-session
+        baseline — this is the seam that guarantees it."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._arrays = None
+            self._free = []
+        self._mem_slot.set(0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()

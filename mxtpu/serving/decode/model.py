@@ -1,0 +1,106 @@
+"""Decode-step graph builders: ``(tokens, state) -> (logits, state)``.
+
+A decode server does not serve the training-time unrolled graph — it
+serves the SINGLE-STEP program, with the recurrent state promoted from
+internal wiring to explicit inputs/outputs so it can live in the
+:class:`~mxtpu.serving.decode.SequenceSlotArena` between steps. This
+module turns the repo's bucketed LSTM LM (examples/rnn/lstm_bucketing)
+into that step program:
+
+* parameter names match the training graph exactly (``embed``,
+  ``lstm_l<k>_*``, ``pred``), so a trained checkpoint's ``arg:`` dict
+  loads unchanged;
+* state inputs are fresh ``decode_state_<i>`` Variables in the cell
+  stack's ``state_info`` order, shaped by
+  :meth:`~mxtpu.rnn.BaseRNNCell.state_spec`;
+* the output group is ``[logits] + next_states`` — raw pre-softmax
+  logits (greedy argmax and temperature sampling both work off them;
+  an in-graph softmax would only add an f32 island for the bf16 pass
+  to carve around).
+
+The resulting symbol is served through the ordinary serving machinery
+(``ExecutorPool`` → ``Predictor`` → ``Executor``), so the step program
+gets AOT cost rows, warm-cache entries and the active compile pipeline
+(``MXTPU_PIPELINE=bf16``) without any decode-specific compile path.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ... import ndarray as _nd
+from ... import symbol as _sym
+from ...base import MXNetError
+
+__all__ = ["lm_step_symbol", "lm_decode_fixture"]
+
+
+def lm_step_symbol(vocab_size, num_embed, num_hidden, num_layers=2,
+                   cell=None):
+    """Single-step LSTM-LM graph: ``data`` ``(N, 1)`` token ids +
+    ``decode_state_*`` ``(N, H)`` states -> ``Group([logits (N, V)] +
+    next states)``.
+
+    ``cell`` overrides the default stacked ``LSTMCell`` (any
+    ``BaseRNNCell`` whose ``state_info`` shapes are ``(batch, ...)``).
+    Returns ``(symbol, state_names, state_specs)`` where ``state_specs``
+    is the per-sequence :meth:`state_spec` list at batch 1 — exactly
+    what ``SequenceSlotArena`` and ``DecodeSession`` consume."""
+    from ...rnn import LSTMCell, SequentialRNNCell
+    if cell is None:
+        cell = SequentialRNNCell()
+        for i in range(num_layers):
+            cell.add(LSTMCell(num_hidden=num_hidden,
+                              prefix="lstm_l%d_" % i))
+    cell.reset()
+    specs = cell.state_spec(1)
+    for s in specs:
+        if len(s["shape"]) != 2:
+            raise MXNetError(
+                "lm_step_symbol serves (batch, features) states; got "
+                "state shape %s — unfuse/flatten the cell first"
+                % (s["shape"],))
+    data = _sym.Variable("data")
+    embed = _sym.Embedding(data=data, input_dim=int(vocab_size),
+                           output_dim=int(num_embed), name="embed")
+    states_in = [_sym.Variable("decode_state_%d" % i)
+                 for i in range(len(specs))]
+    outputs, next_states = cell.unroll(1, inputs=embed,
+                                       begin_state=states_in,
+                                       merge_outputs=True)
+    pred = _sym.Reshape(outputs, shape=(-1, int(num_hidden)))
+    logits = _sym.FullyConnected(data=pred, num_hidden=int(vocab_size),
+                                 name="pred")
+    group = _sym.Group([logits] + list(next_states))
+    state_names = ["decode_state_%d" % i for i in range(len(specs))]
+    return group, state_names, specs
+
+
+def lm_decode_fixture(vocab_size=16, num_embed=8, num_hidden=16,
+                      num_layers=2, seed=0):
+    """A ready-to-serve tiny LM decoder: ``(symbol_json, params,
+    example_shapes, state_names, meta)`` with seeded random weights in
+    the checkpoint ``arg:`` convention — the decode analogue of
+    ``models/serving_fixtures.py`` (tests, bench_decode, examples).
+
+    ``example_shapes`` carries per-request shapes with leading dim 1
+    for EVERY input (tokens and states), which is what ``DecodeSession``
+    / ``ExecutorPool.bucket_shapes`` substitute bucket sizes into."""
+    sym, state_names, specs = lm_step_symbol(
+        vocab_size, num_embed, num_hidden, num_layers=num_layers)
+    example_shapes = {"data": (1, 1)}
+    for name, spec in zip(state_names, specs):
+        example_shapes[name] = (1,) + spec["shape"][1:]
+    rng = _np.random.RandomState(seed)
+    arg_shapes, _, _ = sym.infer_shape(**example_shapes)
+    params = {}
+    for name, shape in zip(sym.list_arguments(), arg_shapes):
+        if name in example_shapes:
+            continue
+        fan_in = int(_np.prod(shape[1:])) if len(shape) > 1 else shape[0]
+        scale = 1.0 / max(1.0, float(_np.sqrt(fan_in)))
+        params["arg:" + name] = _nd.array(
+            rng.uniform(-scale, scale, size=shape).astype(_np.float32))
+    meta = {"vocab_size": int(vocab_size), "num_embed": int(num_embed),
+            "num_hidden": int(num_hidden), "num_layers": int(num_layers),
+            "seed": int(seed)}
+    return sym.tojson(), params, example_shapes, state_names, meta

@@ -33,10 +33,16 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry"]
 
 
 class MetricsRegistry(_BaseRegistry):
-    """Named metrics + correlated span emission for one serving session."""
+    """Named metrics + correlated span emission for one serving session.
 
-    def __init__(self):
-        super().__init__(namespace="mxtpu_serving")
+    ``namespace`` prefixes the Prometheus series and keys the merged
+    ``json_snapshot``; a DecodeSession riding the same HTTP server as a
+    predict session uses ``mxtpu_decode`` so the two registries' shared
+    series names (queue_depth, requests_*, ...) never collide in one
+    scrape."""
+
+    def __init__(self, namespace="mxtpu_serving"):
+        super().__init__(namespace=namespace)
 
     def span(self, name, category="serving"):
         """Correlated trace-span context manager: nests under the ambient

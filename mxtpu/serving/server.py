@@ -804,33 +804,66 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         session = self.server.session
+        decode = self.server.decode
         path, _, query = self.path.partition("?")
         if path in ("/healthz", "/"):
-            if session.closed:
+            # a combined server drains when EITHER attached session is
+            # closed — the balancer must stop routing the moment one of
+            # the two route families starts answering 503
+            closed = any(s.closed for s in (session, decode)
+                         if s is not None)
+            if closed:
                 self._json(503, {"status": "draining"})
-            else:
+                return
+            if session is not None:
                 healthy = session.healthy_replicas()
                 total = len(session.pool)
-                self._json(200, {"status": "degraded" if healthy < total
-                                 else "ok",
-                                 "replicas": total,
-                                 "healthy_replicas": healthy,
-                                 "degraded": healthy < total,
-                                 "buckets": list(session.buckets),
-                                 "mode": session.mode,
-                                 "version": session.version_tag,
-                                 "admission": STATE_NAMES.get(
-                                     session._admission_state, "?")})
+                body = {"status": "degraded" if healthy < total
+                        else "ok",
+                        "replicas": total,
+                        "healthy_replicas": healthy,
+                        "degraded": healthy < total,
+                        "buckets": list(session.buckets),
+                        "mode": session.mode,
+                        "version": session.version_tag,
+                        "admission": STATE_NAMES.get(
+                            session._admission_state, "?")}
+            else:
+                body = {"status": "ok", "mode": "decode",
+                        "buckets": list(decode.buckets),
+                        "version": decode.version_tag,
+                        "admission": STATE_NAMES.get(
+                            decode._admission_state, "?")}
+            if decode is not None and session is not None:
+                body["decode"] = {
+                    "buckets": list(decode.buckets),
+                    "version": decode.version_tag,
+                    "admission": STATE_NAMES.get(
+                        decode._admission_state, "?")}
+            self._json(200, body)
         elif path == "/v1/version":
-            self._json(200, session.version_info())
+            owner = session if session is not None else decode
+            body = owner.version_info()
+            if session is not None and decode is not None:
+                body["decode"] = decode.version_info()
+            self._json(200, body)
         elif path == "/v1/metrics":
             # legacy flat-JSON contract: this session's serving stats
-            self._json(200, session.stats())
+            # (+ the decode session's under "decode" when both attached)
+            owner = session if session is not None else decode
+            body = owner.stats()
+            if session is not None and decode is not None:
+                body["decode"] = decode.stats()
+            self._json(200, body)
         elif path == "/metrics":
             # the full pane: process-wide registry (engine, executor,
-            # fit, kvstore, io) + this session's serving registry.
+            # fit, kvstore, io) + every attached session registry.
             # Prometheus text by default; ?format=json for the same data
-            regs = (_tel.registry(), session.metrics)
+            regs = (_tel.registry(),)
+            if session is not None:
+                regs += (session.metrics,)
+            if decode is not None:
+                regs += (decode.metrics,)
             if "format=json" in query:
                 self._json(200, _tel.json_snapshot(*regs))
             else:
@@ -840,11 +873,15 @@ class _Handler(BaseHTTPRequestHandler):
             # live debug snapshot: buffer ledger, program cost table,
             # flight-recorder ring, engine state, active device waits —
             # what a postmortem dumps, served on demand; plus the serving
-            # panels mxtpu_top renders (admission, version, warm cache)
+            # panels mxtpu_top renders (admission, version, warm cache,
+            # decode slots)
             state = _diag.debug_state()
-            state["serving"] = session.stats()
-            state["serving_admission"] = session.admission_snapshot()
-            state["serving_version"] = session.version_info()
+            if session is not None:
+                state["serving"] = session.stats()
+                state["serving_admission"] = session.admission_snapshot()
+                state["serving_version"] = session.version_info()
+            if decode is not None:
+                state["decode"] = decode.debug_panel()
             state["serving_warm_cache"] = warm_cache().manifest()
             self._json(200, state)
         else:
@@ -853,10 +890,17 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         session = self.server.session
         if self.path in ("/v1/admin/swap",):
-            self._do_swap(session)
+            self._do_swap()
+            return
+        if self.path in ("/v1/generate",):
+            self._do_generate(self.server.decode)
             return
         if self.path not in ("/v1/predict", "/predict"):
             self._json(404, {"error": "unknown path %s" % self.path})
+            return
+        if session is None:
+            self._json(404, {"error": "no predict session attached "
+                             "(decode-only server; POST /v1/generate)"})
             return
         try:
             length = int(self.headers.get("Content-Length", 0))
@@ -900,11 +944,65 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(500, {"error": "%s: %s"
                              % (type(exc).__name__, exc)})
 
-    def _do_swap(self, session):
+    def _do_generate(self, decode):
+        """POST /v1/generate {"prompt": [token ids], "max_new_tokens"?,
+        "eos_id"?, "seed"?, "temperature"?, "timeout_sec"?} -> token ids
+        (and text when the session holds a vocab map). Same overload
+        taxonomy as predict: 429 shed/full, 504 deadline, 503 drain."""
+        if decode is None:
+            self._json(404, {"error": "no decode session attached "
+                             "(pass decode= to ServingHTTPServer)"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(payload, dict) or \
+                    not isinstance(payload.get("prompt"), list):
+                raise ValueError(
+                    "body must be {\"prompt\": [token ids], ...}")
+            prompt = [int(t) for t in payload["prompt"]]
+            kwargs = {}
+            if payload.get("max_new_tokens") is not None:
+                kwargs["max_new_tokens"] = int(payload["max_new_tokens"])
+            if payload.get("eos_id") is not None:
+                kwargs["eos_id"] = int(payload["eos_id"])
+            kwargs["seed"] = int(payload.get("seed", 0))
+            kwargs["temperature"] = float(payload.get("temperature", 0.0))
+            timeout = payload.get("timeout_sec",
+                                  self.server.request_timeout)
+            if timeout is not None:
+                timeout = float(timeout)
+        except (ValueError, TypeError, KeyError) as exc:
+            self._json(400, {"error": str(exc)})
+            return
+        try:
+            result = decode.generate(prompt, timeout=timeout, **kwargs)
+            self._json(200, result)
+        except AdmissionShed as exc:
+            self._json(429, {"error": str(exc), "shed": True})
+        except QueueFull as exc:
+            self._json(429, {"error": str(exc)})
+        except TimeoutError as exc:
+            self._json(504, {"error": str(exc)})
+        except BatcherClosed as exc:
+            self._json(503, {"error": str(exc)})
+        except NumericsError as exc:
+            self._json(500, {"error": str(exc)})
+        except MXNetError as exc:
+            self._json(400, {"error": str(exc)})
+        except Exception as exc:  # backend failure / worker crash
+            self._json(500, {"error": "%s: %s"
+                             % (type(exc).__name__, exc)})
+
+    def _do_swap(self):
         """POST /v1/admin/swap {"symbol_file", "params_file",
-        "version_tag"?}: hot-swap from checkpoint files on the server's
-        filesystem (the rollout surface; in-process callers use
-        ``session.swap_model`` directly).
+        "version_tag"?, "target"?}: hot-swap from checkpoint files on
+        the server's filesystem (the rollout surface; in-process callers
+        use ``session.swap_model`` directly). On a combined server
+        ``"target": "predict"|"decode"`` names which session to roll
+        (default: the predict session when attached, else decode) — a
+        decode checkpoint must never land on the predict pool by
+        routing accident.
 
         Control-plane gating: predict is the open data plane, but a
         model mutation that opens server-side file paths must not be —
@@ -929,6 +1027,17 @@ class _Handler(BaseHTTPRequestHandler):
             symbol_file = payload["symbol_file"]
             params_file = payload["params_file"]
             tag = payload.get("version_tag")
+            target = payload.get("target")
+            if target is None:
+                target = "predict" if self.server.session is not None \
+                    else "decode"
+            if target not in ("predict", "decode"):
+                raise ValueError("target must be 'predict' or 'decode' "
+                                 "(got %r)" % (target,))
+            session = self.server.session if target == "predict" \
+                else self.server.decode
+            if session is None:
+                raise ValueError("no %s session attached" % target)
             with open(symbol_file) as f:
                 symbol_json = f.read()
             params = _nd.load(params_file)
@@ -954,10 +1063,16 @@ class ServingHTTPServer(ThreadingHTTPServer):
     daemon_threads = True
 
     def __init__(self, session, host="127.0.0.1", port=0,
-                 request_timeout=30.0, admin_token=None):
+                 request_timeout=30.0, admin_token=None, decode=None):
         import os
+        if session is None and decode is None:
+            raise MXNetError("ServingHTTPServer needs a ServingSession, "
+                             "a DecodeSession (decode=), or both")
         super().__init__((host, port), _Handler)
         self.session = session
+        # a DecodeSession (mxtpu.serving.decode) answering /v1/generate;
+        # may ride alongside the predict session or alone
+        self.decode = decode
         self.request_timeout = request_timeout
         # gates POST /v1/admin/swap; None (and no env) disables it
         self.admin_token = admin_token if admin_token is not None \
@@ -968,7 +1083,10 @@ class ServingHTTPServer(ThreadingHTTPServer):
         return "http://%s:%d" % self.server_address[:2]
 
     def shutdown(self):
-        self.session.close(drain=True)
+        if self.session is not None:
+            self.session.close(drain=True)
+        if self.decode is not None:
+            self.decode.close(drain=True)
         super().shutdown()
 
 

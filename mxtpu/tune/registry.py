@@ -336,6 +336,23 @@ declare("serving.warm_versions", "int", 4,
         env="MXTPU_SERVING_WARM_VERSIONS",
         help="model versions the process-wide WarmExecutableCache retains")
 
+# --- decode (stateful autoregressive decode serving, docs/decode.md)
+declare("decode.slot_capacity", "int", 8, env="MXTPU_DECODE_SLOTS",
+        candidates=(4, 8, 16, 32), safe_range=(1, 256),
+        help="sequence slots in the device-resident decode state arena "
+             "(in-flight sequences per DecodeSession)")
+declare("decode.max_new_tokens_default", "int", 32,
+        env="MXTPU_DECODE_MAX_NEW_TOKENS",
+        candidates=(16, 32, 64, 128), safe_range=(1, 4096),
+        help="generated-token budget a /v1/generate request gets when it "
+             "does not name its own max_new_tokens")
+declare("decode.join_watermark", "int", 4,
+        env="MXTPU_DECODE_JOIN_WATERMARK",
+        candidates=(1, 2, 4, 8), safe_range=(1, 64),
+        help="requests allowed to queue while the slot arena is full "
+             "before length-aware est-completion pricing starts "
+             "shedding (429)")
+
 # --- elastic (async checkpoint cadence, docs/elastic.md)
 declare("elastic.every_n_steps", "int", 0, env="MXTPU_ELASTIC_EVERY_STEPS",
         candidates=(0, 50, 200, 1000),

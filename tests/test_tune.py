@@ -72,6 +72,9 @@ def test_registry_defaults_are_the_hand_picked_constants():
               "serving.min_mem_headroom": 0.03,
               "serving.queue_frac_shed": 0.95,
               "serving.degrade_frac": 0.5, "serving.warm_versions": 4,
+              "decode.slot_capacity": 8,
+              "decode.max_new_tokens_default": 32,
+              "decode.join_watermark": 4,
               "elastic.every_n_steps": 0, "elastic.epoch_period": 1,
               "elastic.keep": 2, "compile.pipeline": ""}
     for name, want in expect.items():
@@ -109,7 +112,7 @@ def test_registry_version_is_stable_and_knob_sensitive():
     assert len(v1) == 12
     # every catalogued knob belongs to a known subsystem
     subs = {k.subsystem for k in tune.knobs()}
-    assert subs == {"fit", "serving", "elastic", "compile"}
+    assert subs == {"fit", "serving", "decode", "elastic", "compile"}
 
 
 def test_bool_coercion_matches_env_contract():

@@ -81,6 +81,7 @@ def render(metrics, state, width=100):
     """Render one frame as a list of lines (shared by plain and curses)."""
     proc = _parse_series(metrics.get("mxtpu", {}))
     serving = _parse_series(metrics.get("mxtpu_serving", {}))
+    decode_reg = _parse_series(metrics.get("mxtpu_decode", {}))
     lines = []
     bar = "=" * width
     lines.append("mxtpu_top — %s" % time.strftime("%H:%M:%S"))
@@ -131,6 +132,24 @@ def render(metrics, state, width=100):
             % (ver.get("version", "?"), ver.get("generation", "?"),
                ver.get("symbol_hash", "?"), ver.get("swaps", 0),
                len(state.get("serving_warm_cache") or [])))
+        lines.append(bar)
+
+    # ---- decode panel (stateful sequence serving, PR 15)
+    dec = state.get("decode") or {}
+    if dec:
+        cap = dec.get("slot_capacity", 0) or 0
+        occupied = cap - dec.get("free_slots", 0)
+        tps = decode_reg.get("decode_tokens_per_sec", [({}, 0)])[0][1] \
+            if decode_reg else 0
+        adm_d = dec.get("admission") or {}
+        lines.append(
+            "decode: slots %d/%d | active %s queued %s | steps %s | "
+            "tokens %s (%.1f/s) | state %s | admission %s"
+            % (occupied, cap, dec.get("active_sequences", "?"),
+               dec.get("queued", "?"), dec.get("steps", "?"),
+               dec.get("tokens_out", "?"), tps,
+               _fmt_bytes(dec.get("state_bytes", 0)),
+               adm_d.get("state", "?")))
         lines.append(bar)
 
     # ---- memory table
