@@ -56,9 +56,16 @@ LOCK_LEVELS = [
     # serving-swap (a decode hot-swap builds pools, never the reverse)
     # and above the replica dispatch locks the step loop acquires
     ("decode", {("DecodeSession", "_lock"), ("DecodeSession", "_work")}),
-    # the slot arena's free-list lock: taken under the session lock at
-    # admit/evict, never holds anything itself except telemetry
-    ("decode-arena", {("SequenceSlotArena", "_lock")}),
+    # the slot/block arena free-list locks: taken under the session
+    # lock at admit/evict/block-growth, never hold anything themselves
+    # except telemetry
+    ("decode-arena", {("SequenceSlotArena", "_lock"),
+                      ("PagedArena", "_lock")}),
+    # the token-stream queue (condition shares the lock): emit sites
+    # hold session/arena locks while pushing, never the reverse — a
+    # leaf-like level between the arena and the replica dispatch locks
+    ("decode-stream", {("TokenStream", "_lock"),
+                       ("TokenStream", "_ready")}),
     ("pool", {("ExecutorPool", "_rr_lock"), ("ExecutorPool", "_owned_lock"),
               ("_Replica", "lock")}),
     ("slot-state", {("FusedState", "_mem_lock")}),
@@ -191,6 +198,8 @@ HOT_PATHS = {
     # here lands in every token of every sequence
     "mxtpu/serving/decode/session.py": None,
     "mxtpu/serving/decode/arena.py": None,
+    # the stream sits on every retired token's emit path
+    "mxtpu/serving/decode/stream.py": None,
     "mxtpu/predict.py": None,
     "mxtpu/metric.py": {"DeviceKernel", "DeviceMetricAccum"},
     "mxtpu/io.py": {"PrefetchingIter", "DevicePrefetchIter"},

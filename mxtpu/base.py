@@ -113,7 +113,9 @@ def parse_attr(value, proto):
     if ty in (tuple, list):
         if isinstance(value, str):
             v = ast.literal_eval(value) if value.strip() else ()
-            return tuple(v) if not isinstance(v, (tuple, list)) else tuple(v)
+            # attr_repr writes one-element tuples without a trailing
+            # comma ("(1)"), which literal_eval reads back as a scalar
+            return (v,) if not isinstance(v, (tuple, list)) else tuple(v)
         if isinstance(value, (tuple, list)):
             return tuple(value)
         return (value,)

@@ -93,6 +93,14 @@ POINTS = {
         "DecodeSession._evict, before a finished/expired sequence's "
         "slot bookkeeping — failure while retiring a sequence (the "
         "slot must still return to the free list)",
+    "serving.decode.prefill":
+        "DecodeSession._prefill_chunk, before one chunked-prefill "
+        "dispatch — failing prefill mid-prompt (the sequence fails "
+        "alone; its eviction must return every allocated KV block)",
+    "serving.decode.block_alloc":
+        "DecodeSession._ensure_blocks, before the paged arena grows a "
+        "sequence's block table — allocation failure, indistinguishable "
+        "from a dry block pool (per-sequence failure, no leaked blocks)",
     "io.prefetch.produce":
         "PrefetchingIter producer thread, before the underlying "
         "iterator's next() — crashing data pipeline",

@@ -19,7 +19,9 @@ p99 under open-loop load. Pieces:
   * ``metrics``   — qps / shed-rate / batch-fill / in-flight depth /
                     refill latency / latency-percentile observability
                     over ``mxtpu.telemetry``
-  * ``decode``    — stateful autoregressive decode serving: device-
+  * ``decode``    — stateful autoregressive decode serving (and, v2,
+                    the paged KV-cache arena + attention decode +
+                    chunked prefill + token streaming): device-
                     resident per-sequence state (``SequenceSlotArena``)
                     riding step-granularity continuous batching
                     (``DecodeSession``, ``POST /v1/generate``) with
@@ -42,7 +44,8 @@ from .pool import (ExecutorPool, WarmExecutableCache, default_contexts,
 from .server import (DEFAULT_BUCKETS, ReplicaCrash, ServingHTTPServer,
                      ServingSession, serve)
 from .decode import (DecodeResult, DecodeSession, DecodeWorkerCrash,
-                     SequenceSlotArena, serve_decode)
+                     PagedArena, SequenceSlotArena, TokenStream,
+                     serve_decode)
 
 __all__ = [
     "ACCEPTING", "DEGRADED", "SHEDDING", "AdmissionPolicy", "AdmissionShed",
@@ -56,5 +59,5 @@ __all__ = [
     "DEFAULT_BUCKETS", "ReplicaCrash", "ServingHTTPServer",
     "ServingSession", "serve",
     "DecodeSession", "DecodeResult", "DecodeWorkerCrash",
-    "SequenceSlotArena", "serve_decode",
+    "PagedArena", "SequenceSlotArena", "TokenStream", "serve_decode",
 ]

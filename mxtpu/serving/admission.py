@@ -60,14 +60,15 @@ class AdmissionSignals:
                  "inflight_depth", "inflight_limit", "replicas",
                  "est_batch_ms", "est_queue_wait_ms", "watchdog_age_s",
                  "mem_headroom_frac", "slot_capacity", "slots_free",
-                 "est_join_wait_ms", "est_tokens_ahead")
+                 "est_join_wait_ms", "est_tokens_ahead",
+                 "blocks_capacity", "blocks_free")
 
     def __init__(self, queue_depth=0, queue_limit=1, pending_rows=0,
                  inflight_depth=0, inflight_limit=1, replicas=1,
                  est_batch_ms=0.0, est_queue_wait_ms=0.0,
                  watchdog_age_s=0.0, mem_headroom_frac=None,
                  slot_capacity=0, slots_free=0, est_join_wait_ms=None,
-                 est_tokens_ahead=0):
+                 est_tokens_ahead=0, blocks_capacity=0, blocks_free=0):
         self.queue_depth = queue_depth
         self.queue_limit = queue_limit
         self.pending_rows = pending_rows
@@ -87,6 +88,12 @@ class AdmissionSignals:
         self.slots_free = slots_free
         self.est_join_wait_ms = est_join_wait_ms
         self.est_tokens_ahead = est_tokens_ahead
+        # paged-KV observability (zero for slot arenas): the policy's
+        # shed math is slot- and token-based — a full block pool fails
+        # the individual sequence at alloc time instead of shedding at
+        # the door, so these are REPORTED, not judged
+        self.blocks_capacity = blocks_capacity
+        self.blocks_free = blocks_free
 
     def to_dict(self):
         return {k: getattr(self, k) for k in self.__slots__}

@@ -20,17 +20,35 @@ barriers, no idle device steps while admittable work waits. Pieces:
                   version, and length-aware admission (per-step cost
                   row × expected remaining tokens)
   * ``model``   — single-step graph builders for the repo's LSTM LM
-                  (training checkpoint names load unchanged)
+                  (training checkpoint names load unchanged) and the
+                  block-table-aware attention decode pair
+                  (``attn_step_symbol`` / ``attn_prefill_symbol``)
+  * ``stream``  — :class:`TokenStream`, the incremental token side
+                  channel behind ``generate_stream`` and
+                  ``POST /v1/generate?stream=1``
+
+PR-16 generalizes the arena into :class:`PagedArena`: KV-cache state in
+fixed-size blocks (``decode.block_size`` tokens each) allocated per
+sequence as it grows, per-slot block tables, a bucketed
+``(B, max_blocks, block, heads, dim)`` gather view for attention
+decode, and CHUNKED PREFILL (``decode.prefill_chunk_tokens``)
+interleaved with decode steps so a long prompt never stalls generating
+sequences (``decode_prefill_stalls`` counts violations exactly).
 
 HTTP: ``POST /v1/generate`` on the shared serving server
-(``ServingHTTPServer(..., decode=session)`` or :func:`serve_decode`).
+(``ServingHTTPServer(..., decode=session)`` or :func:`serve_decode`);
+``?stream=1`` streams tokens as NDJSON chunks as they retire.
 See docs/decode.md.
 """
-from .arena import SequenceSlotArena
-from .model import lm_decode_fixture, lm_step_symbol
+from .arena import PagedArena, SequenceSlotArena
+from .model import (attn_decode_fixture, attn_prefill_symbol,
+                    attn_step_symbol, lm_decode_fixture, lm_step_symbol)
 from .session import (DecodeResult, DecodeSession, DecodeWorkerCrash,
                       serve_decode)
+from .stream import TokenStream
 
-__all__ = ["SequenceSlotArena", "DecodeSession", "DecodeResult",
-           "DecodeWorkerCrash", "serve_decode", "lm_step_symbol",
-           "lm_decode_fixture"]
+__all__ = ["SequenceSlotArena", "PagedArena", "DecodeSession",
+           "DecodeResult", "DecodeWorkerCrash", "TokenStream",
+           "serve_decode", "lm_step_symbol", "lm_decode_fixture",
+           "attn_step_symbol", "attn_prefill_symbol",
+           "attn_decode_fixture"]

@@ -29,6 +29,24 @@ specific compile path. Admission prices a request's END-TO-END cost —
 per-step cost row × expected remaining tokens of the sequences ahead —
 via :class:`~mxtpu.serving.admission.DecodeAdmissionPolicy`
 (docs/decode.md).
+
+Three arena layouts share this loop (``arena=`` / ``paged=``):
+
+* ``slots`` — the PR-15 contiguous :class:`SequenceSlotArena`
+  (fixed-shape recurrent state per slot, the default);
+* paged ``rows`` — the same recurrent state held as one-token rows in
+  a :class:`PagedArena` (``arena="paged"`` without a ``paged`` bundle):
+  byte-identical tokens to ``slots``, proving the paged gather/scatter
+  math before any attention enters the picture;
+* paged ``kv`` — a growing KV cache in :class:`PagedArena` blocks
+  (``paged=`` an ``attn_decode_fixture``-shaped bundle): block tables
+  grow with the sequence, a CHUNKED PREFILL program primes the cache
+  (``decode.prefill_chunk_tokens`` per dispatch, interleaved with
+  decode steps so a long prompt never stalls a generating sequence —
+  ``decode_prefill_stalls`` counts violations deterministically), and
+  the first token is emitted from the final prefill chunk's logits
+  (``decode_ttft_ms``). Tokens can also stream incrementally
+  (``generate_stream`` → :class:`TokenStream` → chunked HTTP).
 """
 from __future__ import annotations
 
@@ -49,6 +67,7 @@ from ..admission import (ACCEPTING, AdmissionShed, AdmissionSignals,
 from ..batcher import BatcherClosed, QueueFull, pick_bucket
 from ..metrics import MetricsRegistry
 from ..pool import ExecutorPool, default_contexts
+from .stream import TokenStream
 
 __all__ = ["DecodeSession", "DecodeResult", "DecodeWorkerCrash",
            "serve_decode"]
@@ -73,23 +92,37 @@ class DecodeWorkerCrash(Exception):
 
 
 class DecodeResult:
-    """Future for one generate request (``.wait(timeout)`` -> dict)."""
+    """Future for one generate request (``.wait(timeout)`` -> dict).
 
-    __slots__ = ("event", "value", "error", "t_enqueue")
+    With an attached :class:`TokenStream` (``generate_stream``), the
+    terminal transition ALWAYS lands in the stream too: ``finish``
+    pushes ``{"done": result}``, ``fail`` pushes ``{"error", "type"}``
+    — every failure path in the session resolves the result, so a
+    streaming consumer can never be left hanging."""
+
+    __slots__ = ("event", "value", "error", "t_enqueue", "stream")
 
     def __init__(self):
         self.event = threading.Event()
         self.value = None
         self.error = None
         self.t_enqueue = time.monotonic()
+        self.stream = None
 
     def finish(self, value):
         self.value = value
         self.event.set()
+        if self.stream is not None:
+            self.stream.put({"done": value})
+            self.stream.close()
 
     def fail(self, exc):
         self.error = exc
         self.event.set()
+        if self.stream is not None:
+            self.stream.put({"error": str(exc),
+                             "type": type(exc).__name__})
+            self.stream.close()
 
     def wait(self, timeout=None):
         if not self.event.wait(timeout):
@@ -104,9 +137,9 @@ class _Sequence:
     """One in-flight (or queued) generate request."""
 
     __slots__ = ("prompt", "max_new", "eos_id", "seed", "temperature",
-                 "expire_at", "slot", "pool", "version", "fresh", "pos",
-                 "out_tokens", "_rng", "item", "enqueue_step",
-                 "join_step", "finish_step")
+                 "expire_at", "slot", "pool", "prefill_pool", "version",
+                 "fresh", "pos", "out_tokens", "_rng", "item",
+                 "enqueue_step", "join_step", "finish_step")
 
     def __init__(self, prompt, max_new, eos_id, seed, temperature,
                  expire_at):
@@ -118,6 +151,7 @@ class _Sequence:
         self.expire_at = expire_at
         self.slot = None
         self.pool = None
+        self.prefill_pool = None
         self.version = None
         self.fresh = True
         self.pos = 0              # prompt tokens consumed so far
@@ -132,12 +166,18 @@ class _Sequence:
         return self.prompt[self.pos] if self.pos < len(self.prompt) \
             else self.out_tokens[-1]
 
-    def remaining_tokens(self):
+    def remaining_tokens(self, chunk=None):
         """Expected steps to completion: unconsumed prompt + ungenerated
         budget — the length-aware admission model's exact per-sequence
-        basis (no timing involved)."""
-        return (len(self.prompt) - self.pos) \
-            + (self.max_new - len(self.out_tokens))
+        basis (no timing involved). With ``chunk`` (the kv-mode prefill
+        quantum) the unconsumed prompt prices at one step per CHUNK, and
+        the final chunk's step double-counts with the first generated
+        token (prefill emits it), hence the −1."""
+        rem_prompt = len(self.prompt) - self.pos
+        rem_new = self.max_new - len(self.out_tokens)
+        if chunk and rem_prompt > 0:
+            return (rem_prompt + chunk - 1) // chunk + rem_new - 1
+        return rem_prompt + rem_new
 
     def rng(self):
         if self._rng is None:
@@ -180,6 +220,32 @@ class DecodeSession:
         trade (tokens may differ from f32-state decode)
     tuned : TunedConfig artifact (or path); precedence
         ``default < artifact < env < explicit argument``
+    arena : ``"slots"`` (contiguous per-slot state, the default) or
+        ``"paged"`` (block-granular :class:`PagedArena`). Paged without
+        a ``paged`` bundle stores the SAME recurrent state as one-token
+        rows (``rows`` layout — byte-identical tokens to ``slots``);
+        with a bundle it serves a growing KV cache (``kv`` layout).
+    paged : ``attn_decode_fixture``-shaped bundle for the kv layout:
+        ``prefill_symbol_json`` / ``prefill_example_shapes`` /
+        ``prefill_bucket_axes``, ``kv_specs`` (per-TOKEN trailing
+        shapes), ``block_size``, ``max_blocks_per_seq``. The session's
+        ``symbol_json`` / ``example_shapes`` are then the STEP graph
+        (``data`` + ``attn_mask`` + the kv view inputs) and
+        ``state_names`` must be empty.
+    block_size / max_blocks_per_seq / prefill_chunk_tokens : kv-layout
+        geometry and the prefill latency quantum — knobs
+        ``decode.block_size`` (16), ``decode.max_blocks_per_seq`` (16),
+        ``decode.prefill_chunk_tokens`` (32); explicit argument beats
+        the ``paged`` bundle beats env/artifact/default
+    prefill_chunked : False dispatches a sequence's WHOLE remaining
+        prompt as one prefill call (the stall baseline the
+        ``decode_prefill_stalls`` counter exists to indict)
+    prefill_buckets : compiled chunk sizes of the prefill program
+        (default: the resolved ``prefill_chunk_tokens`` alone)
+    kv_blocks : shared KV block pool size (default ``slot_capacity ×
+        max_blocks_per_seq`` — no oversubscription; smaller pools admit
+        more sequences than worst-case fits and fail the overflowing
+        SEQUENCE at block-alloc time, never the whole step)
     """
 
     def __init__(self, symbol_json, params, example_shapes, state_names,
@@ -188,15 +254,43 @@ class DecodeSession:
                  eos_id=None, contexts=None, cache_size=8, warmup=True,
                  max_queue=None, admission="auto",
                  join_wait_budget_ms=None, version_tag="v0", id2word=None,
-                 state_dtype=None, default_timeout=None, tuned=None):
+                 state_dtype=None, default_timeout=None, tuned=None,
+                 arena="slots", paged=None, block_size=None,
+                 max_blocks_per_seq=None, prefill_chunk_tokens=None,
+                 prefill_chunked=True, prefill_buckets=None,
+                 kv_blocks=None):
         from ... import tune as _tune
         self.metrics = MetricsRegistry(namespace="mxtpu_decode")
         _diag.on_session_start()
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self._state_names = list(state_names)
-        for name in ("data",) + tuple(self._state_names):
-            if name not in example_shapes:
-                raise MXNetError("decode example_shapes missing %r" % name)
+        if arena not in ("slots", "paged"):
+            raise MXNetError("arena must be 'slots' or 'paged' (got %r)"
+                             % (arena,))
+        self._kind = "slots" if arena == "slots" \
+            else ("kv" if paged else "rows")
+        pb = dict(paged) if paged else {}
+        if self._kind == "kv":
+            if self._state_names:
+                raise MXNetError(
+                    "kv layout serves a stateless step graph — "
+                    "state_names must be empty (the cache lives in the "
+                    "paged arena, not in recurrent state)")
+            for key in ("prefill_symbol_json", "prefill_example_shapes",
+                        "prefill_bucket_axes", "kv_specs"):
+                if key not in pb:
+                    raise MXNetError("paged bundle missing %r" % key)
+            self._kv_specs = [dict(s) for s in pb["kv_specs"]]
+            self._kv_names = [s["name"] for s in self._kv_specs]
+            for name in ("data", "attn_mask") + tuple(self._kv_names):
+                if name not in example_shapes:
+                    raise MXNetError(
+                        "decode example_shapes missing %r" % name)
+        else:
+            for name in ("data",) + tuple(self._state_names):
+                if name not in example_shapes:
+                    raise MXNetError(
+                        "decode example_shapes missing %r" % name)
         tuned = _tune.artifact(tuned)
         self._tuned = tuned
         self.slot_capacity = _tune.resolve_int(
@@ -211,6 +305,30 @@ class DecodeSession:
         self.max_queue = _tune.resolve_int("serving.max_queue",
                                            explicit=max_queue,
                                            artifact=tuned)
+        # paged geometry: explicit argument beats the bundle beats
+        # env/artifact/knob-default (rows layout pins its own below)
+        self.block_size = _tune.resolve_int(
+            "decode.block_size",
+            explicit=block_size if block_size is not None
+            else pb.get("block_size"), artifact=tuned, floor=1)
+        self.max_blocks_per_seq = _tune.resolve_int(
+            "decode.max_blocks_per_seq",
+            explicit=max_blocks_per_seq if max_blocks_per_seq is not None
+            else pb.get("max_blocks_per_seq"), artifact=tuned, floor=1)
+        self.prefill_chunk_tokens = _tune.resolve_int(
+            "decode.prefill_chunk_tokens", explicit=prefill_chunk_tokens,
+            artifact=tuned, floor=1)
+        self.prefill_chunked = bool(prefill_chunked)
+        self.prefill_buckets = tuple(sorted(set(
+            int(b) for b in (prefill_buckets
+                             or (self.prefill_chunk_tokens,)))))
+        # the declared prefill latency quantum: chunked mode dispatches
+        # at most this many prompt tokens per device call; the unchunked
+        # baseline dispatches up to its largest compiled bucket, and
+        # every oversized dispatch while a generating sequence waits is
+        # a counted stall
+        self._prefill_quantum = self.prefill_chunk_tokens \
+            if self.prefill_chunked else self.prefill_buckets[-1]
         join_wait_budget_ms = _tune.resolve(
             "serving.queue_wait_budget_ms", explicit=join_wait_budget_ms,
             artifact=tuned)
@@ -241,12 +359,52 @@ class DecodeSession:
         if warmup:
             with self.metrics.span("warmup"):
                 self._pool.warmup(self.buckets)
-        from .arena import SequenceSlotArena
-        specs = [{"name": n, "shape": tuple(example_shapes[n]),
-                  "dtype": str(state_dtype or "float32")}
-                 for n in self._state_names]
-        self.arena = SequenceSlotArena(self.slot_capacity, specs,
-                                       ctx=self._contexts[0])
+        from .arena import PagedArena, SequenceSlotArena
+        if self._kind == "kv":
+            self._prefill_symbol_json = pb["prefill_symbol_json"]
+            self._prefill_shapes = {
+                k: tuple(v)
+                for k, v in pb["prefill_example_shapes"].items()}
+            self._prefill_bucket_axes = dict(pb["prefill_bucket_axes"])
+            self._prefill_pool = ExecutorPool(
+                self._prefill_symbol_json, params, self._prefill_shapes,
+                contexts=self._contexts, cache_size=self._cache_size,
+                metrics=self.metrics,
+                version_tag=version_tag + ".prefill",
+                bucket_axes=self._prefill_bucket_axes)
+            if warmup:
+                with self.metrics.span("prefill_warmup"):
+                    self._prefill_pool.warmup(self.prefill_buckets)
+            blocks_total = int(kv_blocks) if kv_blocks is not None \
+                else self.slot_capacity * self.max_blocks_per_seq
+            self.arena = PagedArena(self.slot_capacity, self.block_size,
+                                    blocks_total,
+                                    self.max_blocks_per_seq,
+                                    self._kv_specs,
+                                    ctx=self._contexts[0],
+                                    dtype=state_dtype)
+        elif self._kind == "rows":
+            # recurrent state as one-token rows: block geometry pinned
+            # to one block of one row per slot — the byte-identity
+            # bridge between the contiguous and paged gather math
+            self._prefill_pool = None
+            specs = [{"name": n,
+                      "shape": tuple(example_shapes[n])[1:],
+                      "dtype": str(state_dtype or "float32")}
+                     for n in self._state_names]
+            self.arena = PagedArena(self.slot_capacity, 1,
+                                    self.slot_capacity, 1, specs,
+                                    ctx=self._contexts[0])
+        else:
+            self._prefill_pool = None
+            specs = [{"name": n, "shape": tuple(example_shapes[n]),
+                      "dtype": str(state_dtype or "float32")}
+                     for n in self._state_names]
+            self.arena = SequenceSlotArena(self.slot_capacity, specs,
+                                           ctx=self._contexts[0])
+        # kv-mode admission prices prefill per CHUNK, not per token
+        self._price_chunk = self._prefill_quantum \
+            if self._kind == "kv" else None
         if admission == "auto":
             admission = DecodeAdmissionPolicy(
                 join_wait_budget_ms=join_wait_budget_ms,
@@ -286,6 +444,19 @@ class DecodeSession:
         # the liveness tripwire exists (at 0) from construction so the
         # zero-idle-step gate reads an exact counter, not an absence
         self.metrics.counter("decode_steps_with_admittable_waiting")
+        # prefill/TTFT/paged series exist from construction too — gates
+        # read exact zeros, not absences
+        self.metrics.counter("decode_prefill_chunks")
+        self.metrics.counter("decode_prefill_tokens")
+        self.metrics.counter("decode_prefill_stalls")
+        self.metrics.histogram("decode_ttft_ms")
+        if self._kind != "slots":
+            self.metrics.gauge("decode_kv_blocks_live",
+                               fn=lambda: self.arena.blocks_live)
+            self.metrics.gauge("decode_kv_blocks_free",
+                               fn=lambda: self.arena.blocks_free)
+            self.metrics.gauge("decode_kv_block_occupancy",
+                               fn=lambda: self.arena.block_occupancy)
         self._worker = self._spawn_worker()
 
     # --------------------------------------------------------- versions
@@ -298,7 +469,7 @@ class DecodeSession:
         return self._pool.example_shapes
 
     def swap_model(self, symbol_json, params, version_tag=None,
-                   warmup=True):
+                   warmup=True, prefill_symbol_json=None):
         """Zero-downtime step-model rollout. The incoming pool is built
         and pre-warmed while the old one serves; the flip is one pointer
         swap. Sequences already in flight keep their admission-time pool
@@ -319,8 +490,24 @@ class DecodeSession:
         if warmup:
             with self.metrics.span("swap_warmup"):
                 new_pool.warmup(self.buckets)
+        new_prefill = None
+        if self._kind == "kv":
+            # the prefill program swaps IN LOCKSTEP with the step
+            # program (shared weights): in-flight sequences keep their
+            # admission-time (step, prefill) pool PAIR
+            new_prefill = ExecutorPool(
+                prefill_symbol_json or self._prefill_symbol_json,
+                params, self._prefill_shapes, contexts=self._contexts,
+                cache_size=self._cache_size, metrics=self.metrics,
+                version_tag=version_tag + ".prefill",
+                bucket_axes=self._prefill_bucket_axes)
+            if warmup:
+                with self.metrics.span("swap_warmup"):
+                    new_prefill.warmup(self.prefill_buckets)
         with self._lock:
             self._pool = new_pool
+            if new_prefill is not None:
+                self._prefill_pool = new_prefill
             self._generation += 1
             self.version_tag = version_tag
         self.metrics.counter("model_swaps").inc()
@@ -357,8 +544,10 @@ class DecodeSession:
         token count until the slot a new arrival needs frees (sorted
         per-sequence remaining, not timing)."""
         with self._lock:
-            remaining = sorted(s.remaining_tokens() for s in self._active)
-            queued = [s.remaining_tokens() for s in self._queue]
+            remaining = sorted(s.remaining_tokens(self._price_chunk)
+                               for s in self._active)
+            queued = [s.remaining_tokens(self._price_chunk)
+                      for s in self._queue]
         step_ms, _ = self._est_step_ms()
         free = self.arena.free_slots
         est_join = 0.0
@@ -390,7 +579,9 @@ class DecodeSession:
             slot_capacity=self.slot_capacity,
             slots_free=free,
             est_join_wait_ms=est_join,
-            est_tokens_ahead=tokens_ahead)
+            est_tokens_ahead=tokens_ahead,
+            blocks_capacity=getattr(self.arena, "blocks_total", 0),
+            blocks_free=getattr(self.arena, "blocks_free", 0))
 
     def _admit(self):
         pol = self._admission
@@ -421,10 +612,14 @@ class DecodeSession:
 
     # ------------------------------------------------------------ client
     def generate_async(self, prompt, max_new_tokens=None, eos_id=None,
-                       seed=0, temperature=0.0, timeout=None):
+                       seed=0, temperature=0.0, timeout=None,
+                       stream=False):
         """Enqueue one generate request; returns a :class:`DecodeResult`
         future. Raises AdmissionShed/QueueFull under backpressure (429),
-        BatcherClosed when draining (503)."""
+        BatcherClosed when draining (503). With ``stream=True`` the
+        result carries a :class:`TokenStream` (``result.stream``) that
+        receives every retired token and the terminal done/error
+        event."""
         if self._closed:
             raise BatcherClosed("decode session is closed")
         prompt = [int(t) for t in prompt]
@@ -444,6 +639,15 @@ class DecodeSession:
                 "generate: prompt (%d) + max_new_tokens (%d) over the "
                 "per-request step cap %d"
                 % (len(prompt), max_new, MAX_REQUEST_TOKENS_CAP))
+        if self._kind == "kv":
+            budget = self.block_size * self.max_blocks_per_seq
+            if len(prompt) + max_new > budget:
+                raise MXNetError(
+                    "generate: prompt (%d) + max_new_tokens (%d) over "
+                    "this session's KV budget %d (block_size %d × "
+                    "max_blocks_per_seq %d)"
+                    % (len(prompt), max_new, budget, self.block_size,
+                       self.max_blocks_per_seq))
         timeout = timeout if timeout is not None else self.default_timeout
         self.metrics.counter("requests_received").inc()
         self._admit()
@@ -452,6 +656,11 @@ class DecodeSession:
         seq = _Sequence(prompt, max_new,
                         eos_id if eos_id is not None else self.eos_id,
                         int(seed), float(temperature), expire_at)
+        if stream:
+            # attached BEFORE enqueue: every terminal transition after
+            # this point (finish, fail, timeout, worker death, close)
+            # lands in the stream too
+            seq.item.stream = TokenStream()
         with self._lock:
             if self._closed:
                 raise BatcherClosed("decode session is closed")
@@ -472,6 +681,18 @@ class DecodeSession:
         return self.generate_async(prompt, timeout=timeout,
                                    **kwargs).wait(timeout)
 
+    def generate_stream(self, prompt, timeout=None, **kwargs):
+        """Streaming generate: returns the :class:`TokenStream` whose
+        events are ``{"token", "index"}`` per retired token and a
+        terminal ``{"done": result}`` / ``{"error", "type"}`` — the
+        HTTP layer's ``?stream=1`` backend. The paired future stays
+        reachable as ``stream`` consumers usually only need events;
+        call :meth:`generate_async` with ``stream=True`` directly when
+        both are wanted."""
+        timeout = timeout if timeout is not None else self.default_timeout
+        return self.generate_async(prompt, timeout=timeout, stream=True,
+                                   **kwargs).stream
+
     def stats(self):
         out = self.metrics.to_dict()
         out["decode_steps"] = self._steps
@@ -481,16 +702,42 @@ class DecodeSession:
     def debug_panel(self):
         """The ``/debug/state`` decode block (rendered by
         ``mxtpu_top``): slots, queue, steps, version, admission."""
-        return {"slot_capacity": self.slot_capacity,
-                "free_slots": self.arena.free_slots,
-                "active_sequences": len(self._active),
-                "queued": len(self._queue),
-                "steps": self._steps,
-                "tokens_out": self._tokens_out,
-                "buckets": list(self.buckets),
-                "state_bytes": self.arena.state_bytes(),
-                "version": self.version_info(),
-                "admission": self.admission_snapshot()}
+        panel = {"slot_capacity": self.slot_capacity,
+                 "free_slots": self.arena.free_slots,
+                 "active_sequences": len(self._active),
+                 "queued": len(self._queue),
+                 "steps": self._steps,
+                 "tokens_out": self._tokens_out,
+                 "buckets": list(self.buckets),
+                 "state_bytes": self.arena.state_bytes(),
+                 "arena": self._kind,
+                 "version": self.version_info(),
+                 "admission": self.admission_snapshot()}
+        if self._kind != "slots":
+            panel["kv"] = {"block_size": self.arena.block_size,
+                           "blocks_total": self.arena.blocks_total,
+                           "blocks_free": self.arena.blocks_free,
+                           "blocks_live": self.arena.blocks_live,
+                           "block_bytes": self.arena.block_bytes,
+                           "live_kv_bytes": self.arena.live_kv_bytes()}
+        if self._kind == "kv":
+            panel["prefill"] = {
+                "chunk_tokens": self.prefill_chunk_tokens,
+                "chunked": self.prefill_chunked,
+                "buckets": list(self.prefill_buckets),
+                "chunks": int(self.metrics.counter(
+                    "decode_prefill_chunks").value),
+                "tokens": int(self.metrics.counter(
+                    "decode_prefill_tokens").value),
+                "stalls": int(self.metrics.counter(
+                    "decode_prefill_stalls").value)}
+        return panel
+
+    def _progress_marker(self):
+        """Monotone loop-progress stamp for the drain watchdog: decode
+        steps alone miss a kv-mode drain that is busy prefilling."""
+        return self._steps + int(
+            self.metrics.counter("decode_prefill_chunks").value)
 
     @property
     def closed(self):
@@ -520,9 +767,10 @@ class DecodeSession:
         # still makes step progress; only a STALLED drain is aborted
         self._worker.join(timeout=60)
         while self._worker.is_alive():
-            before = self._steps
+            before = self._progress_marker()
             self._worker.join(timeout=60)
-            if self._worker.is_alive() and self._steps == before:
+            if self._worker.is_alive() \
+                    and self._progress_marker() == before:
                 log.error("decode: close(drain=%s) saw no step progress "
                           "for 60s — aborting the worker", drain)
                 with self._lock:
@@ -624,6 +872,27 @@ class DecodeSession:
             # behind device work. Sequences group by their admission-
             # time pool so a mid-run swap never migrates in-flight state
             # onto new weights.
+            if self._kind == "kv":
+                # one prefill chunk (oldest prefilling sequence, FIFO)
+                # interleaved with ONE decode step per loop iteration:
+                # a long prompt advances one bounded chunk at a time,
+                # generating sequences advance every iteration — the
+                # never-stall contract, counted not timed
+                prefilling = [s for s in active
+                              if s.pos < len(s.prompt)]
+                decoding = [s for s in active
+                            if s.pos >= len(s.prompt)]
+                if prefilling:
+                    s = prefilling[0]
+                    try:
+                        self._prefill_chunk(s, bool(decoding))
+                    except Exception as exc:
+                        self._fail_chunk([s], exc)
+                    except BaseException:
+                        self._fail_chunk([s], DecodeWorkerCrash(
+                            "decode worker died mid-prefill"))
+                        raise
+                active = decoding
             groups = OrderedDict()
             for s in active:
                 groups.setdefault(id(s.pool), (s.pool, []))[1].append(s)
@@ -631,7 +900,10 @@ class DecodeSession:
                 for i in range(0, len(seqs), self.buckets[-1]):
                     chunk = seqs[i:i + self.buckets[-1]]
                     try:
-                        self._step_chunk(pool, chunk)
+                        if self._kind == "kv":
+                            self._step_chunk_kv(pool, chunk)
+                        else:
+                            self._step_chunk(pool, chunk)
                     except Exception as exc:
                         self._fail_chunk(chunk, exc)
                     except BaseException:
@@ -685,8 +957,21 @@ class DecodeSession:
                 break
             s = self._queue.pop(0)
             s.slot = slot
+            if self._kind == "rows":
+                # rows layout: the one state row is block-allocated at
+                # admission — an injected alloc failure fails THIS
+                # request and the slot (with any partial table) is
+                # released in the eviction's finally
+                try:
+                    self._ensure_blocks(s, 1)
+                except Exception as exc:
+                    self._evict(s, "error", swallow=True)
+                    s.item.fail(exc)
+                    self.metrics.counter("requests_failed").inc()
+                    continue
             s.fresh = True
             s.pool = self._pool        # admission-time version pin
+            s.prefill_pool = self._prefill_pool
             s.version = self.version_tag
             s.join_step = self._steps
             self._active.append(s)
@@ -699,15 +984,20 @@ class DecodeSession:
         state back, emit/retire. The only host transfer is the logits."""
         bucket = pick_bucket(len(seqs), self.buckets)
         tokens = _np.zeros((bucket, 1), dtype=_np.float32)
-        idx = _np.full((bucket,), self.arena.capacity, dtype=_np.int32)
+        rows_mode = self._kind == "rows"
+        pad = self.arena.pad_flat_index if rows_mode \
+            else self.arena.capacity
+        idx = _np.full((bucket,), pad, dtype=_np.int32)
         fresh = _np.ones((bucket,), dtype=_np.float32)
         for i, s in enumerate(seqs):
             tokens[i, 0] = s.next_input_token()
-            idx[i] = s.slot
+            idx[i] = self.arena.flat_index(s.slot, 0) if rows_mode \
+                else s.slot
             fresh[i] = 1.0 if s.fresh else 0.0
         _faults.point("serving.decode.step")
         t0 = time.perf_counter()
-        states = self.arena.gather(idx, fresh)
+        states = self.arena.gather_rows(idx, fresh) if rows_mode \
+            else self.arena.gather(idx, fresh)
         rep = pool.replicas[0]
         shapes = pool.bucket_shapes(bucket)
         with rep.lock:
@@ -721,7 +1011,10 @@ class DecodeSession:
             ex.forward(is_train=False, **feed)
             outs = [o._data for o in ex.outputs]
         logits_dev, new_states = outs[0], outs[1:]
-        self.arena.scatter(idx, new_states)
+        if rows_mode:
+            self.arena.scatter_rows(idx, new_states)
+        else:
+            self.arena.scatter(idx, new_states)
         for s in seqs:
             s.fresh = False
         # the per-step host sync: ONE bulk logits transfer, off every
@@ -740,6 +1033,197 @@ class DecodeSession:
         self.metrics.histogram("decode_step_ms").observe(
             (time.perf_counter() - t0) * 1e3)
         self._advance(seqs, logits)
+
+    def _ensure_blocks(self, s, n_tokens):
+        """Grow ``s``'s KV block table to cover ``n_tokens`` positions.
+        The injection point fires FIRST (chaos: a failed allocation must
+        behave exactly like a dry pool); failure is per-SEQUENCE — the
+        caller fails this request and its eviction releases the slot
+        with every block the table already holds."""
+        _faults.point("serving.decode.block_alloc")
+        self.arena.ensure_tokens(s.slot, n_tokens)
+
+    def _emit_token(self, s, token):
+        """The single token-retirement seam: every emitted token —
+        decode step or final prefill chunk — passes through here, so
+        streaming and time-to-first-token observe ALL of them."""
+        first = not s.out_tokens
+        s.out_tokens.append(token)
+        self._tokens_out += 1
+        self.metrics.counter("decode_tokens_total").inc()
+        if first:
+            self.metrics.histogram("decode_ttft_ms").observe(
+                (time.monotonic() - s.item.t_enqueue) * 1e3)
+        if s.item.stream is not None:
+            s.item.stream.put({"token": int(token),
+                               "index": len(s.out_tokens) - 1})
+
+    def _prefill_chunk(self, s, decoding_active):
+        """One bounded prefill dispatch for ONE sequence: embed + attend
+        the next ``≤ quantum`` prompt tokens against the already-cached
+        positions, scatter their k/v rows, and — on the FINAL chunk —
+        sample the first token from the last valid row's logits (the
+        TTFT emit site). Non-final chunks never transfer logits to the
+        host: the decode loop's one-sync-per-step discipline holds."""
+        _faults.point("serving.decode.prefill")
+        t0 = time.perf_counter()
+        p0 = s.pos
+        rem = len(s.prompt) - p0
+        cv = min(rem, self._prefill_quantum, self.prefill_buckets[-1])
+        bucket = pick_bucket(cv, self.prefill_buckets)
+        self._ensure_blocks(s, p0 + cv)
+        T = self.max_blocks_per_seq * self.block_size
+        data = _np.zeros((bucket, 1), dtype=_np.float32)
+        data[:cv, 0] = s.prompt[p0:p0 + cv]
+        mask_cache = _np.zeros((bucket, T), dtype=_np.float32)
+        mask_cache[:cv, :p0] = 1.0
+        mask_chunk = _np.zeros((bucket, bucket), dtype=_np.float32)
+        for c in range(bucket):
+            if c < cv:
+                mask_chunk[c, :c + 1] = 1.0
+            else:
+                # pad rows carry only the self bit: an all-masked
+                # softmax row would be NaN; their (zero-keyed) output
+                # is discarded and their scatter index is the drop
+                # sentinel
+                mask_chunk[c, c] = 1.0
+        kv_valid = _np.zeros((1, T), dtype=_np.float32)
+        kv_valid[0, :p0] = 1.0
+        chunk_valid = _np.zeros((bucket, 1), dtype=_np.float32)
+        chunk_valid[:cv, 0] = 1.0
+        views = self.arena.gather_view([s.slot])
+        pool = s.prefill_pool
+        rep = pool.replicas[0]
+        shapes = pool.bucket_shapes(bucket)
+        with rep.lock:
+            pred = rep.predictor_for(shapes)
+            ex = pred._executor
+            feed = {"data": data, "attn_mask_cache": mask_cache,
+                    "attn_mask_chunk": mask_chunk,
+                    "kv_valid_cache": kv_valid,
+                    "chunk_valid": chunk_valid}
+            for name, view in zip(self._kv_names, views):
+                feed[name] = view
+            ex.forward(is_train=False, **feed)
+            outs = [o._data for o in ex.outputs]
+        logits_dev, kv_rows = outs[0], outs[1:]
+        flat = _np.full((bucket,), self.arena.pad_flat_index,
+                        dtype=_np.int32)
+        for c in range(cv):
+            flat[c] = self.arena.flat_index(s.slot, p0 + c)
+        self.arena.scatter_rows(flat, kv_rows)
+        s.pos = p0 + cv
+        self.metrics.counter("decode_prefill_chunks").inc()
+        self.metrics.counter("decode_prefill_tokens").inc(cv)
+        if cv > self.prefill_chunk_tokens and decoding_active:
+            # the stall indictment, counted not timed: this dispatch
+            # processed more prompt tokens than the declared latency
+            # quantum while a generating sequence sat out the iteration
+            self.metrics.counter("decode_prefill_stalls").inc()
+        self.metrics.histogram("decode_prefill_chunk_ms").observe(
+            (time.perf_counter() - t0) * 1e3)
+        if s.pos < len(s.prompt):
+            return     # mid-prompt: logits stay on device, no sync
+        _diag.wait_begin("decode_prefill_logits")
+        try:
+            # mxtpu: allow-sync(final-chunk logits materialization — the
+            # first-token sample is a host decision, same discipline as
+            # the decode step's one transfer)
+            logits = jax.device_get(logits_dev)
+        finally:
+            _diag.wait_end()
+        if s.expire_at is not None and time.monotonic() > s.expire_at:
+            self._retire(s, error=TimeoutError(
+                "generate exceeded its deadline mid-prefill"),
+                reason="deadline")
+            return
+        # mxtpu: allow-sync(logits already host-materialized above)
+        token = self._sample(_np.asarray(logits)[cv - 1], s)
+        self._emit_token(s, token)
+        if s.eos_id is not None and token == s.eos_id:
+            self._retire(s, reason="eos")
+        elif len(s.out_tokens) >= s.max_new:
+            self._retire(s, reason="length")
+
+    def _step_chunk_kv(self, pool, seqs):
+        """One attention decode step for up to largest-bucket GENERATING
+        sequences: grow block tables, gather the bucketed KV view, run
+        the step program, scatter each sequence's new k/v row at its
+        position, emit one token each. Same one-host-sync shape as the
+        recurrent ``_step_chunk``."""
+        # block growth first, per sequence, before any device work: a
+        # dry pool (or injected alloc fault) fails THAT sequence alone
+        # and the step proceeds for the rest
+        live = []
+        for s in seqs:
+            try:
+                self._ensure_blocks(s, s.pos + 1)
+                live.append(s)
+            except Exception as exc:
+                with self._lock:
+                    if s in self._active:
+                        self._active.remove(s)
+                s.finish_step = self._steps
+                self._evict(s, "error", swallow=True)
+                s.item.fail(exc)
+                self.metrics.counter("requests_failed").inc()
+        if not live:
+            return
+        seqs = live
+        bucket = pick_bucket(len(seqs), self.buckets)
+        T = self.max_blocks_per_seq * self.block_size
+        _faults.point("serving.decode.step")
+        t0 = time.perf_counter()
+        tokens = _np.zeros((bucket, 1), dtype=_np.float32)
+        mask = _np.zeros((bucket, T), dtype=_np.float32)
+        slots = [None] * bucket
+        flat = _np.full((bucket,), self.arena.pad_flat_index,
+                        dtype=_np.int32)
+        for i, s in enumerate(seqs):
+            tokens[i, 0] = s.next_input_token()
+            mask[i, :s.pos] = 1.0
+            slots[i] = s.slot
+            flat[i] = self.arena.flat_index(s.slot, s.pos)
+        views = self.arena.gather_view(slots)
+        rep = pool.replicas[0]
+        shapes = pool.bucket_shapes(bucket)
+        with rep.lock:
+            pred = rep.predictor_for(shapes)
+            ex = pred._executor
+            feed = {"data": tokens, "attn_mask": mask}
+            for name, view in zip(self._kv_names, views):
+                feed[name] = view
+            ex.forward(is_train=False, **feed)
+            outs = [o._data for o in ex.outputs]
+        logits_dev, kv_rows = outs[0], outs[1:]
+        self.arena.scatter_rows(flat, kv_rows)
+        for s in seqs:
+            s.pos += 1
+        _diag.wait_begin("decode_logits")
+        try:
+            # mxtpu: allow-sync(per-step logits materialization — the
+            # single deliberate host transfer of the decode loop;
+            # sampling and EOS checks are host decisions by nature)
+            logits = jax.device_get(logits_dev)
+        finally:
+            _diag.wait_end()
+        self._steps += 1
+        self.metrics.counter("decode_steps_total").inc()
+        self.metrics.histogram("decode_step_ms").observe(
+            (time.perf_counter() - t0) * 1e3)
+        now = time.monotonic()
+        for i, s in enumerate(seqs):
+            if s.expire_at is not None and now > s.expire_at:
+                self._retire(s, error=TimeoutError(
+                    "generate exceeded its deadline mid-decode"),
+                    reason="deadline")
+                continue
+            token = self._sample(logits[i], s)
+            self._emit_token(s, token)
+            if s.eos_id is not None and token == s.eos_id:
+                self._retire(s, reason="eos")
+            elif len(s.out_tokens) >= s.max_new:
+                self._retire(s, reason="length")
 
     def _sample(self, row, seq):
         """Next token from one logits row: greedy argmax at
@@ -772,9 +1256,7 @@ class DecodeSession:
             if s.pos < len(s.prompt):
                 continue   # still prefilling: logits unused by contract
             token = self._sample(logits[i], s)
-            s.out_tokens.append(token)
-            self._tokens_out += 1
-            self.metrics.counter("decode_tokens_total").inc()
+            self._emit_token(s, token)
             if s.eos_id is not None and token == s.eos_id:
                 self._retire(s, reason="eos")
             elif len(s.out_tokens) >= s.max_new:

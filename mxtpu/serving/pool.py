@@ -315,10 +315,24 @@ class ExecutorPool:
 
     def __init__(self, symbol_json, params, example_shapes, contexts=None,
                  cache_size=8, metrics=None, version_tag="v0",
-                 shared_cache=None):
+                 shared_cache=None, bucket_axes=None):
         if not example_shapes:
             raise MXNetError("ExecutorPool requires example_shapes")
         self.example_shapes = {k: tuple(v) for k, v in example_shapes.items()}
+        # which axes of each input the bucket size substitutes into:
+        # default (0,) — the classic leading batch dim. () pins the
+        # example shape (fixed-side inputs, e.g. a single sequence's KV
+        # view under a token-bucketed prefill program); (0, 1) covers
+        # square masks whose both sides are the bucket.
+        self.bucket_axes = {
+            k: tuple(int(a) for a in (bucket_axes or {}).get(k, (0,)))
+            for k in self.example_shapes}
+        for k, axes in self.bucket_axes.items():
+            for a in axes:
+                if not 0 <= a < len(self.example_shapes[k]):
+                    raise MXNetError(
+                        "bucket_axes[%r]=%r out of range for example "
+                        "shape %r" % (k, axes, self.example_shapes[k]))
         contexts = contexts or default_contexts()
         self.metrics = metrics
         self.version_tag = version_tag
@@ -374,8 +388,15 @@ class ExecutorPool:
             return id(executor) in self._owned_ids
 
     def bucket_shapes(self, bucket):
-        return {k: (bucket,) + tuple(s[1:])
-                for k, s in self.example_shapes.items()}
+        """Batch shapes at ``bucket``: the bucket size substituted at
+        each input's declared ``bucket_axes`` (default: leading axis)."""
+        out = {}
+        for k, s in self.example_shapes.items():
+            shape = list(s)
+            for a in self.bucket_axes[k]:
+                shape[a] = int(bucket)
+            out[k] = tuple(shape)
+        return out
 
     def bucket_costs(self):
         """Measured per-bucket cost rows ``{bucket: {exec_ms, flops,

@@ -23,6 +23,8 @@ The HTTP layer is a thin JSON veneer (stdlib ``ThreadingHTTPServer`` —
 zero new dependencies) over the same session:
 
     POST /v1/predict     {"inputs": {"data": [[...]]}}  -> {"outputs": [...]}
+    POST /v1/generate    {"prompt": [ids], ...} -> tokens (decode session;
+                         ``?stream=1`` = chunked NDJSON token stream)
     GET  /v1/metrics     serving metrics JSON
     GET  /v1/version     active model version / generation / symbol hash
     POST /v1/admin/swap  {"symbol_file", "params_file", "version_tag"}
@@ -889,13 +891,14 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         session = self.server.session
-        if self.path in ("/v1/admin/swap",):
+        path, _, query = self.path.partition("?")
+        if path in ("/v1/admin/swap",):
             self._do_swap()
             return
-        if self.path in ("/v1/generate",):
-            self._do_generate(self.server.decode)
+        if path in ("/v1/generate",):
+            self._do_generate(self.server.decode, query)
             return
-        if self.path not in ("/v1/predict", "/predict"):
+        if path not in ("/v1/predict", "/predict"):
             self._json(404, {"error": "unknown path %s" % self.path})
             return
         if session is None:
@@ -944,11 +947,13 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(500, {"error": "%s: %s"
                              % (type(exc).__name__, exc)})
 
-    def _do_generate(self, decode):
+    def _do_generate(self, decode, query=""):
         """POST /v1/generate {"prompt": [token ids], "max_new_tokens"?,
         "eos_id"?, "seed"?, "temperature"?, "timeout_sec"?} -> token ids
         (and text when the session holds a vocab map). Same overload
-        taxonomy as predict: 429 shed/full, 504 deadline, 503 drain."""
+        taxonomy as predict: 429 shed/full, 504 deadline, 503 drain.
+        With ``?stream=1`` the response is a chunked NDJSON stream
+        (:meth:`_stream_generate`) — tokens as they retire."""
         if decode is None:
             self._json(404, {"error": "no decode session attached "
                              "(pass decode= to ServingHTTPServer)"})
@@ -975,6 +980,9 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, TypeError, KeyError) as exc:
             self._json(400, {"error": str(exc)})
             return
+        if query and "stream=1" in query.split("&"):
+            self._stream_generate(decode, prompt, timeout, kwargs)
+            return
         try:
             result = decode.generate(prompt, timeout=timeout, **kwargs)
             self._json(200, result)
@@ -993,6 +1001,73 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as exc:  # backend failure / worker crash
             self._json(500, {"error": "%s: %s"
                              % (type(exc).__name__, exc)})
+
+    def _write_stream_event(self, event):
+        """One NDJSON line as one HTTP/1.1 chunk (manual hex-size
+        framing — ``http.server`` has no chunked writer)."""
+        body = (json.dumps(event) + "\n").encode()
+        self.wfile.write(b"%x\r\n" % len(body) + body + b"\r\n")
+
+    def _stream_generate(self, decode, prompt, timeout, kwargs):
+        """``POST /v1/generate?stream=1``: chunked ``application/
+        x-ndjson``, one event per line as the session retires tokens —
+        ``{"token", "index"}`` each, then a terminal ``{"done": result}``
+        or ``{"error", "type"}``. Errors BEFORE the stream commits keep
+        the ordinary JSON status taxonomy (429/504/503/400/500); once
+        the 200 header is out, every failure — including a mid-stream
+        deadline — arrives as a clean terminal error event followed by
+        the last-chunk marker, never a reset socket."""
+        try:
+            item = decode.generate_async(prompt, timeout=timeout,
+                                         stream=True, **kwargs)
+        except AdmissionShed as exc:
+            self._json(429, {"error": str(exc), "shed": True})
+            return
+        except QueueFull as exc:
+            self._json(429, {"error": str(exc)})
+            return
+        except BatcherClosed as exc:
+            self._json(503, {"error": str(exc)})
+            return
+        except MXNetError as exc:
+            self._json(400, {"error": str(exc)})
+            return
+        except Exception as exc:
+            self._json(500, {"error": "%s: %s"
+                             % (type(exc).__name__, exc)})
+            return
+        # committed: chunked transfer needs HTTP/1.1 on the status line;
+        # one response per connection (the chunked tail is the terminator)
+        self.protocol_version = "HTTP/1.1"
+        self.close_connection = True
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+        # per-event wait: the request deadline plus margin (the SESSION
+        # enforces the deadline and pushes the terminal error event; this
+        # bound only catches a wedged producer)
+        wait_s = (timeout + 5.0) if timeout is not None \
+            else (self.server.request_timeout or 30.0)
+        try:
+            while True:
+                try:
+                    ev = item.stream.get(wait_s)
+                except TimeoutError as exc:
+                    self._write_stream_event(
+                        {"error": str(exc), "type": "TimeoutError"})
+                    break
+                if ev is None:
+                    break
+                self._write_stream_event(ev)
+                if "done" in ev or "error" in ev:
+                    break
+            self.wfile.write(b"0\r\n\r\n")
+        except OSError:
+            # client went away mid-stream: the sequence finishes (or
+            # deadlines) server-side; events drop at the closed socket
+            pass
 
     def _do_swap(self):
         """POST /v1/admin/swap {"symbol_file", "params_file",

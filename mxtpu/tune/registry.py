@@ -352,6 +352,23 @@ declare("decode.join_watermark", "int", 4,
         help="requests allowed to queue while the slot arena is full "
              "before length-aware est-completion pricing starts "
              "shedding (429)")
+declare("decode.block_size", "int", 16, env="MXTPU_DECODE_BLOCK_SIZE",
+        candidates=(8, 16, 32, 64), safe_range=(1, 1024),
+        help="tokens per KV-cache block in the paged decode arena "
+             "(allocation granularity: a sequence holds "
+             "ceil(tokens/block_size) blocks)")
+declare("decode.max_blocks_per_seq", "int", 16,
+        env="MXTPU_DECODE_MAX_BLOCKS_PER_SEQ",
+        candidates=(8, 16, 32, 64), safe_range=(1, 512),
+        help="block-table length per sequence slot — block_size × this "
+             "is the per-request token budget AND the bucketed "
+             "attention view's time extent")
+declare("decode.prefill_chunk_tokens", "int", 32,
+        env="MXTPU_DECODE_PREFILL_CHUNK",
+        candidates=(16, 32, 64, 128), safe_range=(1, 4096),
+        help="prompt tokens per chunked-prefill dispatch — the prefill "
+             "latency quantum: a longer prompt never occupies the "
+             "decode loop for more than one chunk per iteration")
 
 # --- elastic (async checkpoint cadence, docs/elastic.md)
 declare("elastic.every_n_steps", "int", 0, env="MXTPU_ELASTIC_EVERY_STEPS",

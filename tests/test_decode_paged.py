@@ -1,0 +1,520 @@
+"""Paged KV-cache decode serving (PR-16: PagedArena, attention decode,
+chunked prefill, token streaming).
+
+Tier-1 (CPU, `not slow`) unless marked. The PR's acceptance gates, all
+on exact counters / byte comparisons per the PR-2 deterministic
+convention:
+
+* **paged gather math** — the `rows` layout (recurrent state as
+  one-token rows in a `PagedArena`) emits byte-identical tokens to the
+  PR-15 contiguous arena on the same fixture and arrival schedule;
+* **attention decode** — kv-layout tokens are byte-identical joined vs
+  alone (bf16 pipeline and mid-run hot-swap in the slow tier), and
+  NaN-poisoned unwritten cache blocks leave every token unchanged
+  (select-not-multiply inertness, proven end-to-end);
+* **never-stall** — a long prompt chunked at
+  `decode.prefill_chunk_tokens` causes ZERO oversized prefill
+  dispatches while a generating sequence waits
+  (`decode_prefill_stalls == 0`, exact counter); the unchunked
+  baseline on the same schedule shows >= 1;
+* **ledger exactness** — the `decode_kv` origin's live bytes equal
+  `blocks_live x block_bytes` at every transition, and injected
+  prefill / block-alloc / evict failures never leak a KV block (free
+  list exact, ledger back to baseline);
+* **streaming** — `?stream=1` delivers every token then a terminal
+  event; a mid-stream deadline terminates the chunked response
+  cleanly, and pre-commit errors keep the JSON status taxonomy.
+"""
+import http.client
+import json
+import threading
+import time
+
+import jax.numpy as jnp
+import pytest
+
+import mxtpu.diagnostics as diag
+from mxtpu import faults
+from mxtpu.analysis import concurrency as conc
+from mxtpu.base import MXNetError
+from mxtpu.serving import DecodeSession, ServingHTTPServer
+from mxtpu.serving.decode import (PagedArena, TokenStream,
+                                  attn_decode_fixture, lm_decode_fixture)
+
+# shared fixtures + one version tag per weight set: sessions adopt the
+# process warm cache, so the suite pays each program compile once
+_LM = {}
+_ATTN = {}
+
+
+def _lm(seed=0):
+    if seed not in _LM:
+        _LM[seed] = lm_decode_fixture(seed=seed)
+    return _LM[seed]
+
+
+def _attn(seed=0):
+    if seed not in _ATTN:
+        _ATTN[seed] = attn_decode_fixture(seed=seed)
+    return _ATTN[seed]
+
+
+def _rows_or_slots_session(arena, seed=0, **kwargs):
+    sym, params, shapes, state_names, _ = _lm(seed)
+    kwargs.setdefault("buckets", (4,))
+    kwargs.setdefault("slot_capacity", 2)
+    kwargs.setdefault("version_tag", "tp-v%d" % seed)
+    return DecodeSession(sym, params, shapes, state_names, arena=arena,
+                         **kwargs)
+
+
+def _kv_session(seed=0, **kwargs):
+    fx = _attn(seed)
+    kwargs.setdefault("buckets", (2,))
+    kwargs.setdefault("slot_capacity", 2)
+    kwargs.setdefault("prefill_chunk_tokens", 2)
+    kwargs.setdefault("prefill_buckets", (2,))
+    kwargs.setdefault("version_tag", "tkv-v%d" % seed)
+    return DecodeSession(fx["step_symbol_json"], fx["params"],
+                         fx["step_example_shapes"], [], arena="paged",
+                         paged=fx, **kwargs)
+
+
+REQS = [([3, 5], 5, 0, 0.0), ([2], 6, 1, 0.5), ([7, 8, 9], 4, 2, 0.5),
+        ([4], 5, 3, 0.0), ([6, 2], 3, 4, 0.9)]
+
+
+def _run_joined(sess, reqs):
+    """Seeded concurrent arrival schedule: join/leave churn between
+    steps (capacity < request count forces queue + slot reuse)."""
+    res = [None] * len(reqs)
+
+    def run(i):
+        prompt, max_new, rseed, temp = reqs[i]
+        res[i] = sess.generate(prompt, max_new_tokens=max_new,
+                               seed=rseed, temperature=temp, timeout=60)
+
+    ts = [threading.Thread(target=run, args=(i,))
+          for i in range(len(reqs))]
+    for j, t in enumerate(ts):
+        t.start()
+        if j % 2:
+            time.sleep(0.003)
+    for t in ts:
+        t.join(timeout=120)
+    assert all(r is not None for r in res), "hung generate waiter"
+    return [r["tokens"] for r in res]
+
+
+# --------------------------------------------------- paged rows layout
+def test_paged_rows_byte_identity_with_contiguous_slots():
+    """The paged gather/scatter math proven on the PR-15 fixture before
+    any attention enters: same requests, same churny schedule, tokens
+    byte-identical between the contiguous and rows layouts."""
+    with _rows_or_slots_session("slots") as sess:
+        baseline = _run_joined(sess, REQS)
+        assert sess.metrics.counter(
+            "decode_steps_with_admittable_waiting").value == 0
+    with _rows_or_slots_session("paged") as sess:
+        assert sess.arena.__class__ is PagedArena
+        paged = _run_joined(sess, REQS)
+        assert sess.metrics.counter(
+            "decode_steps_with_admittable_waiting").value == 0
+    assert paged == baseline
+
+
+# ------------------------------------------------------ arena geometry
+def test_paged_arena_ledger_exact_and_free_list():
+    """decode_kv live bytes == blocks_live x block_bytes at EVERY
+    transition; release returns the exact block set; over-budget and
+    dry-pool growth raise without losing blocks."""
+    base = diag.ledger().live_bytes(origin="decode_kv")
+    specs = [{"name": "k", "shape": (2, 4), "dtype": "float32"},
+             {"name": "v", "shape": (2, 4), "dtype": "float32"}]
+    with PagedArena(2, 4, 5, 3, specs) as a:
+        # 2 leaves x block_size 4 x (2x4) f32 elements = 256 B/block
+        assert a.block_bytes == 256
+        s0 = a.allocate()
+        s1 = a.allocate()
+        assert a.allocate() is None
+        a.ensure_tokens(s0, 5)          # 2 blocks
+        a.ensure_tokens(s1, 4)          # 1 block
+        assert a.blocks_live == 3
+        assert diag.ledger().live_bytes(origin="decode_kv") \
+            == base + 3 * a.block_bytes
+        with pytest.raises(MXNetError):
+            a.ensure_tokens(s1, 13)     # > max_blocks_per_seq (3)
+        a.ensure_tokens(s1, 12)         # to the cap is fine
+        assert a.blocks_live == 5
+        with pytest.raises(MXNetError):
+            a.ensure_tokens(s0, 9)      # pool dry (5 total, 5 live)
+        a.release(s1)
+        assert a.blocks_live == 2 and a.blocks_free == 3
+        assert diag.ledger().live_bytes(origin="decode_kv") \
+            == base + 2 * a.block_bytes
+        a.release(s0)
+        assert a.blocks_free == a.blocks_total
+        assert diag.ledger().live_bytes(origin="decode_kv") == base
+    assert diag.ledger().live_bytes(origin="decode_kv") == base
+
+
+# --------------------------------------------------- attention decode
+def test_attn_joined_vs_alone_byte_identity():
+    """kv layout: the same requests decoded under churn and alone emit
+    byte-identical tokens (chunked prefill + paged attention included),
+    and no KV block survives the last retirement."""
+    reqs = [([1, 2, 3, 4, 5], 5, 0, 0.0), ([3, 1], 5, 1, 0.5),
+            ([2, 2, 2, 2, 2, 2, 2], 4, 2, 0.5), ([4], 6, 3, 0.9)]
+    with _kv_session() as sess:
+        joined = _run_joined(sess, reqs)
+        alone = [sess.generate(p, max_new_tokens=m, seed=s,
+                               temperature=t, timeout=60)["tokens"]
+                 for p, m, s, t in reqs]
+        assert sess.arena.blocks_free == sess.arena.blocks_total
+        assert sess.metrics.counter("decode_prefill_stalls").value == 0
+    assert joined == alone
+
+
+def test_attn_padded_blocks_provably_inert():
+    """NaN-poison the ENTIRE kv pool right after construction: every
+    row a valid lane can see is scattered before it is read, and pad
+    lanes are select-not-multiply masked — so tokens are byte-identical
+    to the clean run even with NaN garbage underneath."""
+    reqs = [([1, 2, 3, 4, 5], 4, 0, 0.0), ([3, 1], 4, 1, 0.5)]
+    with _kv_session() as sess:
+        clean = [sess.generate(p, max_new_tokens=m, seed=s,
+                               temperature=t, timeout=60)["tokens"]
+                 for p, m, s, t in reqs]
+    with _kv_session() as sess:
+        sess.arena._arrays = [jnp.full_like(x, jnp.nan)
+                              for x in sess.arena._arrays]
+        poisoned = [sess.generate(p, max_new_tokens=m, seed=s,
+                                  temperature=t, timeout=60)["tokens"]
+                    for p, m, s, t in reqs]
+    assert poisoned == clean
+
+
+@pytest.mark.slow
+def test_attn_byte_identity_under_bf16_pipeline(monkeypatch):
+    """The kv step/prefill programs ride the active compile pipeline:
+    under MXTPU_PIPELINE=bf16 decode still emits the same tokens joined
+    vs alone (bf16 vs f32 tokens MAY differ; determinism must not)."""
+    monkeypatch.setenv("MXTPU_PIPELINE", "bf16")
+    reqs = [([1, 2, 3, 4, 5], 5, 0, 0.0), ([3, 1], 5, 1, 0.5),
+            ([2, 2, 2, 2, 2], 4, 2, 0.5)]
+    with _kv_session(version_tag="tkv-bf16") as sess:
+        joined = _run_joined(sess, reqs)
+        alone = [sess.generate(p, max_new_tokens=m, seed=s,
+                               temperature=t, timeout=60)["tokens"]
+                 for p, m, s, t in reqs]
+    assert joined == alone
+
+
+@pytest.mark.slow
+def test_attn_swap_model_mid_run_byte_identity():
+    """A mid-run hot-swap rebuilds the (step, prefill) pool PAIR in
+    lockstep: sequences admitted before the flip finish on the old
+    weights byte-identically; post-flip sequences run the new ones."""
+    fx = _attn(0)
+    fx2 = _attn(1)
+    with _kv_session() as sess:
+        before = sess.generate([1, 2, 3, 4, 5], max_new_tokens=4,
+                               timeout=60)["tokens"]
+        item = sess.generate_async([1, 2, 3, 4, 5], max_new_tokens=4,
+                                   timeout=60, stream=True)
+        # the first streamed token proves the sequence was ADMITTED
+        # (old pool pinned) before the flip below
+        first = item.stream.get(60)
+        assert "token" in first
+        sess.swap_model(fx2["step_symbol_json"], fx2["params"],
+                        version_tag="tkv-v1-swap",
+                        prefill_symbol_json=fx2["prefill_symbol_json"])
+        inflight = item.wait(60)
+        after = sess.generate([1, 2, 3, 4, 5], max_new_tokens=4,
+                              timeout=60)
+        # in-flight rode its admission-time version...
+        assert inflight["tokens"] == before
+        # ...and post-swap traffic really changed weights
+        assert after["version"] == "tkv-v1-swap"
+        with _kv_session(seed=1, version_tag="tkv-v1-swap-ref") as ref:
+            assert after["tokens"] == ref.generate(
+                [1, 2, 3, 4, 5], max_new_tokens=4, timeout=60)["tokens"]
+
+
+# ------------------------------------------------- chunked prefill
+def test_long_prompt_never_stalls_decode_chunked_vs_baseline():
+    """THE TTFT/stall acceptance gate, on exact counters: with chunked
+    prefill a long prompt produces ZERO oversized prefill dispatches
+    while a generating sequence waits; the unchunked baseline on the
+    same schedule produces >= 1. Liveness tripwire stays 0 in both."""
+    def run(chunked, tag):
+        kwargs = dict(prefill_chunk_tokens=2, version_tag=tag)
+        if chunked:
+            kwargs["prefill_buckets"] = (2,)
+        else:
+            kwargs.update(prefill_chunked=False, prefill_buckets=(8,))
+        with _kv_session(**kwargs) as sess:
+            short = sess.generate_async([1], max_new_tokens=15,
+                                        timeout=60)
+            # the short request must be GENERATING when the long prompt
+            # arrives — wait for its first emitted token
+            deadline = time.monotonic() + 30
+            while sess.metrics.counter("decode_tokens_total").value < 1 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.002)
+            long = sess.generate_async([1, 2, 3, 4, 5, 6, 7, 8],
+                                       max_new_tokens=4, timeout=60)
+            a, b = short.wait(60), long.wait(60)
+            assert len(a["tokens"]) == 15 and len(b["tokens"]) == 4
+            assert sess.metrics.counter(
+                "decode_steps_with_admittable_waiting").value == 0
+            stalls = sess.metrics.counter("decode_prefill_stalls").value
+            chunks = sess.metrics.counter("decode_prefill_chunks").value
+            ttft_n = sess.stats()["decode_ttft_ms"]["count"]
+        return stalls, chunks, ttft_n
+
+    stalls_c, chunks_c, ttft_c = run(True, "tkv-chunked")
+    stalls_u, chunks_u, _ = run(False, "tkv-unchunked")
+    assert stalls_c == 0              # the never-stall contract
+    assert stalls_u >= 1              # the indicted baseline
+    assert chunks_c > chunks_u        # same prompt, bounded quanta
+    assert ttft_c >= 2                # every request observed TTFT
+
+
+def test_prefill_chunk_pricing_math():
+    """Admission prices the remaining prompt at one step per CHUNK; the
+    final chunk's step double-counts with the first generated token."""
+    from mxtpu.serving.decode.session import _Sequence
+    s = _Sequence(list(range(10)), 6, None, 0, 0.0, None)
+    assert s.remaining_tokens() == 16             # per-token (rows/slots)
+    assert s.remaining_tokens(4) == 3 + 6 - 1     # ceil(10/4) chunks
+    s.pos = 8
+    assert s.remaining_tokens(4) == 1 + 6 - 1
+    s.pos = 10
+    s.out_tokens = [1]
+    assert s.remaining_tokens(4) == 5             # prompt done: rem_new
+
+
+def test_paged_knob_resolution_precedence(monkeypatch):
+    """decode.block_size / max_blocks_per_seq / prefill_chunk_tokens:
+    bundle beats artifact/default, explicit beats bundle, env reaches
+    sessions that get neither (hand-picked defaults preserved)."""
+    from mxtpu.tune import registry as treg
+    assert treg.get_knob("decode.block_size").default == 16
+    assert treg.get_knob("decode.max_blocks_per_seq").default == 16
+    assert treg.get_knob("decode.prefill_chunk_tokens").default == 32
+    # kv session: the fixture bundle's geometry (4, 4) wins over the
+    # knob defaults; explicit argument wins over the bundle
+    with _kv_session(warmup=False) as sess:
+        assert sess.block_size == 4
+        assert sess.max_blocks_per_seq == 4
+        assert sess.prefill_chunk_tokens == 2    # explicit in _kv_session
+    with _kv_session(warmup=False, kv_blocks=6, block_size=4,
+                     max_blocks_per_seq=3) as sess:
+        assert sess.max_blocks_per_seq == 3      # explicit beats bundle
+        assert sess.arena.blocks_total == 6      # explicit pool size
+    monkeypatch.setenv("MXTPU_DECODE_BLOCK_SIZE", "64")
+    with _rows_or_slots_session("slots", warmup=False) as sess:
+        assert sess.block_size == 64             # env beats default
+
+
+def test_kv_budget_refused_at_submit():
+    with _kv_session(warmup=False) as sess:
+        budget = sess.block_size * sess.max_blocks_per_seq
+        with pytest.raises(MXNetError):
+            sess.generate_async([1] * budget, max_new_tokens=1)
+
+
+# ------------------------------------------------------------- chaos
+def test_chaos_prefill_and_block_alloc_leak_nothing():
+    """Injected prefill failures and block-alloc failures (each
+    indistinguishable from a dry pool) fail individual requests but
+    leak nothing: free list exact, decode_kv ledger back to baseline,
+    the session keeps serving."""
+    base = diag.ledger().live_bytes(origin="decode_kv")
+    with _kv_session() as sess:
+        with faults.scope("serving.decode.prefill:p=1.0,seed=2,times=3"):
+            for i in range(3):
+                with pytest.raises(Exception):
+                    sess.generate([1, 2, 3, 4], max_new_tokens=2,
+                                  timeout=30)
+        with faults.scope(
+                "serving.decode.block_alloc:p=1.0,seed=3,times=2"):
+            for i in range(2):
+                with pytest.raises(Exception):
+                    sess.generate([1, 2], max_new_tokens=2, timeout=30)
+        assert sess.arena.blocks_free == sess.arena.blocks_total
+        assert sess.arena.free_slots == sess.arena.capacity
+        assert diag.ledger().live_bytes(origin="decode_kv") == base
+        # post-chaos the same session still serves
+        r = sess.generate([1, 2, 3], max_new_tokens=2, timeout=30)
+        assert r["finish_reason"] == "length"
+        assert sess.arena.blocks_free == sess.arena.blocks_total
+    assert diag.ledger().live_bytes(origin="decode_kv") == base
+
+
+def test_evict_injection_never_leaks_blocks():
+    """The _evict finally contract extended to the paged arena: an
+    injected eviction failure may fail the request, but every block in
+    the table comes back."""
+    with _kv_session() as sess:
+        with faults.scope("serving.decode.evict:p=1.0,seed=1,times=3"):
+            for i in range(3):
+                try:
+                    sess.generate([1, 2, 3], max_new_tokens=2,
+                                  timeout=30)
+                except Exception:
+                    pass
+        assert sess.arena.blocks_free == sess.arena.blocks_total
+        assert sess.arena.free_slots == sess.arena.capacity
+
+
+# -------------------------------------------------------- concurrency
+def test_armed_witness_kv_gate():
+    """Concurrent kv decode (arena + stream locks live) under the armed
+    lock-order witness: zero violations, acyclic observed graph."""
+    with conc.scope() as w:
+        with _kv_session() as sess:
+            stream = sess.generate_stream([1, 2, 3], max_new_tokens=3,
+                                          timeout=60)
+            toks = [e for e in stream.events(timeout=60)]
+            assert any("done" in e for e in toks)
+            _run_joined(sess, [([2, 3], 3, 0, 0.0), ([1], 3, 1, 0.5),
+                               ([4, 5, 6], 3, 2, 0.0)])
+    rep = w.report()
+    assert w.violations == 0, rep.render()
+    assert w.state()["acyclic"], w.state()["cycles"]
+
+
+# ---------------------------------------------------------- streaming
+def test_token_stream_unit():
+    s = TokenStream()
+    s.put({"token": 1, "index": 0})
+    s.put({"done": {}})
+    s.close()
+    s.put({"token": 9, "index": 9})       # dropped after close
+    assert s.get(1) == {"token": 1, "index": 0}
+    assert s.get(1) == {"done": {}}
+    assert s.get(1) is None and s.closed
+    empty = TokenStream()
+    with pytest.raises(TimeoutError):
+        empty.get(0.01)
+
+
+def test_generate_stream_events_match_result():
+    with _kv_session() as sess:
+        item = sess.generate_async([1, 2, 3, 4, 5], max_new_tokens=4,
+                                   stream=True, timeout=60)
+        events = list(item.stream.events(timeout=60))
+        tokens = [e["token"] for e in events if "token" in e]
+        done = [e for e in events if "done" in e]
+        assert done and done[0]["done"]["tokens"] == tokens
+        assert [e["index"] for e in events if "token" in e] \
+            == list(range(len(tokens)))
+        assert item.wait(1)["tokens"] == tokens
+
+
+def test_stream_closed_on_every_failure_path():
+    """A failing request's stream terminates with the error event —
+    never a hung consumer (here: injected prefill failure)."""
+    with _kv_session() as sess:
+        with faults.scope("serving.decode.prefill:p=1.0,seed=5,times=1"):
+            stream = sess.generate_stream([1, 2, 3, 4], max_new_tokens=2,
+                                          timeout=30)
+            events = list(stream.events(timeout=30))
+        assert events and "error" in events[-1]
+
+
+# --------------------------------------------------------------- HTTP
+def _http_sess():
+    sess = _kv_session()
+    server = ServingHTTPServer(None, decode=sess, port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return sess, server
+
+
+def test_http_stream_tokens_and_terminal_event():
+    sess, server = _http_sess()
+    try:
+        host, port = server.server_address[:2]
+        c = http.client.HTTPConnection(host, port, timeout=60)
+        c.request("POST", "/v1/generate?stream=1",
+                  json.dumps({"prompt": [1, 2, 3, 4, 5],
+                              "max_new_tokens": 4, "seed": 1,
+                              "temperature": 0.5}),
+                  {"Content-Type": "application/json"})
+        r = c.getresponse()
+        assert r.status == 200
+        assert r.getheader("Transfer-Encoding") == "chunked"
+        assert r.getheader("Content-Type") == "application/x-ndjson"
+        lines = [json.loads(l) for l in r if l.strip()]
+        c.close()
+        tokens = [e["token"] for e in lines if "token" in e]
+        done = [e for e in lines if "done" in e]
+        assert done and done[0]["done"]["tokens"] == tokens
+        assert len(tokens) == 4
+        # plain (non-stream) POST still returns one JSON body
+        c = http.client.HTTPConnection(host, port, timeout=60)
+        c.request("POST", "/v1/generate",
+                  json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 2}),
+                  {"Content-Type": "application/json"})
+        r = c.getresponse()
+        assert r.status == 200
+        assert len(json.loads(r.read())["tokens"]) == 2
+        c.close()
+    finally:
+        server.shutdown()
+
+
+def test_http_stream_error_taxonomy():
+    """Pre-commit errors keep the JSON status taxonomy even with
+    ?stream=1; a mid-stream deadline arrives as a clean terminal error
+    chunk on the already-committed 200."""
+    sess, server = _http_sess()
+    try:
+        host, port = server.server_address[:2]
+        # bad request BEFORE the stream commits -> plain 400 JSON
+        c = http.client.HTTPConnection(host, port, timeout=60)
+        c.request("POST", "/v1/generate?stream=1",
+                  json.dumps({"prompt": []}),
+                  {"Content-Type": "application/json"})
+        r = c.getresponse()
+        assert r.status == 400 and "error" in json.loads(r.read())
+        c.close()
+        # over-budget prompt -> 400 too (kv budget check at submit)
+        c = http.client.HTTPConnection(host, port, timeout=60)
+        c.request("POST", "/v1/generate?stream=1",
+                  json.dumps({"prompt": [1] * 20, "max_new_tokens": 4}),
+                  {"Content-Type": "application/json"})
+        r = c.getresponse()
+        assert r.status == 400
+        r.read()
+        c.close()
+        # mid-stream deadline: 200 committed, terminal error event, the
+        # chunked body terminates cleanly (readlines() returns)
+        c = http.client.HTTPConnection(host, port, timeout=60)
+        c.request("POST", "/v1/generate?stream=1",
+                  json.dumps({"prompt": [1] * 8, "max_new_tokens": 8,
+                              "timeout_sec": 0.0005}),
+                  {"Content-Type": "application/json"})
+        r = c.getresponse()
+        assert r.status == 200
+        lines = [json.loads(l) for l in r if l.strip()]
+        c.close()
+        assert lines and "error" in lines[-1]
+        assert lines[-1].get("type") in ("TimeoutError",)
+    finally:
+        server.shutdown()
+
+
+def test_debug_panel_kv_block():
+    with _kv_session() as sess:
+        sess.generate([1, 2, 3], max_new_tokens=2, timeout=30)
+        panel = sess.debug_panel()
+        assert panel["arena"] == "kv"
+        assert panel["kv"]["blocks_total"] == sess.arena.blocks_total
+        assert panel["kv"]["live_kv_bytes"] == 0
+        assert panel["prefill"]["chunk_tokens"] == 2
+        assert panel["prefill"]["chunks"] >= 1
+        assert panel["prefill"]["stalls"] == 0

@@ -31,6 +31,17 @@ convention — wall-clock percentiles are recorded but caveated:
   contract for continuous) and, at the saturated point, the
   length-aware admission taxonomy (``sheds_by_reason``).
 
+v2 adds the **paged** section (PR 16): the attention-decode session in
+``kv`` layout under ONE deterministic arrival schedule run twice —
+chunked prefill vs the unchunked baseline. Verdict basis is again
+counters, not clocks: ``decode_prefill_stalls`` (oversized prefill
+dispatches while a generating sequence waited — 0 by construction for
+chunked, >= 1 for the baseline), chunk counts, and the paged pool
+reservation (``kv_blocks x block_bytes``, sufficient for the worst
+CONCURRENT working set) against the contiguous worst case
+(``capacity x max_tokens`` rows, reserved always). The
+``decode_ttft_ms`` histogram rides along wall-clock-caveated.
+
 Writes BENCH_decode.json; ``bench.py`` carries the ``decode_serving``
 companion entry queued for real-TPU re-measurement.
 
@@ -53,13 +64,24 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 from mxtpu.serving import AdmissionShed, QueueFull  # noqa: E402
 from mxtpu.serving.decode import (DecodeSession,  # noqa: E402
-                                  lm_decode_fixture)
+                                  attn_decode_fixture, lm_decode_fixture)
 from loadgen_serving import run_open_loop  # noqa: E402
 
 BUCKETS = (1, 4, 8)
 PROMPT_LEN = 4
 MAX_NEW = 12
 VOCAB = 16
+
+# paged (kv-layout) scenario geometry: 32-token budget, short decoders
+# of 12 total tokens (3 blocks) vs long prompts of 28 (7 blocks)
+PAGED_BLOCK = 4
+PAGED_MAX_BLOCKS = 8
+PAGED_CAPACITY = 4
+PAGED_CHUNK = 4
+PAGED_SHORT = ([2, 3], 10)          # prompt, max_new -> 12 tokens
+PAGED_LONG_LEN, PAGED_LONG_NEW = 24, 4   # -> 28 tokens
+# worst CONCURRENT working set: capacity x long-sequence blocks
+PAGED_KV_BLOCKS = PAGED_CAPACITY * 7
 
 
 class _StaticBatchGate:
@@ -236,6 +258,79 @@ def _run_mode(fixture, mode, offered_rps, duration_s, seed,
     return out
 
 
+def _paged_session(fx, chunked):
+    kwargs = dict(buckets=(1, 2, 4), slot_capacity=PAGED_CAPACITY,
+                  prefill_chunk_tokens=PAGED_CHUNK,
+                  kv_blocks=PAGED_KV_BLOCKS, version_tag="bench-kv",
+                  admission="auto")
+    if chunked:
+        kwargs["prefill_buckets"] = (PAGED_CHUNK,)
+    else:
+        kwargs.update(prefill_chunked=False,
+                      prefill_buckets=(PAGED_LONG_LEN,))
+    return DecodeSession(fx["step_symbol_json"], fx["params"],
+                         fx["step_example_shapes"], [], arena="paged",
+                         paged=fx, **kwargs)
+
+
+def _run_paged_point(fx, chunked, seed):
+    """ONE deterministic schedule, run under both prefill policies:
+    two short sequences decode; once both have emitted a token, four
+    long prompts arrive. Chunked prefill interleaves their prompt work
+    with the shorts' steps (zero stalls, by construction); the
+    unchunked baseline dispatches each 24-token prompt whole while the
+    shorts wait (every such dispatch is a counted stall)."""
+    sess = _paged_session(fx, chunked)
+    prompt_s, new_s = PAGED_SHORT
+    shorts = [sess.generate_async(prompt_s, max_new_tokens=new_s,
+                                  timeout=60) for _ in range(2)]
+    deadline = time.monotonic() + 30
+    while int(sess.metrics.counter("decode_tokens_total").value) < 2 \
+            and time.monotonic() < deadline:
+        time.sleep(0.002)
+    rng = np.random.RandomState(seed)
+    longs = [sess.generate_async(
+        [int(t) for t in rng.randint(1, VOCAB, PAGED_LONG_LEN)],
+        max_new_tokens=PAGED_LONG_NEW, timeout=60) for _ in range(4)]
+    peak_blocks = 0
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        peak_blocks = max(peak_blocks, sess.arena.blocks_live)
+        panel = sess.debug_panel()
+        if not panel["active_sequences"] and not panel["queued"]:
+            break
+        time.sleep(0.005)
+    results = [f.wait(60) for f in shorts + longs]
+    assert all(r["finish_reason"] == "length" for r in results)
+    stats = sess.stats()
+    out = {
+        "prefill_chunked": chunked,
+        "completed": len(results),
+        "prefill_chunks": int(sess.metrics.counter(
+            "decode_prefill_chunks").value),
+        "prefill_tokens": int(sess.metrics.counter(
+            "decode_prefill_tokens").value),
+        "prefill_stalls": int(sess.metrics.counter(
+            "decode_prefill_stalls").value),
+        "steps_total": int(sess.metrics.counter(
+            "decode_steps_total").value),
+        "blocks_live_peak_observed": peak_blocks,
+        "ttft_ms_wall_clock_caveat": stats.get("decode_ttft_ms"),
+    }
+    block_bytes = sess.arena.block_bytes
+    geom = {
+        "block_size": sess.block_size,
+        "max_blocks_per_seq": sess.max_blocks_per_seq,
+        "kv_blocks": sess.arena.blocks_total,
+        "block_bytes": block_bytes,
+        "paged_pool_bytes": sess.arena.blocks_total * block_bytes,
+        "contiguous_worst_case_bytes":
+            PAGED_CAPACITY * PAGED_MAX_BLOCKS * block_bytes,
+    }
+    sess.close()
+    return out, geom
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--duration", type=float, default=4.0)
@@ -267,7 +362,36 @@ def main(argv=None):
         }
         curve[label] = point
 
+    afx = attn_decode_fixture(vocab_size=VOCAB, num_embed=8,
+                              block_size=PAGED_BLOCK,
+                              max_blocks_per_seq=PAGED_MAX_BLOCKS,
+                              seed=0)
+    chunked_pt, geom = _run_paged_point(afx, True, args.seed)
+    unchunked_pt, _ = _run_paged_point(afx, False, args.seed)
+    paged = {
+        "model": "attn_decode(vocab=%d,heads=2,head_dim=4,layers=1)"
+                 % VOCAB,
+        "geometry": geom,
+        "schedule": {"short": list(PAGED_SHORT[0]) + [PAGED_SHORT[1]],
+                     "long_prompt_len": PAGED_LONG_LEN,
+                     "long_max_new": PAGED_LONG_NEW,
+                     "longs": 4, "shorts": 2,
+                     "prefill_chunk_tokens": PAGED_CHUNK},
+        "chunked": chunked_pt,
+        "unchunked": unchunked_pt,
+        "verdict": {
+            "prefill_stalls_chunked_vs_unchunked":
+                [chunked_pt["prefill_stalls"],
+                 unchunked_pt["prefill_stalls"]],
+            "chunked_never_stalls": chunked_pt["prefill_stalls"] == 0,
+            "paged_pool_vs_contiguous_worst_case_bytes":
+                [geom["paged_pool_bytes"],
+                 geom["contiguous_worst_case_bytes"]],
+        },
+    }
+
     doc = {
+        "version": 2,
         "model": "lstm_lm_step(vocab=%d,hidden=16,layers=2)" % VOCAB,
         "buckets": list(BUCKETS),
         "prompt_len": PROMPT_LEN,
@@ -276,6 +400,7 @@ def main(argv=None):
         "probe_step_ms": round(step_ms, 3),
         "step_cost_rows": {str(b): c for b, c in sorted(costs.items())},
         "curve": curve,
+        "paged": paged,
         "basis_note":
             "Verdict rests on deterministic counters (PR-2 convention): "
             "mean slot occupancy and idle-row-step integral from "
@@ -288,7 +413,12 @@ def main(argv=None):
             "(>45% noise floor) and the CPU backend dispatches "
             "synchronously — recorded for shape, NOT a verdict basis; "
             "bench.py's decode_serving entry queues the wall-clock "
-            "comparison for real-TPU re-measurement.",
+            "comparison for real-TPU re-measurement. The paged section "
+            "(v2) rests on the decode_prefill_stalls counter (oversized "
+            "prefill dispatches while a generating sequence waited) and "
+            "the pool-reservation arithmetic; its decode_ttft_ms "
+            "histogram and blocks_live_peak_observed are "
+            "wall-clock/sampling artifacts recorded for shape only.",
     }
     out_path = os.path.abspath(args.out)
     with open(out_path, "w") as f:
@@ -300,6 +430,10 @@ def main(argv=None):
             label, v["occupancy_continuous_vs_static"],
             v["tokens_per_step_continuous_vs_static"],
             v["zero_idle_steps_tripwire"]))
+    pv = paged["verdict"]
+    print("paged: stalls chunked/unchunked %s  pool vs contiguous %s" % (
+        pv["prefill_stalls_chunked_vs_unchunked"],
+        pv["paged_pool_vs_contiguous_worst_case_bytes"]))
     return 0
 
 

@@ -150,6 +150,21 @@ def render(metrics, state, width=100):
                dec.get("tokens_out", "?"), tps,
                _fmt_bytes(dec.get("state_bytes", 0)),
                adm_d.get("state", "?")))
+        kv = dec.get("kv") or {}
+        if kv:
+            ttft = decode_reg.get("decode_ttft_ms", [({}, {})])[0][1] \
+                if decode_reg else {}
+            ttft = ttft if isinstance(ttft, dict) else {}
+            pre = dec.get("prefill") or {}
+            lines.append(
+                "decode kv: blocks %s/%s (%s live) | kv %s | "
+                "prefill chunks %s stalls %s | ttft p50 %.1fms (n=%d)"
+                % (kv.get("blocks_live", "?"), kv.get("blocks_total", "?"),
+                   _fmt_bytes(kv.get("live_kv_bytes", 0)),
+                   "chunk=%s" % pre.get("chunk_tokens", "?")
+                   if pre else "rows",
+                   pre.get("chunks", "-"), pre.get("stalls", "-"),
+                   ttft.get("p50_ms", 0.0), ttft.get("count", 0)))
         lines.append(bar)
 
     # ---- memory table
