@@ -111,6 +111,11 @@ LOCK_LEVELS = [
     # schedule from inside arbitrary subsystems, so its lock must be
     # acquirable under everything above
     ("faults", {("FaultSchedule", "_lock"), ("injection", "_CONF_LOCK")}),
+    # the measurement-corpus appender (obs/corpus.py): taken at the
+    # build/retire/step measurement seams, which may hold nearly
+    # anything above; it only guards one file handle and never acquires
+    # another tracked lock
+    ("obs-corpus", {("corpus", "_WRITER_LOCK")}),
     # innermost leaves: never hold anything else
     ("leaf", {("profiler", "_lock")}),
 ]

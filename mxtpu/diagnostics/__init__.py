@@ -42,6 +42,10 @@ from .programs import (ProgramRecord, cost_enabled, latest_record,
                        summarize_shardings)
 from .flight import (FlightRecorder, flight_enabled, record, recorder,
                      set_flight_enabled)
+# importing mxtpu.obs.trace ARMS the span ring (tracing.set_span_sink)
+# alongside the flight hook above — every process that diagnoses also
+# captures an exportable timeline (MXTPU_TRACE=0 opts out)
+from ..obs import trace as _obs_trace
 from .watchdog import (Watchdog, active_waits, add_action, ensure_watchdog,
                        progress_age_s, remove_action, stop_watchdog,
                        wait_begin, wait_end)
@@ -78,6 +82,7 @@ def set_enabled(flag):
     flag; the watchdog keeps running — it is the point of the package."""
     set_mem_enabled(flag)
     set_flight_enabled(flag)
+    _obs_trace.set_trace_enabled(flag)
 
 
 def reconcile():
@@ -119,6 +124,14 @@ def debug_state(flight_limit=256):
         "waits": active_waits(),
         # armed flag + observed lock graph summary (armed witness only)
         "concurrency": _conc.state(),
+        # span-ring fill level: how much timeline GET /debug/trace holds
+        "trace": {
+            "enabled": _obs_trace.trace_enabled(),
+            "spans": len(_obs_trace.ring())
+                     if _obs_trace.ring() is not None else 0,
+            "capacity": _obs_trace.ring().capacity
+                        if _obs_trace.ring() is not None else 0,
+        },
     }
     try:
         state["reconcile"] = reconcile()

@@ -237,6 +237,16 @@ def record_program(kind, owner, compiled, compile_ms, transforms=None):
                        "captured programs of this kind")
     if rec.temp_bytes > g.value:
         g.set(rec.temp_bytes)
+    # the measurement corpus's build row (config half of the
+    # config→measurement pair): appended OUTSIDE _LOCK — the durable
+    # fsync append must never serialize the registry — and gated on the
+    # env inside record_build itself. A corpus failure must not take
+    # down the build it is describing, same contract as the analyses.
+    try:
+        from ..obs import corpus as _obs_corpus
+        _obs_corpus.record_build(rec.to_dict())
+    except Exception:
+        pass
     return rec
 
 

@@ -1,0 +1,54 @@
+"""Shared durable-write primitives: the snapshot writer's fsync-rename
+idiom, factored out so other append/replace writers (the obs
+measurement corpus) reuse the exact same crash-window contract instead
+of re-deriving it.
+
+Two primitives:
+
+  * :func:`write_atomic` — tmp + fsync + ``os.replace`` + dir fsync:
+    the destination either has the full new content or the previous
+    one, never a prefix. Carries the ``elastic.snapshot.fsync_rename``
+    fault point between the tmp write and its rename — firing there IS
+    a torn write, which is what the chaos gates inject.
+  * :func:`fsync_dir` — best-effort directory fsync so a rename (or a
+    freshly created append file) survives power loss, not just process
+    death.
+
+Kept stdlib-light (os + the faults guard) so it is importable from the
+lowest layers.
+"""
+from __future__ import annotations
+
+import os
+
+from ..faults import injection as _faults
+
+__all__ = ["fsync_dir", "write_atomic"]
+
+
+def fsync_dir(path):
+    """Best-effort directory fsync so the rename itself is durable."""
+    try:
+        fd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                     os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass  # platform without dir fsync
+
+
+def write_atomic(path, data_bytes):
+    """tmp + fsync + rename: the file either has the full content or the
+    previous one — never a prefix."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data_bytes)
+        f.flush()
+        os.fsync(f.fileno())
+    # between the tmp write and its rename: firing here IS a torn write
+    _faults.point("elastic.snapshot.fsync_rename")
+    os.replace(tmp, path)
+    fsync_dir(path)
+    return len(data_bytes)

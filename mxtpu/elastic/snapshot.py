@@ -70,32 +70,11 @@ _tel.registry().gauge(
 
 
 # --------------------------------------------------------------- file layer
-def _fsync_dir(path):
-    """Best-effort directory fsync so the rename itself is durable."""
-    try:
-        fd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
-                     os.O_RDONLY)
-        try:
-            os.fsync(fd)
-        finally:
-            os.close(fd)
-    except OSError:
-        pass  # platform without dir fsync
-
-
-def _write_atomic(path, data_bytes):
-    """tmp + fsync + rename: the file either has the full content or the
-    previous one — never a prefix."""
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(data_bytes)
-        f.flush()
-        os.fsync(f.fileno())
-    # between the tmp write and its rename: firing here IS a torn write
-    _faults.point("elastic.snapshot.fsync_rename")
-    os.replace(tmp, path)
-    _fsync_dir(path)
-    return len(data_bytes)
+# the fsync-rename primitives moved to elastic/durable.py so the obs
+# measurement corpus shares the exact crash-window contract; the private
+# aliases keep this module's historical call sites (and tests) intact
+from .durable import fsync_dir as _fsync_dir  # noqa: E402
+from .durable import write_atomic as _write_atomic  # noqa: E402
 
 
 def _write_ndsave_atomic(path, host_arrays):
@@ -328,6 +307,12 @@ class SnapshotWriter:
                     self._cond.notify_all()
 
     def _write(self, job):
+        with _tel.span("elastic.write", category="elastic",
+                       tags={"kind": job.kind, "label": job.label,
+                             "generation": job.generation}):
+            self._write_inner(job)
+
+    def _write_inner(self, job):
         global _LAST_DURABLE_T
         _faults.point("elastic.snapshot.write")
         t0 = time.perf_counter()

@@ -15,6 +15,7 @@ from .. import telemetry as _tel
 from ..base import MXNetError, NativeError
 from ..executor import device_wait as _device_wait
 from ..model import BatchEndParam
+from ..obs import corpus as _obs_corpus
 from ..telemetry import tracing as _tracing
 
 
@@ -484,6 +485,14 @@ class BaseModule:
                     else:
                         self.update_metric(eval_metric, data_batch.label)
                     step_ms.observe(sp.duration_ms + pacing)
+                    if _obs_corpus.enabled():
+                        # measurement-corpus service row: the same
+                        # per-step wall time the histogram sees, keyed
+                        # by batch rows for the cost-model fit
+                        _obs_corpus.record_service(
+                            "fit_step", sp.duration_ms + pacing,
+                            rows=data_batch.data[0].shape[0]
+                            if data_batch.data else None)
                     if monitor is not None:
                         monitor.toc_print()
                     if accum is not None and (

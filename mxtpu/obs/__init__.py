@@ -1,0 +1,35 @@
+"""mxtpu.obs — the exported observability surface.
+
+PR 2 (telemetry) and PR 4 (diagnostics) made the process legible
+*in-process*: correlated spans, series, the flight ring, the program
+cost registry. This package is the export layer on top of them, in
+three coupled pieces:
+
+  * :mod:`~mxtpu.obs.trace` + :mod:`~mxtpu.obs.trace_export` — a
+    bounded lock-free ring of finished spans (armed as
+    ``tracing.set_span_sink``) and a Chrome trace-event / Perfetto
+    exporter merging it with the diagnostics flight ring onto named
+    per-thread tracks with flow events. Served at ``GET /debug/trace``;
+    fetched by ``mxtpu_top --trace-out``.
+  * :mod:`~mxtpu.obs.sampler` — the seeded deterministic per-request
+    exemplar sampler (``MXTPU_TRACE_SAMPLE``) the decode session uses,
+    so gates assert *exactly which* requests carry traces.
+  * :mod:`~mxtpu.obs.corpus` — the append-only JSONL measurement
+    corpus (``MXTPU_CORPUS_DIR``): program-build features + measured
+    service ms, crash-safe, with a ``load()/summarize()`` reader that
+    reproduces the ``tune.search`` service model offline.
+
+See docs/observability.md (trace contract, span inventory) and
+docs/tune.md (corpus schema).
+"""
+from __future__ import annotations
+
+from . import corpus, sampler, trace, trace_export
+from .sampler import TraceSampler
+from .trace import SpanRing, install, ring, set_trace_enabled, trace_enabled
+
+__all__ = [
+    "trace", "trace_export", "sampler", "corpus",
+    "SpanRing", "ring", "install", "set_trace_enabled", "trace_enabled",
+    "TraceSampler",
+]

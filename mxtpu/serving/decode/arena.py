@@ -368,7 +368,10 @@ class PagedArena:
         positions. Host bookkeeping only. Raises :class:`MXNetError`
         when the sequence would exceed ``max_blocks_per_seq`` or the
         shared pool is dry — the caller fails THAT sequence (releasing
-        its table) and the pool stays exact."""
+        its table) and the pool stays exact. Returns the number of
+        blocks newly appended (0 when the table already covered the
+        positions) — the session's flight/timeline events record only
+        ACTUAL growth, not every covering check."""
         import math
         need = math.ceil(int(n_tokens) / self.block_size)
         with self._lock:
@@ -381,6 +384,7 @@ class PagedArena:
                     " %d (%d tokens at block_size %d)"
                     % (need, self.max_blocks_per_seq, n_tokens,
                        self.block_size))
+            grew = 0
             while len(table) < need:
                 if not self._free_blocks:
                     raise MXNetError(
@@ -388,8 +392,10 @@ class PagedArena:
                         "needed for slot %d)"
                         % (self.blocks_total, need, slot))
                 table.append(self._free_blocks.pop())
+                grew += 1
             live = self.blocks_total - len(self._free_blocks)
         self._mem_slot.set(live * self.block_bytes)
+        return grew
 
     def tokens_capacity(self, slot):
         """Token positions ``slot``'s current table covers."""

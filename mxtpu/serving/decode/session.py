@@ -53,7 +53,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 
 import jax
 import numpy as _np
@@ -62,6 +62,8 @@ from ... import diagnostics as _diag
 from ...analysis import concurrency as _conc
 from ...base import MXNetError
 from ...faults import injection as _faults
+from ...obs import corpus as _obs_corpus
+from ...obs.sampler import TraceSampler
 from ..admission import (ACCEPTING, AdmissionShed, AdmissionSignals,
                          DecodeAdmissionPolicy, STATE_NAMES)
 from ..batcher import BatcherClosed, QueueFull, pick_bucket
@@ -139,7 +141,8 @@ class _Sequence:
     __slots__ = ("prompt", "max_new", "eos_id", "seed", "temperature",
                  "expire_at", "slot", "pool", "prefill_pool", "version",
                  "fresh", "pos", "out_tokens", "_rng", "item",
-                 "enqueue_step", "join_step", "finish_step")
+                 "enqueue_step", "join_step", "finish_step",
+                 "req_ord", "t_admit", "t_last_tok", "trace")
 
     def __init__(self, prompt, max_new, eos_id, seed, temperature,
                  expire_at):
@@ -161,6 +164,18 @@ class _Sequence:
         self.enqueue_step = -1
         self.join_step = -1
         self.finish_step = -1
+        self.req_ord = -1         # session-wide enqueue ordinal
+        self.t_admit = None       # session clock at slot admission
+        self.t_last_tok = None    # session clock at the previous emit
+        self.trace = None         # exemplar event list when sampled
+
+    def mark(self, event, t, **detail):
+        """Append one exemplar timeline event (no-op unless sampled)."""
+        if self.trace is not None:
+            row = {"event": event, "t": round(float(t), 6)}
+            if detail:
+                row.update(detail)
+            self.trace.append(row)
 
     def next_input_token(self):
         return self.prompt[self.pos] if self.pos < len(self.prompt) \
@@ -258,10 +273,29 @@ class DecodeSession:
                  arena="slots", paged=None, block_size=None,
                  max_blocks_per_seq=None, prefill_chunk_tokens=None,
                  prefill_chunked=True, prefill_buckets=None,
-                 kv_blocks=None):
+                 kv_blocks=None, clock=None, trace_sample=None):
         from ... import tune as _tune
         self.metrics = MetricsRegistry(namespace="mxtpu_decode")
         _diag.on_session_start()
+        # the session clock: EVERY request-latency stamp (enqueue,
+        # admission, token retire, deadline) reads this one callable, so
+        # tests inject a deterministic clock and assert exact TTFT/TBT
+        # values measured at token RETIRE, not at HTTP flush
+        self._clock = clock if clock is not None else time.monotonic
+        # seeded deterministic exemplar sampling (MXTPU_TRACE_SAMPLE, or
+        # an explicit rate/sampler for tests): which requests carry a
+        # structured per-token timeline is a pure function of the
+        # enqueue ordinal
+        if isinstance(trace_sample, TraceSampler):
+            self._sampler = trace_sample
+        elif trace_sample is not None:
+            rate, _, seed = str(trace_sample).partition(":")
+            self._sampler = TraceSampler(rate=float(rate),
+                                         seed=int(seed) if seed else 0)
+        else:
+            self._sampler = TraceSampler()
+        self._req_ord = 0
+        self._sampled_traces = deque(maxlen=16)
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self._state_names = list(state_names)
         if arena not in ("slots", "paged"):
@@ -450,6 +484,14 @@ class DecodeSession:
         self.metrics.counter("decode_prefill_tokens")
         self.metrics.counter("decode_prefill_stalls")
         self.metrics.histogram("decode_ttft_ms")
+        # per-request latency attribution (PR 17): time-between-tokens
+        # and the per-phase breakdown exist from construction so gates
+        # read exact zeros, not absences
+        self.metrics.histogram("decode_tbt_ms")
+        for _phase in ("admission", "prefill", "step", "retire"):
+            self.metrics.histogram("decode_phase_ms",
+                                   labels={"phase": _phase})
+        self.metrics.counter("decode_trace_sampled")
         if self._kind != "slots":
             self.metrics.gauge("decode_kv_blocks_live",
                                fn=lambda: self.arena.blocks_live)
@@ -651,11 +693,14 @@ class DecodeSession:
         timeout = timeout if timeout is not None else self.default_timeout
         self.metrics.counter("requests_received").inc()
         self._admit()
-        expire_at = time.monotonic() + timeout if timeout is not None \
-            else None
+        now = self._clock()
+        expire_at = now + timeout if timeout is not None else None
         seq = _Sequence(prompt, max_new,
                         eos_id if eos_id is not None else self.eos_id,
                         int(seed), float(temperature), expire_at)
+        # re-stamp on the SESSION clock (the DecodeResult ctor used the
+        # wall monotonic): every latency below subtracts this value
+        seq.item.t_enqueue = now
         if stream:
             # attached BEFORE enqueue: every terminal transition after
             # this point (finish, fail, timeout, worker death, close)
@@ -669,6 +714,12 @@ class DecodeSession:
                 raise QueueFull("decode queue full (%d requests)"
                                 % self.max_queue)
             seq.enqueue_step = self._steps
+            seq.req_ord = self._req_ord
+            self._req_ord += 1
+            if self._sampler.sampled(seq.req_ord):
+                seq.trace = []
+                seq.mark("enqueue", now, prompt_len=len(prompt),
+                         max_new=max_new)
             self._queue.append(seq)
             self._work.notify()
         return seq.item
@@ -712,7 +763,13 @@ class DecodeSession:
                  "state_bytes": self.arena.state_bytes(),
                  "arena": self._kind,
                  "version": self.version_info(),
-                 "admission": self.admission_snapshot()}
+                 "admission": self.admission_snapshot(),
+                 "trace_sample": {
+                     "rate": self._sampler.rate,
+                     "seed": self._sampler.seed,
+                     "sampled": int(self.metrics.counter(
+                         "decode_trace_sampled").value),
+                     "held": len(self._sampled_traces)}}
         if self._kind != "slots":
             panel["kv"] = {"block_size": self.arena.block_size,
                            "blocks_total": self.arena.blocks_total,
@@ -942,7 +999,7 @@ class DecodeSession:
         session lock) — the join-within-one-step contract: every
         admittable request is in the NEXT step's batch. Expired queued
         requests are reaped here, before they could waste a slot."""
-        now = time.monotonic()
+        now = self._clock()
         live = []
         for s in self._queue:
             if s.expire_at is not None and now > s.expire_at:
@@ -975,8 +1032,17 @@ class DecodeSession:
             s.version = self.version_tag
             s.join_step = self._steps
             self._active.append(s)
+            s.t_admit = now
+            wait_ms = (now - s.item.t_enqueue) * 1e3
             self.metrics.histogram("decode_join_latency_ms").observe(
-                (now - s.item.t_enqueue) * 1e3)
+                wait_ms)
+            # phase=admission: queue wait, enqueue -> slot grant
+            self.metrics.histogram(
+                "decode_phase_ms",
+                labels={"phase": "admission"}).observe(wait_ms)
+            s.mark("admit", now, slot=slot, step=self._steps)
+            _diag.record("decode", "admit",
+                         "ord=%d slot=%d" % (s.req_ord, slot))
 
     def _step_chunk(self, pool, seqs):
         """One device step for up to largest-bucket sequences of one
@@ -1030,8 +1096,19 @@ class DecodeSession:
             _diag.wait_end()
         self._steps += 1
         self.metrics.counter("decode_steps_total").inc()
-        self.metrics.histogram("decode_step_ms").observe(
-            (time.perf_counter() - t0) * 1e3)
+        step_ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.histogram("decode_step_ms").observe(step_ms)
+        self.metrics.histogram(
+            "decode_phase_ms", labels={"phase": "step"}).observe(step_ms)
+        _diag.record("decode", "step",
+                     "n=%d step=%d %.3fms" % (len(seqs), self._steps,
+                                              step_ms))
+        if _obs_corpus.enabled():
+            _obs_corpus.record_service("decode_step", step_ms,
+                                      rows=len(seqs))
+        now = self._clock()
+        for s in seqs:
+            s.mark("step", now, step=self._steps)
         self._advance(seqs, logits)
 
     def _ensure_blocks(self, s, n_tokens):
@@ -1041,19 +1118,39 @@ class DecodeSession:
         caller fails this request and its eviction releases the slot
         with every block the table already holds."""
         _faults.point("serving.decode.block_alloc")
-        self.arena.ensure_tokens(s.slot, n_tokens)
+        grew = self.arena.ensure_tokens(s.slot, n_tokens)
+        if grew:
+            _diag.record("decode", "block_alloc",
+                         "slot=%d +%d blocks" % (s.slot, grew))
+            s.mark("block_alloc", self._clock(), blocks=grew)
 
     def _emit_token(self, s, token):
         """The single token-retirement seam: every emitted token —
         decode step or final prefill chunk — passes through here, so
-        streaming and time-to-first-token observe ALL of them."""
+        streaming and time-to-first-token observe ALL of them.
+
+        TTFT and TBT are stamped HERE, on the session clock, at token
+        retire — before the stream put, so a slow streaming consumer
+        (HTTP flush, chunked-transfer backpressure) can never inflate
+        the latency series. The injected-clock test pins this contract.
+        """
         first = not s.out_tokens
         s.out_tokens.append(token)
         self._tokens_out += 1
         self.metrics.counter("decode_tokens_total").inc()
+        now = self._clock()
         if first:
             self.metrics.histogram("decode_ttft_ms").observe(
-                (time.monotonic() - s.item.t_enqueue) * 1e3)
+                (now - s.item.t_enqueue) * 1e3)
+        else:
+            self.metrics.histogram("decode_tbt_ms").observe(
+                (now - s.t_last_tok) * 1e3)
+        s.t_last_tok = now
+        s.mark("token", now, index=len(s.out_tokens) - 1,
+               token=int(token))
+        _diag.record("decode", "token",
+                     "ord=%d idx=%d" % (s.req_ord,
+                                        len(s.out_tokens) - 1))
         if s.item.stream is not None:
             s.item.stream.put({"token": int(token),
                                "index": len(s.out_tokens) - 1})
@@ -1120,8 +1217,20 @@ class DecodeSession:
             # processed more prompt tokens than the declared latency
             # quantum while a generating sequence sat out the iteration
             self.metrics.counter("decode_prefill_stalls").inc()
+        prefill_ms = (time.perf_counter() - t0) * 1e3
         self.metrics.histogram("decode_prefill_chunk_ms").observe(
-            (time.perf_counter() - t0) * 1e3)
+            prefill_ms)
+        self.metrics.histogram(
+            "decode_phase_ms",
+            labels={"phase": "prefill"}).observe(prefill_ms)
+        _diag.record("decode", "prefill_chunk",
+                     "slot=%d pos=%d/%d %.3fms"
+                     % (s.slot, s.pos, len(s.prompt), prefill_ms))
+        if _obs_corpus.enabled():
+            _obs_corpus.record_service("decode_prefill", prefill_ms,
+                                       rows=cv)
+        s.mark("prefill_chunk", self._clock(), pos=s.pos,
+               prompt_len=len(s.prompt), tokens=cv)
         if s.pos < len(s.prompt):
             return     # mid-prompt: logits stay on device, no sync
         _diag.wait_begin("decode_prefill_logits")
@@ -1132,7 +1241,7 @@ class DecodeSession:
             logits = jax.device_get(logits_dev)
         finally:
             _diag.wait_end()
-        if s.expire_at is not None and time.monotonic() > s.expire_at:
+        if s.expire_at is not None and self._clock() > s.expire_at:
             self._retire(s, error=TimeoutError(
                 "generate exceeded its deadline mid-prefill"),
                 reason="deadline")
@@ -1209,10 +1318,19 @@ class DecodeSession:
             _diag.wait_end()
         self._steps += 1
         self.metrics.counter("decode_steps_total").inc()
-        self.metrics.histogram("decode_step_ms").observe(
-            (time.perf_counter() - t0) * 1e3)
-        now = time.monotonic()
+        step_ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.histogram("decode_step_ms").observe(step_ms)
+        self.metrics.histogram(
+            "decode_phase_ms", labels={"phase": "step"}).observe(step_ms)
+        _diag.record("decode", "step",
+                     "n=%d step=%d %.3fms" % (len(seqs), self._steps,
+                                              step_ms))
+        if _obs_corpus.enabled():
+            _obs_corpus.record_service("decode_step", step_ms,
+                                       rows=len(seqs))
+        now = self._clock()
         for i, s in enumerate(seqs):
+            s.mark("step", now, step=self._steps)
             if s.expire_at is not None and now > s.expire_at:
                 self._retire(s, error=TimeoutError(
                     "generate exceeded its deadline mid-decode"),
@@ -1244,7 +1362,7 @@ class DecodeSession:
         """Consume one step's logits: prompt prefill advances the
         cursor, generation emits a token, finished sequences retire and
         free their slot for the NEXT step."""
-        now = time.monotonic()
+        now = self._clock()
         for i, s in enumerate(seqs):
             if s.expire_at is not None and now > s.expire_at:
                 self._retire(s, error=TimeoutError(
@@ -1263,18 +1381,36 @@ class DecodeSession:
                 self._retire(s, reason="length")
 
     def _retire(self, s, reason, error=None):
+        t0 = time.perf_counter()
         s.finish_step = self._steps
         with self._lock:
             if s in self._active:
                 self._active.remove(s)
         self._evict(s, reason)
+        now = self._clock()
+        s.mark("retire", now, reason=reason,
+               tokens=len(s.out_tokens), error=error is not None)
+        if s.trace is not None:
+            # sampled request: count it, hold the finished exemplar for
+            # the debug panel, and (on success) ship it in the result
+            self.metrics.counter("decode_trace_sampled").inc()
+            self._sampled_traces.append(
+                {"req_ord": s.req_ord, "reason": reason,
+                 "error": error is not None,
+                 "events": list(s.trace)})
         if error is not None:
             self.metrics.counter("requests_timed_out").inc()
+            self.metrics.histogram(
+                "decode_phase_ms", labels={"phase": "retire"}).observe(
+                (time.perf_counter() - t0) * 1e3)
             s.item.fail(error)
             return
         self.metrics.counter("requests_completed").inc()
-        self.metrics.histogram("request_latency_ms").observe(
-            (time.monotonic() - s.item.t_enqueue) * 1e3)
+        request_ms = (now - s.item.t_enqueue) * 1e3
+        self.metrics.histogram("request_latency_ms").observe(request_ms)
+        if _obs_corpus.enabled():
+            _obs_corpus.record_service("decode_request", request_ms,
+                                       rows=len(s.out_tokens))
         result = {"tokens": list(s.out_tokens),
                   "prompt_len": len(s.prompt),
                   "finish_reason": reason,
@@ -1283,9 +1419,14 @@ class DecodeSession:
                   "join_step": s.join_step,
                   "finish_step": s.finish_step,
                   "steps": s.finish_step - s.join_step}
+        if s.trace is not None:
+            result["trace"] = list(s.trace)
         if self.id2word is not None:
             result["text"] = " ".join(
                 str(self.id2word.get(t, t)) for t in s.out_tokens)
+        self.metrics.histogram(
+            "decode_phase_ms", labels={"phase": "retire"}).observe(
+            (time.perf_counter() - t0) * 1e3)
         s.item.finish(result)
 
     def _evict(self, s, reason, swallow=False):

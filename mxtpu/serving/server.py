@@ -55,6 +55,7 @@ from .. import telemetry as _tel
 from ..analysis import concurrency as _conc
 from ..base import MXNetError, NativeError, NumericsError
 from ..faults import RetryPolicy, env_attempts
+from ..obs import corpus as _obs_corpus
 from .admission import (ACCEPTING, AdmissionShed, AdmissionSignals,
                         SignalAdmissionPolicy, STATE_NAMES, derive_knobs,
                         mix_service_model)
@@ -370,6 +371,12 @@ class ServingSession:
         self.metrics.histogram(
             "batch_service_ms",
             labels={"bucket": str(bucket)}).observe(service_ms)
+        if _obs_corpus.enabled():
+            # the measurement-corpus ledger: the same marginal service
+            # fact the admission model learns from, persisted for
+            # offline tune.search fitting (docs/tune.md)
+            _obs_corpus.record_service("serving", service_ms,
+                                       bucket=bucket)
 
     def _service_model(self):
         """The queue-drain model admission budgets with: mix-weighted
@@ -886,6 +893,14 @@ class _Handler(BaseHTTPRequestHandler):
                 state["decode"] = decode.debug_panel()
             state["serving_warm_cache"] = warm_cache().manifest()
             self._json(200, state)
+        elif path == "/debug/trace":
+            # the whole captured timeline as Chrome trace-event JSON:
+            # span ring as duration slices on per-thread tracks, flight
+            # ring as instants, cross-thread parent links as flow
+            # events. Load the body straight into Perfetto / chrome
+            # about:tracing, or fetch via `mxtpu_top --trace-out`.
+            from ..obs import trace_export as _trace_export
+            self._text(200, _trace_export.dumps(), "application/json")
         else:
             self._json(404, {"error": "unknown path %s" % self.path})
 

@@ -8,13 +8,15 @@ batch — capture the submitting span with ``current_span()`` and restore
 it on the far side with ``parent=``, so one trace id threads engine push
 -> executor run -> kvstore push/pull -> serving request.
 
-Spans are emitted twice on exit:
+Spans are emitted on exit into every armed sink:
   * into ``mxtpu.profiler`` as a chrome://tracing event whose ``args``
     carry trace/span/parent ids (only while the profiler runs);
   * into the telemetry registry as an observation on the labeled
     histogram ``span_ms{span=<name>}`` (always, unless telemetry is
     disabled) — the substrate for the profiler's aggregate_stats tables
-    and for Prometheus latency series without a profiler session.
+    and for Prometheus latency series without a profiler session;
+  * into the ``mxtpu.obs`` span ring via ``set_span_sink`` (when armed)
+    — the bounded capture the Perfetto timeline exporter reads.
 """
 from __future__ import annotations
 
@@ -33,10 +35,24 @@ _current = contextvars.ContextVar("mxtpu_telemetry_span", default=None)
 # unset; set_flight_recorder is called by the diagnostics package.
 _flight = None
 
+# span-sink hook (mxtpu.obs.trace): every FINISHED span — with its
+# wall-clock endpoints and correlation ids — lands in the bounded span
+# ring the timeline exporter reads. Same one-global-read-when-unset
+# contract as the flight hook; set_span_sink is called by mxtpu.obs.
+_sink = None
+
 
 def set_flight_recorder(rec):
     global _flight
     _flight = rec
+
+
+def set_span_sink(fn):
+    """Install ``fn(span)`` to receive every finished span (None
+    unhooks). The callee must be lock-free and allocation-light — it
+    runs inside ``Span.__exit__`` on every instrumented region."""
+    global _sink
+    _sink = fn
 
 
 class Span:
@@ -86,6 +102,9 @@ class Span:
         if f is not None:
             f.record("span_end", self.name,
                      "%d %.3fms" % (self.span_id, self.duration_ms))
+        k = _sink
+        if k is not None:
+            k(self)
         self._emit()
         return False
 

@@ -545,12 +545,27 @@ def test_monitor_stats_become_telemetry_series():
 
 def test_series_inventory_documented():
     """Every literal telemetry series emitted by mxtpu/ appears in the
-    docs/observability.md inventory (the CI check tool)."""
+    docs/observability.md inventory (the CI check tool) — and every
+    span name in its span-inventory section."""
     import subprocess
     import sys
     root = os.path.join(os.path.dirname(__file__), "..")
     proc = subprocess.run(
         [sys.executable, os.path.join(root, "tools",
                                       "check_series_documented.py")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_bench_files_carry_verdict_basis():
+    """Every BENCH_*.json that claims a perf verdict records the
+    deterministic basis the verdict was computed from (the CI check
+    tool; raw run logs are exempt)."""
+    import subprocess
+    import sys
+    root = os.path.join(os.path.dirname(__file__), "..")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools",
+                                      "check_bench_basis.py")],
         capture_output=True, text=True)
     assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Benchmark: trace timeline capture cost on the Module.fit loop.
+
+The span ring (``mxtpu.obs.trace``) stores one tuple per COMPLETED span
+into a preallocated slot — that store is the entire per-event cost the
+always-on timeline adds on top of the telemetry the spans already pay
+for. This bench makes the <0.5%-of-a-step claim falsifiable on the
+exact-crossing basis the faults/concurrency benches use:
+
+  1. microbench ``SpanRing.record`` tight-loop → ns/record (immune to
+     host noise);
+  2. run a short mlp fit with the ring armed and COUNT the spans one
+     step actually completes (deterministic: fit.step + its
+     executor/engine/kvstore children — read off the ring, not
+     modeled);
+  3. overhead_pct = ns/record × spans/step vs the measured step time.
+
+Writes BENCH_obs.json. Acceptance: off/on cost < 0.5% of an mlp fit
+step on this basis.
+
+Usage: python tools/bench_obs.py [--out BENCH_obs.json]
+"""
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import mxtpu as mx  # noqa: E402
+from mxtpu import diagnostics as _diag  # noqa: E402
+from mxtpu import telemetry as tel  # noqa: E402
+from mxtpu.obs import trace as obs_trace  # noqa: E402
+from mxtpu.obs import trace_export  # noqa: E402
+from mxtpu.models import mlp as _mlp  # noqa: E402
+from mxtpu.telemetry import tracing as _tracing  # noqa: E402
+
+
+def _make_data(n, batch_size, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 784).astype(np.float32)
+    y = rng.randint(0, 10, n).astype(np.float32)
+    return mx.io.NDArrayIter(X, y, batch_size=batch_size,
+                             label_name="softmax_label")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--examples", type=int, default=2048)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "..", "BENCH_obs.json"))
+    args = ap.parse_args(argv)
+
+    logging.getLogger().setLevel(logging.WARNING)
+    batches = args.examples // args.batch_size
+
+    # ---- 1. ns per ring record, tight loop over a real completed span
+    ring = obs_trace.SpanRing(4096)
+    with _tracing.span("bench.probe", category="bench") as probe:
+        pass
+    n_micro = 200000
+    t0 = time.perf_counter()
+    for _ in range(n_micro):
+        ring.record(probe)
+    record_ns = (time.perf_counter() - t0) * 1e9 / n_micro
+
+    # ---- 2. exact spans/step: warmed fit with the ring armed
+    it = _make_data(args.examples, args.batch_size)
+    mod = mx.mod.Module(_mlp.get_symbol(10), context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05})   # warm compile
+    obs_trace.install()
+    live = obs_trace.ring()
+    live.clear()
+    step_h = tel.registry().histogram("fit_step_ms")
+    c0 = step_h.count
+    t0 = time.perf_counter()
+    mod.fit(it, num_epoch=args.epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05})
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    steps = step_h.count - c0
+    spans_captured = len(live)
+    step_ms = wall_ms / max(1, steps)
+    spans_per_step = spans_captured / max(1, steps)
+    by_name = {}
+    for s in live.snapshot():
+        by_name[s["name"]] = by_name.get(s["name"], 0) + 1
+
+    # ---- 3. verdict on the deterministic basis
+    capture_us_per_step = record_ns * spans_per_step / 1e3
+    overhead_pct = capture_us_per_step / 10.0 / step_ms
+    ok = overhead_pct < 0.5
+
+    # exporter sanity (not part of the verdict — export is on-demand):
+    # one dumps() over the full ring, for the record
+    t0 = time.perf_counter()
+    body = trace_export.dumps()
+    export_ms = (time.perf_counter() - t0) * 1e3
+    events = len(json.loads(body).get("traceEvents", []))
+
+    result = {
+        "bench": "trace timeline capture cost (mxtpu.obs.trace)",
+        "model": "mlp",
+        "batch_size": args.batch_size,
+        "batches_per_epoch": batches,
+        "steps_measured": steps,
+        "step_ms": round(step_ms, 4),
+        "ring_record_ns": round(record_ns, 1),
+        "spans_per_step": round(spans_per_step, 3),
+        "spans_by_name": dict(sorted(by_name.items())),
+        "capture_us_per_step": round(capture_us_per_step, 4),
+        "capture_pct_of_step": round(overhead_pct, 5),
+        "target_pct": 0.5,
+        "pass": ok,
+        "export_on_demand": {"events": events,
+                             "dumps_ms": round(export_ms, 3),
+                             "bytes": len(body)},
+        "basis": "deterministic microbench: ns per SpanRing.record "
+                 "(tight loop, %d iterations) x the EXACT spans one "
+                 "fit step completes (counted off the armed ring over "
+                 "%d steps), vs the same run's measured step wall "
+                 "time. No off/on wall-clock subtraction — on a shared "
+                 "host that delta sits inside scheduler noise; the "
+                 "per-event cost x crossing count bound is what the "
+                 "<%s%% claim rests on (same convention as "
+                 "BENCH_faults guard / BENCH_concurrency)."
+                 % (n_micro, steps, 0.5),
+    }
+    out = os.path.abspath(args.out)
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result, indent=2))
+    print("wrote", out)
+    return 0 if result["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,6 +16,12 @@ conditional expression, names built from constants) are declared in
 exposition-only series (``*_p50/90/99``, serving ``qps`` etc.) are
 documented as patterns and listed in ``DERIVED_OK``.
 
+The same gate covers trace spans (PR 17): every literal name passed to
+``span(...)`` must appear, backticked, in the docs' "## Span inventory"
+section — a span on the exported timeline that no document explains is
+the same dashboard rot one abstraction up. Dynamic span names go in
+``EXTRA_SPANS`` with the placeholder spelling the docs use.
+
 Usage: python tools/check_series_documented.py [--docs docs/observability.md]
 """
 from __future__ import annotations
@@ -47,6 +53,17 @@ DERIVED_OK = {
     "qps", "batch_fill_ratio", "executor_cache_hit_rate",
 }
 
+#: literal first-string-arg of span(...) calls (telemetry.span,
+#: tracing.span, metrics.span — the name is always the first string).
+#: Dotted names allowed; a name with format placeholders ("batch[%d]")
+#: deliberately fails the closing-quote match and is declared below.
+_SPAN_RE = re.compile(r"\bspan\(\s*(?:name=)?\"([a-z][a-z0-9_.]+)\"")
+
+#: dynamic span names, spelled the way the docs' span inventory does
+EXTRA_SPANS = [
+    "batch[N]",   # _tel.span("batch[%d]" % bucket) — serving/server.py
+]
+
 
 def emitted_series(pkg_dir):
     names = set(EXTRA_EMITTED)
@@ -59,6 +76,34 @@ def emitted_series(pkg_dir):
                 src = f.read()
             names.update(_CALL_RE.findall(src))
     return names - DERIVED_OK
+
+
+def emitted_spans(pkg_dir):
+    names = set(EXTRA_SPANS)
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn)) as f:
+                src = f.read()
+            names.update(_SPAN_RE.findall(src))
+    return names
+
+
+def span_inventory(doc_text):
+    """Backticked span names inside the "## Span inventory" section
+    ONLY — a prose mention elsewhere is not an inventory entry."""
+    names = set()
+    in_section = False
+    for line in doc_text.splitlines():
+        if line.startswith("#"):
+            in_section = line.strip().lower().lstrip("# ") \
+                == "span inventory"
+            continue
+        if in_section:
+            names.update(re.findall(r"`([a-z][a-z0-9_.\[\]N]+)`", line))
+    return names
 
 
 def main(argv=None):
@@ -87,7 +132,21 @@ def main(argv=None):
         print("add them to the series inventory table (or, for derived/"
               "non-series names, to DERIVED_OK in this tool).")
         return 1
-    print("check_series_documented: %d series, all documented." % len(names))
+    spans = emitted_spans(args.pkg)
+    doc_spans = span_inventory(doc_text)
+    missing_spans = sorted(s for s in spans if s not in doc_spans)
+    if missing_spans:
+        print("check_series_documented: %d emitted spans missing from the "
+              "'## Span inventory' section of %s:"
+              % (len(missing_spans), os.path.relpath(args.docs, ROOT)))
+        for s in missing_spans:
+            print("  - %s" % s)
+        print("every span lands on the exported timeline "
+              "(/debug/trace) — document it, or declare a dynamic "
+              "name's doc spelling in EXTRA_SPANS.")
+        return 1
+    print("check_series_documented: %d series + %d spans, all documented."
+          % (len(names), len(spans)))
     return 0
 
 

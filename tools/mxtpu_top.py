@@ -67,6 +67,15 @@ def _scalar(series, name, default=0):
     return v.get("count", default) if isinstance(v, dict) else v
 
 
+def _hist(series, name):
+    """The UNLABELED histogram snapshot dict for ``name`` ({} if absent):
+    labeled rows (phase=..., bucket=...) are separate series entries."""
+    for labels, v in series.get(name, []):
+        if not labels and isinstance(v, dict):
+            return v
+    return {}
+
+
 def snapshot(endpoint):
     """One polled frame's raw data: (metrics-json, debug-state)."""
     metrics = _fetch_json(endpoint.rstrip("/") + "/metrics?format=json")
@@ -152,19 +161,39 @@ def render(metrics, state, width=100):
                adm_d.get("state", "?")))
         kv = dec.get("kv") or {}
         if kv:
-            ttft = decode_reg.get("decode_ttft_ms", [({}, {})])[0][1] \
-                if decode_reg else {}
-            ttft = ttft if isinstance(ttft, dict) else {}
             pre = dec.get("prefill") or {}
             lines.append(
                 "decode kv: blocks %s/%s (%s live) | kv %s | "
-                "prefill chunks %s stalls %s | ttft p50 %.1fms (n=%d)"
+                "prefill chunks %s stalls %s"
                 % (kv.get("blocks_live", "?"), kv.get("blocks_total", "?"),
                    _fmt_bytes(kv.get("live_kv_bytes", 0)),
                    "chunk=%s" % pre.get("chunk_tokens", "?")
                    if pre else "rows",
-                   pre.get("chunks", "-"), pre.get("stalls", "-"),
-                   ttft.get("p50_ms", 0.0), ttft.get("count", 0)))
+                   pre.get("chunks", "-"), pre.get("stalls", "-")))
+        # latency attribution: TTFT/TBT percentiles + the per-phase
+        # breakdown (histograms expand to count/mean/p50/p90/p99 in the
+        # registry's json snapshot)
+        ttft = _hist(decode_reg, "decode_ttft_ms")
+        tbt = _hist(decode_reg, "decode_tbt_ms")
+        lines.append(
+            "decode latency: ttft p50 %.1f p99 %.1fms (n=%d) | "
+            "tbt p50 %.1f p99 %.1fms (n=%d)"
+            % (ttft.get("p50", 0.0), ttft.get("p99", 0.0),
+               ttft.get("count", 0),
+               tbt.get("p50", 0.0), tbt.get("p99", 0.0),
+               tbt.get("count", 0)))
+        phases = []
+        for labels, v in sorted(decode_reg.get("decode_phase_ms", []),
+                                key=lambda r: r[0].get("phase", "")):
+            if isinstance(v, dict) and v.get("count"):
+                phases.append("%s p50 %.1fms (n=%d)"
+                              % (labels.get("phase", "?"),
+                                 v.get("p50", 0.0), v.get("count", 0)))
+        tr = dec.get("trace_sample") or {}
+        lines.append(
+            "decode phases: %s | sampled traces %s (rate %s)"
+            % (" | ".join(phases) if phases else "(none yet)",
+               tr.get("sampled", 0), tr.get("rate", 0.0)))
         lines.append(bar)
 
     # ---- memory table
@@ -253,6 +282,28 @@ def _loop_curses(endpoint, interval):
     return 0
 
 
+def _dump_trace(endpoint, path):
+    """One-shot timeline export: GET /debug/trace -> FILE."""
+    try:
+        with urllib.request.urlopen(
+                endpoint.rstrip("/") + "/debug/trace", timeout=30) as r:
+            body = r.read()
+    except Exception as exc:
+        print("mxtpu_top: trace fetch from %s failed: %s"
+              % (endpoint, exc), file=sys.stderr)
+        return 1
+    with open(path, "wb") as f:
+        f.write(body)
+    try:
+        n = len(json.loads(body).get("traceEvents", []))
+    except ValueError:
+        n = -1
+    print("wrote %s (%d bytes, %s events) — open in Perfetto or "
+          "chrome://tracing" % (path, len(body),
+                                n if n >= 0 else "?"))
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("endpoint", help="http://host:port of an mxtpu server")
@@ -261,7 +312,14 @@ def main(argv=None):
                     help="print one plain-text frame and exit")
     ap.add_argument("--curses", action="store_true",
                     help="full-screen refresh (q to quit)")
+    ap.add_argument("--trace-out", metavar="FILE",
+                    help="fetch the server's captured timeline "
+                         "(GET /debug/trace, Chrome trace-event JSON), "
+                         "write it to FILE, and exit — load in Perfetto "
+                         "or chrome://tracing")
     args = ap.parse_args(argv)
+    if args.trace_out:
+        return _dump_trace(args.endpoint, args.trace_out)
     if args.curses and not args.once and sys.stdout.isatty():
         return _loop_curses(args.endpoint, args.interval)
     return _loop_plain(args.endpoint, args.interval, args.once)
