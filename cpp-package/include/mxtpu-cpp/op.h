@@ -2918,6 +2918,21 @@ inline std::vector<NDArray> degrees(const NDArray &data, const std::map<std::str
   return op_.Invoke();
 }
 
+inline Symbol dequantize_int8(const std::string &symbol_name, const Symbol &data, const Shape & scale, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("dequantize_int8");
+  op_.SetParam("scale", scale);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.SetInput("data", data);
+  return op_.CreateSymbol(symbol_name);
+}
+inline std::vector<NDArray> dequantize_int8(const NDArray &data, const Shape & scale, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("dequantize_int8");
+  op_.SetParam("scale", scale);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.AddInput(data);
+  return op_.Invoke();
+}
+
 inline Symbol dot(const std::string &symbol_name, const Symbol &lhs, const Symbol &rhs, const std::map<std::string, std::string> &kwargs = {}) {
   Operator op_("dot");
   for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
@@ -3610,6 +3625,21 @@ inline Symbol prod(const std::string &symbol_name, const Symbol &data, const std
 }
 inline std::vector<NDArray> prod(const NDArray &data, const std::map<std::string, std::string> &kwargs = {}) {
   Operator op_("prod");
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.AddInput(data);
+  return op_.Invoke();
+}
+
+inline Symbol quantize_int8(const std::string &symbol_name, const Symbol &data, const Shape & scale, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("quantize_int8");
+  op_.SetParam("scale", scale);
+  for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
+  op_.SetInput("data", data);
+  return op_.CreateSymbol(symbol_name);
+}
+inline std::vector<NDArray> quantize_int8(const NDArray &data, const Shape & scale, const std::map<std::string, std::string> &kwargs = {}) {
+  Operator op_("quantize_int8");
+  op_.SetParam("scale", scale);
   for (const auto &kv : kwargs) op_.SetParam(kv.first, kv.second);
   op_.AddInput(data);
   return op_.Invoke();

@@ -54,6 +54,7 @@ __all__ = ["DataflowAnalysis", "run_analysis", "precision_flow",
            "conv_layout", "LayoutPlan",
            "remat_reuse_plan", "RematReusePlan", "recompute_flops",
            "update_fusion_plan", "UpdateFusionPlan",
+           "quant_plan", "QuantPlan", "QUANT_COMPUTE",
            "BF16_SAFE", "F32_ISLAND", "MASTER_WEIGHT"]
 
 
@@ -165,6 +166,17 @@ def _sensitive_tables():
     return _F32_EXPLOG | _F32_NORMS | _F32_MISC | _REDUCTIONS | _DIV_OPS
 
 
+def _is_float_dtype(dt):
+    """True for every float dtype INCLUDING the ml_dtypes extension
+    types (bfloat16 is not a ``np.floating`` subtype, but a post-bf16
+    graph is full of it and the quant pass must still see its compute
+    as float-valued)."""
+    try:
+        return "float" in _np.dtype(dt).name
+    except TypeError:
+        return False
+
+
 class PrecisionPlan:
     """Result of :func:`precision_flow`.
 
@@ -254,7 +266,7 @@ class _PrecisionFlow(DataflowAnalysis):
             return F32_ISLAND
         # integer/bool outputs gain nothing and must not be cast
         out_dt = ctx.dtypes.get((id(node), 0))
-        if out_dt is not None and not _np.issubdtype(out_dt, _np.floating):
+        if out_dt is not None and not _is_float_dtype(out_dt):
             self.reasons[id(node)] = "non-float output (%s)" % out_dt
             return F32_ISLAND
         if op in _BF16_COMPUTE:
@@ -302,6 +314,210 @@ def precision_flow(symbol, shapes=None, types=None):
     for node in ctx.topo:
         if node.is_variable and node.name not in plan.var_class:
             plan.var_class[node.name] = F32_ISLAND
+    return plan
+
+
+# ------------------------------------------------------------ int8 quant plan
+#: matmul-class compute the int8 post-training-quantization rewrite
+#: targets: the weight stores int8 with per-output-channel scales (axis
+#: 0 in BOTH layouts — FullyConnected (num_hidden, input_dim),
+#: Convolution (O, I, kH, kW)) and the data input gains a per-tensor
+#: quantize/dequantize pair where calibration stats exist.
+#: Deconvolution stays out of scope: its (I, O, kH, kW) weight layout
+#: would make axis-0 scales quantize per INPUT channel.
+QUANT_COMPUTE = {"FullyConnected", "Convolution"}
+
+
+def _through_casts(src, idx=0, limit=8):
+    """Follow a pure Cast chain to its ultimate producer entry
+    ``(node, out_idx)`` — the bf16 rewrite interposes ``*_amp`` casts,
+    and both calibration naming and weight resolution must see through
+    them so ``quant`` composes with ``bf16``."""
+    hops = 0
+    while (not src.is_variable and src.op.name == "Cast"
+           and len(src.inputs) == 1 and hops < limit):
+        src, idx = src.inputs[0]
+        hops += 1
+    return src, idx
+
+
+def entry_name(node, idx):
+    """Canonical name of a graph entry ``(node, out_idx)`` — the key
+    calibration stats are recorded and replayed under."""
+    return node.name if idx == 0 else "%s_o%d" % (node.name, idx)
+
+
+class QuantPlan:
+    """Result of :func:`quant_plan` — what the ``quant`` rewrite is
+    licensed to do.
+
+    ``sites`` maps ``id(node)`` → ``{node, weight, weight_slot,
+    act_slots, active}`` for every matmul-class node whose weight
+    resolves (through casts) to a non-aux variable; ``weights`` maps a
+    qualified weight variable's NAME → ``{axis, elems, shape, sites}``
+    (a site is ``active`` iff its weight qualified); ``skipped``
+    records (name, reason) for weights the plan declined; ``observe``
+    lists the activation entries calibration should watch, named by
+    :func:`entry_name` of their through-cast producer so the keys are
+    stable across bf16 composition."""
+
+    def __init__(self, symbol):
+        self.symbol = symbol
+        self.sites = {}
+        self.weights = {}
+        self.skipped = []
+        self.observe = []       # (entry_name, node, out_idx)
+        self.n_f32_islands = 0
+        self.min_layer_elems = 0
+        self._shp = None
+        self._dt = None
+
+    @property
+    def n_sites(self):
+        return sum(1 for s in self.sites.values() if s["active"])
+
+    @property
+    def n_weights(self):
+        return len(self.weights)
+
+    @property
+    def weight_bytes_saved(self):
+        """Exact bytes the int8 weight storage removes: f32 (4 B) →
+        int8 (1 B) per element of every qualified weight."""
+        return sum(3 * w["elems"] for w in self.weights.values())
+
+    def summary(self):
+        return ("quant_plan: %d quantizable site(s), %d int8 weight(s) "
+                "(%.1f KB saved), %d activation entr%s to calibrate, "
+                "%d f32 island(s), %d weight(s) skipped"
+                % (self.n_sites, self.n_weights,
+                   self.weight_bytes_saved / 1024.0, len(self.observe),
+                   "y" if len(self.observe) == 1 else "ies",
+                   self.n_f32_islands, len(self.skipped)))
+
+    def to_findings(self, pass_name="quant_plan"):
+        out = []
+        for name, w in sorted(self.weights.items()):
+            out.append(Finding(
+                pass_name, INFO,
+                "weight '%s' %s quantizes to per-channel int8 (axis %d, "
+                "%d elems, saves %.1f KB) at site(s) %s"
+                % (name, w["shape"], w["axis"], w["elems"],
+                   3 * w["elems"] / 1024.0, ", ".join(w["sites"])),
+                node=name, provenance=tuple(w["sites"])))
+        for name, reason in self.skipped:
+            out.append(Finding(
+                pass_name, INFO,
+                "weight '%s' stays f32: %s" % (name, reason), node=name))
+        return out
+
+
+def quant_plan(symbol, shapes=None, types=None, min_layer_elems=0):
+    """License the int8 PTQ rewrite over ``symbol``; returns a
+    :class:`QuantPlan`. Reuses :func:`precision_flow`'s classification
+    — a node the bf16 rewrite would not touch (f32 island, non-float
+    output) is never quantized either — then qualifies each
+    matmul-class site's weight: it must resolve through casts to a
+    non-aux variable ALL of whose consumer edges are quantizable
+    weight slots (otherwise the f32 master would still stream
+    alongside the int8 copy) and meet the ``min_layer_elems`` floor."""
+    plan = QuantPlan(symbol)
+    plan.min_layer_elems = int(min_layer_elems)
+    pplan = precision_flow(symbol, shapes=shapes, types=types)
+    plan.n_f32_islands = pplan.n_f32
+    shp, dt, _ev = _prov.infer_walk(symbol, shapes, types)
+    plan._shp, plan._dt = shp, dt
+    topo = symbol._topo()
+    aux = symbol._aux_node_set()
+    consumers = {}
+    nodes_by_id = {}
+    for n in topo:
+        nodes_by_id[id(n)] = n
+        if n.is_variable:
+            continue
+        for i, (s, _idx) in enumerate(n.inputs):
+            consumers.setdefault(id(s), []).append((n, i))
+    # pass 1: the candidate sites and their weight variables
+    weight_sites = {}
+    for node in topo:
+        if node.is_variable or node.op.name not in QUANT_COMPUTE:
+            continue
+        if pplan.classes.get(id(node)) != BF16_SAFE:
+            continue
+        names = node.op.input_names(node.parsed_attrs(),
+                                    n=len(node.inputs))
+        if "weight" not in names:
+            continue
+        w_slot = names.index("weight")
+        act_slots = [i for i, nm in enumerate(names) if nm == "data"]
+        var, _vidx = _through_casts(*node.inputs[w_slot])
+        if not var.is_variable or id(var) in aux:
+            continue
+        plan.sites[id(node)] = {"node": node.name, "weight": var.name,
+                                "weight_slot": w_slot,
+                                "act_slots": act_slots, "active": False}
+        weight_sites.setdefault(id(var), []).append(node)
+    # pass 2: weight candidacy over ALL consumer edges of the variable
+    for vid, sites in weight_sites.items():
+        var = nodes_by_id[vid]
+        ok = True
+        stack = list(consumers.get(vid, ()))
+        while stack and ok:
+            c, i = stack.pop()
+            if not c.is_variable and c.op.name == "Cast":
+                nxt = consumers.get(id(c), ())
+                if not nxt:
+                    ok = False  # cast feeding a head: value escapes
+                stack.extend(nxt)
+                continue
+            site = plan.sites.get(id(c))
+            if site is None or site["weight_slot"] != i \
+                    or site["weight"] != var.name:
+                ok = False
+        if not ok:
+            plan.skipped.append(
+                (var.name, "consumed beyond quantizable weight slots "
+                           "(the f32 master would still have to stream)"))
+            continue
+        s = plan._shp.get(var.name)
+        if s is None:
+            plan.skipped.append((var.name, "shape unresolved — the "
+                                           "per-channel scale count is "
+                                           "unknowable"))
+            continue
+        elems = 1
+        for d in s:
+            elems *= int(d)
+        if elems < plan.min_layer_elems:
+            plan.skipped.append(
+                (var.name, "under quant.min_layer_elems (%d < %d) — "
+                           "dequant overhead beats the byte savings"
+                 % (elems, plan.min_layer_elems)))
+            continue
+        plan.weights[var.name] = {"axis": 0, "elems": elems,
+                                  "shape": tuple(s),
+                                  "sites": [n.name for n in sites]}
+        for n in sites:
+            plan.sites[id(n)]["active"] = True
+    # pass 3: the activation entries calibration observes — data-slot
+    # inputs of ACTIVE sites, through casts, float-valued, non-variable
+    seen = set()
+    for node in topo:
+        site = plan.sites.get(id(node))
+        if site is None or not site["active"]:
+            continue
+        for i in site["act_slots"]:
+            src, idx = _through_casts(*node.inputs[i])
+            if src.is_variable:
+                continue
+            d = plan._dt.get((id(src), idx))
+            if d is not None and not _is_float_dtype(d):
+                continue
+            name = entry_name(src, idx)
+            if name in seen:
+                continue
+            seen.add(name)
+            plan.observe.append((name, src, idx))
     return plan
 
 

@@ -97,6 +97,11 @@ LOCK_LEVELS = [
     # so tune sits between watchdog and the registry/engine levels
     ("tune", {("config", "_LOCK"), ("registry", "_LOCK"),
               ("OnlineController", "_lock")}),
+    # int8 calibration stats fold (compile/quant.py): observe() runs on
+    # the instrumented-program return path — possibly under replica
+    # dispatch locks — holds only for the per-name dict fold, and emits
+    # telemetry OUTSIDE the lock, so it sits just above the registry
+    ("quant-calib", {("CalibRecorder", "_lock")}),
     ("telemetry-registry", {("MetricsRegistry", "_lock"),
                             ("_DefaultRegistry", "_lock")}),
     # _BUILD_LOCK moved executor.py -> compile/pipeline.py in PR 7 (the
@@ -226,4 +231,7 @@ HOT_PATHS = {
     # a host sync or f64 promotion here lands in every bind/fit
     "mxtpu/analysis/rewrite.py": None,
     "mxtpu/analysis/dataflow.py": None,
+    # the calibration observer runs on every observed inference call's
+    # return path, and quantize/scale math runs per program build
+    "mxtpu/compile/quant.py": None,
 }

@@ -52,15 +52,18 @@ from . import provenance as _prov
 __all__ = ["TransformPass", "TransformContext", "register_transform",
            "get_transform", "list_transforms", "Bf16MixedPrecisionPass",
            "ConvLayoutPass", "OptimizerUpdateFusionPass",
-           "RematReusePass", "apply_precision_plan", "apply_layout_plan",
-           "CANONICAL_ORDER"]
+           "RematReusePass", "QuantizePass", "apply_precision_plan",
+           "apply_layout_plan", "apply_quant_plan", "CANONICAL_ORDER"]
 
 #: The canonical composition order. ``layout`` must see the conv runs
 #: before bf16's Casts could split them; ``bf16`` classifies the
 #: layout-retargeted graph (transposes follow their producers);
-#: ``fuse_opt`` and ``remat_reuse`` only annotate, but ``remat_reuse``
-#: runs last so its liveness walk sees the final node set.
-CANONICAL_ORDER = ("layout", "bf16", "fuse_opt", "remat_reuse")
+#: ``quant`` runs after bf16 so its weight resolution sees (and
+#: replaces) the ``*_amp`` casts and its dequant nodes emit the bf16
+#: the rewritten consumers expect; ``fuse_opt`` and ``remat_reuse``
+#: only annotate, but ``remat_reuse`` runs last so its liveness walk
+#: sees the final node set.
+CANONICAL_ORDER = ("layout", "bf16", "quant", "fuse_opt", "remat_reuse")
 
 _TRANSFORMS = {}
 
@@ -92,16 +95,42 @@ class TransformContext:
     """Everything a transform may read, plus where it records what it
     did. ``actions`` collects INFO findings (per-node provenance — the
     ``--pipeline`` report surface); a transform appends there and
-    returns the rewritten Symbol (or None for "no change")."""
+    returns the rewritten Symbol (or None for "no change").
+
+    ``values`` (executor builds only) maps bound parameter names to
+    their live arrays — a weight-materializing pass (``quant``) reads
+    scales off them and NEVER mutates them. :meth:`add_hint` declares
+    a variable the transform INTRODUCED (a new argument the original
+    graph cannot infer); the pipeline folds the hints into the
+    shape/dtype maps the post-rewrite verifier suite runs with.
+    ``prepared_args`` is the pass's contract with the executor: each
+    entry names a new argument the executor must materialize from an
+    existing one (``{new: {"src", "scale", "axis"}}`` — computed once
+    per weight version, streamed to the program in place of the f32
+    master)."""
 
     def __init__(self, symbol, kind=None, shapes=None, types=None,
-                 module=None):
+                 module=None, values=None):
         self.symbol = symbol
         self.kind = kind
         self.shapes = dict(shapes or {})
         self.types = dict(types or {})
         self.module = module
+        self.values = dict(values or {})
         self.actions = []
+        self.hint_shapes = {}
+        self.hint_types = {}
+        self.prepared_args = {}
+
+    def add_hint(self, name, shape=None, dtype=None):
+        """Pin an introduced variable's shape/dtype for the verifier
+        re-run (and for every later pass in the composition)."""
+        if shape is not None:
+            self.hint_shapes[name] = tuple(shape)
+            self.shapes[name] = tuple(shape)
+        if dtype is not None:
+            self.hint_types[name] = dtype
+            self.types[name] = dtype
 
 
 class TransformPass:
@@ -261,6 +290,248 @@ class Bf16MixedPrecisionPass(TransformPass):
         self.action(
             tctx, "%s; %d master-weight parameter(s) stay f32 in the "
             "fused state" % (plan.summary(), plan.n_master))
+        return new_sym
+
+
+# ----------------------------------------------------------- quant rewrite
+def apply_quant_plan(symbol, plan, weight_scales, act_scales=None,
+                     actions=None, pass_name="quant"):
+    """Clone ``symbol`` realizing ``plan`` (a
+    :class:`~mxtpu.analysis.dataflow.QuantPlan`): every qualified
+    weight's use edge is replaced by ``dequantize_int8`` over a NEW int8
+    variable (``<weight>__q8`` — the f32 master drops out of the
+    program's arguments; the executor streams the prepared int8 copy
+    instead), and every calibrated activation edge into an active site
+    gains a per-tensor ``quantize_int8``/``dequantize_int8`` pair.
+    ``weight_scales`` maps weight name → ``(scales_tuple, axis)``;
+    ``act_scales`` maps observed entry name → per-tensor scale. Dequant
+    outputs keep the dtype the replaced edge carried (bf16 under a
+    composed ``bf16`` pass), so consumers are byte-compatible.
+
+    Returns ``(new_symbol, prepared, counts)`` — ``prepared`` is the
+    executor contract ``{new_arg: {"src", "scale", "axis"}}``;
+    ``counts`` has exact ``dequant`` / ``act_qdq`` node tallies (the
+    bench basis)."""
+    from ..ops.registry import get_op
+    from ..symbol.symbol import _Node, Symbol
+    q_op = get_op("quantize_int8")
+    dq_op = get_op("dequantize_int8")
+    act_scales = act_scales or {}
+    if actions is None:
+        actions = []
+    mapping = {}
+    w_dq = {}       # (weight name, out dtype) -> shared dequant node
+    q_vars = {}     # weight name -> the int8 variable node
+    a_qdq = {}      # (id(orig src), idx, out dtype) -> shared QDQ tail
+    prepared = {}
+    counts = {"dequant": 0, "act_qdq": 0}
+
+    def edge_dtype(src, idx):
+        d = plan._dt.get((id(src), idx)) if plan._dt else None
+        return str(_np.dtype(d)) if d is not None else "float32"
+
+    def weight_dq(wname, out_dt):
+        key = (wname, out_dt)
+        hit = w_dq.get(key)
+        if hit is not None:
+            return hit
+        scales, axis = weight_scales[wname]
+        qv = q_vars.get(wname)
+        if qv is None:
+            qv = _Node(None, wname + "__q8", {}, [])
+            q_vars[wname] = qv
+            prepared[wname + "__q8"] = {"src": wname,
+                                        "scale": tuple(scales),
+                                        "axis": int(axis)}
+        node = _Node(dq_op, "%s__dq" % wname if out_dt == "float32"
+                     else "%s__dq_%s" % (wname, out_dt),
+                     {"scale": tuple(scales), "axis": int(axis),
+                      "out_dtype": out_dt}, [(qv, 0)])
+        w_dq[key] = node
+        counts["dequant"] += 1
+        return node
+
+    def act_qdq_of(nsrc, src, idx, sname, out_dt, consumer):
+        key = (id(src), idx, out_dt)
+        hit = a_qdq.get(key)
+        if hit is not None:
+            return hit
+        s = (float(act_scales[sname]),)
+        base = _df.entry_name(src, idx)
+        q = _Node(q_op, "%s__q8" % base, {"scale": s, "axis": -1},
+                  [(nsrc, idx)])
+        dq = _Node(dq_op, "%s__dq" % base,
+                   {"scale": s, "axis": -1, "out_dtype": out_dt},
+                   [(q, 0)])
+        a_qdq[key] = dq
+        counts["dequant"] += 1
+        counts["act_qdq"] += 1
+        actions.append(Finding(
+            pass_name, INFO,
+            "activation '%s' into '%s' quantizes per-tensor to int8 "
+            "(calibrated scale %.6g) and dequantizes to %s at the "
+            "consumer" % (sname, consumer, s[0], out_dt),
+            node=consumer, provenance=(sname,)))
+        return dq
+
+    for node in symbol._topo():
+        if node.is_variable:
+            mapping[id(node)] = node
+            continue
+        site = plan.sites.get(id(node))
+        active = site is not None and site["active"] \
+            and site["weight"] in weight_scales
+        new_inputs = []
+        for i, (src, idx) in enumerate(node.inputs):
+            nsrc = mapping[id(src)]
+            if active and i == site["weight_slot"]:
+                new_inputs.append(
+                    (weight_dq(site["weight"], edge_dtype(src, idx)), 0))
+            elif active and i in site["act_slots"]:
+                base_node, bidx = _df._through_casts(src, idx)
+                sname = _df.entry_name(base_node, bidx)
+                if base_node.is_variable or sname not in act_scales:
+                    new_inputs.append((nsrc, idx))
+                else:
+                    new_inputs.append(
+                        (act_qdq_of(nsrc, src, idx, sname,
+                                    edge_dtype(src, idx), node.name), 0))
+            else:
+                new_inputs.append((nsrc, idx))
+        clone = _Node(node.op, node.name, dict(node.attrs), new_inputs)
+        clone._extra_attrs = dict(node._extra_attrs)
+        mapping[id(node)] = clone
+    heads = [(mapping[id(n)], i) for n, i in symbol._outputs]
+    return Symbol(heads), prepared, counts
+
+
+@register_transform
+class QuantizePass(TransformPass):
+    """int8 post-training quantization for inference programs: weights
+    stream per-channel int8 (dequantized at use), calibrated activations
+    gain per-tensor quantize/dequantize pairs, f32 islands and training
+    kinds are never touched."""
+
+    name = "quant"
+
+    #: build kinds the rewrite may touch. Training kinds must keep f32
+    #: master weights wired for the optimizer update; the executor tags
+    #: its eval-graph builds ``executor_infer`` (the serving pool's
+    #: bucketed programs and the decode step both build through it).
+    INFERENCE_KINDS = frozenset({"executor_infer", "fwd_eval", "infer",
+                                 "serving", "decode"})
+
+    def _decline(self, tctx, reason, message):
+        from .. import telemetry as _tel
+        _tel.counter(
+            "quant_rejections", labels={"reason": reason},
+            help="quant rewrite declines, by reason (the graph keeps "
+                 "serving unquantized)").inc()
+        self.action(tctx, message)
+        return None
+
+    def run(self, tctx):
+        from .. import telemetry as _tel
+        from ..compile import quant as _quant
+        from ..tune import registry as _knobs
+        if tctx.kind not in self.INFERENCE_KINDS:
+            return self._decline(
+                tctx, "not_inference",
+                "inference-only pass: build kind %r trains or updates "
+                "state, so parameters must keep their f32 masters — "
+                "rewrite skipped" % (tctx.kind,))
+        if not tctx.values:
+            return self._decline(
+                tctx, "no_values",
+                "no bound parameter values in this build context — "
+                "weight scales are unknowable offline; rewrite skipped")
+        per_channel = bool(_knobs.resolve("quant.per_channel"))
+        min_elems = int(_knobs.resolve("quant.min_layer_elems"))
+        plan = _df.quant_plan(tctx.symbol, shapes=tctx.shapes,
+                              types=tctx.types,
+                              min_layer_elems=min_elems)
+        # a planned weight with no bound value cannot be scaled — its
+        # sites stay f32 (hot-swap bind dicts name every parameter, so
+        # this only fires for exotic manual binds)
+        for wname in [w for w in list(plan.weights)
+                      if w not in tctx.values]:
+            del plan.weights[wname]
+            plan.skipped.append((wname, "no bound value to scale"))
+            for site in plan.sites.values():
+                if site["weight"] == wname:
+                    site["active"] = False
+        tctx.actions.extend(plan.to_findings(pass_name=self.name))
+        if not plan.weights:
+            return self._decline(
+                tctx, "no_sites",
+                "%s — rewrite skipped" % plan.summary())
+        wscales = {}
+        for wname, w in plan.weights.items():
+            scales, axis = _quant.weight_scales(
+                tctx.values[wname], axis=w["axis"],
+                per_channel=per_channel)
+            wscales[wname] = (scales, axis)
+        # activation scales: the armed live recorder wins; otherwise
+        # replay the persisted corpus capture (fault-pointed load —
+        # a broken corpus degrades to weight-only, never a crash)
+        act_scales = {}
+        src_label = None
+        rec = _quant.recorder()
+        if rec is not None and rec.n_samples:
+            act_scales = rec.scales()
+            src_label = ("live calibration recorder (%d samples)"
+                         % rec.n_samples)
+        else:
+            try:
+                replay = _quant.replay_scales()
+            except Exception as exc:
+                _tel.counter(
+                    "quant_rejections",
+                    labels={"reason": "calibration_load"},
+                    help="quant rewrite declines, by reason (the graph "
+                         "keeps serving unquantized)").inc()
+                self.action(
+                    tctx, "calibration load failed (%s: %s) — "
+                    "activations stay float (weight-only int8)"
+                    % (type(exc).__name__, exc))
+                replay = {}
+            if replay:
+                act_scales = replay
+                src_label = "measurement-corpus replay"
+        wanted = {name for name, _n, _i in plan.observe}
+        act_scales = {k: v for k, v in act_scales.items() if k in wanted}
+        new_sym, prepared, counts = apply_quant_plan(
+            tctx.symbol, plan, wscales, act_scales,
+            actions=tctx.actions, pass_name=self.name)
+        for new, spec in prepared.items():
+            w = plan.weights[spec["src"]]
+            tctx.add_hint(new, shape=w["shape"], dtype="int8")
+            tctx.prepared_args[new] = spec
+        if act_scales:
+            self.action(
+                tctx, "%d/%d activation entr%s quantized with per-"
+                "tensor scales from %s"
+                % (counts["act_qdq"], len(plan.observe),
+                   "y" if counts["act_qdq"] == 1 else "ies", src_label))
+        elif plan.observe:
+            self.action(
+                tctx, "no calibration stats for the %d activation "
+                "entr%s — weight-only int8 (arm MXTPU_QUANT_CALIB or "
+                "quant.calibration_scope() during representative "
+                "traffic, or persist a corpus capture to replay)"
+                % (len(plan.observe),
+                   "y" if len(plan.observe) == 1 else "ies"))
+        _tel.gauge(
+            "quant_bytes_saved",
+            help="weight bytes removed from the program's argument "
+                 "stream by the last applied quant rewrite").set(
+            plan.weight_bytes_saved)
+        self.action(
+            tctx, "%s; %d dequantize node(s) interposed (%d weight, %d "
+            "activation); %s per-channel weight scales"
+            % (plan.summary(), counts["dequant"],
+               counts["dequant"] - counts["act_qdq"], counts["act_qdq"],
+               "axis-0" if per_channel else "per-tensor (knob off)"))
         return new_sym
 
 

@@ -27,12 +27,14 @@ from .pipeline import (PipelineReport, add_build_listener, configure,
                        configured, instrument_program, notify_build,
                        pipeline_scope, program_build_count,
                        record_program_build, remove_build_listener,
-                       set_output_sanitizer, transform_graph)
+                       set_calib_observer, set_output_sanitizer,
+                       transform_graph)
+from . import quant
 
 __all__ = [
     "PipelineReport", "transform_graph", "configure", "configured",
     "pipeline_scope",
     "add_build_listener", "remove_build_listener", "notify_build",
     "program_build_count", "record_program_build", "instrument_program",
-    "set_output_sanitizer",
+    "set_output_sanitizer", "set_calib_observer", "quant",
 ]

@@ -32,7 +32,8 @@ from .. import diagnostics as _diag
 from .. import telemetry as _tel
 from ..analysis import concurrency as _conc
 
-__all__ = ["set_output_sanitizer", "add_build_listener",
+__all__ = ["set_output_sanitizer", "set_calib_observer",
+           "add_build_listener",
            "remove_build_listener", "program_build_count", "notify_build",
            "record_program_build", "instrument_program",
            "prewarm_scope", "in_prewarm", "prewarm_build_count",
@@ -56,6 +57,22 @@ def set_output_sanitizer(fn):
     outputs (the numerics sanitizer); ``None`` uninstalls."""
     global _OUTPUT_SANITIZER
     _OUTPUT_SANITIZER = fn
+
+
+# The int8-calibration observer rides the same seam with the same
+# zero-overhead contract: compile.quant installs fn(kind, {name: array})
+# here while calibration is armed (MXTPU_QUANT_CALIB / arm()); programs
+# built with observation heads (instrument_program's ``calib_heads``)
+# feed the extra outputs through it and strip them before the sanitizer
+# and the caller ever see them.
+_CALIB_OBSERVER = None
+
+
+def set_calib_observer(fn):
+    """Install ``fn(kind, named_arrays)`` receiving every instrumented
+    program's calibration observations; ``None`` uninstalls."""
+    global _CALIB_OBSERVER
+    _CALIB_OBSERVER = fn
 
 
 # ---------------------------------------------------------------- cache hooks
@@ -165,7 +182,7 @@ _DEMOTE_MISS_TOTAL = 64  # lifetime misses → demote even if hits interleave
 
 
 def instrument_program(kind, fn, owner=None, matmul_env=False,
-                       precision=None, transforms=None):
+                       precision=None, transforms=None, calib_heads=None):
     """Wrap a freshly built jit program with the build-seam diagnostics.
 
     First invocation — the one that pays tracing + XLA compilation —
@@ -190,7 +207,15 @@ def instrument_program(kind, fn, owner=None, matmul_env=False,
     after the pipeline's bf16 rewrite); without it, the record derives a
     label from the captured argument dtypes. ``transforms`` stamps the
     record with the applied transform-pass names (the per-transform
-    ProgramRecord tag — a rejected pass never appears)."""
+    ProgramRecord tag — a rejected pass never appears).
+
+    ``calib_heads`` (int8 calibration capture): names, in order, of the
+    OBSERVATION heads the builder appended to the program's primary
+    output list — the program must return a tuple whose first element is
+    that list (the Executor's ``(outs, aux_updates)`` shape). The
+    wrapper feeds ``{name: array}`` to the armed calibration observer
+    and strips the extra outputs before the sanitizer and the caller see
+    them, so an observed program is call-compatible with a clean one."""
     import time as _time
     # keep only the owner's NAME: the wrapper outlives the owner in
     # process-global caches (metric.py _ACCUM_FN_CACHE), and a closure
@@ -322,6 +347,22 @@ def instrument_program(kind, fn, owner=None, matmul_env=False,
 
     def wrapped(*args, **kwargs):
         out = _dispatch(args, kwargs)
+        if calib_heads:
+            # split the trailing observation heads off the primary
+            # output list, feed the observer, return the clean shape
+            outs, rest = out[0], tuple(out[1:])
+            n = len(calib_heads)
+            main, extra = list(outs[:len(outs) - n]), outs[len(outs) - n:]
+            obs = _CALIB_OBSERVER
+            if obs is not None:
+                try:
+                    obs(kind, dict(zip(calib_heads, extra)))
+                except Exception:
+                    # mxtpu: allow-swallow(observer contract: a broken
+                    # calibration observer must not fail the serving
+                    # call it observes)
+                    pass
+            out = (main,) + rest
         san = _OUTPUT_SANITIZER
         if san is not None:
             # the hook gets THIS program's precision tag, not the
@@ -440,6 +481,9 @@ class PipelineReport:
         self.entries = []      # {name, applied, rejected, actions,
         #                         offending, error}
         self.symbol_changed = False
+        # {new_arg: {"src", "scale", "axis"}} from applied passes — the
+        # executor materializes these (e.g. int8 weights) at bind time
+        self.prepared_args = {}
 
     def _add(self, name):
         e = {"name": name, "applied": False, "rejected": False,
@@ -458,7 +502,11 @@ class PipelineReport:
     @property
     def precision(self):
         """Precision tag for the diagnostics program record, or None
-        when no precision-changing transform applied."""
+        when no precision-changing transform applied. An applied quant
+        rewrite wins over bf16 — the program's weight streams are int8
+        regardless of what precision the surviving compute runs in."""
+        if "quant" in self.applied:
+            return "int8_ptq"
         return "mixed_bf16" if "bf16" in self.applied else None
 
     @property
@@ -574,7 +622,7 @@ def _fresh_errors(base, post):
 
 
 def transform_graph(symbol, kind=None, shapes=None, types=None,
-                    module=None, passes=None):
+                    module=None, passes=None, values=None):
     """Run the active pipeline over ``symbol``; returns
     ``(symbol', PipelineReport)``.
 
@@ -585,7 +633,9 @@ def transform_graph(symbol, kind=None, shapes=None, types=None,
     logged) and the pipeline continues from the unrewritten graph.
     ``passes`` overrides the configured list (the ``--pipeline`` report
     surface); with an empty pipeline the input symbol is returned
-    untouched, cheaply.
+    untouched, cheaply. ``values`` (executor builds) exposes the bound
+    parameter arrays to weight-materializing passes (``quant`` reads
+    scales off them); passes never mutate them.
     """
     names = tuple(passes) if passes is not None else configured()
     names = canonical_order(names)
@@ -606,7 +656,8 @@ def transform_graph(symbol, kind=None, shapes=None, types=None,
             _log.warning("compile pipeline: %s", exc)
             continue
         tctx = _rw.TransformContext(cur, kind=kind, shapes=shapes,
-                                    types=types, module=module)
+                                    types=types, module=module,
+                                    values=values)
         try:
             new_sym = tp.run(tctx)
         except Exception as exc:  # a broken transform must not kill builds
@@ -617,6 +668,15 @@ def transform_graph(symbol, kind=None, shapes=None, types=None,
         entry["actions"] = list(tctx.actions)
         if new_sym is None or new_sym is cur:
             continue
+        # a pass may INTRODUCE variables (quant's int8 weights) — fold
+        # its declared hints in so the verifier re-run and every later
+        # pass see their shapes/dtypes (hints for variables a rejected
+        # graph dropped are inert: inference looks up by name)
+        if tctx.hint_shapes or tctx.hint_types:
+            shapes = dict(shapes)
+            shapes.update(tctx.hint_shapes)
+            types = dict(types)
+            types.update(tctx.hint_types)
         if base is None:
             base = _verify(cur, shapes, types, module)
         post = _verify(new_sym, shapes, types, module)
@@ -634,6 +694,7 @@ def transform_graph(symbol, kind=None, shapes=None, types=None,
         cur = new_sym
         base = post  # the accepted graph is the next baseline
         entry["applied"] = True
+        report.prepared_args.update(tctx.prepared_args)
         _tel.counter("transform_applied", labels={"pass": name}).inc()
     report.symbol_changed = cur is not symbol
     return cur, report

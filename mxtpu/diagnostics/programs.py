@@ -160,11 +160,13 @@ def summarize_shardings(rec, args):
 
 def summarize_precision(rec, args, tag=None):
     """Stamp ``rec.precision``: the compile pipeline's explicit ``tag``
-    wins (a bf16-rewritten program's ARGS are all f32 — master weights
-    — so dtype scanning alone cannot see the rewrite); otherwise the
-    label derives from the captured argument dtypes ("bf16" when every
-    float leaf is half-precision, "mixed" when both families appear,
-    else the dominant float family). Never raises."""
+    wins — "mixed_bf16" after the bf16 rewrite, "int8_ptq" after an
+    applied quant rewrite (a rewritten program's ARGS alone cannot tell
+    the story: bf16 keeps f32 master weights, and int8 weight streams
+    under per-site dequants would scan as "mixed"); otherwise the label
+    derives from the captured argument dtypes ("bf16" when every float
+    leaf is half-precision, "mixed" when both families appear, else the
+    dominant float family). Never raises."""
     if tag:
         rec.precision = str(tag)
         return

@@ -264,12 +264,20 @@ class Executor:
         self.outputs = []
         self._pending_grads = None
         self._fns = {}
-        self._fns_config = ()   # pipeline config the program table is for
+        self._fns_config = ()   # (pipeline config, calib flag) of the table
         # compile-pipeline state: the (possibly transformed) graph the
-        # traced programs are built from, cached per active pipeline
-        # config, and the report of what the transforms did/rejected
-        self._xform = None
+        # traced programs are built from, cached per (pipeline config,
+        # inference flag) — the quant pass rewrites ONLY the inference
+        # variant, training kinds keep f32 masters — and the report of
+        # what the transforms did/rejected (the latest build's report)
+        self._xform = {}
         self.pipeline_report = None
+        # quant's prepared-argument contract for the inference variant:
+        # {new_arg: {"src", "scale", "axis"}}; the int8 copies are
+        # quantized once per source array identity and re-streamed
+        self._prepared_args = {}
+        self._prep_cache = {}     # src name -> (source array, int8 copy)
+        self._prep_src = {}       # src name -> array at transform time
         self._monitor_callback = None
         # Adaptive heads-mode: callers that drive backward(out_grads)
         # (Module's unfused path with an external loss — the reference's
@@ -313,21 +321,32 @@ class Executor:
         return [n for n in self.arg_names
                 if self.grad_req.get(n, "null") != "null" and n in self.grad_dict]
 
-    def _program_symbol(self, names):
+    def _program_symbol(self, names, infer=False):
         """The graph the traced programs compile: the bind symbol run
         through the compile pipeline (mxtpu/compile/pipeline.py). With
         the pipeline empty — the default — this IS ``self._symbol``,
-        cost one tuple compare per build. The transform result is cached
-        per pipeline config; every accepted rewrite was re-proven by the
-        verifier suite before landing here. ``names`` is the config the
-        CALLER resolved — resolved exactly once per build, so a
-        concurrent ``configure()`` cannot split the table's config stamp
-        from the graph the program was actually built against."""
-        if self._xform is not None and self._xform[0] == names:
-            return self._xform[1]
+        cost one dict lookup per build. The transform result is cached
+        per (pipeline config, inference flag): ``infer`` builds tag the
+        pipeline ``kind="executor_infer"`` and expose the bound
+        parameter VALUES, which licenses inference-only rewrites (the
+        quant pass quantizes weights off them); training builds keep
+        ``kind="executor"`` and the f32 masters. Every accepted rewrite
+        was re-proven by the verifier suite before landing here.
+        ``names`` is the config the CALLER resolved — resolved exactly
+        once per build, so a concurrent ``configure()`` cannot split the
+        table's config stamp from the graph the program was actually
+        built against."""
+        key = (names, bool(infer))
+        hit = self._xform.get(key)
+        if hit is not None:
+            sym, report = hit
+            self.pipeline_report = report
+            if infer:
+                self._prepared_args = report.prepared_args \
+                    if report is not None else {}
+            return sym
         if not names:
-            sym = self._symbol
-            self.pipeline_report = None
+            sym, report = self._symbol, None
         else:
             shapes = {n: tuple(v.shape)
                       for d in (self.arg_dict, self.aux_dict)
@@ -335,9 +354,24 @@ class Executor:
             types = {n: v.dtype
                      for d in (self.arg_dict, self.aux_dict)
                      for n, v in d.items() if v is not None}
-            sym, self.pipeline_report = _pipeline.transform_graph(
-                self._symbol, kind="executor", shapes=shapes, types=types)
-        self._xform = (names, sym)
+            values = None
+            if infer:
+                values = {n: v._data for n, v in self.arg_dict.items()
+                          if v is not None}
+            sym, report = _pipeline.transform_graph(
+                self._symbol,
+                kind="executor_infer" if infer else "executor",
+                shapes=shapes, types=types, values=values)
+        self._xform[key] = (sym, report)
+        self.pipeline_report = report
+        if infer:
+            self._prepared_args = report.prepared_args \
+                if report is not None else {}
+            self._prep_cache = {}
+            self._prep_src = {
+                spec["src"]: values[spec["src"]]
+                for spec in self._prepared_args.values()
+                if values and spec["src"] in values}
         return sym
 
     def _precision_tag(self):
@@ -349,21 +383,46 @@ class Executor:
         return rep.transforms if rep is not None else None
 
     def _get_fn(self, kind):
+        from .compile import quant as _quant
         # the program table is valid for ONE pipeline config: flipping
         # the pipeline mid-life must not serve a program built from the
         # other graph, so a config change drops the table (programs
         # rebuild lazily; flipping back rebuilds too — correctness over
-        # caching for a debugging-time toggle)
+        # caching for a debugging-time toggle). Arming/disarming int8
+        # calibration is a config change too: observed programs carry
+        # extra output heads a clean table must not keep serving.
         names = _pipeline.configured()
-        if getattr(self, "_fns_config", ()) != names:
+        cfg = (names, _quant.calibrating())
+        if getattr(self, "_fns_config", ()) != cfg:
             self._fns = {}
-            self._fns_config = names
+            self._fns_config = cfg
+        infer = kind == "fwd_eval"
+        if infer and self._prepared_args:
+            # a quantized program bakes its weight scales into the
+            # graph: a swapped-in parameter array (hot-swap/set_params)
+            # invalidates them, so the inference variant rebuilds and
+            # re-quantizes from the NEW weights (id compare per call —
+            # the prepared set is a handful of entries)
+            for src, built in self._prep_src.items():
+                nd = self.arg_dict.get(src)
+                if nd is not None and nd._data is not built:
+                    self._fns.pop("fwd_eval", None)
+                    self._xform.pop((names, True), None)
+                    break
         fn = self._fns.get(kind)
         if fn is not None:
             _M_CACHE_HITS.inc()
             return fn
         _notify_build(kind, self)
-        symbol = self._program_symbol(names)
+        symbol = self._program_symbol(names, infer=infer)
+        calib_heads = None
+        if infer and _quant.calibrating():
+            entries = self._calib_entries(symbol)
+            if entries:
+                from .symbol.symbol import Symbol as _Sym
+                calib_heads = tuple(nm for nm, _n, _i in entries)
+                symbol = _Sym(list(symbol._outputs)
+                              + [(n, i) for _nm, n, i in entries])
         if kind == "fwd_eval":
             run = _trace_graph(symbol, is_train=False,
                                placements=self._placements)
@@ -449,9 +508,64 @@ class Executor:
             raise MXNetError("unknown program kind %s" % kind)
         fn = _instrument_program(kind, fn, owner=self, matmul_env=True,
                                  precision=self._precision_tag(),
-                                 transforms=self._transform_tags())
+                                 transforms=self._transform_tags(),
+                                 calib_heads=calib_heads)
         self._fns[kind] = fn
         return fn
+
+    def _calib_entries(self, symbol):
+        """Observation heads for int8 activation calibration: the
+        entries ``quant_plan`` wants watched, planned on the ORIGINAL
+        bind symbol (stable names — a quantized or bf16-rewritten graph
+        would hide its own sites) and located by producer name in the
+        traced graph ``symbol``. Returns ``[(entry_name, node, idx)]``
+        in plan order."""
+        from .analysis import dataflow as _df
+        from .tune import registry as _knobs
+        shapes = {n: tuple(v.shape)
+                  for d in (self.arg_dict, self.aux_dict)
+                  for n, v in d.items() if v is not None}
+        types = {n: v.dtype
+                 for d in (self.arg_dict, self.aux_dict)
+                 for n, v in d.items() if v is not None}
+        plan = _df.quant_plan(
+            self._symbol, shapes=shapes, types=types,
+            min_layer_elems=int(_knobs.resolve("quant.min_layer_elems")))
+        if not plan.observe:
+            return []
+        byname = {}
+        for n in symbol._topo():
+            if not n.is_variable:
+                byname.setdefault(n.name, n)
+        out = []
+        for name, node, idx in plan.observe:
+            n2 = byname.get(node.name)
+            if n2 is not None:
+                out.append((name, n2, idx))
+        return out
+
+    def _inject_prepared(self, raw_args):
+        """Swap quant's prepared arguments into the eval-program feed:
+        pop each quantized weight's f32 master and stream the int8 copy
+        (quantized once per source array identity) under the rewrite's
+        new argument name. No-op (zero copies) without an applied quant
+        rewrite."""
+        prep = self._prepared_args
+        if not prep:
+            return raw_args
+        from .compile import quant as _quant
+        out = dict(raw_args)
+        for new, spec in prep.items():
+            cur = out.pop(spec["src"], None)
+            if cur is None:
+                continue
+            cached = self._prep_cache.get(spec["src"])
+            if cached is None or cached[0] is not cur:
+                cached = (cur, _quant.quantize_array(
+                    cur, spec["scale"], spec["axis"]))
+                self._prep_cache[spec["src"]] = cached
+            out[new] = cached[1]
+        return out
 
     def _raw_args(self):
         return {n: self.arg_dict[n]._data for n in self.arg_names}
@@ -573,7 +687,12 @@ class Executor:
                 self._pending_grads = grads
         else:
             kind = "fwd_train" if is_train else "fwd_eval"
-            outs, auxu = self._get_fn(kind)(raw_args, raw_aux, rng)
+            fn = self._get_fn(kind)
+            if kind == "fwd_eval":
+                # _get_fn just resolved the inference variant, so the
+                # prepared-arg contract matches the program being fed
+                raw_args = self._inject_prepared(raw_args)
+            outs, auxu = fn(raw_args, raw_aux, rng)
             self._pending_grads = None
         if is_train:
             self._apply_aux(auxu)

@@ -115,6 +115,11 @@ POINTS = {
         "latency injection here IS a wedged device",
     "engine.dispatch":
         "engine push/dispatch seam — failing async op dispatch",
+    "quant.calibration_load":
+        "compile.quant.load_calibration, before the corpus read that "
+        "feeds int8 activation scales — a corrupt/unreadable "
+        "calibration store must decline the quant rewrite (the graph "
+        "serves unquantized), never crash the build",
 }
 
 _KINDS = ("raise", "errno", "latency", "kill")

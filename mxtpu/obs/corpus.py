@@ -21,7 +21,13 @@ Schema (version :data:`SCHEMA_VERSION`, one JSON object per line):
     keyed by ``bucket``), decode step / prefill chunk
     (``"decode_step"``/``"decode_prefill"``, keyed by ``rows``), and
     the fit step loop (``"fit_step"``), each with measured ``ms`` —
-    the *measurement* half.
+    the *measurement* half;
+  * **calibration rows** (``"row": "calib"``) — appended by
+    ``compile.quant.persist_calibration``: one complete snapshot of
+    the int8 activation-calibration stats (per-node count / abs-max /
+    running percentile, plus the percentile used), so int8 scales
+    calibrated from live traffic replay bit-identically offline
+    (``compile.quant.replay_scales``).
 
 Durability contract: one file per process (``mxtpu_corpus.<pid>.jsonl``
 — fleet processes never interleave), every row flushed + fsynced at
@@ -48,7 +54,8 @@ import time
 from ..analysis import concurrency as _conc
 
 __all__ = ["SCHEMA_VERSION", "enabled", "corpus_path", "record_build",
-           "record_service", "load", "summarize", "reset"]
+           "record_service", "record_calibration", "load", "summarize",
+           "reset"]
 
 SCHEMA_VERSION = 1
 _ENV = "MXTPU_CORPUS_DIR"
@@ -187,6 +194,21 @@ def record_service(source, ms, bucket=None, rows=None, program_id=None,
     return _append(row)
 
 
+def record_calibration(stats, percentile=None):
+    """Append one int8-calibration snapshot row (``stats`` is
+    ``CalibRecorder.stats()`` — a complete per-node mapping, so replay
+    reads the LATEST row and never stitches partials). No-op unless
+    the corpus is enabled."""
+    if not enabled():
+        return False
+    row = {"v": SCHEMA_VERSION, "row": "calib",
+           "t": round(time.time(), 6),
+           "stats": {str(k): dict(v) for k, v in (stats or {}).items()}}
+    if percentile is not None:
+        row["percentile"] = float(percentile)
+    return _append(row)
+
+
 # -------------------------------------------------------------- read side
 def load(dirpath=None, strict=False):
     """Every schema-valid row across the dir's ``*.jsonl`` files,
@@ -215,7 +237,7 @@ def load(dirpath=None, strict=False):
                 raise ValueError(
                     "corpus %s: corrupt row at line %d" % (name, i + 1))
             if isinstance(row, dict) and row.get("row") in (
-                    "build", "service"):
+                    "build", "service", "calib"):
                 rows.append(row)
             elif strict:
                 raise ValueError(

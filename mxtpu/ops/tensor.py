@@ -102,6 +102,47 @@ register("Cast", lambda a, x: x.astype(_np.dtype(a.dtype)),
          attrs={"dtype": Required(str)}, aliases=("cast",))
 register("_identity_with_attr_like_rhs", lambda a, l, r: l, arg_names=["lhs", "rhs"], attrs={})
 
+
+# ------------------------------------------------- int8 PTQ casts (compile quant)
+def _q8_scale(a, like):
+    """The scale attr as a broadcastable f32 array: per-tensor when
+    ``axis`` is negative (one scale for the whole tensor), per-channel
+    along ``axis`` otherwise (one scale per slice, reshaped so it
+    broadcasts against ``like``)."""
+    s = jnp.asarray(tuple(a.scale), jnp.float32)
+    axis = int(a.axis)
+    if axis < 0 or like.ndim == 0:
+        return s.reshape(()) if s.size == 1 else s
+    shape = [1] * like.ndim
+    shape[axis] = s.shape[0]
+    return s.reshape(shape)
+
+
+def _quantize_int8(a, x):
+    q = jnp.round(x.astype(jnp.float32) / _q8_scale(a, x))
+    return jnp.clip(q, -127, 127).astype(jnp.int8)
+
+
+def _dequantize_int8(a, q):
+    out = q.astype(jnp.float32) * _q8_scale(a, q)
+    return out.astype(_np.dtype(a.out_dtype))
+
+
+register("quantize_int8", _quantize_int8,
+         attrs={"scale": Required(tuple), "axis": -1},
+         doc="Symmetric int8 quantize: round(clip(x/scale, -127, 127)) "
+             "as int8. scale is a tuple of f32 scales — one element for "
+             "per-tensor (axis<0), one per slice of `axis` for "
+             "per-channel. The inverse of dequantize_int8; inserted by "
+             "the compile pipeline's `quant` pass, never user-authored.")
+register("dequantize_int8", _dequantize_int8,
+         attrs={"scale": Required(tuple), "axis": -1,
+                "out_dtype": "float32"},
+         doc="Symmetric int8 dequantize: q * scale, cast to out_dtype. "
+             "scale/axis mirror quantize_int8; out_dtype lets the pair "
+             "compose with the bf16 rewrite (bf16 activations round-"
+             "trip through int8 without an extra Cast).")
+
 # ---------------------------------------------------------------- binary elemwise
 binary("elemwise_add", jnp.add, aliases=("_plus", "_add"))
 binary("_grad_add", jnp.add)

@@ -146,16 +146,36 @@ class WarmExecutableCache:
             while len(self._versions) > self.max_versions:
                 self._versions.popitem(last=False)
 
-    def record_cost(self, sym_hash, tag, bucket, cost):
+    @staticmethod
+    def _cost_key(bucket, pipeline=None):
+        """Cost rows are keyed (bucket, compile-pipeline config): the
+        same (symbol, version) serves very different exec_ms once a
+        rewrite (bf16, quant) is in play, and a quantized swap-in must
+        not inherit the f32 service model and mis-derive the admission
+        watermark. ``pipeline=None`` stamps the CURRENT config."""
+        if pipeline is None:
+            from ..compile import pipeline as _pipeline
+            pipeline = _pipeline.configured()
+        return (int(bucket), tuple(pipeline))
+
+    def record_cost(self, sym_hash, tag, bucket, cost, pipeline=None):
+        key = self._cost_key(bucket, pipeline)
         with self._lock:
             v = self._versions.get((sym_hash, tag))
             if v is not None:
-                v["costs"][int(bucket)] = dict(cost)
+                v["costs"][key] = dict(cost)
 
-    def costs_for(self, sym_hash, tag):
+    def costs_for(self, sym_hash, tag, pipeline=None):
+        """The version's measured rows for ONE pipeline config (default:
+        the current one), in the ``{bucket: cost}`` shape the admission
+        policy and ``derive_knobs`` consume."""
+        want = self._cost_key(0, pipeline)[1]
         with self._lock:
             v = self._versions.get((sym_hash, tag))
-            return dict(v["costs"]) if v is not None else {}
+            if v is None:
+                return {}
+            return {b: dict(c) for (b, cfg), c in v["costs"].items()
+                    if cfg == want}
 
     def evict(self, sym_hash=None, tag=None):
         """Drop matching versions (both None = clear). Returns #evicted."""
@@ -192,8 +212,12 @@ class WarmExecutableCache:
                 ctxs[ctx] = sorted({shapes[0][1][0] for shapes in keys})
             out.append({"symbol_hash": sym_hash, "version": tag,
                         "created": created, "replicas": ctxs,
-                        "bucket_costs": {str(b): c
-                                         for b, c in costs.items()}})
+                        # "8" for pipeline-less rows, "8@bf16,quant"
+                        # for rows measured under a rewrite config
+                        "bucket_costs": {
+                            "%d@%s" % (b, ",".join(cfg)) if cfg
+                            else str(b): c
+                            for (b, cfg), c in costs.items()}})
         return out
 
 
@@ -467,11 +491,16 @@ class ExecutorPool:
         for b in buckets:
             shapes = self.bucket_shapes(b)
             key = Predictor.shape_key(shapes)
-            if rep.adopted and key in rep.base._bind_cache:
-                # adopted warm: compiled AND executed by its
-                # builder (a fresh replica's construction bind
-                # is only traced lazily — it still needs the
-                # first-call compile below)
+            if (rep.adopted and key in rep.base._bind_cache
+                    and b in self._bucket_costs):
+                # adopted warm WITH a cost row for the current pipeline
+                # config: compiled AND executed by its builder (a fresh
+                # replica's construction bind is only traced lazily — it
+                # still needs the first-call compile below). When the
+                # config changed since the builder measured (f32 rows,
+                # quant config live), _bucket_costs came back empty for
+                # this config and the bucket falls through: the forward
+                # below rebuilds under the new config and measures it.
                 continue
             dummy = {k: _np.zeros(s, dtype=_np.float32)
                      for k, s in shapes.items()}

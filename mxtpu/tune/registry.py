@@ -387,8 +387,10 @@ declare("elastic.keep", "int", 2, env="MXTPU_ELASTIC_KEEP",
 # itself is canonical — compile.pipeline normalizes the order)
 declare("compile.pipeline", "str", "", env="MXTPU_PIPELINE",
         candidates=("", "bf16", "fuse_opt", "layout", "remat_reuse",
+                    "quant", "bf16,quant",
                     "bf16,fuse_opt", "bf16,fuse_opt,remat_reuse",
-                    "bf16,fuse_opt,layout,remat_reuse"),
+                    "bf16,fuse_opt,layout,remat_reuse",
+                    "bf16,quant,fuse_opt,layout,remat_reuse"),
         help="transform-pass list the compile pipeline runs (comma-"
              "separated registry names; empty = no rewrites)")
 declare("compile.fuse_opt_max_kb", "float", 32.0,
@@ -404,3 +406,19 @@ declare("compile.remat_threshold", "float", 4.0,
         help="remat_reuse annotation bar: a node's residual is "
              "recomputed in backward when its recompute-flops per saved "
              "byte is at or below this ratio")
+
+# --- quant (int8 post-training quantization, docs/compile.md)
+declare("quant.calibration_percentile", "float", 99.9,
+        env="MXTPU_QUANT_PERCENTILE",
+        candidates=(99.0, 99.9, 99.99, 100.0), safe_range=(90.0, 100.0),
+        help="activation clipping statistic: per-batch percentile of "
+             "|x| whose running max sets the per-tensor int8 scale "
+             "(100.0 = plain abs-max, no clipping)")
+declare("quant.per_channel", "bool", True, env="MXTPU_QUANT_PER_CHANNEL",
+        candidates=(True, False),
+        help="weight scales per output channel (axis 0) when on; one "
+             "per-tensor scale per weight when off")
+declare("quant.min_layer_elems", "int", 64, env="MXTPU_QUANT_MIN_ELEMS",
+        candidates=(0, 64, 4096, 65536), safe_range=(0, 1 << 24),
+        help="smallest weight (elements) the quant pass rewrites — "
+             "below it the dequantize overhead beats the byte savings")

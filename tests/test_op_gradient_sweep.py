@@ -251,6 +251,9 @@ SKIP = {
     "cast_storage": "storage plumbing; identity derivative",
     "_contrib_quantize": "int8 output",
     "_contrib_dequantize": "int8 input",
+    "quantize_int8": "int8 output; inference-only (quant rewrite)",
+    "dequantize_int8": "int8 input; inference-only (quant rewrite), "
+                       "exact-value tested in tests/test_quant.py",
     # random samplers (stochastic output; distribution tests elsewhere)
     "_random_exponential": "stochastic", "_random_gamma": "stochastic",
     "_random_generalized_negative_binomial": "stochastic",

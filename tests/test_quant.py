@@ -1,0 +1,538 @@
+"""int8 post-training quantization through the gated transform seam
+(ISSUE 18): the ``quant`` TransformPass beside ``bf16``, weight scales
+per output channel, activation scales calibrated from live traffic via
+the output-sanitizer-adjacent observer seam, parity-gated per the
+standing PR-7 contract, serving-wide.
+
+Acceptance gates:
+* parity — a quant-rewritten eval matches the f32 eval's top-1 on the
+  mlp/lenet fixtures within the documented budget (2/256), and the
+  ``bf16,quant`` composition holds the same gate;
+* decode — token-level agreement on the greedy decode fixture, and a
+  mid-run hot-swap to a quantized version pins in-flight sequences to
+  their admission-time (f32) programs while post-swap admissions run
+  quantized;
+* safety — a deliberately broken quant config is REJECTED with the
+  offending Finding and the unrewritten graph still serves/trains;
+  the sanitizer trips on injected NaN in a quantized ``fwd_eval`` and
+  the postmortem names ``int8_ptq``;
+* calibration — capture → corpus persist → offline replay is
+  bit-identical;
+* serving — warm-cache cost rows are keyed (bucket, pipeline config):
+  a quantized swap-in never inherits the f32 service model.
+"""
+import logging
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+import mxtpu.symbol as S
+from mxtpu import analysis
+from mxtpu import diagnostics as diag
+from mxtpu import telemetry as tel
+from mxtpu.analysis import dataflow, rewrite
+from mxtpu.compile import pipeline, quant
+from mxtpu.models import lenet, mlp
+
+
+def _mlp_fixture(batch=64, seed=0):
+    """mlp symbol + random-init f32 params + eval input: PTQ is an
+    inference-time rewrite, so the parity gates run on a bound
+    executor's eval path — no fit needed."""
+    sym = mlp.get_symbol(10)
+    arg_shapes, _, _ = sym.infer_shape(data=(batch, 784),
+                                       softmax_label=(batch,))
+    rng = np.random.RandomState(seed)
+    args = {}
+    for name, shape in zip(sym.list_arguments(), arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        scale = 0.1 if name.endswith("weight") else 0.0
+        args[name] = mx.nd.array(
+            rng.randn(*shape).astype(np.float32) * scale)
+    x = rng.rand(batch, 784).astype(np.float32)
+    return sym, args, x
+
+
+_TRAINED = {}
+
+
+def _trained_mlp_fixture(batch=256):
+    """mlp fit for one epoch (cached per module): trained logits carry
+    real decision margins, the honest substrate for the top-1 gates —
+    random-init logits sit near uniform where ANY rounding flips an
+    argmax."""
+    if "mlp" not in _TRAINED:
+        X = np.random.RandomState(0).rand(256, 784).astype(np.float32)
+        y = np.random.RandomState(1).randint(
+            0, 10, 256).astype(np.float32)
+        it = mx.io.NDArrayIter(X, y, batch_size=64,
+                               label_name="softmax_label")
+        mod = mx.mod.Module(mlp.get_symbol(10), context=mx.cpu(),
+                            logger=logging.getLogger("quiet"))
+        mod.logger.setLevel(logging.ERROR)
+        mod.fit(it, num_epoch=1, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1})
+        args, _ = mod.get_params()
+        _TRAINED["mlp"] = ({k: v.copyto(mx.cpu())
+                            for k, v in args.items()}, X)
+    args, X = _TRAINED["mlp"]
+    return mlp.get_symbol(10), dict(args), X[:batch]
+
+
+def _bind_eval(sym, args, x, names):
+    """Bind and run ONE eval forward under the pipeline config; returns
+    (executor, output array)."""
+    full = dict(args, data=mx.nd.array(x),
+                softmax_label=mx.nd.zeros((x.shape[0],)))
+    with pipeline.pipeline_scope(names):
+        ex = sym.bind(mx.cpu(), full, args_grad=None, grad_req="null")
+        out = ex.forward(is_train=False)[0].asnumpy()
+    return ex, out
+
+
+# ------------------------------------------------------------- the catalog
+def test_quant_registered_with_canonical_order():
+    names = [n for n, _ in rewrite.list_transforms()]
+    assert "quant" in names
+    assert rewrite.CANONICAL_ORDER == (
+        "layout", "bf16", "quant", "fuse_opt", "remat_reuse")
+    # operator spelling never matters: quant lands after bf16
+    assert pipeline.canonical_order(["quant", "bf16"]) == ("bf16",
+                                                           "quant")
+
+
+def test_quant_plan_sites_islands_and_floor():
+    """The licensing analysis: FC compute sites quantize, the softmax
+    head stays an f32 island, and ``min_layer_elems`` drops small
+    layers from the plan."""
+    sym = mlp.get_symbol(10)
+    arg_shapes, _, _ = sym.infer_shape(data=(64, 784),
+                                       softmax_label=(64,))
+    shapes = dict(zip(sym.list_arguments(), arg_shapes))
+    plan = dataflow.quant_plan(sym, shapes=shapes)
+    assert plan.n_sites == 3           # fc1, fc2, fc3
+    assert set(plan.weights) == {"fc1_weight", "fc2_weight",
+                                 "fc3_weight"}
+    for w in plan.weights.values():
+        assert w["axis"] == 0          # per OUTPUT channel
+    assert plan.weight_bytes_saved == sum(
+        3 * w["elems"] for w in plan.weights.values())
+    # the softmax head is never a site
+    site_ops = {s["node"] for s in plan.sites.values()}
+    assert not any("softmax" in n for n in site_ops)
+    # floor: a huge min_layer_elems deactivates everything
+    plan2 = dataflow.quant_plan(sym, shapes=shapes,
+                                min_layer_elems=10**9)
+    assert plan2.n_sites == 0 and not plan2.weights
+
+
+def test_weight_scales_per_channel_math():
+    w = np.array([[1.0, -2.0], [0.5, 0.25], [0.0, 0.0]], np.float32)
+    scales, axis = quant.weight_scales(w, axis=0, per_channel=True)
+    assert axis == 0 and len(scales) == 3
+    assert scales[0] == pytest.approx(2.0 / 127.0)
+    assert scales[1] == pytest.approx(0.5 / 127.0)
+    # all-zero row clamps to TINY_SCALE (f32-rounded) — never div0s
+    assert scales[2] == pytest.approx(quant.TINY_SCALE)
+    scales_t, axis_t = quant.weight_scales(w, per_channel=False)
+    assert axis_t == -1 and len(scales_t) == 1
+    assert scales_t[0] == pytest.approx(2.0 / 127.0)
+
+
+def test_quantize_roundtrip_error_bounded_by_half_scale():
+    rng = np.random.RandomState(3)
+    w = rng.randn(8, 16).astype(np.float32)
+    scales, axis = quant.weight_scales(w)
+    q = np.asarray(quant.quantize_array(w, scales, axis))
+    assert q.dtype == np.int8
+    deq = q.astype(np.float32) * np.asarray(scales,
+                                            np.float32)[:, None]
+    err = np.abs(deq - w)
+    bound = np.asarray(scales, np.float32)[:, None] * 0.5 + 1e-7
+    assert (err <= bound).all()
+
+
+# ---------------------------------------------------------- the rewrite
+def test_quant_rewrite_structure_and_prepared_args():
+    """Exact dequant-node counts, int8 prepared-arg specs, and the
+    explicit precision tag — the deterministic basis the bench
+    re-measures."""
+    sym, args, x = _mlp_fixture()
+    values = {k: v._data for k, v in args.items()}
+    arg_shapes, _, _ = sym.infer_shape(data=(64, 784),
+                                       softmax_label=(64,))
+    shapes = dict(zip(sym.list_arguments(), arg_shapes))
+    sym2, rep = pipeline.transform_graph(
+        sym, kind="fwd_eval", shapes=shapes, passes=["quant"],
+        values=values)
+    assert rep.applied == ["quant"] and rep.rejected == []
+    assert rep.precision == "int8_ptq"
+    names = [n.name for n in sym2._topo() if not n.is_variable]
+    assert sum(1 for n in names if n.endswith("__dq")) == 3
+    assert set(rep.prepared_args) == {"fc1_weight__q8",
+                                      "fc2_weight__q8",
+                                      "fc3_weight__q8"}
+    for new, spec in rep.prepared_args.items():
+        assert spec["src"] == new[:-len("__q8")]
+        assert spec["axis"] == 0
+        assert len(spec["scale"]) == values[spec["src"]].shape[0]
+
+
+def test_quant_declines_train_kind_and_missing_values():
+    sym, args, _ = _mlp_fixture()
+    arg_shapes, _, _ = sym.infer_shape(data=(64, 784),
+                                       softmax_label=(64,))
+    shapes = dict(zip(sym.list_arguments(), arg_shapes))
+    reg = tel.registry()
+    b_train = reg.counter("quant_rejections",
+                          labels={"reason": "not_inference"}).value
+    _, rep = pipeline.transform_graph(sym, kind="executor",
+                                      shapes=shapes, passes=["quant"])
+    assert rep.applied == []
+    assert reg.counter("quant_rejections",
+                       labels={"reason": "not_inference"}).value \
+        == b_train + 1
+    b_vals = reg.counter("quant_rejections",
+                         labels={"reason": "no_values"}).value
+    _, rep = pipeline.transform_graph(sym, kind="fwd_eval",
+                                      shapes=shapes, passes=["quant"])
+    assert rep.applied == []
+    assert reg.counter("quant_rejections",
+                       labels={"reason": "no_values"}).value \
+        == b_vals + 1
+
+
+# ------------------------------------------------------------- parity gates
+@pytest.mark.parametrize("names", [["quant"], ["bf16", "quant"]])
+def test_quant_parity_gate_mlp(names):
+    """THE acceptance gate (PR-7 convention, eval flavor): top-1 on the
+    mlp fixture agrees with the f32 eval within 2/256; probabilities
+    within the int8 envelope. Holds for quant alone AND composed after
+    bf16 in canonical order."""
+    sym, args, x = _mlp_fixture()
+    _, ref = _bind_eval(sym, args, x, [])
+    ex, out = _bind_eval(sym, args, x, names)
+    rep = ex.pipeline_report
+    assert "quant" in rep.applied and rep.rejected == []
+    if "bf16" in names:
+        assert rep.applied.index("bf16") < rep.applied.index("quant")
+    assert rep.precision == "int8_ptq"
+    agree = (np.argmax(out, 1) == np.argmax(ref, 1)).mean()
+    assert agree >= 1.0 - 2 / 256.0, agree
+    assert np.max(np.abs(out - ref)) < 0.05
+
+
+def test_quant_parity_gate_lenet_eval():
+    """Same gate on the conv fixture: Convolution sites quantize (the
+    per-output-channel axis is 0 in (O,I,kH,kW) layout) and top-1
+    holds."""
+    sym = lenet.get_symbol(10)
+    batch = 32
+    arg_shapes, _, _ = sym.infer_shape(data=(batch, 1, 28, 28),
+                                       softmax_label=(batch,))
+    rng = np.random.RandomState(1)
+    args = {}
+    for name, shape in zip(sym.list_arguments(), arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        scale = 0.1 if name.endswith("weight") else 0.0
+        args[name] = mx.nd.array(
+            rng.randn(*shape).astype(np.float32) * scale)
+    x = rng.rand(batch, 1, 28, 28).astype(np.float32)
+    _, ref = _bind_eval(sym, args, x, [])
+    ex, out = _bind_eval(sym, args, x, ["quant"])
+    assert "quant" in ex.pipeline_report.applied
+    agree = (np.argmax(out, 1) == np.argmax(ref, 1)).mean()
+    assert agree >= 1.0 - 2 / 256.0, agree
+
+
+def test_quant_never_touches_training():
+    """The kind gate end-to-end: a fit with quant in the pipeline list
+    trains on the UNREWRITTEN graph (quant declines non-inference
+    kinds), and the eval path of the same module quantizes."""
+    X = np.random.RandomState(0).rand(128, 784).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, 128).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=64,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(mlp.get_symbol(10), context=mx.cpu(),
+                        logger=logging.getLogger("quiet"))
+    mod.logger.setLevel(logging.ERROR)
+    with pipeline.pipeline_scope(["quant"]):
+        mod.fit(it, num_epoch=1, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1})
+        rep = mod._fused.pipeline_report
+        assert "quant" not in rep.applied
+        assert rep.precision != "int8_ptq"
+    args, _ = mod.get_params()
+    for v in args.values():
+        assert np.isfinite(v.asnumpy()).all()
+
+
+# ------------------------------------------------------------- calibration
+def test_calibration_capture_persist_replay_bit_identical(tmp_path,
+                                                          monkeypatch):
+    """Live-traffic calibration: armed evals observe activations, the
+    stats persist into the measurement corpus, and the offline replay
+    reproduces the SAME scales bit-for-bit (the running-max percentile
+    fold is order-independent)."""
+    monkeypatch.setenv("MXTPU_CORPUS_DIR", str(tmp_path))
+    from mxtpu.obs import corpus
+    corpus.reset()
+    sym, args, x = _mlp_fixture()
+    reg = tel.registry()
+    before = reg.counter("quant_calib_samples").value
+    with quant.calibration_scope() as rec:
+        with pipeline.pipeline_scope([]):
+            ex = sym.bind(mx.cpu(),
+                          dict(args, data=mx.nd.array(x),
+                               softmax_label=mx.nd.zeros((64,))),
+                          args_grad=None, grad_req="null")
+            ex.forward(is_train=False)
+            ex.forward(is_train=False)
+        assert rec.n_samples > 0
+        live = quant.scales_from_stats(rec.stats())
+        quant.persist_calibration(rec)
+    assert reg.counter("quant_calib_samples").value > before
+    assert live, "no activation scales captured"
+    replayed = quant.replay_scales()
+    assert replayed == live            # bit-identical, not approx
+    # the corpus row round-trips through load()
+    rows = [r for r in corpus.load() if r.get("row") == "calib"]
+    assert rows and rows[-1]["stats"]
+
+
+def test_calibrated_activation_qdq_applies_and_holds_parity():
+    """With a calibrated recorder armed, the rewrite interposes
+    activation Q/DQ pairs (not just weight dequants) and the parity
+    gate still holds on the trained fixture (256 samples — the budget
+    convention's denominator)."""
+    sym, args, x = _trained_mlp_fixture(batch=256)
+    _, ref = _bind_eval(sym, args, x, [])
+    with quant.calibration_scope():
+        _bind_eval(sym, args, x, [])       # capture pass
+        ex, out = _bind_eval(sym, args, x, ["quant"])
+    rep = ex.pipeline_report
+    assert "quant" in rep.applied
+    # activation Q/DQ pairs really landed (not just weight dequants)
+    key = (("quant",), True)
+    assert key in ex._xform
+    nodes = [n.name for n in ex._xform[key][0]._topo()
+             if not n.is_variable]
+    assert any(n.endswith("__q8") for n in nodes), nodes
+    agree = (np.argmax(out, 1) == np.argmax(ref, 1)).mean()
+    assert agree >= 1.0 - 2 / 256.0, agree
+    assert np.max(np.abs(out - ref)) < 0.05
+
+
+def test_calibration_load_fault_point_weight_only_fallback():
+    """The declared fault point at the calibration-load seam: a corpus
+    read failure must degrade to the weight-only rewrite (counted), not
+    reject the pass outright."""
+    from mxtpu import faults
+    sym, args, x = _mlp_fixture()
+    reg = tel.registry()
+    before = reg.counter("quant_rejections",
+                         labels={"reason": "calibration_load"}).value
+    with faults.scope("quant.calibration_load:kind=raise,times=1"):
+        ex, out = _bind_eval(sym, args, x, ["quant"])
+    assert "quant" in ex.pipeline_report.applied   # weight-only applied
+    assert reg.counter("quant_rejections",
+                       labels={"reason": "calibration_load"}).value \
+        == before + 1
+    assert np.isfinite(out).all()
+
+
+# ------------------------------------------------------- rejection/fallback
+def test_broken_quant_config_rejected_unrewritten_graph_serves(
+        monkeypatch):
+    """PR-7 rejected-rewrite e2e, quant flavor: wrong-length scales make
+    the rewritten graph fail shape inference — the gate rejects exactly
+    ``quant`` with the offending Finding, bumps the counter, and the
+    UNREWRITTEN graph still evals AND trains."""
+
+    def bad_scales(w, axis=0, per_channel=True):
+        return (1.0, 2.0), 0           # wrong length for every weight
+
+    monkeypatch.setattr(quant, "weight_scales", bad_scales)
+    before = tel.registry().counter("transform_rejected",
+                                    labels={"pass": "quant"}).value
+    sym, args, x = _mlp_fixture()
+    ex, out = _bind_eval(sym, args, x, ["quant"])
+    rep = ex.pipeline_report
+    assert rep.rejected == ["quant"]
+    assert rep.applied == [] and not rep.prepared_args
+    entry = [e for e in rep.entries if e["name"] == "quant"][0]
+    assert entry["offending"] or entry["error"]
+    assert tel.registry().counter(
+        "transform_rejected", labels={"pass": "quant"}).value \
+        == before + 1
+    assert np.isfinite(out).all()      # fallback serves
+    # ...and the same config still trains (fallback end-to-end)
+    X = np.random.RandomState(0).rand(64, 784).astype(np.float32)
+    y = np.zeros(64, np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=64,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(mlp.get_symbol(10), context=mx.cpu(),
+                        logger=logging.getLogger("quiet"))
+    mod.logger.setLevel(logging.ERROR)
+    with pipeline.pipeline_scope(["quant"]):
+        mod.fit(it, num_epoch=1, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1})
+    args2, _ = mod.get_params()
+    assert all(np.isfinite(v.asnumpy()).all() for v in args2.values())
+
+
+def test_sanitizer_trips_on_quantized_eval_names_int8_ptq():
+    """Sanitizer × quant: injected NaN input through a quantized
+    ``fwd_eval`` still trips (the f32 islands carry it to the head),
+    and the error + postmortem name the ``int8_ptq`` precision mode."""
+    sym, args, x = _mlp_fixture()
+    x = x.copy()
+    x[7] = np.nan
+    analysis.sanitizer_enable("nan")
+    try:
+        with pytest.raises(analysis.NumericsError) as ei:
+            _bind_eval(sym, args, x, ["quant"])
+    finally:
+        analysis.sanitizer_disable()
+    assert "precision=int8_ptq" in str(ei.value)
+    pm = diag.last_postmortem()
+    assert pm is not None and pm["source"] == "sanitizer"
+
+
+# ----------------------------------------------------------- weight refresh
+def test_weight_hot_swap_requantizes_identically():
+    """set_params after a quantized build: the staleness check rebuilds
+    the prepared int8 stream from the NEW master weights — bit-identical
+    to a fresh bind with those weights."""
+    sym, args, x = _mlp_fixture(seed=0)
+    _, args2, _ = _mlp_fixture(seed=5)
+    label = mx.nd.zeros((x.shape[0],))
+    with pipeline.pipeline_scope(["quant"]):
+        ex = sym.bind(mx.cpu(), dict(args, data=mx.nd.array(x),
+                                     softmax_label=label),
+                      args_grad=None, grad_req="null")
+        ex.forward(is_train=False)
+        for k, v in args2.items():     # swap masters in place
+            ex.arg_dict[k][:] = v
+        out_swapped = ex.forward(is_train=False)[0].asnumpy()
+        ex2 = sym.bind(mx.cpu(), dict(args2, data=mx.nd.array(x),
+                                      softmax_label=label),
+                       args_grad=None, grad_req="null")
+        out_fresh = ex2.forward(is_train=False)[0].asnumpy()
+    assert np.array_equal(out_swapped, out_fresh)
+
+
+# ------------------------------------------------------------ serving-wide
+def _pool_fixture():
+    data = S.Variable("data")
+    fc1 = S.FullyConnected(data, name="pfc1", num_hidden=32)
+    act = S.Activation(fc1, act_type="relu", name="prelu1")
+    fc2 = S.FullyConnected(act, name="pfc2", num_hidden=10)
+    out = S.SoftmaxOutput(fc2, name="softmax")
+    rng = np.random.RandomState(0)
+    params = {"pfc1_weight": mx.nd.array(rng.randn(32, 16) * 0.1),
+              "pfc1_bias": mx.nd.zeros((32,)),
+              "pfc2_weight": mx.nd.array(rng.randn(10, 32) * 0.1),
+              "pfc2_bias": mx.nd.zeros((10,))}
+    return out.tojson(), params, {"data": (4, 16)}
+
+
+def test_warm_cache_costs_keyed_by_pipeline_config():
+    """Satellite fix: cost rows are (bucket, pipeline config) — a
+    quantized swap-in of the SAME version must not inherit the f32
+    service model, and its warmup measures its own rows even when the
+    replicas adopt warm."""
+    from mxtpu.serving.pool import ExecutorPool, warm_cache
+    sj, params, shapes = _pool_fixture()
+    warm_cache().evict()
+    pool_f32 = ExecutorPool(sj, params, shapes, contexts=[mx.cpu()],
+                            version_tag="vq1")
+    pool_f32.warmup([4, 8])
+    assert sorted(pool_f32.bucket_costs()) == [4, 8]
+    with pipeline.pipeline_scope(["quant"]):
+        pool_q = ExecutorPool(sj, params, shapes, contexts=[mx.cpu()],
+                              version_tag="vq1")
+        assert pool_q.bucket_costs() == {}   # no f32 inheritance
+        pool_q.warmup([4])                   # adopted warm, new config
+        assert 4 in pool_q.bucket_costs()
+    # f32 rows untouched; manifest renders the config-qualified key
+    assert sorted(pool_f32.bucket_costs()) == [4, 8]
+    m = [v for v in warm_cache().manifest() if v["version"] == "vq1"]
+    assert m and set(m[0]["bucket_costs"]) == {"4", "8", "4@quant"}
+
+
+def test_serving_pool_quant_top1_parity():
+    from mxtpu.serving.pool import ExecutorPool, warm_cache
+    sj, params, shapes = _pool_fixture()
+    warm_cache().evict()
+    x = np.random.RandomState(1).randn(4, 16).astype(np.float32)
+
+    def run(pool):
+        out = pool.run({"data": x})[0]
+        return out.asnumpy() if hasattr(out, "asnumpy") \
+            else np.asarray(out)
+
+    ref = run(ExecutorPool(sj, params, shapes, contexts=[mx.cpu()],
+                           version_tag="vp1"))
+    with pipeline.pipeline_scope(["quant"]):
+        got = run(ExecutorPool(sj, params, shapes, contexts=[mx.cpu()],
+                               version_tag="vp1"))
+    assert np.argmax(got, 1).tolist() == np.argmax(ref, 1).tolist()
+    assert 0 < np.max(np.abs(got - ref)) < 0.05
+
+
+# ------------------------------------------------------------------ decode
+def test_decode_token_parity_and_hot_swap_to_quantized():
+    """Token-level gate on the decode fixture: greedy decode under the
+    quant pipeline emits the SAME tokens as f32, and a mid-run
+    ``swap_model`` to a quantized version pins the in-flight sequence
+    to its admission-time f32 program while post-swap admissions run
+    quantized (version tags prove which program served)."""
+    from mxtpu.serving import DecodeSession
+    from mxtpu.serving.decode import lm_decode_fixture
+    sym, params, shapes, state_names, _ = lm_decode_fixture(seed=0)
+    reqs = [([3, 5], 8, 0, 0.0), ([2], 8, 0, 0.0)]
+
+    def decode_all(names, tag):
+        out = []
+        with pipeline.pipeline_scope(names):
+            with DecodeSession(sym, params, shapes, state_names,
+                               buckets=(4,), slot_capacity=1,
+                               version_tag=tag) as sess:
+                for prompt, max_new, seed, temp in reqs:
+                    out.append(sess.generate(
+                        prompt, max_new_tokens=max_new, seed=seed,
+                        temperature=temp, timeout=60)["tokens"])
+        return out
+
+    f32 = decode_all([], "qd-f32")
+    q = decode_all(["quant"], "qd-int8")
+    assert q == f32, (q, f32)          # token-level parity, greedy
+
+    # mid-run hot-swap: start f32, swap to the quantized config
+    res = [None] * 2
+    with DecodeSession(sym, params, shapes, state_names, buckets=(4,),
+                       slot_capacity=1, version_tag="qd-v0") as sess:
+
+        def run(i, prompt, n):
+            res[i] = sess.generate(prompt, max_new_tokens=n,
+                                   timeout=120)
+
+        t = threading.Thread(target=run, args=(0, [3, 5], 24))
+        t.start()
+        deadline = time.monotonic() + 10
+        while len(sess._active) < 1 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        with pipeline.pipeline_scope(["quant"]):
+            info = sess.swap_model(sym, params, version_tag="qd-v1")
+            assert info["generation"] == 1
+            run(1, [2], 8)
+        t.join(timeout=120)
+    assert res[0]["version"] == "qd-v0"     # pinned to admission-time
+    assert res[1]["version"] == "qd-v1"     # served by the quant build
+    assert res[1]["tokens"] == f32[1]       # and token-parity held

@@ -112,7 +112,8 @@ def test_registry_version_is_stable_and_knob_sensitive():
     assert len(v1) == 12
     # every catalogued knob belongs to a known subsystem
     subs = {k.subsystem for k in tune.knobs()}
-    assert subs == {"fit", "serving", "decode", "elastic", "compile"}
+    assert subs == {"fit", "serving", "decode", "elastic", "compile",
+                    "quant"}
 
 
 def test_bool_coercion_matches_env_contract():

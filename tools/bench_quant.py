@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Benchmark: what the int8 PTQ rewrite buys, per inference program.
+
+The verdict basis is DETERMINISTIC (PR-2 convention): the quant plan's
+liveness-derived weight-byte arithmetic (f32 master -> int8 stream is
+exactly 3 bytes saved per element, computed from the inferred shapes),
+the exact dequant/f32-island node counts of the rewritten graph, and
+the cost registry's XLA ``memory_analysis`` argument bytes for the SAME
+eval program built f32 versus under ``MXTPU_PIPELINE=quant``. Wall-clock
+is recorded as a CAVEAT only: XLA:CPU widens int8 matmuls (dequant runs
+as a real f32 multiply on the host), so CPU wall-clock says nothing
+about TPU behavior — the byte numbers are the TPU-relevant ones.
+
+Also records the parity deltas the test gate enforces
+(tests/test_quant.py) and the calibration capture -> corpus persist ->
+offline replay bit-identity check, so the JSON is a self-contained
+record.
+
+Usage: python tools/bench_quant.py [--out BENCH_quant.json]
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import mxtpu as mx  # noqa: E402
+from mxtpu import diagnostics as diag  # noqa: E402
+from mxtpu.analysis import dataflow  # noqa: E402
+from mxtpu.compile import pipeline, quant  # noqa: E402
+from mxtpu.models import lenet, mlp  # noqa: E402
+
+
+def _fixture(model, batch=64, seed=0):
+    get = mlp.get_symbol if model == "mlp" else lenet.get_symbol
+    sym = get(10)
+    dshape = (batch, 1, 28, 28) if model == "lenet" else (batch, 784)
+    arg_shapes, _, _ = sym.infer_shape(data=dshape,
+                                       softmax_label=(batch,))
+    rng = np.random.RandomState(seed)
+    args = {}
+    for name, shape in zip(sym.list_arguments(), arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        scale = 0.1 if name.endswith("weight") else 0.0
+        args[name] = mx.nd.array(
+            rng.randn(*shape).astype(np.float32) * scale)
+    x = rng.rand(*dshape).astype(np.float32)
+    hints = dict(zip(sym.list_arguments(), arg_shapes))
+    return sym, args, x, hints
+
+
+def _eval(sym, args, x, names):
+    full = dict(args, data=mx.nd.array(x),
+                softmax_label=mx.nd.zeros((x.shape[0],)))
+    with pipeline.pipeline_scope(names):
+        ex = sym.bind(mx.cpu(), full, args_grad=None, grad_req="null")
+        t0 = time.perf_counter()
+        out = ex.forward(is_train=False)[0].asnumpy()
+        out = ex.forward(is_train=False)[0].asnumpy()
+        wall = time.perf_counter() - t0
+    rec = diag.programs("fwd_eval")[-1]
+    return ex, out, rec, wall
+
+
+def plan_basis(sym, hints):
+    """The platform-independent deterministic basis: the quant plan's
+    exact weight-byte arithmetic off the inferred shapes."""
+    plan = dataflow.quant_plan(sym, shapes=hints)
+    w_f32 = sum(4 * w["elems"] for w in plan.weights.values())
+    total_param_f32 = sum(
+        4 * int(np.prod(hints[n])) for n in hints
+        if n not in ("data", "softmax_label"))
+    saved = plan.weight_bytes_saved
+    return plan, {
+        "quant_sites": plan.n_sites,
+        "quantized_weights": sorted(plan.weights),
+        "weight_bytes_f32": w_f32,
+        "weight_bytes_int8": w_f32 - saved,
+        "weight_bytes_saved": saved,
+        "weight_bytes_delta_pct": round(100.0 * saved
+                                        / max(w_f32, 1), 2),
+        "param_bytes_f32": total_param_f32,
+        "param_bytes_quant": total_param_f32 - saved,
+        "param_bytes_delta_pct": round(
+            100.0 * saved / max(total_param_f32, 1), 2),
+        "f32_islands": plan.n_f32_islands,
+        "note": "3 bytes saved per f32->int8 weight element, from the "
+                "plan's shape walk — exact, platform-independent",
+    }
+
+
+def graph_counts(sym2):
+    names = [n.name for n in sym2._topo() if not n.is_variable]
+    return {
+        "dequant_nodes": sum(1 for n in names if n.endswith("__dq")),
+        "act_quant_nodes": sum(1 for n in names
+                               if n.endswith("__q8")),
+    }
+
+
+def calibration_replay_check():
+    """Capture on live traffic, persist to a scratch corpus, replay —
+    the scales must match bit-for-bit (order-independent fold)."""
+    sym, args, x, _ = _fixture("mlp")
+    with tempfile.TemporaryDirectory() as d:
+        os.environ["MXTPU_CORPUS_DIR"] = d
+        try:
+            from mxtpu.obs import corpus
+            corpus.reset()
+            with quant.calibration_scope() as rec:
+                _eval(sym, args, x, [])
+                live = quant.scales_from_stats(rec.stats())
+                quant.persist_calibration(rec)
+            replayed = quant.replay_scales()
+        finally:
+            del os.environ["MXTPU_CORPUS_DIR"]
+            corpus.reset()
+    return {"observed_nodes": sorted(live),
+            "replay_bit_identical": replayed == live}
+
+
+def bench_model(model):
+    sym, args, x, hints = _fixture(model)
+    plan, basis = plan_basis(sym, hints)
+    _, ref, r32, w32 = _eval(sym, args, x, [])
+    ex, out, rq, wq = _eval(sym, args, x, ["quant"])
+    assert "quant" in ex.pipeline_report.applied, \
+        ex.pipeline_report.render()
+    assert rq["precision"] == "int8_ptq", rq
+    key = (("quant",), True)
+    counts = graph_counts(ex._xform[key][0])
+    agree = float((np.argmax(out, 1) == np.argmax(ref, 1)).mean())
+    return {
+        "plan": basis,
+        "graph": counts,
+        "f32": {"argument_bytes": r32["argument_bytes"],
+                "bytes_accessed": r32["bytes_accessed"],
+                "flops": r32["flops"]},
+        "quant": {"argument_bytes": rq["argument_bytes"],
+                  "bytes_accessed": rq["bytes_accessed"],
+                  "flops": rq["flops"]},
+        "argument_bytes_delta_pct": round(
+            100.0 * (r32["argument_bytes"] - rq["argument_bytes"])
+            / max(r32["argument_bytes"], 1), 2),
+        "bytes_accessed_delta_pct": round(
+            100.0 * (r32["bytes_accessed"] - rq["bytes_accessed"])
+            / max(r32["bytes_accessed"], 1.0), 2),
+        "top1_agreement": agree,
+        "max_abs_output_delta": float(np.max(np.abs(out - ref))),
+        "wall_s_f32": round(w32, 4),
+        "wall_s_quant": round(wq, 4),
+        "weight_bytes_verdict": basis["weight_bytes_delta_pct"] >= 40.0,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "..", "BENCH_quant.json"))
+    args = ap.parse_args()
+    results = {}
+    for model in ("mlp", "lenet"):
+        results[model] = bench_model(model)
+        r = results[model]
+        print("%s: weight bytes -%.1f%% (param args -%.1f%%), "
+              "%d dequants, arg bytes -%.1f%%, top-1 agreement %.4f"
+              % (model, r["plan"]["weight_bytes_delta_pct"],
+                 r["plan"]["param_bytes_delta_pct"],
+                 r["graph"]["dequant_nodes"],
+                 r["argument_bytes_delta_pct"],
+                 r["top1_agreement"]))
+    calib = calibration_replay_check()
+    print("calibration replay bit-identical:",
+          calib["replay_bit_identical"])
+    payload = {
+        "bench": "int8 PTQ rewrite (compile pipeline, quant pass)",
+        "basis": "deterministic, two views: (1) the quant plan's exact "
+                 "weight-byte arithmetic off the inferred shapes (3 "
+                 "bytes per f32->int8 element — the stream a "
+                 "bandwidth-bound TPU decode reads every step) plus "
+                 "exact dequant/island node counts of the rewritten "
+                 "Symbol; (2) XLA memory_analysis argument bytes + "
+                 "cost_analysis bytes-accessed from the diagnostics "
+                 "cost registry for the fwd_eval program as built on "
+                 "THIS host; same weights, same inputs",
+        "host_cost_caveat": "XLA:CPU widens int8 matmuls — the dequant "
+                            "runs as a real f32 multiply on the host, "
+                            "so bytes-accessed/wall-clock deltas there "
+                            "understate (or invert) the TPU win; the "
+                            "plan's weight-byte numbers and the "
+                            "argument-bytes delta are the TPU-relevant "
+                            "basis",
+        "wall_clock_caveat": "2-core CPU host, >45% noise floor (PR-2 "
+                             "convention) — wall-clock recorded but NOT "
+                             "a verdict basis",
+        "parity_gate": "tests/test_quant.py (top-1 exact-or-gated "
+                       "2/256 on mlp/lenet for quant and bf16,quant; "
+                       "token-level on the decode fixture incl. "
+                       "mid-run hot-swap)",
+        "acceptance": all(r["weight_bytes_verdict"]
+                          for r in results.values()),
+        "calibration": calib,
+        "models": results,
+    }
+    out = os.path.abspath(args.out)
+    with open(out, "w") as f:
+        json.dump(payload, f, indent=1)
+    print("wrote", out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
