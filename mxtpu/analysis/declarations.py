@@ -121,6 +121,10 @@ LOCK_LEVELS = [
     # anything above; it only guards one file handle and never acquires
     # another tracked lock
     ("obs-corpus", {("corpus", "_WRITER_LOCK")}),
+    # the training-health panel snapshot: written at the metric-sync
+    # cadence on the training thread, read by debug_state/mxtpu_top —
+    # guards one dict swap, acquires nothing
+    ("health", {("health", "_PANEL_LOCK")}),
     # innermost leaves: never hold anything else
     ("leaf", {("profiler", "_lock")}),
 ]
@@ -212,6 +216,10 @@ HOT_PATHS = {
     "mxtpu/serving/decode/stream.py": None,
     "mxtpu/predict.py": None,
     "mxtpu/metric.py": {"DeviceKernel", "DeviceMetricAccum"},
+    # the device accumulate + cadence fold run between steps on the
+    # training thread; detectors are pure host floats (cheap), but the
+    # sync discipline (ONE pragma'd pull per cadence) is the contract
+    "mxtpu/obs/health.py": None,
     "mxtpu/io.py": {"PrefetchingIter", "DevicePrefetchIter"},
     # the snapshot CAPTURE path runs on the training thread between
     # steps: it must enqueue device-side copies, never materialize host

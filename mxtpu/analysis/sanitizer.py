@@ -26,18 +26,29 @@ from .. import telemetry as _tel
 from ..base import MXNetError, NumericsError
 from . import concurrency as _conc
 
-__all__ = ["NumericsError", "enable", "disable", "mode", "sanitize_tree"]
+__all__ = ["NumericsError", "enable", "disable", "mode", "sanitize_tree",
+           "trip_count"]
 
 _VALID = ("nan", "inf", "all")
 
 _MODE = None
 _CHECKERS = {}
 _LOCK = _conc.lock("sanitizer", "_LOCK")
+_TRIPS = 0
 
 
 def mode():
     """The active sanitize mode ('nan' / 'inf' / 'all') or None."""
     return _MODE
+
+
+def trip_count():
+    """Monotone process-wide trip counter. The health divergence
+    detector compares it across a cadence window to keep to ONE
+    postmortem per root cause: a nonfinite the sanitizer already
+    captured must not produce a second (health) postmortem for the same
+    wreckage (obs/health.py)."""
+    return _TRIPS
 
 
 def enable(which="all"):
@@ -106,10 +117,20 @@ def sanitize_tree(kind, out, precision=None):
     import jax
     import jax.numpy as jnp
     import numpy as _np
+    scan = out
+    if kind == "fused_step" and isinstance(out, tuple) and len(out) == 5:
+        # health-armed step: the 5th element is the training-health stat
+        # tree — sum-of-squares rows that may LEGITIMATELY overflow to
+        # inf while the model state is the real root cause (and the
+        # detectors classify them regardless). Check the model state
+        # only; err.outputs below still carries the full tuple so the
+        # donation recovery adopts everything.
+        scan = out[:4]
     try:
-        paths_leaves = jax.tree_util.tree_flatten_with_path(out)[0]
+        paths_leaves = jax.tree_util.tree_flatten_with_path(scan)[0]
     except Exception:
-        paths_leaves = [((), leaf) for leaf in jax.tree_util.tree_leaves(out)]
+        paths_leaves = [((), leaf)
+                        for leaf in jax.tree_util.tree_leaves(scan)]
     checked = []
     for path, leaf in paths_leaves:
         if isinstance(leaf, jax.Array) \
@@ -152,6 +173,8 @@ def sanitize_tree(kind, out, precision=None):
     reason = "sanitizer: %s in outputs of program kind '%s' " \
              "(precision=%s, %d/%d leaves): %s" \
              % (what, kind, precision, len(bad), len(checked), desc)
+    global _TRIPS
+    _TRIPS += 1
     # registry-direct: a numerics trip must count even with the helper-
     # mediated telemetry disabled
     _tel.registry().counter(

@@ -47,8 +47,8 @@ from .flight import (FlightRecorder, flight_enabled, record, recorder,
 # captures an exportable timeline (MXTPU_TRACE=0 opts out)
 from ..obs import trace as _obs_trace
 from .watchdog import (Watchdog, active_waits, add_action, ensure_watchdog,
-                       progress_age_s, remove_action, stop_watchdog,
-                       wait_begin, wait_end)
+                       fire_actions, progress_age_s, remove_action,
+                       stop_watchdog, wait_begin, wait_end)
 
 __all__ = [
     "DeviceMemoryLedger", "ledger", "alloc_origin", "current_origin",
@@ -60,7 +60,7 @@ __all__ = [
     "set_flight_enabled",
     "Watchdog", "ensure_watchdog", "stop_watchdog", "active_waits",
     "wait_begin", "wait_end", "add_action", "remove_action",
-    "progress_age_s",
+    "fire_actions", "progress_age_s",
     "debug_state", "postmortem", "last_postmortem", "dump_state",
     "install_signal_handler", "set_enabled",
 ]
@@ -137,6 +137,15 @@ def debug_state(flight_limit=256):
         state["reconcile"] = reconcile()
     except Exception:
         pass  # jax not importable / backend not initialized: skip the check
+    try:
+        # lazy: obs.health imports diagnostics — the panel accessor is
+        # reached only at snapshot time, never at import time
+        from ..obs import health as _health
+        hp = _health.panel()
+        if hp is not None:
+            state["training_health"] = hp
+    except Exception:
+        pass  # a debug read must never fail because a panel source did
     return state
 
 

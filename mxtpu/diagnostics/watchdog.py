@@ -33,7 +33,7 @@ from ..analysis import concurrency as _conc
 
 __all__ = ["Watchdog", "ensure_watchdog", "stop_watchdog", "wait_begin",
            "wait_end", "active_waits", "add_action", "remove_action",
-           "progress_age_s"]
+           "fire_actions", "progress_age_s"]
 
 # ------------------------------------------------------------- action hooks
 # Subscribers that ACT on a detection (elastic supervisor: checkpoint-
@@ -59,6 +59,23 @@ def remove_action(fn):
         _ACTIONS.remove(fn)
     except ValueError:
         pass
+
+
+def fire_actions(reason):
+    """Run every registered action for a detection raised OUTSIDE the
+    watchdog thread — the health divergence rollback
+    (``MXTPU_HEALTH_ACTION=rollback``, obs/health.py) reuses the same
+    subscriber seam the hang detector fires through, so an attached
+    elastic supervisor reacts identically to both. Same swallow
+    contract as :meth:`Watchdog._fire`: one broken action must not
+    starve the rest."""
+    for fn in list(_ACTIONS):
+        try:
+            fn(reason)
+        except Exception:
+            # mxtpu: allow-swallow(an action must never kill the caller
+            # that detected the anomaly)
+            pass
 
 # ------------------------------------------------------- device-wait registry
 _WAITS = {}  # thread id -> (t0, description); GIL-atomic dict ops
@@ -203,11 +220,7 @@ class Watchdog:
         # evidence first, action second: the registered actions (elastic
         # supervisor restore-retry) run AFTER the postmortem capture, so
         # a recovery that works still leaves the wedge forensics behind
-        for fn in list(_ACTIONS):
-            try:
-                fn(reason)
-            except Exception:
-                pass  # an action must never kill the watchdog
+        fire_actions(reason)
 
     def _loop(self):
         while not self._stop.wait(self.interval):

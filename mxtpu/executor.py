@@ -117,7 +117,8 @@ def _block_boundaries(symbol):
     return cut_ids
 
 
-def _trace_graph(symbol, is_train, placements=None, remat_tags=None):
+def _trace_graph(symbol, is_train, placements=None, remat_tags=None,
+                 tap_filter=None):
     """Return fn(arg_vals, aux_vals, rng) -> (outputs, aux_updates_dict).
 
     ``placements`` maps a ctx-group name to a jax Device or Sharding:
@@ -129,15 +130,29 @@ def _trace_graph(symbol, is_train, placements=None, remat_tags=None):
     ``remat_tags`` maps node ids to checkpoint_name tags; under a
     ``jax.checkpoint`` wrapper with a save_only_these_names policy the
     tagged activations are the ONLY residuals kept for backward — the
-    selective-rematerialization hook (see module/fused.py)."""
+    selective-rematerialization hook (see module/fused.py).
+
+    ``tap_filter`` — a regex pattern (string): intermediate outputs
+    whose name ``match``es get an abs-mean *tap* (a scalar f32 reduced
+    on device) collected alongside the outputs, and ``run`` returns a
+    3-tuple ``(outputs, aux_updates, taps)``. This is the Monitor
+    adapter's device-side stat: the tensors themselves never leave the
+    device, only the scalars ride the cadence sync (obs/health.py).
+    Without a filter the return stays the historical 2-tuple."""
     topo = symbol._topo()
     node_index = {id(n): i for i, n in enumerate(topo)}
     aux_nodes = symbol._aux_node_set()
     out_entries = [(id(n), i) for n, i in symbol._outputs]
+    tap_prog = None
+    if tap_filter is not None:
+        import re
+        from .symbol.symbol import _output_names
+        tap_prog = re.compile(tap_filter)
 
     def run(arg_vals, aux_vals, rng):
         env = {}
         aux_updates = {}
+        taps = {}
         for node in topo:
             if node.is_variable:
                 if id(node) in aux_nodes:
@@ -171,6 +186,13 @@ def _trace_graph(symbol, is_train, placements=None, remat_tags=None):
                              for i, o in enumerate(outs))
             for i in range(n_vis):
                 env[(id(node), i)] = outs[i]
+            if tap_prog is not None and not node.is_variable:
+                for i, oname in enumerate(_output_names(node, n_vis)):
+                    o = outs[i]
+                    if tap_prog.match(oname) and \
+                            jnp.issubdtype(o.dtype, jnp.inexact):
+                        taps[oname] = jnp.mean(
+                            jnp.abs(o.astype(jnp.float32)))
             # aux updates propagate back to the feeding aux variable
             if node.op.aux_names and len(outs) > n_vis:
                 names = node.op.input_names(attrs, n=len(node.inputs))
@@ -179,7 +201,10 @@ def _trace_graph(symbol, is_train, placements=None, remat_tags=None):
                     src = node.inputs[idx][0]
                     if src.is_variable:
                         aux_updates[src.name] = outs[n_vis + j]
-        return [env[e] for e in out_entries], aux_updates
+        outs_list = [env[e] for e in out_entries]
+        if tap_prog is not None:
+            return outs_list, aux_updates, taps
+        return outs_list, aux_updates
 
     return run
 

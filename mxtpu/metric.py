@@ -528,7 +528,21 @@ class DeviceMetricAccum:
         self._sums = None
         self._counts = None
         self._pending = False
+        self._riders = []
         self._zero()
+
+    def add_rider(self, rider):
+        """Register a cadence rider: an object whose ``pull()`` returns a
+        device tree (or None) and whose ``deliver(host_tree)`` receives
+        its host values. Riders share ``sync()``'s SINGLE ``device_get``
+        — the seam that lets training-health stats (obs/health.py) reach
+        the host with zero additional sync points."""
+        if rider not in self._riders:
+            self._riders.append(rider)
+
+    def remove_rider(self, rider):
+        if rider in self._riders:
+            self._riders.remove(rider)
 
     @classmethod
     def wrap(cls, metric):
@@ -608,17 +622,28 @@ class DeviceMetricAccum:
         self._pending = True
 
     def sync(self):
-        """The ONLY host round-trip: pull the per-metric scalar sums, fold
-        them into the wrapped host metrics, zero the device state, and
-        refresh ``last_snapshot``. Returns the snapshot pairs."""
-        if self._pending:
+        """The ONLY host round-trip: pull the per-metric scalar sums —
+        and every registered rider's pending device tree, in the SAME
+        transfer — fold them into the wrapped host metrics, zero the
+        device state, and refresh ``last_snapshot``. Returns the
+        snapshot pairs."""
+        cargo = [(r, r.pull()) for r in self._riders]
+        cargo = [(r, t) for r, t in cargo if t is not None]
+        if self._pending or cargo:
             import jax
             # mxtpu: allow-sync(sync() IS the cadence sync point — the
-            # one intended host round-trip of the device metric path)
-            vals = jax.device_get(self._sums)
-            for child, v, n in zip(self.children, vals, self._counts):
-                child.sum_metric += float(v)
-                child.num_inst += n
-            self._zero()
+            # one intended host round-trip of the device metric path;
+            # rider trees (training health) ride the same transfer)
+            vals, freight = jax.device_get(
+                (self._sums if self._pending else [],
+                 [t for _, t in cargo]))
+            if self._pending:
+                for child, v, n in zip(self.children, vals,
+                                       self._counts):
+                    child.sum_metric += float(v)
+                    child.num_inst += n
+                self._zero()
+            for (r, _), host in zip(cargo, freight):
+                r.deliver(host)
         self.last_snapshot = self.metric.get_name_value()
         return self.last_snapshot

@@ -137,7 +137,7 @@ class BaseModule:
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, max_in_flight=None, metric_sync=None,
             device_metrics=None, device_prefetch=None, mesh=None,
-            elastic=None, resume=None, tuned=None):
+            elastic=None, resume=None, tuned=None, health=None):
         """Training loop (parity base_module.py:376-525), pipelined.
 
         ``mesh`` — SPMD mesh execution (docs/sharding.md): train
@@ -194,6 +194,17 @@ class BaseModule:
           ``None`` defers to the process-active artifact
           (:func:`mxtpu.tune.use` / ``MXTPU_TUNED``), ``False`` ignores
           it. A stale artifact (knob-registry mismatch) is rejected.
+
+        Training health (docs/observability.md):
+
+        * ``health`` — arm device-resident per-layer training-health
+          statistics + the anomaly detector suite
+          (:mod:`mxtpu.obs.health`). Stats ride the ``metric_sync``
+          cadence — zero additional host sync points. ``None`` defers
+          to the ``MXTPU_HEALTH`` env var; ``MXTPU_HEALTH_ACTION=
+          rollback`` additionally arms divergence auto-rollback via the
+          elastic supervisor (docs/elastic.md). Needs the fused train
+          step; disarmed (with a log line) otherwise.
         """
         from ..initializer import Uniform
         from .. import tune as _tune
@@ -275,7 +286,7 @@ class BaseModule:
                     arg_params, aux_params, allow_missing, force_rebind,
                     force_init, begin_epoch, num_epoch, validation_metric,
                     monitor, max_in_flight, metric_sync, device_metrics,
-                    el_cfg, resume_state, tuned_metric_sync)
+                    el_cfg, resume_state, tuned_metric_sync, health)
         except Exception as exc:
             # fatal training exception: capture the flight ring / ledger /
             # engine state BEFORE the stack unwinds and the evidence GCs.
@@ -298,18 +309,27 @@ class BaseModule:
                   aux_params, allow_missing, force_rebind, force_init,
                   begin_epoch, num_epoch, validation_metric, monitor,
                   max_in_flight, metric_sync, device_metrics,
-                  el_cfg=None, resume_state=None, tuned_metric_sync=None):
+                  el_cfg=None, resume_state=None, tuned_metric_sync=None,
+                  health=None):
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)
         if monitor is not None:
             self.install_monitor(monitor)
-            device_metrics = False  # monitor.toc reads per-batch host stats
         self.init_params(initializer=initializer, arg_params=arg_params,
                          aux_params=aux_params, allow_missing=allow_missing,
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+        # only now is the monitor's path settled: install_monitor may
+        # have gone adapter mode (device taps over the fused step), and
+        # init_optimizer may have walked that back when the fused step
+        # declined — only the legacy per-op path reads per-batch host
+        # stats that the device metric accumulator would miss
+        monitor_adapter = monitor is not None and \
+            getattr(self, "_monitor_adapter", None) is monitor
+        if monitor is not None and not monitor_adapter:
+            device_metrics = False  # monitor.toc reads per-batch host stats
         if validation_metric is None:
             validation_metric = eval_metric
         if not isinstance(eval_metric, _metric.EvalMetric):
@@ -336,6 +356,28 @@ class BaseModule:
         # Speedometer (and anything else reading the metric between
         # cadence syncs) consumes this snapshot instead of forcing a sync
         eval_metric._device_accum = accum
+
+        # training health (docs/observability.md): the device-resident
+        # stat kernels + detector suite, riding the metric-sync cadence.
+        # The Monitor adapter reuses the same session detectors-off —
+        # its taps need the identical cadence transport.
+        from ..obs import health as _health
+        if health is None:
+            health = _health.armed_by_env()
+        health_session = None
+        fused = getattr(self, "_fused", None)
+        if fused is not None and (health or monitor_adapter):
+            health_session = _health.HealthSession(
+                fused, monitor=monitor if monitor_adapter else None,
+                detect=bool(health), logger=self.logger)
+            if accum is not None:
+                accum.add_rider(health_session)
+        elif health:
+            self.logger.info(
+                "fit(health): the fused train step is not armed — "
+                "training-health stats are computed inside it; disarmed "
+                "for this fit")
+            health = False
         callbacks = _as_list(batch_end_callback)
         if metric_sync is None:
             from .. import callback as _cb
@@ -449,6 +491,10 @@ class BaseModule:
                         self.forward_backward(data_batch)
                         self.update()
                     dispatch_ms.observe(sp.duration_ms)
+                    if health_session is not None:
+                        # fold the step's device stat rows (async, no
+                        # transfer) before anything can overwrite them
+                        health_session.on_step()
                     if el_session is not None:
                         # BEFORE the lookahead fetch below: the only
                         # point where the iterator cursor still reads
@@ -493,17 +539,29 @@ class BaseModule:
                             "fit_step", sp.duration_ms + pacing,
                             rows=data_batch.data[0].shape[0]
                             if data_batch.data else None)
-                    if monitor is not None:
-                        monitor.toc_print()
-                    if accum is not None and (
-                            end_of_batch or metric_sync == 1 or
-                            (metric_sync and nbatch and
-                             nbatch % metric_sync == 0)):
+                    cadence_now = (end_of_batch or metric_sync == 1 or
+                                   (metric_sync and nbatch and
+                                    nbatch % metric_sync == 0))
+                    if health_session is not None and monitor is not None \
+                            and monitor.activated:
+                        # a sampled (monitored) batch forces a cadence so
+                        # its device taps land before toc_print below
+                        cadence_now = True
+                    if accum is not None and cadence_now:
                         if end_of_batch:
                             inflight.clear()  # metric sync covers every step
                         t0 = time.perf_counter()
                         accum.sync()
                         msync_ms.observe((time.perf_counter() - t0) * 1e3)
+                    elif health_session is not None and cadence_now:
+                        health_session.sync_direct()
+                    if health_session is not None and cadence_now:
+                        # detectors run on the freshly landed window —
+                        # BEFORE el_session.on_step below, so a rollback
+                        # wedge aborts before the corrupted snapshot
+                        health_session.on_cadence(eval_metric)
+                    if monitor is not None:
+                        monitor.toc_print()
                     if el_session is not None:
                         # after the step's metrics accumulated, before
                         # the callbacks: the cadence snapshot point, and
@@ -572,6 +630,10 @@ class BaseModule:
             # post-fit reads (and the next fit) must see live values,
             # not this run's last cadence snapshot
             eval_metric._device_accum = None
+            if health_session is not None:
+                if accum is not None:
+                    accum.remove_rider(health_session)
+                health_session.close()
             _online.release(inflight_limit)
 
 

@@ -401,6 +401,7 @@ class FusedTrainStep:
             if ann:
                 tags = ann
                 self._remat = "annotated"
+        self._remat_tags = tags   # kept: arm_health re-traces with taps
         self._run = _trace_graph(self._graph_symbol, is_train=True,
                                  remat_tags=tags)
         # optimizer-update fusion (the fuse_opt transform): trainable
@@ -418,6 +419,12 @@ class FusedTrainStep:
             # grid — no tensor data moves)
             self._mesh = Mesh(_np.array(self.devices), ("data",))
         self._step_fn = None
+        # training-health stats (obs/health.py): armed by arm_health();
+        # when armed the step program additionally returns per-class
+        # stat rows, stashed on last_health for the cadence accumulator
+        self._health_classes = None
+        self._health_taps = None
+        self.last_health = None
         self.state = state if state is not None else FusedState()
         self.outputs = None     # last step's outputs (device arrays)
         self.last_labels = None  # last step's labels, already device-put —
@@ -555,6 +562,44 @@ class FusedTrainStep:
             out.append(tuple(names))
         return out
 
+    # ------------------------------------------------ training health
+    def arm_health(self, taps=None):
+        """Arm device-resident training-health stats (obs/health.py):
+        the step program additionally computes per-parameter-class rows
+        [grad_sq, weight_sq, update_sq, nonfinite] + grad max-abs, all
+        reduced ON DEVICE inside the fused step — nothing extra crosses
+        the host boundary until the metric-sync cadence pulls them.
+
+        Classes reuse the fuse_opt batched-update grouping (stat row
+        count stays bounded); ungrouped trainables get a row each.
+        ``taps`` — a Monitor regex pattern: matching intermediate
+        outputs also get device abs-mean taps (the Monitor adapter).
+        Returns the ``(label, member names)`` class list. Idempotent
+        for an unchanged spec; a change invalidates the compiled step
+        so the next ``step()`` rebuilds through the build seam."""
+        from ..obs.health import class_label
+        classes = []
+        seen = set()
+        for names in self._validated_update_groups():
+            classes.append((class_label(names), tuple(names)))
+            seen.update(names)
+        for n in self.trainable:
+            if n not in seen:
+                classes.append((n, (n,)))
+        classes = tuple(classes)
+        if classes == self._health_classes \
+                and taps == self._health_taps:
+            return classes
+        if taps != self._health_taps:
+            self._health_taps = taps
+            self._run = _trace_graph(self._graph_symbol, is_train=True,
+                                     remat_tags=self._remat_tags,
+                                     tap_filter=taps)
+        self._health_classes = classes
+        self.last_health = None
+        self._step_fn = None
+        return classes
+
     # ------------------------------------------------ the program
     def _build(self):
         run = self._run
@@ -570,6 +615,8 @@ class FusedTrainStep:
                 len(grouped_names), len(trainable))
 
         remat = self._remat
+        health_classes = self._health_classes
+        tap_armed = self._health_taps is not None
         # weight-update sharding: constrain each gradient entering the
         # optimizer to the opt-state sharding BEFORE the update — GSPMD
         # then reduce-scatters the vjp gradient instead of all-reducing
@@ -591,6 +638,11 @@ class FusedTrainStep:
                 env = dict(fixed)
                 env.update(train_p)
                 env.update(batch)
+                if tap_armed:
+                    # taps are vjp aux: forward-only device scalars the
+                    # Monitor adapter reads — never differentiated
+                    outs, auxu, taps = run(env, aux, rng)
+                    return (outs, auxu), taps
                 outs, auxu = run(env, aux, rng)
                 return outs, auxu
 
@@ -619,7 +671,12 @@ class FusedTrainStep:
                     policy=jax.checkpoint_policies
                     .save_anything_except_these_names("mxtpu_remat"))
             train_p = {n: params[n] for n in trainable}
-            (outs, auxu), vjp = jax.vjp(f, train_p)
+            taps = None
+            if tap_armed:
+                (outs, auxu), vjp, taps = jax.vjp(f, train_p,
+                                                  has_aux=True)
+            else:
+                (outs, auxu), vjp = jax.vjp(f, train_p)
             cts = ([jnp.ones_like(o) for o in outs],
                    {k: jnp.zeros_like(v) for k, v in auxu.items()})
             (grads,) = vjp(cts)
@@ -657,7 +714,42 @@ class FusedTrainStep:
                 new_opt[n] = s2
             new_aux = dict(aux)
             new_aux.update(auxu)
-            return new_params, new_aux, new_opt, outs
+            if not health_classes:
+                return new_params, new_aux, new_opt, outs
+            # training-health rows (obs/health.py): per class, f32
+            # sums [grad_sq, weight_sq, update_sq, nonfinite] + grad
+            # max-abs — tiny reductions XLA fuses into the update
+            # kernels it already runs over these same buffers. The
+            # nonfinite count covers grads AND the fresh weights, so
+            # an LR bomb is visible at the cadence of the step that
+            # fired it, before the next step consumes the wreckage.
+            f32 = jnp.float32
+            sum_rows, max_rows = [], []
+            for _label, names in health_classes:
+                g2 = w2 = u2 = nf = None
+                gm = None
+                for n in names:
+                    g = grads[n].astype(f32)
+                    p_new = new_params[n].astype(f32)
+                    d = p_new - params[n].astype(f32)
+                    bad = (jnp.sum(~jnp.isfinite(g))
+                           + jnp.sum(~jnp.isfinite(p_new))).astype(f32)
+                    parts = (jnp.sum(g * g), jnp.sum(p_new * p_new),
+                             jnp.sum(d * d), bad)
+                    if g2 is None:
+                        g2, w2, u2, nf = parts
+                        gm = jnp.max(jnp.abs(g))
+                    else:
+                        g2, w2, u2, nf = (g2 + parts[0], w2 + parts[1],
+                                          u2 + parts[2], nf + parts[3])
+                        gm = jnp.maximum(gm, jnp.max(jnp.abs(g)))
+                sum_rows.append(jnp.stack([g2, w2, u2, nf]))
+                max_rows.append(gm)
+            hstats = {"sums": jnp.stack(sum_rows),
+                      "max": jnp.stack(max_rows)}
+            if taps is not None:
+                hstats["taps"] = taps
+            return new_params, new_aux, new_opt, outs, hstats
 
         if self._mesh is not None and self._plan is not None:
             plan = self._plan
@@ -676,10 +768,13 @@ class FusedTrainStep:
             # specs — with the update computed sharded, THIS is what
             # makes GSPMD insert the weight all-gather — and keep the
             # optimizer state sharded across steps; outputs propagate
+            out_sh = (p_sh, a_sh, o_sh, None)
+            if health_classes:
+                out_sh += (None,)   # health rows: propagated (replicated)
             self._step_fn = jax.jit(
                 step, in_shardings=(p_sh, a_sh, o_sh, b_sh, repl, repl,
                                     repl),
-                out_shardings=(p_sh, a_sh, o_sh, None),
+                out_shardings=out_sh,
                 donate_argnums=(0, 1, 2))
         elif self._mesh is not None:
             repl = NamedSharding(self._mesh, P())
@@ -744,9 +839,12 @@ class FusedTrainStep:
                 precision=rep.precision if rep is not None else None,
                 transforms=rep.transforms if rep is not None else None)
         try:
-            self.params, self.aux, self.opt_state, outs = self._step_fn(
+            res = self._step_fn(
                 self.params, self.aux, self.opt_state, batch,
                 self._put(lrs), self._put(wds), _rnd.next_key())
+            self.params, self.aux, self.opt_state, outs = res[:4]
+            if len(res) == 5:   # health armed: per-class stat rows
+                self.last_health = res[4]
         except NumericsError as exc:
             # the step already ran and DONATED the old state trees; the
             # sanitizer raised before the unpack above could adopt the
@@ -755,8 +853,11 @@ class FusedTrainStep:
             # buffers — a caller that catches and checkpoints must not
             # hit "Array has been deleted".
             res = getattr(exc, "outputs", None)
-            if isinstance(res, tuple) and len(res) == 4:
-                self.params, self.aux, self.opt_state, self.outputs = res
+            if isinstance(res, tuple) and len(res) in (4, 5):
+                self.params, self.aux, self.opt_state, self.outputs = \
+                    res[:4]
+                if len(res) == 5:
+                    self.last_health = res[4]
             raise
         self.outputs = outs
         return outs

@@ -54,10 +54,13 @@ import time
 from ..analysis import concurrency as _conc
 
 __all__ = ["SCHEMA_VERSION", "enabled", "corpus_path", "record_build",
-           "record_service", "record_calibration", "load", "summarize",
-           "reset"]
+           "record_service", "record_calibration", "record_health",
+           "load", "summarize", "reset"]
 
-SCHEMA_VERSION = 1
+# v2: adds the "health" row kind (training-health stats per cadence,
+# obs/health.py). Readers stay version-tolerant: load() keys on the
+# row kind, never the version, and the torn-tail contract is unchanged
+SCHEMA_VERSION = 2
 _ENV = "MXTPU_CORPUS_DIR"
 
 _WRITER_LOCK = _conc.lock("corpus", "_WRITER_LOCK")
@@ -209,6 +212,25 @@ def record_calibration(stats, percentile=None):
     return _append(row)
 
 
+def record_health(cadence, stats, loss=None, anomalies=None):
+    """Append one training-health row: the per-class stat dicts as of
+    one metric-sync cadence (``stats`` is HealthSession's
+    ``{class: {grad_norm, weight_norm, update_ratio, grad_max,
+    nonfinite}}``), the window loss, and any detector firings. These
+    rows are the training-dynamics half of the learned cost/outcome
+    model's corpus (ROADMAP item 4). No-op unless enabled."""
+    if not enabled():
+        return False
+    row = {"v": SCHEMA_VERSION, "row": "health",
+           "t": round(time.time(), 6), "cadence": int(cadence),
+           "stats": {str(k): dict(v) for k, v in (stats or {}).items()}}
+    if loss is not None:
+        row["loss"] = float(loss)
+    if anomalies:
+        row["anomalies"] = [str(a) for a in anomalies]
+    return _append(row)
+
+
 # -------------------------------------------------------------- read side
 def load(dirpath=None, strict=False):
     """Every schema-valid row across the dir's ``*.jsonl`` files,
@@ -237,7 +259,7 @@ def load(dirpath=None, strict=False):
                 raise ValueError(
                     "corpus %s: corrupt row at line %d" % (name, i + 1))
             if isinstance(row, dict) and row.get("row") in (
-                    "build", "service", "calib"):
+                    "build", "service", "calib", "health"):
                 rows.append(row)
             elif strict:
                 raise ValueError(

@@ -293,6 +293,20 @@ declare("fit.remat", "str", "none", env="MXTPU_REMAT",
              "remat and suppresses them, like block/conv/all pin "
              "their explicit policy")
 
+# --- training health (device-resident stats + detectors,
+#     docs/observability.md "Training health")
+declare("health.cadence", "int", 1, env="MXTPU_HEALTH_CADENCE",
+        candidates=(1, 2, 4), safe_range=(1, 16),
+        help="detector stride in metric-sync cadences: the stat rows "
+             "land every sync, the detector suite runs every Nth")
+declare("health.window", "int", 8, env="MXTPU_HEALTH_WINDOW",
+        candidates=(4, 8, 16), safe_range=(2, 64),
+        help="rolling-window length (in detector cadences) of the loss "
+             "spike / divergence baselines")
+declare("health.spike_k", "float", 8.0, env="MXTPU_HEALTH_SPIKE_K",
+        safe_range=(2.0, 32.0),
+        help="loss-spike threshold in MADs above the rolling median")
+
 # --- serving (ServingSession / batcher / admission, docs/serving.md)
 declare("serving.max_in_flight", "int", 2, env="MXTPU_SERVING_INFLIGHT",
         candidates=(1, 2, 3, 4, 6), safe_range=(1, 8),

@@ -113,7 +113,7 @@ def test_registry_version_is_stable_and_knob_sensitive():
     # every catalogued knob belongs to a known subsystem
     subs = {k.subsystem for k in tune.knobs()}
     assert subs == {"fit", "serving", "decode", "elastic", "compile",
-                    "quant"}
+                    "quant", "health"}
 
 
 def test_bool_coercion_matches_env_contract():

@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Benchmark: training-health stat cost on the Module.fit loop.
+
+Three claims from docs/observability.md ("Training health"), each on a
+deterministic basis (the BENCH_faults/BENCH_obs convention — no bare
+off/on wall-clock subtraction, which sits inside scheduler noise on a
+shared host):
+
+  1. **zero added sync points** — wrap ``jax.device_get`` with a
+     counting shim and run the SAME warmed mlp fit disarmed and with
+     ``health=True``: the call-count delta must be exactly 0 (the stat
+     accumulator rides the DeviceMetricAccum cadence sync, it never
+     owns a transfer of its own);
+  2. **disarmed guard < 0.5% of a step** — the entire disarmed cost is
+     a handful of ``is None`` attribute checks per step (fused driver
+     5-tuple probe + fit-loop session guards); microbench ns/check ×
+     the exact checks/step against the measured step time;
+  3. **armed cadence cost** — microbench the real host-side
+     ``HealthSession._derive`` + gauge emission over a delivered
+     window, reported as ns-per-stat × stats-per-cadence (C classes ×
+     5 stats), amortized over the ``metric_sync`` stride.
+
+Writes BENCH_health.json. Acceptance: sync delta == 0 AND disarmed
+guard < 0.5%.
+
+Usage: python tools/bench_health.py [--out BENCH_health.json]
+"""
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+import mxtpu as mx  # noqa: E402
+from mxtpu import telemetry as tel  # noqa: E402
+from mxtpu.models import mlp as _mlp  # noqa: E402
+from mxtpu.obs import health as _health  # noqa: E402
+
+
+def _make_data(n, batch_size, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 784).astype(np.float32)
+    y = rng.randint(0, 10, n).astype(np.float32)
+    return mx.io.NDArrayIter(X, y, batch_size=batch_size,
+                             label_name="softmax_label")
+
+
+def _fit(mod, it, epochs, metric_sync, health):
+    metric = mx.metric.create(["acc", "ce"])
+    mod.fit(it, num_epoch=epochs, eval_metric=metric, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05},
+            metric_sync=metric_sync, health=health)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--examples", type=int, default=2048)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--metric-sync", type=int, default=2)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "..", "BENCH_health.json"))
+    args = ap.parse_args(argv)
+
+    logging.getLogger().setLevel(logging.ERROR)
+    batches = args.examples // args.batch_size
+    it = _make_data(args.examples, args.batch_size)
+
+    # two identical modules: arming health retraces the fused program,
+    # so the armed run needs its own compiled module
+    mod_off = mx.mod.Module(_mlp.get_symbol(10), context=mx.cpu())
+    mod_on = mx.mod.Module(_mlp.get_symbol(10), context=mx.cpu())
+    _fit(mod_off, it, 1, args.metric_sync, False)    # warm compiles
+    _fit(mod_on, it, 1, args.metric_sync, True)
+
+    # ---- 1. sync-point proof: count jax.device_get calls, off vs on.
+    # Every mxtpu host pull goes through the public `jax.device_get`
+    # attribute, so a counting shim sees the exact transfer count.
+    real_get = jax.device_get
+    counts = {"n": 0}
+
+    def counting_get(*a, **kw):
+        counts["n"] += 1
+        return real_get(*a, **kw)
+
+    def counted_fit(mod, health):
+        counts["n"] = 0
+        step_h = tel.registry().histogram("fit_step_ms")
+        c0, t0 = step_h.count, time.perf_counter()
+        jax.device_get = counting_get
+        try:
+            _fit(mod, it, args.epochs, args.metric_sync, health)
+        finally:
+            jax.device_get = real_get
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        steps = step_h.count - c0
+        return counts["n"], steps, wall_ms / max(1, steps)
+
+    gets_off, steps_off, step_ms_off = counted_fit(mod_off, False)
+    gets_on, steps_on, step_ms_on = counted_fit(mod_on, True)
+    sync_delta = gets_on - gets_off
+
+    # ---- 2. disarmed guard: ns per `is None` check x checks/step.
+    # Disarmed, the health plumbing per step is: the fused driver's
+    # result-arity probe, the fit loop's on_step session guard, and the
+    # two cadence-block session guards -> 4 attribute checks.
+    class _Probe:
+        last_health = None
+    probe = _Probe()
+    n_micro = 1000000
+    t0 = time.perf_counter()
+    hit = 0
+    for _ in range(n_micro):
+        if probe.last_health is not None:
+            hit += 1
+    check_ns = (time.perf_counter() - t0) * 1e9 / n_micro
+    checks_per_step = 4
+    guard_pct = (check_ns * checks_per_step) / (step_ms_off * 1e6) * 100
+
+    # ---- 3. armed cadence cost: the real derive + gauge emission over
+    # a delivered window, on the ns-per-stat x stats-per-cadence basis
+    fused = mod_on._fused
+    sess = _health.HealthSession(fused, detect=False)
+    try:
+        C = len(sess.labels)
+        host = {"sums": np.abs(np.random.RandomState(7)
+                               .randn(C, 4)).astype(np.float32),
+                "max": np.random.RandomState(8)
+                .rand(C).astype(np.float32)}
+        n_cad = 2000
+        t0 = time.perf_counter()
+        for _ in range(n_cad):
+            stats = sess._derive(host, args.metric_sync)
+        derive_ns = (time.perf_counter() - t0) * 1e9 / n_cad
+        t0 = time.perf_counter()
+        for _ in range(n_cad):
+            sess._emit_gauges(stats)
+        gauge_ns = (time.perf_counter() - t0) * 1e9 / n_cad
+    finally:
+        sess.close()
+    stats_per_cadence = C * len(_health.STATS)
+    ns_per_stat = (derive_ns + gauge_ns) / stats_per_cadence
+    cadence_us = (derive_ns + gauge_ns) / 1e3
+    # amortized over the metric_sync stride against the armed step time
+    armed_host_pct = cadence_us / args.metric_sync / (step_ms_on * 1e3) \
+        * 100
+
+    ok = sync_delta == 0 and guard_pct < 0.5
+    result = {
+        "bench": "training-health stat cost (mxtpu.obs.health)",
+        "model": "mlp",
+        "batch_size": args.batch_size,
+        "batches_per_epoch": batches,
+        "metric_sync": args.metric_sync,
+        "sync_points": {
+            "device_get_calls_disarmed": gets_off,
+            "device_get_calls_armed": gets_on,
+            "steps_disarmed": steps_off,
+            "steps_armed": steps_on,
+            "added_sync_points": sync_delta,
+        },
+        "disarmed_guard": {
+            "none_check_ns": round(check_ns, 2),
+            "checks_per_step": checks_per_step,
+            "guard_pct_of_step": round(guard_pct, 6),
+            "target_pct": 0.5,
+        },
+        "armed_cadence": {
+            "classes": C,
+            "stats_per_cadence": stats_per_cadence,
+            "derive_ns": round(derive_ns, 1),
+            "gauge_emit_ns": round(gauge_ns, 1),
+            "ns_per_stat": round(ns_per_stat, 1),
+            "cadence_host_us": round(cadence_us, 3),
+            "amortized_pct_of_step": round(armed_host_pct, 5),
+        },
+        "step_ms_disarmed": round(step_ms_off, 4),
+        "step_ms_armed": round(step_ms_on, 4),
+        "wall_clock_caveat": "step_ms_armed vs step_ms_disarmed is a "
+                             "shared-host wall-clock pair recorded for "
+                             "the log only; the verdict never reads it.",
+        "pass": ok,
+        "basis": "sync proof: exact jax.device_get call counts over "
+                 "identical warmed fits (disarmed %d vs armed %d over "
+                 "%d steps) — the rider fold into the metric accum's "
+                 "one cadence transfer means the delta must be 0, not "
+                 "merely small. Disarmed guard: deterministic "
+                 "microbench ns per `is None` attribute check (%d "
+                 "iterations) x the exact %d guard checks one disarmed "
+                 "step executes, vs the same run's measured step time. "
+                 "Armed cadence: ns-per-stat from the REAL "
+                 "HealthSession._derive + gauge emission over a "
+                 "delivered (C=%d, 4) window x %d stats per cadence, "
+                 "amortized over the metric_sync=%d stride (same "
+                 "convention as BENCH_obs / BENCH_faults)."
+                 % (gets_off, gets_on, steps_on, n_micro,
+                    checks_per_step, C, stats_per_cadence,
+                    args.metric_sync),
+    }
+    out = os.path.abspath(args.out)
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result, indent=2))
+    print("wrote", out)
+    return 0 if result["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -196,6 +196,38 @@ def render(metrics, state, width=100):
                tr.get("sampled", 0), tr.get("rate", 0.0)))
         lines.append(bar)
 
+    # ---- training-health panel (device-resident stats, obs/health.py)
+    th = state.get("training_health") or {}
+    if th:
+        anom = th.get("anomalies") or {}
+        loss = th.get("window_loss")
+        lines.append(
+            "train health: %s action=%s | cadences %s (%s steps/"
+            "cadence) | loss %s | anomalies %s"
+            % ("armed" if th.get("armed") else "last run",
+               th.get("action", "?"), th.get("cadences", "?"),
+               th.get("steps_per_cadence", "?"),
+               "%.5g" % loss if loss is not None else "-",
+               ",".join("%s=%d" % kv for kv in sorted(anom.items()))
+               or "none"))
+        lines.append("%-24s %10s %10s %10s %10s %6s"
+                     % ("layer class", "|grad|", "|w|", "|dw|/|w|",
+                        "grad max", "nonfin"))
+        rows = th.get("classes") or []
+        for c in rows[:12]:
+            lines.append("%-24s %10.4g %10.4g %10.4g %10.4g %6d"
+                         % (str(c.get("class", "?"))[:24],
+                            c.get("grad_norm", 0.0),
+                            c.get("weight_norm", 0.0),
+                            c.get("update_ratio", 0.0),
+                            c.get("grad_max", 0.0),
+                            c.get("nonfinite", 0)))
+        if len(rows) > 12:
+            lines.append("  ... %d more classes" % (len(rows) - 12))
+        for msg in th.get("recent") or []:
+            lines.append("  ! %s" % msg)
+        lines.append(bar)
+
     # ---- memory table
     lines.append("%-12s %-16s %12s" % ("ctx", "origin", "live"))
     mem_rows = sorted(proc.get("mem_live_bytes", []),
