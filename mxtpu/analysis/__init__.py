@@ -23,7 +23,15 @@ under a static-analysis contract. Six parts:
   each must be licensed by a dataflow fact and is re-proven by the
   verifier suite before it may compile (a failing rewrite is rejected
   with the offending Finding). The catalog — ``layout``, ``bf16``,
-  ``fuse_opt``, ``remat_reuse`` — composes in that canonical order.
+  ``quant``, ``fuse_opt``, ``remat_reuse`` — composes in that
+  canonical order.
+* **translation validation** (:mod:`~mxtpu.analysis.equiv` +
+  :mod:`~mxtpu.analysis.graphgen`): every accepted rewrite is
+  certified ``transformed ≡ original`` modulo the pass's declared
+  rewrite algebra (``MXTPU_PIPELINE_CERT``, default armed; a refusal
+  rejects the pass exactly like the error budget), and a seeded
+  random-graph fuzzer differential-tests the catalog over generated
+  DAGs (``tools/fuzz_transforms.py`` for deep sweeps).
 * **numerics sanitizer** (:mod:`~mxtpu.analysis.sanitizer`):
   ``MXTPU_SANITIZE=nan|inf|all`` wraps every built program's outputs in
   device-side NaN/Inf checks (bf16 leaves upcast before the check); a
@@ -68,12 +76,14 @@ __all__ = [
     "remat_reuse_plan", "update_fusion_plan",
     "rewrite", "TransformPass", "register_transform", "get_transform",
     "list_transforms", "declarations", "concurrency",
+    "equiv", "Certificate", "certify", "entry_key",
+    "graphgen", "random_graph", "fuzz_round",
 ]
 
 #: lazily-imported submodules (PEP 562): resolving any of them (or a
 #: symbol below) imports the heavy graph/symbol web on first use only
 _LAZY_MODULES = ("passes", "sanitizer", "provenance", "dataflow",
-                 "rewrite")
+                 "rewrite", "equiv", "graphgen")
 
 #: public name -> (submodule, attribute)
 _LAZY_ATTRS = {
@@ -99,6 +109,11 @@ _LAZY_ATTRS = {
     "register_transform": ("rewrite", "register_transform"),
     "get_transform": ("rewrite", "get_transform"),
     "list_transforms": ("rewrite", "list_transforms"),
+    "Certificate": ("equiv", "Certificate"),
+    "certify": ("equiv", "certify"),
+    "entry_key": ("equiv", "entry_key"),
+    "random_graph": ("graphgen", "random_graph"),
+    "fuzz_round": ("graphgen", "fuzz_round"),
 }
 
 

@@ -57,8 +57,11 @@ def main(argv=None):
         transforms = list_transforms()
         print("compile-pipeline transforms (--pipeline): %d registered"
               % len(transforms))
+        from . import get_transform
         for name, doc in transforms:
-            print("  %-16s %s" % (name, doc))
+            algebra = getattr(get_transform(name), "algebra", None)
+            print("  %-16s [%s] %s"
+                  % (name, algebra or "no algebra", doc))
         print("sanitizer: MXTPU_SANITIZE=%s"
               % (sanitizer_mode() or "(unset; nan|inf|all)"))
         print("usage: python -m mxtpu.analysis model.json "

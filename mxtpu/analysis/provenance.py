@@ -35,13 +35,32 @@ def infer_walk(symbol, shape_hints=None, type_hints=None):
     real ``infer_shape``/bind runs (same ``__shape__`` hint decoding,
     same top-down ``infer_args`` parameter backfill), so whatever the
     real bind would have inferred, this walk infers too.
+
+    The walk is memoized ON the symbol (keyed by the hint dicts): every
+    per-node step pays a ``jax.eval_shape`` trace, and the build seam
+    runs the same walk many times over the same graph — each dataflow
+    analysis, the verifier suite, hint enrichment, and the
+    certification gate's license re-proofs all share this substrate.
+    Symbols are immutable after construction (transforms build NEW
+    graphs), so graph + hints fully determine the result; callers get
+    fresh top-level dicts, safe to mutate.
     """
     from ..symbol.symbol import _infer_graph
-    events = []
     type_hints = {k: _np.dtype(v) for k, v in (type_hints or {}).items()}
-    shapes, dtypes = _infer_graph(symbol, dict(shape_hints or {}),
-                                  type_hints, events=events)
-    return shapes, dtypes, events
+    key = (tuple(sorted((k, tuple(v) if v is not None else None)
+                        for k, v in (shape_hints or {}).items())),
+           tuple(sorted((k, str(v)) for k, v in type_hints.items())))
+    memo = symbol.__dict__.setdefault("_infer_walk_memo", {})
+    hit = memo.get(key)
+    if hit is None:
+        events = []
+        shapes, dtypes = _infer_graph(symbol, dict(shape_hints or {}),
+                                      type_hints, events=events)
+        if len(memo) >= 8:   # a symbol sees a handful of hint sets, ever
+            memo.clear()
+        memo[key] = hit = (shapes, dtypes, events)
+    shapes, dtypes, events = hit
+    return dict(shapes), dict(dtypes), list(events)
 
 
 def unknown_root_paths(symbol, shapes, node):

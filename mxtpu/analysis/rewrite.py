@@ -136,9 +136,25 @@ class TransformContext:
 class TransformPass:
     """Base class: subclass, set ``name``, implement ``run(tctx)``
     returning a NEW Symbol (the input graph must not be mutated — the
-    pipeline needs the original for fallback) or None for no change."""
+    pipeline needs the original for fallback) or None for no change.
+
+    Every registered pass must also declare its **rewrite algebra** —
+    the name of the closed edit set its rewrite stays inside, checked
+    per-build by :mod:`mxtpu.analysis.equiv` when the pipeline's
+    certification gate is armed (``MXTPU_PIPELINE_CERT``).  A pass
+    without a declared algebra is refused by the gate and flagged by
+    ``tools/mxtpu_lint.py``.  ``license`` names the dataflow analysis
+    that licenses the rewrite and ``knobs`` the tune-registry knobs it
+    resolves — both pinned against docs/compile.md's catalog table by
+    the docs-rot guard."""
 
     name = None
+    #: rewrite-algebra name from mxtpu.analysis.equiv.ALGEBRAS
+    algebra = None
+    #: licensing dataflow analysis (docs/compile.md catalog column)
+    license = None
+    #: tune-registry knob names the pass resolves
+    knobs = ()
 
     def describe(self):
         return (self.__doc__ or "").strip().split("\n")[0]
@@ -274,6 +290,9 @@ class Bf16MixedPrecisionPass(TransformPass):
     demands, f32 master weights cast at use, outputs cast back."""
 
     name = "bf16"
+    algebra = "cast_boundaries"
+    license = "precision_flow"
+    knobs = ()
 
     def run(self, tctx):
         plan = _df.precision_flow(tctx.symbol, shapes=tctx.shapes,
@@ -413,6 +432,10 @@ class QuantizePass(TransformPass):
     kinds are never touched."""
 
     name = "quant"
+    algebra = "qdq_streams"
+    license = "quant_plan"
+    knobs = ("quant.calibration_percentile", "quant.per_channel",
+             "quant.min_layer_elems")
 
     #: build kinds the rewrite may touch. Training kinds must keep f32
     #: master weights wired for the optimizer update; the executor tags
@@ -654,6 +677,9 @@ class ConvLayoutPass(TransformPass):
     model says the interior savings beat the conversions."""
 
     name = "layout"
+    algebra = "layout_runs"
+    license = "conv_layout"
+    knobs = ()
 
     def run(self, tctx):
         plan = _df.conv_layout(tctx.symbol, shapes=tctx.shapes,
@@ -677,6 +703,9 @@ class OptimizerUpdateFusionPass(TransformPass):
     parameter."""
 
     name = "fuse_opt"
+    algebra = "annotation_only"
+    license = "update_fusion_plan"
+    knobs = ("compile.fuse_opt_max_kb",)
 
     def run(self, tctx):
         from ..tune import registry as _knobs
@@ -725,6 +754,9 @@ class RematReusePass(TransformPass):
     aliasing pairs."""
 
     name = "remat_reuse"
+    algebra = "annotation_only"
+    license = "remat_reuse_plan"
+    knobs = ("compile.remat_threshold",)
 
     def run(self, tctx):
         from ..tune import registry as _knobs

@@ -39,6 +39,7 @@ __all__ = ["set_output_sanitizer", "set_calib_observer",
            "prewarm_scope", "in_prewarm", "prewarm_build_count",
            "configure", "configured", "refresh_from_knobs",
            "pipeline_scope", "canonical_order",
+           "set_certification", "certification_enabled",
            "transform_graph", "PipelineReport"]
 
 _log = _logging.getLogger("mxtpu.compile")
@@ -73,6 +74,39 @@ def set_calib_observer(fn):
     program's calibration observations; ``None`` uninstalls."""
     global _CALIB_OBSERVER
     _CALIB_OBSERVER = fn
+
+
+# ------------------------------------------------------- certification gate
+# Translation validation (mxtpu.analysis.equiv) rides the transform
+# seam as a gate BESIDE the verifier re-run: every accepted rewrite is
+# certified equivalent to its input modulo the pass's declared algebra,
+# and a non-certifiable rewrite is refused — rejected and fallen back
+# from exactly like the error-budget path. Disarmed
+# (MXTPU_PIPELINE_CERT=0), the per-pass cost is ONE module-global
+# check — the zero-overhead contract tools/bench_equiv.py pins down.
+_CERT_DISARM = ("0", "off", "false", "none", "")
+_CERT_ARMED = (_os.environ.get("MXTPU_PIPELINE_CERT", "1")
+               .strip().lower() not in _CERT_DISARM)
+
+
+def set_certification(flag):
+    """Arm (True) or disarm (False) the pipeline's per-pass
+    equivalence-certification gate; returns the previous state."""
+    global _CERT_ARMED
+    prev = _CERT_ARMED
+    _CERT_ARMED = bool(flag)
+    return prev
+
+
+def certification_enabled():
+    return _CERT_ARMED
+
+
+def _certify(tp, original, transformed, kind=None, shapes=None,
+             types=None):
+    from ..analysis import equiv as _equiv
+    return _equiv.certify(tp, original, transformed, kind=kind,
+                          shapes=shapes, types=types)
 
 
 # ---------------------------------------------------------------- cache hooks
@@ -162,18 +196,20 @@ def notify_build(kind, owner):
             pass
 
 
-def record_program_build(kind, owner, fn, precision=None, transforms=None):
+def record_program_build(kind, owner, fn, precision=None, transforms=None,
+                         cert=None):
     """Public build-seam entry for program tables outside the Executor
     (the fused train step, metric accumulators): bump the build
     counters, notify the listeners, and wrap ``fn`` for first-call
     compile timing and cost capture — the exact sequence the Executor's
     ``_get_fn`` performs, so every traced-program construction in the
-    process reports through one seam. ``precision``/``transforms`` tag
-    the program's cost record (``program_table``'s prec/xforms columns)
-    when the compile pipeline rewrote the graph."""
+    process reports through one seam. ``precision``/``transforms``/
+    ``cert`` tag the program's cost record (``program_table``'s
+    prec/xforms/cert columns) when the compile pipeline rewrote the
+    graph."""
     notify_build(kind, owner)
     return instrument_program(kind, fn, owner=owner, precision=precision,
-                              transforms=transforms)
+                              transforms=transforms, cert=cert)
 
 
 _AOT_MISS = object()     # sentinel: "the AOT capture path produced nothing"
@@ -182,7 +218,8 @@ _DEMOTE_MISS_TOTAL = 64  # lifetime misses → demote even if hits interleave
 
 
 def instrument_program(kind, fn, owner=None, matmul_env=False,
-                       precision=None, transforms=None, calib_heads=None):
+                       precision=None, transforms=None, calib_heads=None,
+                       cert=None):
     """Wrap a freshly built jit program with the build-seam diagnostics.
 
     First invocation — the one that pays tracing + XLA compilation —
@@ -254,7 +291,7 @@ def instrument_program(kind, fn, owner=None, matmul_env=False,
                 exe = fn.lower(*args, **kwargs).compile()
                 state["rec"] = _diag.record_program(
                     kind, owner, exe, (_time.perf_counter() - t0) * 1e3,
-                    transforms=transforms)
+                    transforms=transforms, cert=cert)
                 # SPMD shape of the program: devices spanned + how many
                 # arg leaves are mesh-split vs replicated (read off the
                 # live args — the one place both are in hand)
@@ -487,7 +524,8 @@ class PipelineReport:
 
     def _add(self, name):
         e = {"name": name, "applied": False, "rejected": False,
-             "actions": [], "offending": [], "error": None}
+             "actions": [], "offending": [], "error": None,
+             "cert": None, "cert_refused": False}
         self.entries.append(e)
         return e
 
@@ -517,6 +555,26 @@ class PipelineReport:
         its rewrite)."""
         return tuple(self.applied)
 
+    @property
+    def cert(self):
+        """Certification tag for the diagnostics ProgramRecord: ``ok``
+        when every applied rewrite carries an equivalence certificate,
+        ``off`` when some applied rewrite was accepted with the gate
+        disarmed, None when no rewrite applied (the program compiled
+        from the unrewritten graph — nothing to certify)."""
+        applied = [e for e in self.entries if e["applied"]]
+        if not applied:
+            return None
+        if all(e["cert"] is not None and e["cert"].ok for e in applied):
+            return "ok"
+        return "off"
+
+    def certificates(self):
+        """name → :class:`~mxtpu.analysis.equiv.Certificate` for every
+        pass the gate examined (applied or refused)."""
+        return {e["name"]: e["cert"] for e in self.entries
+                if e["cert"] is not None}
+
     def findings(self):
         """The report flattened to the Finding schema (merged into
         ``Symbol.lint(pipeline=...)`` / ``Module.check`` reports and the
@@ -534,25 +592,47 @@ class PipelineReport:
                 continue
             if e["rejected"]:
                 off = e["offending"][0] if e["offending"] else None
-                out.append(Finding(
-                    "pipeline", WARNING,
-                    "transform '%s' REJECTED: its output graph fails "
-                    "verifier pass '%s' (%s) — the build fell back to "
-                    "the unrewritten graph"
-                    % (e["name"], off.pass_name if off else "?",
-                       off.message if off else "unknown"),
-                    node=off.node if off else None,
-                    fix_hint="the rewrite is unsound for this graph; "
-                             "fix the transform or drop it from "
-                             "MXTPU_PIPELINE"))
+                if e["cert_refused"]:
+                    cert = e["cert"]
+                    out.append(Finding(
+                        "pipeline", WARNING,
+                        "transform '%s' REFUSED by certification: its "
+                        "rewrite is not equivalent to the input graph "
+                        "under its declared algebra '%s' (%s) — the "
+                        "build fell back to the unrewritten graph"
+                        % (e["name"],
+                           (cert.algebra if cert else None)
+                           or "<undeclared>",
+                           cert.reason if cert else "unknown"),
+                        node=off.node if off else None,
+                        fix_hint="the rewrite left its declared "
+                                 "algebra; fix the transform or drop "
+                                 "it from MXTPU_PIPELINE"))
+                else:
+                    out.append(Finding(
+                        "pipeline", WARNING,
+                        "transform '%s' REJECTED: its output graph "
+                        "fails verifier pass '%s' (%s) — the build "
+                        "fell back to the unrewritten graph"
+                        % (e["name"], off.pass_name if off else "?",
+                           off.message if off else "unknown"),
+                        node=off.node if off else None,
+                        fix_hint="the rewrite is unsound for this "
+                                 "graph; fix the transform or drop it "
+                                 "from MXTPU_PIPELINE"))
                 out.extend(e["offending"])
             else:
+                cert = e.get("cert")
+                certified = (", certified equivalent (algebra %s)"
+                             % cert.algebra
+                             if e["applied"] and cert is not None
+                             and cert.ok else "")
                 out.append(Finding(
                     "pipeline", INFO,
-                    "transform '%s' %s (%d recorded action(s))"
+                    "transform '%s' %s (%d recorded action(s)%s)"
                     % (e["name"],
                        "applied" if e["applied"] else "made no change",
-                       len(e["actions"]))))
+                       len(e["actions"]), certified)))
             out.extend(e["actions"])
         return out
 
@@ -560,6 +640,9 @@ class PipelineReport:
         return {"kind": self.kind, "passes": list(self.passes),
                 "applied": self.applied, "rejected": self.rejected,
                 "symbol_changed": self.symbol_changed,
+                "cert": self.cert,
+                "certificates": {n: c.to_dict() for n, c in
+                                 self.certificates().items()},
                 "findings": [f.to_dict() for f in self.findings()]}
 
     def render(self):
@@ -691,6 +774,28 @@ def transform_graph(symbol, kind=None, shapes=None, types=None,
                 "back to the unrewritten graph", name, kind,
                 offending[0].pass_name, offending[0].message)
             continue
+        if _CERT_ARMED:
+            cert = _certify(tp, cur, new_sym, kind=kind, shapes=shapes,
+                            types=types)
+            entry["cert"] = cert
+            if not cert.ok:
+                entry["rejected"] = True
+                entry["cert_refused"] = True
+                entry["offending"] = [cert.to_finding()]
+                _tel.counter(
+                    "transform_cert_refused", labels={"pass": name},
+                    help="pipeline rewrites refused by equivalence "
+                         "certification (the build fell back to the "
+                         "unrewritten graph)").inc()
+                _log.warning(
+                    "compile pipeline: transform '%s' REFUSED by "
+                    "certification for kind=%s — %s; falling back to "
+                    "the unrewritten graph", name, kind, cert.reason)
+                continue
+            _tel.counter(
+                "transform_certified", labels={"pass": name},
+                help="pipeline rewrites certified equivalent to their "
+                     "input modulo the pass's declared algebra").inc()
         cur = new_sym
         base = post  # the accepted graph is the next baseline
         entry["applied"] = True

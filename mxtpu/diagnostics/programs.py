@@ -69,7 +69,7 @@ class ProgramRecord:
                  "bytes_accessed", "argument_bytes", "output_bytes",
                  "temp_bytes", "generated_code_bytes", "calls",
                  "n_devices", "sharded_args", "replicated_args",
-                 "precision", "transforms", "_exe")
+                 "precision", "transforms", "cert", "_exe")
 
     def __init__(self, kind, owner, compile_ms):
         self.id = next(_ids)
@@ -94,6 +94,10 @@ class ProgramRecord:
         # compile-pipeline passes that were APPLIED to the graph this
         # program compiled from (rejected passes never appear)
         self.transforms = ()
+        # equivalence-certification tag: "ok" when every applied rewrite
+        # carried a certificate, "off" when built with the gate
+        # disarmed, "-" for untransformed programs
+        self.cert = "-"
         self._exe = None  # weakref to the compiled executable (HLO source)
 
     def hlo_text(self):
@@ -124,6 +128,7 @@ class ProgramRecord:
             "replicated_args": self.replicated_args,
             "precision": self.precision,
             "transforms": list(self.transforms),
+            "cert": self.cert,
         }
 
 
@@ -192,14 +197,17 @@ def summarize_precision(rec, args, tag=None):
         pass
 
 
-def record_program(kind, owner, compiled, compile_ms, transforms=None):
+def record_program(kind, owner, compiled, compile_ms, transforms=None,
+                   cert=None):
     """Capture a freshly compiled executable's analyses into the registry
     (and the telemetry counters). Never raises — introspection must not
     take down the program it is describing. ``transforms`` stamps the
-    applied compile-pipeline pass names on the record."""
+    applied compile-pipeline pass names on the record; ``cert`` the
+    pipeline's equivalence-certification tag for those rewrites."""
     rec = ProgramRecord(kind, owner, compile_ms)
     if transforms:
         rec.transforms = tuple(transforms)
+        rec.cert = cert or "off"
     try:
         cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):
@@ -275,15 +283,15 @@ def program_table(kind=None):
     rows = programs(kind)
     header = ("id", "kind", "owner", "calls", "compile_ms", "mflops",
               "mb_accessed", "arg_kb", "out_kb", "temp_kb", "devs",
-              "prec", "xforms")
-    lines = ["%4s %-12s %-16s %6s %10s %10s %11s %8s %8s %8s %9s %-10s %s"
-             % header]
+              "prec", "cert", "xforms")
+    lines = ["%4s %-12s %-16s %6s %10s %10s %11s %8s %8s %8s %9s %-10s "
+             "%-4s %s" % header]
     for r in rows:
         devs = "%d" % r.get("n_devices", 1)
         if r.get("sharded_args"):
             devs += " (%ds)" % r["sharded_args"]
         lines.append("%4d %-12s %-16s %6d %10.1f %10.2f %11.2f %8d %8d "
-                     "%8d %9s %-10s %s"
+                     "%8d %9s %-10s %-4s %s"
                      % (r["id"], r["kind"][:12], r["owner"][:16], r["calls"],
                         r["compile_ms"], r["flops"] / 1e6,
                         r["bytes_accessed"] / 1e6,
@@ -291,6 +299,7 @@ def program_table(kind=None):
                         r["output_bytes"] // 1024,
                         r["temp_bytes"] // 1024, devs,
                         r.get("precision", "f32")[:10],
+                        r.get("cert", "-"),
                         ",".join(r.get("transforms", ())) or "-"))
     return "\n".join(lines)
 

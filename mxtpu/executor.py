@@ -407,6 +407,10 @@ class Executor:
         rep = self.pipeline_report
         return rep.transforms if rep is not None else None
 
+    def _cert_tag(self):
+        rep = self.pipeline_report
+        return rep.cert if rep is not None else None
+
     def _get_fn(self, kind):
         from .compile import quant as _quant
         # the program table is valid for ONE pipeline config: flipping
@@ -534,7 +538,8 @@ class Executor:
         fn = _instrument_program(kind, fn, owner=self, matmul_env=True,
                                  precision=self._precision_tag(),
                                  transforms=self._transform_tags(),
-                                 calib_heads=calib_heads)
+                                 calib_heads=calib_heads,
+                                 cert=self._cert_tag())
         self._fns[kind] = fn
         return fn
 

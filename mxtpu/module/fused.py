@@ -837,7 +837,8 @@ class FusedTrainStep:
             self._step_fn = record_program_build(
                 "fused_step", self, self._step_fn,
                 precision=rep.precision if rep is not None else None,
-                transforms=rep.transforms if rep is not None else None)
+                transforms=rep.transforms if rep is not None else None,
+                cert=rep.cert if rep is not None else None)
         try:
             res = self._step_fn(
                 self.params, self.aux, self.opt_state, batch,

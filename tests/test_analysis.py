@@ -508,3 +508,53 @@ def test_lint_swallowed_exception_rule():
               "        pass  # mxtpu: allow-swallow(test)\n")
     assert not lint.lint_source(pragma, "mxtpu/engine.py")
     assert not lint.lint_source(bare, "mxtpu/visualization.py")
+
+
+def test_lint_transform_algebra_rule():
+    """Registry completeness (ISSUE 20): a registered TransformPass
+    without a declared rewrite algebra — or a catalog pass missing from
+    CANONICAL_ORDER — is a lint error."""
+    lint = _lint_mod()
+    bare = ("@register_transform\n"
+            "class MyPass(TransformPass):\n"
+            "    name = \"my_pass\"\n")
+    assert [f.rule for f in lint.lint_source(
+        bare, "mxtpu/analysis/rewrite.py")] == ["transform-algebra"]
+    declared = bare + "    algebra = \"annotation_only\"\n"
+    assert not lint.lint_source(declared, "mxtpu/analysis/rewrite.py")
+    # the pragma escape (a deliberate certify-refused experiment)
+    pragma = ("@register_transform\n"
+              "class MyPass(TransformPass):  "
+              "# mxtpu: allow-algebra(experiment)\n"
+              "    name = \"my_pass\"\n")
+    assert not lint.lint_source(pragma, "mxtpu/analysis/rewrite.py")
+    # decorator spellings all count as registration
+    spelled = ("@rewrite.register_transform\n"
+               "class P(TransformPass):\n    name = \"p\"\n")
+    assert [f.rule for f in lint.lint_source(
+        spelled, "mxtpu/analysis/rewrite.py")] == ["transform-algebra"]
+    # a declared catalog pass absent from CANONICAL_ORDER is an error...
+    drifted = ("CANONICAL_ORDER = (\"other\",)\n"
+               "@register_transform\n"
+               "class P(TransformPass):\n"
+               "    name = \"p\"\n"
+               "    algebra = \"annotation_only\"\n")
+    founds = lint.lint_source(drifted, "mxtpu/analysis/rewrite.py")
+    assert [f.rule for f in founds] == ["transform-algebra",
+                                       "transform-algebra"], founds
+    assert any("CANONICAL_ORDER" in f.message for f in founds)
+    # ... and so is a CANONICAL_ORDER name with no registered class
+    assert any("names 'other'" in f.message for f in founds)
+    synced = ("CANONICAL_ORDER = (\"p\",)\n"
+              "@register_transform\n"
+              "class P(TransformPass):\n"
+              "    name = \"p\"\n"
+              "    algebra = \"annotation_only\"\n")
+    assert not lint.lint_source(synced, "mxtpu/analysis/rewrite.py")
+    # the live catalog file must lint clean (registry complete)
+    path = os.path.join(ROOT, "mxtpu", "analysis", "rewrite.py")
+    with open(path) as fh:
+        src = fh.read()
+    assert not [f for f in lint.lint_source(src,
+                                            "mxtpu/analysis/rewrite.py")
+                if f.rule == "transform-algebra"]

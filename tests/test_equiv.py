@@ -1,0 +1,421 @@
+"""Translation validation (ISSUE 20): the equivalence canonicalizer,
+per-pass certification over the model fixtures, the pipeline cert gate
+(arm/disarm, counters, refusal-with-fallback), the ProgramRecord cert
+column, the seeded transform fuzzer, and the docs-rot guard.
+
+Acceptance gates:
+* every catalog pass and the full canonical composition certify on
+  mlp / lenet / resnet-20 / lstm decode step / attn prefill graphs
+  (incl. the bf16 and quant inference kinds);
+* a deliberately-miscompiling pass (the PR-14
+  ``save_any_names_but_these`` near-miss shape) is REFUSED by
+  certification — not by the error budget — the rest of the catalog
+  still applies, and the fit falls back to the no-pipeline numbers;
+* a bounded fuzz round (>= 64 seeded graphs x sampled configs)
+  certifies and differential-tests deterministically: the same master
+  seed reproduces the identical verdict sequence.
+"""
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+import mxtpu.symbol as S
+from mxtpu import diagnostics as diag
+from mxtpu import telemetry as tel
+from mxtpu.analysis import equiv, graphgen, rewrite
+from mxtpu.compile import pipeline
+from mxtpu.models import lenet, mlp, resnet
+from mxtpu.serving.decode.model import (attn_prefill_symbol,
+                                        lm_step_symbol)
+
+
+# ------------------------------------------------------------- fixtures
+def _mlp_fix(batch=64):
+    return mlp.get_symbol(10), {"data": (batch, 784),
+                                "softmax_label": (batch,)}
+
+
+def _lenet_fix(batch=64):
+    return lenet.get_symbol(10), {"data": (batch, 1, 28, 28),
+                                  "softmax_label": (batch,)}
+
+
+def _resnet20_fix(batch=4):
+    sym = resnet.get_symbol(num_classes=10, num_layers=20,
+                            image_shape=(3, 28, 28))
+    return sym, {"data": (batch, 3, 28, 28), "softmax_label": (batch,)}
+
+
+def _decode_step_fix(batch=4):
+    group, state_names, specs = lm_step_symbol(16, 8, 16, num_layers=2)
+    shapes = {"data": (batch, 1)}
+    for name, spec in zip(state_names, specs):
+        shapes[name] = (batch,) + tuple(spec["shape"][1:])
+    return group, shapes
+
+
+def _prefill_fix():
+    C, max_blocks, block, H, D = 4, 2, 4, 2, 4
+    T = max_blocks * block
+    sym = attn_prefill_symbol(16, 8, H, D, max_blocks, block,
+                              num_layers=1)
+    shapes = {"data": (C, 1), "attn_mask_cache": (C, T),
+              "attn_mask_chunk": (C, C), "kv_valid_cache": (1, T),
+              "chunk_valid": (C, 1),
+              "kv_k_0": (1, max_blocks, block, H, D),
+              "kv_v_0": (1, max_blocks, block, H, D)}
+    return sym, shapes
+
+
+FIXTURES = {
+    "mlp": _mlp_fix,
+    "lenet": _lenet_fix,
+    "resnet20": _resnet20_fix,
+    "decode_step": _decode_step_fix,
+    "prefill": _prefill_fix,
+}
+
+
+def _seeded_values(sym, shapes, seed=3):
+    """f32 arrays for every argument (quant reads scales off them)."""
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    rng = np.random.RandomState(seed)
+    out = {}
+    for name, shp in zip(sym.list_arguments(), arg_shapes):
+        out[name] = (rng.rand(*shp).astype(np.float32) - 0.5)
+    return out
+
+
+def _fit(symbol, names, n=256, batch=64, epochs=2, seed=7):
+    rng = np.random.RandomState(0)
+    X = rng.rand(n, 784).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, n).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(symbol, context=mx.cpu(),
+                        logger=logging.getLogger("quiet"))
+    mod.logger.setLevel(logging.ERROR)
+    metric = mx.metric.create(["acc", "ce"])
+    with pipeline.pipeline_scope(names):
+        mx.random.seed(seed)
+        np.random.seed(seed)
+        mod.fit(it, num_epoch=epochs, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1},
+                eval_metric=metric)
+    args, _ = mod.get_params()
+    return mod, {k: v.asnumpy() for k, v in args.items()}, \
+        dict(zip(*metric.get()))
+
+
+# ------------------------------------------------------- canonical keys
+def test_entry_key_name_independent():
+    def build(prefix):
+        x = S.Variable("%s_in" % prefix)
+        x = S.FullyConnected(x, num_hidden=8, name="%s_fc" % prefix)
+        x = S.Activation(x, act_type="relu", name="%s_act" % prefix)
+        return S.SoftmaxOutput(x, name="%s_sm" % prefix)
+    assert equiv.entry_key(build("a")) == equiv.entry_key(build("b"))
+    assert equiv.canonical_digest(build("a")) == \
+        equiv.canonical_digest(build("b"))
+
+
+def test_entry_key_separates_structure():
+    x = S.Variable("data")
+    relu = S.SoftmaxOutput(S.Activation(x, act_type="relu", name="a"),
+                           name="sm")
+    tanh = S.SoftmaxOutput(S.Activation(x, act_type="tanh", name="a"),
+                           name="sm")
+    assert equiv.entry_key(relu) != equiv.entry_key(tanh)
+
+
+def test_entry_key_commutative_input_order():
+    x = S.Variable("data")
+    r = S.Activation(x, act_type="relu", name="r")
+    t = S.Activation(x, act_type="tanh", name="t")
+    # elemwise_add is commutative: operand order canonicalizes away
+    assert equiv.entry_key(S.elemwise_add(r, t, name="s")) == \
+        equiv.entry_key(S.elemwise_add(t, r, name="s"))
+    # Concat is NOT: operand order is semantic and must survive
+    assert equiv.entry_key(S.Concat(r, t, dim=1, name="c")) != \
+        equiv.entry_key(S.Concat(t, r, dim=1, name="c"))
+
+
+def test_entry_key_strips_annotation_attrs():
+    sym, _ = _mlp_fix()
+    extra = {id(n): {"__remat__": "1", "__update_class__": "c0"}
+             for n in sym._topo() if not n.is_variable}
+    ann = rewrite._annotate_clone(sym, node_extra=extra)
+    assert equiv.entry_key(ann) == equiv.entry_key(sym)
+
+
+def test_entry_key_detects_rewire():
+    def build(skip_relu):
+        x = S.Variable("data")
+        fc1 = S.FullyConnected(x, num_hidden=8, name="fc1")
+        h = fc1 if skip_relu else S.Activation(fc1, act_type="relu",
+                                               name="r1")
+        fc2 = S.FullyConnected(h, num_hidden=8, name="fc2")
+        return S.SoftmaxOutput(fc2, name="sm")
+    assert equiv.entry_key(build(False)) != equiv.entry_key(build(True))
+
+
+# ---------------------------------------- catalog certification (all kinds)
+_CONFIG_IDS = {
+    ("layout",): "layout",
+    ("bf16",): "bf16",
+    ("fuse_opt",): "fuse_opt",
+    ("remat_reuse",): "remat_reuse",
+    ("layout", "bf16", "fuse_opt", "remat_reuse"): "composed",
+}
+
+
+@pytest.mark.parametrize("fixture", sorted(FIXTURES))
+@pytest.mark.parametrize("passes", list(_CONFIG_IDS),
+                         ids=list(_CONFIG_IDS.values()))
+def test_catalog_certifies_on_training_kind(fixture, passes):
+    """Every catalog pass (and the full canonical composition) either
+    declines or applies WITH a passing certificate — on every model
+    fixture, decode step and prefill graphs included."""
+    sym, shapes = FIXTURES[fixture]()
+    _, rep = pipeline.transform_graph(sym, kind="fused_step",
+                                      shapes=shapes, passes=list(passes))
+    for e in rep.entries:
+        assert not e["cert_refused"], (fixture, e["name"],
+                                       e["cert"] and e["cert"].reason)
+        assert e["error"] is None, (fixture, e["name"], e["error"])
+        if e["applied"]:
+            assert e["cert"] is not None and e["cert"].ok, \
+                (fixture, e["name"], e["cert"])
+            assert e["cert"].digest, (fixture, e["name"])
+    if rep.applied:
+        assert rep.cert == "ok"
+
+
+@pytest.mark.parametrize("fixture", ["mlp", "decode_step"])
+def test_quant_certifies_on_inference_kinds(fixture):
+    """The quant rewrite — weight streams + composed bf16 — certifies
+    under ``qdq_streams`` on its inference build kinds."""
+    sym, shapes = FIXTURES[fixture]()
+    kind = "executor_infer" if fixture == "mlp" else "decode"
+    values = _seeded_values(sym, shapes)
+    _, rep = pipeline.transform_graph(
+        sym, kind=kind, shapes=shapes, passes=["bf16", "quant"],
+        values=values)
+    assert "quant" in rep.applied, [
+        (e["name"], e["actions"], e["error"]) for e in rep.entries]
+    for e in rep.entries:
+        assert not e["cert_refused"], (e["name"],
+                                       e["cert"] and e["cert"].reason)
+    assert rep.cert == "ok"
+    certs = rep.certificates()
+    assert certs["quant"].algebra == "qdq_streams"
+    assert certs["quant"].counts.get("weight_streams", 0) >= 1
+
+
+def test_certify_refuses_undeclared_algebra():
+    class _NoAlgebra(rewrite.TransformPass):
+        name = "_test_noalg"
+    sym, _ = _mlp_fix()
+    cert = equiv.certify(_NoAlgebra(), sym, sym)
+    assert not cert.ok and "no rewrite algebra" in cert.reason
+    f = cert.to_finding()
+    assert f.pass_name == "certificate" and f.severity == "error"
+    cert2 = equiv.certify(
+        type("_T", (rewrite.TransformPass,),
+             {"name": "_test_badalg", "algebra": "no_such"})(),
+        sym, sym)
+    assert not cert2.ok and "unknown rewrite algebra" in cert2.reason
+
+
+# ------------------------------------------------------- the gate itself
+def test_set_certification_disarm_tags_off():
+    prev = pipeline.set_certification(False)
+    try:
+        assert not pipeline.certification_enabled()
+        sym, shapes = _mlp_fix()
+        _, rep = pipeline.transform_graph(sym, kind="fused_step",
+                                          shapes=shapes, passes=["bf16"])
+        assert "bf16" in rep.applied
+        assert all(e["cert"] is None for e in rep.entries)
+        assert rep.cert == "off"
+        assert rep.certificates() == {}
+    finally:
+        pipeline.set_certification(prev)
+    assert pipeline.certification_enabled() == prev
+
+
+def test_certified_counter_increments():
+    before = tel.registry().counter("transform_certified",
+                                    labels={"pass": "bf16"}).value
+    sym, shapes = _mlp_fix()
+    pipeline.transform_graph(sym, kind="fused_step", shapes=shapes,
+                             passes=["bf16"])
+    after = tel.registry().counter("transform_certified",
+                                   labels={"pass": "bf16"}).value
+    assert after == before + 1
+
+
+# --------------------------------------------- the miscompile near-miss
+class _SaveAnyNamesButThesePass(rewrite.TransformPass):
+    """The PR-14 near-miss reborn as a fixture: verifier-CLEAN but
+    semantics-changing — rebuilds the mlp graph with ``relu1`` spliced
+    out of ``fc2``'s input edge (shapes all still check, so the error
+    budget cannot see it; only certification can)."""
+
+    name = "_test_miscompile"
+    algebra = "annotation_only"
+
+    def run(self, tctx):
+        d = S.Flatten(S.Variable("data"))
+        fc1 = S.FullyConnected(d, num_hidden=128, name="fc1")
+        S.Activation(fc1, act_type="relu", name="relu1")  # spliced out
+        fc2 = S.FullyConnected(fc1, num_hidden=64, name="fc2")
+        act2 = S.Activation(fc2, act_type="relu", name="relu2")
+        fc3 = S.FullyConnected(act2, num_hidden=10, name="fc3")
+        self.action(tctx, "spliced relu1 out of fc2's input edge")
+        return S.SoftmaxOutput(fc3, name="softmax")
+
+
+def test_miscompile_refused_by_certification_not_error_budget():
+    rewrite._TRANSFORMS.setdefault("_test_miscompile",
+                                   _SaveAnyNamesButThesePass())
+    try:
+        before = tel.registry().counter(
+            "transform_cert_refused",
+            labels={"pass": "_test_miscompile"}).value
+        sym, shapes = _mlp_fix()
+        sym2, rep = pipeline.transform_graph(
+            sym, kind="fused_step", shapes=shapes,
+            passes=["_test_miscompile", "bf16", "fuse_opt",
+                    "remat_reuse"])
+        entry = next(e for e in rep.entries
+                     if e["name"] == "_test_miscompile")
+        # refused by the CERT gate, not the verifier error budget
+        assert entry["cert_refused"] and entry["rejected"]
+        assert not entry["applied"]
+        assert entry["offending"], entry
+        f = entry["offending"][0]
+        assert f.pass_name == "certificate", f.pass_name
+        assert "REFUSED" in f.message and "annotation_only" in f.message
+        assert entry["cert"] is not None and not entry["cert"].ok
+        # the rest of the catalog still applies, certified
+        assert "bf16" in rep.applied
+        for e in rep.entries:
+            if e["applied"]:
+                assert e["cert"].ok, e["name"]
+        assert rep.cert == "ok"
+        after = tel.registry().counter(
+            "transform_cert_refused",
+            labels={"pass": "_test_miscompile"}).value
+        assert after == before + 1
+        # the refusal surfaces in the report's findings stream
+        msgs = [g.message for g in rep.findings()]
+        assert any("REFUSED by certification" in m for m in msgs), msgs
+    finally:
+        rewrite._TRANSFORMS.pop("_test_miscompile", None)
+
+
+def test_miscompile_fallback_trains_to_no_pipeline_parity():
+    """The refused pass falls back exactly like the error-budget path:
+    with ONLY the miscompiling pass configured, nothing rewrites and
+    the fit reproduces the no-pipeline numbers."""
+    rewrite._TRANSFORMS.setdefault("_test_miscompile",
+                                   _SaveAnyNamesButThesePass())
+    try:
+        _, w0, v0 = _fit(mlp.get_symbol(10), [])
+        mod, w1, v1 = _fit(mlp.get_symbol(10), ["_test_miscompile"])
+        rep = mod._fused.pipeline_report
+        entry = rep.entries[0]
+        assert entry["cert_refused"] and entry["rejected"]
+        assert rep.applied == [] and not rep.symbol_changed
+        assert abs(v0["accuracy"] - v1["accuracy"]) <= 1e-12, (v0, v1)
+        assert abs(v0["cross-entropy"] - v1["cross-entropy"]) < 1e-9
+        for k in w0:
+            np.testing.assert_allclose(w0[k], w1[k], rtol=0, atol=1e-6)
+    finally:
+        rewrite._TRANSFORMS.pop("_test_miscompile", None)
+
+
+# --------------------------------------------------- ProgramRecord cert
+def test_program_record_carries_cert_tag():
+    mod, _, _ = _fit(mlp.get_symbol(10), ["bf16", "remat_reuse"],
+                     epochs=1)
+    recs = diag.programs("fused_step")
+    assert recs and recs[-1]["cert"] == "ok"
+    assert "bf16" in recs[-1]["transforms"]
+    table = diag.program_table("fused_step")
+    assert "cert" in table.splitlines()[0]
+    assert rewrite is not None and mod is not None
+
+
+# --------------------------------------------------------- the fuzzer
+def test_fuzz_round_certifies_64_graphs():
+    before = tel.registry().counter("fuzz_graphs_run").value
+    res = graphgen.fuzz_round(20260808, n_graphs=64)
+    assert res["n_graphs"] == 64 and len(res["verdicts"]) == 64
+    assert res["refutations"] == [], res["refutations"]
+    # the round exercises real rewrites, not 64 no-ops
+    applied = [v for v in res["verdicts"] if "applied=-" not in v]
+    assert len(applied) >= 20, len(applied)
+    # ... and real numeric differentials on semantics-preserving configs
+    diffed = [v for v in res["verdicts"]
+              if "diff=exact" in v or "diff=max" in v]
+    assert diffed, res["verdicts"][:8]
+    after = tel.registry().counter("fuzz_graphs_run").value
+    assert after == before + 64
+
+
+def test_fuzz_round_is_deterministic():
+    """PR-13 convention: same master seed => identical verdict
+    sequence (graphs, sampled configs, certificates)."""
+    r1 = graphgen.fuzz_round(7, n_graphs=16, numeric=False)
+    r2 = graphgen.fuzz_round(7, n_graphs=16, numeric=False)
+    assert r1["verdicts"] == r2["verdicts"]
+    assert r1["refutations"] == [] == r2["refutations"]
+    # a different master seed walks a different graph sequence
+    r3 = graphgen.fuzz_round(8, n_graphs=16, numeric=False)
+    assert r3["verdicts"] != r1["verdicts"]
+
+
+def test_sub_seed_stable():
+    assert graphgen.sub_seed(7, 0, "graph") == \
+        graphgen.sub_seed(7, 0, "graph")
+    assert graphgen.sub_seed(7, 0, "graph") != \
+        graphgen.sub_seed(7, 1, "graph")
+    assert graphgen.sub_seed(7, 0, "graph") != \
+        graphgen.sub_seed(7, 0, "cfg")
+
+
+# ------------------------------------------------------ docs-rot guard
+def test_docs_catalog_matches_live_registry():
+    """docs/compile.md's catalog table must track the registry: one row
+    per registered pass carrying its declared algebra, license analysis
+    and every knob — and the canonical-order prose must match
+    ``rewrite.CANONICAL_ORDER`` exactly."""
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "docs", "compile.md")
+    with open(path) as fh:
+        doc = fh.read()
+    lines = doc.splitlines()
+    for name, _doc in rewrite.list_transforms():
+        if name.startswith("_"):
+            continue
+        tp = rewrite.get_transform(name)
+        rows = [l for l in lines if l.startswith("| `%s` |" % name)]
+        assert len(rows) == 1, \
+            "docs/compile.md catalog table needs exactly one row " \
+            "for %r (found %d)" % (name, len(rows))
+        row = rows[0]
+        assert "`%s`" % tp.algebra in row, (name, tp.algebra)
+        assert "`%s`" % tp.license in row, (name, tp.license)
+        for knob in tp.knobs:
+            assert "`%s`" % knob in row, (name, knob)
+        assert name in rewrite.CANONICAL_ORDER, name
+    order = "`%s`" % ", ".join(rewrite.CANONICAL_ORDER)
+    assert order in doc, \
+        "docs/compile.md canonical-order prose does not match " \
+        "rewrite.CANONICAL_ORDER (%s)" % order
+    assert "MXTPU_PIPELINE_CERT" in doc

@@ -380,8 +380,15 @@ def test_full_catalog_parity_gate(model, kw):
     recs = diag.programs("fused_step")
     assert recs and recs[-1]["precision"] == "mixed_bf16"
     assert "remat_reuse" in recs[-1]["transforms"]
+    # every transformed build ships with its equivalence certificate
+    assert recs[-1]["cert"] == "ok"
     table = diag.program_table("fused_step")
     assert "xforms" in table.splitlines()[0]
+    assert "cert" in table.splitlines()[0]
+    # ... and the report certifies each applied pass individually
+    for e in rep.entries:
+        if e["applied"]:
+            assert e["cert"] is not None and e["cert"].ok, e["name"]
 
 
 def test_transform_counters_emitted():

@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Benchmark: translation-validation cost at the program-build seam.
+
+The certification gate (``mxtpu.analysis.equiv`` via
+``compile.pipeline``) runs ONCE per accepted rewrite per program build
+— it is build-time machinery, never on the step path. This bench makes
+the <0.5%-of-a-build claim falsifiable on the exact-crossing basis the
+obs/faults/concurrency benches use:
+
+  1. microbench ``equiv.certify`` per catalog pass on the lenet graph
+     (the conv fixture every pass applies to) → ns/certificate;
+  2. build the composed-pipeline fused step once and read the build's
+     measured ``compile_ms`` off the diagnostics ProgramRecord, plus
+     the EXACT number of certificates that build minted (one per
+     applied pass — read off the PipelineReport, not modeled);
+  3. overhead_pct = Σ ns/certificate × crossings vs the measured
+     program-build time;
+  4. disarmed: the gate is one module-global bool check — tight-loop
+     it for the strictly-zero-overhead claim.
+
+Writes BENCH_equiv.json. Acceptance: armed certification < 0.5% of
+the program build it guards.
+
+Usage: python tools/bench_equiv.py [--out BENCH_equiv.json]
+"""
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import mxtpu as mx  # noqa: E402
+from mxtpu import diagnostics as diag  # noqa: E402
+from mxtpu.analysis import equiv, rewrite  # noqa: E402
+from mxtpu.compile import pipeline  # noqa: E402
+from mxtpu.models import lenet  # noqa: E402
+
+PASSES = ("layout", "bf16", "fuse_opt", "remat_reuse")
+
+
+def _lenet_fixture(batch=64):
+    sym = lenet.get_symbol(10)
+    shapes = {"data": (batch, 1, 28, 28), "softmax_label": (batch,)}
+    return sym, shapes
+
+
+def _certify_ns(sym, shapes, iters=25):
+    """ns per equiv.certify call, per catalog pass (each timed over the
+    pass's own rewrite of the lenet graph)."""
+    out = {}
+    prev = pipeline.set_certification(False)
+    try:
+        pairs = {}
+        for name in PASSES:
+            sym2, rep = pipeline.transform_graph(
+                sym, kind="fused_step", shapes=shapes, passes=[name])
+            if name in rep.applied:
+                pairs[name] = sym2
+    finally:
+        pipeline.set_certification(prev)
+    for name, sym2 in pairs.items():
+        cert = equiv.certify(name, sym, sym2, kind="fused_step",
+                             shapes=shapes)
+        assert cert.ok, (name, cert.reason)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            equiv.certify(name, sym, sym2, kind="fused_step",
+                          shapes=shapes)
+        out[name] = (time.perf_counter() - t0) / iters * 1e9
+    return out
+
+
+def _disarmed_ns(iters=2000000):
+    """The disarmed gate is one module-global bool read."""
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        if pipeline._CERT_ARMED:
+            pass
+    return (time.perf_counter() - t0) / iters * 1e9
+
+
+def _build_fused(shapes, names):
+    """One composed-pipeline fused-step build; returns (compile_ms,
+    applied pass list) read off the diagnostics ProgramRecord and the
+    step's PipelineReport."""
+    X = np.random.RandomState(0).rand(
+        256, 1, 28, 28).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, 256).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=64,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(lenet.get_symbol(10), context=mx.cpu(),
+                        logger=logging.getLogger("quiet"))
+    mod.logger.setLevel(logging.ERROR)
+    with pipeline.pipeline_scope(list(names)):
+        mod.fit(it, num_epoch=1, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1})
+    rep = mod._fused.pipeline_report
+    recs = diag.programs("fused_step")
+    return recs[-1]["compile_ms"], list(rep.applied), rep.cert
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=25)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "..", "BENCH_equiv.json"))
+    args = ap.parse_args(argv)
+
+    sym, shapes = _lenet_fixture()
+    per_pass_ns = _certify_ns(sym, shapes, iters=args.iters)
+    compile_ms, applied, cert_tag = _build_fused(shapes, PASSES)
+    assert cert_tag == "ok", cert_tag
+    crossings = len(applied)
+    armed_ms = sum(per_pass_ns.get(n, 0.0) for n in applied) / 1e6
+    pct = 100.0 * armed_ms / compile_ms
+    disarmed = _disarmed_ns()
+
+    payload = {
+        "bench": "translation-validation cost at the program-build "
+                 "seam (mxtpu.analysis.equiv)",
+        "model": "lenet",
+        "batch_size": 64,
+        "passes": list(PASSES),
+        "applied": applied,
+        "certify_ns_per_pass": {k: round(v, 1)
+                                for k, v in per_pass_ns.items()},
+        "certificates_per_build": crossings,
+        "cert_ms_per_build": round(armed_ms, 4),
+        "program_build_compile_ms": round(compile_ms, 3),
+        "cert_pct_of_build": round(pct, 4),
+        "target_pct": 0.5,
+        "pass": bool(pct < 0.5),
+        "disarmed_check_ns": round(disarmed, 2),
+        "basis": "deterministic microbench: ns per equiv.certify call "
+                 "per catalog pass (each timed over the pass's own "
+                 "rewrite of the lenet graph) x the EXACT number of "
+                 "certificates one composed-pipeline fused-step build "
+                 "mints (one per applied pass, read off the "
+                 "PipelineReport), vs the same build's measured "
+                 "compile_ms on its diagnostics ProgramRecord. No "
+                 "off/on wall-clock subtraction - on a shared host "
+                 "that delta sits inside scheduler noise; the "
+                 "per-certificate cost x crossing count bound is what "
+                 "the <0.5% claim rests on (same convention as "
+                 "BENCH_obs / BENCH_faults / BENCH_concurrency). "
+                 "Certification is build-time only: the step path "
+                 "never crosses it, and the disarmed gate is one "
+                 "module-global bool check (disarmed_check_ns).",
+        "caveat": "CPU-backend JAX build: compile_ms is the XLA:CPU "
+                  "AOT compile of the fused step; on real TPU the "
+                  "build is strictly slower while the certify cost is "
+                  "host-side and unchanged, so the percentage only "
+                  "falls.",
+    }
+    with open(args.out, "w") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+    print("bench_equiv: %d certificates/build, %.3f ms cert vs %.1f ms "
+          "build (%.4f%%, target <0.5%%) -> %s"
+          % (crossings, armed_ms, compile_ms, pct, args.out))
+    print("  disarmed gate: %.1f ns/check" % disarmed)
+    return 0 if payload["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Deep transform-fuzzing sweeps over seeded random graphs.
+
+Tier-1 runs one bounded :func:`mxtpu.analysis.graphgen.fuzz_round`;
+this tool drives the same machinery wider — more graphs, every catalog
+config, every knob vector — and persists any refutation as a JSON
+regression fixture under ``tests/fixtures/`` so the exact
+``(seed, config)`` replays in the suite forever.
+
+    python tools/fuzz_transforms.py --seed 20260808 --graphs 512
+    python tools/fuzz_transforms.py --seed 7 --graphs 64 \
+        --fixture-dir tests/fixtures
+
+Exit status is non-zero when any graph is refuted, so the sweep can
+gate CI.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="seeded random-graph transform fuzzing (deep sweep)")
+    ap.add_argument("--seed", type=int, default=20260808,
+                    help="master seed (every graph/config derives from "
+                         "it deterministically)")
+    ap.add_argument("--graphs", type=int, default=256,
+                    help="number of random graphs to run")
+    ap.add_argument("--no-numeric", action="store_true",
+                    help="skip the numeric differential (certify only)")
+    ap.add_argument("--fixture-dir", default=None,
+                    help="directory to persist refutation fixtures "
+                         "into (default: tests/fixtures next to the "
+                         "repo root)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="print refutations only")
+    args = ap.parse_args(argv)
+
+    from mxtpu.analysis import graphgen
+    res = graphgen.fuzz_round(args.seed, n_graphs=args.graphs,
+                              numeric=not args.no_numeric)
+    if not args.quiet:
+        for v in res["verdicts"]:
+            print(v)
+    print("fuzz_transforms: %d graph(s), %d refutation(s) "
+          "(master seed %d)"
+          % (res["n_graphs"], len(res["refutations"]), res["master_seed"]))
+    if not res["refutations"]:
+        return 0
+    fdir = args.fixture_dir or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests", "fixtures")
+    os.makedirs(fdir, exist_ok=True)
+    path = os.path.join(
+        fdir, "fuzz_refutation_seed%d.json" % args.seed)
+    with open(path, "w") as fh:
+        json.dump({"master_seed": res["master_seed"],
+                   "n_graphs": res["n_graphs"],
+                   "refutations": [
+                       {"graph_seed": s, "config": list(c),
+                        "verdict": v}
+                       for s, c, v in res["refutations"]]},
+                  fh, indent=2, sort_keys=True)
+    print("refutation fixture written: %s" % path)
+    for s, c, v in res["refutations"]:
+        print("  REFUTED graph_seed=%d config=%s" % (s, ",".join(c)))
+        print("    %s" % v)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

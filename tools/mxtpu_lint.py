@@ -113,6 +113,7 @@ PRAGMA_THREAD = "mxtpu: allow-thread("
 PRAGMA_F64 = "mxtpu: allow-f64("
 PRAGMA_SWALLOW = "mxtpu: allow-swallow("
 PRAGMA_RAW_LOCK = "mxtpu: allow-raw-lock("
+PRAGMA_ALGEBRA = "mxtpu: allow-algebra("
 
 #: threading constructors the unregistered-lock rule polices
 _LOCK_CTORS = {"Lock", "RLock", "Condition"}
@@ -202,12 +203,57 @@ class _Linter(ast.NodeVisitor):
         self.class_stack = []
         self.lock_stack = []
         self.findings = []
+        # transform-registry completeness: TransformPass subclasses
+        # registered via @register_transform, judged post-walk against
+        # the file's CANONICAL_ORDER tuple (the catalog file only)
+        self.transform_classes = []   # (lineno, class, name, algebra)
+        self.canonical_order = None
+        self.canonical_order_line = 0
 
     # ------------------------------------------------------------ scope
     def visit_ClassDef(self, node):
+        if any(self._is_register_transform(d)
+               for d in node.decorator_list):
+            name = algebra = None
+            for stmt in node.body:
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                for tgt in stmt.targets:
+                    if not isinstance(tgt, ast.Name):
+                        continue
+                    if isinstance(stmt.value, ast.Constant) \
+                            and isinstance(stmt.value.value, str):
+                        if tgt.id == "name":
+                            name = stmt.value.value
+                        elif tgt.id == "algebra":
+                            algebra = stmt.value.value
+            self.transform_classes.append(
+                (node.lineno, node.name, name, algebra))
         self.class_stack.append(node.name)
         self.generic_visit(node)
         self.class_stack.pop()
+
+    @staticmethod
+    def _is_register_transform(dec):
+        if isinstance(dec, ast.Call):
+            dec = dec.func
+        if isinstance(dec, ast.Name):
+            return dec.id == "register_transform"
+        return isinstance(dec, ast.Attribute) \
+            and dec.attr == "register_transform"
+
+    def visit_Assign(self, node):
+        if not self.class_stack:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) \
+                        and tgt.id == "CANONICAL_ORDER" \
+                        and isinstance(node.value, ast.Tuple):
+                    names = [e.value for e in node.value.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, str)]
+                    self.canonical_order = tuple(names)
+                    self.canonical_order_line = node.lineno
+        self.generic_visit(node)
 
     def _in_hot_scope(self):
         if self.hot_scopes == "not-hot":
@@ -474,6 +520,47 @@ class _Linter(ast.NodeVisitor):
                     "thread created without daemon=True and the module "
                     "never join()s: give it a join/close lifecycle or "
                     "annotate '# %sreason)'" % PRAGMA_THREAD))
+        # registry completeness: every registered TransformPass must
+        # declare its rewrite algebra (the certification gate refuses
+        # undeclared passes at build time; catch it at lint time), and
+        # the catalog file's passes must all appear in CANONICAL_ORDER
+        catalog_names = set()
+        for lineno, cls, name, algebra in self.transform_classes:
+            if name:
+                catalog_names.add(name)
+            if not algebra \
+                    and not _has_pragma(self.lines, lineno,
+                                        PRAGMA_ALGEBRA):
+                self.findings.append(LintFinding(
+                    "transform-algebra", self.relpath, lineno,
+                    "TransformPass '%s' registered without a declared "
+                    "rewrite algebra: the certification gate will "
+                    "refuse every rewrite it makes; declare "
+                    "'algebra = \"...\"' (mxtpu.analysis.equiv."
+                    "ALGEBRAS) or annotate '# %sreason)'"
+                    % (cls, PRAGMA_ALGEBRA)))
+            if self.canonical_order is not None and name \
+                    and name not in self.canonical_order \
+                    and not _has_pragma(self.lines, lineno,
+                                        PRAGMA_ALGEBRA):
+                self.findings.append(LintFinding(
+                    "transform-algebra", self.relpath, lineno,
+                    "catalog pass '%s' missing from CANONICAL_ORDER: "
+                    "canonical_order() cannot sequence it, so operator "
+                    "pipelines run it in listing order; add it to the "
+                    "tuple or annotate '# %sreason)'"
+                    % (name, PRAGMA_ALGEBRA)))
+        if self.canonical_order is not None and self.transform_classes:
+            for name in self.canonical_order:
+                if name not in catalog_names and not _has_pragma(
+                        self.lines, self.canonical_order_line,
+                        PRAGMA_ALGEBRA):
+                    self.findings.append(LintFinding(
+                        "transform-algebra", self.relpath,
+                        self.canonical_order_line,
+                        "CANONICAL_ORDER names '%s' but no registered "
+                        "TransformPass in this file declares that "
+                        "name" % name))
         return self.findings
 
 
